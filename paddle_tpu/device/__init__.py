@@ -1,0 +1,130 @@
+"""paddle.device namespace. Parity: python/paddle/device/ (incl. cuda shims).
+
+On TPU there are no user-managed streams/events: XLA schedules async
+dispatch. Stream/Event keep API shape; synchronize() blocks on all devices.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (set_device, get_device, device_count, CPUPlace,
+                          TPUPlace, XLAPlace, CUDAPlace,
+                          is_compiled_with_cuda, is_compiled_with_tpu)
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "Stream", "Event", "current_stream", "stream_guard", "cuda",
+           "get_all_device_type", "get_available_device"]
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class Stream:
+    """API-parity stream: XLA owns real scheduling; operations are ordered
+    program-order per device already."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+class _CudaNS:
+    """paddle.device.cuda.* shims routing to the accelerator (TPU)."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _CudaNS.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _CudaNS.memory_allocated(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaNS()

@@ -1,0 +1,15 @@
+"""paddle.distributed.all_reduce. Parity: communication/all_reduce.py."""
+from __future__ import annotations
+
+from ...tensor.tensor import Tensor
+from .group import ReduceOp, _default_group
+
+__all__ = ["all_reduce"]
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _default_group()
+    out = g.pg.allreduce(tensor._data, op)
+    tensor._data = out
+    from .group import Task
+    return Task(out)

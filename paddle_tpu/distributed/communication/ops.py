@@ -1,0 +1,182 @@
+"""Collective functional API.
+
+Parity: python/paddle/distributed/communication/{all_gather,broadcast,reduce,
+scatter,all_to_all,send/recv,batch_isend_irecv}.py + stream/* async variants.
+In-place semantics match the reference (result written back into the given
+tensor / tensor_list).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+from .group import ReduceOp, Task, _default_group
+
+__all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
+           "scatter", "alltoall", "alltoall_single", "send", "recv", "isend",
+           "irecv", "barrier", "reduce_scatter", "stream"]
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = group or _default_group()
+    gathered = g.pg.allgather(tensor._data)  # [nranks, ...]
+    n = g.nranks
+    tensor_list.clear()
+    for i in range(max(n, 1)):
+        tensor_list.append(Tensor(gathered[i] if gathered.ndim > tensor._data.ndim
+                                  else gathered))
+    return Task(gathered)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _default_group()
+    if g.nranks <= 1:
+        object_list.clear()
+        object_list.append(obj)
+        return
+    import numpy as np
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to the max length across ranks
+    ln = Tensor(jnp.asarray([payload.size], jnp.int32))
+    lens = []
+    all_gather(lens, ln, group=g)
+    maxlen = int(max(int(l._data[0]) for l in lens))
+    buf = np.zeros(maxlen, np.uint8)
+    buf[: payload.size] = payload
+    outs = []
+    all_gather(outs, Tensor(jnp.asarray(buf)), group=g)
+    object_list.clear()
+    for t, l in zip(outs, lens):
+        raw = bytes(np.asarray(t._data)[: int(l._data[0])])
+        object_list.append(pickle.loads(raw))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    src_in_group = g.get_group_rank(src) if g.ranks else src
+    out = g.pg.broadcast(tensor._data, max(src_in_group, 0))
+    tensor._data = out
+    return Task(out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _default_group()
+    out = g.pg.allreduce(tensor._data, op)  # all ranks get it; dst semantics kept
+    tensor._data = out
+    return Task(out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return Task()
+    # src rank provides tensor_list; realize as broadcast of the stack + index
+    stacked = (jnp.stack([t._data for t in tensor_list])
+               if tensor_list else jnp.zeros((g.nranks, *tensor.shape),
+                                             tensor.dtype))
+    full = g.pg.broadcast(stacked, max(g.get_group_rank(src), 0))
+    me = max(g.rank, 0)
+    tensor._data = full[me]
+    return Task()
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = group or _default_group()
+    if isinstance(in_tensor_list, Tensor):
+        # tensor-form alltoall
+        out = g.pg.alltoall(in_tensor_list._data)
+        return Tensor(out)
+    stacked = jnp.concatenate([t._data[None] if t.ndim == len(in_tensor_list[0].shape)
+                               else t._data for t in in_tensor_list], axis=0)
+    out = g.pg.alltoall(stacked)
+    n = max(g.nranks, 1)
+    if out_tensor_list is None:
+        out_tensor_list = []
+    out_tensor_list.clear()
+    chunk = out.shape[0] // n
+    for i in range(n):
+        out_tensor_list.append(Tensor(out[i * chunk:(i + 1) * chunk].squeeze(0)
+                                      if chunk == 1 else
+                                      out[i * chunk:(i + 1) * chunk]))
+    return Task(out)
+
+
+def alltoall_single(in_tensor, out_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None, group=None,
+                    sync_op=True):
+    g = group or _default_group()
+    out = g.pg.alltoall(in_tensor._data)
+    if out_tensor is not None:
+        out_tensor._data = out
+        return Task(out)
+    return Tensor(out)
+
+
+# Point-to-point: realized as ppermute pairs (ICI neighbor exchange).
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = group or _default_group()
+    me = max(g.rank, 0)
+    g.pg.permute(tensor._data, [(me, g.get_group_rank(dst) if g.ranks else dst)])
+    return Task()
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    me = max(g.rank, 0)
+    out = g.pg.permute(tensor._data,
+                       [(g.get_group_rank(src) if g.ranks else src, me)])
+    tensor._data = out
+    return Task(out)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def barrier(group=None):
+    g = group or _default_group()
+    return g.pg.barrier()
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = group or _default_group()
+    if tensor_list is not None:
+        stacked = jnp.concatenate([t._data for t in tensor_list], axis=0)
+    else:
+        stacked = tensor._data
+    out = g.pg.reducescatter(stacked, op)
+    tensor._data = out
+    return Task(out)
+
+
+class _StreamNS:
+    """paddle.distributed.stream.* async variants (sync_op=False parity)."""
+
+    @staticmethod
+    def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   use_calc_stream=False):
+        from .all_reduce import all_reduce as _ar
+        return _ar(tensor, op, group, sync_op)
+
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    alltoall_single = staticmethod(alltoall_single)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+    reduce_scatter = staticmethod(reduce_scatter)
+
+
+stream = _StreamNS()

@@ -1,0 +1,173 @@
+"""paddle.Model high-level API. Parity: python/paddle/hapi/model.py
+(Model.prepare/fit/evaluate/predict/save/load + callbacks).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..io import DataLoader
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, no_grad
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[:-1] if len(batch) > 2 else [batch[0]], batch[-1]
+            return [batch[0]], None
+        return [batch], None
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*ins)
+        loss = self._loss(outs, labels) if labels is not None else outs
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(loss.item())]
+        for m in self._metrics:
+            m.update(*m.compute(outs, labels))
+            metrics.append(m.accumulate())
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*ins)
+        loss = self._loss(outs, labels) if labels is not None else outs
+        metrics = [float(loss.item())]
+        for m in self._metrics:
+            m.update(*m.compute(outs, labels))
+            metrics.append(m.accumulate())
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*ins)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_dir=save_dir, save_freq=save_freq,
+                                metrics=self._metrics)
+        for c in cbks:
+            c.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for c in cbks:
+                c.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                for c in cbks:
+                    c.on_train_batch_begin(step)
+                ins, labels = self._split_batch(batch)
+                res = self.train_batch(ins, labels)
+                loss_v = res[0] if isinstance(res, list) else res
+                logs = {"loss": loss_v}
+                for m in self._metrics:
+                    logs[m.name() if callable(getattr(m, "name", None))
+                         else "metric"] = m.accumulate()
+                for c in cbks:
+                    c.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            for c in cbks:
+                c.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=verbose,
+                              callbacks=callbacks)
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        for c in cbks:
+            c.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, labels = self._split_batch(batch)
+            res = self.eval_batch(ins, labels)
+            losses.append(res[0] if isinstance(res, list) else res)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs["loss"] = float(np.mean(losses)) if losses else 0.0
+        for m in self._metrics:
+            logs[getattr(m, "name", lambda: "metric")()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io import load
+        self.network.set_state_dict(load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(load(opt_path))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        info = {"total_params": n_params}
+        print(f"Total params: {n_params:,}")
+        return info

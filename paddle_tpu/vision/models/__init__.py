@@ -1,0 +1,6 @@
+"""Vision model zoo. Parity: python/paddle/vision/models/ (resnet, vgg,
+mobilenet, lenet) + ViT for the benchmark config (BASELINE configs[3])."""
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+from .lenet import LeNet
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenetv2 import MobileNetV2, mobilenet_v2

@@ -1,0 +1,35 @@
+"""Test config: force pure-CPU jax with an 8-device virtual mesh so every
+parallelism test runs without TPU hardware (SURVEY §4's multi-process-on-one-
+host equivalence pattern, realized as multi-device-on-CPU).
+
+The container's sitecustomize registers the axon TPU PJRT plugin in every
+interpreter; initializing that backend claims the exclusive TPU grant and can
+block for minutes. Tests must never touch the tunnel: XLA_FLAGS is set before
+first backend init and jax_platforms is forced to cpu via jax.config (env
+JAX_PLATFORMS=axon is baked into the container, so the config override — which
+wins over the env — is the reliable lever).
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(102)
+    np.random.seed(102)
+    yield
+    from paddle_tpu.tensor.tensor import clear_tape
+    clear_tape()

@@ -1,0 +1,192 @@
+"""Autograd engine tests: analytic grads vs finite differences (the OpTest
+check_grad pattern) + tape semantics (hooks, no_grad, PyLayer, accumulation).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0], rtol=1e-6)
+
+    def test_matmul_grad_vs_numeric(self):
+        a = np.random.randn(3, 4).astype(np.float64).astype(np.float32)
+        b = np.random.randn(4, 2).astype(np.float32)
+        ta = paddle.to_tensor(a, stop_gradient=False)
+        tb = paddle.to_tensor(b, stop_gradient=False)
+        out = paddle.matmul(ta, tb).sum()
+        out.backward()
+
+        def f_a(x):
+            return (x @ b).sum()
+
+        def f_b(x):
+            return (a @ x).sum()
+        np.testing.assert_allclose(ta.grad.numpy(), numeric_grad(f_a, a),
+                                   rtol=1e-2, atol=1e-3)
+        np.testing.assert_allclose(tb.grad.numpy(), numeric_grad(f_b, b),
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y1 = (x * 2).sum()
+        y1.backward(retain_graph=True)
+        y2 = (x * 3).sum()
+        y2.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_branching_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        y = a + b
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 7.0)
+
+    def test_shared_intermediate(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        h = x * x      # used twice
+        y = h + h * 3
+        y.backward()
+        # dy/dx = 4 * d(x^2)/dx = 8x = 16
+        np.testing.assert_allclose(x.grad.numpy(), 16.0)
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 5
+        assert y.stop_gradient
+        y2 = (x * 2).sum()
+        y2.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * 3 + x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_non_scalar_backward_with_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * x
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert x.grad is None
+
+    def test_grad_unused_allow(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        res = paddle.grad(y, [x, z], allow_unused=True)
+        assert res[1] is None
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_pylayer_multi_output(self):
+        class SplitHalf(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2, x * 3
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                return g1 * 2 + g2 * 3
+
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        a, b = SplitHalf.apply(x)
+        (a + b).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+class TestRecompute:
+    def test_recompute_matches_plain(self):
+        from paddle_tpu.distributed.fleet.utils.recompute_mod import recompute
+        lin = paddle.nn.Linear(8, 8)
+        x_np = np.random.randn(4, 8).astype(np.float32)
+
+        x1 = paddle.to_tensor(x_np, stop_gradient=False)
+        out1 = paddle.nn.functional.relu(lin(x1)).sum()
+        out1.backward()
+        g_plain = lin.weight.grad.numpy().copy()
+        gx_plain = x1.grad.numpy().copy()
+        lin.clear_gradients()
+
+        x2 = paddle.to_tensor(x_np, stop_gradient=False)
+        out2 = recompute(lambda t: paddle.nn.functional.relu(lin(t)), x2).sum()
+        out2.backward()
+        np.testing.assert_allclose(lin.weight.grad.numpy(), g_plain,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(x2.grad.numpy(), gx_plain, rtol=1e-5)
+
+    def test_recompute_with_dropout_rng_replay(self):
+        from paddle_tpu.distributed.fleet.utils.recompute_mod import recompute
+        lin = paddle.nn.Linear(16, 16)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                             stop_gradient=False)
+
+        def block(t):
+            return paddle.nn.functional.dropout(
+                paddle.nn.functional.relu(lin(t)), p=0.5, training=True)
+
+        out = recompute(block, x).sum()
+        out.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()

@@ -1,0 +1,244 @@
+"""nn.Layer system + layers vs NumPy/torch-free references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_param_registration(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        m = M()
+        names = dict(m.named_parameters())
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                              "fc2.bias"}
+        assert len(m.parameters()) == 4
+        assert len(m.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(4, 4)
+        m2 = nn.Linear(4, 4)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_array_equal(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.9))
+        x = paddle.ones([10, 4])
+        m.eval()
+        y1 = m(x).numpy()
+        y2 = m(x).numpy()
+        np.testing.assert_array_equal(y1, y2)
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        m(paddle.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        m(paddle.ones([1, 2]))
+        assert calls == [1]
+
+    def test_buffers(self):
+        m = nn.BatchNorm1D(4)
+        bufs = dict(m.named_buffers())
+        assert "_mean" in bufs and "_variance" in bufs
+        sd = m.state_dict()
+        assert "_mean" in sd
+
+    def test_to_dtype(self):
+        import jax.numpy as jnp
+        m = nn.Linear(4, 4)
+        m.bfloat16()
+        assert m.weight.dtype == jnp.bfloat16
+        m.float()
+        assert m.weight.dtype == jnp.float32
+
+
+class TestLayers:
+    def test_linear(self):
+        m = nn.Linear(4, 3)
+        x = np.random.randn(2, 4).astype(np.float32)
+        out = m(paddle.to_tensor(x))
+        expect = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+    def test_embedding(self):
+        m = nn.Embedding(10, 6, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = m(ids)
+        assert out.shape == [1, 3, 6]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(6))
+
+    def test_layernorm_vs_numpy(self):
+        m = nn.LayerNorm(8)
+        x = np.random.randn(4, 8).astype(np.float32)
+        out = m(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        expect = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_rmsnorm(self):
+        m = nn.RMSNorm(8)
+        x = np.random.randn(2, 8).astype(np.float32)
+        out = m(paddle.to_tensor(x)).numpy()
+        expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_batchnorm_train_updates_stats(self):
+        m = nn.BatchNorm1D(4, momentum=0.5)
+        x = paddle.to_tensor(np.random.randn(16, 4).astype(np.float32) + 3.0)
+        m.train()
+        m(x)
+        assert abs(m._mean.numpy().mean() - 1.5) < 1.0  # moved toward 3
+
+    def test_conv2d_vs_manual(self):
+        m = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0  # identity kernel
+        m.weight.set_value(w)
+        x = np.random.randn(1, 1, 5, 5).astype(np.float32)
+        out = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+
+    def test_conv2d_grad(self):
+        m = nn.Conv2D(2, 4, 3)
+        x = paddle.to_tensor(np.random.randn(2, 2, 8, 8).astype(np.float32))
+        out = m(x).sum()
+        out.backward()
+        assert m.weight.grad is not None
+        assert m.weight.grad.shape == [4, 2, 3, 3]
+
+    def test_pools(self):
+        x_np = np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4)
+        x = paddle.to_tensor(x_np)
+        mp = F.max_pool2d(x, 2, 2).numpy()
+        np.testing.assert_allclose(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(x, 2, 2).numpy()
+        np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        ad = F.adaptive_avg_pool2d(x, 1).numpy()
+        np.testing.assert_allclose(ad[0, 0, 0, 0], x_np.mean())
+
+    def test_activations(self):
+        x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy(), np.exp(x) / np.exp(x).sum(), rtol=1e-6)
+        np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+
+    def test_sequential_layerlist(self):
+        s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = s(paddle.ones([1, 4]))
+        assert out.shape == [1, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        assert len(list(ll.parameters())) == 6
+
+
+class TestLosses:
+    def test_cross_entropy_vs_numpy(self):
+        logits = np.random.randn(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, (8,))
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels)).numpy()
+        # numpy reference
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        nll = (lse.squeeze(-1) - logits[np.arange(8), labels]).mean()
+        np.testing.assert_allclose(loss, nll, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels),
+                               ignore_index=-100).numpy()
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        per = lse.squeeze(-1) - logits[np.arange(4), np.maximum(labels, 0)]
+        expect = per[[0, 2]].mean()
+        np.testing.assert_allclose(loss, expect, rtol=1e-5)
+
+    def test_mse_l1(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(6).astype(np.float32)
+        t = (np.random.rand(6) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(z), paddle.to_tensor(t)).numpy()
+        p = 1 / (1 + np.exp(-z))
+        expect = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+class TestAttention:
+    def test_sdpa_matches_reference(self):
+        B, S, H, D = 2, 6, 2, 8
+        q = np.random.randn(B, S, H, D).astype(np.float32)
+        k = np.random.randn(B, S, H, D).astype(np.float32)
+        v = np.random.randn(B, S, H, D).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v)
+        ).numpy()
+        # numpy reference
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(D)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expect = (p @ vt).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        B, S, H, D = 1, 5, 1, 4
+        q = np.random.randn(B, S, H, D).astype(np.float32)
+        k = np.random.randn(B, S, H, D).astype(np.float32)
+        v = np.random.randn(B, S, H, D).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            is_causal=True).numpy()
+        # position 0 attends only to position 0
+        np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_multihead_attention_layer(self):
+        m = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        out = m(x)
+        assert out.shape == [2, 5, 16]
+        out.sum().backward()
+        assert m.q_proj.weight.grad is not None
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                           dim_feedforward=32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]

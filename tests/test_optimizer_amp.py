@@ -1,0 +1,180 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as optim
+
+
+def _quadratic_setup():
+    p = paddle.create_parameter([4], "float32")
+    p.set_value(np.ones(4, np.float32) * 5.0)
+    return p
+
+
+def _step(opt, p, n=1):
+    for _ in range(n):
+        loss = (p * p).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+class TestOptimizers:
+    def test_sgd_descends(self):
+        p = _quadratic_setup()
+        opt = optim.SGD(learning_rate=0.1, parameters=[p])
+        _step(opt, p, 20)
+        assert np.abs(p.numpy()).max() < 1.0
+
+    def test_adamw_descends(self):
+        p = _quadratic_setup()
+        opt = optim.AdamW(learning_rate=0.3, parameters=[p])
+        _step(opt, p, 50)
+        assert np.abs(p.numpy()).max() < 1.0
+
+    def test_adamw_vs_reference_formula(self):
+        # one step of AdamW against hand-computed update
+        p = paddle.create_parameter([2], "float32")
+        p.set_value(np.array([1.0, -2.0], np.float32))
+        lr, b1, b2, eps, wd = 0.1, 0.9, 0.999, 1e-8, 0.01
+        opt = optim.AdamW(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                          weight_decay=wd, parameters=[p])
+        w0 = p.numpy().copy()
+        loss = (p * paddle.to_tensor([3.0, 4.0])).sum()
+        loss.backward()
+        g = np.array([3.0, 4.0], np.float32)
+        opt.step()
+        m = (1 - b1) * g
+        v = (1 - b2) * g * g
+        mhat = m / (1 - b1)
+        vhat = v / (1 - b2)
+        expect = w0 - lr * (mhat / (np.sqrt(vhat) + eps) + wd * w0)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+    def test_momentum(self):
+        p = _quadratic_setup()
+        opt = optim.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=[p])
+        _step(opt, p, 30)
+        assert np.abs(p.numpy()).max() < 2.0
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.create_parameter([3], "float32")
+        p.set_value(np.zeros(3, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optim.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        (p * paddle.to_tensor([30.0, 40.0, 0.0])).sum().backward()
+        opt.step()
+        # grad norm 50 clipped to 1 → update = -[0.6,0.8,0]/50*... = -g/50
+        np.testing.assert_allclose(p.numpy(), [-0.6, -0.8, 0.0], rtol=1e-5)
+
+    def test_optimizer_state_dict_roundtrip(self):
+        p = _quadratic_setup()
+        opt = optim.AdamW(learning_rate=0.1, parameters=[p])
+        _step(opt, p, 3)
+        sd = opt.state_dict()
+        p2 = paddle.create_parameter([4], "float32")
+        p2.name = p.name
+        opt2 = optim.AdamW(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        m1 = opt._acc("moment1", p).numpy()
+        m2 = opt2._acc("moment1", p2).numpy()
+        np.testing.assert_allclose(m1, m2)
+
+    def test_multi_precision_master_weights(self):
+        import jax.numpy as jnp
+        p = paddle.create_parameter([4], "bfloat16")
+        opt = optim.AdamW(learning_rate=0.01, parameters=[p],
+                          multi_precision=True)
+        (p * 2.0).sum().backward()
+        opt.step()
+        master = opt._master_weights[id(p)]
+        assert master.dtype == jnp.float32
+        assert p.dtype == jnp.bfloat16
+
+
+class TestLRSchedulers:
+    def test_cosine(self):
+        s = optim.lr.CosineAnnealingDecay(0.1, T_max=10)
+        vals = []
+        for _ in range(10):
+            vals.append(s())
+            s.step()
+        assert vals[0] == pytest.approx(0.1)
+        assert vals[-1] < vals[0]
+
+    def test_warmup(self):
+        s = optim.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                  end_lr=0.1)
+        first = s()
+        for _ in range(6):
+            s.step()
+        assert first < 0.1
+        assert s() == pytest.approx(0.1)
+
+    def test_scheduler_in_optimizer(self):
+        p = paddle.create_parameter([2], "float32")
+        s = optim.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        opt = optim.SGD(learning_rate=s, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        s.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_noam(self):
+        s = optim.lr.NoamDecay(d_model=128, warmup_steps=10,
+                               learning_rate=1.0)
+        v0 = s()
+        for _ in range(9):
+            s.step()
+        assert s() > v0
+
+
+class TestAMP:
+    def test_auto_cast_o1_bf16_matmul(self):
+        import jax.numpy as jnp
+        a = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+        out2 = paddle.matmul(a, b)
+        assert out2.dtype == jnp.float32
+
+    def test_decorate_o2(self):
+        import jax.numpy as jnp
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        assert model.weight.dtype == jnp.bfloat16
+        assert opt._multi_precision
+
+    def test_grad_scaler_flow(self):
+        model = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        loss = model(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        w0 = model.weight.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        assert not np.allclose(model.weight.numpy(), w0)
+
+    def test_grad_scaler_skips_on_inf(self):
+        model = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        w0 = model.weight.numpy().copy()
+        model.weight.grad = paddle.to_tensor(
+            np.array([[np.inf], [1.0]], np.float32))
+        scaler.unscale_(opt)
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(model.weight.numpy(), w0)
+        assert scaler.get_loss_scaling() == pytest.approx(2.0)

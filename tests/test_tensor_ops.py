@@ -1,0 +1,230 @@
+"""Op tests vs NumPy oracle — the OpTest pattern from
+test/legacy_test/op_test.py (check_output against a NumPy reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return t.numpy()
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(_np(x), [1, 2, 3])
+        assert x.dtype == paddle.float32
+
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).sum() == 0
+        assert _np(paddle.ones([2, 3])).sum() == 6
+        np.testing.assert_allclose(_np(paddle.full([2], 7.5)), [7.5, 7.5])
+
+    def test_arange_linspace(self):
+        np.testing.assert_allclose(_np(paddle.arange(5)), np.arange(5))
+        np.testing.assert_allclose(_np(paddle.linspace(0, 1, 5)),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_allclose(_np(paddle.eye(3)), np.eye(3))
+        a = np.arange(9.0).reshape(3, 3)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.tril(t)), np.tril(a))
+        np.testing.assert_allclose(_np(paddle.triu(t, 1)), np.triu(a, 1))
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(_np(ta + tb), a + b, rtol=1e-6)
+        np.testing.assert_allclose(_np(ta * tb), a * b, rtol=1e-6)
+        np.testing.assert_allclose(_np(ta - tb), a - b, rtol=1e-6)
+        np.testing.assert_allclose(_np(ta / (tb + 10)), a / (b + 10),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(ta.maximum(tb)), np.maximum(a, b))
+
+    def test_unary_ops(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.1
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.exp(t)), np.exp(a), rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.log(t)), np.log(a), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(_np(paddle.sqrt(t)), np.sqrt(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.tanh(t)), np.tanh(a), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(_np(paddle.rsqrt(t)), 1 / np.sqrt(a),
+                                   rtol=1e-4)
+
+    def test_matmul(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(5, 3).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(_np(out), a @ b, rtol=1e-5)
+        out_t = paddle.matmul(paddle.to_tensor(a.T), paddle.to_tensor(b),
+                              transpose_x=True)
+        np.testing.assert_allclose(_np(out_t), a @ b, rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.sum(t)), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.mean(t, axis=1)), a.mean(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.max(t, axis=[0, 2])),
+                                   a.max((0, 2)))
+        np.testing.assert_allclose(_np(t.sum(axis=-1, keepdim=True)),
+                                   a.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.cumsum(t, axis=1)),
+                                   np.cumsum(a, 1), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.clip(t, -0.5, 0.5)),
+                                   np.clip(a, -0.5, 0.5))
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(_np(out), a @ b, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24.0).reshape(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.reshape(t, [6, 4])),
+                                   a.reshape(6, 4))
+        np.testing.assert_allclose(_np(paddle.transpose(t, [2, 0, 1])),
+                                   a.transpose(2, 0, 1))
+        np.testing.assert_allclose(_np(t.T), a.transpose(2, 1, 0))
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(_np(paddle.concat([ta, tb], axis=0)),
+                                   np.concatenate([a, b], 0))
+        np.testing.assert_allclose(_np(paddle.stack([ta, tb], axis=1)),
+                                   np.stack([a, b], 1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(_np(parts[1]), a[:, 1:2])
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        np.testing.assert_allclose(_np(parts[1]), a[:, 1:])
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            _np(paddle.gather(t, paddle.to_tensor(idx))), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(t, paddle.to_tensor(idx), paddle.to_tensor(upd))
+        expect = a.copy()
+        expect[idx] = 1.0
+        np.testing.assert_allclose(_np(out), expect)
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.random.randn(1, 3, 1).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.unsqueeze(t, [0]).shape == [1, 1, 3, 1]
+        np.testing.assert_allclose(_np(paddle.tile(paddle.to_tensor(
+            np.array([1.0, 2.0], np.float32)), [2, 2])),
+            np.tile(np.array([1.0, 2.0]), (2, 2)))
+
+    def test_where_masked_fill(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        cond = paddle.to_tensor(a > 0)
+        out = paddle.where(cond, t, paddle.zeros_like(t))
+        np.testing.assert_allclose(_np(out), np.where(a > 0, a, 0))
+        out = paddle.masked_fill(t, cond, -1.0)
+        np.testing.assert_allclose(_np(out), np.where(a > 0, -1.0, a))
+
+    def test_pad(self):
+        a = np.random.randn(2, 3, 4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        out = paddle.nn.functional.__dict__  # ensure import
+        p = paddle.tensor.manipulation.pad(t, [1, 2, 3, 4])
+        np.testing.assert_allclose(
+            _np(p), np.pad(a, ((0, 0), (0, 0), (1, 2), (3, 4))))
+
+    def test_getitem_setitem(self):
+        a = np.arange(12.0).reshape(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(t[1]), a[1])
+        np.testing.assert_allclose(_np(t[:, 1:3]), a[:, 1:3])
+        t[0, 0] = 99.0
+        assert t.numpy()[0, 0] == 99.0
+
+
+class TestSearchLogic:
+    def test_argmax_topk_sort(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(_np(paddle.argmax(t, axis=1)),
+                                      a.argmax(1))
+        vals, idx = paddle.topk(t, 3, axis=1)
+        np.testing.assert_allclose(_np(vals), np.sort(a, 1)[:, ::-1][:, :3],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.sort(t, axis=1)), np.sort(a, 1))
+
+    def test_logic(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([1.0, 5.0, 3.0], np.float32)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal(_np(paddle.equal(ta, tb)), a == b)
+        assert bool(paddle.allclose(ta, ta))
+        assert not bool(paddle.equal_all(ta, tb))
+
+    def test_unique_nonzero(self):
+        a = np.array([1, 3, 1, 2, 3], np.int64)
+        out = paddle.unique(paddle.to_tensor(a))
+        np.testing.assert_array_equal(_np(out), [1, 2, 3])
+
+
+class TestLinalgStat:
+    def test_norm_inv_det(self):
+        a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3,
+                                                                  dtype=np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.linalg.norm(t)),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.linalg.inv(t)),
+                                   np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_np(paddle.linalg.det(t)),
+                                   np.linalg.det(a), rtol=1e-4)
+
+    def test_stat(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(_np(paddle.std(t)), a.std(ddof=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(paddle.median(t)), np.median(a),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_np(paddle.var(t, axis=0)),
+                                   a.var(0, ddof=1), rtol=1e-5)
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=-2, max=2).numpy()
+        assert u.min() >= -2 and u.max() <= 2
+        r = paddle.randint(0, 10, [50]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
