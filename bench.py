@@ -1,0 +1,98 @@
+"""Benchmark: GPT-2 124M causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Self-baseline protocol per BASELINE.md (reference published numbers are
+unknown; vs_baseline tracks the last recorded run in bench_baseline.json).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt2_124m
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5" if on_tpu else "1"))
+
+    paddle.seed(0)
+    model = gpt2_124m()
+    if on_tpu:
+        model.bfloat16()  # bf16 params; fp32 master weights in AdamW
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    n_params = sum(p.size for p in model.parameters())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # First call traces with slot creation (state superset), second call
+    # recompiles into the steady signature — no eager per-op compile storm.
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    loss._data.block_until_ready()
+
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        loss = train_step(x, y)
+        loss._data.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    tokens_per_sec = batch * seq / med
+
+    # MFU: dense-transformer 6·N·tokens estimate + attention term
+    cfg = model.config
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS",
+                                       "197" if on_tpu else "1"))
+    mfu = (flops_per_token * tokens_per_sec) / (peak_tflops * 1e12)
+
+    baseline_path = os.path.join(os.path.dirname(__file__),
+                                 "bench_baseline.json")
+    vs_baseline = None
+    try:
+        with open(baseline_path) as f:
+            prev = json.load(f).get("value")
+        if prev:
+            vs_baseline = round(tokens_per_sec / prev, 4)
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": vs_baseline,
+        "mfu": round(mfu, 4),
+        "median_step_s": round(med, 5),
+        "batch": batch, "seq": seq, "params": n_params,
+        "device": str(dev), "loss": float(np.asarray(loss._data)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed.models.moe parity surface."""
+from .gate import BaseGate, NaiveGate, GShardGate, SwitchGate
+from .moe_layer import MoELayer, ExpertMLP
+from .grad_clip import ClipGradForMOEByGlobalNorm

@@ -1,0 +1,250 @@
+"""DataLoader / save-load / jit.to_static / hapi tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           TensorDataset)
+
+
+class RangeDS(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i, i * 2]), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(RangeDS(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 2]
+        assert y.shape == [4]
+
+    def test_drop_last_shuffle(self):
+        dl = DataLoader(RangeDS(10), batch_size=4, drop_last=True,
+                        shuffle=True)
+        assert len(list(dl)) == 2
+
+    def test_multiworker_order(self):
+        dl = DataLoader(RangeDS(12), batch_size=3, num_workers=2)
+        xs = [b[0].numpy()[:, 0] for b in dl]
+        flat = np.concatenate(xs)
+        np.testing.assert_array_equal(flat, np.arange(12))
+
+    def test_iterable_dataset(self):
+        class It(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32([i])
+        dl = DataLoader(It(), batch_size=3)
+        bs = list(dl)
+        assert len(bs) == 3
+        assert bs[-1].shape == [1, 1]
+
+    def test_tensor_dataset(self):
+        t = TensorDataset([paddle.ones([6, 2]), paddle.zeros([6])])
+        x, y = t[2]
+        assert x.shape == [2]
+
+    def test_distributed_batch_sampler(self):
+        ds = RangeDS(16)
+        s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+        s3 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=3)
+        idx0 = [i for b in s0 for i in b]
+        idx3 = [i for b in s3 for i in b]
+        assert len(idx0) == len(idx3) == 4
+        assert set(idx0).isdisjoint(idx3)
+        assert len(s0) == 2
+
+    def test_distributed_sampler_epoch_shuffle(self):
+        ds = RangeDS(16)
+        s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                    shuffle=True)
+        s.set_epoch(0)
+        e0 = [i for b in s for i in b]
+        s.set_epoch(1)
+        e1 = [i for b in s for i in b]
+        assert e0 != e1
+
+
+class TestSaveLoad:
+    def test_state_dict_file_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8))
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8))
+        m2.set_state_dict(paddle.load(path))
+        np.testing.assert_array_equal(m[0].weight.numpy(),
+                                      m2[0].weight.numpy())
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=m.parameters())
+        m(paddle.ones([2, 4])).sum().backward()
+        opt.step()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), path)
+        sd = paddle.load(path)
+        assert any("moment1" in k for k in sd)
+
+    def test_nested_structures(self, tmp_path):
+        obj = {"a": paddle.ones([2]), "b": [paddle.zeros([3]), 5],
+               "c": {"d": "text"}}
+        path = str(tmp_path / "obj.pd")
+        paddle.save(obj, path)
+        back = paddle.load(path)
+        np.testing.assert_array_equal(back["a"].numpy(), [1, 1])
+        assert back["b"][1] == 5
+        assert back["c"]["d"] == "text"
+
+    def test_jit_save_load(self, tmp_path):
+        m = nn.Linear(4, 2)
+        path = str(tmp_path / "infer")
+        paddle.jit.save(m, path)
+        loaded = paddle.jit.load(path)
+        assert "weight" in loaded.state_dict()
+
+
+class TestToStatic:
+    def test_forward_cache_single_compile(self):
+        m = nn.Linear(4, 4)
+        calls = []
+        orig_forward = m.forward
+
+        def counting(x):
+            calls.append(1)
+            return orig_forward(x)
+        fwd = paddle.jit.to_static(counting)
+        x = paddle.ones([2, 4])
+        fwd(x)
+        fwd(x)
+        fwd(x)
+        assert len(calls) == 1  # traced once
+
+    def test_shape_polymorphism_recompiles(self):
+        m = nn.Linear(4, 4)
+        fwd = paddle.jit.to_static(lambda x: m(x))
+        a = fwd(paddle.ones([2, 4]))
+        b = fwd(paddle.ones([3, 4]))
+        assert a.shape == [2, 4] and b.shape == [3, 4]
+
+    def test_train_step_state_threading(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        x = paddle.ones([4, 4])
+        y = paddle.zeros([4, 1])
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = paddle.nn.functional.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(x, y).numpy()) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_jit_matches_eager_train(self):
+        def build():
+            paddle.seed(11)
+            m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+            opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                         parameters=m.parameters())
+            return m, opt
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(
+            np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randn(8, 1).astype(
+            np.float32))
+
+        m1, o1 = build()
+        eager_losses = []
+        for _ in range(4):
+            l = paddle.nn.functional.mse_loss(m1(x), y)
+            l.backward()
+            o1.step()
+            o1.clear_grad()
+            eager_losses.append(float(l.numpy()))
+
+        m2, o2 = build()
+
+        @paddle.jit.to_static
+        def step(x, y):
+            l = paddle.nn.functional.mse_loss(m2(x), y)
+            l.backward()
+            o2.step()
+            o2.clear_grad()
+            return l
+        jit_losses = [float(step(x, y).numpy()) for _ in range(4)]
+        np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_dropout_differs_across_jit_calls(self):
+        """RNG key threads through the compiled step as state — two calls
+        must produce different masks (trace-time constant would repeat)."""
+        paddle.seed(5)
+        drop = nn.Dropout(0.5)
+
+        @paddle.jit.to_static
+        def f(x):
+            return drop(x)
+        x = paddle.ones([100])
+        a = f(x).numpy()
+        b = f(x).numpy()
+        assert not np.array_equal(a, b)
+
+
+class TestHapi:
+    def test_model_fit_evaluate(self, tmp_path):
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(2, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+
+        class DS(paddle.io.Dataset):
+            def __getitem__(self, i):
+                x = np.float32([i % 3, (i % 3) * 2])
+                return x, np.int64(i % 3)
+
+            def __len__(self):
+                return 30
+
+        model.fit(DS(), batch_size=10, epochs=3, verbose=0)
+        logs = model.evaluate(DS(), batch_size=10, verbose=0)
+        assert logs["loss"] < 1.2
+        model.save(str(tmp_path / "ckpt"))
+        model.load(str(tmp_path / "ckpt"))
+
+
+class TestStaticAPI:
+    def test_program_executor(self):
+        prog = paddle.static.Program()
+
+        def fwd(x):
+            return x * 2 + 1
+        prog._build_fn = fwd
+        exe = paddle.static.Executor()
+        out = exe.run(prog, feed={"x": np.array([1.0, 2.0], np.float32)},
+                      fetch_list=["out"])
+        np.testing.assert_allclose(out[0], [3.0, 5.0])
+
+    def test_input_spec(self):
+        spec = paddle.static.InputSpec([None, 4], "float32", "x")
+        assert spec.shape == [None, 4]
