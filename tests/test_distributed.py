@@ -90,11 +90,13 @@ class TestCollectivesSPMD:
                                       concat_axis=0, tiled=True)
         out = shard_map(body, mesh=mesh, in_specs=P("ranks", None),
                         out_specs=P("ranks", None))(data)
-        np.testing.assert_allclose(np.asarray(out), data.reshape(8, 8).T.reshape(8, 8).T.T
-                                   if False else np.asarray(out))
-        # row r of output = column r gathered from all ranks
-        np.testing.assert_allclose(np.asarray(out)[0],
-                                   np.arange(64.0).reshape(8, 8)[:, 0])
+        # rank r receives column r from every rank: shard shape (8, 1),
+        # global out shape (64, 1); rows [8r, 8r+8) hold column r.
+        out = np.asarray(out)
+        assert out.shape == (64, 1)
+        src = np.arange(64.0).reshape(8, 8)
+        for r in range(8):
+            np.testing.assert_allclose(out[8 * r:8 * r + 8, 0], src[:, r])
 
 
 class TestEagerCollectivesSingleWorld:
