@@ -40,7 +40,15 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
 
-def _use_pallas(q_shape, dtype) -> bool:
+def _use_pallas(q_shape, k_shape, dtype) -> bool:
+    """Pallas only on TPU (interpret mode off-TPU is slower than the XLA
+    composite); PADDLE_TPU_FORCE_PALLAS=1 overrides for dispatch tests."""
+    import os
+    if jax.default_backend() != "tpu" and \
+            os.environ.get("PADDLE_TPU_FORCE_PALLAS") != "1":
+        return False
+    if q_shape[2] % k_shape[2] != 0:   # GQA requires kv_heads | q_heads
+        return False
     try:
         from ...ops.pallas import flash_attention as fa
         return fa.is_supported(q_shape, dtype)
@@ -53,7 +61,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  training=True, name=None):
     mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
 
-    if mask_arr is None and _use_pallas(tuple(query.shape), query.dtype):
+    if mask_arr is None and _use_pallas(tuple(query.shape), tuple(key.shape),
+                                        query.dtype):
         from ...ops.pallas import flash_attention as fa
 
         def f(q, k, v):

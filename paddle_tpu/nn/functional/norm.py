@@ -15,11 +15,37 @@ __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
            "local_response_norm", "rms_norm"]
 
 
+def _pallas_ln_ok(x, normalized_shape, weight, bias, need_bias=True) -> bool:
+    """Fused-kernel gate: last-dim norm, affine params matching x's dtype,
+    on TPU (the composite promotes mixed dtypes; the kernel keeps x.dtype,
+    so mixed-dtype configs must take the composite for backend parity)."""
+    try:
+        import jax
+        if jax.default_backend() != "tpu":
+            return False
+        from ...ops.pallas import layer_norm as pln
+        if len(tuple(normalized_shape)) != 1 or weight is None:
+            return False
+        if need_bias and bias is None:
+            return False
+        if weight.dtype != x.dtype or (bias is not None
+                                       and bias.dtype != x.dtype):
+            return False
+        return pln.is_supported(tuple(x.shape), x.dtype)
+    except Exception:
+        return False
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     n_axes = len(tuple(normalized_shape))
+
+    if _pallas_ln_ok(x, normalized_shape, weight, bias):
+        from ...ops.pallas import layer_norm as pln
+        return apply_op(lambda a, w, b: pln.layer_norm(a, w, b, epsilon),
+                        x, weight, bias)
 
     def core(a, *wb):
         axes = tuple(range(a.ndim - n_axes, a.ndim))
@@ -44,6 +70,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (LLaMA-family). Stats in fp32, output in input dtype."""
+    if weight is not None and _pallas_ln_ok(x, (x.shape[-1],), weight, None,
+                                            need_bias=False):
+        from ...ops.pallas import layer_norm as pln
+        return apply_op(lambda a, w: pln.rms_norm(a, w, epsilon), x, weight)
+
     def core(a, *w):
         var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
         out = a.astype(jnp.float32) * jnp.reciprocal(jnp.sqrt(var + epsilon))

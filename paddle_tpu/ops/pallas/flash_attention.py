@@ -1,0 +1,395 @@
+"""Flash attention as a TPU Pallas (Mosaic) kernel.
+
+Capability parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu ::
+FlashAttnKernel / flash_attn_grad_kernel.cu (FA-2 wrapper over
+third_party/flashattn).  This is NOT a port of that CUDA: it is the
+blockwise online-softmax algorithm laid out for the TPU memory hierarchy —
+Q/K/V tiles staged in VMEM, the S = QK^T and P·V contractions on the MXU in
+fp32, and the softmax running stats (m, l) carried in VMEM scratch across
+the KV-block grid dimension.
+
+Layout convention follows the reference flash_attn API: [batch, seq,
+num_heads, head_dim]; the wrapper transposes to [B, H, S, D] so the kernel
+works on (seq, head_dim) tiles (last dim = lanes).
+
+Supports: causal masking, GQA/MQA (kv_heads divides q_heads; realized in the
+BlockSpec index_map — zero-copy), bf16/f32 inputs (compute fp32), seq
+lengths not divisible by the block size (masked tail blocks).  Backward is
+the standard two-kernel split: dKV (grid over KV blocks, scan Q) and dQ
+(grid over Q blocks, scan KV), with delta = rowsum(dO * O) precomputed.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "is_supported"]
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def is_supported(q_shape, dtype) -> bool:
+    """Wrapper-level gate: rank-4 [B,S,H,D], supported dtype, head_dim ≤ 256."""
+    if len(q_shape) != 4:
+        return False
+    d = q_shape[-1]
+    if d > 256:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _block_sizes(sq: int, sk: int):
+    bq = min(128, max(8, 1 << (sq - 1).bit_length() if sq < 128 else 128))
+    bk = min(128, max(128 if sk >= 128 else 1 << (sk - 1).bit_length(), 8))
+    return bq, bk
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, sq, sk, bq, bk):
+    # Causal uses bottom-right alignment (FA2 convention): row i attends
+    # key j iff j <= i + sk - sq.
+    offset = sk - sq
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+
+    # Causal: skip blocks strictly above the (aligned) diagonal entirely.
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = cols < sk                      # key-padding tail
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:]                                   # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                             # [bq, bk]
+        p = jnp.where(mask, p, 0.0)
+
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:] = m_new
+        v = v_ref[0, 0].astype(jnp.float32)                # [bk, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)     # padded q rows: garbage-free
+        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_sc[:] + jnp.log(l_safe)      # [bq, 1]
+
+
+def _fwd(q, k, v, *, causal, scale, bq, bk):
+    """q,k,v: [B,H,S,D] (kv may have fewer heads for GQA). Returns (o, lse)."""
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk
+    sq_p = math.ceil(sq / bq) * bq
+    sk_p = math.ceil(k.shape[2] / bk) * bk
+    sk = k.shape[2]
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+
+    grid = (b, h, sq_p // bq, sk_p // bk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               sq=sq, sk=sk, bq=bq, bk=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o[:, :, :sq], lse[:, :, :sq]        # lse: [B, H, Sq, 1]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, sq, sk, bq, bk):
+    offset = sk - sq
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)               # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)               # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                               # [bq, 1]
+        delta = delta_ref[0, 0]                           # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (cols < sk) & (rows < sq)
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk]
+
+        # dv += P^T dO
+        dv_sc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = P * (dO V^T - delta) * scale
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dk += dS^T Q
+        dk_sc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc, *, scale, causal, sq, sk, bq, bk):
+    offset = sk - sq
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                               # [bq, 1]
+        delta = delta_ref[0, 0]                           # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (cols < sk) & (rows < sq)
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, *, causal, scale, bq, bk):
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk
+    sk = k.shape[2]
+    sq_p = math.ceil(sq / bq) * bq
+    sk_p = math.ceil(sk / bk) * bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [B, H, Sq, 1]
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))) \
+            if sq_p != sq else x
+
+    def padk(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))) \
+            if sk_p != sk else x
+
+    q_, do_ = padq(q), padq(do)
+    k_, v_ = padk(k), padk(v)
+    lse_, delta_ = padq(lse), padq(delta)
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, i, g=group: (b_, h_ // g, j, 0))
+    rowspec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+
+    # dK/dV: one [bk,d] accumulator pair per KV block; Q scanned innermost.
+    # GQA: compute per-Q-head dk/dv (shape [B,H,...]) and segment-sum to
+    # [B,Hk,...] outside the kernel — XLA turns that into a cheap reshape-sum.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          sq=sq, sk=sk, bq=bq, bk=bk),
+        grid=(b, h, sk_p // bk, sq_p // bq),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_, k_, v_, do_, lse_, delta_)
+
+    qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec2 = pl.BlockSpec((1, 1, bk, d),
+                          lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          sq=sq, sk=sk, bq=bq, bk=bk),
+        grid=(b, h, sq_p // bq, sk_p // bk),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q_, k_, v_, do_, lse_, delta_)
+
+    dq = dq[:, :, :sq]
+    dk = dk[:, :, :sk]
+    dv = dv[:, :, :sk]
+    if group > 1:
+        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API (custom_vjp; [B, S, H, D] layout like the reference flash_attn)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    o, _ = _core_fwd(q, k, v, causal, scale)
+    return o
+
+
+def _core_fwd(q, k, v, causal, scale):
+    bq, bk = _block_sizes(q.shape[2], k.shape[2])
+    return _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    o, lse = _core_fwd(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v, o, lse = res
+    bq, bk = _block_sizes(q.shape[2], k.shape[2])
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, causal=causal, scale=scale,
+                      bq=bq, bk=bk)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """q,k,v: [batch, seq, heads, head_dim] (kv heads may divide q heads).
+
+    Returns [batch, seq, heads, head_dim]; differentiable (custom VJP with
+    flash backward kernels).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}) for GQA flash attention")
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash(qt, kt, vt, bool(causal), float(scale))
+    return jnp.swapaxes(o, 1, 2)
