@@ -20,9 +20,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tensor.tensor import Tensor, persistent_tensors
+from .context_parallel import (ring_attention, ulysses_attention,
+                               make_ring_attention_fn,
+                               make_ulysses_attention_fn)
 
 __all__ = ["apply_shardings", "shard_batch", "data_spec", "current_mesh",
-           "with_spec"]
+           "with_spec", "ring_attention", "ulysses_attention",
+           "make_ring_attention_fn", "make_ulysses_attention_fn"]
 
 
 def current_mesh() -> Optional[Mesh]:
