@@ -176,6 +176,40 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     return F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
 
 
+def _decode_attn(q, cache, ts, s, attn_mask):
+    """Cache attention for the decode step. TPU: the Pallas flash-decode
+    kernel over the full static-shape cache with length masking (no
+    per-step recompiles); fallback: dense sdpa over the valid prefix."""
+    import os
+    use_pallas = attn_mask is None and (
+        jax.default_backend() == "tpu" or
+        os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1")
+    if use_pallas:
+        from ...ops.pallas import decode_attention as da
+        kc = cache._data[0]          # [B, H, Smax, D]
+        if da.is_supported(tuple(q.shape),
+                           (kc.shape[0], kc.shape[2], kc.shape[1], kc.shape[3]),
+                           q.dtype):
+            # inference-only kernel (no VJP) — bypass the autograd tape
+            lens = jnp.full((q.shape[0],), ts, jnp.int32)
+            out = da.decode_attention(
+                jax.lax.stop_gradient(q._data),
+                jnp.swapaxes(jax.lax.stop_gradient(cache._data[0]), 1, 2),
+                jnp.swapaxes(jax.lax.stop_gradient(cache._data[1]), 1, 2),
+                lens)
+            return Tensor(out)
+    k_full = Tensor(jnp.swapaxes(cache._data[0, :, :, :ts + s], 1, 2))
+    v_full = Tensor(jnp.swapaxes(cache._data[1, :, :, :ts + s], 1, 2))
+    if attn_mask is None and s > 1:
+        # match the kernel path: new token r attends the prefix plus new
+        # tokens <= r (causal among the chunk)
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(ts + s)[None, :]
+        attn_mask = Tensor((cols <= ts + rows)[None, None])
+    return F.scaled_dot_product_attention(q, k_full, v_full,
+                                          attn_mask=attn_mask)
+
+
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             linear_weights, linear_biases, ffn_ln_scales,
                             ffn_ln_biases, ffn1_weights, ffn1_biases,
@@ -232,10 +266,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 c = c.at[1, :, :, ts:ts + s].set(jnp.swapaxes(vv, 1, 2))
                 return c
             cache._data = upd(cache._data, k._data, v._data)
-            k_full = Tensor(jnp.swapaxes(cache._data[0, :, :, :ts + s], 1, 2))
-            v_full = Tensor(jnp.swapaxes(cache._data[1, :, :, :ts + s], 1, 2))
-            attn = F.scaled_dot_product_attention(q, k_full, v_full,
-                                                  attn_mask=attn_mask)
+            attn = _decode_attn(q, cache, ts, s, attn_mask)
             new_caches.append(cache)
         else:
             attn = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,

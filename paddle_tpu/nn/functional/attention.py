@@ -12,14 +12,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...core.rng import next_key
 from ...tensor.tensor import Tensor, apply_op
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
-def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
-    """Composite attention: [B,S,H,D] layout; fp32 softmax for stability."""
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, dropout_key=None):
+    """Composite attention: [B,S,H,D] layout; fp32 softmax for stability.
+    Attention dropout (reference: dropout on the softmax probs, upscaled)
+    is applied when dropout_p > 0 and a key is supplied."""
     qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -36,6 +39,10 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
         else:
             logits = logits + mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
@@ -60,22 +67,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
     mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    drop_p = float(dropout_p) if training else 0.0
 
-    if mask_arr is None and _use_pallas(tuple(query.shape), tuple(key.shape),
-                                        query.dtype):
+    if drop_p == 0.0 and mask_arr is None and \
+            _use_pallas(tuple(query.shape), tuple(key.shape), query.dtype):
         from ...ops.pallas import flash_attention as fa
 
         def f(q, k, v):
             return fa.flash_attention(q, k, v, causal=is_causal)
         return apply_op(f, query, key, value)
 
+    key_ = next_key() if drop_p > 0.0 else None
+
     def f(q, k, v, *m):
-        return _sdpa_ref(q, k, v, m[0] if m else None, dropout_p, is_causal,
-                         None)
+        return _sdpa_ref(q, k, v, m[0] if m else None, drop_p, is_causal,
+                         None, dropout_key=key_)
     if attn_mask is not None:
-        return apply_op(lambda q, k, v, m: _sdpa_ref(q, k, v, m, dropout_p,
-                                                     is_causal, None),
-                        query, key, value, attn_mask)
+        return apply_op(f, query, key, value, attn_mask)
     return apply_op(f, query, key, value)
 
 

@@ -21,7 +21,9 @@ def _pallas_ln_ok(x, normalized_shape, weight, bias, need_bias=True) -> bool:
     so mixed-dtype configs must take the composite for backend parity)."""
     try:
         import jax
-        if jax.default_backend() != "tpu":
+        import os
+        if jax.default_backend() != "tpu" and \
+                os.environ.get("PADDLE_TPU_FORCE_PALLAS") != "1":
             return False
         from ...ops.pallas import layer_norm as pln
         if len(tuple(normalized_shape)) != 1 or weight is None:
