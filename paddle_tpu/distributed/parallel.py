@@ -38,8 +38,56 @@ def _maybe_jax_distributed_init():
     pid = int(os.environ.get("PADDLE_TRAINER_ID",
                              os.environ.get("JAX_PROCESS_ID", "0")))
     if coord:
+        _store_barrier(coord, n, pid)
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=n, process_id=pid)
+
+
+def _store_barrier(coord: str, world: int, rank: int):
+    """Pre-init rendezvous over the native TCPStore (csrc/runtime.cc —
+    parity: paddle/fluid/distributed/store/tcp_store.cc): rank 0 runs the
+    master daemon one port above the coordinator port, every rank registers
+    and waits until all are present, so jax.distributed.initialize never
+    races a late-starting coordinator. Best-effort: skipped when the native
+    runtime is unavailable."""
+    try:
+        from ..core.native import TCPStore, TCPStoreServer
+    except Exception:
+        return
+    import logging
+    try:
+        host, port = coord.rsplit(":", 1)
+        store_port = int(port) + 1
+        if rank == 0:
+            try:
+                srv = TCPStoreServer(store_port)
+                _state["_store_server"] = srv   # keep alive for the job
+            except OSError as e:
+                logging.warning(
+                    "paddle_tpu: TCPStore barrier master failed to bind "
+                    "port %d (%s); skipping pre-init rendezvous", store_port,
+                    e)
+                return
+        # bounded connect: if the master never comes up, fall through to
+        # jax.distributed.initialize (which has its own retry) instead of
+        # stalling the job for the full store timeout
+        c = TCPStore(host, store_port,
+                     timeout_s=float(os.environ.get(
+                         "PADDLE_STORE_CONNECT_TIMEOUT", "15")))
+        c.add("init/count", 1)
+        if rank == 0:
+            while c.get("init/count") is None or \
+                    int.from_bytes(c.get("init/count")[:8], "little",
+                                   signed=True) < world:
+                import time
+                time.sleep(0.05)
+            c.set("init/ready", b"1")
+        c.wait("init/ready", timeout_s=float(os.environ.get(
+            "PADDLE_STORE_TIMEOUT", "300")))
+        c.close()
+    except Exception as e:
+        logging.warning("paddle_tpu: TCPStore pre-init rendezvous skipped "
+                        "(%s)", e)
 
 
 def init_parallel_env():

@@ -349,15 +349,40 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def _iter_workers(self):
+        """Multi-worker prefetch. Workers share one scaffolding; the
+        ready-batch handoff prefers the native bounded queue
+        (csrc/runtime.cc — blocks in C with the GIL released, bounded
+        capacity = prefetch back-pressure, the reference's buffered-reader
+        behavior) and falls back to a Python condition variable. Worker
+        exceptions propagate to the consumer; waiting never times out while
+        any worker is alive."""
+        try:
+            from ..core.native import NativeQueue
+            nq = NativeQueue(max(self.num_workers * self.prefetch_factor, 2))
+        except Exception:
+            nq = None
+
         idx_queue: "queue.Queue" = queue.Queue()
         out: dict[int, object] = {}
-        out_lock = threading.Lock()
-        out_cv = threading.Condition(out_lock)
+        out_cv = threading.Condition(threading.Lock())
         batches = list(self.batch_sampler)
         for i, b in enumerate(batches):
             idx_queue.put((i, b))
         n_total = len(batches)
         stop = threading.Event()
+
+        class _WorkerError:
+            def __init__(self, exc):
+                self.exc = exc
+
+        def publish(i, data):
+            with out_cv:
+                out[i] = data
+                out_cv.notify_all()
+            if nq is not None:
+                while not stop.is_set():
+                    if nq.put(i + 1, timeout_s=1.0):   # tokens are 1-based
+                        break
 
         def worker(wid):
             _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
@@ -368,20 +393,51 @@ class DataLoader:
                     i, b = idx_queue.get_nowait()
                 except queue.Empty:
                     return
-                data = self._fetch(b)
-                with out_cv:
-                    out[i] = data
-                    out_cv.notify_all()
+                try:
+                    data = self._fetch(b)
+                except BaseException as e:    # propagate to consumer
+                    publish(i, _WorkerError(e))
+                    return
+                publish(i, data)
 
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(self.num_workers)]
         for t in threads:
             t.start()
+
+        def take(i):
+            if nq is not None:
+                while i not in take.ready:
+                    tok = nq.get(timeout_s=1.0)
+                    if tok is not None:
+                        take.ready.add(tok - 1)
+                    elif not any(t.is_alive() for t in threads) \
+                            and i not in out:
+                        raise RuntimeError(
+                            f"DataLoader workers died before batch {i}")
+                take.ready.discard(i)
+                with out_cv:
+                    return out.pop(i)
+            with out_cv:
+                while i not in out:
+                    if not out_cv.wait(timeout=1.0) and \
+                            not any(t.is_alive() for t in threads) \
+                            and i not in out:
+                        raise RuntimeError(
+                            f"DataLoader workers died before batch {i}")
+                return out.pop(i)
+        take.ready = set()
+
         try:
             for i in range(n_total):
-                with out_cv:
-                    while i not in out:
-                        out_cv.wait(timeout=60.0)
-                    yield out.pop(i)
+                data = take(i)
+                if isinstance(data, _WorkerError):
+                    raise data.exc
+                yield data
         finally:
             stop.set()
+            if nq is not None:
+                nq.close()
+                for t in threads:
+                    t.join(timeout=5.0)
+                nq.free()

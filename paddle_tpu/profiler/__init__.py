@@ -17,9 +17,15 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from ..core.native import NativeTracer
+
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
            "SummaryView"]
+
+# Host span collector (C++, csrc/runtime.cc — parity with the reference's
+# native host tracer); None-safe when the toolchain is absent.
+_host_tracer = NativeTracer()
 
 
 class ProfilerTarget(Enum):
@@ -85,12 +91,14 @@ class RecordEvent:
     def begin(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        _host_tracer.begin(self.name)
         self.begin_ns = time.perf_counter_ns()
 
     def end(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        _host_tracer.end()
         self.end_ns = time.perf_counter_ns()
 
     def __enter__(self):
@@ -134,6 +142,7 @@ class Profiler:
                 self._active = True
             except Exception:
                 self._active = False
+            _host_tracer.enable(True)
         self._t0 = time.perf_counter()
 
     def stop(self):
@@ -143,6 +152,12 @@ class Profiler:
             except Exception:
                 pass
             self._active = False
+        if _host_tracer.available and not self.timer_only:
+            # chrome trace of host spans alongside the XPlane dump
+            os.makedirs(self._log_dir(), exist_ok=True)
+            _host_tracer.dump(os.path.join(self._log_dir(),
+                                           "host_trace.json"))
+            _host_tracer.enable(False)
         if self.on_trace_ready:
             self.on_trace_ready(self)
 
