@@ -45,6 +45,14 @@ from . import incubate
 from . import vision
 from . import profiler
 from . import hapi
+from . import metric
+from . import regularizer
+from . import distribution
+from . import fft
+from . import signal
+from . import version
+from . import inference
+from . import text
 from .hapi.model import Model
 from .framework.io import save, load
 from .framework.layer_helpers import DataParallel

@@ -117,10 +117,18 @@ class Optimizer:
     def _decayed(self, p, g32, m32):
         """L2-regularizer-style weight decay folded into the gradient
         (Paddle's `weight_decay=L2Decay(...)` semantics for non-AdamW)."""
-        wd = self._weight_decay
+        # per-param ParamAttr regularizer overrides the optimizer-level one
+        # (reference precedence: python/paddle/regularizer.py docstring)
+        reg = getattr(p, "regularizer", None)
+        wd = self._weight_decay if reg is None else reg
         if wd is None:
             return g32
-        coeff = getattr(wd, "_coeff", wd if isinstance(wd, float) else 0.0)
+        reg = wd
+        if callable(reg) and not isinstance(reg, float):
+            return reg(g32, m32)
+        coeff = getattr(reg, "_coeff",
+                        getattr(reg, "coeff",
+                                reg if isinstance(reg, float) else 0.0))
         return g32 + coeff * m32
 
     def clear_grad(self, set_to_zero: bool = False):

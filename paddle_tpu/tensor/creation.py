@@ -179,6 +179,18 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     from ..nn.initializer import Constant, XavierNormal
     init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    if attr is not None and getattr(attr, "initializer", None) is not None \
+            and default_initializer is None:
+        init = attr.initializer
     dt = convert_dtype(dtype) or get_default_dtype()
     arr = init(_shape(shape), dt)
-    return Parameter(arr, name=name, dtype=dt)
+    p = Parameter(arr, name=name or getattr(attr, "name", None), dtype=dt)
+    if attr is not None:
+        # carry ParamAttr knobs the optimizer consults (per-param
+        # regularizer precedence, lr scaling, trainability)
+        p.regularizer = getattr(attr, "regularizer", None)
+        if getattr(attr, "learning_rate", None) is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+        if getattr(attr, "trainable", True) is False:
+            p.stop_gradient = True
+    return p

@@ -1,0 +1,146 @@
+"""metric / regularizer / distribution / fft / signal / version / elastic
+(SURVEY §2.6-2.7 inventory lines)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestMetric:
+    def test_accuracy_stream(self):
+        m = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [1]], np.int64))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        assert abs(m.accumulate() - 0.5) < 1e-6
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_precision_recall(self):
+        p = paddle.metric.Precision()
+        r = paddle.metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7], np.float32)
+        labels = np.array([1, 0, 1, 1], np.int64)
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        auc = paddle.metric.Auc()
+        preds = np.array([0.9, 0.8, 0.1, 0.2], np.float32)
+        labels = np.array([1, 1, 0, 0], np.int64)
+        auc.update(preds, labels)
+        assert auc.accumulate() > 0.99
+
+    def test_functional_accuracy(self):
+        acc = paddle.metric.accuracy(
+            paddle.to_tensor(np.array([[0.1, 0.9], [0.9, 0.1]], np.float32)),
+            paddle.to_tensor(np.array([[1], [0]], np.int64)))
+        assert float(acc._data) == 1.0
+
+
+class TestRegularizer:
+    def test_l2_decay_changes_update(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m1 = nn.Linear(4, 4)
+        m2 = nn.Linear(4, 4)
+        m2.set_state_dict(m1.state_dict())
+        o1 = paddle.optimizer.Momentum(0.1, parameters=m1.parameters(),
+                                       weight_decay=None)
+        o2 = paddle.optimizer.Momentum(
+            0.1, parameters=m2.parameters(),
+            weight_decay=paddle.regularizer.L2Decay(0.5))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for m, o in ((m1, o1), (m2, o2)):
+            loss = m(x).sum()
+            loss.backward()
+            o.step()
+        w1 = np.asarray(m1.weight._data)
+        w2 = np.asarray(m2.weight._data)
+        assert not np.allclose(w1, w2)
+
+
+class TestDistribution:
+    def test_normal_logprob_entropy_kl(self):
+        d = paddle.distribution.Normal(0.0, 1.0)
+        lp = float(d.log_prob(paddle.to_tensor(0.0))._data)
+        assert abs(lp - (-0.5 * np.log(2 * np.pi))) < 1e-5
+        e = float(d.entropy()._data)
+        assert abs(e - 0.5 * (1 + np.log(2 * np.pi))) < 1e-5
+        d2 = paddle.distribution.Normal(1.0, 2.0)
+        kl = float(paddle.distribution.kl_divergence(d, d2)._data)
+        assert kl > 0
+
+    def test_sampling_shapes_and_determinism(self):
+        paddle.seed(3)
+        d = paddle.distribution.Normal(np.zeros(3, np.float32),
+                                       np.ones(3, np.float32))
+        s = d.sample((5,))
+        assert s.shape == [5, 3]
+        c = paddle.distribution.Categorical(
+            np.log(np.array([0.999, 0.001], np.float32)))
+        draws = c.sample((100,))
+        assert np.asarray(draws._data).mean() < 0.1
+        b = paddle.distribution.Bernoulli(np.float32(0.0))
+        assert float(b.sample()._data) == 0.0
+
+
+class TestFFT:
+    def test_roundtrip(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8).astype(
+            np.float32))
+        y = paddle.fft.fft(x)
+        z = paddle.fft.ifft(y)
+        np.testing.assert_allclose(np.asarray(z._data).real,
+                                   np.asarray(x._data), atol=1e-5)
+
+    def test_rfft_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(1).randn(16).astype(
+            np.float32), stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        mag = (y.abs() ** 2).sum()
+        mag.backward()
+        assert x.grad is not None
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        sig = np.sin(np.arange(256) * 0.1).astype(np.float32)[None]
+        x = paddle.to_tensor(sig)
+        spec = paddle.signal.stft(x, n_fft=64, hop_length=16)
+        rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                  length=256)
+        np.testing.assert_allclose(np.asarray(rec._data)[0, 8:-8],
+                                   sig[0, 8:-8], atol=1e-4)
+
+
+class TestVersionAndElastic:
+    def test_version(self):
+        assert paddle.version.full_version
+        assert paddle.version.cuda() is False
+
+    def test_elastic_membership(self):
+        from paddle_tpu.core.native import load_native
+        if load_native() is None:
+            pytest.skip("native runtime unavailable")
+        from paddle_tpu.distributed.fleet.elastic.manager import (
+            ElasticManager, ElasticStatus)
+        m = ElasticManager(server="", np="1:4")
+        m.enable = True
+        m._connect()
+        m.register()
+        assert m.worker_id in m.alive_workers()
+        assert m.watch() == ElasticStatus.HOLD          # first observation
+        assert m.watch() == ElasticStatus.HOLD          # unchanged
+        m.exit()
+
+    def test_elastic_disabled_noop(self):
+        from paddle_tpu.distributed.fleet.elastic.manager import (
+            ElasticManager, ElasticStatus)
+        m = ElasticManager()
+        assert not m.enable
+        m.register()
+        assert m.watch() == ElasticStatus.COMPLETED
