@@ -68,6 +68,11 @@ class ElasticManager:
             h, p = self.server.rsplit(":", 1)
             port = int(p)
         else:
+            if self.worker_id != "0":
+                raise RuntimeError(
+                    "PADDLE_ELASTIC_ENABLE=1 without PADDLE_ELASTIC_SERVER: "
+                    "only rank 0 can run the membership store locally; set "
+                    "PADDLE_ELASTIC_SERVER=host:port on every worker")
             h, port = "127.0.0.1", 0
         if self.worker_id == "0" and (not self.server
                                       or h in ("127.0.0.1", self.host)):
@@ -81,6 +86,11 @@ class ElasticManager:
     def _hb_key(self, wid=None):
         return f"elastic/heartbeat/{wid if wid is not None else self.worker_id}"
 
+    # liveness is judged from heartbeat COUNTER progress observed with the
+    # watcher's own clock (no cross-host wall-clock comparison — NTP skew
+    # between pod hosts would otherwise eat directly into the timeout)
+    _seen: dict = None
+
     # ------------------------------------------------------------ lifecycle
     def register(self):
         """Register this worker + start the heartbeat thread."""
@@ -93,7 +103,7 @@ class ElasticManager:
         self._hb_thread.start()
 
     def _beat(self):
-        self._client.set(self._hb_key(), repr(time.time()).encode())
+        self._client.add(self._hb_key(), 1)
 
     def _hb_loop(self):
         while not self._stop.is_set():
@@ -104,14 +114,25 @@ class ElasticManager:
             self._stop.wait(self.heartbeat_interval)
 
     def alive_workers(self, timeout: float = ELASTIC_TIMEOUT):
-        """Worker ids whose heartbeat is fresher than `timeout` seconds."""
+        """Worker ids whose heartbeat counter advanced within `timeout`
+        seconds of the watcher's clock (skew-free: progress, not wall time,
+        is compared across hosts)."""
         if not self.enable:
             return [self.worker_id]
-        now = time.time()
+        if self._seen is None:
+            self._seen = {}
+        now = time.monotonic()
         alive = []
         for wid in range(self.np_max):
             v = self._client.get(self._hb_key(wid))
-            if v is not None and now - float(v.decode()) < timeout:
+            if v is None or len(v) < 8:
+                continue
+            count = int.from_bytes(v[:8], "little", signed=True)
+            prev = self._seen.get(wid)
+            if prev is None or count > prev[0]:
+                self._seen[wid] = (count, now)
+                alive.append(str(wid))
+            elif now - prev[1] < timeout:
                 alive.append(str(wid))
         return alive
 

@@ -15,7 +15,7 @@ __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
 def _wrap1(name):
     jfn = getattr(jnp.fft, name)
 
-    def fn(x, n=None, axis=-1, norm="backward", name_=None):
+    def fn(x, n=None, axis=-1, norm="backward", name=None):
         return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
     fn.__name__ = name
     return fn
@@ -24,7 +24,7 @@ def _wrap1(name):
 def _wrap2(name, axes_default=(-2, -1)):
     jfn = getattr(jnp.fft, name)
 
-    def fn(x, s=None, axes=axes_default, norm="backward", name_=None):
+    def fn(x, s=None, axes=axes_default, norm="backward", name=None):
         return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
     fn.__name__ = name
     return fn

@@ -112,7 +112,12 @@ class StaticFunction:
     """Compiled wrapper around an eager function (dygraph → XLA program)."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, donate_state: bool = True, static_argnames=None):
+                 backend=None, donate_state: bool = None, static_argnames=None):
+        if donate_state is None:
+            # default off until the buffer-donation path is re-verified on
+            # the tunnel TPU backend; opt in per-function or via env
+            import os
+            donate_state = os.environ.get("PADDLE_TPU_DONATE") == "1"
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._input_spec = input_spec
@@ -156,6 +161,22 @@ class StaticFunction:
         saved_grads = [(t, t.grad) for t in state]
         try:
             out_arrays, new_state = jitted(state_arrays, arg_arrays)
+        except Exception as e:
+            _tape.nodes[:] = saved_nodes
+            for t, arr in zip(state, state_arrays):
+                t._data = arr
+            for t, g in saved_grads:
+                t.grad = g
+            if self._donate_state:
+                # execution-time failure after donation: the restored arrays
+                # may already be deleted — say so instead of surfacing a
+                # bare "Array has been deleted" later
+                raise RuntimeError(
+                    "to_static step failed after state buffers were donated; "
+                    "persistent state may be invalid. Re-create the model/"
+                    "optimizer or use to_static(donate_state=False) for "
+                    "rollback-on-error semantics.") from e
+            raise
         finally:
             _tape.nodes[:] = saved_nodes
             for t, arr in zip(state, state_arrays):
@@ -194,7 +215,14 @@ class StaticFunction:
             _tape.nodes.clear()
             return out_arrays, new_state
 
-        jitted = jax.jit(pure)
+        # donate the state buffers: params/optimizer slots update in place
+        # (XLA aliases input->output), halving steady-state HBM traffic for
+        # the weight update; callers never read the pre-step arrays again
+        # (writeback below replaces every tensor's _data with the outputs).
+        # Opt out with to_static(donate_state=False) to keep pre-step arrays
+        # valid (e.g. external references, or rollback-on-error semantics).
+        donate = (0,) if self._donate_state else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
         entry = (jitted, out_spec_box, state_after_box)
         self._cache[key] = entry
         return entry
@@ -218,15 +246,18 @@ def _spec_key(spec):
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
     """Decorator/wrapper compiling an eager function into one XLA program."""
+    donate = kwargs.get("donate_state", None)
+
     def decorate(fn):
         if isinstance(fn, StaticFunction):
             return fn
         from ..nn.layer.layers import Layer
         if isinstance(fn, Layer):
             layer = fn
-            layer.forward = StaticFunction(layer.forward, input_spec)
+            layer.forward = StaticFunction(layer.forward, input_spec,
+                                           donate_state=donate)
             return layer
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, donate_state=donate)
     if function is not None:
         return decorate(function)
     return decorate

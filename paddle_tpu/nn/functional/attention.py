@@ -69,12 +69,26 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
     drop_p = float(dropout_p) if training else 0.0
 
-    if drop_p == 0.0 and mask_arr is None and \
+    # dropout routing: the flash kernel supports dropout (in-kernel PRNG /
+    # seed-regenerated mask), but its Mosaic compile at large shapes is
+    # currently far slower than the composite's; opt in with
+    # PADDLE_TPU_FLASH_DROPOUT=1 (e.g. long sequences where the composite's
+    # O(S^2) probs would not fit)
+    import os
+    flash_drop_ok = drop_p == 0.0 or \
+        os.environ.get("PADDLE_TPU_FLASH_DROPOUT") == "1"
+    if mask_arr is None and flash_drop_ok and \
             _use_pallas(tuple(query.shape), tuple(key.shape), query.dtype):
         from ...ops.pallas import flash_attention as fa
+        seed = None
+        if drop_p > 0.0:
+            import jax.random as jrandom
+            seed = jrandom.randint(next_key(), (), 0, 2 ** 31 - 1,
+                                   dtype=jnp.int32)
 
         def f(q, k, v):
-            return fa.flash_attention(q, k, v, causal=is_causal)
+            return fa.flash_attention(q, k, v, causal=is_causal,
+                                      dropout_p=drop_p, dropout_seed=seed)
         return apply_op(f, query, key, value)
 
     key_ = next_key() if drop_p > 0.0 else None

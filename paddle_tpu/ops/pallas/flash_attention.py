@@ -57,8 +57,35 @@ def _block_sizes(sq: int, sk: int):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *, scale, causal, sq, sk, bq, bk):
+def _drop_tile(seed_ref, bi, hi, qi, ki, bq, bk, dropout_p):
+    """Scaled keep multiplier generated in-kernel (TPU hardware PRNG, zero
+    HBM traffic); seeded per (call, batch, head, q-block, k-block) so the
+    backward kernels regenerate the identical mask. Mosaic takes at most 2
+    seed words — fold the block coordinates into one."""
+    nh = pl.num_programs(1)
+    # q/k block counts differ between the three kernels' grids, but the
+    # (qi, ki) pair itself is kernel-invariant; fold with fixed strides
+    # large enough for any block count
+    tile_id = ((bi * nh + hi) * 4096 + qi) * 4096 + ki
+    pltpu.prng_seed(seed_ref[0], tile_id)
+    bits = pltpu.prng_random_bits((bq, bk)).astype(jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return jnp.where(bits >= thresh, 1.0 / (1.0 - dropout_p), 0.0)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, sq, sk, bq, bk,
+                drop_mode=0, dropout_p=0.0):
+    # drop_mode: 0 = no dropout, 1 = mask input (interpret), 2 = in-kernel
+    # PRNG (TPU). Mode 1/2 append dmask / SMEM seed to the inputs.
+    if drop_mode == 1:
+        dmask_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        dmask_ref = None
+    else:
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        dmask_ref = seed_ref = None
     # Causal uses bottom-right alignment (FA2 convention): row i attends
     # key j iff j <= i + sk - sq.
     offset = sk - sq
@@ -104,6 +131,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_sc[:] = m_new
+        # dropout on the softmax probs (post-normalization semantics: the
+        # l denominator above uses the raw p)
+        if dmask_ref is not None:
+            p = p * dmask_ref[0, 0]
+        elif seed_ref is not None:
+            p = p * _drop_tile(seed_ref, pl.program_id(0), pl.program_id(1),
+                               qi, ki, bq, bk, dropout_p)
         v = v_ref[0, 0].astype(jnp.float32)                # [bk, d]
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -118,8 +152,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = m_sc[:] + jnp.log(l_safe)      # [bq, 1]
 
 
-def _fwd(q, k, v, *, causal, scale, bq, bk):
-    """q,k,v: [B,H,S,D] (kv may have fewer heads for GQA). Returns (o, lse)."""
+def _fwd(q, k, v, drop=None, *, causal, scale, bq, bk):
+    """q,k,v: [B,H,S,D] (kv may have fewer heads for GQA). Returns (o, lse).
+    drop: None, ('mask', dmask [B,H,Sq_p,Sk_p] f32) or ('prng', seed, p)."""
     b, h, sq, d = q.shape
     hk = k.shape[1]
     group = h // hk
@@ -133,18 +168,30 @@ def _fwd(q, k, v, *, causal, scale, bq, bk):
         v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
 
     grid = (b, h, sq_p // bq, sk_p // bk)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               sq=sq, sk=sk, bq=bq, bk=bk)
+    drop_mode = 0 if drop is None else (1 if drop[0] == "mask" else 2)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, sq=sq, sk=sk, bq=bq, bk=bk,
+        drop_mode=drop_mode,
+        dropout_p=drop[2] if drop_mode == 2 else 0.0)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+    ]
+    args = [q, k, v]
+    if drop_mode == 1:
+        in_specs.append(pl.BlockSpec((1, 1, bq, bk),
+                                     lambda b_, h_, i, j: (b_, h_, i, j)))
+        args.append(drop[1])
+    elif drop_mode == 2:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.reshape(drop[1].astype(jnp.int32), (1,)))
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
@@ -159,7 +206,7 @@ def _fwd(q, k, v, *, causal, scale, bq, bk):
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return o[:, :, :sq], lse[:, :, :sq]        # lse: [B, H, Sq, 1]
 
 
@@ -168,8 +215,17 @@ def _fwd(q, k, v, *, causal, scale, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc,
-                    *, scale, causal, sq, sk, bq, bk):
+                    *rest, scale, causal, sq, sk, bq, bk, drop_mode=0,
+                    dropout_p=0.0):
+    if drop_mode == 1:
+        dmask_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        dmask_ref = None
+    else:
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
+        dmask_ref = seed_ref = None
     offset = sk - sq
     ki = pl.program_id(2)
     qi = pl.program_id(3)
@@ -204,13 +260,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             mask = mask & (cols <= rows + offset)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk]
 
-        # dv += P^T dO
+        if dmask_ref is not None:
+            dm = dmask_ref[0, 0]
+        elif seed_ref is not None:
+            # same (b, h, q-block, k-block) seeding as the forward kernel
+            dm = _drop_tile(seed_ref, pl.program_id(0), pl.program_id(1),
+                            qi, ki, bq, bk, dropout_p)
+        else:
+            dm = None
+        # dv += (D∘P)^T dO
+        pd = p * dm if dm is not None else p
         dv_sc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # ds = P * (dO V^T - delta) * scale
+        # ds = P * (D∘(dO V^T) - delta) * scale   (delta = rowsum(dO∘O)
+        # absorbs the dropout mask exactly — see derivation in _flash_bwd)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dm is not None:
+            dp = dp * dm
         ds = p * (dp - delta) * scale
         # dk += dS^T Q
         dk_sc[:] += jax.lax.dot_general(
@@ -224,7 +292,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_sc, *, scale, causal, sq, sk, bq, bk):
+                   *rest, scale, causal, sq, sk, bq, bk, drop_mode=0,
+                   dropout_p=0.0):
+    if drop_mode == 1:
+        dmask_ref, dq_ref, dq_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, dq_ref, dq_sc = rest
+        dmask_ref = None
+    else:
+        dq_ref, dq_sc = rest
+        dmask_ref = seed_ref = None
     offset = sk - sq
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -259,6 +337,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dmask_ref is not None:
+            dp = dp * dmask_ref[0, 0]
+        elif seed_ref is not None:
+            dp = dp * _drop_tile(seed_ref, pl.program_id(0),
+                                 pl.program_id(1), qi, ki, bq, bk, dropout_p)
         ds = p * (dp - delta) * scale
         dq_sc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -269,13 +352,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, *, causal, scale, bq, bk):
+def _bwd(q, k, v, o, lse, do, drop=None, *, causal, scale, bq, bk):
     b, h, sq, d = q.shape
     hk = k.shape[1]
     group = h // hk
     sk = k.shape[2]
     sq_p = math.ceil(sq / bq) * bq
     sk_p = math.ceil(sk / bk) * bk
+    drop_mode = 0 if drop is None else (1 if drop[0] == "mask" else 2)
+    drop_p = drop[2] if drop_mode == 2 else 0.0
+
+    def drop_arg():
+        if drop_mode == 1:
+            return drop[1]
+        return jnp.reshape(drop[1].astype(jnp.int32), (1,))
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)              # [B, H, Sq, 1]
@@ -300,11 +390,21 @@ def _bwd(q, k, v, o, lse, do, *, causal, scale, bq, bk):
     # dK/dV: one [bk,d] accumulator pair per KV block; Q scanned innermost.
     # GQA: compute per-Q-head dk/dv (shape [B,H,...]) and segment-sum to
     # [B,Hk,...] outside the kernel — XLA turns that into a cheap reshape-sum.
+    dkv_in = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    dkv_args = [q_, k_, v_, do_, lse_, delta_]
+    if drop_mode == 1:
+        dkv_in.append(pl.BlockSpec((1, 1, bq, bk),
+                                   lambda b_, h_, j, i: (b_, h_, i, j)))
+        dkv_args.append(drop_arg())
+    elif drop_mode == 2:
+        dkv_in.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_args.append(drop_arg())
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          sq=sq, sk=sk, bq=bq, bk=bk),
+                          sq=sq, sk=sk, bq=bq, bk=bk, drop_mode=drop_mode,
+                          dropout_p=drop_p),
         grid=(b, h, sk_p // bk, sq_p // bq),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=dkv_in,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
@@ -318,23 +418,33 @@ def _bwd(q, k, v, o, lse, do, *, causal, scale, bq, bk):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q_, k_, v_, do_, lse_, delta_)
+    )(*dkv_args)
 
     qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
     kspec2 = pl.BlockSpec((1, 1, bk, d),
                           lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0))
     rowspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq_in = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    dq_args = [q_, k_, v_, do_, lse_, delta_]
+    if drop_mode == 1:
+        dq_in.append(pl.BlockSpec((1, 1, bq, bk),
+                                  lambda b_, h_, i, j: (b_, h_, i, j)))
+        dq_args.append(drop_arg())
+    elif drop_mode == 2:
+        dq_in.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_args.append(drop_arg())
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          sq=sq, sk=sk, bq=bq, bk=bk),
+                          sq=sq, sk=sk, bq=bq, bk=bk, drop_mode=drop_mode,
+                          dropout_p=drop_p),
         grid=(b, h, sq_p // bq, sk_p // bk),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        in_specs=dq_in,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda b_, h_, i, j: (b_, h_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q_, k_, v_, do_, lse_, delta_)
+    )(*dq_args)
 
     dq = dq[:, :, :sq]
     dk = dk[:, :, :sk]
@@ -349,38 +459,71 @@ def _bwd(q, k, v, o, lse, do, *, causal, scale, bq, bk):
 # Public API (custom_vjp; [B, S, H, D] layout like the reference flash_attn)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    o, _ = _core_fwd(q, k, v, causal, scale)
+def _dropout_mask(seed, shape, dropout_p):
+    """Scaled keep-mask [B,H,Sq_p,Sk_p] regenerated identically fwd/bwd from
+    the int32 seed — the residual is the seed, not the O(S^2) mask (the
+    philox-offset recompute trick of the reference FA2, done with the JAX
+    PRNG at the XLA level)."""
+    key = jax.random.PRNGKey(seed)
+    keep = jax.random.bernoulli(key, 1.0 - dropout_p, shape)
+    return keep.astype(jnp.float32) / (1.0 - dropout_p)
+
+
+def _padded_sizes(sq, sk):
+    bq, bk = _block_sizes(sq, sk)
+    return bq, bk, math.ceil(sq / bq) * bq, math.ceil(sk / bk) * bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, seed, causal, scale, dropout_p):
+    o, _ = _core_fwd(q, k, v, seed, causal, scale, dropout_p)
     return o
 
 
-def _core_fwd(q, k, v, causal, scale):
-    bq, bk = _block_sizes(q.shape[2], k.shape[2])
-    return _fwd(q, k, v, causal=causal, scale=scale, bq=bq, bk=bk)
+def _make_drop(q, k, seed, dropout_p):
+    """TPU: in-kernel PRNG (zero HBM mask traffic); interpret: explicit
+    seed-regenerated mask array (prng_* primitives have no CPU lowering)."""
+    if dropout_p <= 0.0:
+        return None
+    if not _interpret():
+        return ("prng", seed, dropout_p)
+    bq, bk, sq_p, sk_p = _padded_sizes(q.shape[2], k.shape[2])
+    return ("mask",
+            _dropout_mask(seed, (q.shape[0], q.shape[1], sq_p, sk_p),
+                          dropout_p))
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    o, lse = _core_fwd(q, k, v, causal, scale)
-    return o, (q, k, v, o, lse)
+def _core_fwd(q, k, v, seed, causal, scale, dropout_p):
+    bq, bk, _, _ = _padded_sizes(q.shape[2], k.shape[2])
+    drop = _make_drop(q, k, seed, dropout_p)
+    return _fwd(q, k, v, drop, causal=causal, scale=scale, bq=bq, bk=bk)
 
 
-def _flash_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
-    bq, bk = _block_sizes(q.shape[2], k.shape[2])
-    dq, dk, dv = _bwd(q, k, v, o, lse, g, causal=causal, scale=scale,
+def _flash_fwd(q, k, v, seed, causal, scale, dropout_p):
+    o, lse = _core_fwd(q, k, v, seed, causal, scale, dropout_p)
+    return o, (q, k, v, o, lse, seed)
+
+
+def _flash_bwd(causal, scale, dropout_p, res, g):
+    q, k, v, o, lse, seed = res
+    bq, bk, _, _ = _padded_sizes(q.shape[2], k.shape[2])
+    drop = _make_drop(q, k, seed, dropout_p)
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, drop, causal=causal, scale=scale,
                       bq=bq, bk=bk)
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
+def flash_attention(q, k, v, causal=False, scale=None, dropout_p=0.0,
+                    dropout_seed=None):
     """q,k,v: [batch, seq, heads, head_dim] (kv heads may divide q heads).
 
     Returns [batch, seq, heads, head_dim]; differentiable (custom VJP with
-    flash backward kernels).
+    flash backward kernels). dropout_p > 0 applies attention-prob dropout
+    (upscaled) with a seed-regenerated mask — pass dropout_seed (int32
+    scalar, traced ok) for reproducibility.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -391,5 +534,8 @@ def flash_attention(q, k, v, causal=False, scale=None):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o = _flash(qt, kt, vt, bool(causal), float(scale))
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((), jnp.int32)
+    o = _flash(qt, kt, vt, dropout_seed, bool(causal), float(scale),
+               float(dropout_p))
     return jnp.swapaxes(o, 1, 2)

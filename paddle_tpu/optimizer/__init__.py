@@ -2,15 +2,6 @@
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
                         Adadelta, RMSProp, Lamb)
-
-
-class L2Decay:
-    """Parity: paddle.regularizer.L2Decay."""
-
-    def __init__(self, coeff=0.0):
-        self._coeff = coeff
-
-
-class L1Decay:
-    def __init__(self, coeff=0.0):
-        self._coeff = coeff
+# single source of truth for regularizers (paddle.regularizer); re-exported
+# here for the legacy paddle.optimizer.L1Decay/L2Decay spelling
+from ..regularizer import L1Decay, L2Decay

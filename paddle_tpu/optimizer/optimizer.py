@@ -100,7 +100,10 @@ class Optimizer:
             lr = self.get_lr()
             self._step_count += 1
             for p, g in params_grads:
-                self._update_param(p, g, lr)
+                # per-param lr scaling from ParamAttr(learning_rate=...)
+                scale = getattr(p, "optimize_attr", None)
+                p_lr = lr * scale["learning_rate"] if scale else lr
+                self._update_param(p, g, p_lr)
 
     def _update_param(self, p: Parameter, g: Tensor, lr: float):
         raise NotImplementedError

@@ -135,12 +135,13 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
                                     (k_cur, v_cur))
         return (o_acc, lse_acc, k_nxt, v_nxt), None
 
-    b, _, h, d = q.shape
-    # initial accumulators must carry the same varying-over-axis type as the
-    # per-step outputs (jax>=0.8 vma typing inside shard_map)
-    o0 = jax.lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
-    lse0 = jax.lax.pvary(jnp.full((b, h, sq), _NEG_INF, jnp.float32),
-                         (axis_name,))
+    # initial accumulators must carry the same varying-over-axes type as the
+    # per-step outputs (jax>=0.8 vma typing inside shard_map); deriving them
+    # from q inherits q's full vma set (e.g. (pp, sep) when nested inside a
+    # pipeline shard_map), which a bare pvary over axis_name would not
+    zero_q = q.astype(jnp.float32) * 0.0
+    o0 = zero_q
+    lse0 = jnp.swapaxes(zero_q[..., 0], 1, 2) + _NEG_INF   # [B,H,Sq]
     (o, _, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),
                                    jnp.arange(n))
     return o.astype(q.dtype)

@@ -10,6 +10,11 @@ class WeightDecayRegularizer:
     def __init__(self, coeff=0.0):
         self.coeff = float(coeff)
 
+    # legacy alias: optimizer code paths read `_coeff`
+    @property
+    def _coeff(self):
+        return self.coeff
+
     def __call__(self, grad_arr, param_arr):
         raise NotImplementedError
 

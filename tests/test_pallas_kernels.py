@@ -212,3 +212,75 @@ def test_decode_attention_vs_dense(sq, group):
     ref = jnp.einsum("bhqk,bkhd->bqhd", p, vr)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+class TestFlashDropout:
+    """Flash attention with seed-regenerated dropout (fwd/bwd mask parity)."""
+
+    def _qkv(self, b=2, s=32, h=2, d=16):
+        rng = np.random.RandomState(5)
+        mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        return mk(), mk(), mk()
+
+    def test_matches_reference_with_same_mask(self):
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        q, k, v = self._qkv()
+        p_drop, seed = 0.3, jnp.asarray(7, jnp.int32)
+        out = fa.flash_attention(q, k, v, causal=True, dropout_p=p_drop,
+                                 dropout_seed=seed)
+        # reference with the identical regenerated mask
+        bq, bk, sq_p, sk_p = fa._padded_sizes(q.shape[1], k.shape[1])
+        dm = fa._dropout_mask(seed, (q.shape[0], q.shape[2], sq_p, sk_p),
+                              p_drop)[:, :, :q.shape[1], :k.shape[1]]
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+        msk = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s_ = jnp.where(msk[None, None], s_, -1e30)
+        p_ = jax.nn.softmax(s_, axis=-1) * dm
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p_, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_reference(self):
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        q, k, v = self._qkv(s=16)
+        p_drop, seed = 0.25, jnp.asarray(3, jnp.int32)
+        bq, bk, sq_p, sk_p = fa._padded_sizes(q.shape[1], k.shape[1])
+        dm = fa._dropout_mask(seed, (q.shape[0], q.shape[2], sq_p, sk_p),
+                              p_drop)[:, :, :q.shape[1], :k.shape[1]]
+
+        def loss_flash(q, k, v):
+            o = fa.flash_attention(q, k, v, causal=True, dropout_p=p_drop,
+                                   dropout_seed=seed)
+            return jnp.sum(o ** 2)
+
+        def loss_ref(q, k, v):
+            s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+            msk = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+            s_ = jnp.where(msk[None, None], s_, -1e30)
+            p_ = jax.nn.softmax(s_, axis=-1) * dm
+            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p_, v) ** 2)
+
+        gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_sdpa_routes_dropout_to_flash(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
+        monkeypatch.setenv("PADDLE_TPU_FLASH_DROPOUT", "1")
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        paddle.seed(4)
+        q = paddle.to_tensor(np.random.randn(1, 16, 2, 16).astype(np.float32),
+                             stop_gradient=False)
+        k = paddle.to_tensor(np.random.randn(1, 16, 2, 16).astype(np.float32))
+        v = paddle.to_tensor(np.ones((1, 16, 2, 16), np.float32))
+        o_drop = F.scaled_dot_product_attention(q, k, v, dropout_p=0.9,
+                                                training=True, is_causal=True)
+        o_ref = F.scaled_dot_product_attention(q.detach(), k, v,
+                                               dropout_p=0.0, is_causal=True)
+        assert not np.allclose(np.asarray(o_drop._data),
+                               np.asarray(o_ref._data))
+        o_drop.sum().backward()
+        assert q.grad is not None

@@ -1,0 +1,75 @@
+"""paddle.text: Viterbi decoding + local-file datasets."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (Imikolov, UCIHousing, ViterbiDecoder,
+                             viterbi_decode)
+
+
+def brute_force_viterbi(pot, trans, bos, eos):
+    """Enumerate all paths (small N, T)."""
+    import itertools
+    t, n = pot.shape
+    best, best_path = -1e30, None
+    for path in itertools.product(range(n), repeat=t):
+        s = trans[bos, path[0]] + pot[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        s += trans[path[-1], eos]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        n, t, b = 3, 5, 2
+        pot = rng.randn(b, t, n).astype(np.float32)
+        trans = rng.randn(n + 2, n + 2).astype(np.float32)
+        scores, paths = viterbi_decode(pot, trans)
+        for i in range(b):
+            ref_s, ref_p = brute_force_viterbi(pot[i], trans, n, n + 1)
+            assert abs(float(np.asarray(scores._data)[i]) - ref_s) < 1e-4
+            assert list(np.asarray(paths._data)[i]) == ref_p
+
+    def test_decoder_class(self):
+        rng = np.random.RandomState(1)
+        pot = rng.randn(1, 4, 3).astype(np.float32)
+        trans = rng.randn(5, 5).astype(np.float32)
+        dec = ViterbiDecoder(trans)
+        scores, paths = dec(pot)
+        assert paths.shape == [1, 4]
+
+
+class TestDatasets:
+    def test_ucihousing(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.rand(50, 14)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, data)
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imikolov(self, tmp_path):
+        d = tmp_path / "simple-examples" / "data"
+        os.makedirs(d)
+        (d / "ptb.train.txt").write_text(
+            "the cat sat on the mat\nthe dog sat on the cat\n" * 30)
+        tar = tmp_path / "ptb.tgz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(tmp_path / "simple-examples", arcname="simple-examples")
+        ds = Imikolov(data_file=str(tar), window_size=3, min_word_freq=5)
+        assert len(ds) > 0
+        assert len(ds[0]) == 3
+
+    def test_missing_file_clear_error(self):
+        with pytest.raises(FileNotFoundError, match="data_file"):
+            UCIHousing(data_file=None)
