@@ -4,3 +4,11 @@ from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .lenet import LeNet
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
+from .alexnet import AlexNet, alexnet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                           shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+from .googlenet import GoogLeNet, googlenet

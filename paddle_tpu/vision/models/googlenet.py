@@ -1,0 +1,69 @@
+"""GoogLeNet (Inception v1). Parity: python/paddle/vision/models/googlenet.py."""
+from __future__ import annotations
+
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_relu(in_ch, out_ch, k, stride=1, padding=0):
+    return Sequential(Conv2D(in_ch, out_ch, k, stride=stride,
+                             padding=padding), ReLU())
+
+
+class Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(in_ch, c1, 1)
+        self.b2 = Sequential(_conv_relu(in_ch, c3r, 1),
+                             _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_conv_relu(in_ch, c5r, 1),
+                             _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             _conv_relu(in_ch, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            _conv_relu(64, 64, 1),
+            _conv_relu(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc4 = Sequential(
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc5 = Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = Dropout(0.2)
+        self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        x = self.dropout(flatten(self.pool(x), start_axis=1))
+        return self.fc(x)
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

@@ -217,3 +217,37 @@ class TestFusedLayers:
         np.testing.assert_allclose(
             np.linalg.norm(q.numpy(), axis=-1),
             np.linalg.norm(q2.numpy(), axis=-1), rtol=1e-4)
+
+
+class TestVisionZooAdditions:
+    """New zoo families forward on tiny inputs (SURVEY §2.6 vision zoo)."""
+
+    def _run(self, model, size=64):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, size, size).astype(
+                np.float32))
+        model.eval()
+        out = model(x)
+        assert out.shape == [1, 10]
+        assert np.isfinite(np.asarray(out._data)).all()
+
+    def test_alexnet(self):
+        from paddle_tpu.vision.models import alexnet
+        self._run(alexnet(num_classes=10), size=128)
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+        self._run(squeezenet1_1(num_classes=10), size=64)
+
+    def test_densenet(self):
+        from paddle_tpu.vision.models import densenet121
+        self._run(densenet121(num_classes=10), size=64)
+
+    def test_shufflenet(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_25
+        self._run(shufflenet_v2_x0_25(num_classes=10), size=64)
+
+    def test_googlenet(self):
+        from paddle_tpu.vision.models import googlenet
+        self._run(googlenet(num_classes=10), size=64)

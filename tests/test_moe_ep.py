@@ -1,0 +1,63 @@
+"""MoE expert parallelism: dispatch utility ops + EP-sharded forward vs
+single-device oracle (SURVEY §2.4 MoE alltoall ops, §2.5 EP)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.distributed.models.moe import (
+    MoELayer, number_count, limit_by_capacity, prune_gate_by_capacity,
+    random_routing)
+
+
+class TestDispatchOps:
+    def test_number_count(self):
+        out = number_count(np.array([0, 2, 2, 5, -1, 2]), 6)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      [1, 0, 3, 0, 0, 1])
+
+    def test_limit_by_capacity(self):
+        # 2 workers × 3 experts, capacity [2, 10, 1]
+        ec = np.array([2, 3, 1,   2, 2, 2])
+        out = limit_by_capacity(ec, np.array([2, 10, 1]), n_worker=2)
+        # expert0: w0 takes 2, w1 gets 0; expert1: all pass; expert2: w0=1,w1=0
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      [2, 3, 1, 0, 2, 0])
+
+    def test_prune_gate_by_capacity(self):
+        gate = np.array([0, 0, 1, 0, 1])
+        ec = np.array([2, 2])       # expert0 cap 2, expert1 cap 2
+        out = prune_gate_by_capacity(gate, ec, n_expert=2, n_worker=1)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      [0, 0, 1, -1, 1])
+
+    def test_random_routing(self):
+        idx = np.array([[0, 1], [2, 3]])
+        val = np.array([[0.9, 0.4], [0.8, 0.05]], np.float32)
+        prob = np.array([0.5, 0.5], np.float32)
+        out = random_routing(idx, val, prob)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      [[0, 1], [2, -1]])
+
+
+class TestMoEForwardEP:
+    def test_ep_sharded_matches_single_device(self):
+        """Expert-dim sharding over an 8-device mesh produces the same
+        output as unsharded execution (GSPMD inserts the all-to-all)."""
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=8, gate="naive",
+                       top_k=2)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8, 16).astype(np.float32))
+        ref = moe(x)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        # shard expert-stacked params over the mesh; input replicated
+        for p in moe.experts.parameters():
+            p._data = jax.device_put(p._data,
+                                     NamedSharding(mesh, P("dp")))
+        out = moe(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), atol=1e-5,
+                                   rtol=1e-5)
