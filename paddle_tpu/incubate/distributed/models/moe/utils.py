@@ -25,7 +25,7 @@ def _arr(x):
 def number_count(numbers, upper_range):
     """Histogram of expert indices: [N] int -> [upper_range] counts."""
     n = _arr(numbers).astype(jnp.int32)
-    counts = jnp.zeros((upper_range,), jnp.int64).at[
+    counts = jnp.zeros((upper_range,), jnp.int32).at[
         jnp.clip(n, 0, upper_range - 1)].add(jnp.where(
             (n >= 0) & (n < upper_range), 1, 0))
     return Tensor(counts)
@@ -36,8 +36,8 @@ def limit_by_capacity(expert_count, capacity, n_worker=1):
     expert_count: [n_worker * n_expert] ordered worker-major (reference
     layout); capacity: [n_expert]. Returns the clamped counts — workers
     consume a shared capacity in worker order."""
-    ec = _arr(expert_count).astype(jnp.int64)
-    cap = _arr(capacity).astype(jnp.int64)
+    ec = _arr(expert_count).astype(jnp.int32)
+    cap = _arr(capacity).astype(jnp.int32)
     n_expert = cap.shape[0]
     grid = ec.reshape(n_worker, n_expert)
 
@@ -45,7 +45,7 @@ def limit_by_capacity(expert_count, capacity, n_worker=1):
         # prefix allocation in worker order
         cum = jnp.cumsum(counts_e)
         allowed_end = jnp.minimum(cum, cap_e)
-        allowed_start = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+        allowed_start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                          allowed_end[:-1]])
         return allowed_end - allowed_start
 
@@ -58,11 +58,11 @@ def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1):
     gate_idx: [N] expert assignment per token (order = arrival order);
     expert_count: [n_worker*n_expert] clamped counts."""
     gi = _arr(gate_idx).astype(jnp.int32)
-    ec = _arr(expert_count).astype(jnp.int64)
+    ec = _arr(expert_count).astype(jnp.int32)
     total = ec.reshape(n_worker, n_expert).sum(0)
 
     # rank of each token within its expert (stable arrival order)
-    one_hot = jax.nn.one_hot(gi, n_expert, dtype=jnp.int64)
+    one_hot = jax.nn.one_hot(gi, n_expert, dtype=jnp.int32)
     rank = (jnp.cumsum(one_hot, axis=0) * one_hot).sum(-1) - 1   # [N]
     keep = rank < jnp.take(total, jnp.clip(gi, 0, n_expert - 1))
     return Tensor(jnp.where(keep & (gi >= 0), gi, -1))
@@ -91,13 +91,17 @@ def global_scatter(x, local_count, global_count, group=None):
     (moe_layer.py) is the jit path where GSPMD inserts the same exchange
     automatically — this explicit op exists for the eager collective-API
     parity tests."""
-    from .....distributed import get_world_size
-    if group is None and get_world_size() <= 1:
-        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
     arr = _arr(x)
-    axis = getattr(group, "axis_name", "dp") if group is not None else "dp"
-    out = jax.lax.all_to_all(arr, axis, split_axis=0, concat_axis=0,
-                             tiled=True)
+    axis = getattr(group, "axis_name", None) if group is not None else None
+    if group is not None and getattr(group, "nranks", 1) <= 1:
+        return x if isinstance(x, Tensor) else Tensor(arr)
+    try:
+        out = jax.lax.all_to_all(arr, axis or "dp", split_axis=0,
+                                 concat_axis=0, tiled=True)
+    except NameError:
+        # axis name not bound — eager call outside shard_map/pmap, where
+        # the locally-dispatched buffer already IS the exchange result
+        out = arr
     return Tensor(out) if isinstance(x, Tensor) else out
 
 

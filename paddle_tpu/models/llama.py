@@ -40,7 +40,7 @@ class LlamaConfig:
                  max_position=4096, rms_eps=1e-5, rope_base=10000.0,
                  initializer_range=0.02, tensor_parallel=True,
                  sequence_parallel=False, recompute=False,
-                 tie_word_embeddings=False):
+                 tie_word_embeddings=False, context_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -55,6 +55,10 @@ class LlamaConfig:
         self.sequence_parallel = sequence_parallel
         self.recompute = recompute
         self.tie_word_embeddings = tie_word_embeddings
+        # long-context: shard the sequence over the mesh's 'sep' axis and
+        # run exact ring attention (parallel/context_parallel.py) instead of
+        # gathering the full sequence per chip
+        self.context_parallel = context_parallel
 
 
 def _attr(std):
@@ -69,6 +73,8 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_kv_heads
         self.head_dim = c.hidden_size // c.num_heads
         self.rope_base = c.rope_base
+        self.context_parallel = c.context_parallel
+        self._ring_cache = None
         h = c.hidden_size
         kv_out = self.num_kv_heads * self.head_dim
         std = c.initializer_range
@@ -97,6 +103,20 @@ class LlamaAttention(Layer):
             self.o_proj = Linear(h, h, weight_attr=_attr(std),
                                  bias_attr=False)
 
+    def _ring_fn(self):
+        """Ring attention over the active mesh's 'sep' axis (cached per
+        mesh); None when no sep-parallel mesh is active."""
+        from ..parallel import current_mesh
+        mesh = current_mesh()
+        if mesh is None or "sep" not in mesh.shape or mesh.shape["sep"] < 2:
+            return None
+        if getattr(self, "_ring_cache", None) is None or \
+                self._ring_cache[0] is not mesh:
+            from ..parallel.context_parallel import make_ring_attention_fn
+            self._ring_cache = (mesh, make_ring_attention_fn(
+                mesh, axis_name="sep", causal=True))
+        return self._ring_cache[1]
+
     def forward(self, x, kv_cache=None, time_step=None):
         b, s = x.shape[0], x.shape[1]
         q = reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
@@ -111,6 +131,9 @@ class LlamaAttention(Layer):
         if kv_cache is not None:
             k_cat, v_cat, kv_cache = _append_cache(kv_cache, k, v, time_step)
             out = F.scaled_dot_product_attention(q, k_cat, v_cat)
+        elif self.context_parallel and self._ring_fn() is not None:
+            fn = self._ring_fn()
+            out = apply_op(fn, q, k, v)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = reshape(out, [b, s, self.num_heads * self.head_dim])

@@ -20,7 +20,7 @@ _CFG = {121: (64, 32, (6, 12, 24, 16)),
 
 
 class _DenseLayer(Layer):
-    def __init__(self, in_ch, growth, bn_size=4):
+    def __init__(self, in_ch, growth, bn_size=4, dropout=0.0):
         super().__init__()
         self.norm1 = BatchNorm2D(in_ch)
         self.relu = ReLU()
@@ -28,10 +28,14 @@ class _DenseLayer(Layer):
         self.norm2 = BatchNorm2D(bn_size * growth)
         self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
                             bias_attr=False)
+        from ...nn.layer.common import Dropout
+        self.dropout = Dropout(dropout) if dropout > 0 else None
 
     def forward(self, x):
         out = self.conv1(self.relu(self.norm1(x)))
         out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
         return concat([x, out], axis=1)
 
 
@@ -59,7 +63,7 @@ class DenseNet(Layer):
         stages = []
         for i, n in enumerate(blocks):
             for _ in range(n):
-                stages.append(_DenseLayer(ch, growth, bn_size))
+                stages.append(_DenseLayer(ch, growth, bn_size, dropout))
                 ch += growth
             if i != len(blocks) - 1:
                 stages.append(_Transition(ch, ch // 2))
@@ -67,13 +71,18 @@ class DenseNet(Layer):
         self.blocks = Sequential(*stages)
         self.norm_final = BatchNorm2D(ch)
         self.relu = ReLU()
-        self.pool = AdaptiveAvgPool2D((1, 1))
-        self.classifier = Linear(ch, num_classes)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1)) if with_pool else None
+        self.classifier = Linear(ch, num_classes) if num_classes > 0 else None
 
     def forward(self, x):
-        x = self.blocks(self.stem(x))
-        x = self.pool(self.relu(self.norm_final(x)))
-        return self.classifier(flatten(x, start_axis=1))
+        x = self.relu(self.norm_final(self.blocks(self.stem(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(flatten(x, start_axis=1))
+        return x
 
 
 def densenet121(pretrained=False, **kw):

@@ -32,7 +32,27 @@ class Inception(Layer):
                       axis=1)
 
 
+class _AuxHead(Layer):
+    """Auxiliary classifier (reference returns its logits during training)."""
+
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D((4, 4))
+        self.conv = _conv_relu(in_ch, 128, 1)
+        self.fc1 = Linear(128 * 4 * 4, 1024)
+        self.relu = ReLU()
+        self.dropout = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(flatten(x, start_axis=1)))
+        return self.fc2(self.dropout(x))
+
+
 class GoogLeNet(Layer):
+    """forward returns (out, aux1, aux2) like the reference googlenet."""
+
     def __init__(self, num_classes=1000, with_pool=True):
         super().__init__()
         self.stem = Sequential(
@@ -45,24 +65,35 @@ class GoogLeNet(Layer):
             Inception(192, 64, 96, 128, 16, 32, 32),
             Inception(256, 128, 128, 192, 32, 96, 64),
             MaxPool2D(3, stride=2, padding=1))
-        self.inc4 = Sequential(
-            Inception(480, 192, 96, 208, 16, 48, 64),
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4bcd = Sequential(
             Inception(512, 160, 112, 224, 24, 64, 64),
             Inception(512, 128, 128, 256, 24, 64, 64),
-            Inception(512, 112, 144, 288, 32, 64, 64),
-            Inception(528, 256, 160, 320, 32, 128, 128),
-            MaxPool2D(3, stride=2, padding=1))
+            Inception(512, 112, 144, 288, 32, 64, 64))
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
         self.inc5 = Sequential(
             Inception(832, 256, 160, 320, 32, 128, 128),
             Inception(832, 384, 192, 384, 48, 128, 128))
-        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.with_pool = with_pool
+        self.pool = AdaptiveAvgPool2D((1, 1)) if with_pool else None
         self.dropout = Dropout(0.2)
-        self.fc = Linear(1024, num_classes)
+        self.fc = Linear(1024, num_classes) if num_classes > 0 else None
+        self.aux1 = _AuxHead(512, num_classes) if num_classes > 0 else None
+        self.aux2 = _AuxHead(528, num_classes) if num_classes > 0 else None
 
     def forward(self, x):
-        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
-        x = self.dropout(flatten(self.pool(x), start_axis=1))
-        return self.fc(x)
+        x = self.inc4a(self.inc3(self.stem(x)))
+        out1 = self.aux1(x) if self.aux1 is not None else None
+        x = self.inc4bcd(x)
+        out2 = self.aux2(x) if self.aux2 is not None else None
+        x = self.inc5(self.pool4(self.inc4e(x)))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(flatten(x, start_axis=1)))
+            return x, out1, out2
+        return x
 
 
 def googlenet(pretrained=False, **kw):

@@ -51,13 +51,20 @@ class SqueezeNet(Layer):
                 Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
                 Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
             )
-        self.classifier = Sequential(
-            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
-            AdaptiveAvgPool2D((1, 1)),
-        )
+        self.with_pool = with_pool
+        if num_classes > 0:
+            head = [Dropout(0.5), Conv2D(512, num_classes, 1), ReLU()]
+            if with_pool:
+                head.append(AdaptiveAvgPool2D((1, 1)))
+            self.classifier = Sequential(*head)
+        else:
+            self.classifier = None
 
     def forward(self, x):
-        return flatten(self.classifier(self.features(x)), start_axis=1)
+        x = self.features(x)
+        if self.classifier is None:
+            return x
+        return flatten(self.classifier(x), start_axis=1)
 
 
 def squeezenet1_0(pretrained=False, **kw):

@@ -104,3 +104,39 @@ class TestUlyssesAttention:
         for a, b in zip(gu, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=3e-4, rtol=3e-4)
+
+
+class TestLlamaContextParallel:
+    def test_llama_ring_matches_dense(self):
+        """llama with context_parallel=True on a sep mesh == dense llama
+        with identical weights (SURVEY §5.7 long-context first-class)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.llama import llama_tiny
+
+        paddle.seed(5)
+        dense = llama_tiny(tensor_parallel=False)
+        paddle.seed(5)
+        ring = llama_tiny(tensor_parallel=False, context_parallel=True)
+        for a, b in zip(dense.parameters(), ring.parameters()):
+            np.testing.assert_array_equal(np.asarray(a._data),
+                                          np.asarray(b._data))
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            x = paddle.to_tensor(np.random.RandomState(0).randint(
+                0, 256, (2, 32)).astype(np.int32))
+            dense.eval(); ring.eval()
+            out_d = dense(x)
+            out_r = ring(x)
+            np.testing.assert_allclose(np.asarray(out_r._data),
+                                       np.asarray(out_d._data),
+                                       atol=3e-5, rtol=3e-5)
+        finally:
+            from paddle_tpu.distributed.fleet.base.topology import \
+                _HYBRID_GROUP
+            _HYBRID_GROUP[0] = None

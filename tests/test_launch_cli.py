@@ -1,0 +1,61 @@
+"""paddle_tpu.distributed.launch CLI: env contract, logs, restart
+(SURVEY §2.5 Launcher, §5.3 failure detection)."""
+import os
+import subprocess
+import sys
+
+COMPANION = """
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+assert os.environ["PADDLE_MASTER"]
+assert os.environ["JAX_PROCESS_ID"] == rank
+print("rank", rank, "ok")
+marker = sys.argv[1] + "/done." + rank
+open(marker, "w").write("1")
+"""
+
+FLAKY = """
+import os, sys
+attempt_file = sys.argv[1] + "/attempts"
+n = int(open(attempt_file).read()) if os.path.exists(attempt_file) else 0
+open(attempt_file, "w").write(str(n + 1))
+sys.exit(0 if n >= 1 else 1)      # fail on first attempt, pass on second
+"""
+
+
+def _run_launch(tmp_path, script_body, extra_args, script_args):
+    script = tmp_path / "companion.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log")] + extra_args +
+        [str(script)] + script_args,
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        capture_output=True, text=True, timeout=120)
+
+
+class TestLaunchCLI:
+    def test_two_proc_env_contract_and_logs(self, tmp_path):
+        r = _run_launch(tmp_path, COMPANION, ["--nproc_per_node", "2"],
+                        [str(tmp_path)])
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "done.0").exists()
+        assert (tmp_path / "done.1").exists()
+        # non-zero ranks log to workerlog.N
+        assert "ok" in (tmp_path / "log" / "workerlog.1").read_text()
+
+    def test_max_restart_retries_failed_pod(self, tmp_path):
+        r = _run_launch(tmp_path, FLAKY,
+                        ["--nproc_per_node", "1", "--max_restart", "2"],
+                        [str(tmp_path)])
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "attempts").read_text() == "2"
+
+    def test_failure_propagates_exit_code(self, tmp_path):
+        r = _run_launch(tmp_path, "import sys; sys.exit(3)\n",
+                        ["--nproc_per_node", "1"], [])
+        assert r.returncode == 3

@@ -249,5 +249,12 @@ class TestVisionZooAdditions:
         self._run(shufflenet_v2_x0_25(num_classes=10), size=64)
 
     def test_googlenet(self):
+        import paddle_tpu as paddle
         from paddle_tpu.vision.models import googlenet
-        self._run(googlenet(num_classes=10), size=64)
+        m = googlenet(num_classes=10)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+        out, aux1, aux2 = m(x)
+        assert out.shape == [1, 10] and aux1.shape == [1, 10] \
+            and aux2.shape == [1, 10]
