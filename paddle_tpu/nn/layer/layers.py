@@ -23,10 +23,14 @@ _dynamic_mode = [True]
 
 def enable_static():
     _dynamic_mode[0] = False
+    from ...static import _install_capture
+    _install_capture()
 
 
 def disable_static():
     _dynamic_mode[0] = True
+    from ...static import _remove_capture
+    _remove_capture()
 
 
 def in_dynamic_mode() -> bool:

@@ -176,6 +176,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..nn.layer.layers import in_dynamic_mode
+        if not in_dynamic_mode():
+            # static build: register backward+update for each Executor.run
+            # (the reference appends backward + optimizer ops to the program)
+            from ..static import default_main_program
+            default_main_program()._add_minimize(self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -304,6 +304,12 @@ class Tensor:
     def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
 
     def _cmp(self, other, fn):
+        if _capture_hook[0] is not None:
+            # static build: route through apply_op so the comparison is
+            # recorded into the Program (it would otherwise replay stale)
+            if isinstance(other, Tensor):
+                return apply_op(lambda a, b, f=fn: f(a, b), self, other)
+            return apply_op(lambda a, o=other, f=fn: f(a, o), self)
         ov = other._data if isinstance(other, Tensor) else other
         return Tensor(fn(self._data, ov))
 
@@ -335,6 +341,24 @@ class Tensor:
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)
         v = _as_jax(value)
+        if _capture_hook[0] is not None:
+            # static build: record the scatter as an op producing a NEW
+            # value for this tensor's uid, so Executor.run replays it
+            if isinstance(value, Tensor):
+                out = apply_op(
+                    lambda a, vv, i=idx: a.at[i].set(vv.astype(a.dtype)),
+                    self, value)
+            else:
+                out = apply_op(
+                    lambda a, vv=v, i=idx: a.at[i].set(vv.astype(a.dtype)),
+                    self)
+            self._data = out._data
+            hook = _capture_hook[0]
+            hook(None, (), ())  # no-op marker keeps hook import honest
+            # alias the new value back onto this tensor's uid for replay
+            from ..static import _alias_capture_output
+            _alias_capture_output(out, self)
+            return
         self._data = self._data.at[idx].set(v.astype(self._data.dtype))
 
     def __repr__(self):
@@ -391,8 +415,12 @@ def apply_op(jax_fn: Callable, *tensors: Tensor, n_outputs: int = 1):
     if not need_grad:
         out = jax_fn(*arrays)
         if n_outputs == 1 and not isinstance(out, tuple):
-            return Tensor(out)
-        return tuple(Tensor(o) for o in out)
+            res = Tensor(out)
+            _maybe_capture(jax_fn, tensors, (res,))
+            return res
+        res = tuple(Tensor(o) for o in out)
+        _maybe_capture(jax_fn, tensors, res)
+        return res
 
     primal_out, vjp_fn = jax.vjp(jax_fn, *arrays)
     multi = isinstance(primal_out, tuple)
@@ -407,7 +435,20 @@ def apply_op(jax_fn: Callable, *tensors: Tensor, n_outputs: int = 1):
         outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
     )
     _tape.nodes.append(node)
+    _maybe_capture(jax_fn, tensors, outs)
     return outs if multi else outs[0]
+
+
+# static-graph capture hook: set by paddle_tpu.static when building a
+# Program (enable_static); records (fn, inputs, outputs) so Executor.run can
+# replay the graph with new feeds. None in eager mode — zero overhead.
+_capture_hook = [None]
+
+
+def _maybe_capture(jax_fn, inputs, outputs):
+    hook = _capture_hook[0]
+    if hook is not None:
+        hook(jax_fn, inputs, outputs)
 
 
 def tape_nodes():

@@ -236,14 +236,17 @@ class TestHapi:
 class TestStaticAPI:
     def test_program_executor(self):
         prog = paddle.static.Program()
-
-        def fwd(x):
-            return x * 2 + 1
-        prog._build_fn = fwd
+        paddle.enable_static()
+        try:
+            with paddle.static.program_guard(prog):
+                x = paddle.static.data("x", [None])
+                out = x * 2 + 1
+        finally:
+            paddle.disable_static()
         exe = paddle.static.Executor()
-        out = exe.run(prog, feed={"x": np.array([1.0, 2.0], np.float32)},
-                      fetch_list=["out"])
-        np.testing.assert_allclose(out[0], [3.0, 5.0])
+        res = exe.run(prog, feed={"x": np.array([1.0, 2.0], np.float32)},
+                      fetch_list=[out])
+        np.testing.assert_allclose(res[0], [3.0, 5.0])
 
     def test_input_spec(self):
         spec = paddle.static.InputSpec([None, 4], "float32", "x")
