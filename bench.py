@@ -14,13 +14,36 @@ import time
 import numpy as np
 
 
-def main():
+def _init_devices():
+    """Initialize the JAX backend, surviving transient TPU/axon init flake.
+
+    The axon tunnel backend can fail with UNAVAILABLE on first contact
+    (BENCH_r01: rc=1, no number recorded). Retry with backoff; if the
+    accelerator never comes up, fall back to CPU via jax.config (which
+    wins over the baked-in JAX_PLATFORMS=axon env) so the bench still
+    emits its one JSON line instead of dying.
+    """
     import jax
+
+    last_err = None
+    for attempt in range(4):
+        try:
+            return jax, jax.devices()[0]
+        except Exception as e:  # backend init failure (RuntimeError etc.)
+            last_err = e
+            time.sleep(2.0 * (attempt + 1))
+    print(f"bench: accelerator init failed after retries ({last_err}); "
+          f"falling back to CPU", file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return jax, jax.devices()[0]
+
+
+def main():
+    jax, dev = _init_devices()
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import gpt2_124m
 
-    dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
     batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
     seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
@@ -106,4 +129,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        # Last-resort: keep the one-JSON-line contract even on an
+        # unexpected failure so the driver records what went wrong
+        # instead of a bare traceback with parsed=null.
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)

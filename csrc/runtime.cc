@@ -23,6 +23,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -185,6 +186,14 @@ struct Master {
       }
     }
   done:
+    {
+      // deregister before closing: stop() shutdown()s every fd still in
+      // client_fds, and the OS may have reassigned a closed fd number to
+      // an unrelated descriptor in this process
+      std::lock_guard<std::mutex> l(fds_mu);
+      client_fds.erase(std::remove(client_fds.begin(), client_fds.end(), fd),
+                       client_fds.end());
+    }
     ::close(fd);
   }
 

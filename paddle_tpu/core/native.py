@@ -151,7 +151,18 @@ class TCPStore:
         n = self._lib.pd_store_get(self._h, key.encode(), buf, max_len)
         if n < 0:
             return None
-        return buf.raw[:min(n, max_len)]
+        # value larger than the buffer: the C side reports the full length —
+        # retry with an exact-size buffer instead of silently truncating
+        # (loop: the value may have grown again between calls)
+        for _ in range(4):
+            if n <= max_len:
+                return buf.raw[:n]
+            max_len = n
+            buf = ctypes.create_string_buffer(max_len)
+            n = self._lib.pd_store_get(self._h, key.encode(), buf, max_len)
+            if n < 0:
+                return None
+        raise IOError(f"store get: value for {key!r} keeps growing")
 
     def add(self, key: str, delta: int) -> int:
         out = ctypes.c_longlong()

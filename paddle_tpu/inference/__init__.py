@@ -170,22 +170,54 @@ class Predictor:
             return lambda *xs: m(*xs)
         return m                            # TranslatedLayer / callable
 
+    def _compiled_forward(self, arrs):
+        """Jit the forward per input-shape/dtype bucket; repeated runs with
+        the same shapes reuse the compiled executable. Model params are
+        passed as jit arguments (not baked as constants) so re-loading
+        weights into the same Layer keeps the cache valid."""
+        import jax
+        from ..nn.layer.layers import Layer
+        from ..tensor.tensor import Tensor, no_grad, _tape
+
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+        entry = self._compiled.get(key)
+        if entry is None:
+            forward = self._forward_fn()
+            m = self._model
+            params = list(m.parameters()) if isinstance(m, Layer) else []
+
+            def pure(param_arrays, input_arrays):
+                old = [p._data for p in params]
+                for p, a in zip(params, param_arrays):
+                    p._data = a
+                try:
+                    with no_grad():
+                        outs = forward(*[Tensor(a) for a in input_arrays])
+                finally:
+                    for p, a in zip(params, old):
+                        p._data = a
+                    _tape.nodes.clear()
+                if not isinstance(outs, (list, tuple)):
+                    outs = [outs]
+                return [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                        for o in outs]
+
+            entry = (jax.jit(pure), params)
+            self._compiled[key] = entry
+        jitted, params = entry
+        return jitted([p._data for p in params],
+                      [jnp.asarray(a) for a in arrs])
+
     def run(self, inputs: Optional[list] = None):
         """Either handle-style (copy_from_cpu then run()) or direct
         (run([np arrays]) -> list of np arrays, the paddle_infer v2 API)."""
-        from ..tensor.tensor import Tensor, no_grad
         if inputs is not None:
             arrs = [np.asarray(a) for a in inputs]
         else:
             arrs = [self._inputs[n].copy_to_cpu()
                     for n in self._input_names if n in self._inputs]
-        fn = self._forward_fn()
-        with no_grad():
-            outs = fn(*[Tensor(jnp.asarray(a)) for a in arrs])
-        if not isinstance(outs, (list, tuple)):
-            outs = [outs]
-        np_outs = [np.asarray(o._data if isinstance(o, Tensor) else o)
-                   for o in outs]
+        outs = self._compiled_forward(arrs)
+        np_outs = [np.asarray(o) for o in outs]
         self._output_names = [f"out_{i}" if len(np_outs) > 1 else "out"
                               for i in range(len(np_outs))]
         for n, a in zip(self._output_names, np_outs):

@@ -440,4 +440,8 @@ class DataLoader:
                 nq.close()
                 for t in threads:
                     t.join(timeout=5.0)
-                nq.free()
+                if not any(t.is_alive() for t in threads):
+                    nq.free()
+                # else: a worker is still stuck inside user dataset code and
+                # could call nq.put after free — leak the handle instead of
+                # freeing under its feet (use-after-free)

@@ -119,6 +119,19 @@ def default_startup_program() -> Program:
     return _startup_program
 
 
+def _alias_capture_output(src: Tensor, dst: Tensor) -> None:
+    """Rewrite the last recorded op's output uid from ``src`` to ``dst``.
+
+    Tensor.__setitem__ during static capture records the scatter as an op
+    producing a fresh tensor; aliasing its output uid onto the assigned
+    tensor's uid makes replay treat it as an in-place update (later ops
+    that consume the target tensor read the scattered value from env)."""
+    ops = _main_program.ops
+    if ops and src._uid in ops[-1].output_ids:
+        ids = ops[-1].output_ids
+        ids[ids.index(src._uid)] = dst._uid
+
+
 def _install_capture():
     """Called by paddle.enable_static(): record ops into the active main
     program. paddle.disable_static() removes the hook."""

@@ -353,8 +353,6 @@ class Tensor:
                     lambda a, vv=v, i=idx: a.at[i].set(vv.astype(a.dtype)),
                     self)
             self._data = out._data
-            hook = _capture_hook[0]
-            hook(None, (), ())  # no-op marker keeps hook import honest
             # alias the new value back onto this tensor's uid for replay
             from ..static import _alias_capture_output
             _alias_capture_output(out, self)

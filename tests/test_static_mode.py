@@ -74,3 +74,35 @@ class TestStaticCaptureReplay:
         out, = exe.run(test_prog, feed={"x": np.ones((3, 2), np.float32)},
                        fetch_list=[y])
         np.testing.assert_allclose(out, np.full((3, 2), 2.0))
+
+
+class TestStaticSetitem:
+    def test_setitem_recorded_and_replayed(self):
+        # regression: __setitem__ during capture must alias the scatter
+        # output onto the target tensor's uid so replay sees the update
+        paddle.enable_static()
+        x = paddle.static.data("x", [None, 4])
+        y = x * 1.0
+        y[:, 0] = 7.0
+        z = y + 1.0
+        exe = paddle.static.Executor()
+        paddle.disable_static()
+        a = np.zeros((3, 4), np.float32)
+        out, = exe.run(feed={"x": a}, fetch_list=[z])
+        expect = np.ones((3, 4), np.float32)
+        expect[:, 0] = 8.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_setitem_tensor_value(self):
+        paddle.enable_static()
+        x = paddle.static.data("x", [2, 3])
+        v = paddle.static.data("v", [3])
+        y = x + 0.0
+        y[1] = v
+        exe = paddle.static.Executor()
+        paddle.disable_static()
+        out, = exe.run(feed={"x": np.zeros((2, 3), np.float32),
+                             "v": np.arange(3, dtype=np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out[1], [0., 1., 2.])
+        np.testing.assert_allclose(out[0], 0.0)
