@@ -31,7 +31,8 @@ def _init_devices():
             return jax, jax.devices()[0]
         except Exception as e:  # backend init failure (RuntimeError etc.)
             last_err = e
-            time.sleep(2.0 * (attempt + 1))
+            if attempt < 3:
+                time.sleep(2.0 * (attempt + 1))
     print(f"bench: accelerator init failed after retries ({last_err}); "
           f"falling back to CPU", file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")

@@ -54,11 +54,14 @@ def distributed_model(model):
     strategy = _get_strategy()
     from .meta_parallel.parallel_layers import (TensorParallel,
                                                 ShardingParallel)
-    from .meta_parallel.pipeline_parallel import PipelineParallel
+    from .meta_parallel.pipeline_parallel import (PipelineParallel,
+                                                  PipelineParallelWithInterleave)
     from .meta_parallel.pp_layers import PipelineLayer
     from ...framework.layer_helpers import DataParallel
 
     if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model, PipelineLayer):
+        if (getattr(model, "_num_virtual_pipeline_stages", None) or 1) > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)
     mode = hcg.get_parallel_mode()
     if mode == "tensor_parallel":

@@ -53,6 +53,7 @@ class PipelineLayer(Layer):
         self._topo = topology
         self._num_stages = num_stages or (
             topology.get_dim("pipe") if topology else 1)
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
         self.descs = list(layers)
         built = []

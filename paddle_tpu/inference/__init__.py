@@ -176,7 +176,7 @@ class Predictor:
         passed as jit arguments (not baked as constants) so re-loading
         weights into the same Layer keeps the cache valid."""
         import jax
-        from ..nn.layer.layers import Layer
+        from ..nn.layer.layers import Layer, substitute_param_arrays
         from ..tensor.tensor import Tensor, no_grad, _tape
 
         key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
@@ -187,15 +187,11 @@ class Predictor:
             params = list(m.parameters()) if isinstance(m, Layer) else []
 
             def pure(param_arrays, input_arrays):
-                old = [p._data for p in params]
-                for p, a in zip(params, param_arrays):
-                    p._data = a
                 try:
-                    with no_grad():
+                    with substitute_param_arrays(params, param_arrays), \
+                            no_grad():
                         outs = forward(*[Tensor(a) for a in input_arrays])
                 finally:
-                    for p, a in zip(params, old):
-                        p._data = a
                     _tape.nodes.clear()
                 if not isinstance(outs, (list, tuple)):
                     outs = [outs]

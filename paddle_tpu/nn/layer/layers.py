@@ -407,3 +407,22 @@ class ParameterList(Layer):
     def append(self, parameter):
         self.add_parameter(str(len(self._parameters)), parameter)
         return self
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def substitute_param_arrays(params, arrays):
+    """Temporarily swap each Parameter's backing array (functionalization
+    helper: lets jit/grad trace a Layer forward with the params supplied as
+    function arguments instead of captured constants). Restores the
+    originals on exit."""
+    old = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for p, a in zip(params, old):
+            p._data = a

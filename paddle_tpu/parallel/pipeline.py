@@ -31,7 +31,16 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["gpipe", "make_gpipe_fn", "microbatch", "unmicrobatch"]
+__all__ = ["gpipe", "gpipe_interleaved", "make_gpipe_fn", "microbatch",
+           "unmicrobatch"]
+
+
+def _pvary(x, axis_name):
+    """Mark x as varying over axis_name (pcast where available; pvary on
+    older jax)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    return jax.lax.pvary(x, (axis_name,))
 
 
 def microbatch(x, num_micro: int):
@@ -62,8 +71,8 @@ def gpipe(stage_fn: Callable, stage_params, x_mb, axis_name: str = "pp",
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    state0 = jax.lax.pvary(jnp.zeros_like(x_mb[0]), (axis_name,))
-    outs0 = jax.lax.pvary(jnp.zeros_like(x_mb), (axis_name,))
+    state0 = _pvary(jnp.zeros_like(x_mb[0]), axis_name)
+    outs0 = _pvary(jnp.zeros_like(x_mb), axis_name)
 
     def tick(carry, t):
         state, outs = carry
@@ -84,6 +93,76 @@ def gpipe(stage_fn: Callable, stage_params, x_mb, axis_name: str = "pp",
                                 jnp.arange(m + p - 1))
     # broadcast the final-stage outputs to every rank (loss is computed
     # replicated, exactly like the reference's shared-loss broadcast)
+    outs = jnp.where(i == p - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+
+def gpipe_interleaved(stage_fn: Callable, chunk_params, x_mb,
+                      axis_name: str = "pp", num_chunks: int = 2,
+                      remat: bool = True):
+    """Interleaved (virtual-pipeline) schedule; call inside shard_map.
+
+    Parity: PipelineParallelWithInterleave (virtual_pp_degree model chunks
+    per rank). Layer assignment is the reference's round-robin: of the
+    v·P chunks in layer order, stage i holds chunks {i, P+i, 2P+i, ...}.
+
+    TPU-native schedule (single SPMD scan, no P2P processes): microbatches
+    are processed in depth-first waves of P. Device 0's emission clock τ
+    advances one slot per tick; slot τ of wave w (u = τ - w·v·P) carries
+    microbatch m = w·P + u%P at chunk c = u//P. An activation finishing
+    chunk c on device P-1 re-enters device 0 exactly when the schedule
+    processes (m, c+1) there, so no rank ever buffers more than the one
+    in-flight activation — the per-device chunk select is a
+    dynamic_index over the local [v, ...] chunk stack. Pipeline bubble is
+    P-1 ticks total (vs v·(P-1) for running v sequential gpipe passes),
+    matching the interleaved-1F1B bubble reduction. M not divisible by P
+    wastes the masked tail slots of the last wave.
+
+    chunk_params: this device's chunks, leading axis v (chunk c = global
+        chunk c·P + i). stage_fn(one_chunk_params, h) -> h.
+    x_mb: [M, mb, ...] microbatched input, replicated over pp.
+    Returns [M, mb, ...] final outputs, identical on every pp rank.
+    """
+    p = jax.lax.axis_size(axis_name)
+    i = jax.lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    v = num_chunks
+    waves = -(-m // p)                      # ceil
+    total = waves * v * p + p - 1
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    state0 = _pvary(jnp.zeros_like(x_mb[0]), axis_name)
+    outs0 = _pvary(jnp.zeros_like(x_mb), axis_name)
+
+    def tick(carry, t):
+        state, outs = carry
+        incoming = jax.lax.ppermute(state, axis_name, perm)
+        tau = t - i                          # device-0 emission clock
+        w = tau // (v * p)
+        u = tau - w * (v * p)
+        c = jnp.clip(u // p, 0, v - 1)
+        mb_idx = jnp.clip(w * p + u % p, 0, m - 1)
+        valid = (tau >= 0) & (tau < waves * v * p) & (w * p + u % p < m)
+
+        inject = (i == 0) & (c == 0)
+        mb = jax.lax.dynamic_index_in_dim(x_mb, mb_idx, 0, keepdims=False)
+        inp = jnp.where(inject, mb, incoming)
+        params_c = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+            chunk_params)
+        new = fn(params_c, inp)
+        # don't let garbage from invalid slots contaminate the ring
+        new = jnp.where(valid, new, incoming)
+
+        done = (i == p - 1) & (c == v - 1) & valid
+        cur = jax.lax.dynamic_index_in_dim(outs, mb_idx, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(done, new, cur), mb_idx, 0)
+        return (new, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0), jnp.arange(total))
     outs = jnp.where(i == p - 1, outs, jnp.zeros_like(outs))
     return jax.lax.psum(outs, axis_name)
 

@@ -1,0 +1,250 @@
+"""Fleet pipeline-parallel vs serial equivalence on the 8-device CPU mesh.
+
+SURVEY §4 companion pattern (hybrid_parallel_pp_transformer.py): build the
+same model twice (fixed seed), train one serially and one through
+fleet.distributed_model(PipelineLayer) → PipelineParallel.train_batch
+(compiled ppermute schedule), assert loss and updated params allclose.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import jax
+
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+    PipelineParallel, PipelineParallelWithInterleave)
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+D = 16
+NLAYERS = 8
+
+
+class Block(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(D, D)
+
+    def forward(self, x):
+        return paddle.nn.functional.tanh(self.fc(x)) + x
+
+
+class Head(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(D, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class Stem(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(D, D)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def mse(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def make_model(seed):
+    paddle.seed(seed)
+    descs = [LayerDesc(Stem)] + [LayerDesc(Block) for _ in range(NLAYERS)] \
+        + [LayerDesc(Head)]
+    return PipelineLayer(descs, num_stages=2, loss_fn=mse)
+
+
+def serial_steps(model, opt, xs, ys, nsteps):
+    losses = []
+    for s in range(nsteps):
+        x = paddle.to_tensor(xs[s])
+        y = paddle.to_tensor(ys[s])
+        loss = mse(model.forward(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    return losses
+
+
+def fleet_pp(pp, virtual=None):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": pp,
+        "sharding_degree": 1,
+        "pp_configs": {"accumulate_steps": 4},
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    model = make_model(7)
+    if virtual:
+        model._num_virtual_pipeline_stages = virtual
+    wrapped = fleet.distributed_model(model)
+    return model, wrapped
+
+
+@needs8
+class TestFleetPipeline:
+    def _data(self, nsteps=3, batch=8):
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(batch, D).astype(np.float32) for _ in range(nsteps)]
+        ys = [rng.randn(batch, 4).astype(np.float32) for _ in range(nsteps)]
+        return xs, ys
+
+    def _run_pp(self, wrapped, model, xs, ys, nsteps):
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        losses = []
+        for s in range(nsteps):
+            loss = wrapped.train_batch(
+                [paddle.to_tensor(xs[s]), paddle.to_tensor(ys[s])], opt)
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    def _assert_matches_serial(self, wrapped, model, kind):
+        xs, ys = self._data()
+        assert isinstance(wrapped, kind)
+        losses_pp = self._run_pp(wrapped, model, xs, ys, 3)
+
+        ref = make_model(7)
+        opt = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+        losses_ref = serial_steps(ref, opt, xs, ys, 3)
+
+        np.testing.assert_allclose(losses_pp, losses_ref, atol=1e-5,
+                                   rtol=1e-5)
+        for p_pp, p_ref in zip(model.parameters(), ref.parameters()):
+            np.testing.assert_allclose(np.asarray(p_pp._data),
+                                       np.asarray(p_ref._data),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_pp2_matches_serial(self):
+        model, wrapped = fleet_pp(2)
+        assert wrapped._mesh() is not None
+        self._assert_matches_serial(wrapped, model, PipelineParallel)
+
+    def test_pp2_interleave_matches_serial(self):
+        model, wrapped = fleet_pp(2, virtual=2)
+        assert isinstance(wrapped, PipelineParallelWithInterleave)
+        assert wrapped.num_virtual == 2
+        self._assert_matches_serial(wrapped, model,
+                                    PipelineParallelWithInterleave)
+
+    def test_partition_prologue_epilogue(self):
+        model, wrapped = fleet_pp(2)
+        pro, body, epi = wrapped._partition()
+        assert len(body) == NLAYERS
+        assert len(pro) == 1 and len(epi) == 1
+
+    def test_fallback_without_mesh_pp1(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = make_model(3)
+        wrapped = fleet.distributed_model(model)
+        assert isinstance(wrapped, PipelineParallel)
+        assert wrapped._mesh() is None      # sequential fallback path
+        xs, ys = self._data(2)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        loss = wrapped.train_batch(
+            [paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])], opt)
+        assert np.isfinite(float(np.asarray(loss._data)))
+
+
+@needs8
+class TestFleetPipelineShared:
+    """Tied weights via SharedLayerDesc must be jit arguments (not baked
+    constants) and receive grad contributions from BOTH uses."""
+
+    def _make(self, seed):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            SharedLayerDesc)
+        paddle.seed(seed)
+
+        def head_fwd(layer, x):
+            # second use of the tied weight: project with its transpose
+            w = layer.fc.weight
+            return paddle.matmul(x, w.t())
+
+        descs = (
+            [SharedLayerDesc("tied", Stem)]
+            + [LayerDesc(Block) for _ in range(4)]
+            + [SharedLayerDesc("tied", Stem, forward_func=head_fwd)]
+        )
+        return PipelineLayer(descs, num_stages=2, loss_fn=mse)
+
+    def test_tied_weights_update_and_grads(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "pp_configs": {"accumulate_steps": 2}}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = self._make(11)
+        wrapped = fleet.distributed_model(model)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+
+        rng = np.random.RandomState(5)
+        xs = [rng.randn(4, D).astype(np.float32) for _ in range(2)]
+        ys = [rng.randn(4, D).astype(np.float32) for _ in range(2)]
+        losses_pp = [float(np.asarray(wrapped.train_batch(
+            [paddle.to_tensor(x), paddle.to_tensor(y)], opt)._data))
+            for x, y in zip(xs, ys)]
+        assert not getattr(wrapped, "_pp_disabled", False), \
+            "tied-weight model must use the compiled pipeline"
+
+        ref = self._make(11)
+        opt_ref = paddle.optimizer.SGD(0.1, parameters=ref.parameters())
+        losses_ref = serial_steps(ref, opt_ref, xs, ys, 2)
+        np.testing.assert_allclose(losses_pp, losses_ref, atol=1e-5,
+                                   rtol=1e-5)
+        for p_pp, p_ref in zip(model.parameters(), ref.parameters()):
+            np.testing.assert_allclose(np.asarray(p_pp._data),
+                                       np.asarray(p_ref._data),
+                                       atol=1e-5, rtol=1e-5)
+
+
+@needs8
+class TestFleetPipelineFallback:
+    def test_tuple_activation_falls_back(self):
+        """Models with tuple inter-stage activations fall back to the
+        sequential micro-batch loop instead of crashing."""
+
+        class TupleBlock(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(D, D)
+
+            def forward(self, x, m=None):
+                h = paddle.nn.functional.tanh(self.fc(x))
+                return (h, m if m is not None else h)
+
+        class Untuple(paddle.nn.Layer):
+            def forward(self, x, m):
+                return x + m
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "pp_configs": {"accumulate_steps": 2}}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(1)
+        model = PipelineLayer(
+            [LayerDesc(TupleBlock) for _ in range(4)] + [LayerDesc(Untuple)],
+            num_stages=2, loss_fn=mse)
+        wrapped = fleet.distributed_model(model)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(4, D).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, D).astype(np.float32))
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            loss = wrapped.train_batch([x, y], opt)
+        assert np.isfinite(float(np.asarray(loss._data)))
+        assert wrapped._pp_disabled
