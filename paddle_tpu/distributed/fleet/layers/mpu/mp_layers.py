@@ -144,16 +144,98 @@ class VocabParallelEmbedding(Layer):
         return constraint(out, *([None] * out.ndim))
 
 
+def _vocab_parallel_ce_fn(mesh, vocab, ignore_index):
+    """Two-pass vocab-parallel softmax CE over the 'mp' axis inside
+    shard_map — the reference's c_softmax_with_cross_entropy semantics
+    (local max → cross-rank max, local sum-exp → cross-rank sum, target
+    logit fetched from its owner rank). The [N, V] logits stay sharded
+    [N, V/mp] per device throughout; only [N, 1] statistics cross the ICI —
+    the full-vocab gather GSPMD might otherwise insert (the exact memory
+    blow-up the reference op exists to avoid) cannot happen inside
+    shard_map's manual region."""
+    from jax import shard_map
+
+    mp = mesh.shape["mp"]
+    part = vocab // mp
+    data_axes = tuple(a for a in ("dp", "sharding", "sep")
+                      if a in mesh.shape and mesh.shape[a] > 1)
+
+    def ce(lg, lb):
+        # lg: [n_local, V/mp]; lb: [n_local]. fp32 softmax math to match
+        # the dense path (loss numerics must not depend on mp degree)
+        lg = lg.astype(jnp.float32)
+        idx = jax.lax.axis_index("mp")
+        # max is for numerical stability only — detach BEFORE pmax (pmax
+        # has no differentiation rule; a zero tangent short-circuits it)
+        m = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(lg, -1, keepdims=True)), "mp")
+        z = jax.lax.psum(jnp.sum(jnp.exp(lg - m), -1, keepdims=True), "mp")
+        lo = idx * part
+        in_range = (lb >= lo) & (lb < lo + part)
+        loc = jnp.clip(lb - lo, 0, part - 1)
+        tgt_local = jnp.take_along_axis(lg, loc[:, None], -1)[:, 0]
+        tgt = jax.lax.psum(jnp.where(in_range, tgt_local, 0.0), "mp")
+        loss = m[:, 0] + jnp.log(z[:, 0]) - tgt
+        if ignore_index is not None:
+            loss = jnp.where(lb == ignore_index, 0.0, loss)
+        return loss
+
+    def run(logits2d, labels1d):
+        n = logits2d.shape[0]
+        bspec = data_axes if data_axes and n % _axes_size(
+            mesh, data_axes) == 0 else None
+        f = shard_map(ce, mesh=mesh,
+                      in_specs=(P(bspec, "mp"), P(bspec)),
+                      out_specs=P(bspec))
+        return f(logits2d, labels1d)
+
+    return run
+
+
+def _axes_size(mesh, axes):
+    s = 1
+    for a in axes:
+        s *= mesh.shape[a]
+    return s
+
+
 class ParallelCrossEntropy(Layer):
-    """Softmax CE over mp-sharded logits. The reference computes a two-pass
-    max/sum reduction across ranks; GSPMD derives the same from the sharded
-    log-softmax composite."""
+    """Softmax CE over mp-sharded logits without materializing the full
+    vocab per device. Parity: mp_ops.py :: ParallelCrossEntropy /
+    c_softmax_with_cross_entropy_op.cu (two-pass max/sum across mp ranks).
+
+    With an active mesh whose mp ≥ 2 (and a divisible vocab) the loss runs
+    the shard_map two-pass kernel; otherwise it degrades to dense CE —
+    numerically identical either way (the reference's serial-vs-parallel
+    contract)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
+        self._run_cache = {}
+
+    def _run_fn(self, mesh, vocab):
+        # cache per (mesh, vocab): a stable callable identity keeps jax's
+        # dispatch cache warm across eager steps (no per-call retrace)
+        key = (id(mesh), vocab)
+        fn = self._run_cache.get(key)
+        if fn is None:
+            fn = _vocab_parallel_ce_fn(mesh, vocab, self.ignore_index)
+            self._run_cache = {key: fn}
+        return fn
 
     def forward(self, input, label):
-        loss = F.cross_entropy(input, label, reduction="none",
+        mesh = _mesh()
+        vocab = int(input.shape[-1])
+        if mesh is not None and mesh.shape.get("mp", 1) >= 2 and \
+                vocab % mesh.shape["mp"] == 0:
+            run = self._run_fn(mesh, vocab)
+            shape = tuple(input.shape[:-1])
+
+            def f(lg, lb):
+                out = run(lg.reshape(-1, vocab),
+                          lb.reshape(-1).astype(jnp.int32))
+                return out.reshape(shape)
+            return apply_op(f, input, label)
+        return F.cross_entropy(input, label, reduction="none",
                                ignore_index=self.ignore_index)
-        return loss

@@ -108,6 +108,30 @@ def _unflatten_out(spec, arrays):
     return walk(spec)
 
 
+def _constrain_to_spec(t, arr):
+    """Pin a persistent tensor's post-step placement to its annotated
+    PartitionSpec (replicated when unannotated) on the active hybrid mesh.
+
+    Without this, GSPMD's propagation is free to re-shard state outputs —
+    e.g. ZeRO-1 annotates only optimizer moments, but params touching
+    sharded moments could come back sharded too, silently changing the
+    sharding level's semantics. A no-op for already-conforming layouts and
+    off-mesh runs."""
+    try:
+        from ..parallel import current_mesh, _valid_spec
+        mesh = current_mesh()
+        if mesh is None or not hasattr(arr, "ndim"):
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = getattr(t, "sharding_spec", None)
+        pspec = P(*spec) if (spec is not None and
+                             _valid_spec(arr, spec, mesh)) else P()
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, pspec))
+    except Exception:
+        return arr
+
+
 class StaticFunction:
     """Compiled wrapper around an eager function (dygraph → XLA program)."""
 
@@ -207,7 +231,8 @@ class StaticFunction:
             out_spec_box[0] = out_spec
             state_after = persistent_tensors()
             state_after_box[0] = state_after
-            new_state = [t._data for t in state_after]
+            new_state = [_constrain_to_spec(t, t._data)
+                         for t in state_after]
             for t, a in zip(state, old):
                 t._data = a
             for t in state_after:

@@ -230,13 +230,21 @@ class LlamaForCausalLM(Layer):
         self.config = c
         self.llama = LlamaModel(c)
         if not c.tie_word_embeddings:
+            # gather_output=False: logits stay mp-sharded on the vocab dim
+            # straight into the vocab-parallel CE (a gather here would
+            # materialize the full [B*S, V] on every device — the memory
+            # blow-up ParallelCrossEntropy exists to avoid)
             self.lm_head = (ColumnParallelLinear(
                 c.hidden_size, c.vocab_size, weight_attr=_attr(
-                    c.initializer_range), has_bias=False, gather_output=True)
+                    c.initializer_range), has_bias=False, gather_output=False)
                 if c.tensor_parallel else
                 Linear(c.hidden_size, c.vocab_size,
                        weight_attr=_attr(c.initializer_range),
                        bias_attr=False))
+        if c.tensor_parallel:
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ParallelCrossEntropy)
+            self.parallel_loss = ParallelCrossEntropy()
 
     def forward(self, input_ids, labels=None, loss_mask=None):
         h = self.llama(input_ids)
@@ -245,9 +253,18 @@ class LlamaForCausalLM(Layer):
         else:
             logits = self.lm_head(h)
         if labels is not None:
-            loss = F.cross_entropy(reshape(logits,
-                                           [-1, self.config.vocab_size]),
-                                   reshape(labels, [-1]), reduction="none")
+            if self.config.tensor_parallel:
+                # vocab-parallel two-pass CE: mp-sharded logits never
+                # materialize the full vocab per device (mp_layers ::
+                # ParallelCrossEntropy); dense CE off-mesh
+                loss = self.parallel_loss(
+                    reshape(logits, [-1, self.config.vocab_size]),
+                    reshape(labels, [-1]))
+            else:
+                loss = F.cross_entropy(reshape(logits,
+                                               [-1, self.config.vocab_size]),
+                                       reshape(labels, [-1]),
+                                       reduction="none")
             if loss_mask is not None:
                 m = reshape(loss_mask, [-1])
                 loss = (loss * m).sum() / m.sum().clip(min=1.0)

@@ -33,3 +33,16 @@ def _seed_all():
     yield
     from paddle_tpu.tensor.tensor import clear_tape
     clear_tape()
+    # reset global fleet/mesh state: each test starts without an active
+    # hybrid mesh (the reference's per-process test isolation); tests that
+    # need one call fleet.init themselves
+    from paddle_tpu.distributed.fleet.base.topology import _HYBRID_GROUP
+    from paddle_tpu.distributed.fleet import _fleet_state
+    _HYBRID_GROUP[0] = None
+    _fleet_state.update(strategy=None, hcg=None, initialized=False)
+    # drop dead persistent tensors NOW: the WeakSet otherwise loses the
+    # previous test's leftovers at a nondeterministic GC point, which can
+    # change jit.to_static's state-identity cache key between two calls in
+    # the NEXT test and break trace-count assertions
+    import gc
+    gc.collect()
