@@ -18,7 +18,8 @@ from . import fleet
 from . import sharding
 from .auto_parallel.api import shard_tensor, ProcessMesh, shard_op
 from .spawn_mod import spawn
-from .checkpoint import save_state_dict, load_state_dict
+from .checkpoint import (save_state_dict, load_state_dict,
+                         wait_all_async_saves)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",

@@ -221,7 +221,7 @@ class ParallelCrossEntropy(Layer):
         fn = self._run_cache.get(key)
         if fn is None:
             fn = _vocab_parallel_ce_fn(mesh, vocab, self.ignore_index)
-            self._run_cache = {key: fn}
+            self._run_cache[key] = fn
         return fn
 
     def forward(self, input, label):

@@ -158,3 +158,27 @@ def t(x, name=None):
 
 def transpose_last(x):
     return t(x)
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def multi_dot(x, name=None):
+    """Optimal-order chained matmul over a list of tensors."""
+    return apply_op(lambda *arrs: jnp.linalg.multi_dot(arrs), *x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0,
+                                      fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+__all__ += ["svdvals", "multi_dot", "cov", "corrcoef"]

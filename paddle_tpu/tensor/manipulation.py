@@ -430,3 +430,103 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         local = a % size
         return jnp.where(shard == shard_id, local, ignore_value)
     return apply_op(f, input)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-gather (paddle.take). mode: 'raise'/'wrap'/'clip'."""
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        i = idx
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:               # 'clip' (and 'raise' — no host check under jit)
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+    return apply_op(f, x)
+
+
+def msort(x, name=None):
+    return apply_op(lambda a: jnp.sort(a, axis=0), x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(int(offset))
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rng = jnp.arange(a.shape[-1])
+        r = rng + max(-int(offset), 0)
+        c = rng + max(int(offset), 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for pos in range(nd):
+            order.append(src.get(pos, None) if pos in src else next(it))
+        return jnp.transpose(out, order)
+    return apply_op(f, input)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (torch.Tensor.unfold semantics, which
+    paddle.unfold for tensors follows): returns windows stacked on a new
+    trailing dim."""
+    def f(a):
+        ax = int(axis) % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        def take_win(s):
+            return jax.lax.dynamic_slice_in_dim(a, s, size, axis=ax)
+        wins = jax.vmap(take_win)(starts)          # [n, ..., size, ...]
+        wins = jnp.moveaxis(wins, 0, ax)           # windows sit at `axis`
+        return jnp.moveaxis(wins, ax + 1, -1)      # window content last
+    return apply_op(f, x)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a, v):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        vm = jnp.moveaxis(v, int(axis), 0)
+        out = moved.at[idx].add(vm.astype(moved.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def index_add_(x, index, axis, value, name=None):
+    out = index_add(x, index, axis, value)
+    x._data = out._data
+    return x
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    ids = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in indices)
+
+    def f(a, v):
+        ref = a.at[ids]
+        v = v.astype(a.dtype)
+        return ref.add(v) if accumulate else ref.set(v)
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x, indices, value, accumulate)
+    x._data = out._data
+    return x
+
+
+__all__ += ["take", "msort", "diag_embed", "unfold", "index_add",
+            "index_add_", "index_put", "index_put_"]

@@ -221,24 +221,36 @@ def cumprod(x, dim=None, dtype=None, name=None):
 _export("cumprod", cumprod)
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
+def _cum_minmax_indices(arr, ax, is_min):
+    """Indices of the running extremum, first occurrence on ties: an O(n)
+    associative scan over (value, index) pairs — lexicographic min/max with
+    the earlier index winning equal values."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, arr.shape, ax)
+
+    def combine(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = (rv < lv) if is_min else (rv > lv)
+        return (jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li))
+
+    _, inds = jax.lax.associative_scan(combine, (arr, idx), axis=ax)
+    return inds
+
+
+def _cum_minmax(x, axis, is_min):
     def f(a):
-        if axis is None:
-            a = a.reshape(-1)
-            ax = 0
-        else:
-            ax = int(axis)
-        return jax.lax.cummax(a, axis=ax)
+        a = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        return (jax.lax.cummin if is_min else jax.lax.cummax)(a, axis=ax)
     vals = apply_op(f, x)
     arr = x._data.reshape(-1) if axis is None else x._data
     ax = 0 if axis is None else int(axis)
-    n = arr.shape[ax]
-    eq = jnp.equal(jnp.moveaxis(vals._data, ax, -1)[..., :, None],
-                   jnp.moveaxis(arr, ax, -1)[..., None, :])
-    idx_range = jnp.arange(n)
-    inds = jnp.max(jnp.where(eq, idx_range, -1), axis=-1)
-    inds = jnp.moveaxis(inds, -1, ax)
+    inds = _cum_minmax_indices(arr, ax, is_min)
     return vals, Tensor(inds.astype(jnp.int64))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax(x, axis, is_min=False)
 
 
 _export("cummax", cummax)
@@ -368,3 +380,111 @@ def broadcast_shape(x_shape, y_shape):
 
 
 _export("broadcast_shape", broadcast_shape)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Parity: paddle.cummin — returns (values, indices of first min)."""
+    return _cum_minmax(x, axis, is_min=True)
+
+
+_export("cummin", cummin)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.reshape(-1))
+        return jax.lax.cumlogsumexp(a, axis=int(axis))
+    return apply_op(f, x)
+
+
+_export("logcumsumexp", logcumsumexp)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                           axis2=axis2), x)
+
+
+_export("diagonal", diagonal)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+_export("vander", vander)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` whose p-norm exceeds max_norm."""
+    def f(a):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, int(axis))
+    return apply_op(f, x)
+
+
+_export("renorm", renorm)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(x._data if isinstance(x, Tensor) else jnp.asarray(x))
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+_export("frexp", frexp)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        if isinstance(x, Tensor):
+            return apply_op(lambda a, b: jnp.trapezoid(a, b, axis=axis), y, x)
+        return apply_op(lambda a: jnp.trapezoid(a, jnp.asarray(x),
+                                                axis=axis), y)
+    return apply_op(lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+_export("trapezoid", trapezoid)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Running trapezoid integral along axis; shape [..., n-1] (scipy
+    semantics, no initial zero)."""
+    def seg(a, xs):
+        ax = int(axis) % a.ndim
+        a0 = jax.lax.slice_in_dim(a, 0, a.shape[ax] - 1, axis=ax)
+        a1 = jax.lax.slice_in_dim(a, 1, a.shape[ax], axis=ax)
+        if xs is None:
+            w = dx if dx is not None else 1.0
+            segs = (a0 + a1) * 0.5 * w
+        else:
+            x0 = jax.lax.slice_in_dim(xs, 0, xs.shape[-1] - 1, axis=-1)
+            x1 = jax.lax.slice_in_dim(xs, 1, xs.shape[-1], axis=-1)
+            d = (x1 - x0)
+            shape = [1] * a.ndim
+            shape[ax] = d.shape[-1]
+            segs = (a0 + a1) * 0.5 * d.reshape(shape)
+        return jnp.cumsum(segs, axis=ax)
+    if x is not None:
+        xs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        return apply_op(lambda a: seg(a, xs), y)
+    return apply_op(lambda a: seg(a, None), y)
+
+
+_export("cumulative_trapezoid", cumulative_trapezoid)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    import numpy as _np
+    return Tensor(jnp.asarray(_np.histogram_bin_edges(
+        _np.asarray(arr), bins=bins, range=rng).astype(_np.float32)))
+
+
+_export("histogram_bin_edges", histogram_bin_edges)

@@ -228,3 +228,166 @@ class TestRandom:
         assert r.min() >= 0 and r.max() < 10
         p = paddle.randperm(10).numpy()
         np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+
+class TestRound2Ops:
+    """NumPy-oracle tests for the round-2 op additions (VERDICT item 9):
+    cum* variants, searchsorted-class, index ops, windows, linalg extras."""
+
+    def setup_method(self, m):
+        self.rng = np.random.RandomState(42)
+        self.x = self.rng.randn(3, 5).astype(np.float32)
+
+    def test_cummin(self):
+        v, i = paddle.cummin(paddle.to_tensor(self.x), axis=1)
+        ref = np.minimum.accumulate(self.x, axis=1)
+        np.testing.assert_allclose(_np(v), ref)
+        np.testing.assert_array_equal(
+            np.take_along_axis(self.x, _np(i).astype(np.int64), 1), ref)
+
+    def test_logcumsumexp(self):
+        out = paddle.logcumsumexp(paddle.to_tensor(self.x), axis=1)
+        ref = np.logaddexp.accumulate(self.x.astype(np.float64), axis=1)
+        np.testing.assert_allclose(_np(out), ref, atol=1e-5)
+
+    def test_diagonal_trace(self):
+        a = self.rng.randn(4, 4).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.diagonal(paddle.to_tensor(a))),
+                                   np.diagonal(a))
+        np.testing.assert_allclose(
+            _np(paddle.diagonal(paddle.to_tensor(a), offset=1)),
+            np.diagonal(a, offset=1))
+
+    def test_vander(self):
+        v = np.array([1., 2., 3.], np.float32)
+        np.testing.assert_allclose(_np(paddle.vander(paddle.to_tensor(v))),
+                                   np.vander(v))
+
+    def test_renorm(self):
+        a = self.rng.randn(3, 4).astype(np.float32) * 3
+        out = _np(paddle.renorm(paddle.to_tensor(a), 2.0, 0, 1.0))
+        norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        # rows already under the cap must be untouched
+        small = a / (np.linalg.norm(a.reshape(3, -1), axis=1,
+                                    keepdims=True) * 2)
+        np.testing.assert_allclose(
+            _np(paddle.renorm(paddle.to_tensor(small), 2.0, 0, 1.0)), small,
+            rtol=1e-6)
+
+    def test_frexp(self):
+        m, e = paddle.frexp(paddle.to_tensor(self.x))
+        np.testing.assert_allclose(_np(m) * (2.0 ** _np(e)), self.x,
+                                   rtol=1e-6)
+
+    def test_trapezoid(self):
+        y = np.array([1., 2., 3., 4.], np.float32)
+        np.testing.assert_allclose(
+            float(_np(paddle.trapezoid(paddle.to_tensor(y)))),
+            np.trapezoid(y))
+        xs = np.array([0., 1., 3., 6.], np.float32)
+        np.testing.assert_allclose(
+            float(_np(paddle.trapezoid(paddle.to_tensor(y),
+                                       x=paddle.to_tensor(xs)))),
+            np.trapezoid(y, xs))
+
+    def test_take_modes(self):
+        t = paddle.to_tensor(self.x)
+        idx = paddle.to_tensor(np.array([0, 7, 14]))
+        np.testing.assert_allclose(_np(paddle.take(t, idx)),
+                                   np.take(self.x, [0, 7, 14]))
+        idx2 = paddle.to_tensor(np.array([-1, 15, 100]))
+        np.testing.assert_allclose(
+            _np(paddle.take(t, idx2, mode="wrap")),
+            np.take(self.x, [-1, 15, 100], mode="wrap"))
+        np.testing.assert_allclose(
+            _np(paddle.take(t, idx2, mode="clip")),
+            np.take(self.x, [14, 14, 14]))
+
+    def test_msort(self):
+        np.testing.assert_allclose(_np(paddle.msort(paddle.to_tensor(self.x))),
+                                   np.msort(self.x) if hasattr(np, "msort")
+                                   else np.sort(self.x, axis=0))
+
+    def test_diag_embed(self):
+        d = self.rng.randn(2, 3).astype(np.float32)
+        out = _np(paddle.diag_embed(paddle.to_tensor(d)))
+        ref = np.zeros((2, 3, 3), np.float32)
+        for i in range(2):
+            ref[i] = np.diag(d[i])
+        np.testing.assert_allclose(out, ref)
+
+    def test_unfold_windows(self):
+        out = _np(paddle.unfold(paddle.to_tensor(self.x), 1, 3, 2))
+        assert out.shape == (3, 2, 3)
+        np.testing.assert_allclose(out[:, 0, :], self.x[:, 0:3])
+        np.testing.assert_allclose(out[:, 1, :], self.x[:, 2:5])
+
+    def test_index_add_put(self):
+        t = paddle.to_tensor(self.x)
+        v = np.ones((2, 5), np.float32)
+        out = _np(paddle.index_add(t, paddle.to_tensor(np.array([0, 2])), 0,
+                                   paddle.to_tensor(v)))
+        ref = self.x.copy()
+        ref[[0, 2]] += 1
+        np.testing.assert_allclose(out, ref)
+
+        out2 = _np(paddle.index_put(
+            t, (paddle.to_tensor(np.array([0, 1])),
+                paddle.to_tensor(np.array([2, 3]))),
+            paddle.to_tensor(np.array([9., 8.], np.float32))))
+        ref2 = self.x.copy()
+        ref2[0, 2] = 9.
+        ref2[1, 3] = 8.
+        np.testing.assert_allclose(out2, ref2)
+
+    def test_index_add_grad(self):
+        t = paddle.to_tensor(self.x)
+        t.stop_gradient = False
+        v = paddle.to_tensor(np.ones((2, 5), np.float32))
+        v.stop_gradient = False
+        out = paddle.index_add(t, paddle.to_tensor(np.array([0, 2])), 0, v)
+        out.sum().backward()
+        np.testing.assert_allclose(_np(t.grad), np.ones_like(self.x))
+        np.testing.assert_allclose(_np(v.grad), np.ones((2, 5)))
+
+    def test_linalg_svdvals_multidot_cov_corrcoef(self):
+        a = self.rng.randn(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.svdvals(paddle.to_tensor(a))),
+            np.linalg.svd(a, compute_uv=False), atol=1e-5)
+        ms = [self.rng.randn(4, 4).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(
+            _np(paddle.linalg.multi_dot([paddle.to_tensor(m) for m in ms])),
+            np.linalg.multi_dot(ms), atol=1e-3)
+        np.testing.assert_allclose(_np(paddle.linalg.cov(paddle.to_tensor(a))),
+                                   np.cov(a), atol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.linalg.corrcoef(paddle.to_tensor(a))),
+            np.corrcoef(a), atol=1e-5)
+
+    def test_histogram_bin_edges(self):
+        e = _np(paddle.histogram_bin_edges(paddle.to_tensor(self.x), bins=7))
+        ref = np.histogram_bin_edges(self.x, bins=7)
+        np.testing.assert_allclose(e, ref, rtol=1e-6)
+
+    def test_cummin_cummax_tie_indices(self):
+        # first occurrence wins on ties; never a future index
+        a = np.array([3., 1., 2., 1.], np.float32)
+        v, i = paddle.cummin(paddle.to_tensor(a), axis=0)
+        np.testing.assert_allclose(_np(v), [3., 1., 1., 1.])
+        np.testing.assert_array_equal(_np(i), [0, 1, 1, 1])
+        b = np.array([1., 3., 2., 3.], np.float32)
+        v2, i2 = paddle.cummax(paddle.to_tensor(b), axis=0)
+        np.testing.assert_allclose(_np(v2), [1., 3., 3., 3.])
+        np.testing.assert_array_equal(_np(i2), [0, 1, 1, 1])
+
+    def test_cumulative_trapezoid(self):
+        y = np.array([1., 2., 3., 4.], np.float32)
+        out = _np(paddle.cumulative_trapezoid(paddle.to_tensor(y)))
+        np.testing.assert_allclose(out, [1.5, 4.0, 7.5])
+        xs = np.array([0., 1., 3., 6.], np.float32)
+        out2 = _np(paddle.cumulative_trapezoid(paddle.to_tensor(y),
+                                               x=paddle.to_tensor(xs)))
+        ref = np.array([1.5, 1.5 + 5.0, 1.5 + 5.0 + 10.5])
+        np.testing.assert_allclose(out2, ref)
