@@ -69,14 +69,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
     drop_p = float(dropout_p) if training else 0.0
 
-    # dropout routing: the flash kernel supports dropout (in-kernel PRNG /
-    # seed-regenerated mask), but its Mosaic compile at large shapes is
-    # currently far slower than the composite's; opt in with
-    # PADDLE_TPU_FLASH_DROPOUT=1 (e.g. long sequences where the composite's
-    # O(S^2) probs would not fit)
+    # dropout routing: the flash kernel handles dropout with in-kernel
+    # hardware PRNG (zero HBM mask traffic) and is the TRAINING default —
+    # measured on v5e at the GPT-2 bench shape it is both faster to compile
+    # (41s vs 88s) and faster per step than the composite (which must
+    # materialize O(S^2) probs). PADDLE_TPU_FLASH_DROPOUT=0 opts out.
     import os
     flash_drop_ok = drop_p == 0.0 or \
-        os.environ.get("PADDLE_TPU_FLASH_DROPOUT") == "1"
+        os.environ.get("PADDLE_TPU_FLASH_DROPOUT", "1") != "0"
     if mask_arr is None and flash_drop_ok and \
             _use_pallas(tuple(query.shape), tuple(key.shape), query.dtype):
         from ...ops.pallas import flash_attention as fa
