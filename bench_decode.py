@@ -1,0 +1,72 @@
+"""Decode micro-bench: GPT-2-124M-shaped FusedMultiTransformer, compiled
+multi-layer KV-cache decode (FusedDecoder) tokens/s on one chip.
+
+Not the driver's headline bench (that's bench.py); run manually:
+    python bench_decode.py
+Prints ONE JSON line {"metric", "value", "unit", ...}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.generation import FusedDecoder
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+
+    E, H, FF, L, V = ((768, 12, 3072, 12, 50304) if on_tpu
+                      else (64, 4, 128, 2, 256))
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "64"))
+    new_tokens = int(os.environ.get("BENCH_TOKENS", "64" if on_tpu else "8"))
+
+    paddle.seed(0)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L, normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    if on_tpu:
+        for lay in (embed, fmt, head):
+            lay.bfloat16()
+    fmt.eval()
+
+    dec = FusedDecoder(fmt, embed, head, max_seq_len=smax)
+    prompt = np.random.RandomState(0).randint(
+        1, V, (batch, 16)).astype(np.int32)
+
+    # warm (compiles prefill + the decode step)
+    out = dec.generate(paddle.to_tensor(prompt), max_new_tokens=4)
+    float(np.asarray(out._data).sum())
+
+    t0 = time.perf_counter()
+    out = dec.generate(paddle.to_tensor(prompt),
+                       max_new_tokens=new_tokens)
+    float(np.asarray(out._data).sum())
+    dt = time.perf_counter() - t0
+    toks = batch * new_tokens
+    print(json.dumps({
+        "metric": "fused_decode_tokens_per_sec",
+        "value": round(toks / dt, 2),
+        "unit": "tokens/s",
+        "batch": batch, "new_tokens": new_tokens, "max_seq": smax,
+        "layers": L, "hidden": E, "device": str(dev),
+    }))
+
+
+if __name__ == "__main__":
+    main()

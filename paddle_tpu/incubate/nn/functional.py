@@ -121,15 +121,17 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style
                                     =True, time_major=False, rotary_emb_base
-                                    =10000.0):
+                                    =10000.0, position_offset=0):
     """Parity: fused_rotary_position_embedding (phi fusion). Layout
-    [batch, seq, heads, head_dim]."""
+    [batch, seq, heads, head_dim]. position_offset (int or traced scalar)
+    shifts the rotary positions — the KV-cache decode step at time t rotates
+    its single new token with position t, not 0."""
     def rope(x):
         bsz, seq, nh, hd = x.shape
         if sin is None:
             inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, hd, 2,
                                                         dtype=jnp.float32) / hd))
-            t = jnp.arange(seq, dtype=jnp.float32)
+            t = jnp.arange(seq, dtype=jnp.float32) + position_offset
             freqs = jnp.outer(t, inv)
             s = jnp.sin(freqs)
             c = jnp.cos(freqs)
@@ -255,11 +257,17 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
-        if rotary_embs is not None:
-            q, k, _ = fused_rotary_position_embedding(q, k)
         cache = cache_kvs[i] if cache_kvs is not None else None
+        ts = None
         if cache is not None and time_step is not None:
-            ts = int(time_step.item()) if isinstance(time_step, Tensor) else int(time_step)
+            ts = int(time_step.item()) if isinstance(time_step, Tensor) \
+                else int(time_step)
+        if rotary_embs is not None:
+            # decode: the new token sits at absolute position ts, so its
+            # rotary phase is ts — not 0
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_offset=ts or 0)
+        if ts is not None:
 
             def upd(c, kk, vv):
                 c = c.at[0, :, :, ts:ts + s].set(jnp.swapaxes(kk, 1, 2))

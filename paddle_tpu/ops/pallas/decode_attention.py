@@ -62,8 +62,9 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)            # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        # dots in input dtype (bf16 MXU full rate), f32 accumulation/softmax
+        q = q_ref[0, 0]                                # [bq, d]
+        k = k_ref[0, 0]                                # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
@@ -79,9 +80,9 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_sc[:] = m_new
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -99,16 +100,30 @@ def decode_attention(q, k_cache, v_cache, cache_lens, scale=None):
     [cache_lens[b], cache_lens[b] + Sq) (standard write-then-attend decode
     step order).
     """
-    b, sq, h, d = q.shape
-    smax = k_cache.shape[1]
-    hk = k_cache.shape[2]
-    group = h // hk
-    if scale is None:
-        scale = d ** -0.5
-
     qt = jnp.swapaxes(q, 1, 2)                       # [B, H, Sq, D]
     kt = jnp.swapaxes(k_cache, 1, 2)                 # [B, Hk, Smax, D]
     vt = jnp.swapaxes(v_cache, 1, 2)
+    return jnp.swapaxes(
+        decode_attention_bhsd(qt, kt, vt, cache_lens, scale), 1, 2)
+
+
+def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
+    """Same as decode_attention but in kernel layout [B, H, S, D] in AND
+    out — the compiled multi-layer decode loop stores its KV cache in this
+    layout so no per-step full-cache transpose is materialized."""
+    b, h, sq, d = qt.shape
+    smax = kt.shape[2]
+    hk = kt.shape[1]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    # in-kernel dots run in the operand dtype: harmonize a mixed-precision
+    # cache with the query dtype (bf16 q + f32 cache was accepted before
+    # the bf16-dot change and must keep working)
+    if kt.dtype != qt.dtype:
+        kt = kt.astype(qt.dtype)
+    if vt.dtype != qt.dtype:
+        vt = vt.astype(qt.dtype)
 
     bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
     bk = min(256, smax) if smax % 256 == 0 or smax < 256 else 128
@@ -138,7 +153,7 @@ def decode_attention(q, k_cache, v_cache, cache_lens, scale=None):
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
-        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), qt.dtype),
         interpret=_interpret(),
     )(lens, qt, kt, vt)
-    return jnp.swapaxes(out[:, :, :sq], 1, 2)
+    return out[:, :, :sq]
