@@ -192,14 +192,16 @@ def _decode_attn(q, cache, ts, s, attn_mask):
         if da.is_supported(tuple(q.shape),
                            (kc.shape[0], kc.shape[2], kc.shape[1], kc.shape[3]),
                            q.dtype):
-            # inference-only kernel (no VJP) — bypass the autograd tape
+            # inference-only kernel (no VJP) — bypass the autograd tape;
+            # the cache is already in kernel layout [B, H, Smax, D], so use
+            # the bhsd entry point (no full-cache transposes per step)
             lens = jnp.full((q.shape[0],), ts, jnp.int32)
-            out = da.decode_attention(
-                jax.lax.stop_gradient(q._data),
-                jnp.swapaxes(jax.lax.stop_gradient(cache._data[0]), 1, 2),
-                jnp.swapaxes(jax.lax.stop_gradient(cache._data[1]), 1, 2),
+            out = da.decode_attention_bhsd(
+                jnp.swapaxes(jax.lax.stop_gradient(q._data), 1, 2),
+                jax.lax.stop_gradient(cache._data[0]),
+                jax.lax.stop_gradient(cache._data[1]),
                 lens)
-            return Tensor(out)
+            return Tensor(jnp.swapaxes(out, 1, 2))
     k_full = Tensor(jnp.swapaxes(cache._data[0, :, :, :ts + s], 1, 2))
     v_full = Tensor(jnp.swapaxes(cache._data[1, :, :, :ts + s], 1, 2))
     if attn_mask is None and s > 1:

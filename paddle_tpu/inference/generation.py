@@ -15,6 +15,7 @@ Two paths:
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -126,8 +127,10 @@ class FusedDecoder:
     # ------------------------------------------------------------ stacking
     def _stacked(self):
         f = self.fmt
-        version = tuple(id(p._data) for p in f.parameters())
-        if self._stk_cache is not None and self._stk_cache[0] == version:
+        # hold the source arrays themselves: comparing by identity is only
+        # sound while we keep them alive (freed ids get recycled)
+        version = [p._data for p in f.parameters()]
+        if self._stk_cache is not None and                 len(self._stk_cache[0]) == len(version) and                 all(a is b for a, b in zip(self._stk_cache[0], version)):
             return self._stk_cache[1]
 
         def stk(plist):
@@ -279,8 +282,11 @@ class FusedDecoder:
                 nxt = jnp.argmax(logits, axis=-1)
             return nxt.astype(jnp.int32), caches
 
-        return jax.jit(step, donate_argnums=(3,)
-                       if jax.default_backend() != "tpu" else ())
+        # donate the KV cache (in-place ring update, no per-token copy of
+        # the [L,2,B,H,Smax,D] buffer) — except through the axon tunnel,
+        # where buffer donation is observed to hang (see BASELINE.md r2)
+        tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        return jax.jit(step, donate_argnums=() if tunneled else (3,))
 
     # --------------------------------------------------------------- drive
     @no_grad()
@@ -304,7 +310,9 @@ class FusedDecoder:
                 rotary_embs=True if self.use_rotary else None)
         out = out[0] if isinstance(out, tuple) else out
         caches = jnp.stack([c._data for c in layer_caches])
-        logits = self.head(out)
+        last = Tensor(out._data[:, -1:]) if isinstance(out, Tensor) else \
+            Tensor(out[:, -1:])
+        logits = self.head(last)
         logits = (logits._data if isinstance(logits, Tensor) else logits)
         nxt = _sample_next(logits[:, -1], do_sample, top_k, top_p,
                            temperature)
@@ -323,6 +331,8 @@ class FusedDecoder:
         finished = jnp.zeros((b,), bool)
         if eos_token_id is not None:
             finished = finished | (nxt == eos_token_id)
+            if bool(jnp.all(finished)):
+                max_new_tokens = 1            # everything ended at prefill
         for i in range(1, max_new_tokens):
             t = jnp.asarray(prompt + i - 1, jnp.int32)
             k_i = next_key() if do_sample else _zero_key

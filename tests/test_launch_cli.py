@@ -4,6 +4,8 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 COMPANION = """
 import os, sys
 rank = os.environ["PADDLE_TRAINER_ID"]
@@ -30,6 +32,8 @@ def _run_launch(tmp_path, script_body, extra_args, script_args):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--log_dir", str(tmp_path / "log")] + extra_args +
@@ -59,3 +63,94 @@ class TestLaunchCLI:
         r = _run_launch(tmp_path, "import sys; sys.exit(3)\n",
                         ["--nproc_per_node", "1"], [])
         assert r.returncode == 3
+
+
+FT_TRAIN = """
+# Fault-tolerance companion (SURVEY §5.3): trains a Linear regressor,
+# checkpoints every step, dies mid-training on the first attempt, and on
+# relaunch resumes from the checkpoint. The loss curve file must end up
+# identical to an uninterrupted run.
+import os, sys, json
+import numpy as np
+import paddle_tpu as paddle
+
+workdir = sys.argv[1]
+kill_at = int(sys.argv[2])        # <0: never (the uninterrupted oracle run)
+steps = 8
+
+paddle.seed(7)
+m = paddle.nn.Linear(4, 1)
+opt = paddle.optimizer.SGD(0.2, parameters=m.parameters())
+
+ck = os.path.join(workdir, "ck.pdparams")
+curve_path = os.path.join(workdir, "curve.jsonl")
+start = 0
+if os.path.exists(ck):
+    state = paddle.load(ck)
+    m.set_state_dict(state["model"])
+    opt.set_state_dict(state["opt"])
+    start = state["step"]
+
+rng = np.random.RandomState(0)
+xs = rng.randn(steps, 16, 4).astype(np.float32)
+w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+
+for step in range(start, steps):
+    x = paddle.to_tensor(xs[step])
+    y = paddle.to_tensor(xs[step] @ w_true)
+    loss = ((m(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    with open(curve_path, "a") as f:
+        f.write(json.dumps({"step": step,
+                            "loss": float(np.asarray(loss._data))}) + "\\n")
+    paddle.save({"model": m.state_dict(), "opt": opt.state_dict(),
+                 "step": step + 1}, ck)
+    if step + 1 == kill_at and not os.path.exists(
+            os.path.join(workdir, "died")):
+        open(os.path.join(workdir, "died"), "w").write("1")
+        os._exit(17)              # simulated worker crash mid-training
+"""
+
+
+class TestFaultToleranceResume:
+    def _curve(self, path):
+        import json
+        rows = [json.loads(l) for l in open(path)]
+        # resumed runs re-log nothing before `start`; keep last value per step
+        by_step = {}
+        for r in rows:
+            by_step[r["step"]] = r["loss"]
+        return [by_step[i] for i in sorted(by_step)]
+
+    def test_kill_relaunch_resume_matches_uninterrupted(self, tmp_path):
+        """Reference contract (launch/controllers/controller.py + elastic):
+        a worker dying mid-training is relaunched by --max_restart and the
+        checkpoint-resumed loss curve equals the uninterrupted one."""
+        int_dir = tmp_path / "interrupted"
+        ref_dir = tmp_path / "oracle"
+        int_dir.mkdir(), ref_dir.mkdir()
+
+        r = _run_launch(tmp_path, FT_TRAIN,
+                        ["--nproc_per_node", "1", "--max_restart", "1"],
+                        [str(int_dir), "4"])
+        assert r.returncode == 0, r.stderr
+        assert (int_dir / "died").exists()          # it really crashed
+        assert "restarting" in r.stderr             # launcher relaunched it
+
+        r2 = _run_launch(tmp_path, FT_TRAIN,
+                         ["--nproc_per_node", "1"], [str(ref_dir), "-1"])
+        assert r2.returncode == 0, r2.stderr
+
+        got = self._curve(int_dir / "curve.jsonl")
+        want = self._curve(ref_dir / "curve.jsonl")
+        assert len(got) == len(want) == 8
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_no_restart_budget_fails(self, tmp_path):
+        d = tmp_path / "nobudget"
+        d.mkdir()
+        r = _run_launch(tmp_path, FT_TRAIN, ["--nproc_per_node", "1"],
+                        [str(d), "2"])
+        assert r.returncode == 17                   # crash surfaces
