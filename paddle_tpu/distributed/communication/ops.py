@@ -10,6 +10,7 @@ from __future__ import annotations
 import pickle
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ...tensor.tensor import Tensor
@@ -63,9 +64,26 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference semantics: only dst receives the reduction; other ranks'
+    buffers are left as-is (XLA computes the allreduce — the cheapest ICI
+    realization — but non-dst ranks discard it). Non-members no-op;
+    dst must be in the group."""
     g = group or _default_group()
-    out = g.pg.allreduce(tensor._data, op)  # all ranks get it; dst semantics kept
-    tensor._data = out
+    if g.ranks and g.rank < 0:
+        return Task()                       # this process isn't a member
+    dst_in_group = g.get_group_rank(dst) if g.ranks else dst
+    if dst_in_group < 0:
+        raise ValueError(f"reduce: dst rank {dst} is not in the group")
+    arr = tensor._data
+    out = g.pg.allreduce(arr, op)
+    if isinstance(arr, jax.core.Tracer) and g.pg.axis_name:
+        # SPMD trace: every device runs this code — select per-device with
+        # the mesh axis index, not the host-side process rank
+        me = jax.lax.axis_index(g.pg.axis_name)
+        tensor._data = jnp.where(me == dst_in_group, out, arr)
+        return Task(out)
+    if g.nranks <= 1 or max(g.rank, 0) == dst_in_group:
+        tensor._data = out
     return Task(out)
 
 
@@ -75,7 +93,12 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor._data = tensor_list[0]._data
         return Task()
-    # src rank provides tensor_list; realize as broadcast of the stack + index
+    # src rank provides tensor_list; realized as broadcast-of-stack + index.
+    # XLA has no single-source variadic scatter primitive; on the ICI torus
+    # a broadcast is a pipelined ring and non-dst chunks are dead-code at
+    # the slice, so the practical cost matches a hand-rolled scatter for
+    # the small control tensors this API is used for (EP dispatch uses
+    # alltoall, not this).
     stacked = (jnp.stack([t._data for t in tensor_list])
                if tensor_list else jnp.zeros((g.nranks, *tensor.shape),
                                              tensor.dtype))

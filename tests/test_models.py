@@ -258,3 +258,23 @@ class TestVisionZooAdditions:
         out, aux1, aux2 = m(x)
         assert out.shape == [1, 10] and aux1.shape == [1, 10] \
             and aux2.shape == [1, 10]
+
+    def test_mobilenet_v1(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+        self._run(mobilenet_v1(scale=0.25, num_classes=10), size=64)
+
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import (mobilenet_v3_small,
+                                              mobilenet_v3_large)
+        self._run(mobilenet_v3_small(scale=0.5, num_classes=10), size=64)
+        self._run(mobilenet_v3_large(scale=0.35, num_classes=10), size=64)
+
+    def test_resnext_and_wide(self):
+        from paddle_tpu.vision.models import (resnext50_32x4d,
+                                              wide_resnet50_2)
+        self._run(resnext50_32x4d(num_classes=10), size=64)
+        self._run(wide_resnet50_2(num_classes=10), size=64)
+
+    def test_inception_v3(self):
+        from paddle_tpu.vision.models import inception_v3
+        self._run(inception_v3(num_classes=10), size=299)

@@ -1,0 +1,99 @@
+"""Vision datasets vs synthesized standard-format files (SURVEY §2.6)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.vision.datasets import (MNIST, FashionMNIST, Cifar10,
+                                        Cifar100, DatasetFolder, ImageFolder)
+
+
+def _write_mnist(tmp, n=7):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    ip = os.path.join(tmp, "imgs.gz")
+    lp = os.path.join(tmp, "labels.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+    return ip, lp, imgs, labels
+
+
+def _write_cifar(tmp, cifar100=False, n=6):
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 255, (n, 3072), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,)).tolist()
+    key = b"fine_labels" if cifar100 else b"labels"
+    member = "train" if cifar100 else "data_batch_1"
+    payload = pickle.dumps({b"data": data, key: labels})
+    path = os.path.join(tmp, "cifar.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        import io as _io
+        info = tarfile.TarInfo(f"cifar/{member}")
+        info.size = len(payload)
+        tf.addfile(info, _io.BytesIO(payload))
+    return path, data, labels
+
+
+class TestVisionDatasets:
+    def test_mnist_roundtrip(self, tmp_path):
+        ip, lp, imgs, labels = _write_mnist(str(tmp_path))
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == len(imgs)
+        img, lab = ds[3]
+        assert img.shape == (1, 28, 28)
+        np.testing.assert_array_equal(img[0], imgs[3].astype(np.float32))
+        assert lab == int(labels[3])
+        ds2 = FashionMNIST(image_path=ip, label_path=lp)
+        assert len(ds2) == len(imgs)
+
+    def test_cifar10_and_100(self, tmp_path):
+        p, data, labels = _write_cifar(str(tmp_path))
+        ds = Cifar10(data_file=p, mode="train")
+        img, lab = ds[2]
+        assert img.shape == (3, 32, 32)
+        np.testing.assert_array_equal(
+            img.reshape(-1), data[2].astype(np.float32))
+        assert lab == labels[2]
+
+        p2, d2, l2 = _write_cifar(str(tmp_path), cifar100=True)
+        ds2 = Cifar100(data_file=p2, mode="train")
+        assert len(ds2) == len(d2)
+
+    def test_missing_file_raises_clear_error(self, tmp_path):
+        import pytest
+        with pytest.raises(FileNotFoundError, match="network"):
+            MNIST(image_path=str(tmp_path / "nope.gz"),
+                  label_path=str(tmp_path / "nope2.gz"))
+
+    def test_dataset_folder(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                Image.fromarray(np.full((8, 8, 3), 100 + i,
+                                        np.uint8)).save(d / f"{i}.png")
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 4
+        assert ds.classes == ["cat", "dog"]
+        img, target = ds[0]
+        assert img.shape == (3, 8, 8) and target == 0
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat.samples) == 4
+        assert flat[0][0].shape == (3, 8, 8)
+
+    def test_with_dataloader(self, tmp_path):
+        import paddle_tpu as paddle
+        ip, lp, imgs, labels = _write_mnist(str(tmp_path), n=8)
+        ds = MNIST(image_path=ip, label_path=lp)
+        loader = paddle.io.DataLoader(ds, batch_size=4, shuffle=False)
+        batches = list(loader)
+        assert len(batches) == 2
+        xb, yb = batches[0]
+        assert tuple(xb.shape) == (4, 1, 28, 28)
