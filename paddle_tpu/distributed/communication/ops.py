@@ -55,9 +55,27 @@ def all_gather_object(object_list, obj, group=None):
         object_list.append(pickle.loads(raw))
 
 
+def _capture_collective(tensor, fn):
+    """Static capture: record an in-place collective into the active
+    Program (the reference's c_* collective ops in ProgramDesc); returns a
+    Task when recorded, None when no capture is active."""
+    from ...tensor.tensor import apply_op, _capture_hook
+    if _capture_hook[0] is None:
+        return None
+    from ...static import _alias_capture_output
+    out = apply_op(fn, tensor)
+    tensor._data = out._data
+    _alias_capture_output(out, tensor)
+    return Task(out._data)
+
+
 def broadcast(tensor, src=0, group=None, sync_op=True):
     g = group or _default_group()
     src_in_group = g.get_group_rank(src) if g.ranks else src
+    t = _capture_collective(
+        tensor, lambda a: g.pg.broadcast(a, max(src_in_group, 0)))
+    if t is not None:
+        return t
     out = g.pg.broadcast(tensor._data, max(src_in_group, 0))
     tensor._data = out
     return Task(out)
@@ -74,6 +92,9 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     dst_in_group = g.get_group_rank(dst) if g.ranks else dst
     if dst_in_group < 0:
         raise ValueError(f"reduce: dst rank {dst} is not in the group")
+    t = _capture_collective(tensor, lambda a: g.pg.allreduce(a, op))
+    if t is not None:
+        return t
     arr = tensor._data
     out = g.pg.allreduce(arr, op)
     if isinstance(arr, jax.core.Tracer) and g.pg.axis_name:
@@ -93,6 +114,20 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor._data = tensor_list[0]._data
         return Task()
+    from ...tensor.tensor import _capture_hook
+    if _capture_hook[0] is not None and tensor_list:
+        from ...tensor.tensor import apply_op
+        from ...static import _alias_capture_output
+        me = max(g.rank, 0)
+        src_gr = max(g.get_group_rank(src), 0)
+
+        def f(*arrs):
+            full = g.pg.broadcast(jnp.stack(arrs), src_gr)
+            return full[me]
+        out = apply_op(f, *tensor_list)
+        tensor._data = out._data
+        _alias_capture_output(out, tensor)
+        return Task(out._data)
     # src rank provides tensor_list; realized as broadcast-of-stack + index.
     # XLA has no single-source variadic scatter primitive; on the ICI torus
     # a broadcast is a pipelined ring and non-dst chunks are dead-code at

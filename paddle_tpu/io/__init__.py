@@ -268,10 +268,31 @@ class WorkerInfo:
 
 
 _worker_info = threading.local()
+_proc_worker_info = [None]        # set in forked worker processes
 
 
 def get_worker_info():
-    return getattr(_worker_info, "info", None)
+    return getattr(_worker_info, "info", None) or _proc_worker_info[0]
+
+
+def _proc_worker_main(dataset, task_q, res_q, wid, num_workers,
+                      worker_init_fn):
+    """Forked worker: fetch raw sample lists; collate stays in the parent
+    (a fork must not touch the accelerator client)."""
+    import traceback
+    _proc_worker_info[0] = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn:
+        worker_init_fn(wid)
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        i, idx_batch = item
+        try:
+            samples = [dataset[j] for j in idx_batch]
+            res_q.put((i, True, samples))
+        except BaseException:
+            res_q.put((i, False, traceback.format_exc()))
 
 
 def default_collate_fn(batch):
@@ -306,6 +327,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self._fork_ok = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -329,6 +353,12 @@ class DataLoader:
         return self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        gen = self._raw_iter()
+        if self.use_buffer_reader:
+            gen = self._device_prefetch(gen)
+        yield from gen
+
+    def _raw_iter(self):
         if self._iterable_mode:
             yield from self._iter_iterable()
             return
@@ -336,7 +366,95 @@ class DataLoader:
             for idx_batch in self.batch_sampler:
                 yield self._fetch(idx_batch)
             return
-        yield from self._iter_workers()
+        import os
+        if os.environ.get("PADDLE_TPU_LOADER_THREADS") == "1" or \
+                not self._fork_safe():
+            yield from self._iter_workers()
+        else:
+            yield from self._iter_process_workers()
+
+    def _fork_safe(self):
+        """Process workers only when a probe sample contains no device
+        arrays: a forked child must never touch the XLA client (fork-unsafe),
+        and device-tensor datasets (TensorDataset) are trivial indexing
+        where threads lose nothing. Host-data datasets — the decode/augment
+        workloads processes exist for — pass the probe."""
+        if self._fork_ok is None:
+            def host_only(x):
+                if isinstance(x, Tensor):
+                    return isinstance(x._data, np.ndarray)
+                if isinstance(x, (list, tuple)):
+                    return all(host_only(i) for i in x)
+                if isinstance(x, dict):
+                    return all(host_only(v) for v in x.values())
+                return not type(x).__module__.startswith("jax")
+            try:
+                self._fork_ok = host_only(self.dataset[0])
+            except Exception:
+                self._fork_ok = False
+        return self._fork_ok
+
+    # ----------------------------------------------------- device prefetch
+    def _device_prefetch(self, gen):
+        """Pin-memory-thread equivalent (reference: _DataLoaderIterMulti*'s
+        pin-memory/buffer reader): a thread stays prefetch_factor batches
+        ahead, converting to device arrays so host→device transfer overlaps
+        the consumer's step. XLA's async dispatch makes device_put cheap to
+        issue; the queue depth provides the double-buffering."""
+        import jax
+
+        def to_device(item):
+            if isinstance(item, Tensor):
+                if isinstance(item._data, np.ndarray):
+                    return Tensor(jax.device_put(item._data))
+                return item
+            if isinstance(item, np.ndarray):
+                return Tensor(jax.device_put(item))
+            if isinstance(item, (list, tuple)):
+                return type(item)(to_device(i) for i in item)
+            if isinstance(item, dict):
+                return {k: to_device(v) for k, v in item.items()}
+            return item
+
+        end = object()
+        err_box = []
+        q: "queue.Queue" = queue.Queue(maxsize=max(self.prefetch_factor, 1))
+        stop = threading.Event()
+
+        def feeder():
+            try:
+                for item in gen:
+                    item = to_device(item)
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                err_box.append(e)
+            finally:
+                while not stop.is_set():
+                    try:
+                        q.put(end, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    if err_box:
+                        raise err_box[0]
+                    return
+                yield item
+        finally:
+            stop.set()
 
     def _iter_iterable(self):
         batch = []
@@ -347,6 +465,66 @@ class DataLoader:
                 batch = []
         if batch and not getattr(self, "drop_last", False):
             yield self.collate_fn(batch)
+
+    # --------------------------------------------------- process workers
+    def _iter_process_workers(self):
+        """Process-based workers (the reference's default multiprocess
+        loader): dataset __getitem__ — decode/augment, the Python-heavy
+        part — runs in forked children free of the parent's GIL; samples
+        travel back pickled and the PARENT applies collate_fn (user collate
+        may build device tensors, which must not happen in a fork that
+        would re-initialize the accelerator client). Thread mode (the r1
+        behavior) remains via PADDLE_TPU_LOADER_THREADS=1."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        n_total = len(batches)
+        task_q = ctx.Queue()
+        res_q = ctx.Queue(maxsize=max(
+            self.num_workers * self.prefetch_factor, 2))
+        for item in enumerate(batches):
+            task_q.put(item)
+        for _ in range(self.num_workers):
+            task_q.put(None)
+
+        procs = [
+            ctx.Process(target=_proc_worker_main,
+                        args=(self.dataset, task_q, res_q, wid,
+                              self.num_workers, self.worker_init_fn),
+                        daemon=True)
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+
+        pending: dict[int, object] = {}
+        timeout = self.timeout or 5.0
+        try:
+            for want in range(n_total):
+                while want not in pending:
+                    try:
+                        i, ok, payload = res_q.get(timeout=timeout)
+                    except queue.Empty:
+                        if not any(p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                f"DataLoader worker processes died before "
+                                f"batch {want}")
+                        continue
+                    if not ok:
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{payload}")
+                    pending[i] = payload
+                yield self.collate_fn(pending.pop(want))
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2.0)
+            for q_ in (task_q, res_q):
+                q_.cancel_join_thread()
+                q_.close()
 
     def _iter_workers(self):
         """Multi-worker prefetch. Workers share one scaffolding; the

@@ -205,6 +205,8 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
+        data_parallel = isinstance(program, CompiledProgram) and \
+            getattr(program, "_data_parallel", False)
         program = program if isinstance(program, Program) else \
             (program.program if isinstance(program, CompiledProgram)
              else None) or default_main_program()
@@ -219,8 +221,16 @@ class Executor:
             for uid, name in program.feed_holders.items():
                 if name in feed:
                     v = feed[name]
-                    env[uid] = v if isinstance(v, Tensor) else \
+                    t = v if isinstance(v, Tensor) else \
                         Tensor(np.asarray(v))
+                    if data_parallel:
+                        # static-dp pass: shard the feed's batch dim over
+                        # the hybrid mesh's data axes (the reference's
+                        # distributed-program rewrite feeds per-rank
+                        # slices; GSPMD runs the replayed ops SPMD)
+                        from ..parallel import shard_batch
+                        t = shard_batch(t)
+                    env[uid] = t
             from ..tensor.tensor import apply_op
             training = bool(program._minimize_hooks)
             for op in program.ops:
@@ -260,3 +270,14 @@ class Executor:
 class CompiledProgram:
     def __init__(self, program, build_strategy=None):
         self.program = program
+        self.build_strategy = build_strategy
+        self._data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Parity: CompiledProgram.with_data_parallel — marks the program
+        for data-parallel execution; Executor.run then shards feeds over
+        the active hybrid mesh's data axes (fleet.init supplies the mesh)."""
+        self._data_parallel = True
+        return self

@@ -133,14 +133,14 @@ class Flowers(Dataset):
         self.indexes = setid[key][0]
         self.labels = labels
         self._tar = data_file
-        self._tf = None
+        self._local = None      # per-thread/process tar handles (lazy)
         with tarfile.open(data_file, "r:*") as tf:
             self._names = {os.path.basename(m.name): m.name
                            for m in tf.getmembers() if m.isfile()}
 
     def __getstate__(self):
         d = dict(self.__dict__)
-        d["_tf"] = None                     # tar handles don't pickle
+        d["_local"] = None                  # tar handles don't pickle
         return d
 
     def __len__(self):
@@ -151,11 +151,17 @@ class Flowers(Dataset):
         import io as _io
         i = int(self.indexes[idx])
         name = self._names[f"image_{i:05d}.jpg"]
-        if self._tf is None:
-            # one persistent handle per process/worker: re-opening a gzip'd
-            # tar per sample would re-decompress the archive every time
-            self._tf = tarfile.open(self._tar, "r:*")
-        raw = self._tf.extractfile(name).read()
+        import threading
+        if self._local is None:
+            self._local = threading.local()
+        tf = getattr(self._local, "tf", None)
+        if tf is None:
+            # one persistent handle per worker THREAD (a shared handle's
+            # file descriptor would interleave concurrent reads) — and
+            # re-opening a gzip'd tar per sample would re-decompress the
+            # archive every time
+            tf = self._local.tf = tarfile.open(self._tar, "r:*")
+        raw = tf.extractfile(name).read()
         img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
                          np.float32).transpose(2, 0, 1)
         if self.transform is not None:

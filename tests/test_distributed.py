@@ -450,3 +450,67 @@ class TestParallelCrossEntropy:
         np.testing.assert_allclose(np.asarray(out._data),
                                    np.asarray(ref._data), atol=1e-5,
                                    rtol=1e-5)
+
+
+@needs8
+class TestAutoParallelEngine:
+    """Engine.fit over a ProcessMesh (VERDICT r1 missing-4; reference:
+    auto_parallel Engine + planner — here the planner is GSPMD)."""
+
+    def _mk(self, annotate):
+        from paddle_tpu.distributed.auto_parallel import (Engine, ProcessMesh,
+                                                          Shard, Replicate,
+                                                          set_mesh,
+                                                          shard_tensor)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+        set_mesh(mesh)
+        paddle.seed(21)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.Tanh(),
+            paddle.nn.Linear(32, 16))
+        if annotate:
+            # Megatron column/row: fc1 sharded on out, fc2 on in over 'mp'
+            shard_tensor(model[0].weight, mesh, [Replicate(), Shard(1)])
+            shard_tensor(model[2].weight, mesh, [Replicate(), Shard(0)])
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        loss = lambda out, y: ((out - y) ** 2).mean()
+        return Engine(model, loss, opt), model
+
+    def _data(self, n=32):
+        from paddle_tpu.io import TensorDataset
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, 16).astype(np.float32)
+        w = rng.randn(16, 16).astype(np.float32) * 0.3
+        return TensorDataset([paddle.to_tensor(x),
+                              paddle.to_tensor(x @ w)])
+
+    def test_fit_loss_decreases_and_placement(self):
+        engine, model = self._mk(annotate=True)
+        hist = engine.fit(self._data(), epochs=4, batch_size=8)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0] * 0.7, losses
+        # annotated params actually sharded over mp (addressable shards 1/4)
+        w1 = model[0].weight._data
+        shapes = {tuple(s.data.shape) for s in w1.addressable_shards}
+        assert shapes == {(16, 8)}, shapes
+
+    def test_fit_matches_unannotated_numerics(self):
+        e1, m1 = self._mk(annotate=True)
+        np.random.seed(7)                  # fixed shuffle order
+        h1 = e1.fit(self._data(), epochs=2, batch_size=8)
+        e2, m2 = self._mk(annotate=False)
+        np.random.seed(7)
+        h2 = e2.fit(self._data(), epochs=2, batch_size=8)
+        np.testing.assert_allclose(h1.history["loss"], h2.history["loss"],
+                                   rtol=1e-4)
+
+    def test_evaluate_and_predict_and_save(self, tmp_path):
+        engine, model = self._mk(annotate=True)
+        ds = self._data(16)
+        engine.fit(ds, epochs=1, batch_size=8)
+        res = engine.evaluate(ds, batch_size=8)
+        assert np.isfinite(res["loss"])
+        outs = engine.predict(ds, batch_size=8)
+        assert len(outs) == 2 and outs[0].shape == [8, 16]
+        engine.save(str(tmp_path / "engine.pdparams"))
+        engine.load(str(tmp_path / "engine.pdparams"))

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.io as io
 from paddle_tpu import nn
 from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
                            DistributedBatchSampler, IterableDataset,
@@ -251,3 +252,67 @@ class TestStaticAPI:
     def test_input_spec(self):
         spec = paddle.static.InputSpec([None, 4], "float32", "x")
         assert spec.shape == [None, 4]
+
+
+class TestProcessWorkers:
+    """Process-based DataLoader workers + device-prefetch buffer
+    (VERDICT r1 missing-6; reference: python/paddle/io/dataloader/ worker
+    processes & pin-memory thread)."""
+
+    def _ds(self, n=12):
+        class SquareDS(io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return (np.full((3,), i, np.float32), i * i)
+        return SquareDS()
+
+    def test_process_workers_order_and_values(self):
+        loader = io.DataLoader(self._ds(), batch_size=4, shuffle=False,
+                               num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 3
+        for bi, (xb, yb) in enumerate(batches):
+            np.testing.assert_allclose(
+                np.asarray(xb._data)[:, 0], [bi * 4 + j for j in range(4)])
+            np.testing.assert_allclose(
+                np.asarray(yb._data), [(bi * 4 + j) ** 2 for j in range(4)])
+
+    def test_worker_exception_propagates(self):
+        class BadDS(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise ValueError("boom at 5")
+                return np.zeros((2,), np.float32)
+
+        loader = io.DataLoader(BadDS(), batch_size=2, shuffle=False,
+                               num_workers=2)
+        import pytest
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(loader)
+
+    def test_get_worker_info_in_process(self):
+        class WhoDS(io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                info = io.get_worker_info()
+                assert info is not None and info.num_workers == 2
+                return np.asarray([info.id], np.int64)
+
+        loader = io.DataLoader(WhoDS(), batch_size=2, shuffle=False,
+                               num_workers=2)
+        ids = np.concatenate([np.asarray(b._data) for b in list(loader)])
+        assert set(ids.reshape(-1)) <= {0, 1}
+
+    def test_device_prefetch_yields_device_tensors(self):
+        loader = io.DataLoader(self._ds(4), batch_size=2, shuffle=False,
+                               num_workers=0, use_buffer_reader=True)
+        xb, yb = next(iter(loader))
+        import jax
+        assert isinstance(xb._data, jax.Array)
