@@ -87,19 +87,29 @@ class ProcessGroupXLA:
             return self.mesh
         if jax.process_count() == 1:
             return None
-        devs = np.array(jax.devices())[: self.nranks]
+        # one device PER PROCESS: each rank must address exactly its own
+        # shard (hosts may expose several local devices, e.g. a virtual
+        # CPU mesh — taking jax.devices()[:n] could land two mesh slots in
+        # one process and break make_array_from_process_local_data)
+        by_proc: dict[int, object] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        members = self.ranks if self.ranks else sorted(by_proc)[: self.nranks]
+        devs = np.array([by_proc[r] for r in members])
         return Mesh(devs, ("ranks",))
 
     def _run_sharded(self, key, arr, fn, out_spec=None):
         """Cached shard_map program over the group mesh (multi-process path)."""
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         mesh = self._eager_mesh()
         axis = self._axis()
         ck = (key, tuple(arr.shape), str(arr.dtype))
         if ck not in self._jit_cache:
             in_spec = P(axis)
             sm = shard_map(fn, mesh=mesh, in_specs=(in_spec,),
-                           out_specs=out_spec if out_spec is not None else in_spec)
+                           out_specs=out_spec if out_spec is not None
+                           else in_spec,
+                           check_vma=False)
             self._jit_cache[ck] = jax.jit(sm)
         global_arr = jax.make_array_from_process_local_data(
             NamedSharding(mesh, P(axis)),
@@ -128,9 +138,10 @@ class ProcessGroupXLA:
             return lax.all_gather(arr, self._axis())
         if self.nranks <= 1 or jax.process_count() == 1:
             return jnp.asarray(arr)[None]
+        # replicated out_spec: every rank materializes the full [n, ...]
         return jnp.asarray(self._run_sharded(
             ("allgather",), arr,
-            lambda x: lax.all_gather(x, self._axis())))
+            lambda x: lax.all_gather(x[0], self._axis()), out_spec=P()))
 
     def reducescatter(self, arr, op=ReduceOp.SUM):
         import jax.lax as lax
@@ -138,9 +149,11 @@ class ProcessGroupXLA:
             return lax.psum_scatter(arr, self._axis(), tiled=True)
         if self.nranks <= 1 or jax.process_count() == 1:
             return arr
+        # rank-varying chunks: out_spec over the axis, my addressable
+        # shard IS my chunk
         return jnp.asarray(self._run_sharded(
             ("reducescatter", op), arr,
-            lambda x: lax.psum_scatter(x, self._axis(), tiled=True))[0])
+            lambda x: lax.psum_scatter(x[0], self._axis(), tiled=True)))
 
     def broadcast(self, arr, src_group_rank=0):
         import jax.lax as lax
@@ -151,7 +164,8 @@ class ProcessGroupXLA:
             return arr
         return jnp.asarray(self._run_sharded(
             ("broadcast", src_group_rank), arr,
-            lambda x: lax.all_gather(x, self._axis())[src_group_rank]))
+            lambda x: lax.all_gather(x[0], self._axis())[src_group_rank],
+            out_spec=P()))
 
     def alltoall(self, arr):
         import jax.lax as lax
@@ -162,7 +176,7 @@ class ProcessGroupXLA:
             return arr
         return jnp.asarray(self._run_sharded(
             ("alltoall",), arr,
-            lambda x: lax.all_to_all(x, self._axis(), 0, 0, tiled=True))[0])
+            lambda x: lax.all_to_all(x[0], self._axis(), 0, 0, tiled=True)))
 
     def permute(self, arr, perm):
         """ppermute: perm is a list of (src, dst) group-rank pairs."""

@@ -92,7 +92,16 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     dst_in_group = g.get_group_rank(dst) if g.ranks else dst
     if dst_in_group < 0:
         raise ValueError(f"reduce: dst rank {dst} is not in the group")
-    t = _capture_collective(tensor, lambda a: g.pg.allreduce(a, op))
+    def _dst_gated(a):
+        out_ = g.pg.allreduce(a, op)
+        if isinstance(a, jax.core.Tracer) and g.pg.axis_name:
+            me = jax.lax.axis_index(g.pg.axis_name)
+            return jnp.where(me == dst_in_group, out_, a)
+        if g.nranks <= 1 or max(g.rank, 0) == dst_in_group:
+            return out_
+        return a
+
+    t = _capture_collective(tensor, _dst_gated)
     if t is not None:
         return t
     arr = tensor._data

@@ -31,8 +31,17 @@ def _maybe_jax_distributed_init():
     """Multi-host init from PADDLE_* or JAX_* env (TCPStore-equivalent)."""
     n = int(os.environ.get("PADDLE_TRAINERS_NUM",
                            os.environ.get("JAX_NUM_PROCESSES", "1")))
-    if n <= 1 or jax.process_count() > 1:
+    if n <= 1:
         return
+    # must NOT call jax.process_count() here: it initializes the XLA
+    # backend, after which jax.distributed.initialize refuses to run —
+    # probe the distributed client state instead
+    try:
+        from jax._src import distributed as _jd
+        if getattr(_jd.global_state, "client", None) is not None:
+            return
+    except Exception:
+        pass
     coord = os.environ.get("PADDLE_MASTER",
                            os.environ.get("JAX_COORDINATOR_ADDRESS"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID",

@@ -12,6 +12,7 @@ import os
 import pickle
 import struct
 import tarfile
+import threading
 
 import numpy as np
 
@@ -133,7 +134,7 @@ class Flowers(Dataset):
         self.indexes = setid[key][0]
         self.labels = labels
         self._tar = data_file
-        self._local = None      # per-thread/process tar handles (lazy)
+        self._local = threading.local()   # per-thread tar handles
         with tarfile.open(data_file, "r:*") as tf:
             self._names = {os.path.basename(m.name): m.name
                            for m in tf.getmembers() if m.isfile()}
@@ -143,6 +144,10 @@ class Flowers(Dataset):
         d["_local"] = None                  # tar handles don't pickle
         return d
 
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._local = threading.local()
+
     def __len__(self):
         return len(self.indexes)
 
@@ -151,17 +156,16 @@ class Flowers(Dataset):
         import io as _io
         i = int(self.indexes[idx])
         name = self._names[f"image_{i:05d}.jpg"]
-        import threading
-        if self._local is None:
-            self._local = threading.local()
-        tf = getattr(self._local, "tf", None)
-        if tf is None:
-            # one persistent handle per worker THREAD (a shared handle's
-            # file descriptor would interleave concurrent reads) — and
-            # re-opening a gzip'd tar per sample would re-decompress the
-            # archive every time
-            tf = self._local.tf = tarfile.open(self._tar, "r:*")
-        raw = tf.extractfile(name).read()
+        # one persistent handle per (process, thread): a shared handle's
+        # file descriptor would interleave concurrent reads — including a
+        # handle inherited across fork (pid check), and re-opening a
+        # gzip'd tar per sample would re-decompress the archive each time
+        pid = os.getpid()
+        entry = getattr(self._local, "tf", None)
+        if entry is None or entry[0] != pid:
+            entry = (pid, tarfile.open(self._tar, "r:*"))
+            self._local.tf = entry
+        raw = entry[1].extractfile(name).read()
         img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
                          np.float32).transpose(2, 0, 1)
         if self.transform is not None:
