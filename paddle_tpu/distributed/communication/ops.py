@@ -104,16 +104,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     t = _capture_collective(tensor, _dst_gated)
     if t is not None:
         return t
-    arr = tensor._data
-    out = g.pg.allreduce(arr, op)
-    if isinstance(arr, jax.core.Tracer) and g.pg.axis_name:
-        # SPMD trace: every device runs this code — select per-device with
-        # the mesh axis index, not the host-side process rank
-        me = jax.lax.axis_index(g.pg.axis_name)
-        tensor._data = jnp.where(me == dst_in_group, out, arr)
-        return Task(out)
-    if g.nranks <= 1 or max(g.rank, 0) == dst_in_group:
-        tensor._data = out
+    out = _dst_gated(tensor._data)
+    tensor._data = out
     return Task(out)
 
 

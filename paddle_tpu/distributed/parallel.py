@@ -48,8 +48,15 @@ def _maybe_jax_distributed_init():
                              os.environ.get("JAX_PROCESS_ID", "0")))
     if coord:
         _store_barrier(coord, n, pid)
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=n, process_id=pid)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n, process_id=pid)
+        except RuntimeError:
+            # already initialized (user called it, or the private-state
+            # probe above failed on a newer jax) — proceed with the
+            # existing client
+            if jax.process_count() != n:
+                raise
 
 
 def _store_barrier(coord: str, world: int, rank: int):

@@ -221,3 +221,56 @@ class TestMultiProcessCollectives:
                         ["--nproc_per_node", "2"], [str(tmp_path)])
         assert r.returncode == 0, (r.stdout, r.stderr)
         assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+ELASTIC_WORKER = """
+# Elastic end-to-end companion: each worker registers + heartbeats; rank 0
+# watches membership. Worker 1 exits mid-run -> rank 0 must observe RESTART
+# (scale-down) within the timeout. SURVEY §5.3 / VERDICT r1 elastic gap.
+import os, sys, time
+from paddle_tpu.distributed.fleet.elastic.manager import (ElasticManager,
+                                                          ElasticStatus)
+workdir = sys.argv[1]
+rank = os.environ["PADDLE_TRAINER_ID"]
+os.environ["PADDLE_ELASTIC_ENABLE"] = "1"
+os.environ["PADDLE_ELASTIC_NP"] = "1:2"
+os.environ["PADDLE_ELASTIC_SERVER"] = os.environ["PADDLE_MASTER"].rsplit(
+    ":", 1)[0] + ":" + str(int(os.environ["PADDLE_MASTER"].rsplit(
+        ":", 1)[1]) + 37)
+
+mgr = ElasticManager(heartbeat_interval=0.2)
+mgr.register()
+if rank == "1":
+    time.sleep(2.0)
+    mgr.exit(completed=False)      # stop heartbeating: simulated departure
+    open(workdir + "/left.1", "w").write("1")
+    sys.exit(0)
+
+# rank 0: wait until both workers seen, then watch for the departure
+deadline = time.time() + 30
+st = None
+saw_two = False
+while time.time() < deadline:
+    alive = mgr.alive_workers(timeout=1.5)
+    if len(alive) == 2:
+        saw_two = True
+    st = mgr.watch()
+    # only the DOWN transition counts: both workers must have been seen
+    # and the restart must coincide with the shrunken membership
+    if saw_two and st == ElasticStatus.RESTART and len(alive) == 1:
+        open(workdir + "/restart.0", "w").write("1")
+        break
+    time.sleep(0.3)
+mgr.exit()
+assert os.path.exists(workdir + "/restart.0"), (saw_two, st)
+print("elastic scale-down observed")
+"""
+
+
+class TestElasticEndToEnd:
+    def test_scale_down_triggers_restart(self, tmp_path):
+        r = _run_launch(tmp_path, ELASTIC_WORKER,
+                        ["--nproc_per_node", "2"], [str(tmp_path)])
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert (tmp_path / "left.1").exists()
+        assert (tmp_path / "restart.0").exists()
