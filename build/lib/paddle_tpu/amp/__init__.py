@@ -1,0 +1,4 @@
+"""paddle.amp namespace."""
+from . import debugging
+from .auto_cast import auto_cast, amp_guard, decorate
+from .grad_scaler import GradScaler, AmpScaler

@@ -1,0 +1,110 @@
+"""AMP autocast. Parity: python/paddle/amp/auto_cast.py.
+
+O1: matmul-class ops (white list) run in bf16/fp16, reductions/norms stay fp32
+— realized as input casts at the functional layer (the role the reference's
+eager codegen AMP hook plays). O2: `decorate` casts parameters themselves and
+the optimizer keeps fp32 master weights (multi_precision).
+
+TPU note: bf16 is the native fast dtype (MXU); fp16 is supported for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_state", "white_list",
+           "black_list", "is_auto_cast_enabled", "get_amp_dtype"]
+
+WHITE_LIST = {"matmul", "linear", "conv", "einsum", "bmm", "mm", "attention"}
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "cross_entropy", "mean",
+              "sum", "exp", "log", "pow"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp = _AmpState()
+
+
+def amp_state():
+    return _amp
+
+
+def is_auto_cast_enabled() -> bool:
+    return _amp.enabled
+
+
+def get_amp_dtype():
+    return _amp.dtype
+
+
+def white_list():
+    return (WHITE_LIST | _amp.custom_white) - _amp.custom_black
+
+
+def black_list():
+    return (BLACK_LIST | _amp.custom_black) - _amp.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+            _amp.custom_black)
+    _amp.enabled = enable
+    _amp.dtype = convert_dtype(dtype)
+    _amp.level = level
+    _amp.custom_white = set(custom_white_list or ())
+    _amp.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_amp.enabled, _amp.dtype, _amp.level, _amp.custom_white,
+         _amp.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_if_amp(op_name: str, *arrays):
+    """Called by white-list functionals: cast float inputs to the amp dtype."""
+    if not _amp.enabled or op_name in black_list():
+        return arrays
+    if op_name not in white_list():
+        return arrays
+    dt = _amp.dtype
+    return tuple(a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating)
+                 else a for a in arrays)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """AMP-O2: cast model params to the low dtype; optimizer keeps fp32
+    masters. Parity: python/paddle/amp/auto_cast.py :: decorate."""
+    dt = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_all(dt)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for o in opt_list:
+            if level == "O2" and (master_weight is None or master_weight):
+                o._multi_precision = True
+        if single_model and single_opt:
+            return model_list[0], opt_list[0]
+        return model_list if not single_model else model_list[0], \
+            opt_list if not single_opt else opt_list[0]
+    return model_list[0] if single_model else model_list

@@ -1,0 +1,78 @@
+"""NaN/Inf debugging. Parity: python/paddle/amp/debugging.py
+(check_numerics, TensorCheckerConfig) + FLAGS_check_nan_inf
+(paddle/fluid/framework/details/nan_inf_utils_detail.cu).
+
+TPU-native: jax.config debug_nans plus an explicit checker.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "TensorCheckerConfig",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "collect_operator_stats", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+_checker = {"config": None}
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    _checker["config"] = config
+    jax.config.update("jax_debug_nans", bool(config.enable))
+
+
+def disable_tensor_checker():
+    _checker["config"] = None
+    jax.config.update("jax_debug_nans", False)
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    n_nan = int(jnp.sum(jnp.isnan(arr)))
+    n_inf = int(jnp.sum(jnp.isinf(arr)))
+    if n_nan or n_inf:
+        msg = (f"check_numerics: op={op_type} var={var_name} "
+               f"nan={n_nan} inf={n_inf}")
+        cfg = _checker["config"]
+        if cfg is None or cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(msg)
+    return Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf))
+
+
+_op_stats: dict = {}
+
+
+def enable_operator_stats_collection():
+    _op_stats.clear()
+
+
+def disable_operator_stats_collection():
+    pass
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    yield
+    disable_operator_stats_collection()

@@ -1,0 +1,150 @@
+"""paddle.autograd — backward(), grad(), PyLayer, saved-tensor hooks.
+
+Parity: ``python/paddle/autograd/`` (py_layer.py, backward_mode.py).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import (Tensor, no_grad, enable_grad, is_grad_enabled,
+                             set_grad_enabled, apply_op, _tape)
+from .backward_engine import run_backward
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """paddle.grad: gradients of outputs w.r.t. inputs without touching .grad."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    retain = True if retain_graph is None else retain_graph
+
+    # stash current .grad, run engine, read, restore
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    saved_sg = [(t, t.stop_gradient, t._is_leaf) for t in inputs]
+    for t in inputs:
+        t.stop_gradient = False
+        t._is_leaf = True
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=True)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"input {t.name} unused in graph (allow_unused=False)")
+                results.append(None)
+            else:
+                results.append(t.grad)
+    finally:
+        for t, sg, leaf in saved_sg:
+            t.stop_gradient = sg
+            t._is_leaf = leaf
+        for t, g in saved:
+            t.grad = g
+        if not retain:
+            _tape.nodes.clear()
+    return results
+
+
+class PyLayerContext:
+    """Context handed to PyLayer.forward/backward (save_for_backward parity)."""
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer subclasses are applied via .apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op: subclass with static forward(ctx, ...) / backward(ctx, *grads).
+
+    Parity: ``python/paddle/autograd/py_layer.py :: PyLayer``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import _TapeNode, _tape as tape
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs_raw = tuple(out) if multi else (out,)
+
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not need_grad:
+            return out
+
+        outs = tuple(Tensor(o._data if isinstance(o, Tensor) else o,
+                            stop_gradient=False) for o in outs_raw)
+        for o in outs:
+            o._is_leaf = False
+
+        def vjp_fn(cots):
+            gts = tuple(Tensor(c) for c in cots)
+            with no_grad():
+                gin = cls.backward(ctx, *gts) if len(gts) > 1 else cls.backward(ctx, gts[0])
+            gin = gin if isinstance(gin, (tuple, list)) else (gin,)
+            res = []
+            it = iter(gin)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(it, None)
+                    res.append(None if g is None else
+                               (g._data if isinstance(g, Tensor) else g))
+            return tuple(res)
+
+        node = _TapeNode(
+            inputs=tensor_inputs,
+            output_ids=[o._uid for o in outs],
+            vjp_fn=vjp_fn,
+            outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
+        )
+        tape.nodes.append(node)
+        return outs if multi else outs[0]

@@ -1,0 +1,50 @@
+"""FLAGS facade. Parity: paddle.get_flags/set_flags over phi/core/flags.cc.
+
+On TPU the meaningful knobs map to JAX config (debug_nans, default matmul
+precision) or are accepted-and-recorded for API compatibility.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+_FLAGS: dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_embedding_deterministic": False,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_nccl_blocking_wait": False,
+    "FLAGS_matmul_precision": "default",
+}
+
+for k in list(_FLAGS):
+    if k in os.environ:
+        v = os.environ[k]
+        prev = _FLAGS[k]
+        if isinstance(prev, bool):
+            _FLAGS[k] = v.lower() in ("1", "true", "yes")
+        elif isinstance(prev, float):
+            _FLAGS[k] = float(v)
+        elif isinstance(prev, int):
+            _FLAGS[k] = int(v)
+        else:
+            _FLAGS[k] = v
+
+
+def set_flags(flags: dict) -> None:
+    for k, v in flags.items():
+        _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            jax.config.update("jax_debug_nans", bool(v))
+        elif k == "FLAGS_matmul_precision" and v != "default":
+            jax.config.update("jax_default_matmul_precision", v)
+
+
+def get_flags(flags) -> dict:
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}

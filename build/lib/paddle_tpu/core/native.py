@@ -1,0 +1,263 @@
+"""ctypes loader for the native runtime (csrc/runtime.cc).
+
+The shared library is built lazily with g++ on first use and cached next to
+the source (pybind11 is not in this image; the C ABI + ctypes is the
+binding layer — SURVEY §2.2 "Pybind bindings" altitude). Every consumer
+must degrade gracefully when the toolchain is unavailable:
+`load_native()` returns None and the pure-Python fallbacks take over.
+
+Native components exposed here:
+  TCPStore / TCPStoreServer  — rendezvous KV
+      (parity: paddle/fluid/distributed/store/tcp_store.cc :: TCPStore,
+      MasterDaemon)
+  NativeTracer               — host span collector -> chrome trace
+      (parity: paddle/fluid/platform/profiler/ host tracer)
+  NativeQueue                — bounded blocking queue; DataLoader prefetch
+      (parity: the reference's native buffered-reader machinery)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_BUILD_FAILED = False
+
+
+def _src_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "csrc", "runtime.cc")
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(_src_path()),
+                        "libpaddle_tpu_runtime.so")
+
+
+def load_native():
+    """Build (once) and dlopen the runtime; None if unavailable."""
+    global _LIB, _BUILD_FAILED
+    if _LIB is not None:
+        return _LIB
+    if _BUILD_FAILED or os.environ.get("PADDLE_TPU_NO_NATIVE") == "1":
+        return None
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src, lib = _src_path(), _lib_path()
+        try:
+            if (not os.path.exists(lib)
+                    or os.path.getmtime(lib) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-pthread", src, "-o", lib + ".tmp"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(lib + ".tmp", lib)
+            L = ctypes.CDLL(lib)
+        except Exception:
+            _BUILD_FAILED = True
+            return None
+        # signatures
+        L.pd_store_master_start.restype = ctypes.c_void_p
+        L.pd_store_master_start.argtypes = [ctypes.c_int]
+        L.pd_store_master_port.restype = ctypes.c_int
+        L.pd_store_master_port.argtypes = [ctypes.c_void_p]
+        L.pd_store_master_stop.argtypes = [ctypes.c_void_p]
+        L.pd_store_client_connect.restype = ctypes.c_void_p
+        L.pd_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                              ctypes.c_int]
+        L.pd_store_client_close.argtypes = [ctypes.c_void_p]
+        L.pd_store_set.restype = ctypes.c_int
+        L.pd_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+        L.pd_store_get.restype = ctypes.c_int
+        L.pd_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int]
+        L.pd_store_add.restype = ctypes.c_int
+        L.pd_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_longlong,
+                                   ctypes.POINTER(ctypes.c_longlong)]
+        L.pd_store_wait.restype = ctypes.c_int
+        L.pd_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+        L.pd_trace_enable.argtypes = [ctypes.c_int]
+        L.pd_trace_begin.argtypes = [ctypes.c_char_p]
+        L.pd_trace_count.restype = ctypes.c_int
+        L.pd_trace_dump.restype = ctypes.c_int
+        L.pd_trace_dump.argtypes = [ctypes.c_char_p]
+        L.pd_queue_new.restype = ctypes.c_void_p
+        L.pd_queue_new.argtypes = [ctypes.c_int]
+        L.pd_queue_close.argtypes = [ctypes.c_void_p]
+        L.pd_queue_free.argtypes = [ctypes.c_void_p]
+        L.pd_queue_put.restype = ctypes.c_int
+        L.pd_queue_put.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int]
+        L.pd_queue_get.restype = ctypes.c_void_p
+        L.pd_queue_get.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        L.pd_queue_size.restype = ctypes.c_int
+        L.pd_queue_size.argtypes = [ctypes.c_void_p]
+        _LIB = L
+        return L
+
+
+class TCPStoreServer:
+    """Master daemon; bind port 0 for an ephemeral port (read .port)."""
+
+    def __init__(self, port: int = 0):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pd_store_master_start(port)
+        if not self._h:
+            raise OSError(f"TCPStoreServer: cannot bind port {port}")
+        self.port = lib.pd_store_master_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.pd_store_master_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client with the reference TCPStore surface: set/get/add/wait."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pd_store_client_connect(
+            host.encode(), port, int(timeout_s * 1000))
+        if not self._h:
+            raise ConnectionError(f"TCPStore: cannot reach {host}:{port}")
+
+    def set(self, key: str, value: bytes):
+        if self._lib.pd_store_set(self._h, key.encode(), value,
+                                  len(value)) != 0:
+            raise IOError("store set failed")
+
+    def get(self, key: str, max_len: int = 1 << 20):
+        buf = ctypes.create_string_buffer(max_len)
+        n = self._lib.pd_store_get(self._h, key.encode(), buf, max_len)
+        if n < 0:
+            return None
+        # value larger than the buffer: the C side reports the full length —
+        # retry with an exact-size buffer instead of silently truncating
+        # (loop: the value may have grown again between calls)
+        for _ in range(4):
+            if n <= max_len:
+                return buf.raw[:n]
+            max_len = n
+            buf = ctypes.create_string_buffer(max_len)
+            n = self._lib.pd_store_get(self._h, key.encode(), buf, max_len)
+            if n < 0:
+                return None
+        raise IOError(f"store get: value for {key!r} keeps growing")
+
+    def add(self, key: str, delta: int) -> int:
+        out = ctypes.c_longlong()
+        if self._lib.pd_store_add(self._h, key.encode(), delta,
+                                  ctypes.byref(out)) != 0:
+            raise IOError("store add failed")
+        return out.value
+
+    def wait(self, key: str, timeout_s: float = 30.0):
+        if self._lib.pd_store_wait(self._h, key.encode(),
+                                   int(timeout_s * 1000)) != 0:
+            raise TimeoutError(f"store wait({key}) timed out")
+
+    def close(self):
+        if self._h:
+            self._lib.pd_store_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeTracer:
+    """Host span collector; None-safe module-level helpers in profiler.
+
+    Lazy: the (possibly slow, g++-invoking) load_native() runs on first
+    use, never at construction — so importing a module that instantiates a
+    tracer costs nothing."""
+
+    def __init__(self):
+        self._lib_loaded = False
+        self.__lib = None
+
+    @property
+    def _lib(self):
+        if not self._lib_loaded:
+            self.__lib = load_native()
+            self._lib_loaded = True
+        return self.__lib
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def enable(self, on: bool = True):
+        if self._lib:
+            self._lib.pd_trace_enable(1 if on else 0)
+
+    def begin(self, name: str):
+        if self._lib:
+            self._lib.pd_trace_begin(name.encode())
+
+    def end(self):
+        if self._lib:
+            self._lib.pd_trace_end()
+
+    def count(self) -> int:
+        return self._lib.pd_trace_count() if self._lib else 0
+
+    def dump(self, path: str) -> bool:
+        return bool(self._lib) and \
+            self._lib.pd_trace_dump(path.encode()) == 0
+
+
+class NativeQueue:
+    """Bounded blocking queue of integer tokens (1-based; 0 is reserved)."""
+
+    def __init__(self, capacity: int):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pd_queue_new(capacity)
+
+    def put(self, token: int, timeout_s: float = 10.0) -> bool:
+        assert token > 0
+        return self._lib.pd_queue_put(self._h, ctypes.c_void_p(token),
+                                      int(timeout_s * 1000)) == 0
+
+    def get(self, timeout_s: float = 10.0):
+        r = self._lib.pd_queue_get(self._h, int(timeout_s * 1000))
+        return None if not r else int(r)
+
+    def qsize(self) -> int:
+        return self._lib.pd_queue_size(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.pd_queue_close(self._h)
+
+    def free(self):
+        if self._h:
+            self._lib.pd_queue_close(self._h)
+            self._lib.pd_queue_free(self._h)
+            self._h = None

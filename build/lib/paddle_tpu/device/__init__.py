@@ -1,0 +1,186 @@
+"""paddle.device namespace. Parity: python/paddle/device/ (incl. cuda shims).
+
+On TPU there are no user-managed streams/events: XLA schedules async
+dispatch. Stream/Event keep API shape; synchronize() blocks on all devices.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (set_device, get_device, device_count, CPUPlace,
+                          TPUPlace, XLAPlace, CUDAPlace,
+                          is_compiled_with_cuda, is_compiled_with_tpu)
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "Stream", "Event", "current_stream", "stream_guard", "cuda",
+           "get_all_device_type", "get_available_device",
+           "memory_stats", "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "max_memory_reserved",
+           "persistent_state_bytes"]
+
+
+def _resolve_device(device=None):
+    devs = jax.local_devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str) and ":" in device:
+        return devs[int(device.rsplit(":", 1)[1])]
+    return devs[0]
+
+
+def memory_stats(device=None) -> dict:
+    """Allocator stats for one device (parity: paddle.device.cuda memory
+    stats family, backed by the XLA allocator via PJRT memory_stats; empty
+    dict where the backend doesn't report, e.g. CPU)."""
+    try:
+        return dict(_resolve_device(device).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def persistent_state_bytes(per_device: bool = True):
+    """Bytes of framework-persistent state (params, optimizer slots, master
+    weights) actually resident per device, from each array's addressable
+    shards. Backend-independent (works on the CPU mesh, where the PJRT
+    allocator reports nothing) — this is the observable that proves ZeRO
+    sharding reduces per-device state: a tensor sharded over N devices
+    contributes size/N per device, a replicated one its full size on every
+    device."""
+    from ..tensor.tensor import persistent_tensors
+    totals: dict[int, int] = {}
+    for t in persistent_tensors():
+        arr = getattr(t, "_data", None)
+        if arr is None or not hasattr(arr, "addressable_shards"):
+            continue
+        for sh in arr.addressable_shards:
+            totals[sh.device.id] = totals.get(sh.device.id, 0) + \
+                sh.data.nbytes
+    if per_device:
+        return totals
+    return sum(totals.values())
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class Stream:
+    """API-parity stream: XLA owns real scheduling; operations are ordered
+    program-order per device already."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+class _CudaNS:
+    """paddle.device.cuda.* shims routing to the accelerator (TPU)."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def current_stream(device=None):
+        return _current
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaNS()

@@ -1,0 +1,30 @@
+"""paddle.distributed — collective API + fleet (full build in parallel/ and
+fleet/; this module re-exports the user surface).
+
+Parity: python/paddle/distributed/__init__.py.
+"""
+from __future__ import annotations
+
+from .parallel import (init_parallel_env, get_rank, get_world_size,
+                       ParallelEnv, all_reduce_gradients)
+from .communication.all_reduce import all_reduce
+from .communication.group import (new_group, get_group, destroy_process_group,
+                                  is_initialized, ReduceOp, Group)
+from .communication.ops import (all_gather, all_gather_object, broadcast,
+                                reduce, scatter, alltoall, alltoall_single,
+                                send, recv, isend, irecv, barrier,
+                                reduce_scatter, stream)
+from . import fleet
+from . import sharding
+from .auto_parallel.api import shard_tensor, ProcessMesh, shard_op
+from .spawn_mod import spawn
+from .checkpoint import (save_state_dict, load_state_dict,
+                         wait_all_async_saves)
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter", "alltoall",
+    "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
+    "reduce_scatter", "new_group", "get_group", "ReduceOp", "fleet",
+    "sharding", "shard_tensor", "ProcessMesh", "spawn", "is_initialized",
+]

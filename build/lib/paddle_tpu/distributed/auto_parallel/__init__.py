@@ -1,0 +1,3 @@
+from .api import (ProcessMesh, shard_tensor, shard_op, get_mesh, set_mesh,
+                  dtensor_from_fn, reshard, Shard, Replicate, Partial)
+from .engine import Engine

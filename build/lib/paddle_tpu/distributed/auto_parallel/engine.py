@@ -1,0 +1,212 @@
+"""Auto-parallel Engine. Parity: python/paddle/distributed/auto_parallel/
+:: Engine (fit/evaluate/predict over a ProcessMesh with annotated
+shardings; the reference's planner/partitioner/reshard passes).
+
+TPU-native: there is no program-rewrite pipeline to run — the "planner" is
+GSPMD. Engine compiles the train step with jit.to_static over the global
+ProcessMesh; `shard_tensor` annotations on parameters become their
+placements, the batch is sharded over the mesh's data axis, and XLA's
+sharding propagation derives every intermediate placement + collective
+(the spmd_rules/ and reshard/ machinery of the reference)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor, no_grad
+from .api import ProcessMesh, get_mesh
+
+__all__ = ["Engine"]
+
+
+class _History:
+    def __init__(self):
+        self.history = {"loss": []}
+
+
+class Engine:
+    """engine = Engine(model, loss_fn, optimizer); engine.fit(dataset)."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self.model = model
+        self.loss_fn = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+        self.strategy = strategy
+        self._step_fn = None
+        self._eval_fn = None
+        self._placed = False
+
+    # ------------------------------------------------------------ internals
+    def _mesh(self):
+        pm = get_mesh()
+        return pm.jax_mesh() if pm is not None else None
+
+    def _data_axis(self, mesh):
+        names = list(mesh.axis_names)
+        for cand in ("dp", "data", "x"):
+            if cand in names:
+                return cand
+        return names[0]
+
+    def _place(self):
+        """Apply parameter placements: annotated specs (shard_tensor)
+        sharded, everything else replicated — the reference partitioner."""
+        mesh = self._mesh()
+        if mesh is None or self._placed:
+            return
+        from ...parallel import _valid_spec
+        for p in self.model.parameters():
+            spec = p.sharding_spec
+            sh = NamedSharding(mesh, P(*spec)) if (
+                spec is not None and _valid_spec(p._data, spec, mesh)) \
+                else NamedSharding(mesh, P())
+            try:
+                p._data = jax.device_put(p._data, sh)
+            except Exception:
+                pass
+        self._placed = True
+
+    def _shard_batch(self, arr, mesh):
+        ax = self._data_axis(mesh)
+        if arr.shape[0] % mesh.shape[ax] == 0:
+            sh = NamedSharding(mesh, P(ax, *([None] * (arr.ndim - 1))))
+            return jax.device_put(arr, sh)
+        return arr
+
+    def _build_step(self):
+        from ... import jit as pjit
+
+        model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
+
+        @pjit.to_static
+        def step(x, y):
+            out = model(x)
+            loss = loss_fn(out, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        @pjit.to_static
+        def eval_step(x, y):
+            with no_grad():
+                out = model(x)
+                return loss_fn(out, y), out
+
+        return step, eval_step
+
+    def _loader(self, data, batch_size, shuffle):
+        from ...io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        raise TypeError(f"expected Dataset/DataLoader, got {type(data)}")
+
+    def _prep_batch(self, batch, mesh):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            x, y = batch[0], batch[1]
+        elif isinstance(batch, (list, tuple)) and len(batch) == 1:
+            x, y = batch[0], None
+        else:
+            x, y = batch, None
+        if mesh is not None:
+            x = Tensor(self._shard_batch(
+                x._data if isinstance(x, Tensor) else np.asarray(x), mesh))
+            if y is not None:
+                y = Tensor(self._shard_batch(
+                    y._data if isinstance(y, Tensor) else np.asarray(y),
+                    mesh))
+        return x, y
+
+    # ------------------------------------------------------------ public
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        self._place()
+        if self._step_fn is None:
+            self._step_fn, self._eval_fn = self._build_step()
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            valid_data=None, valid_freq=1, log_freq=10, verbose=0,
+            callbacks=None, collate_fn=None):
+        assert self.model is not None and self.optimizer is not None and \
+            self.loss_fn is not None, "Engine needs model, loss, optimizer"
+        self.model.train()
+        self.prepare()
+        mesh = self._mesh()
+        loader = self._loader(train_data, batch_size, shuffle=True)
+        hist = _History()
+        for epoch in range(epochs):
+            for step_idx, batch in enumerate(loader):
+                if steps_per_epoch and step_idx >= steps_per_epoch:
+                    break
+                x, y = self._prep_batch(batch, mesh)
+                loss = self._step_fn(x, y)
+                lv = float(np.asarray(loss._data).mean())
+                hist.history["loss"].append(lv)
+                if verbose and step_idx % log_freq == 0:
+                    print(f"epoch {epoch} step {step_idx}: loss {lv:.4f}")
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                self.evaluate(valid_data, batch_size=batch_size,
+                              verbose=verbose)
+                self.model.train()
+        return hist
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, verbose=0,
+                 collate_fn=None):
+        self.model.eval()
+        self.prepare()
+        mesh = self._mesh()
+        loader = self._loader(valid_data, batch_size, shuffle=False)
+        losses = []
+        for m in self.metrics:
+            m.reset()
+        for step_idx, batch in enumerate(loader):
+            if steps and step_idx >= steps:
+                break
+            x, y = self._prep_batch(batch, mesh)
+            loss, out = self._eval_fn(x, y)
+            losses.append(float(np.asarray(loss._data).mean()))
+            for m in self.metrics:
+                m.update(m.compute(out, y))
+        result = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            result[m.name() if callable(getattr(m, "name", None)) else
+                   type(m).__name__] = m.accumulate()
+        if verbose:
+            print(f"eval: {result}")
+        return result
+
+    @no_grad()
+    def predict(self, test_data, batch_size=1, steps=None, collate_fn=None):
+        self.model.eval()
+        mesh = self._mesh()
+        self._place()
+        outs = []
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        for step_idx, batch in enumerate(loader):
+            if steps and step_idx >= steps:
+                break
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            x, _ = self._prep_batch([x, None], mesh)
+            outs.append(self.model(x))
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+        state = {"model": self.model.state_dict()}
+        if training and self.optimizer is not None:
+            state["opt"] = self.optimizer.state_dict()
+        save(state, path)
+
+    def load(self, path):
+        from ...framework.io import load
+        state = load(path)
+        self.model.set_state_dict(state["model"])
+        if "opt" in state and self.optimizer is not None:
+            self.optimizer.set_state_dict(state["opt"])

@@ -1,0 +1,130 @@
+"""Distributed sharded checkpointing (Orbax-backed, reshard-on-load).
+
+Parity: the reference's auto_parallel dist-checkpoint format +
+save_group_sharded_model / dist ckpt reshard-on-load (SURVEY §5.4:
+python/paddle/distributed/auto_parallel dist-checkpoint).
+
+TPU-native design: Orbax/TensorStore writes each array's shards from their
+owning hosts (no rank-0 gather), `async_save=True` returns while the commit
+runs on a background thread (the train loop overlaps the next steps with the
+write, the reference's async_save semantics), and restore places every
+tensor DIRECTLY onto its current mesh sharding via ArrayRestoreArgs — saved
+on mesh A (e.g. dp4), restored on mesh B (dp2×mp2) without a host
+round-trip; TensorStore reads only each device's slice.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "wait_all_async_saves"]
+
+_pending: list = []
+_pending_lock = threading.Lock()
+
+
+def _to_arrays(sd):
+    return {k: (v._data if isinstance(v, Tensor) else v)
+            for k, v in sd.items()}
+
+
+def _track(ckptr):
+    with _pending_lock:
+        _pending.append(ckptr)
+
+
+def wait_all_async_saves():
+    """Block until every async save commit has landed (call before exit or
+    before reading a checkpoint you just wrote)."""
+    with _pending_lock:
+        pending, _pending[:] = _pending[:], []
+    first_err = None
+    for c in pending:                 # join EVERY commit even if one fails
+        try:
+            c.wait_until_finished()
+        except Exception as e:
+            if first_err is None:
+                first_err = e
+        finally:
+            try:
+                c.close()
+            except Exception:
+                pass
+    if first_err is not None:
+        raise first_err
+
+
+def save_state_dict(state_dict: dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
+    """Write a (possibly sharded) state dict. async_save=True returns as
+    soon as the on-device arrays are snapshot; the serialize/commit runs in
+    the background (wait_all_async_saves() to join)."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        from ..framework.io import save
+        save(state_dict, os.path.join(path, "fallback.pdparams"))
+        return
+    arrays = _to_arrays(state_dict)
+    path = os.path.abspath(path)
+    if async_save:
+        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        ckptr.save(path, arrays, force=True)
+        _track(ckptr)
+        return
+    ocp.PyTreeCheckpointer().save(path, arrays, force=True)
+
+
+def load_state_dict(state_dict: dict, path: str, process_group=None,
+                    coordinator_rank: int = 0) -> dict:
+    """Restore into the given state_dict skeleton, resharding on load: each
+    tensor is materialized directly with its CURRENT sharding (mesh +
+    sharding_spec at restore time — not the one it was saved under), so a
+    checkpoint from mesh A restores onto mesh B with each device reading
+    only its slice."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        from ..framework.io import load
+        restored = load(os.path.join(path, "fallback.pdparams"),
+                        return_numpy=True)
+        for k, t in state_dict.items():
+            if k in restored and isinstance(t, Tensor):
+                t.set_value(np.asarray(restored[k]))
+        return state_dict
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    # restore_args must mirror the CHECKPOINT's tree, not the skeleton's —
+    # tolerate grown/shrunk models (extra skeleton keys stay untouched,
+    # extra checkpoint keys restore as plain arrays and are ignored below)
+    try:
+        saved_keys = set(ckptr.metadata(path).item_metadata.tree.keys())
+    except Exception:
+        saved_keys = set(state_dict.keys())
+    restore_args = {}
+    for k in saved_keys:
+        t = state_dict.get(k)
+        sh = getattr(getattr(t, "_data", None), "sharding", None) \
+            if isinstance(t, Tensor) else None
+        restore_args[k] = ocp.ArrayRestoreArgs(
+            sharding=sh, dtype=t._data.dtype) if sh is not None \
+            else ocp.RestoreArgs()
+    restored = ckptr.restore(path, restore_args=restore_args)
+    for k, t in state_dict.items():
+        if k not in restored:
+            continue
+        arr = restored[k]
+        if isinstance(t, Tensor):
+            # already placed per restore_args sharding — adopt directly
+            # (no host round-trip); keep grad/spec metadata
+            import jax.numpy as jnp
+            t._data = arr if hasattr(arr, "sharding") else jnp.asarray(arr)
+        else:
+            state_dict[k] = Tensor(np.asarray(arr))
+    return state_dict

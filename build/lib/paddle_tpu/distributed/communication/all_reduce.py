@@ -1,0 +1,21 @@
+"""paddle.distributed.all_reduce. Parity: communication/all_reduce.py."""
+from __future__ import annotations
+
+from ...tensor.tensor import Tensor
+from .group import ReduceOp, _default_group
+
+__all__ = ["all_reduce"]
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    from .group import Task
+    g = group or _default_group()
+    # static capture: record the collective into the Program (the
+    # reference's c_allreduce_sum op in ProgramDesc)
+    from .ops import _capture_collective
+    t = _capture_collective(tensor, lambda a: g.pg.allreduce(a, op))
+    if t is not None:
+        return t
+    out = g.pg.allreduce(tensor._data, op)
+    tensor._data = out
+    return Task(out)

@@ -1,0 +1,117 @@
+"""Fleet facade. Parity: python/paddle/distributed/fleet/fleet.py
+(fleet.init / distributed_model / distributed_optimizer / worker APIs).
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from . import meta_parallel
+from .utils import recompute_mod
+from .utils.recompute_mod import recompute, recompute_sequential
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "is_first_worker", "barrier_worker",
+           "recompute", "CommunicateTopology", "HybridCommunicateGroup"]
+
+_fleet_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    from ..parallel import init_parallel_env
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+        dims=(hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+              hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+              hc.get("mp_degree", 1)))
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(strategy=strategy, hcg=hcg, initialized=True)
+    # TP dropout determinism (reference: tensor_init_seed)
+    seed = strategy.tensor_parallel_configs.get("tensor_init_seed", -1)
+    if hc.get("mp_degree", 1) > 1:
+        from ...core.rng import model_parallel_random_seed
+        model_parallel_random_seed(seed if seed > 0 else 100)
+    return _FleetNS
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init()
+    return _fleet_state["hcg"]
+
+
+def _get_strategy() -> DistributedStrategy:
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Wrap per the topology (reference: fleet.distributed_model →
+    DataParallel / TensorParallel / PipelineParallel / ShardingParallel)."""
+    hcg = get_hybrid_communicate_group()
+    strategy = _get_strategy()
+    from .meta_parallel.parallel_layers import (TensorParallel,
+                                                ShardingParallel)
+    from .meta_parallel.pipeline_parallel import (PipelineParallel,
+                                                  PipelineParallelWithInterleave)
+    from .meta_parallel.pp_layers import PipelineLayer
+    from ...framework.layer_helpers import DataParallel
+
+    if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model, PipelineLayer):
+        if (getattr(model, "_num_virtual_pipeline_stages", None) or 1) > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
+        return PipelineParallel(model, hcg, strategy)
+    mode = hcg.get_parallel_mode()
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy)
+    if mode == "data_parallel" and hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+        HybridParallelOptimizer)
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _get_strategy())
+
+
+def worker_index() -> int:
+    from ..parallel import get_rank
+    return get_rank()
+
+
+def worker_num() -> int:
+    from ..parallel import get_world_size
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..communication.ops import barrier
+    barrier()
+
+
+class _FleetNSType:
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+    DistributedStrategy = DistributedStrategy
+
+    @staticmethod
+    def get_hybrid_communicate_group():
+        return get_hybrid_communicate_group()
+
+
+_FleetNS = _FleetNSType()

@@ -1,0 +1,168 @@
+"""Elastic training manager.
+
+Parity: python/paddle/distributed/fleet/elastic/manager.py :: ElasticManager
+— workers register in a membership store, a watcher notices join/leave and
+triggers relaunch with the new world size (env contract PADDLE_ELASTIC_*).
+
+TPU-native: membership lives in the native TCPStore (csrc/runtime.cc)
+instead of etcd — heartbeat keys with host-side timestamps, the watcher
+polls for stale/new members. On TPU pods the platform-level slice health is
+authoritative; this manager handles the *job*-level membership the way the
+reference does (scale-up/down between np_min..np_max, relaunch signal).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Optional
+
+__all__ = ["ElasticStatus", "ElasticManager", "ELASTIC_TIMEOUT"]
+
+ELASTIC_TIMEOUT = float(os.environ.get("PADDLE_ELASTIC_TIMEOUT", "10"))
+
+
+class ElasticStatus(Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+def _parse_np(np_str: str):
+    """'2:4' -> (2, 4); '4' -> (4, 4)."""
+    if ":" in np_str:
+        lo, hi = np_str.split(":")
+        return int(lo), int(hi)
+    n = int(np_str)
+    return n, n
+
+
+class ElasticManager:
+    def __init__(self, server: Optional[str] = None,
+                 np: Optional[str] = None,
+                 host: Optional[str] = None,
+                 heartbeat_interval: float = 1.0):
+        self.server = server or os.environ.get("PADDLE_ELASTIC_SERVER", "")
+        np_str = np or os.environ.get("PADDLE_ELASTIC_NP", "1")
+        self.np_min, self.np_max = _parse_np(np_str)
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.worker_id = os.environ.get("PADDLE_TRAINER_ID", "0")
+        self.heartbeat_interval = heartbeat_interval
+        self.enable = bool(self.server) or \
+            os.environ.get("PADDLE_ELASTIC_ENABLE") == "1"
+        self._client = None
+        self._server_obj = None
+        self._hb_thread = None
+        self._stop = threading.Event()
+        self._last_world = None
+        if self.enable:
+            self._connect()
+
+    # ------------------------------------------------------------ store
+    def _connect(self):
+        from ....core.native import TCPStore, TCPStoreServer
+        if self.server:
+            h, p = self.server.rsplit(":", 1)
+            port = int(p)
+        else:
+            if self.worker_id != "0":
+                raise RuntimeError(
+                    "PADDLE_ELASTIC_ENABLE=1 without PADDLE_ELASTIC_SERVER: "
+                    "only rank 0 can run the membership store locally; set "
+                    "PADDLE_ELASTIC_SERVER=host:port on every worker")
+            h, port = "127.0.0.1", 0
+        if self.worker_id == "0" and (not self.server
+                                      or h in ("127.0.0.1", self.host)):
+            try:
+                self._server_obj = TCPStoreServer(port)
+                port = self._server_obj.port
+            except OSError:
+                pass      # another local process already runs the daemon
+        self._client = TCPStore(h, port)
+
+    def _hb_key(self, wid=None):
+        return f"elastic/heartbeat/{wid if wid is not None else self.worker_id}"
+
+    # liveness is judged from heartbeat COUNTER progress observed with the
+    # watcher's own clock (no cross-host wall-clock comparison — NTP skew
+    # between pod hosts would otherwise eat directly into the timeout)
+    _seen: dict = None
+
+    # ------------------------------------------------------------ lifecycle
+    def register(self):
+        """Register this worker + start the heartbeat thread."""
+        if not self.enable:
+            return
+        self._client.set(f"elastic/worker/{self.worker_id}",
+                         self.host.encode())
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self):
+        self._client.add(self._hb_key(), 1)
+
+    def _hb_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._beat()
+            except Exception:
+                pass
+            self._stop.wait(self.heartbeat_interval)
+
+    def alive_workers(self, timeout: float = ELASTIC_TIMEOUT):
+        """Worker ids whose heartbeat counter advanced within `timeout`
+        seconds of the watcher's clock (skew-free: progress, not wall time,
+        is compared across hosts)."""
+        if not self.enable:
+            return [self.worker_id]
+        if self._seen is None:
+            self._seen = {}
+        now = time.monotonic()
+        alive = []
+        for wid in range(self.np_max):
+            v = self._client.get(self._hb_key(wid))
+            if v is None or len(v) < 8:
+                continue
+            count = int.from_bytes(v[:8], "little", signed=True)
+            prev = self._seen.get(wid)
+            if prev is None or count > prev[0]:
+                self._seen[wid] = (count, now)
+                alive.append(str(wid))
+            elif now - prev[1] < timeout:
+                alive.append(str(wid))
+        return alive
+
+    def watch(self) -> ElasticStatus:
+        """One membership check: HOLD if unchanged/in-range, RESTART when
+        the alive set changed but still >= np_min, ERROR below np_min."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED
+        alive = self.alive_workers()
+        n = len(alive)
+        if n < self.np_min:
+            return ElasticStatus.ERROR
+        if self._last_world is None:
+            self._last_world = alive
+            return ElasticStatus.HOLD
+        if alive != self._last_world:
+            self._last_world = alive
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+        if self.enable and self._client is not None:
+            try:
+                if completed:
+                    self._client.set(f"elastic/done/{self.worker_id}", b"1")
+                self._client.close()
+            except Exception:
+                pass
+        if self._server_obj is not None:
+            self._server_obj.stop()

@@ -1,0 +1,68 @@
+"""TP comm ops. Parity: fleet/layers/mpu/mp_ops.py (_c_identity, _c_concat,
+_c_split, _mp_allreduce, _c_lookup_table, split API).
+
+TPU-native: these are sharding-constraint/collective helpers usable eagerly
+(no-op on one device) and inside jitted SPMD programs (GSPMD/lax lowering).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....tensor.tensor import Tensor, apply_op
+from .mp_layers import constraint
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce", "split"]
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity, backward all-reduce (falls out of XLA transpose)."""
+    return tensor.clone()
+
+
+def _c_concat(tensor, group=None):
+    if group is None or group.nranks <= 1:
+        return tensor.clone()
+    out = group.pg.allgather(tensor._data)
+    return Tensor(jnp.concatenate(list(out), axis=-1))
+
+
+def _c_split(tensor, group=None):
+    if group is None or group.nranks <= 1:
+        return tensor.clone()
+    rank = max(group.rank, 0)
+    n = group.nranks
+    sz = tensor.shape[-1] // n
+    return apply_op(
+        lambda a: jax.lax.slice_in_dim(a, rank * sz, (rank + 1) * sz, axis=-1),
+        tensor)
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    if group is None or group.nranks <= 1:
+        return tensor.clone()
+    return Tensor(group.pg.allreduce(tensor._data))
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity: build a parallel linear/embedding."""
+    from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")

@@ -1,0 +1,6 @@
+"""TP RNG determinism. Parity: fleet/layers/mpu/random.py."""
+from .....core.rng import (RNGStatesTracker, get_rng_state_tracker,
+                           model_parallel_random_seed, MODEL_PARALLEL_RNG)
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "MODEL_PARALLEL_RNG"]

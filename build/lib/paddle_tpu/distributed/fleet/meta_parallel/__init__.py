@@ -1,0 +1,12 @@
+"""fleet.meta_parallel namespace. Parity:
+python/paddle/distributed/fleet/meta_parallel/__init__.py."""
+from .parallel_layers import MetaParallelBase, TensorParallel, ShardingParallel
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
+from ..layers.mpu.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                    VocabParallelEmbedding,
+                                    ParallelCrossEntropy)
+from ..layers.mpu.random import (RNGStatesTracker, get_rng_state_tracker,
+                                 model_parallel_random_seed)
+from .sharding.group_sharded import (GroupShardedStage2, GroupShardedStage3,
+                                     GroupShardedOptimizerStage2)

@@ -1,0 +1,46 @@
+"""Per-strategy model wrappers. Parity:
+python/paddle/distributed/fleet/meta_parallel/{tensor_parallel.py,
+sharding_parallel.py, meta_parallel_base.py}.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+__all__ = ["MetaParallelBase", "TensorParallel", "ShardingParallel"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+class TensorParallel(MetaParallelBase):
+    """Reference broadcasts non-distributed params across mp at wrap time; on
+    the SPMD mesh replicated-by-spec params are identical by construction."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """Params annotated onto the 'sharding' axis (ZeRO); see
+    sharding/group_sharded.py for the stage1/2/3 entry point."""

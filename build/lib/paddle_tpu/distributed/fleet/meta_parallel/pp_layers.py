@@ -1,0 +1,128 @@
+"""Pipeline model description. Parity:
+python/paddle/distributed/fleet/meta_parallel/pp_layers.py :: LayerDesc,
+SharedLayerDesc, PipelineLayer (segmentation, shared-weight groups).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ....nn.layer.layers import Layer, LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Describes a layer sequence partitioned into pp stages.
+
+    Reference behavior: each rank builds ONLY its stage segment and P2P-sends
+    activations. TPU-native single-controller behavior: all stages are built;
+    stage boundaries become sharding/remat boundaries for the compiled
+    pipeline schedule (see pipeline_parallel.py), and `parameters()` of a
+    given stage can be queried for stage-wise placement.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        self._recompute_interval = recompute_interval
+        self.descs = list(layers)
+        built = []
+        self._shared: dict[str, Layer] = {}
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(_SharedForward(self._shared[d.layer_name],
+                                                d.forward_func))
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                    built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        self.run_function = LayerList(built)
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        parts = np.array_split(np.arange(n), self._num_stages)
+        self.segment_parts = [list(map(int, p)) for p in parts]
+
+    def get_stage_from_index(self, idx):
+        for s, part in enumerate(self.segment_parts):
+            if idx in part:
+                return s
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id):
+        return [self.run_function[i] for i in self.segment_parts[stage_id]]
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _SharedForward(Layer):
+    """Second occurrence of a SharedLayerDesc: reuses the first's weights
+    (tied embeddings across first/last stage)."""
+
+    def __init__(self, shared_layer: Layer, forward_func):
+        super().__init__()
+        self._shared_layer_ref = [shared_layer]  # avoid re-registration
+        self._forward_func = forward_func
+
+    def forward(self, *args):
+        layer = self._shared_layer_ref[0]
+        if self._forward_func is not None:
+            return self._forward_func(layer, *args)
+        return layer(*args)

@@ -1,0 +1,186 @@
+"""GroupSharded (ZeRO) stages 1/2/3 over the 'sharding' mesh axis.
+
+Parity:
+  stage1: fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py
+          :: DygraphShardingOptimizer (optimizer states sharded)
+  stage2: fleet/meta_parallel/sharding/group_sharded_stage2.py +
+          group_sharded_optimizer_stage2.py (+ grads sharded; GradStorage)
+  stage3: fleet/meta_parallel/sharding/group_sharded_stage3.py (+ params
+          sharded at rest, allgather-on-use, reduce-scatter grads)
+
+TPU-native realization (the SURVEY §7 hard-part-3 design): sharding is a
+PLACEMENT property, not a buffer-management protocol. Each stage annotates a
+deeper set of tensors with PartitionSpec('sharding') on their largest axis:
+  stage1 → optimizer moments (+ master weights)
+  stage2 → + gradients (reduce-scatter falls out of GSPMD when the grad spec
+             is sharded while params are replicated)
+  stage3 → + parameters at rest (XLA inserts the pre-use all-gather and
+             frees the gathered buffer after use — the reference's per-layer
+             hook machinery, done by the compiler's liveness analysis)
+Under `paddle.jit.to_static` the train step compiles against these specs;
+eagerly on one device all stages are numerically the unsharded step, which is
+exactly the reference's serial-vs-sharded allclose contract.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....nn.layer.layers import Layer
+from .....tensor.tensor import Parameter, Tensor
+
+__all__ = ["GroupShardedStage2", "GroupShardedStage3",
+           "GroupShardedOptimizerStage2", "DygraphShardingOptimizer",
+           "shard_spec_for", "annotate_optimizer_sharding"]
+
+
+def shard_spec_for(t, axis_name: str = "sharding"):
+    """Pick the largest axis to shard; None if too small/indivisible."""
+    shape = tuple(t.shape)
+    if not shape:
+        return None
+    ax = max(range(len(shape)), key=lambda i: shape[i])
+    spec = [None] * len(shape)
+    spec[ax] = axis_name
+    return P(*spec)
+
+
+def annotate_optimizer_sharding(optimizer, axis_name: str = "sharding"):
+    """Mark future + existing accumulators/masters for sharded placement."""
+    optimizer._sharding_axis = axis_name
+    for slot in optimizer._accumulators.values():
+        for t in slot.values():
+            t.sharding_spec = shard_spec_for(t, axis_name)
+    for t in optimizer._master_weights.values():
+        t.sharding_spec = shard_spec_for(t, axis_name)
+    orig_acc = optimizer._acc
+
+    def acc(name, p, init=None):
+        t = orig_acc(name, p, init)
+        if t.sharding_spec is None and t.ndim > 0:
+            t.sharding_spec = shard_spec_for(t, axis_name)
+        return t
+    optimizer._acc = acc
+    orig_master = optimizer._master
+
+    def master(p):
+        t = orig_master(p)
+        if t is not p and t.sharding_spec is None:
+            t.sharding_spec = shard_spec_for(t, axis_name)
+        return t
+    optimizer._master = master
+    return optimizer
+
+
+class DygraphShardingOptimizer:
+    """Stage 1: optimizer-state sharding. Wraps any Optimizer."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner = annotate_optimizer_sharding(optimizer)
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """Stage 2 optimizer: + gradient sharding annotation at accumulate time."""
+
+    def __init__(self, params, optim, group=None, offload=False, device="tpu",
+                 **kw):
+        super().__init__(optim)
+        self._params = list(params)
+
+    def step(self):
+        for p in self._params:
+            if p.grad is not None and p.grad.sharding_spec is None:
+                p.grad.sharding_spec = shard_spec_for(p.grad)
+        self._inner.step()
+
+
+class GroupShardedStage2(Layer):
+    def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                 device="tpu", dp_group=None):
+        super().__init__()
+        self._layers = layer
+        self._sharding_optimizers = (sharding_optimizer
+                                     if isinstance(sharding_optimizer, list)
+                                     else [sharding_optimizer])
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def to(self, *a, **k):
+        self._layers.to(*a, **k)
+        return self
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()
+
+
+class GroupShardedStage3(Layer):
+    """Stage 3: parameters sharded at rest over the 'sharding' axis."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 20, pretrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None,
+                 exclude_layer=None):
+        super().__init__()
+        self._layers = layer
+        self._optimizer = optimizer
+        for _, p in layer.named_parameters():
+            if p.ndim > 0 and p.sharding_spec is None:
+                p.sharding_spec = shard_spec_for(p)
+        if optimizer is not None:
+            annotate_optimizer_sharding(optimizer)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def get_all_parameters(self, convert2cpu: bool = False):
+        """Reference: regather every param slice once for save. On the mesh
+        the full value is recoverable by dropping the sharding constraint —
+        state_dict tensors are already logically full."""
+        return list(self._layers.parameters())
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()

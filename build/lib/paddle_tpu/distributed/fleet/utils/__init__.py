@@ -1,0 +1,4 @@
+from . import recompute_mod
+from . import hybrid_parallel_util
+from . import sequence_parallel_utils
+from .recompute_mod import recompute, recompute_sequential

@@ -1,0 +1,53 @@
+"""Hybrid-parallel grad utilities. Parity:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py ::
+fused_allreduce_gradients, broadcast_mp_parameters, broadcast_dp_parameters,
+sharding_reduce_gradients.
+"""
+from __future__ import annotations
+
+from ....tensor.tensor import no_grad
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters",
+           "sharding_reduce_gradients"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Mean-allreduce grads across the dp group (bucketing = one fused XLA
+    program under jit; eager path defers to the collective API)."""
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    ws = group.nranks if group is not None else 1
+    if ws <= 1:
+        return
+    from ...communication.all_reduce import all_reduce
+    with no_grad():
+        for p in parameter_list:
+            if p.grad is not None:
+                all_reduce(p.grad, group=group)
+                p.grad._data = p.grad._data / ws
+
+
+def broadcast_mp_parameters(model, hcg):
+    """SPMD: replicated-by-spec params are identical across mp by
+    construction; kept for API parity."""
+
+
+def broadcast_dp_parameters(model, hcg):
+    pass
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    group = hcg.get_sharding_parallel_group() if hcg is not None else None
+    ws = group.nranks if group is not None else 1
+    if ws <= 1:
+        return
+    from ...communication.all_reduce import all_reduce
+    with no_grad():
+        for p in parameter_list:
+            if p.grad is not None:
+                all_reduce(p.grad, group=group)
+                p.grad._data = p.grad._data / ws

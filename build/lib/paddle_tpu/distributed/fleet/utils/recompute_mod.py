@@ -1,0 +1,106 @@
+"""Activation recompute. Parity:
+python/paddle/distributed/fleet/utils/recompute.py :: recompute /
+recompute_sequential / RecomputeFunction (PyLayer + RNG-state replay).
+
+Tape-level realization: forward runs under no_grad (zero residual memory);
+a single tape node is recorded whose vjp re-runs the function with gradients
+enabled and backprops through the sub-tape — parameter gradients accumulate
+into .grad exactly as in the reference's RecomputeFunction.backward. RNG
+replay is exact because the global PRNG key is snapshotted and restored
+(explicit keys — stronger than the reference's CUDA RNG state juggling).
+Under paddle.jit.to_static the same code traces into XLA remat regions.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from ....core.rng import get_rng_state, set_rng_state
+from ....tensor.tensor import (Tensor, _TapeNode, _tape, enable_grad,
+                               is_grad_enabled, no_grad)
+from ....autograd.backward_engine import run_backward
+
+__all__ = ["recompute", "recompute_sequential", "RecomputeFunction"]
+
+
+def recompute(function: Callable, *args, **kwargs):
+    kwargs.pop("use_reentrant", None)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    tensor_positions = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensor_inputs = [args[i] for i in tensor_positions]
+    rng_snapshot = get_rng_state() if preserve_rng_state else None
+
+    with no_grad():
+        out = function(*args, **kwargs)
+    multi = isinstance(out, (tuple, list))
+    outs_raw = tuple(out) if multi else (out,)
+    outs = tuple(Tensor(o._data, stop_gradient=False) for o in outs_raw)
+    for o in outs:
+        o._is_leaf = False
+
+    def vjp_fn(cots):
+        if preserve_rng_state:
+            rng_after = get_rng_state()
+            set_rng_state(rng_snapshot)
+        detached = []
+        rebuilt = list(args)
+        for i, t in zip(tensor_positions, tensor_inputs):
+            d = Tensor(t._data, stop_gradient=t.stop_gradient)
+            d._is_leaf = True
+            detached.append(d)
+            rebuilt[i] = d
+        mark = len(_tape.nodes)
+        with enable_grad():
+            out2 = function(*rebuilt, **kwargs)
+        outs2 = tuple(out2) if isinstance(out2, (tuple, list)) else (out2,)
+        seeds = [Tensor(c) for c in cots]
+        run_backward(list(outs2), seeds, retain_graph=True)
+        del _tape.nodes[mark:]
+        if preserve_rng_state:
+            set_rng_state(rng_after)
+        result = []
+        for d, t in zip(detached, tensor_inputs):
+            result.append(None if d.grad is None else d.grad._data)
+        return tuple(result)
+
+    node = _TapeNode(
+        inputs=list(tensor_inputs),
+        output_ids=[o._uid for o in outs],
+        vjp_fn=vjp_fn,
+        outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
+    )
+    _tape.nodes.append(node)
+    return outs if multi else outs[0]
+
+
+class RecomputeFunction:
+    @staticmethod
+    def apply(function, *args, **kwargs):
+        return recompute(function, *args, **kwargs)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """Parity: recompute_sequential — chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if hasattr(functions, "_sub_layers"):
+        layers = list(functions._sub_layers.values())
+    else:
+        layers = list(functions)
+    import numpy as np
+    parts = np.array_split(np.arange(len(layers)), segments)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(seg_layers):
+        def f(x):
+            for l in seg_layers:
+                x = l(x)
+            return x
+        return f
+
+    for part in parts:
+        seg = [layers[i] for i in part]
+        out = recompute(run_segment(seg), out)
+    return out

@@ -1,0 +1,96 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py ::
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp, ColumnSequenceParallelLinear,
+RowSequenceParallelLinear, mark_as_sequence_parallel_parameter,
+register_sequence_parallel_allreduce_hooks.
+
+TPU-native: sequence sharding is a PartitionSpec on the sequence dim over the
+'mp' axis; the allgather-before-qkv / reduce-scatter-after-proj conversions
+the reference hand-writes are exactly what GSPMD inserts when the activation
+spec flips between P('mp'→seq) and P(None) around the annotated matmuls
+("Megatron-SP falls out of XLA sharding propagation nearly for free" —
+SURVEY §5.7).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer.layers import Layer
+from ....tensor.tensor import Tensor
+from ..layers.mpu.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                    constraint)
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "create_fused_allreduce_gradient_hooks"]
+
+
+def _seq_spec(x, axis=0):
+    spec = [None] * x.ndim
+    spec[axis] = "mp"
+    return spec
+
+
+class ScatterOp:
+    """Split activation along sequence dim across mp ranks (fwd scatter /
+    bwd gather)."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        return constraint(x, *_seq_spec(x, axis))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=0):
+        return constraint(x, *([None] * x.ndim))
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return constraint(x, *([None] * x.ndim))
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return constraint(x, *_seq_spec(x, 0))
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives sequence-sharded; all-gather (by constraint flip) before
+    the column-parallel matmul."""
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Output leaves sequence-sharded (reduce-scatter instead of all-reduce)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.is_distributed = False
+    parameter.optimize_attr["sequence_parallel"] = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference: grads of seq-parallel-marked params (LayerNorm etc.) need an
+    mp-group allreduce. On the SPMD mesh those params are replicated by spec,
+    so GSPMD already sums their grad contributions — the hook is a no-op kept
+    for API parity."""
+    return []
+
+
+def create_fused_allreduce_gradient_hooks(parameter_list, accumulation_steps):
+    return []

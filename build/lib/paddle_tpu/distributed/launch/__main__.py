@@ -1,0 +1,90 @@
+"""python -m paddle_tpu.distributed.launch — the launcher CLI.
+
+Parity: python/paddle/distributed/launch/ (collective controller): builds the
+job context, spawns one process per host-slot with the PADDLE_*/JAX_* env
+contract, captures per-rank logs (workerlog.N), restarts on failure up to
+--max_restart (elastic semantics; SURVEY §5.3).
+
+TPU-native: one process per HOST (not per chip) — inside each process JAX owns
+all local chips; rendezvous is the JAX coordination service, not TCPStore.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master", default=None)
+    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--max_restart", type=int, default=0)
+    parser.add_argument("--devices", "--gpus", default=None,
+                        help="accepted for reference-CLI parity; device "
+                             "placement is XLA-managed")
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    nprocs = args.nproc_per_node
+    world = nprocs * args.nnodes
+    master = args.master or f"127.0.0.1:{_free_port()}"
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    attempts = 0
+    while True:
+        procs = []
+        logs = []
+        for local_rank in range(nprocs):
+            rank = args.node_rank * nprocs + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_MASTER": master,
+                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{_free_port()}",
+                "JAX_PROCESS_ID": str(rank),
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_COORDINATOR_ADDRESS": master,
+            })
+            logf = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "a")
+            logs.append(logf)
+            p = subprocess.Popen(
+                [sys.executable, args.training_script] +
+                args.training_script_args,
+                env=env, stdout=logf if rank != 0 else None,
+                stderr=subprocess.STDOUT if rank != 0 else None)
+            procs.append(p)
+
+        codes = [p.wait() for p in procs]
+        for f in logs:
+            f.close()
+        if all(c == 0 for c in codes):
+            return 0
+        attempts += 1
+        if attempts > args.max_restart:
+            print(f"launch: ranks failed with codes {codes}", file=sys.stderr)
+            return max(codes)
+        print(f"launch: restarting (attempt {attempts}/{args.max_restart})",
+              file=sys.stderr)
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

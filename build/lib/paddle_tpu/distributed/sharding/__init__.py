@@ -1,0 +1,49 @@
+"""paddle.distributed.sharding user API. Parity:
+python/paddle/distributed/sharding/group_sharded.py ::
+group_sharded_parallel(level="os"/"os_g"/"p_g_os") / save_group_sharded_model.
+"""
+from __future__ import annotations
+
+from ..fleet.meta_parallel.sharding.group_sharded import (
+    GroupShardedStage2, GroupShardedStage3, GroupShardedOptimizerStage2,
+    DygraphShardingOptimizer)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    assert level in ("os", "os_g", "p_g_os"), f"bad sharding level {level}"
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          group=group, offload=offload)
+        wrapped = GroupShardedStage2(model, opt, group=group,
+                                     sync_buffers=sync_buffers,
+                                     buffer_max_size=buffer_max_size)
+        return wrapped, opt, scaler
+    wrapped = GroupShardedStage3(model, optimizer=optimizer, group=group,
+                                 sync_buffers=sync_buffers,
+                                 segment_size=segment_size, offload=offload,
+                                 sync_comm=sync_comm, dp_group=dp_group,
+                                 exclude_layer=exclude_layer)
+    return wrapped, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ..fleet.meta_parallel.sharding.group_sharded import GroupShardedStage3
+    from ...framework.io import save
+    os.makedirs(output, exist_ok=True)
+    target = model
+    if isinstance(model, GroupShardedStage3):
+        model.get_all_parameters()
+        target = model._layers
+    save(target.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

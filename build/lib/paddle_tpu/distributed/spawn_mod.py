@@ -1,0 +1,56 @@
+"""paddle.distributed.spawn. Parity: python/paddle/distributed/spawn.py.
+
+Launches fn in nprocs OS processes with the PADDLE_* env contract; each child
+gets a process_id and the JAX coordination address so jax.distributed can
+rendezvous (CPU backend: each process owns a slice of cpu devices).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Callable
+
+__all__ = ["spawn"]
+
+
+def _find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(fn, rank, nprocs, coord, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = coord
+    os.environ["JAX_PROCESS_ID"] = str(rank)
+    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coord
+    fn(*args)
+
+
+def spawn(func: Callable, args=(), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    if nprocs <= 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    coord = f"127.0.0.1:{_find_free_port()}"
+    env = {k: v for k, v in os.environ.items()}
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, coord, env, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawned process exited with code {p.exitcode}")
+    return procs

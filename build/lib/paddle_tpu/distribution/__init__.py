@@ -1,0 +1,155 @@
+"""paddle.distribution — probability distributions.
+
+Parity: python/paddle/distribution/ (Distribution base, Normal, Uniform,
+Categorical, Bernoulli, kl_divergence). TPU-native: sampling draws explicit
+PRNG keys from the global seed facade (core.rng) and all math is jnp.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+from ..tensor.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "kl_divergence"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(np.asarray(x), jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(self.scale ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low),
+                                -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        draws = jax.random.categorical(
+            next_key(), self.logits, axis=-1,
+            shape=tuple(shape) + self.logits.shape[:-1]) if shape else \
+            jax.random.categorical(next_key(), self.logits, axis=-1)
+        return Tensor(draws)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-(p * logp).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.p = _arr(probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.p.shape
+        return Tensor(jax.random.bernoulli(next_key(), self.p,
+                                           shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        eps = 1e-8
+        return Tensor(v * jnp.log(self.p + eps)
+                      + (1 - v) * jnp.log(1 - self.p + eps))
+
+    def entropy(self):
+        eps = 1e-8
+        return Tensor(-(self.p * jnp.log(self.p + eps)
+                        + (1 - self.p) * jnp.log(1 - self.p + eps)))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, axis=-1)
+        lq = jax.nn.log_softmax(q.logits, axis=-1)
+        return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

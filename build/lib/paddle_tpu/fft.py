@@ -1,0 +1,62 @@
+"""paddle.fft over jnp.fft. Parity: python/paddle/fft.py (fft/ifft/rfft/
+irfft + 2d/n variants, fftshift, fftfreq). XLA lowers these to TPU-friendly
+FFT HLOs directly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor.tensor import Tensor, apply_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "fftn", "ifftn", "rfft2", "irfft2", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name):
+    jfn = getattr(jnp.fft, name)
+
+    def fn(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
+    fn.__name__ = name
+    return fn
+
+
+def _wrap2(name, axes_default=(-2, -1)):
+    jfn = getattr(jnp.fft, name)
+
+    def fn(x, s=None, axes=axes_default, norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+    fn.__name__ = name
+    return fn
+
+
+fft = _wrap1("fft")
+ifft = _wrap1("ifft")
+rfft = _wrap1("rfft")
+irfft = _wrap1("irfft")
+hfft = _wrap1("hfft")
+ihfft = _wrap1("ihfft")
+fft2 = _wrap2("fft2")
+ifft2 = _wrap2("ifft2")
+fftn = _wrap2("fftn", axes_default=None)
+ifftn = _wrap2("ifftn", axes_default=None)
+rfft2 = _wrap2("rfft2")
+irfft2 = _wrap2("irfft2")
+rfftn = _wrap2("rfftn", axes_default=None)
+irfftn = _wrap2("irfftn", axes_default=None)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x)

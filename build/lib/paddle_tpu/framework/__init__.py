@@ -1,0 +1,3 @@
+"""paddle.framework shims."""
+from .io import save, load
+from .layer_helpers import DataParallel

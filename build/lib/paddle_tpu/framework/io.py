@@ -1,0 +1,54 @@
+"""paddle.save / paddle.load. Parity: python/paddle/framework/io.py.
+
+Pickle over nested dicts of numpy-converted tensors — byte-compatible with
+the reference's .pdparams convention. Distributed/sharded checkpointing
+(Orbax-backed, reshard-on-load) lives in distributed/checkpoint.py.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_saved(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_saved(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if configs.get("return_numpy", False):
+        return obj
+    return _from_saved(obj)

@@ -1,0 +1,60 @@
+"""paddle.DataParallel. Parity: python/paddle/fluid/dygraph/parallel.py.
+
+Reference semantics: wrap a model; gradients are bucketed and all-reduced
+across the dp group by EagerReducer hooks. TPU-native: inside a jitted train
+step over a dp-sharded mesh XLA inserts the reduction automatically from the
+sharding specs; eagerly (single host, multiple devices) gradients are averaged
+via the collective API after backward — fused per bucket as in the reference.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import no_grad
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._no_sync = False
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._no_sync = True
+            try:
+                yield
+            finally:
+                self._no_sync = False
+        return ctx()
+
+    def apply_gradients(self):
+        """All-reduce (mean) every ready grad across the dp group."""
+        if self._no_sync:
+            return
+        from ..distributed import all_reduce_gradients
+        all_reduce_gradients(list(self._layers.parameters()), self.group)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,3 @@
+from . import model
+from .model import Model
+from . import callbacks

@@ -159,8 +159,9 @@ class VisualDL(Callback):
     def _log(self, prefix, logs, step):
         for k, v in (logs or {}).items():
             try:
-                self._w().add_scalar(f"{prefix}/{k}", v, step)
-            except (TypeError, ValueError, IndexError):
+                self._w().add_scalar(f"{prefix}/{k}", float(
+                    np.asarray(getattr(v, "_data", v)).reshape(-1)[0]), step)
+            except (TypeError, ValueError):
                 continue
 
     def on_train_batch_end(self, step, logs=None):
@@ -176,7 +177,6 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._writer is not None:
             self._writer.close()
-            self._writer = None          # a later fit() reopens cleanly
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

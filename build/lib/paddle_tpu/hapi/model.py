@@ -119,32 +119,22 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
-        from .callbacks import Callback
-        cbks = [c for c in (callbacks or []) if isinstance(c, Callback)]
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size,
                        num_workers=num_workers)
         for m in self._metrics:
             m.reset()
-        for c in cbks:
-            c.on_eval_begin()
         logs = {}
         losses = []
         for step, batch in enumerate(loader):
-            for c in cbks:
-                c.on_eval_batch_begin(step)
             ins, labels = self._split_batch(batch)
             res = self.eval_batch(ins, labels)
             losses.append(res[0] if isinstance(res, list) else res)
-            for c in cbks:
-                c.on_eval_batch_end(step, {"loss": losses[-1]})
             if num_iters is not None and step + 1 >= num_iters:
                 break
         logs["loss"] = float(np.mean(losses)) if losses else 0.0
         for m in self._metrics:
             logs[getattr(m, "name", lambda: "metric")()] = m.accumulate()
-        for c in cbks:
-            c.on_eval_end(logs)
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0,

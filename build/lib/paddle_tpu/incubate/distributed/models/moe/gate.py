@@ -1,0 +1,149 @@
+"""MoE gates. Parity: python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate.py, gshard_gate.py, switch_gate.py}.
+
+Each gate returns (combine_weights, dispatch_mask, aux_loss) in the GShard
+dense-einsum formulation — capacity-truncated one-hot masks that the MoELayer
+turns into all-to-all dispatch on the expert mesh axis via einsum (GSPMD
+lowers the sharded einsum to the same global_scatter/global_gather exchange
+the reference implements as dedicated CUDA ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.initializer import Normal
+from .....nn.layer.common import Linear
+from .....nn.layer.layers import Layer
+from .....tensor.tensor import Tensor, apply_op
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate", "BaseGate"]
+
+
+def _top1_dispatch(logits, capacity, noise=None):
+    """[G, S, E] logits → combine [G,S,E,C], dispatch bool, aux loss."""
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits if noise is None else logits + noise, axis=-1)
+    onehot = jax.nn.one_hot(idx, e, dtype=logits.dtype)         # [G,S,E]
+    # aux load-balance loss (GShard eq.4): e * Σ_e mean(gates_e)·mean(mask_e)
+    density = jnp.mean(onehot, axis=1)                          # [G,E]
+    density_proxy = jnp.mean(probs, axis=1)
+    aux = jnp.mean(density * density_proxy) * (e * e)
+    # position within expert queue
+    pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0             # [G,S,E]
+    keep = (pos < capacity) & (onehot > 0)
+    pos_clamped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(pos_clamped, capacity,
+                                dtype=logits.dtype)             # [G,S,E,C]
+    dispatch = cap_onehot * keep[..., None]
+    gate_val = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [G,S,1]
+    combine = dispatch * gate_val[..., None]
+    return combine, dispatch, aux
+
+
+def _top2_dispatch(logits, capacity, key=None):
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    # top-1
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=logits.dtype)
+    # top-2 from masked probs
+    probs2 = probs * (1 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=logits.dtype)
+    density = jnp.mean(mask1, axis=1)
+    density_proxy = jnp.mean(probs, axis=1)
+    aux = jnp.mean(density * density_proxy) * (e * e)
+    pos1 = jnp.cumsum(mask1, axis=1) * mask1 - 1.0
+    pos2 = (jnp.cumsum(mask2, axis=1) + jnp.sum(mask1, axis=1,
+                                                keepdims=True)) * mask2 - 1.0
+    keep1 = (pos1 < capacity) & (mask1 > 0)
+    keep2 = (pos2 < capacity) & (mask2 > 0)
+
+    def build(mask, pos, keep):
+        p = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        return jax.nn.one_hot(p, capacity, dtype=logits.dtype) * keep[..., None]
+    d1 = build(mask1, pos1, keep1)
+    d2 = build(mask2, pos2, keep2)
+    g1 = jnp.sum(probs * mask1, -1, keepdims=True)
+    g2 = jnp.sum(probs * mask2, -1, keepdims=True)
+    denom = jnp.clip(g1 + g2, 1e-9, None)
+    combine = d1 * (g1 / denom)[..., None] + d2 * (g2 / denom)[..., None]
+    dispatch = jnp.maximum(d1, d2)
+    return combine, dispatch, aux
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, capacity_factor=1.2):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        from .....nn.utils_ import ParamAttr
+        self.gate_proj = Linear(
+            d_model, num_experts, bias_attr=False,
+            weight_attr=ParamAttr(initializer=Normal(0.0, 0.02)))
+        self.aux_loss = None
+
+    def capacity(self, seq_len):
+        import math
+        return max(4, int(math.ceil(
+            seq_len * self.capacity_factor / self.num_experts)))
+
+
+class NaiveGate(BaseGate):
+    """top-2 gate without noise. Parity: naive_gate.py."""
+
+    def forward(self, x):
+        logits = self.gate_proj(x)
+        cap = self.capacity(x.shape[1])
+
+        def f(lg):
+            return _top2_dispatch(lg.astype(jnp.float32), cap)
+        combine, dispatch, aux = apply_op(f, logits, n_outputs=3)
+        self.aux_loss = aux
+        return combine, dispatch, aux
+
+
+class GShardGate(BaseGate):
+    """top-2 with jitter noise + capacity. Parity: gshard_gate.py."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.2,
+                 random_routing=True):
+        super().__init__(d_model, num_experts, capacity_factor)
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        logits = self.gate_proj(x)
+        cap = self.capacity(x.shape[1])
+        if self.training and self.random_routing:
+            from .....core.rng import next_key
+            noise = jax.random.uniform(next_key(),
+                                       (x.shape[0], x.shape[1],
+                                        self.num_experts),
+                                       minval=1.0 - 1e-2, maxval=1.0 + 1e-2)
+        else:
+            noise = None
+
+        def f(lg):
+            l32 = lg.astype(jnp.float32)
+            if noise is not None:
+                l32 = l32 * noise
+            return _top2_dispatch(l32, cap)
+        combine, dispatch, aux = apply_op(f, logits, n_outputs=3)
+        self.aux_loss = aux
+        return combine, dispatch, aux
+
+
+class SwitchGate(BaseGate):
+    """top-1 switch routing. Parity: switch_gate.py."""
+
+    def forward(self, x):
+        logits = self.gate_proj(x)
+        cap = self.capacity(x.shape[1])
+
+        def f(lg):
+            return _top1_dispatch(lg.astype(jnp.float32), cap)
+        combine, dispatch, aux = apply_op(f, logits, n_outputs=3)
+        self.aux_loss = aux
+        return combine, dispatch, aux

@@ -1,0 +1,110 @@
+"""MoE dispatch utility ops.
+
+Parity: the reference's CUDA utility kernels around global scatter/gather —
+paddle/fluid/operators/number_count_op.cu, limit_by_capacity_op.cu,
+prune_gate_by_capacity_op.cu, random_routing_op.cu (SURVEY §2.4 "MoE
+alltoall ops"). TPU-native: plain jnp (XLA fuses these small integer
+kernels); all are jit-safe with static shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.rng import next_key
+from .....tensor.tensor import Tensor
+
+__all__ = ["number_count", "limit_by_capacity", "prune_gate_by_capacity",
+           "random_routing", "global_scatter", "global_gather"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def number_count(numbers, upper_range):
+    """Histogram of expert indices: [N] int -> [upper_range] counts."""
+    n = _arr(numbers).astype(jnp.int32)
+    counts = jnp.zeros((upper_range,), jnp.int32).at[
+        jnp.clip(n, 0, upper_range - 1)].add(jnp.where(
+            (n >= 0) & (n < upper_range), 1, 0))
+    return Tensor(counts)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """Clamp per-(worker, expert) counts by each expert's capacity.
+    expert_count: [n_worker * n_expert] ordered worker-major (reference
+    layout); capacity: [n_expert]. Returns the clamped counts — workers
+    consume a shared capacity in worker order."""
+    ec = _arr(expert_count).astype(jnp.int32)
+    cap = _arr(capacity).astype(jnp.int32)
+    n_expert = cap.shape[0]
+    grid = ec.reshape(n_worker, n_expert)
+
+    def per_expert(counts_e, cap_e):
+        # prefix allocation in worker order
+        cum = jnp.cumsum(counts_e)
+        allowed_end = jnp.minimum(cum, cap_e)
+        allowed_start = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                         allowed_end[:-1]])
+        return allowed_end - allowed_start
+
+    out = jax.vmap(per_expert, in_axes=(1, 0), out_axes=1)(grid, cap)
+    return Tensor(out.reshape(-1))
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker=1):
+    """Set gate indices beyond each expert's remaining count to -1.
+    gate_idx: [N] expert assignment per token (order = arrival order);
+    expert_count: [n_worker*n_expert] clamped counts."""
+    gi = _arr(gate_idx).astype(jnp.int32)
+    ec = _arr(expert_count).astype(jnp.int32)
+    total = ec.reshape(n_worker, n_expert).sum(0)
+
+    # rank of each token within its expert (stable arrival order)
+    one_hot = jax.nn.one_hot(gi, n_expert, dtype=jnp.int32)
+    rank = (jnp.cumsum(one_hot, axis=0) * one_hot).sum(-1) - 1   # [N]
+    keep = rank < jnp.take(total, jnp.clip(gi, 0, n_expert - 1))
+    return Tensor(jnp.where(keep & (gi >= 0), gi, -1))
+
+
+def random_routing(topk_idx, topk_value, prob, topk=2):
+    """Reference random_routing: with the 2nd choice, keep it only when
+    prob < 2*topk_value (rescaled threshold), else route to -1."""
+    idx = _arr(topk_idx)
+    val = _arr(topk_value)
+    p = _arr(prob)
+    if idx.ndim == 2 and idx.shape[1] >= 2:
+        keep2 = p < 2.0 * val[:, 1]
+        new2 = jnp.where(keep2, idx[:, 1], -1)
+        idx = idx.at[:, 1].set(new2)
+    return Tensor(idx)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Token exchange to expert owners — the reference's global_scatter
+    NCCL alltoall (paddle/fluid/operators/collective/global_scatter_op.cu).
+
+    TPU-native: inside shard_map, this is jax.lax.all_to_all on the expert
+    mesh axis; at world size 1 (or outside a mapped context) it is the
+    identity on the locally-dispatched buffer. The MoELayer einsum dispatch
+    (moe_layer.py) is the jit path where GSPMD inserts the same exchange
+    automatically — this explicit op exists for the eager collective-API
+    parity tests."""
+    arr = _arr(x)
+    axis = getattr(group, "axis_name", None) if group is not None else None
+    if group is not None and getattr(group, "nranks", 1) <= 1:
+        return x if isinstance(x, Tensor) else Tensor(arr)
+    try:
+        out = jax.lax.all_to_all(arr, axis or "dp", split_axis=0,
+                                 concat_axis=0, tiled=True)
+    except NameError:
+        # axis name not bound — eager call outside shard_map/pmap, where
+        # the locally-dispatched buffer already IS the exchange result
+        out = arr
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (reference global_gather_op.cu)."""
+    return global_scatter(x, global_count, local_count, group)

@@ -1,0 +1,11 @@
+"""paddle.incubate.nn — fused block layers.
+
+Parity: python/paddle/incubate/nn/{layer,functional}/ (FusedMultiHeadAttention,
+FusedFeedForward, FusedMultiTransformer, fused_rotary_position_embedding) over
+the fused CUDA ops in paddle/fluid/operators/fused/. TPU-native: the fusion
+is XLA's job; the layers here present the same fused-API surface over
+composites + Pallas attention (ops/pallas).
+"""
+from . import functional
+from .layer import (FusedMultiHeadAttention, FusedFeedForward,
+                    FusedMultiTransformer, FusedLinear)

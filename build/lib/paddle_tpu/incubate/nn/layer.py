@@ -1,0 +1,177 @@
+"""Fused layers. Parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedMultiTransformer) +
+FusedLinear.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.initializer import Constant, XavierNormal
+from ...nn.layer.layers import Layer, LayerList
+from ...tensor.tensor import Parameter
+from . import functional as IF
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedMultiTransformer", "FusedLinear"]
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        init = XavierNormal()
+        shape = (out_features, in_features) if transpose_weight else \
+            (in_features, out_features)
+        self.weight = Parameter(init(shape, self._dtype))
+        self.bias = None if bias_attr is False else Parameter(
+            jnp.zeros((out_features,), self._dtype))
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return IF.fused_matmul_bias(x, self.weight, self.bias,
+                                    transpose_y=self.transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr
+                 =None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        init = XavierNormal()
+        self.qkv_weight = Parameter(init(
+            (3, num_heads, self.head_dim, embed_dim), self._dtype))
+        self.qkv_bias = Parameter(jnp.zeros((3, num_heads, self.head_dim),
+                                            self._dtype))
+        self.linear_weight = Parameter(init((embed_dim, embed_dim),
+                                            self._dtype))
+        self.linear_bias = Parameter(jnp.zeros((embed_dim,), self._dtype))
+        self.pre_ln_scale = Parameter(jnp.ones((embed_dim,), self._dtype))
+        self.pre_ln_bias = Parameter(jnp.zeros((embed_dim,), self._dtype))
+        self.ln_scale = Parameter(jnp.ones((embed_dim,), self._dtype))
+        self.ln_bias = Parameter(jnp.zeros((embed_dim,), self._dtype))
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        init = XavierNormal()
+        self.linear1_weight = Parameter(init((d_model, dim_feedforward),
+                                             self._dtype))
+        self.linear1_bias = Parameter(jnp.zeros((dim_feedforward,),
+                                                self._dtype))
+        self.linear2_weight = Parameter(init((dim_feedforward, d_model),
+                                             self._dtype))
+        self.linear2_bias = Parameter(jnp.zeros((d_model,), self._dtype))
+        self.ln1_scale = Parameter(jnp.ones((d_model,), self._dtype))
+        self.ln1_bias = Parameter(jnp.zeros((d_model,), self._dtype))
+        self.ln2_scale = Parameter(jnp.ones((d_model,), self._dtype))
+        self.ln2_bias = Parameter(jnp.zeros((d_model,), self._dtype))
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate if act_dropout_rate is not None \
+            else dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, self.act_dropout_rate, self.dropout_rate,
+            self.activation, self.epsilon, self.epsilon,
+            self.normalize_before, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: FusedMultiTransformer (incubate) → fused_multi_transformer op."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.epsilon = epsilon
+        init = XavierNormal()
+        dt = self._dtype
+
+        def plist(shape, is_one=False):
+            from ...nn.layer.layers import ParameterList
+            return ParameterList([
+                Parameter(jnp.ones(shape, dt) if is_one else
+                          (init(shape, dt) if len(shape) > 1 else
+                           jnp.zeros(shape, dt)))
+                for _ in range(num_layers)])
+
+        self.ln_scales = plist((embed_dim,), True)
+        self.ln_biases = plist((embed_dim,))
+        self.qkv_weights = plist((3, num_heads, self.head_dim, embed_dim))
+        self.qkv_biases = plist((3, num_heads, self.head_dim))
+        self.linear_weights = plist((embed_dim, embed_dim))
+        self.linear_biases = plist((embed_dim,))
+        self.ffn_ln_scales = plist((embed_dim,), True)
+        self.ffn_ln_biases = plist((embed_dim,))
+        self.ffn1_weights = plist((embed_dim, dim_feedforward))
+        self.ffn1_biases = plist((dim_feedforward,))
+        self.ffn2_weights = plist((dim_feedforward, embed_dim))
+        self.ffn2_biases = plist((embed_dim,))
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        out, new_caches = IF.fused_multi_transformer(
+            src, list(self.ln_scales), list(self.ln_biases),
+            list(self.qkv_weights), list(self.qkv_biases),
+            list(self.linear_weights), list(self.linear_biases),
+            list(self.ffn_ln_scales), list(self.ffn_ln_biases),
+            list(self.ffn1_weights), list(self.ffn1_biases),
+            list(self.ffn2_weights), list(self.ffn2_biases),
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, pre_caches=pre_caches,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, activation=self.activation,
+            training=self.training)
+        if caches is not None:
+            return out, new_caches
+        return out

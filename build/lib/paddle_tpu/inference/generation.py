@@ -1,0 +1,360 @@
+"""Autoregressive generation loops.
+
+Capability parity: the decode driver around
+fused_multi_transformer_op.cu (paddle/fluid/operators/fused/) and
+PaddleNLP-style `generate()` (greedy / sampling / top-k / top-p).
+
+Two paths:
+  * generate(model, ...)        — model-agnostic: re-runs the forward on the
+    growing prefix each step (correct for any causal LM; XLA caches one
+    executable per prefix-length bucket).
+  * generate_fused(fmt, ...)    — FusedMultiTransformer decode: static-shape
+    KV ring cache + the Pallas flash-decode kernel
+    (paddle_tpu/ops/pallas/decode_attention.py), one compiled step reused
+    for every position — the reference's fused decode loop, TPU-style.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+from ..tensor.tensor import Tensor, no_grad
+
+__all__ = ["generate", "generate_fused", "FusedDecoder"]
+
+
+def _filter_logits(logits, do_sample, top_k, top_p, temperature):
+    if not do_sample:
+        return logits
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        kth = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return logits
+
+
+def _sample_next(logits, do_sample, top_k, top_p, temperature, key=None):
+    """logits: [B, V] jnp array -> [B] int32 token ids."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits, do_sample, top_k, top_p, temperature)
+    return jax.random.categorical(key if key is not None else next_key(),
+                                  logits, axis=-1).astype(jnp.int32)
+
+
+@no_grad()
+def generate(model, input_ids, max_new_tokens: int = 20,
+             eos_token_id: Optional[int] = None, do_sample: bool = False,
+             top_k: int = 0, top_p: float = 1.0, temperature: float = 1.0):
+    """Causal-LM generation; input_ids [B, S] Tensor/ndarray -> [B, S+T].
+
+    Greedy by default; sampling with top-k/top-p/temperature when
+    do_sample=True. Stops early only when every sequence emitted eos.
+    """
+    model.eval()
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    finished = jnp.zeros((ids.shape[0],), bool)
+    for _ in range(max_new_tokens):
+        logits = model(Tensor(ids))
+        logits = logits._data if isinstance(logits, Tensor) else logits
+        nxt = _sample_next(logits[:, -1], do_sample, top_k, top_p,
+                           temperature)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        if eos_token_id is not None and bool(jnp.all(finished)):
+            break
+    return Tensor(ids)
+
+
+class FusedDecoder:
+    """Compiled multi-layer KV-cache decode around FusedMultiTransformer.
+
+    Parity: the decode driver of fused_multi_transformer_op.cu ::
+    FusedMultiTransformerOp — all decoder layers batched into ONE compiled
+    step per token. TPU-native realization:
+      * the KV cache is a layer-stacked static ring buffer
+        [L, 2, B, H, Smax, D] in kernel layout (no per-step transposes or
+        reallocation; position is data, so one executable serves every t);
+      * the layer loop is a lax.scan over stacked layer params — the Pallas
+        flash-decode kernel (ops/pallas/decode_attention.py) compiles once
+        and streams KV blocks for each of the L layers;
+      * under an active mesh with mp >= 2 the attention falls back to a
+        dense masked form whose head dimension GSPMD shards over 'mp'
+        (TP-sharded decode; the manual shard_map kernel path is a
+        follow-up), with caches annotated P(None,None,None,'mp',None,None).
+
+    embed / head are the model's surrounding Layers (token embedding and
+    LM head); their params are passed as jit arguments, not baked in.
+    """
+
+    def __init__(self, fmt, embed, head, max_seq_len, use_rotary=False,
+                 rope_base=10000.0):
+        from ..nn.layer.layers import Layer
+        self.fmt = fmt
+        self.embed = embed
+        self.head = head
+        self.smax = int(max_seq_len)
+        self.use_rotary = use_rotary
+        if use_rotary and float(rope_base) != 10000.0:
+            raise NotImplementedError(
+                "FusedDecoder prefill uses the fused stack's default rotary "
+                "base (10000); plumb rotary_emb_base through "
+                "fused_multi_transformer before changing it")
+        self.rope_base = rope_base
+        self._embed_params = list(embed.parameters()) if isinstance(
+            embed, Layer) else []
+        self._head_params = list(head.parameters()) if isinstance(
+            head, Layer) else []
+        self._step = None
+        self._step_key = None
+        self._stk_cache = None
+
+    # ------------------------------------------------------------ stacking
+    def _stacked(self):
+        f = self.fmt
+        # hold the source arrays themselves: comparing by identity is only
+        # sound while we keep them alive (freed ids get recycled)
+        version = [p._data for p in f.parameters()]
+        if self._stk_cache is not None and                 len(self._stk_cache[0]) == len(version) and                 all(a is b for a, b in zip(self._stk_cache[0], version)):
+            return self._stk_cache[1]
+
+        def stk(plist):
+            return jnp.stack([p._data for p in plist])
+        out = {
+            "ln_s": stk(f.ln_scales), "ln_b": stk(f.ln_biases),
+            "qkv_w": stk(f.qkv_weights), "qkv_b": stk(f.qkv_biases),
+            "lin_w": stk(f.linear_weights), "lin_b": stk(f.linear_biases),
+            "fln_s": stk(f.ffn_ln_scales), "fln_b": stk(f.ffn_ln_biases),
+            "f1_w": stk(f.ffn1_weights), "f1_b": stk(f.ffn1_biases),
+            "f2_w": stk(f.ffn2_weights), "f2_b": stk(f.ffn2_biases),
+        }
+        self._stk_cache = (version, out)
+        return out
+
+    def init_cache(self, batch, dtype=None):
+        f = self.fmt
+        dtype = dtype or self.fmt.qkv_weights[0]._data.dtype
+        return jnp.zeros((f.num_layers, 2, batch, f.num_heads, self.smax,
+                          f.head_dim), dtype)
+
+    # ------------------------------------------------------------ the step
+    def _mesh_mp(self):
+        from ..parallel import current_mesh
+        mesh = current_mesh()
+        if mesh is not None and dict(mesh.shape).get("mp", 1) >= 2:
+            return mesh
+        return None
+
+    def _build_step(self, do_sample, top_k, top_p, temperature):
+        f = self.fmt
+        eps = f.epsilon
+        pre_ln = f.normalize_before
+        nh, hd = f.num_heads, f.head_dim
+        act = f.activation
+        smax = self.smax
+        use_rotary = self.use_rotary
+        rope_base = self.rope_base
+        mesh = self._mesh_mp()
+        from ..nn.layer.layers import substitute_param_arrays
+
+        def ln(x, s, b):
+            mu = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+            out = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+            return (out * s + b).astype(x.dtype)
+
+        def rope1(x, t):
+            # x: [B, 1, H, D] at absolute position t
+            inv = 1.0 / (rope_base ** (jnp.arange(0, hd, 2,
+                                                  dtype=jnp.float32) / hd))
+            fr = t.astype(jnp.float32) * inv            # [D/2]
+            s, c = jnp.sin(fr), jnp.cos(fr)
+            ss = jnp.concatenate([s, s])[None, None, None, :]
+            cc = jnp.concatenate([c, c])[None, None, None, :]
+            x1 = x[..., : hd // 2]
+            x2 = x[..., hd // 2:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            return (x * cc.astype(x.dtype) + rot * ss.astype(x.dtype))
+
+        def attend(q, cache, t):
+            # q: [B, 1, H, D]; cache: [2, B, H, Smax, D]
+            qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
+            if mesh is None:
+                from ..ops.pallas.decode_attention import (
+                    decode_attention_bhsd, is_supported)
+                if is_supported((q.shape[0], 1, nh, hd),
+                                (q.shape[0], smax, nh, hd), q.dtype):
+                    lens = jnp.full((q.shape[0],), t, jnp.int32)
+                    o = decode_attention_bhsd(qt, cache[0], cache[1], lens)
+                    return jnp.swapaxes(o, 1, 2)
+            # dense masked fallback — under a mesh the head dim ('mp')
+            # shards this einsum Megatron-style
+            s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
+                           cache[0].astype(jnp.float32)) * (hd ** -0.5)
+            mask = jnp.arange(smax)[None, None, None, :] <= t
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqs,bhsd->bhqd", p,
+                           cache[1].astype(jnp.float32))
+            return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+        def layer_step(x, xs, t):
+            p, cache = xs
+            residual = x
+            h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
+            emb = h.shape[-1]
+            w = p["qkv_w"].reshape(3 * nh * hd, emb).T
+            qkv = h @ w.astype(h.dtype) + \
+                p["qkv_b"].reshape(-1).astype(h.dtype)
+            b = h.shape[0]
+            qkv = qkv.reshape(b, 1, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if use_rotary:
+                q = rope1(q, t)
+                k = rope1(k, t)
+            # write-then-attend at ring position t
+            knew = jnp.swapaxes(k, 1, 2)[None]          # [1, B, H, 1, D]
+            vnew = jnp.swapaxes(v, 1, 2)[None]
+            cache = jax.lax.dynamic_update_slice(
+                cache, jnp.concatenate([knew, vnew], 0).astype(cache.dtype),
+                (0, 0, 0, t, 0))
+            attn = attend(q, cache, t)
+            attn = attn.reshape(b, 1, nh * hd)
+            attn = attn @ p["lin_w"].astype(attn.dtype) + \
+                p["lin_b"].astype(attn.dtype)
+            x = residual + attn
+            if not pre_ln:
+                x = ln(x, p["ln_s"], p["ln_b"])
+            residual = x
+            h = ln(x, p["fln_s"], p["fln_b"]) if pre_ln else x
+            h = h @ p["f1_w"].astype(h.dtype) + p["f1_b"].astype(h.dtype)
+            h = getattr(jax.nn, act)(h)
+            h = h @ p["f2_w"].astype(h.dtype) + p["f2_b"].astype(h.dtype)
+            x = residual + h
+            if not pre_ln:
+                x = ln(x, p["fln_s"], p["fln_b"])
+            return x, cache
+
+        embed, head = self.embed, self.head
+        e_params, h_params = self._embed_params, self._head_params
+
+        def call_layerlike(fn, params, arrays, x_arr):
+            # no_grad: inference-only — must not record onto (or clear!) a
+            # caller's pending autograd tape
+            with substitute_param_arrays(params, arrays), no_grad():
+                out = fn(Tensor(x_arr))
+            return out._data if isinstance(out, Tensor) else out
+
+        def step(stk, e_arrays, h_arrays, caches, tok, t, key):
+            # tok: [B] int32; t: scalar int32; caches: [L, 2, B, H, Smax, D]
+            x = call_layerlike(embed, e_params, e_arrays, tok[:, None])
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                caches = jax.lax.with_sharding_constraint(
+                    caches, NamedSharding(
+                        mesh, P(None, None, None, "mp", None, None)))
+
+            def body(x, xs):
+                return layer_step(x, xs, t)
+            x, caches = jax.lax.scan(body, x, (stk, caches))
+            logits = call_layerlike(head, h_params, h_arrays, x)
+            logits = logits.reshape(logits.shape[0], -1)
+            logits = _filter_logits(logits, do_sample, top_k, top_p,
+                                    temperature)
+            if do_sample:
+                nxt = jax.random.categorical(key, logits, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return nxt.astype(jnp.int32), caches
+
+        # donate the KV cache (in-place ring update, no per-token copy of
+        # the [L,2,B,H,Smax,D] buffer) — except through the axon tunnel,
+        # where buffer donation is observed to hang (see BASELINE.md r2)
+        tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        return jax.jit(step, donate_argnums=() if tunneled else (3,))
+
+    # --------------------------------------------------------------- drive
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=20, eos_token_id=None,
+                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0):
+        """Prefill the prompt through the eager fused stack (one compile),
+        then run the compiled per-token decode step."""
+        ids = input_ids._data if isinstance(input_ids, Tensor) else \
+            jnp.asarray(np.asarray(input_ids))
+        b, prompt = ids.shape
+        assert prompt + max_new_tokens <= self.smax, (
+            f"max_seq_len {self.smax} < prompt {prompt} + {max_new_tokens}")
+        f = self.fmt
+        f.eval()
+
+        # ---- prefill via the fused stack with per-layer cache views
+        caches = self.init_cache(b)
+        x = self.embed(Tensor(ids))
+        layer_caches = [Tensor(caches[i]) for i in range(f.num_layers)]
+        out = f(x, caches=layer_caches, time_step=0,
+                rotary_embs=True if self.use_rotary else None)
+        out = out[0] if isinstance(out, tuple) else out
+        caches = jnp.stack([c._data for c in layer_caches])
+        last = Tensor(out._data[:, -1:]) if isinstance(out, Tensor) else \
+            Tensor(out[:, -1:])
+        logits = self.head(last)
+        logits = (logits._data if isinstance(logits, Tensor) else logits)
+        nxt = _sample_next(logits[:, -1], do_sample, top_k, top_p,
+                           temperature)
+
+        # ---- compiled decode loop (cache key includes the active mesh:
+        # entering/leaving an mp mesh must rebuild the step)
+        key = (do_sample, top_k, top_p, temperature, id(self._mesh_mp()))
+        if self._step is None or self._step_key != key:
+            self._step = self._build_step(*key[:4])
+            self._step_key = key
+        stk = self._stacked()
+        e_arrays = [p._data for p in self._embed_params]
+        h_arrays = [p._data for p in self._head_params]
+        toks = [nxt]
+        _zero_key = jax.random.PRNGKey(0)   # unused in greedy (argmax branch)
+        finished = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished = finished | (nxt == eos_token_id)
+            if bool(jnp.all(finished)):
+                max_new_tokens = 1            # everything ended at prefill
+        for i in range(1, max_new_tokens):
+            t = jnp.asarray(prompt + i - 1, jnp.int32)
+            k_i = next_key() if do_sample else _zero_key
+            nxt, caches = self._step(stk, e_arrays, h_arrays, caches,
+                                     toks[-1], t, k_i)
+            if eos_token_id is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            toks.append(nxt)
+            if eos_token_id is not None and bool(jnp.all(finished)):
+                break
+        return Tensor(jnp.concatenate(
+            [ids] + [tk[:, None] for tk in toks], axis=1))
+
+
+def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
+                   max_seq_len=None, eos_token_id=None, do_sample=False,
+                   top_k=0, top_p=1.0, temperature=1.0, use_rotary=False):
+    """One-shot driver over FusedDecoder (see class docstring)."""
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    smax = max_seq_len or ids.shape[1] + max_new_tokens
+    dec = FusedDecoder(fmt, embed, head, smax, use_rotary=use_rotary)
+    return dec.generate(input_ids, max_new_tokens, eos_token_id, do_sample,
+                        top_k, top_p, temperature)

@@ -1,0 +1,339 @@
+"""paddle.jit — to_static / save / load.
+
+Parity: python/paddle/jit/ (dy2static program_translator, jit.save). The
+reference AST-transforms Python into a static ProgramDesc; here XLA already is
+the static graph, so ``to_static`` compiles the *same eager code* by tracing:
+
+  1. snapshot every persistent tensor (Parameters, optimizer slots, RNG key),
+  2. build a pure function (state_in, args) -> (out, state_out) that binds
+     tracers into those tensors and runs the user fn — the eager tape,
+     ``backward()`` and ``optimizer.step()`` all work under tracing,
+  3. jax.jit it with donated state (in-place buffer reuse on TPU),
+  4. write the updated state back after each call.
+
+This turns a dygraph train step into ONE fused XLA program: the per-op
+dispatch the reference pays per Python call disappears, and AdamW over the
+whole pytree becomes the fused multi-tensor form for free.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import (Tensor, persistent_tensors, _tape)
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "TranslatedLayer", "enable_to_static"]
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    _to_static_enabled[0] = bool(flag)
+
+
+class _TensorRef:
+    """Placeholder for a Tensor leaf inside a flattened arg/out spec."""
+
+    __slots__ = ("idx", "stop_gradient")
+
+    def __init__(self, idx, stop_gradient):
+        self.idx = idx
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"_TensorRef({self.idx})"
+
+
+def _tree_flatten_args(args, kwargs):
+    leaves = []
+
+    def walk(x):
+        if isinstance(x, Tensor):
+            leaves.append(x)
+            return _TensorRef(len(leaves) - 1, x.stop_gradient)
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(i) for i in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+    spec = walk((args, kwargs))
+    return leaves, spec
+
+
+def _tree_unflatten_args(spec, arrays):
+    def walk(x):
+        if isinstance(x, _TensorRef):
+            return Tensor(arrays[x.idx], stop_gradient=x.stop_gradient)
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(i) for i in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+    args, kwargs = walk(spec)
+    return args, kwargs
+
+
+def _flatten_out(out):
+    arrays = []
+
+    def walk(x):
+        if isinstance(x, Tensor):
+            arrays.append(x._data)
+            return _TensorRef(len(arrays) - 1, x.stop_gradient)
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(i) for i in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+    spec = walk(out)
+    return arrays, spec
+
+
+def _unflatten_out(spec, arrays):
+    def walk(x):
+        if isinstance(x, _TensorRef):
+            return Tensor(arrays[x.idx], stop_gradient=x.stop_gradient)
+        if isinstance(x, (list, tuple)):
+            return type(x)(walk(i) for i in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+    return walk(spec)
+
+
+def _constrain_to_spec(t, arr):
+    """Pin a persistent tensor's post-step placement to its annotated
+    PartitionSpec (replicated when unannotated) on the active hybrid mesh.
+
+    Without this, GSPMD's propagation is free to re-shard state outputs —
+    e.g. ZeRO-1 annotates only optimizer moments, but params touching
+    sharded moments could come back sharded too, silently changing the
+    sharding level's semantics. A no-op for already-conforming layouts and
+    off-mesh runs."""
+    try:
+        from ..parallel import current_mesh, _valid_spec
+        mesh = current_mesh()
+        if mesh is None or not hasattr(arr, "ndim"):
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = getattr(t, "sharding_spec", None)
+        pspec = P(*spec) if (spec is not None and
+                             _valid_spec(arr, spec, mesh)) else P()
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, pspec))
+    except Exception:
+        return arr
+
+
+class StaticFunction:
+    """Compiled wrapper around an eager function (dygraph → XLA program)."""
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 backend=None, donate_state: bool = None, static_argnames=None):
+        if donate_state is None:
+            # default off until the buffer-donation path is re-verified on
+            # the tunnel TPU backend; opt in per-function or via env
+            import os
+            donate_state = os.environ.get("PADDLE_TPU_DONATE") == "1"
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._input_spec = input_spec
+        self._donate_state = donate_state
+        self._cache: dict = {}
+        self._bound_instance = None
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._fn.__get__(instance, owner),
+                               self._input_spec,
+                               donate_state=self._donate_state)
+        setattr(instance, self._fn.__name__, bound)
+        return bound
+
+    @property
+    def dygraph_function(self):
+        return self._fn
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0]:
+            return self._fn(*args, **kwargs)
+
+        arg_tensors, spec = _tree_flatten_args(args, kwargs)
+        arg_arrays = [t._data for t in arg_tensors]
+        state = persistent_tensors()
+        state_arrays = [t._data for t in state]
+
+        key = (
+            tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays),
+            tuple(id(t) for t in state),
+            _spec_key(spec),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(state, spec, key)
+        jitted, out_spec_box, state_after_box = entry
+
+        saved_nodes = _tape.nodes[:]
+        saved_grads = [(t, t.grad) for t in state]
+        try:
+            out_arrays, new_state = jitted(state_arrays, arg_arrays)
+        except Exception as e:
+            _tape.nodes[:] = saved_nodes
+            for t, arr in zip(state, state_arrays):
+                t._data = arr
+            for t, g in saved_grads:
+                t.grad = g
+            if self._donate_state:
+                # execution-time failure after donation: the restored arrays
+                # may already be deleted — say so instead of surfacing a
+                # bare "Array has been deleted" later
+                raise RuntimeError(
+                    "to_static step failed after state buffers were donated; "
+                    "persistent state may be invalid. Re-create the model/"
+                    "optimizer or use to_static(donate_state=False) for "
+                    "rollback-on-error semantics.") from e
+            raise
+        finally:
+            _tape.nodes[:] = saved_nodes
+            for t, arr in zip(state, state_arrays):
+                t._data = arr  # undo any tracer leakage before writeback
+            for t, g in saved_grads:
+                t.grad = g
+        # state_after may be a superset of state: persistent tensors created
+        # during tracing (e.g. lazily-built optimizer slots) are captured as
+        # extra outputs; the next call's key sees the superset and recompiles
+        # once into the steady signature.
+        for t, arr in zip(state_after_box[0] or state, new_state):
+            t._data = arr
+        return _unflatten_out(out_spec_box[0], out_arrays)
+
+    def _build(self, state, spec, key):
+        out_spec_box = [None]
+        state_after_box = [None]
+        fn = self._fn
+
+        def pure(state_arrays, arg_arrays):
+            old = [t._data for t in state]
+            for t, a in zip(state, state_arrays):
+                t._data = a
+            _tape.nodes.clear()
+            args, kwargs = _tree_unflatten_args(spec, arg_arrays)
+            out = fn(*args, **kwargs)
+            out_arrays, out_spec = _flatten_out(out)
+            out_spec_box[0] = out_spec
+            state_after = persistent_tensors()
+            state_after_box[0] = state_after
+            new_state = [_constrain_to_spec(t, t._data)
+                         for t in state_after]
+            for t, a in zip(state, old):
+                t._data = a
+            for t in state_after:
+                t.grad = None
+            _tape.nodes.clear()
+            return out_arrays, new_state
+
+        # donate the state buffers: params/optimizer slots update in place
+        # (XLA aliases input->output), halving steady-state HBM traffic for
+        # the weight update; callers never read the pre-step arrays again
+        # (writeback below replaces every tensor's _data with the outputs).
+        # Opt out with to_static(donate_state=False) to keep pre-step arrays
+        # valid (e.g. external references, or rollback-on-error semantics).
+        donate = (0,) if self._donate_state else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        entry = (jitted, out_spec_box, state_after_box)
+        self._cache[key] = entry
+        return entry
+
+    def concrete_program(self, *args, **kwargs):
+        return None
+
+
+def _spec_key(spec):
+    def walk(x):
+        if isinstance(x, (list, tuple)):
+            return tuple(walk(i) for i in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, walk(v)) for k, v in x.items()))
+        if isinstance(x, (int, float, str, bool, type(None))):
+            return x
+        return str(x)
+    return walk(spec)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling an eager function into one XLA program."""
+    donate = kwargs.get("donate_state", None)
+
+    def decorate(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = StaticFunction(layer.forward, input_spec,
+                                           donate_state=donate)
+            return layer
+        return StaticFunction(fn, input_spec, donate_state=donate)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer:
+    """Loaded inference bundle (jit.save counterpart)."""
+
+    def __init__(self, state_dict, forward_fn=None, meta=None):
+        self._state = state_dict
+        self._meta = meta or {}
+
+    def state_dict(self):
+        return self._state
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity: persist params (+ structure note) for inference.
+
+    Reference exports a ProgramDesc; the TPU-native equivalent persists the
+    state_dict and (optionally) an input spec — reload with jit.load, rebind
+    to the model class, and jax.jit recompiles on first call (XLA is the
+    portable program format here, recompiled per topology).
+    """
+    from ..framework.io import save as fsave
+    from ..nn.layer.layers import Layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        sd = layer.state_dict()
+    else:
+        sd = layer
+    fsave(sd, path + ".pdparams")
+    meta = {"input_spec": repr(input_spec), "class": type(layer).__name__}
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+    sd = fload(path + ".pdparams")
+    meta = {}
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(sd, meta=meta)

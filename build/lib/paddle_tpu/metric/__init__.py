@@ -1,0 +1,164 @@
+"""paddle.metric — streaming metrics.
+
+Parity: python/paddle/metric/metrics.py :: Metric, Accuracy, Precision,
+Recall, Auc (host-side numpy accumulation, exactly as the reference — these
+never enter the compiled graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _np(x):
+    return np.asarray(x._data) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing hook run on (pred, label) before update
+        (reference computes correct-matrix here for Accuracy)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. update() takes the output of compute()."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] == 1:
+            label = label[..., 0]
+        maxk = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[..., :maxk]
+        correct = top == label[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = _np(correct)
+        n = int(np.prod(c.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].sum())
+            self.count[i] += n
+        return self.total[0] / max(self.count[0], 1)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+
+class Precision(Metric):
+    """Binary precision: tp / (tp + fp); pred is prob of positive."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via the reference's threshold-bucket approximation
+    (num_thresholds bins over [0,1])."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx, labels == 1)
+        np.add.at(self._stat_neg, idx, labels == 0)
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            pos = self._stat_pos[i]
+            neg = self._stat_neg[i]
+            auc += neg * (tot_pos + pos / 2.0)
+            tot_pos += pos
+            tot_neg += neg
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (paddle.metric.accuracy)."""
+    pred = _np(input)
+    lab = _np(label)
+    if lab.ndim == 2 and lab.shape[1] == 1:
+        lab = lab[:, 0]
+    top = np.argsort(-pred, axis=-1)[:, :k]
+    acc = float((top == lab[:, None]).any(axis=1).mean())
+    return Tensor(np.asarray(acc, np.float32))

@@ -1,0 +1,6 @@
+"""Model zoo: the five BASELINE config families."""
+from . import gpt
+from . import bert
+from . import llama
+from . import vit
+from . import moe

@@ -1,0 +1,168 @@
+"""BERT family (BASELINE configs[1]: BERT-base pretrain DP+AMP+stage2).
+
+Parity target: PaddleNLP-style BERT on this framework's layers: learned
+position + token-type embeddings, post-LN encoder, MLM + NSP pretraining
+heads.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_base", "bert_tiny"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
+                 initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+
+
+def _attr(std):
+    from ..nn.utils_ import ParamAttr
+    return ParamAttr(initializer=Normal(0.0, std))
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=_attr(c.initializer_range))
+        self.position_embeddings = Embedding(c.max_position, c.hidden_size,
+                                             weight_attr=_attr(c.initializer_range))
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size,
+                                               weight_attr=_attr(c.initializer_range))
+        self.layer_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            from ..tensor.creation import arange
+            position_ids = arange(s, dtype="int32")
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size,
+                            weight_attr=_attr(c.initializer_range))
+
+    def forward(self, hidden):
+        first = hidden[:, 0]
+        return F.tanh(self.dense(first))
+
+
+class BertModel(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.config = c
+        self.embeddings = BertEmbeddings(c)
+        enc_layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_heads, c.intermediate_size, c.dropout,
+            activation="gelu", layer_norm_eps=c.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, c.num_layers)
+        self.pooler = BertPooler(c)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        mask = None
+        if attention_mask is not None:
+            m = attention_mask._data if isinstance(attention_mask, Tensor) \
+                else attention_mask
+            mask = Tensor((m[:, None, None, :] > 0))
+        seq = self.encoder(x, mask)
+        pooled = self.pooler(seq)
+        return seq, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM (tied decoder) + NSP heads; returns combined loss when labels set."""
+
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.config = c
+        self.bert = BertModel(c)
+        self.transform = Linear(c.hidden_size, c.hidden_size,
+                                weight_attr=_attr(c.initializer_range))
+        self.transform_ln = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        from ..tensor.tensor import Parameter
+        self.mlm_bias = Parameter(jnp.zeros((c.vocab_size,), jnp.float32))
+        self.nsp = Linear(c.hidden_size, 2,
+                          weight_attr=_attr(c.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq)))
+        logits = F.linear(h, _t(self.bert.embeddings.word_embeddings.weight),
+                          self.mlm_bias)
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is not None:
+            mlm_loss = F.cross_entropy(
+                reshape(logits, [-1, self.config.vocab_size]),
+                reshape(masked_lm_labels, [-1]), ignore_index=-100)
+            loss = mlm_loss
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits,
+                                              next_sentence_labels)
+            return loss
+        return logits, nsp_logits
+
+
+def _t(w):
+    return apply_op(lambda a: a.T, w)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, c: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(c)
+        self.dropout = Dropout(c.dropout)
+        self.classifier = Linear(c.hidden_size, num_classes,
+                                 weight_attr=_attr(c.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=2, intermediate_size=128, max_position=128,
+                      **kw)

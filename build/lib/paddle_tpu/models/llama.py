@@ -1,0 +1,296 @@
+"""LLaMA-2 family (BASELINE configs[2]: 7B/65B hybrid mp·pp·stage3).
+
+Parity target: the PaddleNLP LLaMA implemented on this framework's layers —
+RMSNorm, rotary embeddings, GQA attention, SwiGLU MLP, tied-or-untied head.
+
+TPU-first design:
+  * attention/projections are mp-annotated (ColumnParallel/RowParallel) so a
+    jitted step over the fleet mesh shards them Megatron-style via GSPMD;
+  * activations can carry a sequence-parallel ('sep') constraint for
+    long-context runs (Ulysses/ring variants live in ops/pallas + parallel/);
+  * rotary embedding is computed in fp32 and fused by XLA; flash attention
+    via F.scaled_dot_product_attention → Pallas kernel on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..distributed.fleet.layers.mpu.mp_layers import (ColumnParallelLinear,
+                                                      RowParallelLinear,
+                                                      VocabParallelEmbedding,
+                                                      constraint)
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.norm import RMSNorm
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor, apply_op
+from ..incubate.nn.functional import fused_rotary_position_embedding
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama2_7b",
+           "llama2_65b", "llama_tiny"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096, num_layers=32,
+                 num_heads=32, num_kv_heads=None, intermediate_size=11008,
+                 max_position=4096, rms_eps=1e-5, rope_base=10000.0,
+                 initializer_range=0.02, tensor_parallel=True,
+                 sequence_parallel=False, recompute=False,
+                 tie_word_embeddings=False, context_parallel=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.rms_eps = rms_eps
+        self.rope_base = rope_base
+        self.initializer_range = initializer_range
+        self.tensor_parallel = tensor_parallel
+        self.sequence_parallel = sequence_parallel
+        self.recompute = recompute
+        self.tie_word_embeddings = tie_word_embeddings
+        # long-context: shard the sequence over the mesh's 'sep' axis and
+        # run exact ring attention (parallel/context_parallel.py) instead of
+        # gathering the full sequence per chip
+        self.context_parallel = context_parallel
+
+
+def _attr(std):
+    from ..nn.utils_ import ParamAttr
+    return ParamAttr(initializer=Normal(0.0, std))
+
+
+class LlamaAttention(Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.num_heads = c.num_heads
+        self.num_kv_heads = c.num_kv_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.rope_base = c.rope_base
+        self.context_parallel = c.context_parallel
+        self._ring_cache = None
+        h = c.hidden_size
+        kv_out = self.num_kv_heads * self.head_dim
+        std = c.initializer_range
+        if c.tensor_parallel:
+            self.q_proj = ColumnParallelLinear(h, h, weight_attr=_attr(std),
+                                               has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, kv_out,
+                                               weight_attr=_attr(std),
+                                               has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kv_out,
+                                               weight_attr=_attr(std),
+                                               has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(h, h, weight_attr=_attr(std),
+                                            has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(h, h, weight_attr=_attr(std),
+                                 bias_attr=False)
+            self.k_proj = Linear(h, kv_out, weight_attr=_attr(std),
+                                 bias_attr=False)
+            self.v_proj = Linear(h, kv_out, weight_attr=_attr(std),
+                                 bias_attr=False)
+            self.o_proj = Linear(h, h, weight_attr=_attr(std),
+                                 bias_attr=False)
+
+    def _ring_fn(self):
+        """Ring attention over the active mesh's 'sep' axis (cached per
+        mesh); None when no sep-parallel mesh is active."""
+        from ..parallel import current_mesh
+        mesh = current_mesh()
+        if mesh is None or "sep" not in mesh.shape or mesh.shape["sep"] < 2:
+            return None
+        if getattr(self, "_ring_cache", None) is None or \
+                self._ring_cache[0] is not mesh:
+            from ..parallel.context_parallel import make_ring_attention_fn
+            self._ring_cache = (mesh, make_ring_attention_fn(
+                mesh, axis_name="sep", causal=True))
+        return self._ring_cache[1]
+
+    def forward(self, x, kv_cache=None, time_step=None):
+        b, s = x.shape[0], x.shape[1]
+        q = reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, rotary_emb_base=self.rope_base)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), k)
+            v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v)
+        if kv_cache is not None:
+            k_cat, v_cat, kv_cache = _append_cache(kv_cache, k, v, time_step)
+            out = F.scaled_dot_product_attention(q, k_cat, v_cat)
+        elif self.context_parallel and self._ring_fn() is not None:
+            fn = self._ring_fn()
+            out = apply_op(fn, q, k, v)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out), kv_cache
+
+
+def _append_cache(cache, k, v, time_step):
+    kc, vc = cache
+    from ..tensor.manipulation import concat
+    k_cat = concat([kc, k], axis=1)
+    v_cat = concat([vc, v], axis=1)
+    return k_cat, v_cat, (k_cat, v_cat)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        h, inter = c.hidden_size, c.intermediate_size
+        std = c.initializer_range
+        if c.tensor_parallel:
+            self.gate_proj = ColumnParallelLinear(h, inter,
+                                                  weight_attr=_attr(std),
+                                                  has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, inter,
+                                                weight_attr=_attr(std),
+                                                has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(inter, h,
+                                               weight_attr=_attr(std),
+                                               has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(h, inter, weight_attr=_attr(std),
+                                    bias_attr=False)
+            self.up_proj = Linear(h, inter, weight_attr=_attr(std),
+                                  bias_attr=False)
+            self.down_proj = Linear(inter, h, weight_attr=_attr(std),
+                                    bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(c.hidden_size, c.rms_eps)
+        self.self_attn = LlamaAttention(c)
+        self.post_attention_layernorm = RMSNorm(c.hidden_size, c.rms_eps)
+        self.mlp = LlamaMLP(c)
+        self._recompute = c.recompute
+
+    def _body(self, x):
+        attn_out, _ = self.self_attn(self.input_layernorm(x))
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+    def forward(self, x):
+        if self._recompute and self.training:
+            from ..distributed.fleet.utils.recompute_mod import recompute
+            return recompute(self._body, x)
+        return self._body(x)
+
+
+class LlamaModel(Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.config = c
+        if c.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(
+                c.vocab_size, c.hidden_size,
+                weight_attr=_attr(c.initializer_range))
+        else:
+            self.embed_tokens = Embedding(
+                c.vocab_size, c.hidden_size,
+                weight_attr=_attr(c.initializer_range))
+        self.layers = LayerList([LlamaBlock(c) for _ in range(c.num_layers)])
+        self.norm = RMSNorm(c.hidden_size, c.rms_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            x = constraint(x, None, "sep", None)
+        for blk in self.layers:
+            x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, c: LlamaConfig):
+        super().__init__()
+        self.config = c
+        self.llama = LlamaModel(c)
+        if not c.tie_word_embeddings:
+            # gather_output=False: logits stay mp-sharded on the vocab dim
+            # straight into the vocab-parallel CE (a gather here would
+            # materialize the full [B*S, V] on every device — the memory
+            # blow-up ParallelCrossEntropy exists to avoid)
+            self.lm_head = (ColumnParallelLinear(
+                c.hidden_size, c.vocab_size, weight_attr=_attr(
+                    c.initializer_range), has_bias=False, gather_output=False)
+                if c.tensor_parallel else
+                Linear(c.hidden_size, c.vocab_size,
+                       weight_attr=_attr(c.initializer_range),
+                       bias_attr=False))
+        if c.tensor_parallel:
+            from ..distributed.fleet.layers.mpu.mp_layers import (
+                ParallelCrossEntropy)
+            self.parallel_loss = ParallelCrossEntropy()
+
+    def forward(self, input_ids, labels=None, loss_mask=None):
+        h = self.llama(input_ids)
+        if self.config.tie_word_embeddings:
+            logits = F.linear(h, _t(self.llama.embed_tokens.weight))
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            if self.config.tensor_parallel:
+                # vocab-parallel two-pass CE: mp-sharded logits never
+                # materialize the full vocab per device (mp_layers ::
+                # ParallelCrossEntropy); dense CE off-mesh
+                loss = self.parallel_loss(
+                    reshape(logits, [-1, self.config.vocab_size]),
+                    reshape(labels, [-1]))
+            else:
+                loss = F.cross_entropy(reshape(logits,
+                                               [-1, self.config.vocab_size]),
+                                       reshape(labels, [-1]),
+                                       reduction="none")
+            if loss_mask is not None:
+                m = reshape(loss_mask, [-1])
+                loss = (loss * m).sum() / m.sum().clip(min=1.0)
+            else:
+                loss = loss.mean()
+            return loss
+        return logits
+
+
+def _t(w):
+    return apply_op(lambda a: a.T, w)
+
+
+def llama2_7b(**kw):
+    return LlamaForCausalLM(LlamaConfig(hidden_size=4096, num_layers=32,
+                                        num_heads=32,
+                                        intermediate_size=11008, **kw))
+
+
+def llama2_65b(**kw):
+    return LlamaForCausalLM(LlamaConfig(hidden_size=8192, num_layers=80,
+                                        num_heads=64,
+                                        intermediate_size=22016, **kw))
+
+
+def llama_tiny(vocab_size=256, **kw):
+    return LlamaForCausalLM(LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position=128, **kw))

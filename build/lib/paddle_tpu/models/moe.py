@@ -1,0 +1,128 @@
+"""ERNIE-3.0-style MoE transformer (BASELINE configs[4]).
+
+Parity target: ERNIE MoE assembled from fleet TP layers + the
+incubate.distributed MoELayer — alternating dense / MoE FFN blocks.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..incubate.distributed.models.moe import MoELayer
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.manipulation import reshape
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["ErnieMoEConfig", "ErnieMoEForCausalLM", "ernie_moe_tiny"]
+
+
+class ErnieMoEConfig:
+    def __init__(self, vocab_size=30000, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, num_experts=8,
+                 moe_every=2, top_k=2, capacity_factor=1.2,
+                 max_position=2048, dropout=0.1, aux_loss_coeff=0.01,
+                 gate="gshard"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.moe_every = moe_every
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.max_position = max_position
+        self.dropout = dropout
+        self.aux_loss_coeff = aux_loss_coeff
+        self.gate = gate
+
+
+class _SelfAttn(Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.qkv = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.proj = Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv(x), [b, s, 3, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                             qkv[:, :, 2], is_causal=True)
+        return self.proj(reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+
+class _Block(Layer):
+    def __init__(self, c, use_moe):
+        super().__init__()
+        self.ln1 = LayerNorm(c.hidden_size)
+        self.attn = _SelfAttn(c)
+        self.ln2 = LayerNorm(c.hidden_size)
+        self.use_moe = use_moe
+        if use_moe:
+            self.ffn = MoELayer(c.hidden_size, c.intermediate_size,
+                                num_experts=c.num_experts, gate=c.gate,
+                                top_k=c.top_k,
+                                capacity_factor=c.capacity_factor)
+        else:
+            self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+            self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        h = self.ln2(x)
+        if self.use_moe:
+            x = x + self.ffn(h)
+        else:
+            x = x + self.fc2(F.gelu(self.fc1(h)))
+        return x
+
+
+class ErnieMoEForCausalLM(Layer):
+    def __init__(self, c: ErnieMoEConfig):
+        super().__init__()
+        self.config = c
+        from ..nn.utils_ import ParamAttr
+        attr = ParamAttr(initializer=Normal(0.0, 0.02))
+        self.wte = Embedding(c.vocab_size, c.hidden_size, weight_attr=attr)
+        self.wpe = Embedding(c.max_position, c.hidden_size, weight_attr=attr)
+        self.blocks = LayerList([
+            _Block(c, use_moe=(i % c.moe_every == c.moe_every - 1))
+            for i in range(c.num_layers)])
+        self.ln_f = LayerNorm(c.hidden_size)
+
+    def aux_loss(self):
+        total = None
+        for blk in self.blocks:
+            if blk.use_moe and blk.ffn.gate.aux_loss is not None:
+                a = blk.ffn.gate.aux_loss
+                total = a if total is None else total + a
+        return total
+
+    def forward(self, input_ids, labels=None):
+        s = input_ids.shape[1]
+        from ..tensor.creation import arange
+        x = self.wte(input_ids) + self.wpe(arange(s, dtype="int32"))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        logits = F.linear(x, apply_op(lambda a: a.T, self.wte.weight))
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits, [-1, self.config.vocab_size]),
+                reshape(labels, [-1]))
+            aux = self.aux_loss()
+            if aux is not None:
+                loss = loss + self.config.aux_loss_coeff * aux
+            return loss
+        return logits
+
+
+def ernie_moe_tiny(**kw):
+    return ErnieMoEForCausalLM(ErnieMoEConfig(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=2,
+        intermediate_size=128, num_experts=4, max_position=128, **kw))

@@ -1,0 +1,115 @@
+"""Vision Transformer (BASELINE configs[3]: ViT-L/16 ImageNet).
+
+Parity target: ViT over this framework's layers — conv patch embed, learned
+positions, class token, pre-LN encoder. Patch embedding is a single strided
+conv → MXU; attention via F.scaled_dot_product_attention (Pallas on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.initializer import Normal, TruncatedNormal, Constant
+from ..nn.layer.common import Dropout, Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer, LayerList
+from ..nn.layer.norm import LayerNorm
+from ..tensor.manipulation import concat, reshape, transpose
+from ..tensor.tensor import Parameter, Tensor
+
+__all__ = ["ViT", "vit_b_16", "vit_l_16", "vit_tiny"]
+
+
+class MLP(Layer):
+    def __init__(self, dim, hidden, dropout=0.0):
+        super().__init__()
+        self.fc1 = Linear(dim, hidden)
+        self.fc2 = Linear(hidden, dim)
+        self.drop = Dropout(dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(F.gelu(self.fc1(x)))))
+
+
+class Attention(Layer):
+    def __init__(self, dim, heads, dropout=0.0):
+        super().__init__()
+        self.heads = heads
+        self.head_dim = dim // heads
+        self.qkv = Linear(dim, 3 * dim)
+        self.proj = Linear(dim, dim)
+        self.dropout = dropout
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv(x), [b, s, 3, self.heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout if self.training else 0.0)
+        return self.proj(reshape(out, [b, s, self.heads * self.head_dim]))
+
+
+class Block(Layer):
+    def __init__(self, dim, heads, mlp_ratio=4.0, dropout=0.0):
+        super().__init__()
+        self.norm1 = LayerNorm(dim, 1e-6)
+        self.attn = Attention(dim, heads, dropout)
+        self.norm2 = LayerNorm(dim, 1e-6)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class ViT(Layer):
+    def __init__(self, image_size=224, patch_size=16, dim=768, depth=12,
+                 heads=12, mlp_ratio=4.0, num_classes=1000, dropout=0.0,
+                 in_channels=3):
+        super().__init__()
+        self.patch_embed = Conv2D(in_channels, dim, patch_size,
+                                  stride=patch_size)
+        n_patches = (image_size // patch_size) ** 2
+        self.cls_token = Parameter(jnp.zeros((1, 1, dim), jnp.float32))
+        self.pos_embed = Parameter(
+            TruncatedNormal(std=0.02)((1, n_patches + 1, dim), jnp.float32))
+        self.pos_drop = Dropout(dropout)
+        self.blocks = LayerList([Block(dim, heads, mlp_ratio, dropout)
+                                 for _ in range(depth)])
+        self.norm = LayerNorm(dim, 1e-6)
+        self.head = Linear(dim, num_classes) if num_classes > 0 else None
+
+    def forward(self, x, labels=None):
+        b = x.shape[0]
+        x = self.patch_embed(x)                 # [B, D, H', W']
+        d = x.shape[1]
+        x = reshape(x, [b, d, -1])
+        x = transpose(x, [0, 2, 1])             # [B, N, D]
+        from ..tensor.manipulation import expand
+        cls = expand(self.cls_token, [b, 1, d])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        cls_out = x[:, 0]
+        if self.head is not None:
+            logits = self.head(cls_out)
+            if labels is not None:
+                return F.cross_entropy(logits, labels)
+            return logits
+        return cls_out
+
+
+def vit_b_16(num_classes=1000, **kw):
+    return ViT(dim=768, depth=12, heads=12, num_classes=num_classes, **kw)
+
+
+def vit_l_16(num_classes=1000, **kw):
+    return ViT(dim=1024, depth=24, heads=16, num_classes=num_classes, **kw)
+
+
+def vit_tiny(num_classes=10, image_size=32, patch_size=8, **kw):
+    return ViT(image_size=image_size, patch_size=patch_size, dim=64,
+               depth=2, heads=2, num_classes=num_classes, **kw)

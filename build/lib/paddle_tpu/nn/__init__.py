@@ -1,0 +1,13 @@
+"""paddle.nn namespace. Parity: python/paddle/nn/__init__.py."""
+from . import functional
+from . import initializer
+from .layer.layers import Layer, LayerList, ParameterList, Sequential
+from .layer.common import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .utils_ import ParamAttr

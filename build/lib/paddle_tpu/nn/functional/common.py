@@ -1,0 +1,266 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+
+Parity: python/paddle/nn/functional/common.py + input.py + extension bits.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.rng import next_key
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "label_smooth", "unfold", "fold",
+           "interpolate", "upsample", "bilinear", "cosine_similarity",
+           "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "zeropad2d",
+           "class_center_sample", "normalize"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b.  Weight layout [in, out] (paddle convention) — feeds the
+    MXU directly as a single jnp.dot; XLA fuses the bias add. Under amp O1 the
+    matmul runs in the amp dtype (bf16 on TPU)."""
+    from ...amp.auto_cast import cast_if_amp
+
+    if bias is None:
+        def f(a, w):
+            a, w = cast_if_amp("linear", a, w)
+            return jnp.matmul(a, w)
+        return apply_op(f, x, weight)
+
+    def f(a, w, b):
+        a, w = cast_if_amp("linear", a, w)
+        out = jnp.matmul(a, w)
+        return out + b.astype(out.dtype)
+    return apply_op(f, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda a: a * (1.0 - p), x)
+        return x
+    key = next_key()
+
+    def f(a):
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            mask_shape = tuple(s if i in [ax % a.ndim for ax in axes] else 1
+                               for i, s in enumerate(a.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply_op(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = next_key()
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        coef_a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        coef_b = -coef_a * p * alpha_p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+    return apply_op(f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    ids = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    def f(w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op(f, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    ids = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(ids, num_classes, dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply_op(f, label)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and len(paddings) == 4) else paddings
+    d = _pair(dilations)
+
+    def f(a):
+        n, c, h, w = a.shape
+        if len(p) == 2:
+            pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        else:
+            pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+        a = jnp.pad(a, pads)
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply_op(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    out_hw = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        H = out_hw[0] + 2 * p[0]
+        W = out_hw[1] + 2 * p[1]
+        oh = (H - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (W - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        out = jnp.zeros((n, c, H, W), a.dtype)
+        a_r = a.reshape(n, c, k[0], k[1], oh, ow)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                patch = a_r[:, :, i, j]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0],
+                             wj:wj + ow * s[1]:s[1]].add(patch)
+        return out[:, :, p[0]:H - p[0], p[1]:W - p[1]] if (p[0] or p[1]) else out
+    return apply_op(f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(a):
+        is_nchw = data_format.upper().startswith("NC")
+        spatial = a.shape[2:] if is_nchw else a.shape[1:-1]
+        if size is not None:
+            tgt = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                        for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(spatial)
+            tgt = tuple(int(dim * f_) for dim, f_ in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "trilinear": "trilinear", "bicubic": "cubic",
+                  "linear": "linear", "area": "linear"}[mode]
+        if is_nchw:
+            new_shape = a.shape[:2] + tgt
+        else:
+            new_shape = (a.shape[0],) + tgt + (a.shape[-1],)
+        return jax.image.resize(a, new_shape, method=method)
+    return apply_op(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    if bias is not None:
+        return apply_op(f, x1, x2, weight, bias)
+    return apply_op(f, x1, x2, weight)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply_op(f, x1, x2)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply_op(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return apply_op(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(n, c, h, w)
+    return apply_op(f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = padding
+
+    def f(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])))
+    return apply_op(f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_op(f, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    lab = label._data
+    uniq = jnp.unique(lab, size=min(num_samples, num_classes),
+                      fill_value=num_classes)
+    remap = jnp.searchsorted(uniq, lab)
+    return Tensor(remap), Tensor(uniq)

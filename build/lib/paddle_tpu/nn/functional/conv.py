@@ -1,0 +1,168 @@
+"""Convolutions over jax.lax.conv_general_dilated (XLA lowers these to the MXU).
+
+Parity: python/paddle/nn/functional/conv.py (conv1d/2d/3d + transpose).
+Weight layout [out_c, in_c/groups, *k] as in the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(i), int(i)) for i in p]
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    if len(p) == n and isinstance(p[0], (list, tuple)):
+        return [tuple(i) for i in p]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    chars = "DHW"[3 - n:]
+    if data_format.upper().startswith("NC"):
+        lhs_spec = "NC" + chars
+    else:
+        lhs_spec = "N" + chars + "C"
+    dn = (lhs_spec, "OI" + chars, lhs_spec)
+
+    def f(a, w, *b):
+        from ...amp.auto_cast import cast_if_amp
+        a, w = cast_if_amp("conv", a, w)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            bshape = [1] * out.ndim
+            c_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            bshape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bshape)
+        return out
+    if bias is not None:
+        return apply_op(f, x, weight, bias)
+    return apply_op(f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCH" if data_format.upper() in ("NCL", "NCH") else "NHC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    chars = "DHW"[3 - n:]
+    lhs_spec = ("NC" + chars) if data_format.upper().startswith("NC") else ("N" + chars + "C")
+    dn = (lhs_spec, "IO" + chars, lhs_spec)
+
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        p = _padding(padding, n)
+        # transposed conv padding: XLA wants (k-1)*d - p low/high with output_padding on high
+        pads = []
+        for i in range(n):
+            k = weight.shape[2 + i]
+            eff = (k - 1) * dil[i]
+            pads.append((eff - p[i][0], eff - p[i][1] + opad[i]))
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=1)
+        if b:
+            bshape = [1] * out.ndim
+            c_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+            bshape[c_axis] = b[0].shape[0]
+            out = out + b[0].reshape(bshape)
+        return out
+
+    # weight layout [in_c, out_c/groups, *k]; flip spatial for transpose conv
+    def prep(w):
+        return jnp.flip(w, axis=tuple(range(2, 2 + n)))
+
+    if groups > 1:
+        def fg(a, w, *b):
+            a_gs = jnp.split(a, groups, axis=1)
+            w_gs = jnp.split(w, groups, axis=0)
+            outs = []
+            for ag, wg in zip(a_gs, w_gs):
+                outs.append(jax.lax.conv_general_dilated(
+                    ag, prep(wg), window_strides=(1,) * n, padding=pads,
+                    lhs_dilation=strides, rhs_dilation=dil,
+                    dimension_numbers=dn))
+            out = jnp.concatenate(outs, axis=1)
+            if b:
+                bshape = [1] * out.ndim
+                bshape[1] = b[0].shape[0]
+                out = out + b[0].reshape(bshape)
+            return out
+        if bias is not None:
+            return apply_op(fg, x, weight, bias)
+        return apply_op(fg, x, weight)
+
+    def f2(a, w, *b):
+        return f(a, prep(w), *b)
+    if bias is not None:
+        return apply_op(f2, x, weight, bias)
+    return apply_op(f2, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, "NCH")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)

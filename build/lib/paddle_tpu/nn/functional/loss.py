@@ -1,0 +1,244 @@
+"""Loss functionals. Parity: python/paddle/nn/functional/loss.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "nll_loss", "l1_loss",
+           "mse_loss", "smooth_l1_loss", "kl_div", "margin_ranking_loss",
+           "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss",
+           "triplet_margin_loss", "log_loss", "square_error_cost",
+           "sigmoid_focal_loss"]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def core(logits, *w):
+        lg = logits.astype(jnp.float32)
+        if use_softmax:
+            logp = jax.nn.log_softmax(lg, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(lg, 1e-15, None))
+        n_class = logp.shape[axis]
+        if soft_label:
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / n_class
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            ids = lab
+            if ids.ndim == logp.ndim:
+                ids = jnp.squeeze(ids, axis=axis)
+            onehot = jax.nn.one_hot(ids, n_class, dtype=logp.dtype, axis=axis)
+            if label_smoothing > 0:
+                onehot = (1 - label_smoothing) * onehot + label_smoothing / n_class
+            loss = -jnp.sum(onehot * logp, axis=axis)
+            valid = (ids != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], jnp.clip(ids, 0, n_class - 1), axis=0)
+                loss = loss * wt
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, wt, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(loss.dtype))
+                return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op(core, input, weight)
+    return apply_op(core, input)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from .activation import softmax as _sm
+        return loss, _sm(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def core(p, t, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(core, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    pw = pos_weight._data if isinstance(pos_weight, Tensor) else pos_weight
+
+    def core(z, t, *w):
+        mx = jnp.maximum(z, 0)
+        loss = mx - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = jax.nn.log_sigmoid(z)
+            lognegsig = jax.nn.log_sigmoid(-z)
+            loss = -(pw * t * logsig + (1 - t) * lognegsig)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([weight] if weight is not None else [])
+    return apply_op(core, *args)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def core(logp, *w):
+        n_class = logp.shape[1]
+        onehot = jax.nn.one_hot(lab, n_class, dtype=logp.dtype, axis=1)
+        loss = -jnp.sum(onehot * logp, axis=1)
+        valid = lab != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lab, 0, n_class - 1))
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, wt, 0.0))
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+    if weight is not None:
+        return apply_op(core, input, weight)
+    return apply_op(core, input)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def core(a, b):
+        d = a - b
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta,
+                         jnp.abs(d) - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op(core, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def core(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.clip(t, 1e-12, None)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(core, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def core(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return apply_op(core, input, other, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def core(a, b, t):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) *
+                                    jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op(core, input1, input2, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def core(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op(core, input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def core(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p + epsilon, axis=-1) ** (1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op(core, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def core(p, t):
+        return -(t * jnp.log(p + epsilon) + (1 - t) * jnp.log(1 - p + epsilon))
+    return apply_op(core, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def core(z, t, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    if normalizer is not None:
+        return apply_op(core, logit, label, normalizer)
+    return apply_op(core, logit, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via jax's optax-style forward algorithm (reference composite)."""
+    lp = log_probs._data  # [T, B, C] paddle layout
+    lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+    il = input_lengths._data if isinstance(input_lengths, Tensor) else jnp.asarray(input_lengths)
+    ll = label_lengths._data if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths)
+
+    def core(lp_arr):
+        import optax
+        # optax expects [B, T, C] logits and [B, N] labels with paddings
+        logits = jnp.swapaxes(lp_arr, 0, 1)
+        B, T, C = logits.shape
+        logit_pad = (jnp.arange(T)[None, :] >= il[:, None]).astype(jnp.float32)
+        N = lab.shape[1]
+        label_pad = (jnp.arange(N)[None, :] >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                                 blank_id=blank)
+        return _reduce(per_seq, reduction)
+    return apply_op(core, log_probs)

@@ -1,0 +1,153 @@
+"""Pooling functionals over lax.reduce_window. Parity: nn/functional/pooling.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+def _pool(x, kernel, stride, padding, n, op, ceil_mode=False,
+          exclusive=True, data_format="NCHW"):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_same = padding.upper() == "SAME"
+        p = None
+    else:
+        pad_same = False
+        p = _tuple(padding, n) if not isinstance(padding, (list, tuple)) or \
+            len(padding) == n else tuple(padding)
+        if isinstance(p[0], (list, tuple)):
+            p = tuple(tuple(i) for i in p)
+        else:
+            p = tuple((i, i) for i in p)
+    is_nc = data_format.upper().startswith("NC")
+
+    def f(a):
+        nd = a.ndim
+        if is_nc:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pads = ((0, 0), (0, 0)) + (p if p else ((0, 0),) * n)
+        else:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = ((0, 0),) + (p if p else ((0, 0),) * n) + ((0, 0),)
+        if pad_same:
+            pads = "SAME"
+        if op == "max":
+            init = -jnp.inf
+            out = jax.lax.reduce_window(a, init, jax.lax.max, window, strides,
+                                        pads)
+            return out
+        # avg
+        out = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                    window, strides, pads)
+        if exclusive and not pad_same and p is not None and any(
+                pi != (0, 0) for pi in (p or ())):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pads)
+            return out / counts
+        denom = 1
+        for kk in k:
+            denom *= kk
+        return out / denom
+    return apply_op(f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode,
+                 exclusive, "NCH")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode,
+                 exclusive, data_format)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode,
+                 data_format="NCH")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                 data_format=data_format)
+
+
+def _adaptive(x, output_size, n, op):
+    out_sz = _tuple(output_size, n)
+
+    def f(a):
+        spatial = a.shape[2:]
+        res = a
+        # decompose into per-axis adaptive windows
+        for i, (dim, osz) in enumerate(zip(spatial, out_sz)):
+            ax = 2 + i
+            starts = (jnp.arange(osz) * dim) // osz
+            ends = ((jnp.arange(osz) + 1) * dim + osz - 1) // osz
+            segs = []
+            for j in range(osz):
+                sl = jax.lax.slice_in_dim(res, int(starts[j]), int(ends[j]),
+                                          axis=ax)
+                red = jnp.max(sl, axis=ax, keepdims=True) if op == "max" else \
+                    jnp.mean(sl, axis=ax, keepdims=True)
+                segs.append(red)
+            res = jnp.concatenate(segs, axis=ax)
+        return res
+    return apply_op(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max")

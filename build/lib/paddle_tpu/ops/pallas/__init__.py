@@ -1,0 +1,16 @@
+"""TPU Pallas kernel library — the performance core.
+
+Parity target: the reference's GPU kernel library (paddle/phi/kernels/gpu/,
+paddle/fluid/operators/fused/) re-designed as TPU Mosaic kernels:
+
+  flash_attention   — flash_attn_kernel.cu :: FlashAttnKernel
+  layer_norm        — layer_norm_kernel.cu :: LayerNormKernel
+  decode_attention  — fused_multi_transformer_op.cu (KV-cache decode path; built in a later milestone this round)
+
+Each module exposes ``is_supported(...)`` so functional wrappers can fall
+back to XLA composites off-TPU or for unsupported configs.  Kernels run in
+interpret mode automatically when the default backend is CPU, which is how
+the unit tests exercise them without a TPU.
+"""
+from . import flash_attention  # noqa: F401
+from . import layer_norm  # noqa: F401

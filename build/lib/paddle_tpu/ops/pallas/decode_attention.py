@@ -1,0 +1,159 @@
+"""Flash-decode attention against a KV cache, as a TPU Pallas kernel.
+
+Capability parity: the attention inner loop of
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu ::
+FusedMultiTransformerOp (masked decode attention over the growing KV cache,
+cuBLASLt + fmha_ref.h in the reference). NOT a port: this is the
+online-softmax flash layout for TPU — the query tile (decode: a handful of
+rows, padded to the 8-row sublane minimum) stays resident in VMEM while KV
+cache blocks stream through, with per-batch valid-length masking read from
+SMEM so one compiled kernel serves every step of the autoregressive loop
+(static shapes: cache is a fixed ring buffer, the length is data).
+
+q: [B, Sq, H, D] (Sq small — 1 for greedy decode), cache: [B, Smax, Hk, D]
+(GQA: Hk | H), cache_lens: [B] int32 valid prefix lengths. New tokens at
+positions cache_lens..cache_lens+Sq-1 attend causally among themselves and
+fully to the cache prefix. Forward-only (inference).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attention", "is_supported"]
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def is_supported(q_shape, cache_shape, dtype) -> bool:
+    if len(q_shape) != 4 or len(cache_shape) != 4:
+        return False
+    if q_shape[-1] > 256 or q_shape[1] > 128:
+        return False
+    if q_shape[2] % cache_shape[2] != 0:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
+            *, scale, sq, bq, bk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]   # cache prefix length for this batch
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    # skip blocks entirely past the last attendable position
+    run = k_start < n_valid + sq
+
+    @pl.when(run)
+    def _():
+        # dots in input dtype (bf16 MXU full rate), f32 accumulation/softmax
+        q = q_ref[0, 0]                                # [bq, d]
+        k = k_ref[0, 0]                                # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        # row r is the token at global position n_valid + r: attends the
+        # prefix (cols < n_valid) and itself/earlier new tokens (causal)
+        mask = (rows < sq) & (cols <= n_valid + rows)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:] = m_new
+        v = v_ref[0, 0]
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, cache_lens, scale=None):
+    """Returns [B, Sq, H, D] attention of the new queries over cache + self.
+
+    The caches hold the prefix in positions [0, cache_lens[b]) and must
+    already contain the new tokens' K/V at positions
+    [cache_lens[b], cache_lens[b] + Sq) (standard write-then-attend decode
+    step order).
+    """
+    qt = jnp.swapaxes(q, 1, 2)                       # [B, H, Sq, D]
+    kt = jnp.swapaxes(k_cache, 1, 2)                 # [B, Hk, Smax, D]
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    return jnp.swapaxes(
+        decode_attention_bhsd(qt, kt, vt, cache_lens, scale), 1, 2)
+
+
+def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
+    """Same as decode_attention but in kernel layout [B, H, S, D] in AND
+    out — the compiled multi-layer decode loop stores its KV cache in this
+    layout so no per-step full-cache transpose is materialized."""
+    b, h, sq, d = qt.shape
+    smax = kt.shape[2]
+    hk = kt.shape[1]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    # in-kernel dots run in the operand dtype: harmonize a mixed-precision
+    # cache with the query dtype (bf16 q + f32 cache was accepted before
+    # the bf16-dot change and must keep working)
+    if kt.dtype != qt.dtype:
+        kt = kt.astype(qt.dtype)
+    if vt.dtype != qt.dtype:
+        vt = vt.astype(qt.dtype)
+
+    bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
+    bk = min(256, smax) if smax % 256 == 0 or smax < 256 else 128
+    sk_p = math.ceil(smax / bk) * bk
+    if sk_p != smax:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, sk_p - smax), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, sk_p - smax), (0, 0)))
+    if bq != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
+
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    grid = (b, h, sk_p // bk)
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=float(scale), sq=sq, bq=bq, bk=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, g=group: (b_, h_ // g, j, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, g=group: (b_, h_ // g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), qt.dtype),
+        interpret=_interpret(),
+    )(lens, qt, kt, vt)
+    return out[:, :, :sq]

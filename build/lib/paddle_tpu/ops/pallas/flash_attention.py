@@ -1,0 +1,563 @@
+"""Flash attention as a TPU Pallas (Mosaic) kernel.
+
+Capability parity: paddle/phi/kernels/gpu/flash_attn_kernel.cu ::
+FlashAttnKernel / flash_attn_grad_kernel.cu (FA-2 wrapper over
+third_party/flashattn).  This is NOT a port of that CUDA: it is the
+blockwise online-softmax algorithm laid out for the TPU memory hierarchy —
+Q/K/V tiles staged in VMEM, the S = QK^T and P·V contractions on the MXU in
+the INPUT dtype (bf16 runs at full MXU rate) with fp32 accumulation, the
+softmax math and running stats (m, l) in fp32 VMEM scratch carried across
+the KV-block grid dimension.
+
+Layout convention follows the reference flash_attn API: [batch, seq,
+num_heads, head_dim]; the wrapper transposes to [B, H, S, D] so the kernel
+works on (seq, head_dim) tiles (last dim = lanes).
+
+Supports: causal masking, GQA/MQA (kv_heads divides q_heads; realized in the
+BlockSpec index_map — zero-copy), bf16/f32 inputs (dots in input dtype,
+fp32 accumulate + softmax), seq
+lengths not divisible by the block size (masked tail blocks).  Backward is
+the standard two-kernel split: dKV (grid over KV blocks, scan Q) and dQ
+(grid over Q blocks, scan KV), with delta = rowsum(dO * O) precomputed.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "is_supported"]
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def is_supported(q_shape, dtype) -> bool:
+    """Wrapper-level gate: rank-4 [B,S,H,D], supported dtype, head_dim ≤ 256."""
+    if len(q_shape) != 4:
+        return False
+    d = q_shape[-1]
+    if d > 256:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _block_sizes(sq: int, sk: int):
+    """512-wide tiles: the [bq,d]x[d,bk] and [bq,bk]x[bk,d] dots must be
+    large enough to fill the MXU pipeline — 128x128 tiles measure ~5-9
+    TFLOP/s on v5e while 512x512 sustain >10x that. VMEM footprint per
+    program stays ~2-3 MB (<< the ~16 MB/core budget)."""
+    def pick(n, cap):
+        return min(cap, max(8, 1 << (n - 1).bit_length() if n < cap else cap))
+
+    import os
+
+    def cap_from_env(var, default):
+        # tuning knob: clamp to [8, 4096] and round down to a power of two
+        # so a bad value degrades to a valid Mosaic block, never a crash
+        try:
+            v = int(os.environ.get(var, default))
+        except ValueError:
+            v = default
+        v = min(max(v, 8), 4096)
+        return 1 << (v.bit_length() - 1)
+
+    return (pick(sq, cap_from_env("PADDLE_TPU_FLASH_BQ", 512)),
+            pick(sk, cap_from_env("PADDLE_TPU_FLASH_BK", 512)))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _drop_tile(seed_ref, bi, hi, qi, ki, bq, bk, dropout_p):
+    """Scaled keep multiplier generated in-kernel (TPU hardware PRNG, zero
+    HBM traffic); seeded per (call, batch, head, q-block, k-block) so the
+    backward kernels regenerate the identical mask. Mosaic takes at most 2
+    seed words — fold the block coordinates into one."""
+    nh = pl.num_programs(1)
+    # q/k block counts differ between the three kernels' grids, but the
+    # (qi, ki) pair itself is kernel-invariant; fold with fixed strides
+    # large enough for any block count
+    tile_id = ((bi * nh + hi) * 4096 + qi) * 4096 + ki
+    pltpu.prng_seed(seed_ref[0], tile_id)
+    bits = pltpu.prng_random_bits((bq, bk)).astype(jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return jnp.where(bits >= thresh, 1.0 / (1.0 - dropout_p), 0.0)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, sq, sk, bq, bk,
+                drop_mode=0, dropout_p=0.0):
+    # drop_mode: 0 = no dropout, 1 = mask input (interpret), 2 = in-kernel
+    # PRNG (TPU). Mode 1/2 append dmask / SMEM seed to the inputs.
+    if drop_mode == 1:
+        dmask_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        dmask_ref = None
+    else:
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        dmask_ref = seed_ref = None
+    # Causal uses bottom-right alignment (FA2 convention): row i attends
+    # key j iff j <= i + sk - sq.
+    offset = sk - sq
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+
+    # Causal: skip blocks strictly above the (aligned) diagonal entirely.
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        # dots run in the input dtype (bf16 MXU full rate) with f32
+        # accumulation; only the softmax math is f32
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk] f32
+
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = cols < sk                      # key-padding tail
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:]                                   # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                             # [bq, bk]
+        p = jnp.where(mask, p, 0.0)
+
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:] = m_new
+        # dropout on the softmax probs (post-normalization semantics: the
+        # l denominator above uses the raw p)
+        if dmask_ref is not None:
+            p = p * dmask_ref[0, 0]
+        elif seed_ref is not None:
+            p = p * _drop_tile(seed_ref, pl.program_id(0), pl.program_id(1),
+                               qi, ki, bq, bk, dropout_p)
+        v = v_ref[0, 0]                                    # [bk, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)     # padded q rows: garbage-free
+        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_sc[:] + jnp.log(l_safe)      # [bq, 1]
+
+
+def _fwd(q, k, v, drop=None, *, causal, scale, bq, bk):
+    """q,k,v: [B,H,S,D] (kv may have fewer heads for GQA). Returns (o, lse).
+    drop: None, ('mask', dmask [B,H,Sq_p,Sk_p] f32) or ('prng', seed, p)."""
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk
+    sq_p = math.ceil(sq / bq) * bq
+    sk_p = math.ceil(k.shape[2] / bk) * bk
+    sk = k.shape[2]
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+
+    grid = (b, h, sq_p // bq, sk_p // bk)
+    drop_mode = 0 if drop is None else (1 if drop[0] == "mask" else 2)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, sq=sq, sk=sk, bq=bq, bk=bk,
+        drop_mode=drop_mode,
+        dropout_p=drop[2] if drop_mode == 2 else 0.0)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0)),
+    ]
+    args = [q, k, v]
+    if drop_mode == 1:
+        in_specs.append(pl.BlockSpec((1, 1, bq, bk),
+                                     lambda b_, h_, i, j: (b_, h_, i, j)))
+        args.append(drop[1])
+    elif drop_mode == 2:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.reshape(drop[1].astype(jnp.int32), (1,)))
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return o[:, :, :sq], lse[:, :, :sq]        # lse: [B, H, Sq, 1]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    *rest, scale, causal, sq, sk, bq, bk, drop_mode=0,
+                    dropout_p=0.0):
+    if drop_mode == 1:
+        dmask_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        dmask_ref = None
+    else:
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
+        dmask_ref = seed_ref = None
+    offset = sk - sq
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]                                   # [bq, d]
+        k = k_ref[0, 0]                                   # [bk, d]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                               # [bq, 1]
+        delta = delta_ref[0, 0]                           # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (cols < sk) & (rows < sq)
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)        # [bq, bk] f32
+
+        if dmask_ref is not None:
+            dm = dmask_ref[0, 0]
+        elif seed_ref is not None:
+            # same (b, h, q-block, k-block) seeding as the forward kernel
+            dm = _drop_tile(seed_ref, pl.program_id(0), pl.program_id(1),
+                            qi, ki, bq, bk, dropout_p)
+        else:
+            dm = None
+        # dv += (D∘P)^T dO
+        pd = p * dm if dm is not None else p
+        dv_sc[:] += jax.lax.dot_general(
+            pd.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = P * (D∘(dO V^T) - delta) * scale   (delta = rowsum(dO∘O)
+        # absorbs the dropout mask exactly — see derivation in _flash_bwd)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dm is not None:
+            dp = dp * dm
+        ds = p * (dp - delta) * scale
+        # dk += dS^T Q
+        dk_sc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   *rest, scale, causal, sq, sk, bq, bk, drop_mode=0,
+                   dropout_p=0.0):
+    if drop_mode == 1:
+        dmask_ref, dq_ref, dq_sc = rest
+        seed_ref = None
+    elif drop_mode == 2:
+        seed_ref, dq_ref, dq_sc = rest
+        dmask_ref = None
+    else:
+        dq_ref, dq_sc = rest
+        dmask_ref = seed_ref = None
+    offset = sk - sq
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True
+    if causal:
+        run = q_start + bq - 1 + offset >= k_start
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                               # [bq, 1]
+        delta = delta_ref[0, 0]                           # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = (cols < sk) & (rows < sq)
+        if causal:
+            mask = mask & (cols <= rows + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dmask_ref is not None:
+            dp = dp * dmask_ref[0, 0]
+        elif seed_ref is not None:
+            dp = dp * _drop_tile(seed_ref, pl.program_id(0),
+                                 pl.program_id(1), qi, ki, bq, bk, dropout_p)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, drop=None, *, causal, scale, bq, bk):
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk
+    sk = k.shape[2]
+    sq_p = math.ceil(sq / bq) * bq
+    sk_p = math.ceil(sk / bk) * bk
+    drop_mode = 0 if drop is None else (1 if drop[0] == "mask" else 2)
+    drop_p = drop[2] if drop_mode == 2 else 0.0
+
+    def drop_arg():
+        if drop_mode == 1:
+            return drop[1]
+        return jnp.reshape(drop[1].astype(jnp.int32), (1,))
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [B, H, Sq, 1]
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))) \
+            if sq_p != sq else x
+
+    def padk(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))) \
+            if sk_p != sk else x
+
+    q_, do_ = padq(q), padq(do)
+    k_, v_ = padk(k), padk(v)
+    lse_, delta_ = padq(lse), padq(delta)
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, j, i, g=group: (b_, h_ // g, j, 0))
+    rowspec = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+
+    # dK/dV: one [bk,d] accumulator pair per KV block; Q scanned innermost.
+    # GQA: compute per-Q-head dk/dv (shape [B,H,...]) and segment-sum to
+    # [B,Hk,...] outside the kernel — XLA turns that into a cheap reshape-sum.
+    dkv_in = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    dkv_args = [q_, k_, v_, do_, lse_, delta_]
+    if drop_mode == 1:
+        dkv_in.append(pl.BlockSpec((1, 1, bq, bk),
+                                   lambda b_, h_, j, i: (b_, h_, i, j)))
+        dkv_args.append(drop_arg())
+    elif drop_mode == 2:
+        dkv_in.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_args.append(drop_arg())
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          sq=sq, sk=sk, bq=bq, bk=bk, drop_mode=drop_mode,
+                          dropout_p=drop_p),
+        grid=(b, h, sk_p // bk, sq_p // bq),
+        in_specs=dkv_in,
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*dkv_args)
+
+    qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec2 = pl.BlockSpec((1, 1, bk, d),
+                          lambda b_, h_, i, j, g=group: (b_, h_ // g, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, bq, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq_in = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
+    dq_args = [q_, k_, v_, do_, lse_, delta_]
+    if drop_mode == 1:
+        dq_in.append(pl.BlockSpec((1, 1, bq, bk),
+                                  lambda b_, h_, i, j: (b_, h_, i, j)))
+        dq_args.append(drop_arg())
+    elif drop_mode == 2:
+        dq_in.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_args.append(drop_arg())
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          sq=sq, sk=sk, bq=bq, bk=bk, drop_mode=drop_mode,
+                          dropout_p=drop_p),
+        grid=(b, h, sq_p // bq, sk_p // bk),
+        in_specs=dq_in,
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*dq_args)
+
+    dq = dq[:, :, :sq]
+    dk = dk[:, :, :sk]
+    dv = dv[:, :, :sk]
+    if group > 1:
+        dk = dk.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, group, sk, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API (custom_vjp; [B, S, H, D] layout like the reference flash_attn)
+# ---------------------------------------------------------------------------
+
+def _dropout_mask(seed, shape, dropout_p):
+    """Scaled keep-mask [B,H,Sq_p,Sk_p] regenerated identically fwd/bwd from
+    the int32 seed — the residual is the seed, not the O(S^2) mask (the
+    philox-offset recompute trick of the reference FA2, done with the JAX
+    PRNG at the XLA level)."""
+    key = jax.random.PRNGKey(seed)
+    keep = jax.random.bernoulli(key, 1.0 - dropout_p, shape)
+    return keep.astype(jnp.float32) / (1.0 - dropout_p)
+
+
+def _padded_sizes(sq, sk):
+    bq, bk = _block_sizes(sq, sk)
+    return bq, bk, math.ceil(sq / bq) * bq, math.ceil(sk / bk) * bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, seed, causal, scale, dropout_p):
+    o, _ = _core_fwd(q, k, v, seed, causal, scale, dropout_p)
+    return o
+
+
+def _make_drop(q, k, seed, dropout_p):
+    """TPU: in-kernel PRNG (zero HBM mask traffic); interpret: explicit
+    seed-regenerated mask array (prng_* primitives have no CPU lowering)."""
+    if dropout_p <= 0.0:
+        return None
+    if not _interpret():
+        return ("prng", seed, dropout_p)
+    bq, bk, sq_p, sk_p = _padded_sizes(q.shape[2], k.shape[2])
+    return ("mask",
+            _dropout_mask(seed, (q.shape[0], q.shape[1], sq_p, sk_p),
+                          dropout_p))
+
+
+def _core_fwd(q, k, v, seed, causal, scale, dropout_p):
+    bq, bk, _, _ = _padded_sizes(q.shape[2], k.shape[2])
+    drop = _make_drop(q, k, seed, dropout_p)
+    return _fwd(q, k, v, drop, causal=causal, scale=scale, bq=bq, bk=bk)
+
+
+def _flash_fwd(q, k, v, seed, causal, scale, dropout_p):
+    o, lse = _core_fwd(q, k, v, seed, causal, scale, dropout_p)
+    return o, (q, k, v, o, lse, seed)
+
+
+def _flash_bwd(causal, scale, dropout_p, res, g):
+    q, k, v, o, lse, seed = res
+    bq, bk, _, _ = _padded_sizes(q.shape[2], k.shape[2])
+    drop = _make_drop(q, k, seed, dropout_p)
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, drop, causal=causal, scale=scale,
+                      bq=bq, bk=bk)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, dropout_p=0.0,
+                    dropout_seed=None):
+    """q,k,v: [batch, seq, heads, head_dim] (kv heads may divide q heads).
+
+    Returns [batch, seq, heads, head_dim]; differentiable (custom VJP with
+    flash backward kernels). dropout_p > 0 applies attention-prob dropout
+    (upscaled) with a seed-regenerated mask — pass dropout_seed (int32
+    scalar, traced ok) for reproducibility.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads "
+            f"({k.shape[2]}) for GQA flash attention")
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((), jnp.int32)
+    o = _flash(qt, kt, vt, dropout_seed, bool(causal), float(scale),
+               float(dropout_p))
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,7 @@
+"""paddle.optimizer namespace."""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
+                        Adadelta, RMSProp, Lamb)
+# single source of truth for regularizers (paddle.regularizer); re-exported
+# here for the legacy paddle.optimizer.L1Decay/L2Decay spelling
+from ..regularizer import L1Decay, L2Decay

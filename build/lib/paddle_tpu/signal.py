@@ -1,0 +1,103 @@
+"""paddle.signal. Parity: python/paddle/signal.py :: stft/istft (framing via
+jax.scipy.signal; XLA fuses the window/FFT pipeline)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.signal as jsig
+
+from .tensor.tensor import Tensor, apply_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def f(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]                       # [..., num, frame]
+        return jnp.moveaxis(framed, (-2, -1), (axis - 1 if axis < 0 else axis,
+                                               axis if axis < 0 else axis + 1))
+    return apply_op(f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1) if axis != -1 else a
+        *lead, num, frame_len = moved.shape
+        out_len = (num - 1) * hop_length + frame_len
+        out = jnp.zeros((*lead, out_len), moved.dtype)
+        for i in range(num):                 # static small loop; XLA unrolls
+            out = out.at[..., i * hop_length:i * hop_length + frame_len].add(
+                moved[..., i, :])
+        return jnp.moveaxis(out, -1, axis) if axis != -1 else out
+    return apply_op(f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, *w):
+        sig = a
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(pad, pad)],
+                          mode=pad_mode)
+        win = w[0] if w else jnp.ones(win_length)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = sig[..., idx] * win                    # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)               # [..., freq, num]
+    if window is not None:
+        return apply_op(f, x, window)
+    return apply_op(f, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, *w):
+        spec = jnp.swapaxes(a, -1, -2)                  # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        win = w[0] if w else jnp.ones(win_length)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        frames = frames * win
+        *lead, num, _ = frames.shape
+        out_len = (num - 1) * hop_length + n_fft
+        out = jnp.zeros((*lead, out_len), frames.dtype)
+        norm = jnp.zeros(out_len, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(win ** 2)
+        out = out / jnp.maximum(norm, 1e-8)
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    if window is not None:
+        return apply_op(f, x, window)
+    return apply_op(f, x)

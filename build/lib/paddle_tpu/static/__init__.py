@@ -1,0 +1,283 @@
+"""paddle.static — Program/Executor with real graph capture + replay.
+
+Parity: python/paddle/static/ (Program, program_guard, data, Executor;
+the executing engine being paddle/fluid/framework/new_executor/ ::
+InterpreterCore). TPU-first: while static mode is on, every op executed
+through the tensor facade is ALSO recorded into the active Program as a
+(pure-fn, inputs, outputs) triple; `Executor.run(program, feed, fetch_list)`
+replays the recorded graph with the feeds substituted — the replay is the
+reference's instruction-list interpretation, except each "instruction" is a
+pure jnp closure and XLA performs the dependency analysis/scheduling when
+the replay is jitted. `Optimizer.minimize(loss)` captured during build
+re-runs backward+update on the replayed values each `run`, which is exactly
+the reference's appended backward+optimizer ops.
+
+Canonical flow (same code as the reference):
+    paddle.enable_static()
+    x = paddle.static.data("x", [None, 13])
+    y = model(x)                       # ops recorded into main program
+    loss = F.mse_loss(y, label); opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    out, = exe.run(feed={"x": arr, ...}, fetch_list=[loss])
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+from ..tensor.tensor import Tensor, _capture_hook, no_grad
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "Executor", "CompiledProgram",
+           "InputSpec", "data", "name_scope", "global_scope", "Scope"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class _OpRecord:
+    __slots__ = ("fn", "inputs", "output_ids")
+
+    def __init__(self, fn, inputs, output_ids):
+        self.fn = fn                # pure jnp closure
+        self.inputs = inputs        # list[Tensor] (live refs; params see
+        #                             their CURRENT values at replay)
+        self.output_ids = output_ids
+
+
+class Program:
+    """Recorded op graph (the reference's ProgramDesc, with jnp closures as
+    the op bodies)."""
+
+    def __init__(self):
+        self.ops: list[_OpRecord] = []
+        self.feed_holders: dict[int, str] = {}   # tensor uid -> feed name
+        self._feed_specs: dict[str, InputSpec] = {}
+        self._minimize_hooks: list = []          # (optimizer, loss_uid)
+        self.random_seed = 0
+
+    # ----------------------------------------------------------- build
+    def _record(self, fn, inputs, outputs):
+        self.ops.append(_OpRecord(fn, list(inputs),
+                                  [o._uid for o in outputs]))
+
+    def _add_feed(self, name, spec, placeholder):
+        self.feed_holders[placeholder._uid] = name
+        self._feed_specs[name] = spec
+
+    def _add_minimize(self, optimizer, loss):
+        self._minimize_hooks.append((optimizer, loss._uid))
+
+    # ----------------------------------------------------------- API parity
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.feed_holders = dict(self.feed_holders)
+        p._feed_specs = dict(self._feed_specs)
+        if not for_test:
+            p._minimize_hooks = list(self._minimize_hooks)
+        return p
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        from ..tensor.tensor import persistent_tensors, Parameter
+        return [t for t in persistent_tensors() if isinstance(t, Parameter)]
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, "
+                f"feeds={list(self._feed_specs)}, "
+                f"minimize={len(self._minimize_hooks)})")
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def _alias_capture_output(src: Tensor, dst: Tensor) -> None:
+    """Rewrite the last recorded op's output uid from ``src`` to ``dst``.
+
+    Tensor.__setitem__ during static capture records the scatter as an op
+    producing a fresh tensor; aliasing its output uid onto the assigned
+    tensor's uid makes replay treat it as an in-place update (later ops
+    that consume the target tensor read the scattered value from env)."""
+    ops = _main_program.ops
+    if ops and src._uid in ops[-1].output_ids:
+        ids = ops[-1].output_ids
+        ids[ids.index(src._uid)] = dst._uid
+
+
+def _install_capture():
+    """Called by paddle.enable_static(): record ops into the active main
+    program. paddle.disable_static() removes the hook."""
+    def hook(fn, inputs, outputs):
+        _main_program._record(fn, inputs, outputs)
+    _capture_hook[0] = hook
+
+
+def _remove_capture():
+    _capture_hook[0] = None
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    # re-point the capture hook at the new main program
+    if _capture_hook[0] is not None:
+        _install_capture()
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+        if _capture_hook[0] is not None:
+            _install_capture()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder: returns a Tensor of zeros (shape with None/-1 dims
+    filled as 1 for the build pass) registered as a feed target."""
+    spec = InputSpec(shape, dtype, name)
+    build_shape = [1 if (s is None or s == -1) else s for s in spec.shape]
+    t = Tensor(np.zeros(build_shape, dtype=np.dtype(dtype)),
+               stop_gradient=True)
+    t.name = name
+    _main_program._add_feed(name, spec, t)
+    return t
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class Executor:
+    """Replay engine. Parity: paddle.static.Executor / InterpreterCore."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        data_parallel = isinstance(program, CompiledProgram) and \
+            getattr(program, "_data_parallel", False)
+        program = program if isinstance(program, Program) else \
+            (program.program if isinstance(program, CompiledProgram)
+             else None) or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        # replay must not re-capture
+        saved_hook = _capture_hook[0]
+        _capture_hook[0] = None
+        try:
+            env: dict[int, Tensor] = {}
+            for uid, name in program.feed_holders.items():
+                if name in feed:
+                    v = feed[name]
+                    t = v if isinstance(v, Tensor) else \
+                        Tensor(np.asarray(v))
+                    if data_parallel:
+                        # static-dp pass: shard the feed's batch dim over
+                        # the hybrid mesh's data axes (the reference's
+                        # distributed-program rewrite feeds per-rank
+                        # slices; GSPMD runs the replayed ops SPMD)
+                        from ..parallel import shard_batch
+                        t = shard_batch(t)
+                    env[uid] = t
+            from ..tensor.tensor import apply_op
+            training = bool(program._minimize_hooks)
+            for op in program.ops:
+                ins = [env.get(t._uid, t) for t in op.inputs]
+                if training:
+                    outs = apply_op(op.fn, *ins)
+                else:
+                    with no_grad():
+                        outs = apply_op(op.fn, *ins)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                for uid, o in zip(op.output_ids, outs):
+                    env[uid] = o
+            for optimizer, loss_uid in program._minimize_hooks:
+                loss = env.get(loss_uid)
+                if loss is not None:
+                    loss.backward()
+                    optimizer.step()
+                    optimizer.clear_grad()
+            results = []
+            for f in fetch_list:
+                uid = f._uid if isinstance(f, Tensor) else None
+                out = env.get(uid, f if isinstance(f, Tensor) else None)
+                if out is None:
+                    results.append(None)
+                elif return_numpy:
+                    results.append(np.asarray(out._data))
+                else:
+                    results.append(out)
+            return results
+        finally:
+            _capture_hook[0] = saved_hook
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+        self._data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Parity: CompiledProgram.with_data_parallel — marks the program
+        for data-parallel execution; Executor.run then shards feeds over
+        the active hybrid mesh's data axes (fleet.init supplies the mesh)."""
+        self._data_parallel = True
+        return self

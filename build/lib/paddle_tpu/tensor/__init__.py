@@ -1,0 +1,39 @@
+"""Tensor package: ops + method attachment onto Tensor (paddle.Tensor.sum()...).
+
+Parity: python/paddle/tensor/__init__.py's monkey-patch of tensor methods.
+"""
+from .tensor import (Tensor, Parameter, no_grad, enable_grad, is_grad_enabled,
+                     set_grad_enabled, apply_op, clear_tape)
+from . import creation, math, manipulation, search, logic, random, stat, linalg
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+_METHOD_MODULES = (math, manipulation, search, logic, stat, linalg)
+_SKIP = {"broadcast_shape", "is_tensor", "einsum"}
+
+
+def _attach_methods():
+    for mod in _METHOD_MODULES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP or name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # creation-style helpers that make sense as methods
+    from .creation import clone as _clone  # noqa
+    for nm, fn in dict(
+        numel=lambda self: self.size,
+    ).items():
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)
+
+
+_attach_methods()

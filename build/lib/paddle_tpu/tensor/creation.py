@@ -1,0 +1,196 @@
+"""Creation ops. Parity: python/paddle/tensor/creation.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from .tensor import Tensor, Parameter, apply_op
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like",
+    "ones_like", "full_like", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "meshgrid", "assign",
+    "clone", "numel", "create_parameter", "tril_indices", "triu_indices",
+    "complex", "polar", "as_tensor",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dt = convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dt is not None:
+            arr = arr.astype(dt)
+        return Tensor(arr, stop_gradient=stop_gradient)
+    arr = jnp.asarray(np.asarray(data) if not hasattr(data, "dtype") else data)
+    if dt is not None:
+        arr = arr.astype(dt)
+    elif arr.dtype == jnp.float64:
+        arr = arr.astype(get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+tensor = to_tensor
+as_tensor = to_tensor
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), convert_dtype(dtype) or get_default_dtype()))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), convert_dtype(dtype) or get_default_dtype()))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dt = convert_dtype(dtype)
+    if dt is None:
+        dt = get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dt))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+
+        def f(v):
+            return base * (1 - jnp.eye(n, k=offset, dtype=x.dtype)) + jnp.diag(v, k=offset)
+        return apply_op(f, x)
+    return apply_op(lambda v: jnp.diag(v, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(src)
+        return output
+    return Tensor(src)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def complex(real, imag, name=None):
+    return apply_op(jax_complex, real, imag)
+
+
+def jax_complex(r, i):
+    return r + 1j * i
+
+
+def polar(abs_t, angle, name=None):
+    return apply_op(lambda a, th: a * jnp.exp(1j * th), abs_t, angle)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import Constant, XavierNormal
+    init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    if attr is not None and getattr(attr, "initializer", None) is not None \
+            and default_initializer is None:
+        init = attr.initializer
+    dt = convert_dtype(dtype) or get_default_dtype()
+    arr = init(_shape(shape), dt)
+    p = Parameter(arr, name=name or getattr(attr, "name", None), dtype=dt)
+    if attr is not None:
+        # carry ParamAttr knobs the optimizer consults (per-param
+        # regularizer precedence, lr scaling, trainability)
+        p.regularizer = getattr(attr, "regularizer", None)
+        if getattr(attr, "learning_rate", None) is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+        if getattr(attr, "trainable", True) is False:
+            p.stop_gradient = True
+    return p

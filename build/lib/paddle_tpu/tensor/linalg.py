@@ -1,0 +1,184 @@
+"""Linear algebra. Parity: python/paddle/tensor/linalg.py + paddle.linalg.*"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = ["norm", "cond", "cholesky", "cholesky_solve", "det", "slogdet",
+           "inv", "pinv", "matrix_power", "matrix_rank", "qr", "lu", "svd",
+           "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
+           "triangular_solve", "cross", "histogramdd", "t", "transpose_last"]
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" or p is None:
+            if axis is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.linalg.norm(a, ord=None if p is None else p, axis=axis,
+                                   keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=axis, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if axis is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        return jnp.linalg.norm(a, ord=p, axis=axis if not isinstance(axis, list)
+                               else tuple(axis), keepdims=keepdim)
+    return apply_op(f, x)
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x._data, p=p))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op(f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax_solve_tri(Lm, b, lower=True)
+        return jax_solve_tri(jnp.swapaxes(Lm, -1, -2), z, lower=False)
+    return apply_op(f, x, y)
+
+
+def jax_solve_tri(a, b, lower):
+    import jax.scipy.linalg as jsl
+    return jsl.solve_triangular(a, b, lower=lower)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    s, ld = jnp.linalg.slogdet(x._data)
+    return Tensor(jnp.stack([s, ld]))
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rcond=rcond,
+                                              hermitian=hermitian), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x._data, tol=tol))
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(x._data, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    lu_, piv = jsl.lu_factor(x._data)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv.astype(jnp.int32)), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_), Tensor(piv.astype(jnp.int32))
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(x._data)
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(x._data, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(x._data))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(x._data, UPLO=UPLO))
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+
+    def f(a, b):
+        return jsl.solve_triangular(a, b, lower=not upper,
+                                    trans=1 if transpose else 0,
+                                    unit_diagonal=unitriangular)
+    return apply_op(f, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1)
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, edges = jnp.histogramdd(x._data, bins=bins, range=ranges,
+                               density=density,
+                               weights=None if weights is None else weights._data)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x.clone()
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def transpose_last(x):
+    return t(x)
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def multi_dot(x, name=None):
+    """Optimal-order chained matmul over a list of tensors."""
+    return apply_op(lambda *arrs: jnp.linalg.multi_dot(arrs), *x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._data if isinstance(fweights, Tensor) else fweights
+    aw = aweights._data if isinstance(aweights, Tensor) else aweights
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0,
+                                      fweights=fw, aweights=aw), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+__all__ += ["svdvals", "multi_dot", "cov", "corrcoef"]

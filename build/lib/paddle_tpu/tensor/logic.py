@@ -1,0 +1,77 @@
+"""Logic ops. Parity: python/paddle/tensor/logic.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["equal", "not_equal", "less_than", "less_equal", "greater_than",
+           "greater_equal", "logical_and", "logical_or", "logical_xor",
+           "logical_not", "equal_all", "allclose", "isclose", "is_tensor",
+           "is_empty"]
+
+
+def _arr(v):
+    return v._data if isinstance(v, Tensor) else v
+
+
+def equal(x, y, name=None):
+    return Tensor(jnp.equal(_arr(x), _arr(y)))
+
+
+def not_equal(x, y, name=None):
+    return Tensor(jnp.not_equal(_arr(x), _arr(y)))
+
+
+def less_than(x, y, name=None):
+    return Tensor(jnp.less(_arr(x), _arr(y)))
+
+
+def less_equal(x, y, name=None):
+    return Tensor(jnp.less_equal(_arr(x), _arr(y)))
+
+
+def greater_than(x, y, name=None):
+    return Tensor(jnp.greater(_arr(x), _arr(y)))
+
+
+def greater_equal(x, y, name=None):
+    return Tensor(jnp.greater_equal(_arr(x), _arr(y)))
+
+
+def logical_and(x, y, out=None, name=None):
+    return Tensor(jnp.logical_and(_arr(x), _arr(y)))
+
+
+def logical_or(x, y, out=None, name=None):
+    return Tensor(jnp.logical_or(_arr(x), _arr(y)))
+
+
+def logical_xor(x, y, out=None, name=None):
+    return Tensor(jnp.logical_xor(_arr(x), _arr(y)))
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(_arr(x)))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_arr(x), _arr(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_arr(x), _arr(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_arr(x), _arr(y), rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))

@@ -1,0 +1,532 @@
+"""Shape/layout ops. Parity: python/paddle/tensor/manipulation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from .tensor import Tensor, apply_op
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
+    "split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
+    "flip", "roll", "rot90", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "unbind", "unstack",
+    "repeat_interleave", "masked_select", "masked_fill", "where", "pad",
+    "cast", "as_real", "as_complex", "tensordot", "unique",
+    "unique_consecutive", "tolist", "crop", "shard_index", "view", "view_as",
+]
+
+
+def _int_tuple(v):
+    if isinstance(v, Tensor):
+        v = v.numpy().tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(i.item() if isinstance(i, Tensor) else i) for i in v)
+
+
+def reshape(x, shape, name=None):
+    shp = _int_tuple(shape)
+    return apply_op(lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _int_tuple(shape))
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shp = x.shape
+    new = shp[:s] + [int(np.prod(shp[s:e + 1] or [1]))] + shp[e + 1:]
+    return reshape(x, new)
+
+
+def transpose(x, perm, name=None):
+    p = _int_tuple(perm)
+    return apply_op(lambda a: jnp.transpose(a, p), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _int_tuple(axis) if axis is not None else None
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        keep = [d for d in ax if a.shape[d] == 1]
+        return jnp.squeeze(a, axis=tuple(keep)) if keep else a
+    return apply_op(f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data = out._data
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _int_tuple(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def f(a):
+        out = a
+        for d in sorted(ax):
+            out = jnp.expand_dims(out, d)
+        return out
+    return apply_op(f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data = out._data
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *ts: jnp.concatenate(ts, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op(lambda *ts: jnp.stack(ts, axis=int(axis)), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(apply_op(
+            lambda a, o=off, s=sz: jax.lax.slice_in_dim(a, o, o + s, axis=ax), x))
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    rt = _int_tuple(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, rt), x)
+
+
+def expand(x, shape, name=None):
+    shp = list(_int_tuple(shape))
+    xs = x.shape
+    full = [xs[i - (len(shp) - len(xs))] if s == -1 else s
+            for i, s in enumerate(shp)]
+    return apply_op(lambda a: jnp.broadcast_to(a, tuple(full)), x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op(lambda a: jnp.broadcast_to(a, _int_tuple(shape)), x)
+
+
+def flip(x, axis, name=None):
+    ax = _int_tuple(axis)
+    return apply_op(lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def gather(x, index, axis=0, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda a: jnp.take(a, idx.reshape(-1) if idx.ndim else idx, axis=ax), x)
+
+
+def gather_nd(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ind]
+    return apply_op(f, x)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1)
+
+    def f(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].add(u)
+    return apply_op(f, x, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    shp = _int_tuple(shape)
+
+    def f(u):
+        z = jnp.zeros(shp, u.dtype)
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return z.at[ind].add(u)
+    return apply_op(f, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a, u):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ind].add(u)
+    return apply_op(f, x, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply_op(lambda a: jnp.take_along_axis(a, idx, axis=1), x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply_op(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def f(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) else jnp.full(idx.shape, v, a.dtype)
+        dims = list(range(a.ndim))
+        ind = []
+        for d in dims:
+            if d == axis:
+                ind.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                ind.append(jnp.broadcast_to(
+                    jnp.arange(a.shape[d]).reshape(shape), idx.shape))
+        ind = tuple(ind)
+        if reduce == "add":
+            return a.at[ind].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[ind].multiply(v)
+        return a.at[ind].set(v)
+    if isinstance(values, Tensor):
+        return apply_op(f, arr, values)
+    return apply_op(lambda a: f(a, values), arr)
+
+
+def slice(input, axes, starts, ends, name=None):
+    starts = _int_tuple(starts)
+    ends = _int_tuple(ends)
+
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            n = a.shape[ax]
+            s2 = max(s + n, 0) if s < 0 else min(s, n)
+            e2 = max(e + n, 0) if e < 0 else min(e, n)
+            out = jax.lax.slice_in_dim(out, s2, e2, axis=ax)
+        return out
+    return apply_op(f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, _int_tuple(starts), _int_tuple(ends), _int_tuple(strides)):
+            idx[ax] = jnp.s_[s:e:st]
+        return a[tuple(idx)]
+    return apply_op(f, x)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    return [apply_op(lambda a, i=i: jnp.take(a, i, axis=axis), input)
+            for i in range(n)]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply_op(lambda a: jnp.repeat(a, r, axis=axis), x)
+
+
+def masked_select(x, mask, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor(x._data[m])  # dynamic shape: not differentiable/jittable
+
+
+def masked_fill(x, mask, value, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    v = value.item() if isinstance(value, Tensor) else value
+    return apply_op(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), x)
+
+
+def where(condition, x=None, y=None, name=None):
+    c = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        nz = jnp.nonzero(c)
+        return tuple(Tensor(i) for i in nz)
+    if isinstance(x, Tensor) and isinstance(y, Tensor):
+        return apply_op(lambda a, b: jnp.where(c, a, b), x, y)
+    if isinstance(x, Tensor):
+        return apply_op(lambda a: jnp.where(c, a, y), x)
+    if isinstance(y, Tensor):
+        return apply_op(lambda b: jnp.where(c, x, b), y)
+    return Tensor(jnp.where(c, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x._data)
+    if as_tuple:
+        return tuple(Tensor(i) for i in nz)
+    return Tensor(jnp.stack(nz, axis=-1))
+
+
+__all__.append("nonzero")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = _int_tuple(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            widths = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to the trailing dims, reversed pairs
+            k = len(p) // 2
+            widths = [(0, 0)] * (nd - k)
+            if data_format.upper().startswith("NC") and len(p) in (2, 4, 6) and nd >= 3:
+                spatial = [(p[2 * i], p[2 * i + 1]) for i in range(k)]
+                widths = [(0, 0), (0, 0)] + spatial
+                widths += [(0, 0)] * (nd - len(widths))
+            else:
+                widths += [(p[2 * i], p[2 * i + 1]) for i in range(k)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply_op(f, x)
+
+
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = jnp.unique(x._data, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) \
+        if arr.ndim > 1 else arr[1:] != arr[:-1]
+    out = [Tensor(jnp.asarray(arr[keep]))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _int_tuple(shape)
+    off = _int_tuple(offsets) if offsets is not None else (0,) * x.ndim
+
+    def f(a):
+        sl = tuple(jnp.s_[o:o + s] for o, s in zip(off, shp))
+        return a[sl]
+    return apply_op(f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return apply_op(f, input)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-gather (paddle.take). mode: 'raise'/'wrap'/'clip'."""
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        i = idx
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        else:               # 'clip' (and 'raise' — no host check under jit)
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+    return apply_op(f, x)
+
+
+def msort(x, name=None):
+    return apply_op(lambda a: jnp.sort(a, axis=0), x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(int(offset))
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rng = jnp.arange(a.shape[-1])
+        r = rng + max(-int(offset), 0)
+        c = rng + max(int(offset), 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for pos in range(nd):
+            order.append(src.get(pos, None) if pos in src else next(it))
+        return jnp.transpose(out, order)
+    return apply_op(f, input)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (torch.Tensor.unfold semantics, which
+    paddle.unfold for tensors follows): returns windows stacked on a new
+    trailing dim."""
+    def f(a):
+        ax = int(axis) % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        def take_win(s):
+            return jax.lax.dynamic_slice_in_dim(a, s, size, axis=ax)
+        wins = jax.vmap(take_win)(starts)          # [n, ..., size, ...]
+        wins = jnp.moveaxis(wins, 0, ax)           # windows sit at `axis`
+        return jnp.moveaxis(wins, ax + 1, -1)      # window content last
+    return apply_op(f, x)
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a, v):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        vm = jnp.moveaxis(v, int(axis), 0)
+        out = moved.at[idx].add(vm.astype(moved.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def index_add_(x, index, axis, value, name=None):
+    out = index_add(x, index, axis, value)
+    x._data = out._data
+    return x
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    ids = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in indices)
+
+    def f(a, v):
+        ref = a.at[ids]
+        v = v.astype(a.dtype)
+        return ref.add(v) if accumulate else ref.set(v)
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    out = index_put(x, indices, value, accumulate)
+    x._data = out._data
+    return x
+
+
+__all__ += ["take", "msort", "diag_embed", "unfold", "index_add",
+            "index_add_", "index_put", "index_put_"]

@@ -1,0 +1,490 @@
+"""Math ops. Parity: python/paddle/tensor/math.py (~the paddle.* math surface).
+
+Every op is a thin differentiable wrapper over jnp via apply_op; XLA fuses the
+elementwise chains into surrounding matmuls on TPU, so there is no per-op
+kernel zoo to maintain (the reference's paddle/phi/kernels/gpu/ role is played
+by XLA codegen here).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__: list = []
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+
+
+def _unary(name, jfn):
+    def op(x, name=None, **kw):
+        return apply_op(jfn, x)
+    op.__name__ = name
+    _export(name, op)
+
+
+def _binary(name, jfn):
+    def op(x, y, name=None, **kw):
+        if isinstance(y, Tensor):
+            return apply_op(jfn, x, y)
+        return apply_op(lambda a: jfn(a, y), x)
+    op.__name__ = name
+    _export(name, op)
+
+
+for _n, _f in dict(
+    abs=jnp.abs, acos=jnp.arccos, acosh=jnp.arccosh, asin=jnp.arcsin,
+    asinh=jnp.arcsinh, atan=jnp.arctan, atanh=jnp.arctanh, ceil=jnp.ceil,
+    cos=jnp.cos, cosh=jnp.cosh, deg2rad=jnp.deg2rad, digamma=jax.scipy.special.digamma,
+    erf=jax.scipy.special.erf, erfinv=jax.scipy.special.erfinv, exp=jnp.exp,
+    expm1=jnp.expm1, floor=jnp.floor, frac=lambda x: x - jnp.trunc(x),
+    i0=jnp.i0, lgamma=jax.scipy.special.gammaln, log=jnp.log, log10=jnp.log10,
+    log1p=jnp.log1p, log2=jnp.log2, neg=jnp.negative, rad2deg=jnp.rad2deg,
+    reciprocal=jnp.reciprocal, round=jnp.round, rsqrt=jax.lax.rsqrt,
+    sign=jnp.sign, sgn=jnp.sign, sin=jnp.sin, sinh=jnp.sinh, sqrt=jnp.sqrt,
+    square=jnp.square, tan=jnp.tan, tanh=jnp.tanh, trunc=jnp.trunc,
+    angle=jnp.angle, conj=jnp.conj, real=jnp.real, imag=jnp.imag,
+    sigmoid=jax.nn.sigmoid, logit=jax.scipy.special.logit,
+).items():
+    _unary(_n, _f)
+
+for _n, _f in dict(
+    add=jnp.add, subtract=jnp.subtract, multiply=jnp.multiply,
+    divide=jnp.divide, floor_divide=jnp.floor_divide, mod=jnp.mod,
+    remainder=jnp.remainder, pow=jnp.power, atan2=jnp.arctan2,
+    fmax=jnp.fmax, fmin=jnp.fmin, maximum=jnp.maximum, minimum=jnp.minimum,
+    logaddexp=jnp.logaddexp, hypot=jnp.hypot, copysign=jnp.copysign,
+    nextafter=jnp.nextafter, ldexp=lambda x, y: x * (2.0 ** y),
+    heaviside=jnp.heaviside, gcd=jnp.gcd, lcm=jnp.lcm,
+    bitwise_and=jnp.bitwise_and, bitwise_or=jnp.bitwise_or,
+    bitwise_xor=jnp.bitwise_xor,
+).items():
+    _binary(_n, _f)
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, x)
+
+
+_export("bitwise_not", bitwise_not)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None, **kw):
+        ax = _axis(axis)
+
+        def f(a):
+            out = jfn(a, axis=ax, keepdims=keepdim)
+            return out
+        return apply_op(f, x)
+    op.__name__ = name
+    _export(name, op)
+
+
+for _n, _f in dict(
+    sum=jnp.sum, mean=jnp.mean, prod=jnp.prod, max=jnp.max, min=jnp.min,
+    amax=jnp.amax, amin=jnp.amin, nansum=jnp.nansum, nanmean=jnp.nanmean,
+    logsumexp=jax.scipy.special.logsumexp,
+    all=jnp.all, any=jnp.any,
+).items():
+    _reduce(_n, _f)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(x._data, axis=_axis(axis), keepdims=keepdim))
+
+
+_export("count_nonzero", count_nonzero)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        from ..amp.auto_cast import cast_if_amp
+        a, b = cast_if_amp("matmul", a, b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, x, y)
+
+
+_export("matmul", matmul)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+_export("mm", mm)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+_export("bmm", bmm)
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+_export("dot", dot)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y)
+
+
+_export("inner", inner)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y)
+
+
+_export("outer", outer)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+_export("addmm", addmm)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op(lambda a: jnp.clip(a, lo, hi), x)
+
+
+_export("clip", clip)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = apply_op(f, x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+_export("scale", scale)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+_export("increment", increment)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+    return apply_op(f, x)
+
+
+_export("cumsum", cumsum)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op(lambda a: jnp.cumprod(a, axis=int(dim)), x)
+
+
+_export("cumprod", cumprod)
+
+
+def _cum_minmax_indices(arr, ax, is_min):
+    """Indices of the running extremum, first occurrence on ties: an O(n)
+    associative scan over (value, index) pairs — lexicographic min/max with
+    the earlier index winning equal values."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, arr.shape, ax)
+
+    def combine(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = (rv < lv) if is_min else (rv > lv)
+        return (jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li))
+
+    _, inds = jax.lax.associative_scan(combine, (arr, idx), axis=ax)
+    return inds
+
+
+def _cum_minmax(x, axis, is_min):
+    def f(a):
+        a = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        return (jax.lax.cummin if is_min else jax.lax.cummax)(a, axis=ax)
+    vals = apply_op(f, x)
+    arr = x._data.reshape(-1) if axis is None else x._data
+    ax = 0 if axis is None else int(axis)
+    inds = _cum_minmax_indices(arr, ax, is_min)
+    return vals, Tensor(inds.astype(jnp.int64))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax(x, axis, is_min=False)
+
+
+_export("cummax", cummax)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+_export("trace", trace)
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y)
+
+
+_export("kron", kron)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply_op(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+_export("diff", diff)
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(x._data))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(x._data))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(x._data))
+
+
+for _n in ("isnan", "isinf", "isfinite"):
+    _export(_n, globals()[_n])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+_export("nan_to_num", nan_to_num)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+_export("stanh", stanh)
+
+
+def multiply_(x, y, name=None):
+    x._data = x._data * (y._data if isinstance(y, Tensor) else y)
+    return x
+
+
+def add_(x, y, name=None):
+    x._data = x._data + (y._data if isinstance(y, Tensor) else y)
+    return x
+
+
+def subtract_(x, y, name=None):
+    x._data = x._data - (y._data if isinstance(y, Tensor) else y)
+    return x
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    x._data = x._data * scale + bias if bias_after_scale else (x._data + bias) * scale
+    return x
+
+
+def clip_(x, min=None, max=None, name=None):
+    x._data = jnp.clip(x._data, min, max)
+    return x
+
+
+for _n in ("multiply_", "add_", "subtract_", "scale_", "clip_"):
+    _export(_n, globals()[_n])
+
+
+def floor_mod(x, y, name=None):
+    return globals()["mod"](x, y)
+
+
+_export("floor_mod", floor_mod)
+
+
+def divide_no_nan(x, y, name=None):
+    return apply_op(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)), x, y)
+
+
+_export("divide_no_nan", divide_no_nan)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+_export("lerp", lerp)
+
+
+def einsum(equation, *operands):
+    return apply_op(functools.partial(jnp.einsum, equation), *operands)
+
+
+_export("einsum", einsum)
+
+
+def multi_dot(xs, name=None):
+    return apply_op(lambda *ts: jnp.linalg.multi_dot(ts), *xs)
+
+
+_export("multi_dot", multi_dot)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+_export("broadcast_shape", broadcast_shape)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Parity: paddle.cummin — returns (values, indices of first min)."""
+    return _cum_minmax(x, axis, is_min=True)
+
+
+_export("cummin", cummin)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.reshape(-1))
+        return jax.lax.cumlogsumexp(a, axis=int(axis))
+    return apply_op(f, x)
+
+
+_export("logcumsumexp", logcumsumexp)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                           axis2=axis2), x)
+
+
+_export("diagonal", diagonal)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+_export("vander", vander)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` whose p-norm exceeds max_norm."""
+    def f(a):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, int(axis))
+    return apply_op(f, x)
+
+
+_export("renorm", renorm)
+
+
+def frexp(x, name=None):
+    m, e = jnp.frexp(x._data if isinstance(x, Tensor) else jnp.asarray(x))
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+_export("frexp", frexp)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        if isinstance(x, Tensor):
+            return apply_op(lambda a, b: jnp.trapezoid(a, b, axis=axis), y, x)
+        return apply_op(lambda a: jnp.trapezoid(a, jnp.asarray(x),
+                                                axis=axis), y)
+    return apply_op(lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+_export("trapezoid", trapezoid)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Running trapezoid integral along axis; shape [..., n-1] (scipy
+    semantics, no initial zero)."""
+    def seg(a, xs):
+        ax = int(axis) % a.ndim
+        a0 = jax.lax.slice_in_dim(a, 0, a.shape[ax] - 1, axis=ax)
+        a1 = jax.lax.slice_in_dim(a, 1, a.shape[ax], axis=ax)
+        if xs is None:
+            w = dx if dx is not None else 1.0
+            segs = (a0 + a1) * 0.5 * w
+        else:
+            x0 = jax.lax.slice_in_dim(xs, 0, xs.shape[-1] - 1, axis=-1)
+            x1 = jax.lax.slice_in_dim(xs, 1, xs.shape[-1], axis=-1)
+            d = (x1 - x0)
+            shape = [1] * a.ndim
+            shape[ax] = d.shape[-1]
+            segs = (a0 + a1) * 0.5 * d.reshape(shape)
+        return jnp.cumsum(segs, axis=ax)
+    if x is not None:
+        xs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        return apply_op(lambda a: seg(a, xs), y)
+    return apply_op(lambda a: seg(a, None), y)
+
+
+_export("cumulative_trapezoid", cumulative_trapezoid)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    import numpy as _np
+    return Tensor(jnp.asarray(_np.histogram_bin_edges(
+        _np.asarray(arr), bins=bins, range=rng).astype(_np.float32)))
+
+
+_export("histogram_bin_edges", histogram_bin_edges)

@@ -1,0 +1,129 @@
+"""Random ops over the global-seed key facade.
+
+Parity: python/paddle/tensor/random.py. Every draw consumes a deterministic
+fresh fold of the global key (paddle.seed), so runs replay exactly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.rng import next_key
+from .creation import _shape
+from .tensor import Tensor
+
+__all__ = ["rand", "randn", "normal", "standard_normal", "uniform", "randint",
+           "randint_like", "randperm", "bernoulli", "multinomial", "poisson",
+           "exponential_", "uniform_", "normal_", "rand_like", "randn_like",
+           "gumbel_softmax"]
+
+
+def rand(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=dt))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_key(), shp,
+                                                dtype=get_default_dtype()))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp,
+                                                 dtype=get_default_dtype()))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=dt,
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype)
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high).astype(dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(next_key(), x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(x._data, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(*x.shape[:-1], num_samples))
+    else:
+        g = jax.random.gumbel(next_key(), x.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(next_key(), x._data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = jax.random.exponential(next_key(), x._data.shape,
+                                     x._data.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(next_key(), x._data.shape, x._data.dtype,
+                                 min, max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(next_key(), x._data.shape,
+                                             x._data.dtype)
+    return x
+
+
+def rand_like(x, name=None):
+    return rand(tuple(x.shape), x.dtype)
+
+
+def randn_like(x, name=None):
+    return randn(tuple(x.shape), x.dtype)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from .tensor import apply_op
+    g = jax.random.gumbel(next_key(), x._data.shape, x._data.dtype)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[...].set(0)
+            onehot = jnp.where(
+                jnp.arange(y.shape[axis]).reshape(
+                    [-1 if i == (axis % y.ndim) else 1 for i in range(y.ndim)]) == idx,
+                1.0, 0.0).astype(y.dtype)
+            return onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op(f, x)

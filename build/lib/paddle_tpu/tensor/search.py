@@ -1,0 +1,101 @@
+"""Search/sort ops. Parity: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "top_k", "searchsorted",
+           "kthvalue", "mode", "index_of_max", "bucketize"]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return Tensor(jnp.argmax(x._data, axis=axis, keepdims=keepdim).astype(jnp.int64))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return Tensor(jnp.argmin(x._data, axis=axis, keepdims=keepdim).astype(jnp.int64))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = x._data
+    idx = jnp.argsort(-a if descending else a, axis=axis, stable=True)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op(f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    ax = x.ndim - 1 if axis in (-1, None) else int(axis)
+
+    def fv(a):
+        m = jnp.moveaxis(a, ax, -1)
+        vals, _ = jax.lax.top_k(m if largest else -m, kk)
+        vals = vals if largest else -vals
+        return jnp.moveaxis(vals, -1, ax)
+    m = jnp.moveaxis(x._data, ax, -1)
+    _, idx = jax.lax.top_k(m if largest else -m, kk)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+    return apply_op(fv, x), Tensor(idx)
+
+
+top_k = topk
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    v = values._data if isinstance(values, Tensor) else values
+    out = jnp.searchsorted(sorted_sequence._data, v, side=side)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = axis % x.ndim
+
+    def f(a):
+        s = jnp.sort(a, axis=ax)
+        out = jnp.take(s, k - 1, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    vals = apply_op(f, x)
+    si = jnp.argsort(x._data, axis=ax)
+    idx = jnp.take(si, k - 1, axis=ax)
+    if keepdim:
+        idx = jnp.expand_dims(idx, ax)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    arr = np.asarray(x._data)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shp = moved.shape[:-1]
+    v = vals.reshape(shp)
+    ii = idxs.reshape(shp)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        ii = np.expand_dims(ii, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ii))
+
+
+def index_of_max(x):
+    return argmax(x)

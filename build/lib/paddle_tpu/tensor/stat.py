@@ -1,0 +1,78 @@
+"""Statistics ops. Parity: python/paddle/tensor/stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "histogram", "bincount", "corrcoef", "cov"]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.std(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.var(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_op(lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim,
+                                           method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanquantile(a, jnp.asarray(q),
+                                              axis=_axis(axis),
+                                              keepdims=keepdim), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = input._data
+    if min == 0 and max == 0:
+        lo, hi = a.min(), a.max()
+    else:
+        lo, hi = min, max
+    h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if weights is not None else None
+    return Tensor(jnp.bincount(x._data.astype(jnp.int32), weights=w,
+                               minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(x._data, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0), x)

@@ -1,0 +1,453 @@
+"""Eager Tensor facade over jax.Array with an imperative autograd tape.
+
+Capability parity target (reference: PaddlePaddle ~2.5/2.6):
+  - ``paddle/fluid/eager/`` dygraph autograd engine (GradNodeBase, AutogradMeta,
+    Backward()) — realized here as a flat Wengert tape of ``jax.vjp`` closures.
+  - ``paddle.Tensor`` user API (stop_gradient, .grad, .backward(), hooks,
+    numpy()/item()/clone()/detach(), operator overloads).
+
+TPU-first design notes:
+  * The underlying storage is always a ``jax.Array`` (or a tracer when the
+    surrounding code runs under ``jax.jit`` — the same tape works while traced,
+    which is how ``paddle.jit.to_static`` compiles a full train step).
+  * Ops execute through ``jax.vjp`` only when gradients are required; otherwise
+    they are plain jnp calls, so inference costs no residual memory.
+  * No streams/events/allocators: XLA owns scheduling and memory on TPU.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import weakref
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "apply_op",
+    "register_persistent",
+    "persistent_tensors",
+    "clear_tape",
+]
+
+_uid = itertools.count()
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.nodes: list[_TapeNode] = []
+        self.grad_enabled: bool = True
+
+
+_tape = _TapeState()
+
+
+class _TapeNode:
+    """One recorded op: output ids <- vjp_fn <- input tensors."""
+
+    __slots__ = ("inputs", "output_ids", "vjp_fn", "outputs_meta")
+
+    def __init__(self, inputs, output_ids, vjp_fn, outputs_meta):
+        self.inputs = inputs            # list[Tensor] (differentiable inputs only)
+        self.output_ids = output_ids    # list[int] tensor uids
+        self.vjp_fn = vjp_fn            # cotangents -> input cotangents
+        self.outputs_meta = outputs_meta  # list[(shape, dtype)] for zero-filling
+
+
+def is_grad_enabled() -> bool:
+    return _tape.grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _tape.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _tape.grad_enabled
+    _tape.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tape.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _tape.grad_enabled
+    _tape.grad_enabled = True
+    try:
+        yield
+    finally:
+        _tape.grad_enabled = prev
+
+
+def clear_tape() -> None:
+    _tape.nodes.clear()
+
+
+# Persistent-state registry: Parameters and optimizer accumulators register here
+# so jit.to_static can functionalize hidden state (collect -> thread through the
+# compiled function -> write back).
+_persistent: "weakref.WeakSet[Tensor]" = weakref.WeakSet()
+
+
+def register_persistent(t: "Tensor") -> None:
+    _persistent.add(t)
+
+
+def persistent_tensors() -> list["Tensor"]:
+    return sorted(_persistent, key=lambda t: t._uid)
+
+
+def _as_jax(value, dtype=None):
+    if isinstance(value, Tensor):
+        return value._data
+    if isinstance(value, (jnp.ndarray, jax.Array)):
+        return value if dtype is None else value.astype(dtype)
+    return jnp.asarray(value, dtype=dtype)
+
+
+class Tensor:
+    """Paddle-shaped eager tensor. Wraps a jax.Array; autograd via the tape."""
+
+    __slots__ = ("_data", "_uid", "stop_gradient", "grad", "name", "persistable",
+                 "_hooks", "_is_leaf", "sharding_spec", "process_mesh",
+                 "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None,
+                 dtype=None):
+        self._data = _as_jax(data, dtype)
+        self._uid = next(_uid)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name or f"tensor_{self._uid}"
+        self.persistable = False
+        self._hooks: list[Callable] = []
+        self._is_leaf = True
+        self.sharding_spec = None   # jax PartitionSpec for pjit/fleet paths
+        self.process_mesh = None
+
+    # ---------------------------------------------------------------- props
+    @property
+    def shape(self) -> list:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def T(self) -> "Tensor":
+        return self.transpose(list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._is_leaf
+
+    @property
+    def value(self):
+        return self._data
+
+    # ------------------------------------------------------------- plumbing
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item() if hasattr(self._data, "item") else np.asarray(self._data).item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+
+    def clone(self) -> "Tensor":
+        return apply_op(lambda x: x + 0, self)
+
+    def astype(self, dtype) -> "Tensor":
+        from ..core.dtype import convert_dtype
+        dt = convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(dt), self)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        return self
+
+    def cuda(self, *a, **k) -> "Tensor":  # API parity; devices are XLA-managed
+        return self
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        for a in args:
+            if isinstance(a, (str, jnp.dtype, type(jnp.float32))) and not isinstance(a, bool):
+                try:
+                    return self.astype(a)
+                except Exception:
+                    pass
+        return self
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    @property
+    def place(self):
+        from ..core.place import _current_place
+        return _current_place()
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    def set_value(self, value) -> None:
+        """In-place value replacement (no tape record — optimizer/init use)."""
+        new = _as_jax(value)
+        if tuple(new.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {new.shape} vs {self._data.shape}")
+        self._data = new.astype(self._data.dtype)
+
+    def _set_data(self, arr) -> None:
+        self._data = arr
+
+    def copy_(self, other, *a) -> "Tensor":
+        self.set_value(other._data if isinstance(other, Tensor) else other)
+        return self
+
+    def fill_(self, v) -> "Tensor":
+        self._data = jnp.full_like(self._data, v)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # ------------------------------------------------------------- autograd
+    def register_hook(self, hook: Callable) -> Callable:
+        self._hooks.append(hook)
+
+        def _remove():
+            if hook in self._hooks:
+                self._hooks.remove(hook)
+        return _remove
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False) -> None:
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False) -> None:
+        from ..autograd.backward_engine import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ------------------------------------------------------------ operators
+    def _binary(self, other, fn):
+        if isinstance(other, Tensor):
+            return apply_op(fn, self, other)
+        const = other
+        return apply_op(lambda x: fn(x, const), self)
+
+    def _rbinary(self, other, fn):
+        const = other
+        return apply_op(lambda x: fn(const, x), self)
+
+    def __add__(self, o): return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._rbinary(o, jnp.add)
+    def __sub__(self, o): return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._rbinary(o, jnp.subtract)
+    def __mul__(self, o): return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._rbinary(o, jnp.multiply)
+    def __truediv__(self, o): return self._binary(o, jnp.divide)
+    def __rtruediv__(self, o): return self._rbinary(o, jnp.divide)
+    def __floordiv__(self, o): return self._binary(o, jnp.floor_divide)
+    def __mod__(self, o): return self._binary(o, jnp.mod)
+    def __pow__(self, o): return self._binary(o, jnp.power)
+    def __rpow__(self, o): return self._rbinary(o, jnp.power)
+    def __matmul__(self, o): return self._binary(o, jnp.matmul)
+    def __rmatmul__(self, o): return self._rbinary(o, jnp.matmul)
+    def __neg__(self): return apply_op(jnp.negative, self)
+    def __abs__(self): return apply_op(jnp.abs, self)
+
+    def __eq__(self, o): return self._cmp(o, jnp.equal)
+    def __ne__(self, o): return self._cmp(o, jnp.not_equal)
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+
+    def _cmp(self, other, fn):
+        if _capture_hook[0] is not None:
+            # static build: route through apply_op so the comparison is
+            # recorded into the Program (it would otherwise replay stale)
+            if isinstance(other, Tensor):
+                return apply_op(lambda a, b, f=fn: f(a, b), self, other)
+            return apply_op(lambda a, o=other, f=fn: f(a, o), self)
+        ov = other._data if isinstance(other, Tensor) else other
+        return Tensor(fn(self._data, ov))
+
+    def __hash__(self):
+        return self._uid
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = _as_jax(value)
+        if _capture_hook[0] is not None:
+            # static build: record the scatter as an op producing a NEW
+            # value for this tensor's uid, so Executor.run replays it
+            if isinstance(value, Tensor):
+                out = apply_op(
+                    lambda a, vv, i=idx: a.at[i].set(vv.astype(a.dtype)),
+                    self, value)
+            else:
+                out = apply_op(
+                    lambda a, vv=v, i=idx: a.at[i].set(vv.astype(a.dtype)),
+                    self)
+            self._data = out._data
+            # alias the new value back onto this tensor's uid for replay
+            from ..static import _alias_capture_output
+            _alias_capture_output(out, self)
+            return
+        self._data = self._data.at[idx].set(v.astype(self._data.dtype))
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={sg},\n       {np.asarray(self._data)!r})")
+
+    __str__ = __repr__
+
+    # jax pytree-friendly conversion
+    def __jax_array__(self):
+        return self._data
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, registered persistent."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "split_axis")
+
+    def __init__(self, data, name=None, trainable: bool = True, dtype=None):
+        super().__init__(data, stop_gradient=not trainable, name=name, dtype=dtype)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.split_axis = None       # tensor-parallel split axis (None = replicated)
+        register_persistent(self)
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")
+
+
+# ------------------------------------------------------------------ op apply
+def apply_op(jax_fn: Callable, *tensors: Tensor, n_outputs: int = 1):
+    """Execute ``jax_fn(*arrays)`` recording a vjp tape node when needed.
+
+    jax_fn must be a pure function of the positional arrays only (bind any
+    non-tensor attrs with closures/partial before calling).
+    """
+    arrays = [t._data for t in tensors]
+    need_grad = _tape.grad_enabled and any(not t.stop_gradient for t in tensors)
+
+    if not need_grad:
+        out = jax_fn(*arrays)
+        if n_outputs == 1 and not isinstance(out, tuple):
+            res = Tensor(out)
+            _maybe_capture(jax_fn, tensors, (res,))
+            return res
+        res = tuple(Tensor(o) for o in out)
+        _maybe_capture(jax_fn, tensors, res)
+        return res
+
+    primal_out, vjp_fn = jax.vjp(jax_fn, *arrays)
+    multi = isinstance(primal_out, tuple)
+    outs_raw = primal_out if multi else (primal_out,)
+    outs = tuple(Tensor(o, stop_gradient=False) for o in outs_raw)
+    for o in outs:
+        o._is_leaf = False
+    node = _TapeNode(
+        inputs=list(tensors),
+        output_ids=[o._uid for o in outs],
+        vjp_fn=(vjp_fn if multi else (lambda g, f=vjp_fn: f(g[0]))),
+        outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
+    )
+    _tape.nodes.append(node)
+    _maybe_capture(jax_fn, tensors, outs)
+    return outs if multi else outs[0]
+
+
+# static-graph capture hook: set by paddle_tpu.static when building a
+# Program (enable_static); records (fn, inputs, outputs) so Executor.run can
+# replay the graph with new feeds. None in eager mode — zero overhead.
+_capture_hook = [None]
+
+
+def _maybe_capture(jax_fn, inputs, outputs):
+    hook = _capture_hook[0]
+    if hook is not None:
+        hook(jax_fn, inputs, outputs)
+
+
+def tape_nodes():
+    return _tape.nodes

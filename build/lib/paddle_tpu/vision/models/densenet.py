@@ -1,0 +1,105 @@
+"""DenseNet. Parity: python/paddle/vision/models/densenet.py."""
+from __future__ import annotations
+
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, LayerList, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {121: (64, 32, (6, 12, 24, 16)),
+        161: (96, 48, (6, 12, 36, 24)),
+        169: (64, 32, (6, 12, 32, 32)),
+        201: (64, 32, (6, 12, 48, 32)),
+        264: (64, 32, (6, 12, 64, 48))}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, in_ch, growth, bn_size=4, dropout=0.0):
+        super().__init__()
+        self.norm1 = BatchNorm2D(in_ch)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(in_ch, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+        from ...nn.layer.common import Dropout
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm = BatchNorm2D(in_ch)
+        self.relu = ReLU()
+        self.conv = Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_ch, growth, blocks = _CFG[layers]
+        self.stem = Sequential(
+            Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(init_ch), ReLU(), MaxPool2D(3, stride=2, padding=1))
+        ch = init_ch
+        stages = []
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                stages.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if i != len(blocks) - 1:
+                stages.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = Sequential(*stages)
+        self.norm_final = BatchNorm2D(ch)
+        self.relu = ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1)) if with_pool else None
+        self.classifier = Linear(ch, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.relu(self.norm_final(self.blocks(self.stem(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(flatten(x, start_axis=1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)

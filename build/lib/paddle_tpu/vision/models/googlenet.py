@@ -1,0 +1,100 @@
+"""GoogLeNet (Inception v1). Parity: python/paddle/vision/models/googlenet.py."""
+from __future__ import annotations
+
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_relu(in_ch, out_ch, k, stride=1, padding=0):
+    return Sequential(Conv2D(in_ch, out_ch, k, stride=stride,
+                             padding=padding), ReLU())
+
+
+class Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(in_ch, c1, 1)
+        self.b2 = Sequential(_conv_relu(in_ch, c3r, 1),
+                             _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_conv_relu(in_ch, c5r, 1),
+                             _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             _conv_relu(in_ch, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class _AuxHead(Layer):
+    """Auxiliary classifier (reference returns its logits during training)."""
+
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D((4, 4))
+        self.conv = _conv_relu(in_ch, 128, 1)
+        self.fc1 = Linear(128 * 4 * 4, 1024)
+        self.relu = ReLU()
+        self.dropout = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(flatten(x, start_axis=1)))
+        return self.fc2(self.dropout(x))
+
+
+class GoogLeNet(Layer):
+    """forward returns (out, aux1, aux2) like the reference googlenet."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            _conv_relu(64, 64, 1),
+            _conv_relu(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4bcd = Sequential(
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64))
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5 = Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool = with_pool
+        self.pool = AdaptiveAvgPool2D((1, 1)) if with_pool else None
+        self.dropout = Dropout(0.2)
+        self.fc = Linear(1024, num_classes) if num_classes > 0 else None
+        self.aux1 = _AuxHead(512, num_classes) if num_classes > 0 else None
+        self.aux2 = _AuxHead(528, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.inc4a(self.inc3(self.stem(x)))
+        out1 = self.aux1(x) if self.aux1 is not None else None
+        x = self.inc4bcd(x)
+        out2 = self.aux2(x) if self.aux2 is not None else None
+        x = self.inc5(self.pool4(self.inc4e(x)))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(flatten(x, start_axis=1)))
+            return x, out1, out2
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

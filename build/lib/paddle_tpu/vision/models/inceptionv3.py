@@ -1,0 +1,155 @@
+"""InceptionV3. Parity: python/paddle/vision/models/inceptionv3.py
+(the five inception block families + aux-free classifier head)."""
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, LayerList, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D, AvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                   bias_attr=False),
+            BatchNorm2D(out_c), ReLU())
+
+
+class _InceptionA(Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _ConvBNReLU(in_c, 64, 1)
+        self.b5 = Sequential(_ConvBNReLU(in_c, 48, 1),
+                             _ConvBNReLU(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBNReLU(in_c, 64, 1),
+                             _ConvBNReLU(64, 96, 3, padding=1),
+                             _ConvBNReLU(96, 96, 3, padding=1))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNReLU(in_c, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionB(Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBNReLU(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(_ConvBNReLU(in_c, 64, 1),
+                              _ConvBNReLU(64, 96, 3, padding=1),
+                              _ConvBNReLU(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, in_c, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.b1 = _ConvBNReLU(in_c, 192, 1)
+        self.b7 = Sequential(
+            _ConvBNReLU(in_c, c7, 1),
+            _ConvBNReLU(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _ConvBNReLU(in_c, c7, 1),
+            _ConvBNReLU(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNReLU(in_c, 192, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionD(Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_ConvBNReLU(in_c, 192, 1),
+                             _ConvBNReLU(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _ConvBNReLU(in_c, 192, 1),
+            _ConvBNReLU(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNReLU(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNReLU(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBNReLU(in_c, 320, 1)
+        self.b3_base = _ConvBNReLU(in_c, 384, 1)
+        self.b3_a = _ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_base = Sequential(_ConvBNReLU(in_c, 448, 1),
+                                   _ConvBNReLU(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBNReLU(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBNReLU(384, 384, (3, 1), padding=(1, 0))
+        self.pool = AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNReLU(in_c, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_base(x)
+        b3d = self.b3d_base(x)
+        return concat([
+            self.b1(x),
+            concat([self.b3_a(b3), self.b3_b(b3)], axis=1),
+            concat([self.b3d_a(b3d), self.b3d_b(b3d)], axis=1),
+            self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _ConvBNReLU(3, 32, 3, stride=2),
+            _ConvBNReLU(32, 32, 3),
+            _ConvBNReLU(32, 64, 3, padding=1),
+            MaxPool2D(3, stride=2),
+            _ConvBNReLU(64, 80, 1),
+            _ConvBNReLU(80, 192, 3),
+            MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,66 @@
+"""MobileNetV1. Parity: python/paddle/vision/models/mobilenetv1.py
+(depthwise-separable conv stack). TPU note: depthwise convs lower to XLA
+convolution with feature_group_count — grouped convs are MXU-efficient at
+these channel counts."""
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0, groups=1):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                   groups=groups, bias_attr=False),
+            BatchNorm2D(out_c), ReLU())
+
+
+class _DepthwiseSeparable(Layer):
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        super().__init__()
+        self.dw = _ConvBNReLU(int(in_c * scale), int(out_c1 * scale), 3,
+                              stride=stride, padding=1,
+                              groups=int(in_c * scale))
+        self.pw = _ConvBNReLU(int(out_c1 * scale), int(out_c2 * scale), 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _ConvBNReLU(3, int(32 * scale), 3, stride=2, padding=1)
+        cfg = [  # in, out1, out2, stride
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1)]
+        self.blocks = Sequential(*[
+            _DepthwiseSeparable(i, o1, o2, s, scale) for i, o1, o2, s in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,120 @@
+"""ShuffleNetV2. Parity: python/paddle/vision/models/shufflenetv2.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+from ...tensor.tensor import apply_op
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def channel_shuffle(x, groups=2):
+    def f(a):
+        b, c, h, w = a.shape
+        return a.reshape(b, groups, c // groups, h, w).swapaxes(1, 2
+                                                                ).reshape(
+            b, c, h, w)
+    return apply_op(f, x)
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1, act=None):
+    pad = k // 2
+    layers = [Conv2D(in_ch, out_ch, k, stride=stride, padding=pad,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_ch)]
+    if act is not None:
+        from ...nn.layer.activation import Swish
+        layers.append(Swish() if act == "swish" else ReLU())
+    return Sequential(*layers)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                _conv_bn(in_ch, in_ch, 3, stride, groups=in_ch, act=None),
+                _conv_bn(in_ch, branch, 1, act=act))
+            b2_in = in_ch
+        else:
+            self.branch1 = None
+            b2_in = in_ch // 2
+        self.branch2 = Sequential(
+            _conv_bn(b2_in, branch, 1, act=act),
+            _conv_bn(branch, branch, 3, stride, groups=branch, act=None),
+            _conv_bn(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1 = apply_op(lambda a: a[:, :a.shape[1] // 2], x)
+            x2 = apply_op(lambda a: a[:, a.shape[1] // 2:], x)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c0, c1, c2, c3, c4 = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, c0, 3, stride=2, act=act)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_ch = c0
+        for out_ch, repeats in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_InvertedResidual(in_ch, out_ch, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(_InvertedResidual(out_ch, out_ch, 1, act))
+            in_ch = out_ch
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(in_ch, c4, 1, act=act)
+        self.pool = AdaptiveAvgPool2D((1, 1)) if with_pool else None
+        self.fc = Linear(c4, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(flatten(x, start_axis=1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)

@@ -1,0 +1,75 @@
+"""SqueezeNet. Parity: python/paddle/vision/models/squeezenet.py."""
+from __future__ import annotations
+
+from ...nn.layer.activation import ReLU
+from ...nn.layer.common import Dropout
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer, Sequential
+from ...nn.layer.pooling import AdaptiveAvgPool2D, MaxPool2D
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(Layer):
+    def __init__(self, inplanes, squeeze_planes, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(inplanes, squeeze_planes, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze_planes, e1, 1)
+        self.expand3x3 = Conv2D(squeeze_planes, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1x1(x)),
+                       self.relu(self.expand3x3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2),
+                Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256),
+            )
+        self.with_pool = with_pool
+        if num_classes > 0:
+            head = [Dropout(0.5), Conv2D(512, num_classes, 1), ReLU()]
+            if with_pool:
+                head.append(AdaptiveAvgPool2D((1, 1)))
+            self.classifier = Sequential(*head)
+        else:
+            self.classifier = None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.classifier is None:
+            return x
+        return flatten(self.classifier(x), start_axis=1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)

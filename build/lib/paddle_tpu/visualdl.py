@@ -1,0 +1,92 @@
+"""Scalar/metric logging. Parity: VisualDL's LogWriter (the reference
+ecosystem's TensorBoard-alike; SURVEY §5.5 'scalars for VisualDL').
+
+TPU-native realization: scalars/histograms/images write standard
+TensorBoard event files (via torch.utils.tensorboard, present in the
+image) so `tensorboard --logdir` reads them directly; when tensorboard is
+unavailable the writer degrades to a JSONL scalar log with the same API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["LogWriter"]
+
+
+class LogWriter:
+    """with LogWriter(logdir="./log") as w: w.add_scalar("loss", v, step)"""
+
+    def __init__(self, logdir: str = "./vdl_log", max_queue: int = 10,
+                 flush_secs: int = 120, filename_suffix: str = "",
+                 display_name: str = "", **kwargs):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._tb = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(log_dir=logdir, max_queue=max_queue,
+                                     flush_secs=flush_secs,
+                                     filename_suffix=filename_suffix)
+        except Exception:
+            self._jsonl = open(os.path.join(logdir, "scalars.jsonl"), "a")
+
+    # ------------------------------------------------------------- scalars
+    def add_scalar(self, tag, value, step=None, walltime=None):
+        value = float(np.asarray(getattr(value, "_data", value)))
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, global_step=step,
+                                walltime=walltime)
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": value, "step": step,
+                 "ts": walltime or time.time()}) + "\n")
+
+    def add_histogram(self, tag, values, step=None, buckets=10,
+                      walltime=None):
+        values = np.asarray(getattr(values, "_data", values))
+        if self._tb is not None:
+            self._tb.add_histogram(tag, values, global_step=step,
+                                   walltime=walltime)
+        else:
+            hist, edges = np.histogram(values, bins=buckets)
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "hist": hist.tolist(),
+                 "edges": edges.tolist(), "step": step}) + "\n")
+
+    def add_image(self, tag, img, step=None, walltime=None,
+                  dataformats="HWC"):
+        img = np.asarray(getattr(img, "_data", img))
+        if self._tb is not None:
+            self._tb.add_image(tag, img, global_step=step,
+                               walltime=walltime, dataformats=dataformats)
+
+    def add_text(self, tag, text_string, step=None, walltime=None):
+        if self._tb is not None:
+            self._tb.add_text(tag, text_string, global_step=step,
+                              walltime=walltime)
+
+    # ----------------------------------------------------------- lifecycle
+    def flush(self):
+        if self._tb is not None:
+            self._tb.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
+
+    def close(self):
+        self.flush()
+        if self._tb is not None:
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+        return False

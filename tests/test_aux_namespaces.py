@@ -1,5 +1,7 @@
 """metric / regularizer / distribution / fft / signal / version / elastic
 (SURVEY §2.6-2.7 inventory lines)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -144,3 +146,35 @@ class TestVersionAndElastic:
         assert not m.enable
         m.register()
         assert m.watch() == ElasticStatus.COMPLETED
+
+
+class TestVisualDLLogWriter:
+    """SURVEY §5.5 scalar logging: VisualDL-shaped LogWriter over
+    TensorBoard event files (+ hapi VisualDL callback)."""
+
+    def test_scalars_histogram_roundtrip(self, tmp_path):
+        from paddle_tpu.visualdl import LogWriter
+        with LogWriter(logdir=str(tmp_path)) as w:
+            for i in range(5):
+                w.add_scalar("train/loss", 1.0 / (i + 1), step=i)
+            w.add_histogram("w", np.random.RandomState(0).randn(64), step=0)
+            w.add_text("note", "hello", step=0)
+        files = os.listdir(tmp_path)
+        assert any("tfevents" in f or f == "scalars.jsonl" for f in files), \
+            files
+
+    def test_hapi_visualdl_callback(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi.callbacks import VisualDL
+        from paddle_tpu.io import TensorDataset
+        paddle.seed(0)
+        m = paddle.Model(paddle.nn.Linear(4, 2))
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            0.1, parameters=m.network.parameters()),
+            loss=paddle.nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 2, (8, 1))
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        cb = VisualDL(log_dir=str(tmp_path))
+        m.fit(ds, epochs=1, batch_size=4, verbose=0, callbacks=[cb])
+        assert os.listdir(tmp_path)         # events written
