@@ -53,6 +53,15 @@ from . import signal
 from . import version
 from . import inference
 from . import text
+from . import utils
+from . import sparse
+from . import audio
+from . import geometric
+from . import quantization
+from . import sysconfig
+from . import hub
+from . import reader
+from .reader import batch
 from .hapi.model import Model
 from .framework.io import save, load
 from .framework.layer_helpers import DataParallel

@@ -1,0 +1,16 @@
+"""paddle.audio — audio feature extraction + WAV IO.
+
+Parity: python/paddle/audio/ (functional, features, backends). The soundfile
+backend is replaced by a stdlib-`wave` PCM backend (zero extra deps);
+load/save cover 16/32-bit PCM WAV, the format the reference's bundled
+datasets use."""
+from __future__ import annotations
+
+from . import functional
+from . import features
+from .backends import load, save, info
+
+__all__ = ["functional", "features", "load", "save", "info",
+           "backends"]
+
+from . import backends  # noqa: E402

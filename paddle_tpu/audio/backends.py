@@ -1,0 +1,78 @@
+"""paddle.audio.backends — WAV load/save over the stdlib `wave` module.
+Parity: python/paddle/audio/backends/ (wave_backend.py :: load, save, info).
+PCM 16/32-bit and 8-bit unsigned supported; float tensors in [-1, 1]."""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["load", "save", "info", "AudioInfo"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+_WIDTH2DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=8 * w.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """→ (Tensor [channels, time] (or [time, channels]), sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dtype = _WIDTH2DTYPE[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16"):
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[:, None]       # mono → [time, 1] regardless of layout
+    elif channels_first:
+        arr = arr.T              # → [time, channels]
+    width = {"PCM_16": 2, "PCM_32": 4, "PCM_U8": 1}[encoding]
+    if np.issubdtype(arr.dtype, np.floating):
+        if width == 1:
+            pcm = np.clip(arr * 128.0 + 128.0, 0, 255).astype(np.uint8)
+        else:
+            scale = float(2 ** (8 * width - 1) - 1)
+            pcm = np.clip(arr * scale, -scale - 1, scale).astype(
+                _WIDTH2DTYPE[width])
+    else:
+        pcm = arr.astype(_WIDTH2DTYPE[width])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(pcm.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(np.ascontiguousarray(pcm).tobytes())

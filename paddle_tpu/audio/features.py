@@ -1,0 +1,117 @@
+"""paddle.audio.features. Parity: python/paddle/audio/features/layers.py ::
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC.
+
+TPU shape: framing is a gather into [frames, n_fft], the STFT is one batched
+rFFT HLO, and mel/DCT projections are MXU matmuls — no per-frame loops."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, n_fft, hop_length, win, center, power,
+                pad_mode="reflect"):
+    """x: [..., T] → power spectrogram [..., freq, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = jnp.take(x, idx, axis=-1)          # [..., frames, n_fft]
+    frames = frames * win
+    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    mag = jnp.abs(spec)
+    if power != 1.0:
+        mag = mag ** power
+    return jnp.swapaxes(mag, -1, -2)            # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: int | None = None,
+                 win_length: int | None = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode:
+                 str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length, fftbins=True)._data
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.window = w
+
+    def forward(self, x: Tensor) -> Tensor:
+        n_fft, hop, win = self.n_fft, self.hop_length, self.window
+        center, power, pad_mode = self.center, self.power, self.pad_mode
+        return apply_op(
+            lambda a: _stft_power(a, n_fft, hop, win, center, power,
+                                  pad_mode), x)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center)
+        self.fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)._data
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self.spectrogram(x)
+        fb = self.fbank
+        return apply_op(lambda s: jnp.einsum("mf,...ft->...mt", fb, s), spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int | None = None, win_length: int | None = None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float | None = None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: float | None = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, n_mels, f_min, f_max, htk,
+                                  norm)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        return power_to_db(self.mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: int | None = None, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: float | None = None,
+                 top_db: float | None = None, dtype: str = "float32",
+                 **mel_kwargs):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+            f_min=f_min, f_max=f_max, top_db=top_db, **mel_kwargs)
+        self.dct = create_dct(n_mfcc, n_mels)._data
+
+    def forward(self, x: Tensor) -> Tensor:
+        lm = self.logmel(x)
+        dct = self.dct
+        return apply_op(lambda s: jnp.einsum("mk,...mt->...kt", dct, s), lm)

@@ -1,0 +1,158 @@
+"""paddle.audio.functional. Parity: python/paddle/audio/functional/
+(functional.py :: hz_to_mel, mel_to_hz, mel_frequencies, fft_frequencies,
+compute_fbank_matrix, power_to_db, create_dct; window.py :: get_window).
+All pure jnp — XLA fuses the filterbank matmuls onto the MXU."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz → mel. Slaney formula by default (linear <1 kHz, log above), HTK
+    formula with htk=True — the reference's dual convention."""
+    scalar = not isinstance(freq, (Tensor, jnp.ndarray))
+    f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
+        freq, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                        mels)
+    if scalar:
+        return float(out)
+    return Tensor(out) if isinstance(freq, Tensor) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, jnp.ndarray))
+    m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
+        mel, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    if scalar:
+        return float(out)
+    return Tensor(out) if isinstance(mel, Tensor) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    """n_mels frequencies evenly spaced on the mel scale."""
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(mel_to_hz(mels, htk))
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    """Center frequencies of rFFT bins."""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: float | None = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights)
+
+
+def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float | None = 80.0):
+    """10*log10(S/ref) with amin flooring and optional top_db clipping."""
+    def fn(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(
+            jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    if isinstance(magnitude, Tensor):
+        return apply_op(fn, magnitude)
+    return fn(jnp.asarray(magnitude))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str | None = "ortho"):
+    """DCT-II basis [n_mels, n_mfcc] for MFCC extraction."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        scale = jnp.full((n_mfcc,), math.sqrt(2.0 / n_mels))
+        scale = scale.at[0].set(math.sqrt(1.0 / n_mels))
+        basis = basis * scale[None, :]
+    else:
+        basis = basis * 2.0
+    return Tensor(basis)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """Window function by name (hann/hamming/blackman/bartlett/
+    kaiser/gaussian/general_gaussian/exponential/triang/bohman/taylor are the
+    reference set; the common core implemented here)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length
+    sym = not fftbins
+    m = n if sym else n + 1
+    t = jnp.arange(m, dtype=jnp.float32)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * t / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * t / (m - 1))
+             + 0.08 * jnp.cos(4 * math.pi * t / (m - 1)))
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2 * t / (m - 1) - 1.0)
+    elif name == "triang":
+        w = 1.0 - jnp.abs((2 * t - (m - 1)) / (m + (0 if sym else 1) - 1))
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = jnp.i0(beta * jnp.sqrt(
+            1 - (2 * t / (m - 1) - 1) ** 2)) / jnp.i0(jnp.asarray(beta))
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = jnp.exp(-0.5 * ((t - (m - 1) / 2) / std) ** 2)
+    elif name == "rect" or name == "boxcar":
+        w = jnp.ones(m)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if not sym:
+        w = w[:-1]
+    return Tensor(w)

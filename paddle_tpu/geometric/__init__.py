@@ -1,0 +1,205 @@
+"""paddle.geometric — graph learning primitives.
+
+Parity: python/paddle/geometric/ (math.py :: segment_sum/mean/max/min;
+message_passing/send_recv.py :: send_u_recv, send_ue_recv, send_uv;
+reindex.py :: reindex_graph; sampling/neighbors.py :: sample_neighbors).
+
+TPU-first: every primitive is a gather + jax.ops.segment_* reduction —
+static segment counts, no atomics (the reference's CUDA kernels use
+atomicAdd; segment_sum is XLA's deterministic sorted-scatter equivalent).
+Graph-structure ops (reindex, sampling) are host-side numpy: structure
+manipulation, not device math, exactly as the reference runs them on CPU
+for CPU graphs."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "sample_neighbors"]
+
+
+def _ids(x):
+    return jnp.asarray(x._data if isinstance(x, Tensor) else x, jnp.int32)
+
+
+def _nseg(segment_ids, num_segments=None):
+    if num_segments is not None:
+        return int(num_segments)
+    ids = np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def segment_sum(data: Tensor, segment_ids, name=None):
+    ids = _ids(segment_ids)
+    n = _nseg(ids)
+    return apply_op(
+        lambda d: jax.ops.segment_sum(d, ids, num_segments=n), data)
+
+
+def segment_mean(data: Tensor, segment_ids, name=None):
+    ids = _ids(segment_ids)
+    n = _nseg(ids)
+
+    def fn(d):
+        s = jax.ops.segment_sum(d, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                  num_segments=n)
+        cnt = cnt.reshape((n,) + (1,) * (d.ndim - 1))
+        return s / jnp.maximum(cnt, 1)
+    return apply_op(fn, data)
+
+
+def _empty_mask(ids, n, ndim):
+    """[n] bool → broadcastable: which segments received no element (the
+    reference zeros them; segment_max/min leave dtype extremes / ±inf)."""
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                              num_segments=n)
+    return (cnt == 0).reshape((n,) + (1,) * (ndim - 1))
+
+
+def segment_max(data: Tensor, segment_ids, name=None):
+    ids = _ids(segment_ids)
+    n = _nseg(ids)
+
+    def fn(d):
+        out = jax.ops.segment_max(d, ids, num_segments=n)
+        return jnp.where(_empty_mask(ids, n, d.ndim),
+                         jnp.zeros((), d.dtype), out)
+    return apply_op(fn, data)
+
+
+def segment_min(data: Tensor, segment_ids, name=None):
+    ids = _ids(segment_ids)
+    n = _nseg(ids)
+
+    def fn(d):
+        out = jax.ops.segment_min(d, ids, num_segments=n)
+        return jnp.where(_empty_mask(ids, n, d.ndim),
+                         jnp.zeros((), d.dtype), out)
+    return apply_op(fn, data)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _reduce(contrib, dst, n, pool_type, dtype):
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(contrib, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(dst.shape, contrib.dtype), dst, num_segments=n)
+        cnt = cnt.reshape((n,) + (1,) * (contrib.ndim - 1))
+        return s / jnp.maximum(cnt, 1)
+    out = _REDUCERS[pool_type](contrib, dst, num_segments=n)
+    if pool_type in ("max", "min"):
+        out = jnp.where(_empty_mask(dst, n, contrib.ndim),
+                        jnp.zeros((), contrib.dtype), out)
+    return out
+
+
+def send_u_recv(x: Tensor, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x[src] along edges, reduce at dst (message passing without
+    edge features)."""
+    src, dst = _ids(src_index), _ids(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+    n = int(n)
+
+    def fn(a):
+        contrib = jnp.take(a, src, axis=0)
+        return _reduce(contrib, dst, n, reduce_op, a.dtype)
+    return apply_op(fn, x)
+
+
+_EDGE_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+             "div": jnp.divide}
+
+
+def send_ue_recv(x: Tensor, y: Tensor, src_index, dst_index,
+                 message_op: str = "add", reduce_op: str = "sum",
+                 out_size=None, name=None):
+    """Combine x[src] with edge features y via message_op, reduce at dst."""
+    src, dst = _ids(src_index), _ids(dst_index)
+    n = int(out_size if out_size is not None else x.shape[0])
+    op = _EDGE_OPS[message_op]
+
+    def fn(a, e):
+        contrib = op(jnp.take(a, src, axis=0), e)
+        return _reduce(contrib, dst, n, reduce_op, a.dtype)
+    return apply_op(fn, x, y)
+
+
+def send_uv(x: Tensor, y: Tensor, src_index, dst_index,
+            message_op: str = "add", name=None):
+    """Per-edge message x[src] op y[dst] (no reduction)."""
+    src, dst = _ids(src_index), _ids(dst_index)
+    op = _EDGE_OPS[message_op]
+    return apply_op(
+        lambda a, b: op(jnp.take(a, src, axis=0), jnp.take(b, dst, axis=0)),
+        x, y)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact (x ∪ neighbors) into contiguous ids: returns (reindexed_src,
+    reindexed_dst, out_nodes). Host-side structure op."""
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x).ravel()
+    nbr = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
+                     else neighbors).ravel()
+    cnt = np.asarray(count._data if isinstance(count, Tensor)
+                     else count).ravel()
+    # order: seed nodes first, then unseen neighbors in first-appearance order
+    mapping: dict[int, int] = {}
+    for v in xs.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    for v in nbr.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    reindex_src = np.array([mapping[int(v)] for v in nbr], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(reindex_src), Tensor(reindex_dst), Tensor(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling from CSC graph (row=indices,
+    colptr=offsets): returns (out_neighbors, out_count[, out_eids]).
+    Host-side; sampling is data-dependent-shape by nature, so it stays off
+    the accelerator (matching the reference's CPU sampler role)."""
+    r = np.asarray(row._data if isinstance(row, Tensor) else row).ravel()
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor)
+                    else colptr).ravel()
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes).ravel()
+    e = None if eids is None else np.asarray(
+        eids._data if isinstance(eids, Tensor) else eids).ravel()
+    out_n, out_c, out_e = [], [], []
+    rng = np.random
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(beg, end)
+        else:
+            sel = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(r[sel])
+        out_c.append(len(sel))
+        if return_eids and e is not None:
+            out_e.append(e[sel])
+    neighbors = Tensor(np.concatenate(out_n) if out_n else
+                       np.zeros(0, np.int64))
+    counts = Tensor(np.asarray(out_c, np.int64))
+    if return_eids:
+        return neighbors, counts, Tensor(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64))
+    return neighbors, counts

@@ -1,0 +1,70 @@
+"""paddle.hub. Parity: python/paddle/hub.py :: list, help, load — load
+models from a repo's hubconf.py. source='local' is fully supported;
+'github'/'gitee' require network and are gated with a clear error (zero-
+egress environment)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf"
+_hubconf_cache: dict[str, object] = {}
+
+
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
+    if not force_reload and repo_dir in _hubconf_cache:
+        return _hubconf_cache[repo_dir]
+    path = os.path.join(repo_dir, _HUBCONF + ".py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF}.py found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(
+        f"{_HUBCONF}_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    _hubconf_cache[repo_dir] = mod
+    return mod
+
+
+def _resolve(repo_dir: str, source: str) -> str:
+    source = source.lower()
+    if source == "local":
+        return repo_dir
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"paddle.hub source='{source}' needs network access, which this "
+            f"environment does not have. Clone the repo locally and call "
+            f"with source='local'.")
+    raise ValueError(
+        f"unknown source {source!r}; expected 'github', 'gitee' or 'local'")
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source), force_reload)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """Docstring of one hubconf entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source), force_reload)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate one hubconf entrypoint with kwargs."""
+    mod = _load_hubconf(_resolve(repo_dir, source), force_reload)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return getattr(mod, model)(**kwargs)

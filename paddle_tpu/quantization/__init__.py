@@ -1,0 +1,254 @@
+"""paddle.quantization — QAT / PTQ over fake-quant with a straight-through
+estimator.
+
+Parity: python/paddle/quantization/ (config.py :: QuantConfig; qat.py :: QAT;
+ptq.py :: PTQ; quanters/abs_max.py :: FakeQuanterWithAbsMaxObserver;
+observers/abs_max.py :: AbsmaxObserver; factory.py).
+
+TPU-first: fake-quant is `x + stop_gradient(dequant(quant(x)) - x)` — the
+STE falls out of the identity path with zero custom-vjp machinery, and XLA
+fuses the round/clip chain into neighbouring ops. int8 inference on TPU is
+then a matter of feeding the learned scales to XLA's native int8 matmul."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "QuanterFactory",
+           "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver",
+           "quanter", "QuantedLinear"]
+
+
+def _fake_quant(x, scale, bit_length):
+    """Quantize→dequantize with STE. scale maps absmax → qmax."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    dq = q * s / qmax
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+class BaseQuanter(Layer):
+    """A quanter is a Layer inserted into the forward path that fake-
+    quantizes what flows through it and tracks the scale it uses."""
+
+    def scales(self) -> Tensor:
+        raise NotImplementedError
+
+    def bit_length(self) -> int:
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average absmax fake quanter (the reference QAT default)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype: str = "float32", name=None):
+        super().__init__()
+        self._moving_rate = float(moving_rate)
+        self._bit_length = int(bit_length)
+        self._scale = 0.0
+        self._initialized = False
+
+    def forward(self, x: Tensor) -> Tensor:
+        bl = self._bit_length
+        if isinstance(x._data, jax.core.Tracer):
+            # inside jit/to_static: host-side moving-average state cannot
+            # update under trace — quantize with the in-graph absmax
+            # (dynamic per-batch quantization, trace-safe) in training, or
+            # the frozen calibrated scale in eval
+            if self.training or not self._initialized:
+                return apply_op(
+                    lambda a: _fake_quant(a, jnp.max(jnp.abs(a)), bl), x)
+            scale = jnp.asarray(self._scale, jnp.float32)
+            return apply_op(lambda a: _fake_quant(a, scale, bl), x)
+        if self.training or not self._initialized:
+            # eval before any calibration also initializes from this batch
+            # (never quantize with a zero scale)
+            cur = float(np.asarray(jnp.max(jnp.abs(x._data))))
+            if not self._initialized:
+                self._scale = cur
+                self._initialized = True
+            elif self.training:
+                r = self._moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        scale = jnp.asarray(self._scale, jnp.float32)
+        return apply_op(lambda a: _fake_quant(a, scale, bl), x)
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ observer: passes activations through untouched while recording
+    the running absmax; `scales()` feeds the convert step."""
+
+    def __init__(self, quant_bits: int = 8, name=None):
+        super().__init__()
+        self._bit_length = int(quant_bits)
+        self._scale = 0.0
+
+    def forward(self, x: Tensor) -> Tensor:
+        if isinstance(x._data, jax.core.Tracer):
+            # calibration must run eagerly to observe ranges; under trace
+            # the observer is a no-op pass-through
+            return x
+        cur = float(np.asarray(jnp.max(jnp.abs(x._data))))
+        self._scale = max(self._scale, cur)
+        return x
+
+    def scales(self) -> Tensor:
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+
+class QuanterFactory:
+    """Bind a quanter class + kwargs for later instantiation (the
+    reference's factory.py contract)."""
+
+    def __init__(self, cls, **kwargs):
+        self._cls = cls
+        self._kwargs = kwargs
+
+    def instance(self) -> BaseQuanter:
+        return self._cls(**self._kwargs)
+
+
+def quanter(cls=None, **kwargs) -> QuanterFactory:
+    if cls is None:
+        cls = FakeQuanterWithAbsMaxObserver
+    return QuanterFactory(cls, **kwargs)
+
+
+class QuantConfig:
+    """Which layers get which activation/weight quanters."""
+
+    def __init__(self, activation: QuanterFactory | None = None,
+                 weight: QuanterFactory | None = None):
+        self._global_act = activation
+        self._global_weight = weight
+        self._layer_cfg: list[tuple[object, QuanterFactory | None,
+                                    QuanterFactory | None]] = []
+        self._type_cfg: list[tuple[type, QuanterFactory | None,
+                                   QuanterFactory | None]] = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg.append((l, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg.append((t, activation, weight))
+
+    def _config_for(self, layer: Layer):
+        for l, a, w in self._layer_cfg:
+            if l is layer:
+                return a, w
+        for t, a, w in self._type_cfg:
+            if isinstance(layer, t):
+                return a, w
+        if self._global_act is not None or self._global_weight is not None:
+            if isinstance(layer, tuple(_QAT_MAPPING)):
+                return self._global_act, self._global_weight
+        return None, None
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized input activations and weight — the QAT
+    stand-in the reference swaps in (nn/quant/qat/linear.py)."""
+
+    def __init__(self, inner: Linear, act_factory, weight_factory):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = (act_factory.instance()
+                                   if act_factory else None)
+        self.weight_quanter = (weight_factory.instance()
+                               if weight_factory else None)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.inner.bias)
+
+
+_QAT_MAPPING: dict[type, type] = {Linear: QuantedLinear}
+
+
+def _swap_layers(model: Layer, config: QuantConfig, observers_only: bool):
+    for holder in model.sublayers(include_self=True):
+        for name, sub in list(holder._sub_layers.items()):
+            if sub is None or isinstance(sub, (BaseQuanter, QuantedLinear)):
+                continue
+            a, w = config._config_for(sub)
+            if a is None and w is None:
+                continue
+            quanted_cls = next(
+                (qc for base, qc in _QAT_MAPPING.items()
+                 if isinstance(sub, base)), None)
+            if quanted_cls is None:
+                import warnings
+                warnings.warn(
+                    f"layer {type(sub).__name__} is configured for "
+                    f"quantization but has no quanted mapping; skipped")
+                continue
+            if observers_only:
+                a = a or quanter(AbsmaxObserver)
+                w = w or quanter(AbsmaxObserver)
+            holder._sub_layers[name] = quanted_cls(sub, a, w)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver: `quantize` swaps supported
+    layers for fake-quanted versions per the config."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        assert inplace, ("TPU build swaps sublayers in place; copy the "
+                         "model first for inplace=False semantics")
+        return _swap_layers(model, self._config, observers_only=False)
+
+
+class PTQ:
+    """Post-training quantization: observers record ranges during
+    calibration forward passes; `convert` freezes scales into fake-quant."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        assert inplace, "see QAT.quantize"
+        return _swap_layers(model, self._config, observers_only=True)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace each observer with a frozen FakeQuanter at the observed
+        scale (eval-mode: scale no longer updates)."""
+        assert inplace, "see QAT.quantize"
+        for holder in model.sublayers(include_self=True):
+            for sub in holder._sub_layers.values():
+                if isinstance(sub, QuantedLinear):
+                    for attr in ("activation_quanter", "weight_quanter"):
+                        obs = getattr(sub, attr)
+                        if isinstance(obs, AbsmaxObserver):
+                            fq = FakeQuanterWithAbsMaxObserver(
+                                bit_length=obs._bit_length)
+                            fq._scale = obs._scale
+                            fq._initialized = True
+                            fq.eval()
+                            setattr(sub, attr, fq)
+                            sub._sub_layers[attr] = fq
+        model.eval()
+        return model

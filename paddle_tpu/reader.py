@@ -1,0 +1,198 @@
+"""paddle.reader (legacy reader decorators) + paddle.batch. Parity:
+python/paddle/reader/decorator.py :: map_readers, shuffle, buffered, compose,
+chain, firstn, cache, xmap_readers and python/paddle/batch.py :: batch.
+Generator-composition utilities predating paddle.io; kept for API parity."""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["batch", "map_readers", "shuffle", "buffered", "compose",
+           "chain", "firstn", "cache", "xmap_readers"]
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Compose a sample reader into a batch reader (paddle.batch)."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def map_readers(func, *readers):
+    """Yield func(*items) zipped across multiple readers."""
+
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Shuffle within a sliding buffer of buf_size samples."""
+
+    def shuffled_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled_reader
+
+
+def buffered(reader, size: int):
+    """Prefetch up to `size` samples on a producer thread."""
+
+    end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                break
+            yield sample
+    return buffered_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers sample-wise into flattened tuples."""
+
+    def _flatten(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def composed_reader():
+        its = [r() for r in readers]
+        for items in itertools.zip_longest(*its):
+            if check_alignment and any(i is None for i in items):
+                raise ComposeNotAligned(
+                    "readers produced different numbers of samples")
+            yield sum((_flatten(i) for i in items), ())
+    return composed_reader
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+
+    def chained_reader():
+        for r in readers:
+            yield from r()
+    return chained_reader
+
+
+def firstn(reader, n: int):
+    """Limit a reader to its first n samples."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialize the reader once; replay from memory afterwards."""
+    data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            data.extend(reader())
+            filled[0] = True
+        yield from data
+    return cache_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map over a reader with worker threads (the reference's
+    thread pool; order=True preserves input order)."""
+
+    end = object()
+
+    def xreader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        break
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as exc:  # propagate to the consumer
+                out_q.put(("__error__", exc))
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        done = 0
+        if order:
+            pending: dict[int, object] = {}
+            want = 0
+            while done < process_num:
+                item = out_q.get()
+                if item is end:
+                    done += 1
+                    continue
+                i, mapped = item
+                if i == "__error__":
+                    raise mapped
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                item = out_q.get()
+                if item is end:
+                    done += 1
+                    continue
+                if item[0] == "__error__":
+                    raise item[1]
+                yield item[1]
+    return xreader

@@ -1,0 +1,497 @@
+"""paddle.sparse — COO/CSR sparse tensors, TPU-first.
+
+Parity surface: python/paddle/sparse/ (creation.py :: sparse_coo_tensor,
+sparse_csr_tensor; unary.py; binary.py; multiary.py :: addmm;
+matmul/masked_matmul in python/paddle/sparse/nn + paddle/phi/kernels/sparse/).
+
+TPU-first design: there is no cuSPARSE analogue on TPU, and XLA has no sparse
+HLOs — the hardware-native realization of sparse compute is gather/scatter +
+segment reductions over STATIC-nnz index/value arrays, which XLA tiles onto
+the VPU/MXU. So SparseCooTensor/SparseCsrTensor are lightweight containers
+(static `nnz` per instance, indices as int32 arrays) whose ops lower to
+jnp.take / scatter-add / jax.ops.segment_sum; `values` is a framework Tensor
+so every sparse op participates in the autograd tape (grads flow to values
+and to dense operands; indices are structure, not data)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op
+
+from . import nn  # noqa: E402  (submodule import at end of file in paddle)
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "coalesce", "transpose",
+    "reshape", "sum", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "addmm", "nn",
+    # unary (values-wise, sparsity-preserving)
+    "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "expm1", "neg", "pow", "cast", "rad2deg",
+    "deg2rad", "relu",
+]
+
+
+def _as_array(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x._data if dtype is None else x._data.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+class SparseCooTensor:
+    """COO: `indices` [sparse_ndim, nnz] int32, `values` [nnz, *dense_dims].
+
+    Static nnz — the TPU contract: one compiled program per (shape, nnz)
+    bucket, no data-dependent shapes inside jit."""
+
+    def __init__(self, indices, values: Tensor, shape, *, coalesced=False):
+        self.indices = _as_array(indices, jnp.int32)
+        self.values = values if isinstance(values, Tensor) else Tensor(
+            _as_array(values))
+        self.shape = tuple(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+        assert self.indices.ndim == 2, "indices must be [sparse_ndim, nnz]"
+        assert self.indices.shape[1] == self.values.shape[0]
+
+    # --- paddle Tensor-protocol subset -----------------------------------
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[1])
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def indices_tensor(self) -> Tensor:
+        return Tensor(self.indices)
+
+    def values_tensor(self) -> Tensor:
+        return self.values
+
+    def to_dense(self) -> Tensor:
+        idx = self.indices
+        shape = self.shape
+
+        def densify(v):
+            return jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v)
+        return apply_op(densify, self.values)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        assert len(self.shape) == 2, "CSR conversion supports 2-D tensors"
+        coo = coalesce(self)
+        rows, cols = np.asarray(coo.indices[0]), coo.indices[1]
+        crows = np.zeros(self.shape[0] + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, cols, coo.values, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: `crows` [rows+1], `cols` [nnz], `values` [nnz]; 2-D (or batched
+    3-D with shared structure per batch in the reference — 2-D here)."""
+
+    def __init__(self, crows, cols, values: Tensor, shape):
+        self.crows = _as_array(crows, jnp.int32)
+        self.cols = _as_array(cols, jnp.int32)
+        self.values = values if isinstance(values, Tensor) else Tensor(
+            _as_array(values))
+        self.shape = tuple(int(s) for s in shape)
+        assert len(self.shape) == 2, "SparseCsrTensor is 2-D"
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.cols.shape[0])
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def crows_tensor(self) -> Tensor:
+        return Tensor(self.crows)
+
+    def cols_tensor(self) -> Tensor:
+        return Tensor(self.cols)
+
+    def values_tensor(self) -> Tensor:
+        return self.values
+
+    def _row_indices(self) -> jnp.ndarray:
+        counts = jnp.diff(self.crows)
+        return jnp.repeat(jnp.arange(self.shape[0], dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz)
+
+    def to_sparse_coo(self) -> SparseCooTensor:
+        idx = jnp.stack([self._row_indices(), self.cols])
+        return SparseCooTensor(idx, self.values, self.shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        rows = self._row_indices()
+        cols, shape = self.cols, self.shape
+
+        def densify(v):
+            return jnp.zeros(shape, v.dtype).at[rows, cols].add(v)
+        return apply_op(densify, self.values)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Build a COO tensor from [sparse_ndim, nnz] indices + nnz values."""
+    idx = _as_array(indices, jnp.int32)
+    vals = values if isinstance(values, Tensor) else Tensor(
+        _as_array(values, dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Build a CSR tensor from compressed row pointers + cols + values."""
+    vals = values if isinstance(values, Tensor) else Tensor(
+        _as_array(values, dtype))
+    vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def _dense_to_coo(x: Tensor, sparse_dim=None) -> SparseCooTensor:
+    arr = np.asarray(x._data)
+    nd = arr.ndim if sparse_dim is None else sparse_dim
+    mask = np.asarray(np.abs(arr) != 0)
+    while mask.ndim > nd:
+        mask = mask.any(axis=-1)
+    idx = np.stack(np.nonzero(mask)).astype(np.int32)
+    gather = tuple(idx)
+
+    def take(a):
+        return a[gather]
+    vals = apply_op(take, x)
+    return SparseCooTensor(idx, vals, arr.shape, coalesced=True)
+
+
+def _attach_tensor_methods():
+    """paddle parity: dense Tensor gains to_sparse_coo/to_sparse_csr."""
+    def to_sparse_coo(self, sparse_dim=None):
+        return _dense_to_coo(self, sparse_dim)
+
+    def to_sparse_csr(self):
+        return _dense_to_coo(self).to_sparse_csr()
+
+    Tensor.to_sparse_coo = to_sparse_coo
+    Tensor.to_sparse_csr = to_sparse_csr
+    Tensor.is_sparse = lambda self: False
+    Tensor.is_sparse_coo = lambda self: False
+    Tensor.is_sparse_csr = lambda self: False
+
+
+_attach_tensor_methods()
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Sort indices lexicographically and sum duplicates. The output nnz is
+    the number of UNIQUE cells (host-computed structure, like every index
+    set here): each distinct support produces its own compiled program.
+    Callers that need one-program steady state should keep supports fixed
+    across steps — the framework's static-nnz contract is per-instance, and
+    coalesce creates a new instance."""
+    if x._coalesced:
+        return x
+    idx = np.asarray(x.indices)
+    flat = np.ravel_multi_index(idx, x.shape[:idx.shape[0]])
+    order = np.argsort(flat, kind="stable")
+    sorted_flat = flat[order]
+    uniq, first = np.unique(sorted_flat, return_index=True)
+    seg = np.zeros(len(flat), np.int32)
+    seg[first] = 1
+    seg = np.cumsum(seg) - 1  # position → output slot
+    n_out = len(uniq)
+    new_idx = np.stack(np.unravel_index(uniq, x.shape[:idx.shape[0]]))
+    order_j = jnp.asarray(order)
+    seg_j = jnp.asarray(seg)
+
+    def merge(v):
+        return jax.ops.segment_sum(v[order_j], seg_j, num_segments=n_out)
+    vals = apply_op(merge, x.values)
+    return SparseCooTensor(new_idx.astype(np.int32), vals, x.shape,
+                           coalesced=True)
+
+
+# ---------------------------------------------------------------------------
+# unary — sparsity-preserving values maps
+# ---------------------------------------------------------------------------
+
+def _unary_factory(name, jfn):
+    def op(x, name_=None):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, apply_op(jfn, x.values),
+                                   x.shape, coalesced=x._coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows, x.cols, apply_op(jfn, x.values),
+                                   x.shape)
+        return apply_op(jfn, x)
+    op.__name__ = name
+    return op
+
+
+abs = _unary_factory("abs", jnp.abs)  # noqa: A001
+sin = _unary_factory("sin", jnp.sin)
+tan = _unary_factory("tan", jnp.tan)
+asin = _unary_factory("asin", jnp.arcsin)
+atan = _unary_factory("atan", jnp.arctan)
+sinh = _unary_factory("sinh", jnp.sinh)
+tanh = _unary_factory("tanh", jnp.tanh)
+asinh = _unary_factory("asinh", jnp.arcsinh)
+atanh = _unary_factory("atanh", jnp.arctanh)
+sqrt = _unary_factory("sqrt", jnp.sqrt)
+square = _unary_factory("square", jnp.square)
+log1p = _unary_factory("log1p", jnp.log1p)
+expm1 = _unary_factory("expm1", jnp.expm1)
+neg = _unary_factory("neg", jnp.negative)
+relu = _unary_factory("relu", lambda v: jnp.maximum(v, 0))
+rad2deg = _unary_factory("rad2deg", jnp.rad2deg)
+deg2rad = _unary_factory("deg2rad", jnp.deg2rad)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary_factory("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype)
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices if index_dtype is None else x.indices.astype(
+            convert_dtype(index_dtype))
+        vals = x.values if vd is None else apply_op(
+            lambda v: v.astype(vd), x.values)
+        return SparseCooTensor(idx, vals, x.shape, coalesced=x._coalesced)
+    crows = x.crows if index_dtype is None else x.crows.astype(
+        convert_dtype(index_dtype))
+    cols = x.cols if index_dtype is None else x.cols.astype(
+        convert_dtype(index_dtype))
+    vals = x.values if vd is None else apply_op(lambda v: v.astype(vd),
+                                               x.values)
+    return SparseCsrTensor(crows, cols, vals, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# structure ops
+# ---------------------------------------------------------------------------
+
+def transpose(x: SparseCooTensor, perm):
+    """Permute dims: sparse dims permute the index rows; dense (trailing)
+    dims permute the values array axes."""
+    assert isinstance(x, SparseCooTensor), "transpose: COO only"
+    perm = list(perm)
+    sd = x.indices.shape[0]
+    assert sorted(perm) == list(range(len(x.shape))), "invalid perm"
+    assert all(p < sd for p in perm[:sd]) and all(
+        p >= sd for p in perm[sd:]), \
+        "perm must not mix sparse and dense dims"
+    new_idx = x.indices[jnp.asarray(perm[:sd])]
+    new_shape = tuple(x.shape[p] for p in perm)
+    vals = x.values
+    if perm[sd:] != list(range(sd, len(x.shape))):
+        vaxes = (0,) + tuple(1 + (p - sd) for p in perm[sd:])
+        vals = apply_op(lambda v: jnp.transpose(v, vaxes), vals)
+    return SparseCooTensor(new_idx, vals, new_shape)
+
+
+def reshape(x: SparseCooTensor, shape):
+    assert isinstance(x, SparseCooTensor), "reshape: COO only"
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        total = int(np.prod(x.shape))
+        shape = tuple(total // known if s == -1 else s for s in shape)
+    flat = jnp.ravel_multi_index(tuple(x.indices), x.shape, mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, shape)).astype(jnp.int32)
+    return SparseCooTensor(new_idx, x.values, shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    """Sum over axes; returns dense Tensor (reference returns 0-D sparse for
+    full reduction — dense is the XLA-natural result and densifies a scalar
+    anyway; per-axis sums densify like the reference's)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if axis is None:
+        out = apply_op(lambda v: jnp.sum(v, dtype=dtype), x.values)
+        return out
+    return x.to_dense().sum(axis=axis, keepdim=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# binary
+# ---------------------------------------------------------------------------
+
+def _coo_elementwise(a: SparseCooTensor, b: SparseCooTensor, jfn, name):
+    assert a.shape == b.shape, f"{name}: shape mismatch {a.shape}/{b.shape}"
+    # union of supports: concatenate then coalesce; the combining op is
+    # addition-like on the union (paddle semantics for add/subtract; mul/div
+    # only defined where supports overlap — realized densely for exactness)
+    idx = jnp.concatenate([a.indices, b.indices], axis=1)
+    merged = SparseCooTensor(
+        idx, apply_op(lambda va, vb: jnp.concatenate([jfn(va, jnp.zeros_like(
+            va)), jfn(jnp.zeros_like(vb), vb)]), a.values, b.values),
+        a.shape)
+    return coalesce(merged)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCsrTensor):
+        if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+            yc = y.to_sparse_coo() if isinstance(y, SparseCsrTensor) else y
+            return _coo_elementwise(x.to_sparse_coo(), yc, jnp.add,
+                                    "add").to_sparse_csr()
+        return apply_op(jnp.add, x.to_dense(), y)  # sparse + dense → dense
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _coo_elementwise(x, y, jnp.add, "add")
+    if isinstance(x, SparseCooTensor):
+        return apply_op(jnp.add, x.to_dense(), y)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return apply_op(jnp.add, x, y.to_dense())
+    return apply_op(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return add(x, _neg_sparse(y))
+    return apply_op(jnp.subtract, x.to_dense() if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else x, y)
+
+
+def _neg_sparse(y):
+    return neg(y)
+
+
+def multiply(x, y, name=None):
+    """Elementwise; sparse*scalar stays sparse. sparse*sparse keeps x's
+    support (static nnz: entries where y is implicitly zero are stored
+    zeros — dense semantics identical, shapes stable across batches)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and np.isscalar(y):
+        return _unary_factory("scale", lambda v: v * y)(x)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(y, SparseCsrTensor):
+        y = y.to_sparse_coo()
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        xc = coalesce(x)
+        gather = tuple(xc.indices)
+        yd = y.to_dense()
+        vals = apply_op(lambda v, d: v * d[gather], xc.values, yd)
+        return SparseCooTensor(xc.indices, vals, xc.shape, coalesced=True)
+    return apply_op(jnp.multiply, x.to_dense() if isinstance(
+        x, SparseCooTensor) else x, y)
+
+
+def divide(x, y, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and np.isscalar(y):
+        return _unary_factory("scale", lambda v: v / y)(x)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor,
+                                        SparseCsrTensor)) else y
+    return apply_op(jnp.divide, xd, yd)
+
+
+# ---------------------------------------------------------------------------
+# matmul family — the TPU-relevant kernels (gather + segment_sum on MXU/VPU)
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense. COO: rows scatter-add of values[:,None] *
+    y[cols]; CSR identically via expanded row ids. Reference kernels:
+    paddle/phi/kernels/sparse/gpu/matmul_kernel.cu (cuSPARSE SpMM)."""
+    if isinstance(x, SparseCsrTensor):
+        rows, cols = x._row_indices(), x.cols
+        shape = x.shape
+        vals = x.values
+    elif isinstance(x, SparseCooTensor):
+        assert x.indices.shape[0] == 2, "matmul: 2-D sparse only"
+        rows, cols = x.indices[0], x.indices[1]
+        shape = x.shape
+        vals = x.values
+    else:  # dense @ sparse
+        assert isinstance(y, (SparseCooTensor, SparseCsrTensor))
+        # x @ S == (S^T @ x^T)^T ; S^T swaps rows/cols
+        yt = y.to_sparse_coo() if isinstance(y, SparseCsrTensor) else y
+        st = SparseCooTensor(jnp.stack([yt.indices[1], yt.indices[0]]),
+                             yt.values, (yt.shape[1], yt.shape[0]))
+        xt = apply_op(lambda a: jnp.swapaxes(a, -1, -2), x)
+        return apply_op(lambda a: jnp.swapaxes(a, -1, -2), matmul(st, xt))
+
+    n_rows = shape[0]
+
+    def spmm(v, d):
+        gathered = jnp.take(d, cols, axis=0)          # [nnz, N]
+        contrib = v[:, None] * gathered               # [nnz, N]
+        return jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+    return apply_op(spmm, vals, y)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (SDDMM). Reference:
+    paddle/phi/kernels/sparse/gpu/masked_matmul_kernel.cu (cuSPARSE SDDMM).
+    TPU realization: two gathers + a row-wise dot on the VPU."""
+    if isinstance(mask, SparseCsrTensor):
+        rows, cols = mask._row_indices(), mask.cols
+        out_is_csr = True
+    else:
+        rows, cols = mask.indices[0], mask.indices[1]
+        out_is_csr = False
+
+    def sddmm(a, b):
+        ar = jnp.take(a, rows, axis=0)                # [nnz, K]
+        bc = jnp.take(b, cols, axis=1).T              # [nnz, K]
+        return jnp.sum(ar * bc, axis=-1)
+    vals = apply_op(sddmm, x, y)
+    if out_is_csr:
+        return SparseCsrTensor(mask.crows, mask.cols, vals, mask.shape)
+    return SparseCooTensor(mask.indices, vals, mask.shape,
+                           coalesced=mask._coalesced)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) with sparse x (reference multiary.py)."""
+    prod = matmul(x, y)
+    inp = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    return apply_op(lambda i, p: beta * i + alpha * p, inp, prod)

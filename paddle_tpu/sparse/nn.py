@@ -1,0 +1,271 @@
+"""paddle.sparse.nn — layers over sparse tensors.
+
+Parity surface: python/paddle/sparse/nn/ (ReLU, ReLU6, LeakyReLU, Softmax,
+Conv3D, SubmConv3D, BatchNorm, MaxPool3D; CUDA kernels under
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu build a gather-scatter
+"rulebook" then GEMM per kernel offset).
+
+TPU-first realization of sparse conv: the rulebook (which input nnz feeds
+which output nnz per kernel offset) is STRUCTURE, not data — build it on
+host in numpy at call time, then run the per-offset gather → [pairs, Cin] ×
+[Cin, Cout] MXU matmul → segment_sum scatter on device. Static pair counts
+per offset keep XLA shapes fixed."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "Conv3D", "SubmConv3D", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import _unary_factory
+        return _unary_factory("relu6", lambda v: jnp.clip(v, 0, 6))(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = float(negative_slope)
+
+    def forward(self, x):
+        from . import _unary_factory
+        s = self.negative_slope
+        return _unary_factory(
+            "leaky_relu", lambda v: jnp.where(v >= 0, v, s * v))(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D CSR's stored values (reference:
+    sparse softmax ignores implicit zeros — normalization runs over the
+    stored entries of each row only)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        assert axis == -1, "sparse softmax supports the last axis"
+
+    def forward(self, x):
+        from . import SparseCsrTensor
+        assert isinstance(x, SparseCsrTensor), "Softmax expects CSR"
+        rows = x._row_indices()
+        n = x.shape[0]
+
+        def rowsoft(v):
+            mx = jax.ops.segment_max(v, rows, num_segments=n)
+            e = jnp.exp(v - mx[rows])
+            z = jax.ops.segment_sum(e, rows, num_segments=n)
+            return e / z[rows]
+        vals = apply_op(rowsoft, x.values)
+        return SparseCsrTensor(x.crows, x.cols, vals, x.shape)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the dense channel dim of COO values [nnz, C]
+    (reference: sparse BN normalizes over stored points per channel)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self.eps = float(epsilon)
+        self.momentum = float(momentum)
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], default_initializer=Constant(0.0))
+        self._mean = jnp.zeros(num_features)
+        self._var = jnp.ones(num_features)
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        assert isinstance(x, SparseCooTensor)
+        eps = self.eps
+        if self.training:
+            def bn(v, w, b):
+                m = v.mean(axis=0)
+                var = v.var(axis=0)
+                return (v - m) * jax.lax.rsqrt(var + eps) * w + b
+            vals = apply_op(bn, x.values, self.weight, self.bias)
+            vnp = np.asarray(x.values._data)
+            self._mean = (self.momentum * self._mean
+                          + (1 - self.momentum) * vnp.mean(axis=0))
+            self._var = (self.momentum * self._var
+                         + (1 - self.momentum) * vnp.var(axis=0))
+        else:
+            m, var = self._mean, self._var
+
+            def bn(v, w, b):
+                return (v - m) * jax.lax.rsqrt(var + eps) * w + b
+            vals = apply_op(bn, x.values, self.weight, self.bias)
+        return SparseCooTensor(x.indices, vals, x.shape,
+                               coalesced=x._coalesced)
+
+
+def _build_rulebook(coords: np.ndarray, spatial, ksize, stride, padding,
+                    subm: bool):
+    """Host-side rulebook: for each kernel offset, (input_slot, output_slot)
+    pairs. coords: [nnz, 4] (batch, z, y, x). Returns (out_coords [m,4],
+    rules {offset_idx: (in_idx array, out_idx array)})."""
+    ks = np.array(ksize)
+    st = np.array(stride)
+    pad = np.array(padding)
+    in_map = {tuple(c): i for i, c in enumerate(coords.tolist())}
+
+    if subm:
+        out_coords = coords
+        out_map = in_map
+    else:
+        out_set = {}
+        out_sp = tuple((np.array(spatial) + 2 * pad - ks) // st + 1)
+        for c in coords:
+            b, z, y, x = c
+            for dz in range(ks[0]):
+                for dy in range(ks[1]):
+                    for dx in range(ks[2]):
+                        oz, rz = divmod(z + pad[0] - dz, st[0])
+                        oy, ry = divmod(y + pad[1] - dy, st[1])
+                        ox, rx = divmod(x + pad[2] - dx, st[2])
+                        if rz or ry or rx:
+                            continue
+                        if (0 <= oz < out_sp[0] and 0 <= oy < out_sp[1]
+                                and 0 <= ox < out_sp[2]):
+                            out_set.setdefault((b, oz, oy, ox),
+                                               len(out_set))
+        out_coords = np.array(sorted(out_set, key=out_set.get), np.int32)
+        if len(out_coords) == 0:
+            out_coords = out_coords.reshape(0, 4)
+        out_map = {tuple(c): i for i, c in enumerate(out_coords.tolist())}
+        spatial = out_sp
+
+    rules = {}
+    k_idx = 0
+    for dz in range(ks[0]):
+        for dy in range(ks[1]):
+            for dx in range(ks[2]):
+                ins, outs = [], []
+                for oc, oi in out_map.items():
+                    b, oz, oy, ox = oc
+                    iz = oz * st[0] - pad[0] + dz if not subm else oz + dz - ks[0] // 2
+                    iy = oy * st[1] - pad[1] + dy if not subm else oy + dy - ks[1] // 2
+                    ix = ox * st[2] - pad[2] + dx if not subm else ox + dx - ks[2] // 2
+                    ii = in_map.get((b, iz, iy, ix))
+                    if ii is not None:
+                        ins.append(ii)
+                        outs.append(oi)
+                if ins:
+                    rules[k_idx] = (np.array(ins, np.int32),
+                                    np.array(outs, np.int32))
+                k_idx += 1
+    return out_coords, rules, tuple(int(s) for s in spatial)
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, subm=False):
+        super().__init__()
+        def _3(v):
+            return (v,) * 3 if isinstance(v, int) else tuple(v)
+        self.ksize = _3(kernel_size)
+        self.stride = _3(stride)
+        self.padding = _3(padding)
+        self.subm = subm
+        from ..nn.initializer import Constant, Uniform
+        kvol = int(np.prod(self.ksize))
+        scale = 1.0 / np.sqrt(in_channels * kvol)
+        self.weight = self.create_parameter(
+            [kvol, in_channels, out_channels],
+            default_initializer=Uniform(-scale, scale))
+        self.bias = self.create_parameter(
+            [out_channels], default_initializer=Constant(0.0))
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        assert isinstance(x, SparseCooTensor)
+        assert x.indices.shape[0] == 4, \
+            "sparse conv expects NDHWC coords [batch,z,y,x] + channel values"
+        coords = np.asarray(x.indices).T  # [nnz, 4]
+        spatial = x.shape[1:4]
+        out_coords, rules, out_spatial = _build_rulebook(
+            coords, spatial, self.ksize, self.stride, self.padding,
+            self.subm)
+        m = len(out_coords)
+        cout = self.weight.shape[-1]
+        rule_items = sorted(rules.items())
+
+        def conv(v, w, b):
+            out = jnp.zeros((m, cout), v.dtype)
+            for k, (ins, outs) in rule_items:
+                gathered = jnp.take(v, jnp.asarray(ins), axis=0)
+                contrib = gathered @ w[k]
+                out = out + jax.ops.segment_sum(
+                    contrib, jnp.asarray(outs), num_segments=m)
+            return out + b
+        vals = apply_op(conv, x.values, self.weight, self.bias)
+        new_shape = (x.shape[0], *out_spatial, cout)
+        return SparseCooTensor(out_coords.T, vals, new_shape,
+                               coalesced=True)
+
+
+class Conv3D(_SparseConvBase):
+    """Sparse 3-D convolution over COO NDHWC point clouds."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, subm=False)
+
+
+class SubmConv3D(_SparseConvBase):
+    """Submanifold sparse conv: output support == input support (stride 1),
+    preventing dilation of the active site set."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, **kw):
+        assert (stride == 1 or tuple(np.atleast_1d(stride)) == (1, 1, 1)), \
+            "SubmConv3D requires stride 1"
+        super().__init__(in_channels, out_channels, kernel_size, 1,
+                         padding, subm=True)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool over COO NDHWC: rulebook + segment_max."""
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        def _3(v):
+            return (v,) * 3 if isinstance(v, int) else tuple(v)
+        self.ksize = _3(kernel_size)
+        self.stride = _3(stride if stride is not None else kernel_size)
+        self.padding = _3(padding)
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        coords = np.asarray(x.indices).T
+        out_coords, rules, out_spatial = _build_rulebook(
+            coords, x.shape[1:4], self.ksize, self.stride, self.padding,
+            subm=False)
+        m = len(out_coords)
+        rule_items = sorted(rules.items())
+
+        def pool(v):
+            out = jnp.full((m, v.shape[-1]), -jnp.inf, v.dtype)
+            for k, (ins, outs) in rule_items:
+                g = jnp.take(v, jnp.asarray(ins), axis=0)
+                out = jnp.maximum(out, jax.ops.segment_max(
+                    g, jnp.asarray(outs), num_segments=m))
+            return out
+        vals = apply_op(pool, x.values)
+        new_shape = (x.shape[0], *out_spatial, x.shape[-1])
+        return SparseCooTensor(out_coords.T, vals, new_shape,
+                               coalesced=True)

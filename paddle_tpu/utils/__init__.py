@@ -1,0 +1,113 @@
+"""paddle.utils. Parity: python/paddle/utils/ — deprecated decorator,
+try_import/require_version, dlpack bridge, nested-structure helpers
+(flatten/pack_sequence_as/map_structure), run_check install check, and the
+download helpers (offline: local cache only, zero-egress environment)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import os
+import warnings
+
+from . import unique_name
+from . import download
+from . import dlpack
+from . import cpp_extension
+
+__all__ = ["deprecated", "try_import", "require_version", "run_check",
+           "flatten", "pack_sequence_as", "map_structure", "unique_name",
+           "download", "dlpack", "cpp_extension"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """Mark an API deprecated; warns once per call site like the reference
+    (python/paddle/utils/deprecated.py)."""
+
+    def decorator(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            def dead(*a, **k):
+                raise RuntimeError(msg)
+            return functools.wraps(fn)(dead)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__doc__ = (fn.__doc__ or "") + f"\n\n.. deprecated:: {msg}"
+        return wrapper
+    return decorator
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    """Import a soft dependency, raising a friendly error if absent."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Optional dependency '{module_name}' is required "
+            f"for this API; it is not installed in this environment.")
+
+
+def require_version(min_version: str, max_version: str | None = None):
+    """Check the installed framework version against [min, max]."""
+    from ..version import full_version
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = _tup(full_version)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"version {full_version} < required minimum {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"version {full_version} > allowed maximum {max_version}")
+    return True
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — verify the install can compile and
+    run a matmul on the current backend, and report device count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = len(jax.devices())
+    plat = jax.devices()[0].platform
+    x = jnp.ones((4, 4))
+    y = jax.jit(lambda a: a @ a)(x)
+    assert np.allclose(np.asarray(y), 4.0)
+    print(f"PaddleTPU works well on 1 {plat} device.")
+    if n > 1:
+        print(f"PaddleTPU works well on {n} {plat} devices.")
+    print("PaddleTPU is installed successfully!")
+
+
+# ---- nested structure helpers (python/paddle/utils/layers_utils.py) ----
+
+def flatten(nest):
+    """Flatten a nested structure (dict/list/tuple) into a flat list,
+    matching paddle.utils.flatten ordering (dicts by insertion order)."""
+    import jax
+    return jax.tree.leaves(nest, is_leaf=lambda x: x is None)
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Inverse of flatten: pack a flat list back into the given structure."""
+    import jax
+    treedef = jax.tree.structure(structure, is_leaf=lambda x: x is None)
+    return jax.tree.unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structures):
+    """Apply func leaf-wise across parallel nested structures."""
+    import jax
+    return jax.tree.map(func, *structures)

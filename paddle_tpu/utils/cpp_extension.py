@@ -1,0 +1,81 @@
+"""paddle.utils.cpp_extension. Parity: python/paddle/utils/cpp_extension/ ::
+load, CppExtension, setup — JIT-compile a C++ sources list into a shared
+library and expose its functions. pybind11 is not in this image, so the ABI
+is plain-C (extern "C") loaded via ctypes — the same binding strategy as the
+framework's own native runtime (paddle_tpu/core/native.py, csrc/runtime.cc)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig as _pysysconfig
+import tempfile
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup",
+           "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources: list[str], extra_cxx_cflags=None,
+         extra_ldflags=None, verbose: bool = False,
+         build_directory: str | None = None):
+    """Compile sources into lib{name}.so and return a ctypes.CDLL handle.
+
+    Functions must be declared extern "C"; callers attach argtypes/restype
+    themselves (ctypes binding model, not pybind11 auto-binding)."""
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    key = hashlib.sha1(("|".join(srcs) + repr(extra_cxx_cflags)
+                        + repr(extra_ldflags)).encode())
+    for s in srcs:
+        with open(s, "rb") as f:
+            key.update(f.read())
+    out = os.path.join(build_dir, f"lib{name}_{key.hexdigest()[:12]}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               "-o", out, *srcs,
+               "-I", _pysysconfig.get_paths()["include"],
+               *(extra_cxx_cflags or []), *(extra_ldflags or []),
+               "-lpthread"]
+        if verbose:
+            print(" ".join(cmd))
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{res.stderr}")
+    return ctypes.CDLL(out)
+
+
+class CppExtension:
+    """Declarative extension spec for setup() (setuptools-compatible)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+        self.name = kwargs.get("name")
+
+
+def CUDAExtension(sources, *args, **kwargs):  # pragma: no cover - no CUDA
+    raise RuntimeError(
+        "CUDAExtension is not supported on the TPU build; write a Pallas "
+        "kernel (paddle_tpu/ops/pallas/) or a C++ host extension instead.")
+
+
+def setup(name: str, ext_modules=None, **kwargs):
+    """Build each CppExtension eagerly into the extension dir (the
+    reference delegates to setuptools; here load() is the builder)."""
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    return [load(ext.name or name, ext.sources,
+                 extra_cxx_cflags=ext.kwargs.get("extra_cxx_cflags"),
+                 extra_ldflags=ext.kwargs.get("extra_ldflags"))
+            for ext in exts]

@@ -1,0 +1,49 @@
+"""paddle.utils.dlpack. Parity: python/paddle/utils/dlpack.py ::
+to_dlpack, from_dlpack — tensor exchange via the DLPack protocol.
+
+jax.Array speaks __dlpack__ natively on CPU/GPU (zero-copy). TPU buffers are
+not DLPack-addressable (the protocol has no TPU device type), so on TPU the
+bridge transfers through host memory — the same data path the reference's
+GPU→CPU interop takes, minus the zero-copy."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def _host_if_tpu(arr):
+    try:
+        platform = arr.devices().pop().platform if hasattr(
+            arr, "devices") else "cpu"
+    except Exception:
+        platform = "cpu"
+    if platform not in ("cpu", "gpu", "cuda", "rocm"):
+        # writable host copy: TPU is outside DLPack's device model, and a
+        # read-only np view cannot be exported through the protocol
+        return np.array(arr)
+    return arr
+
+
+def to_dlpack(x: Tensor):
+    """Export a tensor as a DLPack capsule (consumable by torch/numpy)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return _host_if_tpu(arr).__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    """Import a DLPack capsule or any __dlpack__-bearing object."""
+    if hasattr(capsule, "__dlpack__") and not _is_capsule(capsule):
+        arr = jnp.from_dlpack(_host_if_tpu(capsule))
+    else:
+        # raw capsule: route through jax's dlpack importer
+        from jax import dlpack as jdlpack
+        arr = jdlpack.from_dlpack(capsule)
+    return Tensor(arr)
+
+
+def _is_capsule(obj) -> bool:
+    return type(obj).__name__ == "PyCapsule"

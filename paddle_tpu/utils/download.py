@@ -1,0 +1,56 @@
+"""paddle.utils.download. Parity: python/paddle/utils/download.py ::
+get_weights_path_from_url, get_path_from_url — resolved against the local
+cache ONLY (this environment has zero egress; a cache miss is an error that
+names the expected path rather than a silent hang)."""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DOWNLOAD_HOME = osp.expanduser("~/.cache/paddle/dataset")
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True) -> str:
+    """Map url → {root_dir}/{basename}; require it to already exist locally
+    (offline environment). Decompression of archives is handled by the
+    caller in the reference; here a pre-extracted directory also counts."""
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    # pre-extracted directory (reference decompresses then returns the dir)
+    stem = fullname
+    for ext in (".tar.gz", ".tgz", ".tar", ".zip"):
+        if stem.endswith(ext):
+            stem = stem[:-len(ext)]
+            break
+    if osp.isdir(stem):
+        return stem
+    if osp.exists(fullname):
+        if check_exist and not _md5check(fullname, md5sum):
+            raise RuntimeError(
+                f"md5 mismatch for cached file {fullname}; remove it and "
+                f"re-provision")
+        return fullname
+    raise RuntimeError(
+        f"cannot download {url}: this environment has no network access. "
+        f"Place the file at {fullname} (or the extracted dir at {stem}) "
+        f"and retry.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """Resolve a pretrained-weights url against the local weights cache."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,47 @@
+"""paddle.utils.unique_name. Parity: python/paddle/utils/unique_name.py ::
+generate, guard, switch — process-wide unique name generator used by Layer
+parameter naming and static-graph variable naming."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids: dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids.setdefault(key, 0)
+        self.ids[key] = tmp + 1
+        return f"{key}_{tmp}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    """Return a unique name of the form ``{key}_{N}``."""
+    return generator(key)
+
+
+def switch(new_generator: UniqueNameGenerator | None = None):
+    """Swap the process-wide generator; returns the old one."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh generator (names restart inside the with-block)."""
+    if isinstance(new_generator, str) or new_generator is None:
+        new_generator = UniqueNameGenerator()
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,293 @@
+"""paddle.audio / paddle.geometric / paddle.quantization parity tests.
+NumPy oracles per SURVEY §4. Reference surfaces: python/paddle/audio/,
+python/paddle/geometric/, python/paddle/quantization/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, quantization as Q
+
+
+# --------------------------------------------------------------------------
+# audio
+# --------------------------------------------------------------------------
+
+def test_mel_hz_roundtrip_both_conventions():
+    for htk in (False, True):
+        f = np.array([0.0, 440.0, 1000.0, 4000.0], np.float32)
+        m = audio.functional.hz_to_mel(paddle.to_tensor(f), htk=htk)
+        back = audio.functional.mel_to_hz(m, htk=htk)
+        np.testing.assert_allclose(np.asarray(back._data), f, rtol=1e-3,
+                                   atol=1e-2)
+
+
+def test_fbank_matrix_shape_and_partition():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=256,
+                                               n_mels=20)
+    arr = np.asarray(fb._data)
+    assert arr.shape == (20, 129)
+    assert (arr >= 0).all()
+    # every filter has some support
+    assert (arr.sum(axis=1) > 0).all()
+
+
+def test_power_to_db_matches_oracle():
+    s = np.abs(np.random.RandomState(0).randn(8, 8)).astype(np.float32)
+    out = audio.functional.power_to_db(paddle.to_tensor(s), top_db=None)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               10 * np.log10(np.maximum(s, 1e-10)),
+                               rtol=1e-5)
+
+
+def test_get_window_hann_matches_numpy():
+    w = audio.functional.get_window("hann", 16, fftbins=True)
+    np.testing.assert_allclose(np.asarray(w._data), np.hanning(17)[:-1],
+                               atol=1e-6)
+
+
+def test_spectrogram_parsevalish_and_shapes():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 400).astype(np.float32))
+    spec = audio.features.Spectrogram(n_fft=128, hop_length=64)(x)
+    assert list(spec.shape) == [2, 65, 1 + 400 // 64]
+    mel = audio.features.MelSpectrogram(sr=8000, n_fft=128, hop_length=64,
+                                        n_mels=20, f_min=0.0)(x)
+    assert list(mel.shape) == [2, 20, 1 + 400 // 64]
+    mfcc = audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=128,
+                               hop_length=64, n_mels=20, f_min=0.0)(x)
+    assert list(mfcc.shape) == [2, 13, 1 + 400 // 64]
+    assert np.isfinite(np.asarray(mfcc._data)).all()
+
+
+def test_spectrogram_pure_tone_peak_bin():
+    sr, n_fft = 8000, 256
+    t = np.arange(sr, dtype=np.float32) / sr
+    tone = np.sin(2 * np.pi * 1000.0 * t)[:2048]
+    spec = audio.features.Spectrogram(n_fft=n_fft, hop_length=n_fft)(
+        paddle.to_tensor(tone[None]))
+    mag = np.asarray(spec._data)[0].mean(axis=-1)
+    # 1 kHz → bin 1000/8000*256 = 32
+    assert abs(int(mag.argmax()) - 32) <= 1
+
+
+def test_wav_save_load_roundtrip(tmp_path):
+    sr = 8000
+    x = (0.5 * np.sin(np.linspace(0, 100, 1600))).astype(np.float32)
+    path = str(tmp_path / "t.wav")
+    audio.save(path, paddle.to_tensor(x[None, :]), sr)
+    y, sr2 = audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(y._data)[0], x, atol=1e-3)
+    meta = audio.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    assert meta.num_samples == 1600
+
+
+# --------------------------------------------------------------------------
+# geometric
+# --------------------------------------------------------------------------
+
+def test_segment_ops_match_numpy():
+    rng = np.random.RandomState(2)
+    data = rng.randn(10, 3).astype(np.float32)
+    seg = np.array([0, 0, 1, 1, 1, 2, 2, 3, 3, 3])
+    x = paddle.to_tensor(data)
+    for name, red in [("segment_sum", np.add.reduce),
+                      ("segment_mean", lambda a: a.mean(axis=0)),
+                      ("segment_max", lambda a: a.max(axis=0)),
+                      ("segment_min", lambda a: a.min(axis=0))]:
+        out = getattr(geometric, name)(x, paddle.to_tensor(seg))
+        expect = np.stack([
+            red(data[seg == s]) if name != "segment_sum"
+            else data[seg == s].sum(axis=0) for s in range(4)])
+        np.testing.assert_allclose(np.asarray(out._data), expect, rtol=1e-5,
+                                   err_msg=name)
+
+
+def test_send_u_recv_sum_and_mean():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = np.array([0, 1, 2, 0])
+    dst = np.array([1, 2, 1, 0])
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    expect = np.zeros((4, 3), np.float32)
+    for s, d in zip(src, dst):
+        expect[d] += np.asarray(x._data)[s]
+    np.testing.assert_allclose(np.asarray(out._data), expect, rtol=1e-6)
+    out_mean = geometric.send_u_recv(x, src, dst, reduce_op="mean")
+    cnt = np.bincount(dst, minlength=4)[:, None].clip(1)
+    np.testing.assert_allclose(np.asarray(out_mean._data), expect / cnt,
+                               rtol=1e-6)
+
+
+def test_send_ue_recv_and_send_uv():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(4, 2).astype(np.float32))
+    e = paddle.to_tensor(rng.randn(3, 2).astype(np.float32))
+    src = np.array([0, 1, 3])
+    dst = np.array([2, 2, 0])
+    out = geometric.send_ue_recv(x, e, src, dst, message_op="mul",
+                                 reduce_op="max")
+    xa, ea = np.asarray(x._data), np.asarray(e._data)
+    msg = xa[src] * ea
+    expect = np.zeros((4, 2), np.float32)
+    for d in range(4):
+        rows = msg[dst == d]
+        if len(rows):
+            expect[d] = rows.max(axis=0)
+    np.testing.assert_allclose(np.asarray(out._data), expect, rtol=1e-5)
+    uv = geometric.send_uv(x, x, src, dst, message_op="add")
+    np.testing.assert_allclose(np.asarray(uv._data), xa[src] + xa[dst],
+                               rtol=1e-6)
+
+
+def test_send_u_recv_grad():
+    x = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    out = geometric.send_u_recv(x, np.array([0, 0, 1]),
+                                np.array([1, 2, 0]))
+    out.sum().backward()
+    # node 0 sent twice, node 1 once, node 2 never
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               [[2, 2], [1, 1], [0, 0]])
+
+
+def test_reindex_graph():
+    x = np.array([10, 5])
+    neighbors = np.array([7, 10, 5, 9])
+    count = np.array([2, 2])
+    src, dst, nodes = geometric.reindex_graph(
+        paddle.to_tensor(x), paddle.to_tensor(neighbors),
+        paddle.to_tensor(count))
+    nodes = np.asarray(nodes._data)
+    # seeds keep their position
+    assert nodes[0] == 10 and nodes[1] == 5
+    mapping = {int(v): i for i, v in enumerate(nodes)}
+    np.testing.assert_array_equal(np.asarray(src._data),
+                                  [mapping[7], 0, 1, mapping[9]])
+    np.testing.assert_array_equal(np.asarray(dst._data), [0, 0, 1, 1])
+
+
+def test_sample_neighbors():
+    # CSC: node0 → {1,2,3}, node1 → {0}, node2 → {}
+    row = np.array([1, 2, 3, 0])
+    colptr = np.array([0, 3, 4, 4])
+    nbrs, cnt = geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0, 1, 2])), sample_size=2)
+    cnt = np.asarray(cnt._data)
+    assert cnt.tolist() == [2, 1, 0]
+    sampled = np.asarray(nbrs._data)[:2]
+    assert set(sampled.tolist()) <= {1, 2, 3}
+
+
+# --------------------------------------------------------------------------
+# quantization
+# --------------------------------------------------------------------------
+
+def test_fake_quanter_ste_grad_and_levels():
+    fq = Q.FakeQuanterWithAbsMaxObserver(bit_length=8)
+    x = paddle.to_tensor(np.linspace(-1, 1, 64).astype(np.float32),
+                         stop_gradient=False)
+    out = fq(x)
+    arr = np.asarray(out._data)
+    # quantized to at most 255 distinct levels, near-identity overall
+    assert len(np.unique(np.round(arr, 6))) <= 255
+    np.testing.assert_allclose(arr, np.asarray(x._data), atol=1.5 / 127)
+    out.sum().backward()
+    # STE: gradient of identity
+    np.testing.assert_allclose(np.asarray(x.grad._data), 1.0, atol=1e-6)
+
+
+def test_qat_quantize_swaps_linear_and_trains():
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    cfg = Q.QuantConfig(activation=Q.quanter(bit_length=8),
+                        weight=Q.quanter(bit_length=8))
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model, inplace=True)
+    quanted = [l for l in qmodel.sublayers()
+               if isinstance(l, Q.QuantedLinear)]
+    assert len(quanted) == 2
+    opt = paddle.optimizer.SGD(0.1, parameters=qmodel.parameters())
+    x = paddle.to_tensor(np.random.RandomState(4).randn(4, 8).astype(
+        np.float32))
+    losses = []
+    for _ in range(5):
+        loss = (qmodel(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+    # scales observed during training
+    assert float(np.asarray(quanted[0].weight_quanter.scales()._data)) > 0
+
+
+def test_ptq_observe_then_convert():
+    paddle.seed(1)
+    model = paddle.nn.Sequential(paddle.nn.Linear(6, 6))
+    ptq = Q.PTQ(Q.QuantConfig(activation=Q.quanter(Q.AbsmaxObserver),
+                              weight=Q.quanter(Q.AbsmaxObserver)))
+    pmodel = ptq.quantize(model, inplace=True)
+    x = paddle.to_tensor(np.random.RandomState(5).randn(8, 6).astype(
+        np.float32))
+    ref = np.asarray(pmodel(x)._data)   # observers are pass-through
+    converted = ptq.convert(pmodel, inplace=True)
+    out = np.asarray(converted(x)._data)
+    # int8 fake-quant should track the fp32 output closely
+    np.testing.assert_allclose(out, ref, atol=0.1, rtol=0.2)
+    ql = [l for l in converted.sublayers()
+          if isinstance(l, Q.QuantedLinear)][0]
+    assert isinstance(ql.activation_quanter,
+                      Q.FakeQuanterWithAbsMaxObserver)
+    assert float(np.asarray(ql.activation_quanter.scales()._data)) > 0
+
+
+def test_quant_config_type_and_layer_targeting():
+    l1, l2 = paddle.nn.Linear(4, 4), paddle.nn.Linear(4, 4)
+    model = paddle.nn.Sequential(l1, l2)
+    cfg = Q.QuantConfig()
+    cfg.add_layer_config(l1, activation=Q.quanter(bit_length=8),
+                         weight=Q.quanter(bit_length=8))
+    Q.QAT(cfg).quantize(model, inplace=True)
+    kinds = [type(l).__name__ for l in model.sublayers()]
+    assert kinds.count("QuantedLinear") == 1
+
+
+def test_segment_max_int_empty_segment_zero():
+    data = paddle.to_tensor(np.array([[5], [7]], np.int32))
+    ids = np.array([0, 2])
+    out = geometric.segment_max(data, paddle.to_tensor(ids))
+    np.testing.assert_array_equal(np.asarray(out._data), [[5], [0], [7]])
+    out2 = geometric.segment_min(data, paddle.to_tensor(ids))
+    np.testing.assert_array_equal(np.asarray(out2._data), [[5], [0], [7]])
+
+
+def test_qat_model_inside_to_static():
+    paddle.seed(3)
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    Q.QAT(Q.QuantConfig(activation=Q.quanter(), weight=Q.quanter())
+          ).quantize(model, inplace=True)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(np.random.RandomState(6).randn(4, 8).astype(
+        np.float32))
+    l0 = float(np.asarray(step(x)._data))
+    l1 = float(np.asarray(step(x)._data))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_xmap_readers_propagates_mapper_error():
+    def bad(s):
+        raise ValueError("boom")
+    r = paddle.reader.xmap_readers(bad, lambda: iter(range(4)), 2, 4)
+    with pytest.raises(ValueError, match="boom"):
+        list(r())

@@ -1,0 +1,274 @@
+"""paddle.sparse parity tests — dense NumPy oracles (SURVEY §4 OpTest
+pattern). Reference surface: python/paddle/sparse/ + sparse Phi kernels."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0, with_dups=False):
+    rng = np.random.RandomState(seed)
+    # unique positions (duplicate cells only when with_dups: unary oracles
+    # assume one value per cell, since f(a+b) != f(a)+f(b))
+    cells = rng.choice(int(np.prod(shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(cells, shape)).astype(np.int32)
+    vals = rng.randn(nnz).astype(np.float32)
+    if with_dups:
+        idx = np.concatenate([idx, idx[:, :2]], axis=1)
+        vals = np.concatenate([vals, rng.randn(2).astype(np.float32)])
+    dense = np.zeros(shape, np.float32)
+    np.add.at(dense, tuple(idx), vals)
+    return idx, vals, dense
+
+
+def test_coo_create_to_dense_roundtrip():
+    idx, vals, dense = _rand_coo(with_dups=True)
+    s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    np.testing.assert_allclose(np.asarray(s.to_dense()._data), dense,
+                               rtol=1e-6)
+    s2 = paddle.to_tensor(dense).to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(s2.to_dense()._data), dense,
+                               rtol=1e-6)
+    assert s2.is_sparse_coo() and not s2.is_sparse_csr()
+
+
+def test_csr_roundtrip_and_conversion():
+    idx, vals, dense = _rand_coo(shape=(5, 7), nnz=8, seed=1)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(np.asarray(csr.to_dense()._data), dense,
+                               rtol=1e-6)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(np.asarray(back.to_dense()._data), dense,
+                               rtol=1e-6)
+    # crows is a proper prefix-sum
+    assert csr.crows.shape[0] == dense.shape[0] + 1
+    assert int(csr.crows[-1]) == csr.nnz
+
+
+def test_coalesce_sums_duplicates():
+    idx, vals, dense = _rand_coo(with_dups=True)
+    s = sparse.coalesce(sparse.sparse_coo_tensor(idx, vals, dense.shape))
+    # coalesced: unique indices
+    flat = np.ravel_multi_index(np.asarray(s.indices), dense.shape)
+    assert len(np.unique(flat)) == len(flat)
+    np.testing.assert_allclose(np.asarray(s.to_dense()._data), dense,
+                               rtol=1e-6)
+
+
+def test_sparse_unary_matches_dense():
+    idx, vals, dense = _rand_coo(seed=2)
+    s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    for name in ["sin", "tanh", "square", "abs", "neg", "expm1", "relu"]:
+        out = getattr(sparse, name)(s)
+        ref = getattr(np, name, None)
+        if name == "neg":
+            expect = -dense
+        elif name == "relu":
+            expect = np.maximum(dense, 0)
+        else:
+            expect = ref(dense)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._data), expect,
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"sparse.{name}")
+
+
+def test_sparse_add_subtract():
+    _, _, d1 = _rand_coo(seed=3)
+    _, _, d2 = _rand_coo(seed=4)
+    s1 = paddle.to_tensor(d1).to_sparse_coo()
+    s2 = paddle.to_tensor(d2).to_sparse_coo()
+    np.testing.assert_allclose(
+        np.asarray(sparse.add(s1, s2).to_dense()._data), d1 + d2, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.subtract(s1, s2).to_dense()._data), d1 - d2,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.multiply(s1, s2).to_dense()._data), d1 * d2,
+        rtol=1e-6)
+
+
+def test_sparse_matmul_coo_and_csr():
+    idx, vals, dense = _rand_coo(shape=(4, 6), nnz=7, seed=5)
+    y = np.random.RandomState(6).randn(6, 3).astype(np.float32)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    out = sparse.matmul(coo, paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out._data), dense @ y, rtol=1e-5,
+                               atol=1e-5)
+    csr = coo.to_sparse_csr()
+    out2 = sparse.matmul(csr, paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out2._data), dense @ y, rtol=1e-5,
+                               atol=1e-5)
+    # dense @ sparse
+    x = np.random.RandomState(7).randn(3, 4).astype(np.float32)
+    out3 = sparse.matmul(paddle.to_tensor(x), coo)
+    np.testing.assert_allclose(np.asarray(out3._data), x @ dense, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_sparse_matmul_grad_flows_to_dense_operand():
+    idx, vals, dense = _rand_coo(shape=(3, 4), nnz=5, seed=8)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    y = paddle.to_tensor(np.random.RandomState(9).randn(4, 2).astype(
+        np.float32), stop_gradient=False)
+    out = sparse.matmul(coo, y)
+    out.sum().backward()
+    # d(sum(S@Y))/dY = S^T @ ones
+    expect = dense.T @ np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(np.asarray(y.grad._data), expect, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(10)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5, 6).astype(np.float32)
+    mask_d = (rng.rand(4, 6) < 0.4).astype(np.float32)
+    mask = paddle.to_tensor(mask_d).to_sparse_csr()
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                               (x @ y) * mask_d, rtol=1e-5, atol=1e-5)
+
+
+def test_addmm():
+    rng = np.random.RandomState(11)
+    inp = rng.randn(3, 2).astype(np.float32)
+    sd = rng.randn(3, 4).astype(np.float32) * (rng.rand(3, 4) < 0.5)
+    sd = sd.astype(np.float32)
+    y = rng.randn(4, 2).astype(np.float32)
+    s = paddle.to_tensor(sd).to_sparse_coo()
+    out = sparse.addmm(paddle.to_tensor(inp), s, paddle.to_tensor(y),
+                       beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               0.5 * inp + 2.0 * (sd @ y), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_transpose_reshape_sum():
+    idx, vals, dense = _rand_coo(shape=(4, 5), nnz=6, seed=12)
+    s = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    t = sparse.transpose(s, [1, 0])
+    np.testing.assert_allclose(np.asarray(t.to_dense()._data), dense.T,
+                               rtol=1e-6)
+    r = sparse.reshape(s, [2, 10])
+    np.testing.assert_allclose(np.asarray(r.to_dense()._data),
+                               dense.reshape(2, 10), rtol=1e-6)
+    total = sparse.sum(s)
+    np.testing.assert_allclose(float(np.asarray(total._data)), dense.sum(),
+                               rtol=1e-5)
+
+
+def test_sparse_nn_activations_and_softmax():
+    idx, vals, dense = _rand_coo(shape=(4, 5), nnz=8, seed=13)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    out = sparse.nn.ReLU()(coo)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._data),
+                               np.maximum(dense, 0), rtol=1e-6)
+    csr = coo.to_sparse_csr()
+    soft = sparse.nn.Softmax()(csr)
+    # oracle: softmax over stored entries per row
+    dres = np.asarray(soft.to_dense()._data)
+    crows = np.asarray(csr.crows)
+    cols = np.asarray(csr.cols)
+    v = np.asarray(csr.values._data)
+    for r in range(4):
+        seg = v[crows[r]:crows[r + 1]]
+        if len(seg) == 0:
+            continue
+        e = np.exp(seg - seg.max())
+        expect = e / e.sum()
+        got = dres[r, cols[crows[r]:crows[r + 1]]]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_sparse_subm_conv3d_matches_dense_on_support():
+    """SubmConv3D vs a dense conv oracle, compared on the input support."""
+    rng = np.random.RandomState(14)
+    # one batch, 4x4x4 grid, 2 channels, 6 active sites
+    shape = (1, 4, 4, 4, 2)
+    n = 6
+    coords = np.unique(
+        np.stack([np.zeros(n, np.int32)] +
+                 [rng.randint(0, 4, n).astype(np.int32) for _ in range(3)]),
+        axis=1)
+    vals = rng.randn(coords.shape[1], 2).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, shape)
+    conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.shape == (1, 4, 4, 4, 3)
+    # oracle: dense conv with the same weights, evaluated at active sites
+    dense_in = np.asarray(x.to_dense()._data)[0]  # [4,4,4,2]
+    w = np.asarray(conv.weight._data).reshape(3, 3, 3, 2, 3)
+    b = np.asarray(conv.bias._data)
+    out_d = np.asarray(out.to_dense()._data)[0]
+    for ci in range(coords.shape[1]):
+        _, z, y, xx = coords[:, ci]
+        acc = b.copy()
+        for dz in range(3):
+            for dy in range(3):
+                for dx in range(3):
+                    iz, iy, ix = z + dz - 1, y + dy - 1, xx + dx - 1
+                    if 0 <= iz < 4 and 0 <= iy < 4 and 0 <= ix < 4:
+                        acc = acc + dense_in[iz, iy, ix] @ w[dz, dy, dx]
+        np.testing.assert_allclose(out_d[z, y, xx], acc, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_sparse_conv3d_strided_output_support():
+    rng = np.random.RandomState(15)
+    shape = (1, 4, 4, 4, 1)
+    coords = np.array([[0, 0], [0, 2], [1, 1], [2, 0]], np.int32).T
+    coords = np.concatenate([np.zeros((1, coords.shape[1]), np.int32),
+                             coords[0:1], coords[1:2],
+                             rng.randint(0, 4, (1, coords.shape[1])).astype(
+                                 np.int32)])
+    vals = rng.randn(coords.shape[1], 1).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, shape)
+    conv = sparse.nn.Conv3D(1, 2, kernel_size=2, stride=2)
+    out = conv(x)
+    assert out.shape == (1, 2, 2, 2, 2)
+    assert np.isfinite(np.asarray(out.values._data)).all()
+
+
+def test_sparse_batchnorm_and_cast():
+    rng = np.random.RandomState(16)
+    coords = np.stack([np.zeros(5, np.int32), rng.randint(0, 3, 5),
+                       rng.randint(0, 3, 5), rng.randint(0, 3, 5)])
+    vals = rng.randn(5, 4).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, (1, 3, 3, 3, 4))
+    bn = sparse.nn.BatchNorm(4)
+    out = bn(x)
+    v = np.asarray(out.values._data)
+    np.testing.assert_allclose(v.mean(axis=0), 0, atol=1e-5)
+    np.testing.assert_allclose(v.std(axis=0), 1, atol=1e-2)
+    c = sparse.cast(x, value_dtype="int32", index_dtype="int64")
+    assert "int32" in str(c.values.dtype)
+
+
+def test_csr_plus_dense_densifies():
+    _, _, d1 = _rand_coo(seed=20)
+    csr = paddle.to_tensor(d1).to_sparse_csr()
+    y = np.random.RandomState(21).randn(*d1.shape).astype(np.float32)
+    out = sparse.add(csr, paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out._data), d1 + y, rtol=1e-6)
+    out2 = sparse.subtract(csr, paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out2._data), d1 - y, rtol=1e-6)
+    # dense + sparse
+    out3 = sparse.add(paddle.to_tensor(y), csr)
+    np.testing.assert_allclose(np.asarray(out3._data), y + d1, rtol=1e-6)
+
+
+def test_transpose_dense_dims_permutes_values():
+    rng = np.random.RandomState(22)
+    # 1 sparse dim, 2 dense dims: shape (4, 2, 3)
+    idx = np.array([[0, 2, 3]], np.int32)
+    vals = rng.randn(3, 2, 3).astype(np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, (4, 2, 3))
+    t = sparse.transpose(s, [0, 2, 1])
+    dense = np.asarray(s.to_dense()._data)
+    np.testing.assert_allclose(np.asarray(t.to_dense()._data),
+                               dense.transpose(0, 2, 1), rtol=1e-6)
+    with pytest.raises(AssertionError):
+        sparse.transpose(s, [1, 0, 2])  # mixes sparse/dense dims

@@ -1,0 +1,107 @@
+"""paddle.utils / paddle.sysconfig / paddle.hub / paddle.reader parity tests.
+Reference surface: python/paddle/utils/, python/paddle/hub.py,
+python/paddle/reader/decorator.py, python/paddle/batch.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_unique_name_generate_and_guard():
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard():
+        a = unique_name.generate("w")
+        b = unique_name.generate("w")
+        assert (a, b) == ("w_0", "w_1")
+    with unique_name.guard():
+        # fresh generator inside a new guard restarts numbering
+        assert unique_name.generate("w") == "w_0"
+
+
+def test_deprecated_warns_and_forwards():
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old_api(x):
+        return x + 1
+
+    with pytest.warns(DeprecationWarning):
+        assert old_api(1) == 2
+
+
+def test_require_version_and_try_import():
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("999.0.0")
+    assert paddle.utils.try_import("math") is not None
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_flatten_pack_map_structure():
+    nest = {"a": [1, 2], "b": (3, {"c": 4})}
+    flat = paddle.utils.flatten(nest)
+    assert flat == [1, 2, 3, 4]
+    packed = paddle.utils.pack_sequence_as(nest, [10, 20, 30, 40])
+    assert packed == {"a": [10, 20], "b": (30, {"c": 40})}
+    doubled = paddle.utils.map_structure(lambda v: v * 2, nest)
+    assert doubled == {"a": [2, 4], "b": (6, {"c": 8})}
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = paddle.utils.dlpack.from_dlpack(x._data)  # __dlpack__-bearing object
+    np.testing.assert_array_equal(np.asarray(y._data), np.asarray(x._data))
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.exists(os.path.join(inc, "paddle_tpu_c_api.h"))
+    assert os.path.basename(paddle.sysconfig.get_lib()) == "csrc"
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "ext.cc"
+    src.write_text('extern "C" int add_two(int x) { return x + 2; }\n')
+    lib = paddle.utils.cpp_extension.load(
+        "t_ext", [str(src)], build_directory=str(tmp_path))
+    assert lib.add_two(40) == 42
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n"
+        "    'A tiny test model.'\n"
+        "    return {'scale': scale}\n")
+    assert paddle.hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                     source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                           scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("user/repo", "m", source="github")
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(8))
+    assert list(paddle.batch(r, 3)()) == [[0, 1, 2], [3, 4, 5], [6, 7]]
+    assert list(paddle.batch(r, 3, drop_last=True)()) == [[0, 1, 2],
+                                                          [3, 4, 5]]
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert list(paddle.reader.chain(r, r)()) == list(range(8)) * 2
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, r, r)()) == [
+        2 * i for i in range(8)]
+    assert sorted(paddle.reader.shuffle(r, 4)()) == list(range(8))
+    assert list(paddle.reader.buffered(r, 2)()) == list(range(8))
+    composed = paddle.reader.compose(r, r)
+    assert list(composed())[0] == (0, 0)
+    cached = paddle.reader.cache(r)
+    assert list(cached()) == list(cached())
+    mapped = paddle.reader.xmap_readers(lambda s: s * 10, r, 2, 4, order=True)
+    assert list(mapped()) == [i * 10 for i in range(8)]
