@@ -13,6 +13,13 @@ __all__ = ["batch", "map_readers", "shuffle", "buffered", "compose",
            "chain", "firstn", "cache", "xmap_readers"]
 
 
+class _ReaderError:
+    """Wrapper carrying a producer-thread exception to the consumer."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def batch(reader, batch_size: int, drop_last: bool = False):
     """Compose a sample reader into a batch reader (paddle.batch)."""
 
@@ -67,6 +74,8 @@ def buffered(reader, size: int):
             try:
                 for sample in reader():
                     q.put(sample)
+            except BaseException as exc:  # surface in the consumer
+                q.put(_ReaderError(exc))
             finally:
                 q.put(end)
 
@@ -76,6 +85,8 @@ def buffered(reader, size: int):
             sample = q.get()
             if sample is end:
                 break
+            if isinstance(sample, _ReaderError):
+                raise sample.exc
             yield sample
     return buffered_reader
 

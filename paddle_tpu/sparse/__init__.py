@@ -334,14 +334,23 @@ def transpose(x: SparseCooTensor, perm):
 
 
 def reshape(x: SparseCooTensor, shape):
+    """Reshape the SPARSE dims; dense trailing dims must be unchanged (the
+    reference's sparse reshape keeps the dense suffix too)."""
     assert isinstance(x, SparseCooTensor), "reshape: COO only"
+    sd = x.indices.shape[0]
+    dense_tail = x.shape[sd:]
     shape = tuple(int(s) for s in shape)
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))
         total = int(np.prod(x.shape))
         shape = tuple(total // known if s == -1 else s for s in shape)
-    flat = jnp.ravel_multi_index(tuple(x.indices), x.shape, mode="clip")
-    new_idx = jnp.stack(jnp.unravel_index(flat, shape)).astype(jnp.int32)
+    assert shape[len(shape) - len(dense_tail):] == dense_tail if dense_tail \
+        else True, f"reshape must preserve dense dims {dense_tail}"
+    new_sparse = shape[:len(shape) - len(dense_tail)]
+    flat = jnp.ravel_multi_index(tuple(x.indices), x.shape[:sd],
+                                 mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, new_sparse)).astype(
+        jnp.int32)
     return SparseCooTensor(new_idx, x.values, shape)
 
 
@@ -459,6 +468,10 @@ def matmul(x, y, name=None):
         return apply_op(lambda a: jnp.swapaxes(a, -1, -2), matmul(st, xt))
 
     n_rows = shape[0]
+    y_nd = len(y.shape)
+    assert y_nd == 2, (
+        f"sparse.matmul: dense operand must be 2-D [K, N], got rank {y_nd} "
+        f"(batched SpMM is not supported; vmap over the batch instead)")
 
     def spmm(v, d):
         gathered = jnp.take(d, cols, axis=0)          # [nnz, N]

@@ -1,6 +1,7 @@
 """Linear algebra. Parity: python/paddle/tensor/linalg.py + paddle.linalg.*"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .tensor import Tensor, apply_op
@@ -182,3 +183,39 @@ def corrcoef(x, rowvar=True, name=None):
 
 
 __all__ += ["svdvals", "multi_dot", "cov", "corrcoef"]
+
+
+# ---- round-2 breadth: matrix_exp, householder_product, vecdot -------------
+
+def matrix_exp(x, name=None):
+    """Matrix exponential via jax.scipy.linalg.expm (Pade + scaling-and-
+    squaring — the XLA-friendly fixed-iteration form)."""
+    return apply_op(jax.scipy.linalg.expm, x)
+
+
+def householder_product(x, tau, name=None):
+    """Product of Householder reflectors (the Q of a geqrf factorization).
+    Parity: paddle.linalg.householder_product."""
+    return apply_op(
+        lambda a, t: jax.lax.linalg.householder_product(a, t), x, tau)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=axis), x, y)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A given its Cholesky factor: (LL^T)^-1 via two
+    triangular solves against I."""
+    def f(l):
+        n = l.shape[-1]
+        eye = jnp.eye(n, dtype=l.dtype)
+        u = jnp.swapaxes(l, -1, -2) if not upper else l
+        lo = l if not upper else jnp.swapaxes(l, -1, -2)
+        z = jax.scipy.linalg.solve_triangular(lo, eye, lower=True)
+        return jax.scipy.linalg.solve_triangular(u, z, lower=False)
+    return apply_op(f, x)
+
+
+__all__ += ["matrix_exp", "householder_product", "vecdot",
+            "cholesky_inverse"]

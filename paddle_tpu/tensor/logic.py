@@ -75,3 +75,39 @@ def is_tensor(x):
 
 def is_empty(x, name=None):
     return Tensor(jnp.asarray(x.size == 0))
+
+
+# ---- round-2 breadth ------------------------------------------------------
+
+from .tensor import apply_op  # noqa: E402
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    t = test_x._data if isinstance(test_x, Tensor) else jnp.asarray(test_x)
+    return apply_op(lambda a: jnp.isin(a, t, invert=invert), x)
+
+
+def is_complex(x):
+    return jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(
+        (x._data if isinstance(x, Tensor) else jnp.asarray(x)).dtype,
+        jnp.integer)
+
+
+def isreal(x, name=None):
+    return apply_op(jnp.isreal, x)
+
+
+__all__ += ["isin", "is_complex", "is_floating_point", "is_integer",
+            "isreal"]

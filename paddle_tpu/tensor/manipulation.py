@@ -530,3 +530,209 @@ def index_put_(x, indices, value, accumulate=False, name=None):
 
 __all__ += ["take", "msort", "diag_embed", "unfold", "index_add",
             "index_add_", "index_put", "index_put_"]
+
+
+# ---- round-2 breadth: stack/split families + scatter views ----------------
+import builtins as _builtins  # paddle's slice() op shadows the builtin here
+# Parity: python/paddle/tensor/manipulation.py 2.6 additions (atleast_*,
+# *_stack, *split, index_fill, masked_scatter, as_strided, unflatten,
+# select/slice/diagonal_scatter).
+
+def _seq(xs):
+    return [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+            for x in (xs if isinstance(xs, (list, tuple)) else [xs])]
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, x) for x in _seq(list(inputs))]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, x) for x in _seq(list(inputs))]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, x) for x in _seq(list(inputs))]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = _seq(inputs)
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [apply_op(lambda a: jnp.broadcast_to(a, shape), t) for t in ts]
+
+
+def block_diag(inputs, name=None):
+    ts = _seq(inputs)
+    return apply_op(lambda *as_: jax.scipy.linalg.block_diag(
+        *[jnp.atleast_2d(a) for a in as_]), *ts)
+
+
+def hstack(x, name=None):
+    return apply_op(lambda *as_: jnp.hstack(as_), *_seq(x))
+
+
+def vstack(x, name=None):
+    return apply_op(lambda *as_: jnp.vstack(as_), *_seq(x))
+
+
+def dstack(x, name=None):
+    return apply_op(lambda *as_: jnp.dstack(as_), *_seq(x))
+
+
+def column_stack(x, name=None):
+    return apply_op(lambda *as_: jnp.column_stack(as_), *_seq(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    n = x.shape[axis] if hasattr(x, "shape") else None
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, extra = divmod(n, k)
+        sizes = [base + (1 if i < extra else 0) for i in range(k)]
+        bounds = list(np.cumsum(sizes))[:-1]  # empty chunks allowed (k > n)
+    else:
+        bounds = [int(b) for b in num_or_indices]
+    outs = []
+    prev = 0
+    for b in bounds + [n]:
+        sl = [_builtins.slice(None)] * len(x.shape)
+        sl[axis] = _builtins.slice(prev, b)
+        outs.append(apply_op(lambda a, s=tuple(sl): a[s], x))
+        prev = b
+    return outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    assert len(x.shape) >= 1
+    return tensor_split(x, num_or_indices,
+                        axis=0 if len(x.shape) == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    assert len(x.shape) >= 2, "vsplit needs ndim >= 2"
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    assert len(x.shape) >= 3, "dsplit needs ndim >= 3"
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[idx].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(filled, 0, axis)
+    return apply_op(f, x)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._data = out._data
+    return x
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill x where mask with consecutive elements of value (row-major)."""
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    n_true = int(jnp.broadcast_to(m, tuple(x.shape)).sum())
+    v_size = int(np.prod(value.shape)) if hasattr(value, "shape") \
+        else jnp.asarray(value).size
+    if v_size < n_true:
+        raise ValueError(
+            f"masked_scatter: value has {v_size} elements but mask selects "
+            f"{n_true} positions")
+
+    def f(a, v):
+        mb = jnp.broadcast_to(m, a.shape).ravel()
+        flat = a.ravel()
+        # slot i takes value[rank-of-i-among-true]; clip keeps gather static
+        pos = jnp.cumsum(mb) - 1
+        gathered = jnp.take(v.ravel(), jnp.clip(pos, 0, v.size - 1))
+        return jnp.where(mb, gathered, flat).reshape(a.shape)
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def masked_scatter_(x, mask, value, name=None):
+    out = masked_scatter(x, mask, value)
+    x._data = out._data
+    return x
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view over the flat buffer (gather realization: XLA has no
+    aliasing views, so this materializes the gather — same numerics)."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    idx = jnp.asarray(offset)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s) * st
+    return apply_op(lambda a: a.ravel()[idx], x)
+
+
+def unflatten(x, axis, shape, name=None):
+    shape = list(shape)
+    ax = axis % len(x.shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = x.shape[ax] // known
+    new_shape = list(x.shape[:ax]) + shape + list(x.shape[ax + 1:])
+    return apply_op(lambda a: a.reshape(new_shape), x)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        sl = [_builtins.slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+    if isinstance(values, Tensor):
+        return apply_op(f, x, values)
+    return apply_op(lambda a: f(a, jnp.asarray(values)), x)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        sl = [_builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = _builtins.slice(int(st), int(en), int(sd))
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+    if isinstance(value, Tensor):
+        return apply_op(f, x, value)
+    return apply_op(lambda a: f(a, jnp.asarray(value)), x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        moved = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        n, m = moved.shape[-2:]
+        rows = jnp.arange(max(min(n, m - offset) if offset >= 0
+                              else min(n + offset, m), 0))
+        r = rows - min(offset, 0)
+        c = rows + max(offset, 0)
+        out = moved.at[..., r, c].set(v.astype(a.dtype))
+        return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+    if isinstance(y, Tensor):
+        return apply_op(f, x, y)
+    return apply_op(lambda a: f(a, jnp.asarray(y)), x)
+
+
+__all__ += ["atleast_1d", "atleast_2d", "atleast_3d", "broadcast_tensors",
+            "block_diag", "hstack", "vstack", "dstack", "column_stack",
+            "row_stack", "tensor_split", "hsplit", "vsplit", "dsplit",
+            "index_fill", "index_fill_", "masked_scatter",
+            "masked_scatter_", "as_strided", "unflatten", "select_scatter",
+            "slice_scatter", "diagonal_scatter"]

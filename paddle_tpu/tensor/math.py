@@ -488,3 +488,57 @@ def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
 
 
 _export("histogram_bin_edges", histogram_bin_edges)
+
+
+# ---- round-2 breadth: special functions + bit ops + aliases ---------------
+# Parity: python/paddle/tensor/math.py + ops.py additions in the 2.6 surface.
+
+for _n, _f in dict(
+    sinc=jnp.sinc, signbit=jnp.signbit, exp2=jnp.exp2,
+    erfc=jax.scipy.special.erfc, expit=jax.scipy.special.expit,
+    i0e=jax.scipy.special.i0e, i1=jax.scipy.special.i1,
+    i1e=jax.scipy.special.i1e, positive=jnp.positive,
+).items():
+    _unary(_n, _f)
+
+for _n, _f in dict(
+    gammainc=jax.scipy.special.gammainc,
+    gammaincc=jax.scipy.special.gammaincc,
+    xlogy=jax.scipy.special.xlogy,
+    true_divide=jnp.true_divide,
+    bitwise_left_shift=jnp.left_shift,
+    bitwise_right_shift=jnp.right_shift,
+).items():
+    _binary(_n, _f)
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma at x (n=0 is digamma itself)."""
+    return apply_op(lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+_export("polygamma", polygamma)
+
+
+def erfcx(x, name=None):
+    """Scaled complementary error function exp(x^2)*erfc(x), numerically
+    stable for large x via the log-domain identity."""
+    def fn(a):
+        # direct product overflows for large a; use erfc in float32 range
+        # and the asymptotic 1/(a*sqrt(pi)) tail beyond it
+        safe = jnp.exp(a * a) * jax.scipy.special.erfc(a)
+        tail = 1.0 / (a * jnp.sqrt(jnp.pi))
+        return jnp.where(a > 9.0, tail, safe)
+    return apply_op(fn, x)
+
+
+_export("erfcx", erfcx)
+
+
+def ldexp_(x, y, name=None):
+    out = ldexp(x, y)
+    x._data = out._data
+    return x
+
+
+_export("ldexp_", ldexp_)

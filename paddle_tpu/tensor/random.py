@@ -127,3 +127,36 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             return onehot + y - jax.lax.stop_gradient(y)
         return y
     return apply_op(f, x)
+
+
+# ---- round-2 breadth ------------------------------------------------------
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Samples of exp(N(mean, std^2)). Parity: paddle.log_normal (2.6)."""
+    shape = shape or [1]
+    out = jax.random.normal(next_key(), tuple(shape)) * std + mean
+    return Tensor(jnp.exp(out))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    arr = jax.random.normal(next_key(), tuple(x.shape),
+                            dtype=x._data.dtype) * std + mean
+    x._data = jnp.exp(arr)
+    return x
+
+
+def binomial(count, prob, name=None):
+    """Binomial(count, prob) samples. Parity: paddle.binomial (2.6)."""
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(next_key(), c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return Tensor(out.astype(jnp.int64))
+
+
+def standard_gamma(alpha, name=None):
+    a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    return Tensor(jax.random.gamma(next_key(), a))
+
+
+__all__ += ["log_normal", "log_normal_", "binomial", "standard_gamma"]

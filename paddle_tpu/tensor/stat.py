@@ -76,3 +76,18 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
                                       ddof=1 if ddof else 0), x)
+
+
+# ---- round-2 breadth ------------------------------------------------------
+
+def nanstd(x, axis=None, ddof=0, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanstd(a, axis=axis, ddof=ddof, keepdims=keepdim), x)
+
+
+def nanvar(x, axis=None, ddof=0, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanvar(a, axis=axis, ddof=ddof, keepdims=keepdim), x)
+
+
+__all__ += ["nanstd", "nanvar"]

@@ -272,3 +272,22 @@ def test_transpose_dense_dims_permutes_values():
                                dense.transpose(0, 2, 1), rtol=1e-6)
     with pytest.raises(AssertionError):
         sparse.transpose(s, [1, 0, 2])  # mixes sparse/dense dims
+
+
+def test_sparse_matmul_rejects_batched_dense():
+    idx, vals, dense = _rand_coo(shape=(2, 2), nnz=2, seed=30)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    with pytest.raises(AssertionError, match="2-D"):
+        sparse.matmul(coo, paddle.to_tensor(
+            np.zeros((3, 2, 2), np.float32)))
+
+
+def test_sparse_reshape_hybrid_preserves_dense_tail():
+    rng = np.random.RandomState(31)
+    idx = np.array([[0, 2, 3]], np.int32)
+    vals = rng.randn(3, 2).astype(np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, (4, 2))
+    r = sparse.reshape(s, (2, 2, 2))
+    dense = np.asarray(s.to_dense()._data)
+    np.testing.assert_allclose(np.asarray(r.to_dense()._data),
+                               dense.reshape(2, 2, 2), rtol=1e-6)

@@ -105,3 +105,11 @@ def test_reader_decorators():
     assert list(cached()) == list(cached())
     mapped = paddle.reader.xmap_readers(lambda s: s * 10, r, 2, 4, order=True)
     assert list(mapped()) == [i * 10 for i in range(8)]
+
+
+def test_buffered_propagates_reader_error():
+    def bad():
+        yield 1
+        raise ValueError("mid-epoch")
+    with pytest.raises(ValueError, match="mid-epoch"):
+        list(paddle.reader.buffered(bad, 4)())
