@@ -344,8 +344,8 @@ def reshape(x: SparseCooTensor, shape):
         known = int(np.prod([s for s in shape if s != -1]))
         total = int(np.prod(x.shape))
         shape = tuple(total // known if s == -1 else s for s in shape)
-    assert shape[len(shape) - len(dense_tail):] == dense_tail if dense_tail \
-        else True, f"reshape must preserve dense dims {dense_tail}"
+    assert shape[len(shape) - len(dense_tail):] == tuple(dense_tail), \
+        f"reshape must preserve dense dims {dense_tail}"
     new_sparse = shape[:len(shape) - len(dense_tail)]
     flat = jnp.ravel_multi_index(tuple(x.indices), x.shape[:sd],
                                  mode="clip")

@@ -32,7 +32,10 @@ from ..tensor.tensor import Tensor, _capture_hook, no_grad
 
 __all__ = ["Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "CompiledProgram",
-           "InputSpec", "data", "name_scope", "global_scope", "Scope"]
+           "InputSpec", "data", "name_scope", "global_scope", "Scope",
+           "save_inference_model", "load_inference_model",
+           "serialize_program", "deserialize_program", "normalize_program",
+           "save", "load"]
 
 
 class InputSpec:
@@ -205,6 +208,15 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
+        from .io import InferenceProgram, _FetchHandle
+        if isinstance(program, InferenceProgram):
+            outs = program.run_feeds(feed or {})
+            picked = []
+            for f in (fetch_list or program.fetch_targets):
+                idx = f.index if isinstance(f, _FetchHandle) else int(f)
+                o = outs[idx]
+                picked.append(np.asarray(o) if return_numpy else Tensor(o))
+            return picked
         data_parallel = isinstance(program, CompiledProgram) and \
             getattr(program, "_data_parallel", False)
         program = program if isinstance(program, Program) else \
@@ -281,3 +293,10 @@ class CompiledProgram:
         the active hybrid mesh's data axes (fleet.init supplies the mesh)."""
         self._data_parallel = True
         return self
+
+
+# inference-program IO (module kept separate: paddle.static.io parity)
+from .io import (save_inference_model, load_inference_model,  # noqa: E402
+                 serialize_program, deserialize_program, normalize_program,
+                 save, load)
+from . import io  # noqa: E402

@@ -1,0 +1,257 @@
+"""paddle.static IO — inference-program export/import.
+
+Parity: python/paddle/static/io.py :: save_inference_model,
+load_inference_model, serialize_program, deserialize_program,
+normalize_program, save, load (the reference serializes a ProgramDesc
+protobuf + a params file).
+
+TPU-first: the portable program format here is **StableHLO via
+jax.export** — the XLA-native equivalent of ProgramDesc. The captured
+static Program (op-closure list) is traced once into a pure function
+(feeds, params) -> fetches, exported with shape polymorphism for None/-1
+feed dims, and written as `{prefix}.pdmodel`; parameter values go to
+`{prefix}.pdiparams`. Loading needs no Python model code — the reference's
+inference-deployment contract."""
+from __future__ import annotations
+
+import json
+import struct
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "serialize_program", "deserialize_program", "normalize_program",
+           "save", "load"]
+
+
+def _prune_to_fetches(program, fetch_uids):
+    """Backward closure: keep only ops the fetches depend on (the
+    reference's prune pass dropping backward/optimizer ops from an
+    inference program)."""
+    needed = set(fetch_uids)
+    kept = []
+    for op in reversed(program.ops):
+        if any(uid in needed for uid in op.output_ids):
+            kept.append(op)
+            needed.update(t._uid for t in op.inputs)
+    kept.reverse()
+    return kept
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune to the fetch closure and arrange into (pure_fn, captured):
+    pure_fn(feed_arrays, param_arrays) -> fetch arrays."""
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_uids = {t._uid for t in feed_vars}
+    ops = _prune_to_fetches(program, [t._uid for t in fetch_vars])
+    # captured = inputs of KEPT ops that no kept op produced and aren't feeds
+    produced = set()
+    captured, seen = [], set()
+    for op in ops:
+        for t in op.inputs:
+            uid = t._uid
+            if uid in produced or uid in feed_uids or uid in seen:
+                continue
+            seen.add(uid)
+            captured.append(t)
+        produced.update(op.output_ids)
+    cap_uids = [t._uid for t in captured]
+
+    def pure_fn(feed_arrays, param_arrays):
+        env = dict(zip([t._uid for t in feed_vars], feed_arrays))
+        env.update(dict(zip(cap_uids, param_arrays)))
+        for op in ops:
+            ins = [env.get(t._uid, t._data) for t in op.inputs]
+            outs = op.fn(*ins)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for uid, o in zip(op.output_ids, outs):
+                env[uid] = o
+        return tuple(env[t._uid] for t in fetch_vars)
+
+    return pure_fn, captured, feed_vars, fetch_vars
+
+
+def _feed_shape_structs(program, feed_vars):
+    """ShapeDtypeStructs for export; None/-1 dims become symbolic. Dynamic
+    dims at the same AXIS share one symbol (axis-0 None on every feed is
+    the same batch size — the reference's feed contract), so multi-input
+    dynamic-batch programs unify and trace."""
+    dims_list = []
+    any_sym = False
+    for t in feed_vars:
+        name = getattr(t, "name", None)
+        spec = program._feed_specs.get(name)
+        dims = list(spec.shape if spec is not None else t.shape)
+        for i, d in enumerate(dims):
+            if d is None or d == -1:
+                dims[i] = f"_d{i}"
+                any_sym = True
+        dims_list.append(dims)
+    specs = []
+    scope = jax_export.SymbolicScope() if any_sym else None
+    sym_cache: dict[str, object] = {}
+    for t, dims in zip(feed_vars, dims_list):
+        sh = []
+        for d in dims:
+            if isinstance(d, str):
+                if d not in sym_cache:
+                    sym_cache[d] = jax_export.symbolic_shape(
+                        d, scope=scope)[0]
+                sh.append(sym_cache[d])
+            else:
+                sh.append(d)
+        specs.append(jax.ShapeDtypeStruct(tuple(sh), t._data.dtype))
+    return specs
+
+
+class InferenceProgram:
+    """A loaded/exported inference program: StableHLO + params. Executor.run
+    recognizes it (paddle parity: the object returned in
+    load_inference_model's results[0])."""
+
+    def __init__(self, exported_bytes: bytes, feed_names, n_fetch,
+                 params):
+        self._bytes = exported_bytes
+        self._exported = jax_export.deserialize(bytearray(exported_bytes))
+        self.feed_names = list(feed_names)
+        self.n_fetch = int(n_fetch)
+        self.params = [np.asarray(p) for p in params]
+        # opaque fetch handles (index markers) for Executor.run parity
+        self.fetch_targets = [_FetchHandle(self, i) for i in range(n_fetch)]
+
+    def run_feeds(self, feed: dict):
+        arrays = []
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError(f"missing feed {name!r}; program feeds are "
+                               f"{self.feed_names}")
+            v = feed[name]
+            arrays.append(np.asarray(v._data if isinstance(v, Tensor)
+                                     else v))
+        outs = self._exported.call(arrays, self.params)
+        return list(outs)
+
+
+class _FetchHandle:
+    __slots__ = ("program", "index")
+
+    def __init__(self, program, index):
+        self.program = program
+        self.index = index
+
+
+_MAGIC = b"PTPU1\n"
+
+
+def _pack(header: dict, blob: bytes) -> bytes:
+    """Container: magic + u32 header-len + JSON header + raw StableHLO.
+    No pickle — loading a third-party .pdmodel must not execute code (the
+    reference's ProgramDesc protobuf has the same property)."""
+    h = json.dumps(header).encode()
+    return _MAGIC + struct.pack("<I", len(h)) + h + blob
+
+
+def _unpack(data: bytes):
+    if not data.startswith(_MAGIC):
+        raise ValueError("not a paddle_tpu .pdmodel file")
+    off = len(_MAGIC)
+    (hlen,) = struct.unpack_from("<I", data, off)
+    off += 4
+    header = json.loads(data[off:off + hlen].decode())
+    return header, data[off + hlen:]
+
+
+def _serialize_normalized(program, pure_fn, captured, feed_vars,
+                          fetch_vars) -> bytes:
+    feed_structs = _feed_shape_structs(program, feed_vars)
+    param_structs = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+                     for t in captured]
+    exported = jax_export.export(jax.jit(pure_fn))(feed_structs,
+                                                   param_structs)
+    header = {
+        "feed_names": [getattr(t, "name", None) or f"feed_{i}"
+                       for i, t in enumerate(feed_vars)],
+        "n_fetch": len(fetch_vars),
+    }
+    return _pack(header, bytes(exported.serialize()))
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs) -> bytes:
+    """Program → portable bytes (StableHLO + JSON feed metadata)."""
+    from . import default_main_program
+    program = program or default_main_program()
+    pure_fn, captured, feed_vars, fetch_vars = normalize_program(
+        program, feed_vars, fetch_vars)
+    return _serialize_normalized(program, pure_fn, captured, feed_vars,
+                                 fetch_vars)
+
+
+def deserialize_program(data: bytes, params=None) -> InferenceProgram:
+    header, blob = _unpack(data)
+    return InferenceProgram(blob, header["feed_names"], header["n_fetch"],
+                            params or [])
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars,
+                         executor=None, program=None, **kwargs):
+    """Write {prefix}.pdmodel (serialized program) + {prefix}.pdiparams
+    (parameter values in the program's captured order, .npz — no pickle)."""
+    from . import default_main_program
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    program = program or default_main_program()
+    pure_fn, captured, feed_vars, fetch_vars = normalize_program(
+        program, feed_vars, fetch_vars)
+    data = _serialize_normalized(program, pure_fn, captured, feed_vars,
+                                 fetch_vars)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(data)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        np.savez(f, *[np.asarray(t._data) for t in captured])
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """→ [inference_program, feed_target_names, fetch_targets] (reference
+    return contract); run via Executor.run(program=..., feed=...,
+    fetch_list=fetch_targets)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        data = f.read()
+    with np.load(path_prefix + ".pdiparams", allow_pickle=False) as z:
+        params = [z[k] for k in z.files]
+    prog = deserialize_program(data, params)
+    return [prog, prog.feed_names, prog.fetch_targets]
+
+
+def save(program, model_path: str, protocol: int = 4, **kwargs):
+    """paddle.static.save: persist the program's parameters to
+    {path}.pdparams (.npz keyed by parameter name — no pickle)."""
+    params = {getattr(p, "name", None) or f"param_{i}": np.asarray(p._data)
+              for i, p in enumerate(program.all_parameters())}
+    with open(model_path + ".pdparams", "wb") as f:
+        np.savez(f, **params)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    """paddle.static.load: restore parameters saved by static.save into the
+    program's persistables (matched by name, else by order)."""
+    with np.load(model_path + ".pdparams", allow_pickle=False) as z:
+        saved = {k: z[k] for k in z.files}
+    params = program.all_parameters()
+    by_name = {getattr(p, "name", None): p for p in params}
+    import jax.numpy as jnp
+    matched = 0
+    for i, (name, val) in enumerate(saved.items()):
+        target = by_name.get(name)
+        if target is None and i < len(params):
+            target = params[i]
+        if target is not None:
+            target._data = jnp.asarray(val)
+            matched += 1
+    return matched

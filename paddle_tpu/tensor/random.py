@@ -151,7 +151,10 @@ def binomial(count, prob, name=None):
     p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
     out = jax.random.binomial(next_key(), c.astype(jnp.float32),
                               p.astype(jnp.float32))
-    return Tensor(out.astype(jnp.int64))
+    # reference dtype is int64; without x64 JAX's widest int is int32, so
+    # use the canonical int dtype to avoid a per-call truncation warning
+    int_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return Tensor(out.astype(int_dtype))
 
 
 def standard_gamma(alpha, name=None):

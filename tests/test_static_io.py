@@ -1,0 +1,171 @@
+"""paddle.static IO parity: save/load_inference_model (StableHLO-backed
+ProgramDesc equivalent), serialize/deserialize_program, static save/load.
+Reference: python/paddle/static/io.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_mode():
+    import paddle_tpu.static as S
+    paddle.enable_static()
+    # fresh programs per test
+    S._main_program = S.Program()
+    S._startup_program = S.Program()
+    S._install_capture()
+    yield
+    paddle.disable_static()
+    # don't leak this test's recorded ops into later tests that use the
+    # default programs
+    S._main_program = S.Program()
+    S._startup_program = S.Program()
+
+
+def _build_linear_program(seed=0):
+    x = paddle.static.data("x", [None, 6])
+    lin = paddle.nn.Linear(6, 3)
+    w = np.random.RandomState(seed).randn(6, 3).astype(np.float32)
+    lin.weight._data = paddle.to_tensor(w)._data
+    out = paddle.nn.functional.relu(lin(x))
+    return x, lin, w, out
+
+
+def test_save_load_inference_model_polymorphic_batch(tmp_path, static_mode):
+    x, lin, w, out = _build_linear_program()
+    exe = paddle.static.Executor()
+    prefix = str(tmp_path / "m")
+    paddle.static.save_inference_model(prefix, [x], [out], exe)
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".pdiparams")
+    paddle.disable_static()
+
+    prog, feeds, fetches = paddle.static.load_inference_model(prefix, exe)
+    assert feeds == ["x"]
+    b = np.asarray(lin.bias._data)
+    for bs in (2, 5):
+        arr = np.random.RandomState(bs).randn(bs, 6).astype(np.float32)
+        got, = exe.run(prog, feed={"x": arr}, fetch_list=fetches)
+        np.testing.assert_allclose(got, np.maximum(arr @ w + b, 0),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_loaded_program_is_self_contained(tmp_path, static_mode):
+    """Exported artifact must not depend on live Python objects: mutate the
+    source params after export and expect the OLD values."""
+    x, lin, w, out = _build_linear_program(seed=3)
+    exe = paddle.static.Executor()
+    prefix = str(tmp_path / "m2")
+    paddle.static.save_inference_model(prefix, [x], [out], exe)
+    b = np.asarray(lin.bias._data).copy()
+    lin.weight._data = paddle.to_tensor(np.zeros((6, 3), np.float32))._data
+    paddle.disable_static()
+
+    prog, _, fetches = paddle.static.load_inference_model(prefix, exe)
+    arr = np.random.RandomState(9).randn(4, 6).astype(np.float32)
+    got, = exe.run(prog, feed={"x": arr}, fetch_list=fetches)
+    np.testing.assert_allclose(got, np.maximum(arr @ w + b, 0), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_serialize_deserialize_program_bytes(tmp_path, static_mode):
+    x, lin, w, out = _build_linear_program(seed=5)
+    data = paddle.static.serialize_program([x], [out])
+    assert isinstance(data, bytes) and len(data) > 100
+    from paddle_tpu.static.io import normalize_program
+    _, captured, _, _ = normalize_program(
+        paddle.static.default_main_program(), [x], [out])
+    paddle.disable_static()
+    prog = paddle.static.deserialize_program(
+        data, [np.asarray(t._data) for t in captured])
+    outs = prog.run_feeds({"x": np.ones((2, 6), np.float32)})
+    assert outs[0].shape == (2, 3)
+
+
+def test_static_save_load_params_roundtrip(tmp_path, static_mode):
+    x, lin, w, out = _build_linear_program(seed=7)
+    main = paddle.static.default_main_program()
+    path = str(tmp_path / "ckpt")
+    paddle.static.save(main, path)
+    assert os.path.exists(path + ".pdparams")
+    # clobber, then restore
+    orig = np.asarray(lin.weight._data).copy()
+    lin.weight._data = paddle.to_tensor(np.zeros_like(orig))._data
+    matched = paddle.static.load(main, path)
+    assert matched >= 1
+    np.testing.assert_allclose(np.asarray(lin.weight._data), orig)
+
+
+def test_trained_then_exported_program(tmp_path, static_mode):
+    """Train in static mode (minimize captured), then export the predictor
+    and check the exported program uses the TRAINED weights."""
+    x = paddle.static.data("x", [None, 4])
+    label = paddle.static.data("label", [None, 1])
+    lin = paddle.nn.Linear(4, 1)
+    pred = lin(x)
+    loss = ((pred - label) ** 2).mean()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=[lin.weight, lin.bias])
+    opt.minimize(loss)
+    exe = paddle.static.Executor()
+    rng = np.random.RandomState(0)
+    xa = rng.randn(16, 4).astype(np.float32)
+    ya = (xa @ np.array([[1.], [2.], [-1.], [0.5]], np.float32))
+    first = None
+    for _ in range(30):
+        lv, = exe.run(feed={"x": xa, "label": ya}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first
+    prefix = str(tmp_path / "trained")
+    paddle.static.save_inference_model(prefix, [x], [pred], exe)
+    paddle.disable_static()
+    prog, _, fetches = paddle.static.load_inference_model(prefix, exe)
+    got, = exe.run(prog, feed={"x": xa}, fetch_list=fetches)
+    np.testing.assert_allclose(
+        got, xa @ np.asarray(lin.weight._data)
+        + np.asarray(lin.bias._data), rtol=1e-4, atol=1e-4)
+
+
+def test_export_prunes_training_ops(tmp_path, static_mode):
+    """Dead loss/optimizer ops (fixed-shape label) must not leak into the
+    exported predictor."""
+    x = paddle.static.data("x", [None, 4])
+    label = paddle.static.data("label", [16, 1])
+    lin = paddle.nn.Linear(4, 1)
+    pred = lin(x)
+    loss = ((pred - label) ** 2).mean()  # noqa: F841 - dead wrt pred
+    exe = paddle.static.Executor()
+    prefix = str(tmp_path / "pruned")
+    paddle.static.save_inference_model(prefix, [x], [pred], exe)
+    paddle.disable_static()
+    prog, feeds, fetches = paddle.static.load_inference_model(prefix, exe)
+    assert feeds == ["x"]
+    got, = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)},
+                   fetch_list=fetches)
+    assert got.shape == (3, 1)
+
+
+def test_export_multi_input_shared_batch_dim(tmp_path, static_mode):
+    x = paddle.static.data("x", [None, 4])
+    y = paddle.static.data("y", [None, 4])
+    out = x + y
+    data = paddle.static.serialize_program([x, y], [out])
+    paddle.disable_static()
+    prog = paddle.static.deserialize_program(data, [])
+    res = prog.run_feeds({"x": np.ones((5, 4), np.float32),
+                          "y": np.full((5, 4), 2.0, np.float32)})
+    np.testing.assert_allclose(np.asarray(res[0]), 3.0)
+
+
+def test_pdmodel_is_not_pickle(tmp_path, static_mode):
+    x, lin, w, out = _build_linear_program(seed=11)
+    data = paddle.static.serialize_program([x], [out])
+    assert data.startswith(b"PTPU1\n")
+    import pickle
+    with pytest.raises(Exception):
+        pickle.loads(data)  # container is NOT a pickle payload
+    with pytest.raises(ValueError, match="pdmodel"):
+        paddle.static.deserialize_program(b"garbage")
