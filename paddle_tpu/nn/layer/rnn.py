@@ -1,0 +1,448 @@
+"""Recurrent layers. Parity: python/paddle/nn/layer/rnn.py ::
+RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+LSTM, GRU.
+
+TPU-first: the time loop is one `lax.scan` per (layer, direction) — a
+single compiled loop whose body is an MXU matmul pair, not a Python loop
+of ops (the reference's CUDA path is cuDNN's fused RNN; scan + XLA fusion
+is the TPU analogue). Variable-length sequences mask state updates inside
+the scan body, so shapes stay static. Built-in cells expose a pure-array
+step (`_step`/`_params`) that RNN scans; custom RNNCellBase subclasses
+without one fall back to an eager per-timestep loop through the tape."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor, apply_op
+from ..initializer import Uniform
+from .common import _resolve_init
+from .layers import Layer, LayerList
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...core.dtype import convert_dtype
+        b = batch_ref.shape[batch_dim_idx]
+        dt = convert_dtype(dtype)
+        if dt is None:
+            w = getattr(self, "weight_hh", None)
+            dt = w._data.dtype if w is not None else jnp.float32
+        state_shape = shape or self.state_shape
+        if isinstance(state_shape[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((b, *s), init_value, dt))
+                         for s in state_shape)
+        return Tensor(jnp.full((b, *state_shape), init_value, dt))
+
+
+def _make_cell_params(layer, input_size, hidden_size, gates,
+                      weight_ih_attr=None, weight_hh_attr=None,
+                      bias_ih_attr=None, bias_hh_attr=None):
+    k = 1.0 / math.sqrt(hidden_size)
+    default = Uniform(-k, k)
+    dt = layer._dtype
+    wi_init, wi_name = _resolve_init(weight_ih_attr, default)
+    wh_init, wh_name = _resolve_init(weight_hh_attr, default)
+    from ...tensor.tensor import Parameter
+    layer.weight_ih = Parameter(
+        wi_init((gates * hidden_size, input_size), dt), name=wi_name)
+    layer.weight_hh = Parameter(
+        wh_init((gates * hidden_size, hidden_size), dt), name=wh_name)
+    for attr, name in ((bias_ih_attr, "bias_ih"),
+                       (bias_hh_attr, "bias_hh")):
+        if attr is False:
+            setattr(layer, name, None)
+        else:
+            b_init, b_name = _resolve_init(attr, default)
+            setattr(layer, name,
+                    Parameter(b_init((gates * hidden_size,), dt),
+                              name=b_name))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"SimpleRNNCell activation must be 'tanh' or 'relu', got "
+                f"{activation!r}")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _make_cell_params(self, input_size, hidden_size, 1,
+                          weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                          bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _params(self):
+        return tuple(p for p in (self.weight_ih, self.weight_hh,
+                                 self.bias_ih, self.bias_hh)
+                     if p is not None)
+
+    def _make_step(self):
+        act = jnp.tanh if self.activation == "tanh" else (
+            lambda v: jnp.maximum(v, 0))
+        has_bi = self.bias_ih is not None
+        has_bh = self.bias_hh is not None
+
+        def step(x, h, *params):
+            it = iter(params)
+            wi, wh = next(it), next(it)
+            bi = next(it) if has_bi else 0.0
+            bh = next(it) if has_bh else 0.0
+            return (act(x @ wi.T + bi + h @ wh.T + bh),)
+        return step
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply_op(lambda *a: self._make_step()(*a)[0], inputs, states,
+                     *self._params())
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gates i,f,g,o in the reference's chunk order; states (h, c).
+    proj_size adds the output projection h = (o*tanh(c)) @ W_ho^T."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.proj_size = int(proj_size or 0)
+        h_in = self.proj_size if self.proj_size else hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        default = Uniform(-k, k)
+        from ...tensor.tensor import Parameter
+        dt = self._dtype
+        wi_init, wi_name = _resolve_init(weight_ih_attr, default)
+        wh_init, wh_name = _resolve_init(weight_hh_attr, default)
+        self.weight_ih = Parameter(
+            wi_init((4 * hidden_size, input_size), dt), name=wi_name)
+        self.weight_hh = Parameter(
+            wh_init((4 * hidden_size, h_in), dt), name=wh_name)
+        for attr, name_ in ((bias_ih_attr, "bias_ih"),
+                            (bias_hh_attr, "bias_hh")):
+            if attr is False:
+                setattr(self, name_, None)
+            else:
+                b_init, b_name = _resolve_init(attr, default)
+                setattr(self, name_,
+                        Parameter(b_init((4 * hidden_size,), dt),
+                                  name=b_name))
+        if self.proj_size:
+            self.weight_ho = Parameter(
+                default((self.proj_size, hidden_size), dt))
+
+    @property
+    def state_shape(self):
+        h = self.proj_size if self.proj_size else self.hidden_size
+        return ((h,), (self.hidden_size,))
+
+    def _params(self):
+        ps = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            ps.append(self.bias_ih)
+        if self.bias_hh is not None:
+            ps.append(self.bias_hh)
+        if self.proj_size:
+            ps.append(self.weight_ho)
+        return tuple(ps)
+
+    def _make_step(self):
+        has_bi = self.bias_ih is not None
+        has_bh = self.bias_hh is not None
+        proj = bool(self.proj_size)
+
+        def step(x, h, c, *params):
+            it = iter(params)
+            wi, wh = next(it), next(it)
+            bi = next(it) if has_bi else 0.0
+            bh = next(it) if has_bh else 0.0
+            z = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            c2 = f * c + i * jnp.tanh(g)
+            h2 = o * jnp.tanh(c2)
+            if proj:
+                h2 = h2 @ next(it).T
+            return (h2, c2)
+        return step
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        h, c = apply_op(lambda *a: self._make_step()(*a), inputs, h0, c0,
+                        *self._params(), n_outputs=2)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """Gates r,z,c in the reference's chunk order;
+    h' = z*h + (1-z)*tanh(W_ic x + r*(W_hc h))."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _make_cell_params(self, input_size, hidden_size, 3,
+                          weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                          bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _params(self):
+        return tuple(p for p in (self.weight_ih, self.weight_hh,
+                                 self.bias_ih, self.bias_hh)
+                     if p is not None)
+
+    def _make_step(self):
+        has_bi = self.bias_ih is not None
+        has_bh = self.bias_hh is not None
+
+        def step(x, h, *params):
+            it = iter(params)
+            wi, wh = next(it), next(it)
+            bi = next(it) if has_bi else 0.0
+            bh = next(it) if has_bh else 0.0
+            xz = x @ wi.T + bi
+            hz = h @ wh.T + bh
+            xr, xu, xc = jnp.split(xz, 3, axis=-1)
+            hr, hu, hc = jnp.split(hz, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            u = jax.nn.sigmoid(xu + hu)
+            c = jnp.tanh(xc + r * hc)
+            return (u * h + (1.0 - u) * c,)
+        return step
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply_op(lambda *a: self._make_step()(*a)[0], inputs, states,
+                     *self._params())
+        return h, h
+
+
+def _scan_layer(step, x_tbi, init_states, params, reverse, seq_lens):
+    """One lax.scan over time. x_tbi: [T, B, I] (time-major inside).
+    seq_lens: [B] int or None — beyond-length steps keep state and emit 0."""
+    T = x_tbi.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x_tbi = x_tbi[::-1]
+        ts = ts[::-1]
+
+    def body(carry, xt):
+        x_t, t = xt
+        new = step(x_t, *carry, *params)
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            new = tuple(jnp.where(valid, n, c) for n, c in zip(new, carry))
+            out = jnp.where(valid, new[0], jnp.zeros_like(new[0]))
+        else:
+            out = new[0]
+        return new, out
+
+    final, outs = jax.lax.scan(body, tuple(init_states), (x_tbi, ts))
+    if reverse:
+        outs = outs[::-1]
+    return outs, final
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py :: RNN). Built-in cells
+    run as one compiled scan; custom cells (no `_make_step`) fall back to an
+    eager per-timestep loop through the cell's forward."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def _eager_loop(self, inputs, states, sequence_length):
+        from ...tensor.manipulation import stack, unbind
+        steps = unbind(inputs, axis=0 if self.time_major else 1)
+        if self.is_reverse:
+            steps = steps[::-1]
+        outs = []
+        for x_t in steps:
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=0 if self.time_major else 1), states
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        states = tuple(initial_states) if isinstance(
+            initial_states, (tuple, list)) else (initial_states,)
+        if not hasattr(cell, "_make_step"):
+            if sequence_length is not None:
+                raise ValueError(
+                    "sequence_length requires a built-in cell (scan path)")
+            st = states if len(states) > 1 else states[0]
+            return self._eager_loop(inputs, st, sequence_length)
+
+        time_major, reverse = self.time_major, self.is_reverse
+        step = cell._make_step()
+        seq = None if sequence_length is None else (
+            sequence_length._data if isinstance(sequence_length, Tensor)
+            else jnp.asarray(sequence_length))
+
+        def fn(x, *state_and_params):
+            n_s = len(states)
+            init = state_and_params[:n_s]
+            params = state_and_params[n_s:]
+            x_t = x if time_major else jnp.swapaxes(x, 0, 1)
+            outs, final = _scan_layer(step, x_t, init, params, reverse, seq)
+            outs = outs if time_major else jnp.swapaxes(outs, 0, 1)
+            return (outs, *final)
+
+        res = apply_op(fn, inputs, *states, *cell._params(),
+                       n_outputs=1 + len(states))
+        outs, final = res[0], res[1:]
+        final_states = tuple(final) if len(states) > 1 else final[0]
+        return outs, final_states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same sequence, outputs
+    concatenated on the feature dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        outs = apply_op(lambda a, b: jnp.concatenate([a, b], axis=-1),
+                        out_fw, out_bw)
+        return outs, (st_fw, st_bw)
+
+
+class _StackedRNNBase(Layer):
+    _cell_cls: type = SimpleRNNCell
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **cell_kwargs):
+        super().__init__()
+        assert direction in ("forward", "bidirect", "bidirectional")
+        self.bidirect = direction != "forward"
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        ndir = 2 if self.bidirect else 1
+        rnns = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * ndir
+            if self.bidirect:
+                rnns.append(BiRNN(self._cell_cls(in_sz, hidden_size,
+                                                 **cell_kwargs),
+                                  self._cell_cls(in_sz, hidden_size,
+                                                 **cell_kwargs),
+                                  time_major=time_major))
+            else:
+                rnns.append(RNN(self._cell_cls(in_sz, hidden_size,
+                                               **cell_kwargs),
+                                time_major=time_major))
+        self.rnns = LayerList(rnns)
+
+    def _layer_states(self, initial_states, layer):
+        """Slice stacked [L*D, B, H] paddle-layout initial states into this
+        layer's per-cell states (fw, or ((fw),(bw)) when bidirectional)."""
+        if initial_states is None:
+            return None
+        stacked = initial_states if isinstance(
+            initial_states, (tuple, list)) else (initial_states,)
+        ndir = 2 if self.bidirect else 1
+
+        def pick(i):
+            return tuple(s[layer * ndir + i] for s in stacked)
+
+        def unwrap(t):
+            return t if len(t) > 1 else t[0]
+
+        if self.bidirect:
+            return (unwrap(pick(0)), unwrap(pick(1)))
+        return unwrap(pick(0))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        x = inputs
+        finals = []
+        for i, rnn in enumerate(self.rnns):
+            x, st = rnn(x, self._layer_states(initial_states, i),
+                        sequence_length)
+            finals.append(st)
+            if self.dropout and i < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        # stack finals into the reference layout [L*D, B, H]
+        if self._n_states == 1:
+            hs = []
+            for st in finals:
+                if self.bidirect:
+                    hs += [st[0], st[1]]
+                else:
+                    hs.append(st)
+            h = apply_op(lambda *a: jnp.stack(a), *hs)
+            return x, h
+        hs, cs = [], []
+        for st in finals:
+            if self.bidirect:
+                (h_f, c_f), (h_b, c_b) = st
+                hs += [h_f, h_b]
+                cs += [c_f, c_b]
+            else:
+                hs.append(st[0])
+                cs.append(st[1])
+        h = apply_op(lambda *a: jnp.stack(a), *hs)
+        c = apply_op(lambda *a: jnp.stack(a), *cs)
+        return x, (h, c)
+
+
+class SimpleRNN(_StackedRNNBase):
+    _cell_cls = SimpleRNNCell
+    _n_states = 1
+
+
+class LSTM(_StackedRNNBase):
+    _cell_cls = LSTMCell
+    _n_states = 2
+
+
+class GRU(_StackedRNNBase):
+    _cell_cls = GRUCell
+    _n_states = 1

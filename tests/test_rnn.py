@@ -1,0 +1,265 @@
+"""Recurrent layers vs NumPy step-loop oracles (SURVEY §4 OpTest pattern).
+Reference: python/paddle/nn/layer/rnn.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def _np_lstm_steps(x, h, c, wi, wh, bi, bh):
+    """x: [B,T,I] → outs [B,T,H], (h, c)."""
+    outs = []
+    for t in range(x.shape[1]):
+        z = x[:, t] @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = np.split(z, 4, axis=-1)
+        i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, axis=1), h, c
+
+
+def _np_gru_steps(x, h, wi, wh, bi, bh):
+    outs = []
+    for t in range(x.shape[1]):
+        xz = x[:, t] @ wi.T + bi
+        hz = h @ wh.T + bh
+        xr, xu, xc = np.split(xz, 3, axis=-1)
+        hr, hu, hc = np.split(hz, 3, axis=-1)
+        r = _sigmoid(xr + hr)
+        u = _sigmoid(xu + hu)
+        cand = np.tanh(xc + r * hc)
+        h = u * h + (1 - u) * cand
+        outs.append(h)
+    return np.stack(outs, axis=1), h
+
+
+def _params(cell):
+    return (np.asarray(cell.weight_ih._data), np.asarray(cell.weight_hh._data),
+            np.asarray(cell.bias_ih._data), np.asarray(cell.bias_hh._data))
+
+
+def test_lstm_cell_single_step():
+    paddle.seed(0)
+    cell = paddle.nn.LSTMCell(4, 6)
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    h, (h2, c2) = cell(paddle.to_tensor(x))
+    wi, wh, bi, bh = _params(cell)
+    outs, hn, cn = _np_lstm_steps(x[:, None], np.zeros((3, 6), np.float32),
+                                  np.zeros((3, 6), np.float32),
+                                  wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(h2._data), hn, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c2._data), cn, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_rnn_wrapper_lstm_matches_numpy():
+    paddle.seed(1)
+    cell = paddle.nn.LSTMCell(5, 7)
+    rnn = paddle.nn.RNN(cell)
+    x = np.random.RandomState(2).randn(2, 9, 5).astype(np.float32)
+    outs, (h, c) = rnn(paddle.to_tensor(x))
+    wi, wh, bi, bh = _params(cell)
+    e_outs, e_h, e_c = _np_lstm_steps(x, np.zeros((2, 7), np.float32),
+                                      np.zeros((2, 7), np.float32),
+                                      wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(outs._data), e_outs, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h._data), e_h, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c._data), e_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_layer_matches_numpy():
+    paddle.seed(2)
+    gru = paddle.nn.GRU(4, 5)
+    x = np.random.RandomState(3).randn(3, 6, 4).astype(np.float32)
+    outs, h = gru(paddle.to_tensor(x))
+    cell = gru.rnns[0].cell
+    wi, wh, bi, bh = _params(cell)
+    e_outs, e_h = _np_gru_steps(x, np.zeros((3, 5), np.float32),
+                                wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(outs._data), e_outs, rtol=1e-4,
+                               atol=1e-5)
+    assert list(h.shape) == [1, 3, 5]
+    np.testing.assert_allclose(np.asarray(h._data)[0], e_h, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_simple_rnn_relu_and_reverse():
+    paddle.seed(3)
+    cell = paddle.nn.SimpleRNNCell(3, 4, activation="relu")
+    rnn = paddle.nn.RNN(cell, is_reverse=True)
+    x = np.random.RandomState(4).randn(2, 5, 3).astype(np.float32)
+    outs, h = rnn(paddle.to_tensor(x))
+    wi, wh, bi, bh = _params(cell)
+    # reverse: scan from the last timestep backwards
+    hh = np.zeros((2, 4), np.float32)
+    rev_outs = []
+    for t in reversed(range(5)):
+        hh = np.maximum(x[:, t] @ wi.T + bi + hh @ wh.T + bh, 0)
+        rev_outs.append(hh)
+    expect = np.stack(rev_outs[::-1], axis=1)
+    np.testing.assert_allclose(np.asarray(outs._data), expect, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h._data), rev_outs[-1], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_stacked_bidirectional_shapes_and_grad():
+    paddle.seed(4)
+    lstm = paddle.nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.RandomState(5).randn(4, 10, 8).astype(
+        np.float32), stop_gradient=False)
+    outs, (h, c) = lstm(x)
+    assert list(outs.shape) == [4, 10, 32]
+    assert list(h.shape) == [4, 4, 16]  # L*D=4
+    assert list(c.shape) == [4, 4, 16]
+    outs.sum().backward()
+    assert x.grad is not None
+    for p in lstm.parameters():
+        assert p.grad is not None, "all stacked-cell params get grads"
+
+
+def test_rnn_sequence_length_masks_states():
+    paddle.seed(5)
+    cell = paddle.nn.LSTMCell(3, 4)
+    rnn = paddle.nn.RNN(cell)
+    x = np.random.RandomState(6).randn(2, 6, 3).astype(np.float32)
+    outs, (h, c) = rnn(paddle.to_tensor(x),
+                       sequence_length=paddle.to_tensor(
+                           np.array([6, 3], np.int32)))
+    wi, wh, bi, bh = _params(cell)
+    # example 1 stops updating after t=3: final h equals 3-step run
+    e_outs, e_h, e_c = _np_lstm_steps(x[1:2, :3],
+                                      np.zeros((1, 4), np.float32),
+                                      np.zeros((1, 4), np.float32),
+                                      wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(h._data)[1], e_h[0], rtol=1e-4,
+                               atol=1e-5)
+    # masked timesteps emit zeros
+    np.testing.assert_allclose(np.asarray(outs._data)[1, 3:], 0.0)
+
+
+def test_birnn_concatenates():
+    paddle.seed(6)
+    bi = paddle.nn.BiRNN(paddle.nn.GRUCell(3, 5), paddle.nn.GRUCell(3, 5))
+    x = paddle.to_tensor(np.random.RandomState(7).randn(2, 4, 3).astype(
+        np.float32))
+    outs, (st_f, st_b) = bi(x)
+    assert list(outs.shape) == [2, 4, 10]
+    np.testing.assert_allclose(np.asarray(outs._data)[:, -1, :5],
+                               np.asarray(st_f._data), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs._data)[:, 0, 5:],
+                               np.asarray(st_b._data), rtol=1e-5)
+
+
+def test_lstm_time_major():
+    paddle.seed(7)
+    lstm = paddle.nn.LSTM(4, 6, time_major=True)
+    x = np.random.RandomState(8).randn(7, 3, 4).astype(np.float32)  # [T,B,I]
+    outs, (h, c) = lstm(paddle.to_tensor(x))
+    assert list(outs.shape) == [7, 3, 6]
+    cell = lstm.rnns[0].cell
+    wi, wh, bi, bh = _params(cell)
+    e_outs, e_h, e_c = _np_lstm_steps(x.transpose(1, 0, 2),
+                                      np.zeros((3, 6), np.float32),
+                                      np.zeros((3, 6), np.float32),
+                                      wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(outs._data),
+                               e_outs.transpose(1, 0, 2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_user_initial_states_stacked_layout():
+    paddle.seed(8)
+    lstm = paddle.nn.LSTM(3, 4, num_layers=2)
+    x = np.random.RandomState(9).randn(2, 5, 3).astype(np.float32)
+    h0 = np.random.RandomState(10).randn(2, 2, 4).astype(np.float32)
+    c0 = np.random.RandomState(11).randn(2, 2, 4).astype(np.float32)
+    outs, (h, c) = lstm(paddle.to_tensor(x),
+                        (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    # oracle: run layer 0 then layer 1 with their slices of (h0, c0)
+    cur = x
+    for li in range(2):
+        cell = lstm.rnns[li].cell
+        wi, wh, bi, bh = _params(cell)
+        cur, eh, ec = _np_lstm_steps(cur, h0[li], c0[li], wi, wh, bi, bh)
+    np.testing.assert_allclose(np.asarray(outs._data), cur, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h._data)[1], eh, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cell_bias_attr_false_and_validation():
+    cell = paddle.nn.GRUCell(3, 4, bias_ih_attr=False, bias_hh_attr=False)
+    assert cell.bias_ih is None and cell.bias_hh is None
+    x = paddle.to_tensor(np.random.RandomState(12).randn(2, 3).astype(
+        np.float32))
+    h, _ = cell(x)
+    assert list(h.shape) == [2, 4]
+    with pytest.raises(ValueError, match="activation"):
+        paddle.nn.SimpleRNNCell(3, 4, activation="sigmoid")
+
+
+def test_lstm_cell_proj_size():
+    paddle.seed(9)
+    cell = paddle.nn.LSTMCell(5, 8, proj_size=3)
+    x = paddle.to_tensor(np.random.RandomState(13).randn(2, 5).astype(
+        np.float32))
+    h, (h2, c2) = cell(x)
+    assert list(h2.shape) == [2, 3] and list(c2.shape) == [2, 8]
+    rnn = paddle.nn.RNN(cell)
+    seq = paddle.to_tensor(np.random.RandomState(14).randn(2, 6, 5).astype(
+        np.float32))
+    outs, (hf, cf) = rnn(seq)
+    assert list(outs.shape) == [2, 6, 3]
+
+
+def test_rnn_custom_cell_eager_fallback():
+    class DoubleCell(paddle.nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(3, 3)
+
+        @property
+        def state_shape(self):
+            return (3,)
+
+        def forward(self, inputs, states):
+            h = paddle.tanh(self.lin(inputs) + states)
+            return h, h
+
+    rnn = paddle.nn.RNN(DoubleCell())
+    x = paddle.to_tensor(np.random.RandomState(15).randn(2, 4, 3).astype(
+        np.float32))
+    outs, h = rnn(x)
+    assert list(outs.shape) == [2, 4, 3]
+    assert np.isfinite(np.asarray(outs._data)).all()
+
+
+def test_rnn_initial_states_as_list():
+    cell = paddle.nn.LSTMCell(3, 4)
+    rnn = paddle.nn.RNN(cell)
+    x = paddle.to_tensor(np.random.RandomState(16).randn(2, 5, 3).astype(
+        np.float32))
+    h0 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    c0 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    outs, (h, c) = rnn(x, [h0, c0])
+    ref_outs, _ = rnn(x)
+    np.testing.assert_allclose(np.asarray(outs._data),
+                               np.asarray(ref_outs._data), rtol=1e-6)
+
+
+def test_initial_states_dtype_follows_params():
+    cell = paddle.nn.GRUCell(3, 4)
+    st = cell.get_initial_states(paddle.to_tensor(
+        np.zeros((2, 3), np.float32)))
+    assert st._data.dtype == cell.weight_hh._data.dtype
