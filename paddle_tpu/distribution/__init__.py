@@ -144,12 +144,107 @@ class Bernoulli(Distribution):
                         + (1 - self.p) * jnp.log(1 - self.p + eps)))
 
 
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p||q) rule for a distribution pair
+    (reference: python/paddle/distribution/kl.py :: register_kl)."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
 def kl_divergence(p: Distribution, q: Distribution):
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        return p.kl_divergence(q)
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        lp = jax.nn.log_softmax(p.logits, axis=-1)
-        lq = jax.nn.log_softmax(q.logits, axis=-1)
-        return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
-    raise NotImplementedError(
-        f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    """Dispatch on the most-derived registered (type(p), type(q)) pair —
+    MRO distance, exactly like single-dispatch resolution."""
+    matches = [(cp, cq) for (cp, cq) in _KL_REGISTRY
+               if isinstance(p, cp) and isinstance(q, cq)]
+    if not matches:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    mro_p, mro_q = type(p).__mro__, type(q).__mro__
+    matches.sort(key=lambda pair: (mro_p.index(pair[0]),
+                                   mro_q.index(pair[1])))
+    return _KL_REGISTRY[matches[0]](p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, axis=-1)
+    lq = jax.nn.log_softmax(q.logits, axis=-1)
+    return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-8
+    a, b = p.p, q.p
+    return Tensor(a * (jnp.log(a + eps) - jnp.log(b + eps))
+                  + (1 - a) * (jnp.log(1 - a + eps)
+                               - jnp.log(1 - b + eps)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    # KL is finite only when support(p) ⊆ support(q)
+    inside = (p.low >= q.low) & (p.high <= q.high)
+    val = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(inside, val, jnp.inf))
+
+
+# ---- extended families + transforms (separate modules) --------------------
+from .families import (Beta, Dirichlet, Exponential, Gamma,  # noqa: E402
+                       Geometric, Gumbel, Laplace, LogNormal, Multinomial,
+                       Poisson, StudentT, Binomial, Cauchy)
+from .transform import (Transform, AffineTransform, ExpTransform,  # noqa: E402
+                        SigmoidTransform, TanhTransform, PowerTransform,
+                        AbsTransform, ChainTransform,
+                        TransformedDistribution)
+from . import transform  # noqa: E402
+
+__all__ += ["register_kl", "Beta", "Dirichlet", "Exponential", "Gamma",
+            "Geometric", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+            "Poisson", "StudentT", "Binomial", "Cauchy", "Transform",
+            "AffineTransform", "ExpTransform", "SigmoidTransform",
+            "TanhTransform", "PowerTransform", "AbsTransform",
+            "ChainTransform", "TransformedDistribution", "transform"]
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    lg, dig = jax.scipy.special.gammaln, jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    s1 = a1 + b1
+    return Tensor((lg(a2) + lg(b2) - lg(a2 + b2))
+                  - (lg(a1) + lg(b1) - lg(s1))
+                  + (a1 - a2) * dig(a1) + (b1 - b2) * dig(b1)
+                  + (a2 - a1 + b2 - b1) * dig(s1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    lg, dig = jax.scipy.special.gammaln, jax.scipy.special.digamma
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a1 - a2) * dig(a1) - lg(a1) + lg(a2)
+                  + a2 * (jnp.log(b1) - jnp.log(b2))
+                  + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    return Tensor(jnp.log(q.scale / p.scale)
+                  + (p.scale * jnp.exp(-d / p.scale) + d) / q.scale - 1.0)

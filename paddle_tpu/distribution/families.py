@@ -1,0 +1,395 @@
+"""paddle.distribution families beyond the core four.
+
+Parity: python/paddle/distribution/{beta,dirichlet,exponential,gamma,
+geometric,gumbel,laplace,lognormal,multinomial,poisson,student_t,binomial,
+cauchy}.py. Sampling draws explicit PRNG keys (core.rng.next_key) and all
+math is jnp — XLA-compiled elementwise chains, no host round trips."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+from ..tensor.tensor import Tensor
+
+__all__ = ["Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Poisson",
+           "StudentT", "Binomial", "Cauchy"]
+
+
+from . import Distribution, _arr  # noqa: E402  (late: avoid import cycle)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta,
+                                      shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dig = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor(lbeta - (a - 1) * dig(a) - (b - 1) * dig(b)
+                      + (a + b - 2) * dig(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / c.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration,
+                                           tuple(shape) +
+                                           self.concentration.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        norm = (jax.scipy.special.gammaln(c).sum(-1)
+                - jax.scipy.special.gammaln(c.sum(-1)))
+        return Tensor(((c - 1) * jnp.log(v)).sum(-1) - norm)
+
+    def entropy(self):
+        c = self.concentration
+        c0 = c.sum(-1)
+        k = c.shape[-1]
+        dig = jax.scipy.special.digamma
+        lnB = (jax.scipy.special.gammaln(c).sum(-1)
+               - jax.scipy.special.gammaln(c0))
+        return Tensor(lnB + (c0 - k) * dig(c0)
+                      - ((c - 1) * dig(c)).sum(-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate.shape
+        return Tensor(jax.random.exponential(next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        g = jax.random.gamma(next_key(), self.concentration, shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        dig = jax.scipy.special.digamma
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * dig(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p for k = 0, 1, 2, ... (failures before success)."""
+
+    def __init__(self, probs):
+        self.p = _arr(probs)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.p) / self.p)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.p) / self.p ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.p.shape
+        u = jax.random.uniform(next_key(), shape, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.p)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log1p(-self.p) + jnp.log(self.p))
+
+    def entropy(self):
+        p = self.p
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2
+                      * jnp.ones_like(self.loc))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc
+                      + self.scale * jax.random.gumbel(next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + np.euler_gamma
+                      + jnp.zeros_like(self.loc))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.scale ** 2 * jnp.ones_like(self.loc))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc
+                      + self.scale * jax.random.laplace(next_key(), shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale)
+                      + jnp.zeros_like(self.loc))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(next_key(), shape)
+        return Tensor(jnp.exp(self.loc + self.scale * eps))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_arr = _arr(probs)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_arr)
+
+    def sample(self, shape=()):
+        n = self.total_count
+        k = self.probs_arr.shape[-1]
+        logits = jnp.log(self.probs_arr)
+        big = jnp.broadcast_to(
+            logits, tuple(shape) + logits.shape[:-1] + (n, k))
+        cats = jax.random.categorical(next_key(), big, axis=-1)
+        counts = jax.nn.one_hot(cats, k).sum(-2)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(self.probs_arr)
+        coeff = (jax.scipy.special.gammaln(
+            jnp.asarray(self.total_count + 1.0))
+            - jax.scipy.special.gammaln(v + 1.0).sum(-1))
+        return Tensor(coeff + (v * logp).sum(-1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate.shape
+        return Tensor(jax.random.poisson(next_key(), self.rate,
+                                         shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - jax.scipy.special.gammaln(v + 1.0))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = self.df
+        var = jnp.where(v > 2, self.scale ** 2 * v / (v - 2), jnp.inf)
+        return Tensor(jnp.where(v > 1, var, jnp.nan))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        t = jax.random.t(next_key(), self.df, shape)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = self.df
+        z = (_arr(value) - self.loc) / self.scale
+        lg = jax.scipy.special.gammaln
+        return Tensor(lg((v + 1) / 2) - lg(v / 2)
+                      - 0.5 * jnp.log(v * math.pi) - jnp.log(self.scale)
+                      - (v + 1) / 2 * jnp.log1p(z ** 2 / v))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _arr(total_count)
+        self.p = _arr(probs)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.p)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.p * (1 - self.p))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.total_count.shape, self.p.shape)
+        return Tensor(jax.random.binomial(next_key(), self.total_count,
+                                          self.p, shape=shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.total_count, self.p
+        lg = jax.scipy.special.gammaln
+        return Tensor(lg(n + 1) - lg(v + 1) - lg(n - v + 1)
+                      + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return Tensor(self.loc
+                      + self.scale * jax.random.cauchy(next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros_like(self.loc))

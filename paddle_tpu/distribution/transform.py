@@ -1,0 +1,167 @@
+"""paddle.distribution.transform + TransformedDistribution.
+
+Parity: python/paddle/distribution/transform.py :: Transform, AffineTransform,
+ExpTransform, SigmoidTransform, TanhTransform, PowerTransform, AbsTransform,
+ChainTransform, and transformed_distribution.py :: TransformedDistribution.
+log_prob uses the change-of-variables formula with jnp log-det-jacobians."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TanhTransform", "PowerTransform",
+           "AbsTransform", "ChainTransform", "TransformedDistribution"]
+
+
+from . import _arr  # noqa: E402  (shared helper; late: avoid import cycle)
+
+
+class Transform:
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2(log 2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # one branch of the preimage (reference convention)
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = jnp.zeros_like(x)
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution:
+    """base distribution pushed through a transform; log_prob via the
+    change-of-variables formula."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution
+        assert isinstance(base, Distribution)
+        self.base = base
+        if isinstance(transforms, Transform):
+            self.transform = transforms
+        else:
+            ts = list(transforms)
+            self.transform = ts[0] if len(ts) == 1 else ChainTransform(ts)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = _arr(self.base.log_prob(Tensor(x)))
+        fldj = self.transform._fldj(x)
+        # sum the log-det over event dims so shapes match the base density
+        # (a multivariate base reduces its event axes inside log_prob)
+        while fldj.ndim > base_lp.ndim:
+            fldj = fldj.sum(-1)
+        return Tensor(base_lp - fldj)

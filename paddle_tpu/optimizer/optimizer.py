@@ -93,8 +93,13 @@ class Optimizer:
     # ----------------------------------------------------------------- step
     def step(self):
         with no_grad():
-            params_grads = [(p, p.grad) for p in self._params()
-                            if p.trainable and p.grad is not None]
+            # plain Tensors (stop_gradient=False) are optimizable too —
+            # the reference accepts any trainable tensor, not just
+            # Parameters (python/paddle/optimizer/optimizer.py)
+            params_grads = [
+                (p, p.grad) for p in self._params()
+                if getattr(p, "trainable", not p.stop_gradient)
+                and p.grad is not None]
             if self._grad_clip is not None:
                 params_grads = self._grad_clip(params_grads)
             lr = self.get_lr()

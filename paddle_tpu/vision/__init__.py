@@ -1,3 +1,4 @@
 from . import models
 from . import transforms
 from . import datasets
+from . import ops

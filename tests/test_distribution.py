@@ -1,0 +1,183 @@
+"""paddle.distribution breadth: moment/log_prob/KL oracles.
+Reference: python/paddle/distribution/."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _lp(dist, v):
+    return np.asarray(dist.log_prob(paddle.to_tensor(
+        np.asarray(v, np.float32)))._data)
+
+
+def test_beta_moments_logprob_entropy():
+    d = D.Beta(2.0, 3.0)
+    np.testing.assert_allclose(float(np.asarray(d.mean._data)), 0.4,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(d.variance._data)),
+                               2 * 3 / (25.0 * 6), rtol=1e-5)
+    # pdf(0.5; 2,3) = x(1-x)^2 / B(2,3), B(2,3)=1/12
+    np.testing.assert_allclose(_lp(d, 0.5),
+                               np.log(12 * 0.5 * 0.25), rtol=1e-5)
+    paddle.seed(0)
+    s = np.asarray(d.sample([20000])._data)
+    assert ((s > 0) & (s < 1)).all()
+    np.testing.assert_allclose(s.mean(), 0.4, atol=0.01)
+
+
+def test_gamma_exponential_consistency():
+    g = D.Gamma(1.0, 2.0)       # Gamma(1, rate) == Exponential(rate)
+    e = D.Exponential(2.0)
+    for v in (0.1, 0.7, 2.0):
+        np.testing.assert_allclose(_lp(g, v), _lp(e, v), rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(g.mean._data)), 0.5)
+    paddle.seed(1)
+    s = np.asarray(D.Gamma(3.0, 2.0).sample([20000])._data)
+    np.testing.assert_allclose(s.mean(), 1.5, atol=0.03)
+
+
+def test_dirichlet():
+    d = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+    np.testing.assert_allclose(np.asarray(d.mean._data), [0.2, 0.3, 0.5],
+                               rtol=1e-6)
+    paddle.seed(2)
+    s = np.asarray(d.sample([10000])._data)
+    np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.01)
+    v = np.array([0.2, 0.3, 0.5], np.float32)
+    # analytic: log Dir pdf with alpha (2,3,5)
+    from math import lgamma, log
+    expect = (lgamma(10) - lgamma(2) - lgamma(3) - lgamma(5)
+              + 1 * log(0.2) + 2 * log(0.3) + 4 * log(0.5))
+    np.testing.assert_allclose(_lp(d, v), expect, rtol=1e-5)
+
+
+def test_discrete_families():
+    paddle.seed(3)
+    geo = D.Geometric(0.25)
+    np.testing.assert_allclose(float(np.asarray(geo.mean._data)), 3.0)
+    np.testing.assert_allclose(_lp(geo, 2), np.log(0.75 ** 2 * 0.25),
+                               rtol=1e-5)
+    s = np.asarray(geo.sample([30000])._data)
+    np.testing.assert_allclose(s.mean(), 3.0, atol=0.15)
+
+    poi = D.Poisson(4.0)
+    np.testing.assert_allclose(_lp(poi, 3),
+                               np.log(np.exp(-4) * 4 ** 3 / 6), rtol=1e-5)
+    s = np.asarray(poi.sample([30000])._data)
+    np.testing.assert_allclose(s.mean(), 4.0, atol=0.1)
+
+    b = D.Binomial(10, 0.3)
+    np.testing.assert_allclose(_lp(b, 4),
+                               np.log(210 * 0.3 ** 4 * 0.7 ** 6),
+                               rtol=1e-5)
+
+    m = D.Multinomial(5, np.array([0.2, 0.8], np.float32))
+    s = np.asarray(m.sample([2000])._data)
+    np.testing.assert_allclose(s.sum(-1), 5.0)
+    np.testing.assert_allclose(s.mean(0), [1.0, 4.0], atol=0.15)
+    np.testing.assert_allclose(
+        _lp(m, [2, 3]), np.log(10 * 0.2 ** 2 * 0.8 ** 3), rtol=1e-5)
+
+
+def test_heavy_tails_and_location_scale():
+    lap = D.Laplace(1.0, 2.0)
+    np.testing.assert_allclose(_lp(lap, 3.0), -1.0 - np.log(4.0),
+                               rtol=1e-5)
+    gum = D.Gumbel(0.0, 1.0)
+    np.testing.assert_allclose(_lp(gum, 0.0), -1.0, rtol=1e-5)
+    st = D.StudentT(3.0)
+    # t3 pdf at 0 = Γ(2)/(Γ(1.5)·sqrt(3π))
+    expect = math.lgamma(2.0) - math.lgamma(1.5) - 0.5 * np.log(
+        3 * np.pi)
+    np.testing.assert_allclose(_lp(st, 0.0), expect, rtol=1e-5)
+    c = D.Cauchy(0.0, 1.0)
+    np.testing.assert_allclose(_lp(c, 0.0), -np.log(np.pi), rtol=1e-5)
+    ln = D.LogNormal(0.0, 0.5)
+    paddle.seed(4)
+    s = np.asarray(ln.sample([30000])._data)
+    np.testing.assert_allclose(np.log(s).mean(), 0.0, atol=0.01)
+    np.testing.assert_allclose(float(np.asarray(ln.mean._data)),
+                               np.exp(0.125), rtol=1e-5)
+
+
+def test_kl_registry_and_formulas():
+    # Normal — closed form
+    kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+    expect = np.log(2.0) + (1 + 1) / 8.0 - 0.5
+    np.testing.assert_allclose(float(np.asarray(kl._data)), expect,
+                               rtol=1e-5)
+    # KL(p||p) == 0 for several families
+    for p in (D.Beta(2.0, 3.0), D.Gamma(2.0, 1.0), D.Exponential(0.7),
+              D.Laplace(0.0, 1.0)):
+        z = D.kl_divergence(p, p)
+        np.testing.assert_allclose(float(np.asarray(z._data)), 0.0,
+                                   atol=1e-5)
+    # exponential KL formula vs monte carlo
+    p, q = D.Exponential(2.0), D.Exponential(1.0)
+    paddle.seed(5)
+    s = p.sample([100000])
+    mc = float(np.asarray((_lp(p, np.asarray(s._data))
+                           - _lp(q, np.asarray(s._data))).mean()))
+    np.testing.assert_allclose(float(np.asarray(
+        D.kl_divergence(p, q)._data)), mc, atol=0.02)
+    # custom registration
+    class MyDist(D.Distribution):
+        pass
+
+    @D.register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor(np.float32(42.0))
+
+    assert float(np.asarray(D.kl_divergence(MyDist(), MyDist())._data)) \
+        == 42.0
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(MyDist(), D.Normal(0.0, 1.0))
+
+
+def test_transforms_and_transformed_distribution():
+    t = D.AffineTransform(1.0, 2.0)
+    x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(np.asarray(y._data), [1.0, 3.0])
+    np.testing.assert_allclose(np.asarray(t.inverse(y)._data),
+                               np.asarray(x._data), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(t.forward_log_det_jacobian(x)._data), np.log(2.0))
+
+    # LogNormal == exp(Normal): TransformedDistribution log_prob must match
+    base = D.Normal(0.3, 0.7)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.3, 0.7)
+    v = np.array([0.5, 1.0, 2.5], np.float32)
+    np.testing.assert_allclose(_lp(td, v), _lp(ln, v), rtol=1e-5)
+
+    # chain: sigmoid(affine(x))
+    chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.SigmoidTransform()])
+    xv = np.array([0.3], np.float32)
+    fwd = 1 / (1 + np.exp(-2 * 0.3))
+    np.testing.assert_allclose(
+        np.asarray(chain.forward(paddle.to_tensor(xv))._data), fwd,
+        rtol=1e-6)
+    inv = chain.inverse(paddle.to_tensor(np.array([fwd], np.float32)))
+    np.testing.assert_allclose(np.asarray(inv._data), xv, atol=1e-5)
+    # tanh transform ldj matches direct formula
+    tt = D.TanhTransform()
+    np.testing.assert_allclose(
+        np.asarray(tt.forward_log_det_jacobian(paddle.to_tensor(
+            np.array([0.5], np.float32)))._data),
+        np.log(1 - np.tanh(0.5) ** 2), rtol=1e-5)
+
+
+def test_transformed_sampling_statistics():
+    paddle.seed(6)
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                   [D.AffineTransform(5.0, 3.0)])
+    s = np.asarray(td.sample([50000])._data)
+    np.testing.assert_allclose(s.mean(), 5.0, atol=0.05)
+    np.testing.assert_allclose(s.std(), 3.0, atol=0.05)

@@ -1,0 +1,197 @@
+"""paddle.vision.ops parity tests — NumPy oracles.
+Reference: python/paddle/vision/ops.py + detection CUDA kernels."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_box_iou_pairwise():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    iou = np.asarray(V.box_iou(paddle.to_tensor(a),
+                               paddle.to_tensor(b))._data)
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 0.0, atol=1e-7)
+    np.testing.assert_allclose(iou[1, 0], 1 / 7, rtol=1e-5)
+    np.testing.assert_allclose(iou[1, 1], 1 / 7, rtol=1e-5)
+
+
+def test_nms_greedy_and_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [0, 0, 10, 10]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                            paddle.to_tensor(scores))._data)
+    # box3 (same as box0, higher score) kept; boxes 0,1 suppressed; box2 kept
+    assert keep.tolist() == [3, 2]
+    # category-aware: same boxes in different categories both survive
+    cats = np.array([0, 0, 0, 1])
+    keep2 = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                             paddle.to_tensor(scores),
+                             category_idxs=paddle.to_tensor(cats),
+                             categories=[0, 1])._data)
+    assert 3 in keep2.tolist() and 0 in keep2.tolist()
+    keep3 = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                             paddle.to_tensor(scores), top_k=1)._data)
+    assert keep3.tolist() == [3]
+
+
+def test_roi_align_uniform_map():
+    """On a constant feature map every aligned RoI returns that constant."""
+    feat = np.full((1, 3, 8, 8), 2.5, np.float32)
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 7, 7]], np.float32)
+    out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([2], np.int32)),
+                      output_size=2)
+    arr = np.asarray(out._data)
+    assert arr.shape == (2, 3, 2, 2)
+    np.testing.assert_allclose(arr, 2.5, rtol=1e-5)
+
+
+def test_roi_align_linear_gradient_map():
+    """Feature = x coordinate → aligned samples average to bin centers."""
+    H = W = 8
+    feat = np.tile(np.arange(W, dtype=np.float32), (H, 1))[None, None]
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    out = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([1], np.int32)),
+                      output_size=4, aligned=False)
+    arr = np.asarray(out._data)[0, 0]
+    # interior bin centers step by 2 in x; the border bin clamps its
+    # outside samples to the last column (reference border behavior)
+    diffs = np.diff(arr[0])
+    np.testing.assert_allclose(diffs[:-1], 2.0, atol=1e-4)
+    assert 1.5 <= diffs[-1] <= 2.0
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 6, 6), np.float32)
+    feat[0, 0, 1, 1] = 5.0
+    feat[0, 0, 4, 4] = 7.0
+    boxes = np.array([[0, 0, 5, 5]], np.float32)
+    out = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                     paddle.to_tensor(np.array([1], np.int32)),
+                     output_size=2)
+    arr = np.asarray(out._data)[0, 0]
+    assert arr[0, 0] == 5.0 and arr[1, 1] == 7.0
+
+
+def test_roi_align_grad_flows():
+    feat = paddle.to_tensor(np.random.RandomState(0).randn(
+        1, 2, 8, 8).astype(np.float32), stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    out = V.roi_align(feat, boxes,
+                      paddle.to_tensor(np.array([1], np.int32)), 2)
+    out.sum().backward()
+    g = np.asarray(feat.grad._data)
+    assert g.shape == feat._data.shape and np.abs(g).sum() > 0
+
+
+def test_box_coder_encode_decode_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    targets = np.array([[1, 1, 9, 9]], np.float32)
+    enc = np.asarray(V.box_coder(
+        paddle.to_tensor(priors), None, paddle.to_tensor(targets),
+        code_type="encode_center_size")._data)
+    assert enc.shape == (1, 2, 4)
+    dec = np.asarray(V.box_coder(
+        paddle.to_tensor(priors), None, paddle.to_tensor(
+            enc[0][None].transpose(1, 0, 2)),
+        code_type="decode_center_size")._data)
+    # decoding the encodings against the same priors recovers the target
+    np.testing.assert_allclose(dec[0, 0], targets[0], atol=1e-4)
+
+
+def test_yolo_box_shapes_and_center():
+    rng = np.random.RandomState(1)
+    N, A, K, H, W = 1, 2, 3, 4, 4
+    x = np.zeros((N, A * (5 + K), H, W), np.float32)
+    img = np.array([[128, 128]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[10, 14, 23, 27], class_num=K,
+                               conf_thresh=0.0, downsample_ratio=32)
+    b = np.asarray(boxes._data)
+    s = np.asarray(scores._data)
+    assert b.shape == (1, A * H * W, 4)
+    assert s.shape == (1, A * H * W, K)
+    # zero logits → sigmoid 0.5: first cell center = (0.5/4)*128 = 16
+    cx = (b[0, 0, 0] + b[0, 0, 2]) / 2
+    np.testing.assert_allclose(cx, 16.0, atol=1e-3)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],       # small → low level
+                     [0, 0, 300, 300]],    # large → high level
+                    np.float32)
+    outs, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    sizes = [np.asarray(o._data).shape[0] for o in outs]
+    assert sum(sizes) == 2
+    assert np.asarray(outs[0]._data).shape[0] == 1   # level 2 got the small
+    r = np.asarray(restore._data)
+    cat = np.concatenate([np.asarray(o._data) for o in outs if
+                          np.asarray(o._data).size])
+    np.testing.assert_allclose(cat[r], rois, rtol=1e-6)
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    """With zero offsets, deform_conv2d == standard convolution."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(w))
+    arr = np.asarray(out._data)
+    # oracle: direct correlation
+    expect = np.zeros((1, 3, 4, 4), np.float32)
+    for o in range(3):
+        for i in range(4):
+            for j in range(4):
+                expect[0, o, i, j] = (x[0, :, i:i + 3, j:j + 3]
+                                      * w[o]).sum()
+    np.testing.assert_allclose(arr, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_deform_conv2d_mask_scales_contributions():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    w = rng.randn(1, 1, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 3, 3), np.float32)
+    mask0 = np.zeros((1, 9, 3, 3), np.float32)
+    out0 = np.asarray(V.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        mask=paddle.to_tensor(mask0))._data)
+    np.testing.assert_allclose(out0, 0.0, atol=1e-6)
+    layer = V.DeformConv2D(1, 2, 3)
+    out = layer(paddle.to_tensor(x), paddle.to_tensor(off))
+    assert list(out.shape) == [1, 2, 3, 3]
+
+
+def test_yolo_box_iou_aware_layout():
+    N, A, K, H, W = 1, 2, 3, 2, 2
+    x = np.zeros((N, A + A * (5 + K), H, W), np.float32)
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[10, 14, 23, 27], class_num=K,
+                               conf_thresh=0.0, iou_aware=True,
+                               iou_aware_factor=0.5)
+    s = np.asarray(scores._data)
+    # all-zero logits: conf = 0.5^0.5 * 0.5^0.5 = 0.5; cls = 0.5 → 0.25
+    np.testing.assert_allclose(s, 0.25, rtol=1e-5)
+
+
+def test_distribute_fpn_per_image_counts():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 300, 300],
+                     [0, 0, 12, 12]], np.float32)
+    outs, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([2, 1], np.int32)))
+    # level 2 holds the two small rois: one from each image
+    np.testing.assert_array_equal(np.asarray(nums[0]._data), [1, 1])
+    # restore index reorders concatenated levels back to the input order
+    cat = np.concatenate([np.asarray(o._data) for o in outs
+                          if np.asarray(o._data).size])
+    np.testing.assert_allclose(cat[np.asarray(restore._data)], rois)
