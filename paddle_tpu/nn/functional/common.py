@@ -264,3 +264,108 @@ def class_center_sample(label, num_classes, num_samples, group=None):
                       fill_value=num_classes)
     remap = jnp.searchsorted(uniq, lab)
     return Tensor(remap), Tensor(uniq)
+
+
+# ---- round-2 breadth: spatial sampling + temporal shift -------------------
+# Parity: python/paddle/nn/functional/vision.py :: grid_sample, affine_grid,
+# temporal_shift (CUDA kernels under paddle/phi/kernels/gpu/grid_sample*).
+import numpy as np  # noqa: E402
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N,2,3] → sampling grid [N,H,W,2] in [-1,1] coords."""
+    N, C, H, W = [int(v) for v in (out_shape if not isinstance(
+        out_shape, Tensor) else np.asarray(out_shape._data))]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return apply_op(fn, theta if isinstance(theta, Tensor)
+                    else Tensor(jnp.asarray(theta)))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N,C,H,W], grid [N,Ho,Wo,2] (x,y in [-1,1]) → [N,C,Ho,Wo]."""
+    assert mode in ("bilinear", "nearest")
+    assert padding_mode in ("zeros", "border", "reflection")
+
+    def fn(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def reflect(v, lo, hi):
+            rng_ = hi - lo
+            v = jnp.abs((v - lo) % (2 * rng_ + 1e-12))
+            return lo + jnp.minimum(v, 2 * rng_ - v)
+
+        if padding_mode == "reflection":
+            if align_corners:
+                fx = reflect(fx, 0.0, W - 1.0)
+                fy = reflect(fy, 0.0, H - 1.0)
+            else:
+                # half-pixel convention reflects over the pixel-edge box
+                fx = jnp.clip(reflect(fx, -0.5, W - 0.5), 0, W - 1)
+                fy = jnp.clip(reflect(fy, -0.5, H - 0.5), 0, H - 1)
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, H - 1)
+            xc = jnp.clip(xi, 0, W - 1)
+            vals = jax.vmap(lambda f, yy, xx: f[:, yy, xx])(a, yc, xc)
+            if padding_mode == "zeros":
+                inb = ((yi >= 0) & (yi <= H - 1)
+                       & (xi >= 0) & (xi <= W - 1))
+                vals = vals * inb[:, None]
+            return vals                                   # [N,C,Ho,Wo]
+
+        if mode == "nearest":
+            return gather(jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+        y0 = jnp.floor(fy)
+        x0 = jnp.floor(fx)
+        wy = fy - y0
+        wx = fx - x0
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        out = (gather(y0i, x0i) * ((1 - wy) * (1 - wx))[:, None]
+               + gather(y0i, x0i + 1) * ((1 - wy) * wx)[:, None]
+               + gather(y0i + 1, x0i) * (wy * (1 - wx))[:, None]
+               + gather(y0i + 1, x0i + 1) * (wy * wx)[:, None])
+        return out
+    return apply_op(fn, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift: first `ratio` channels shift t-1, next `ratio` shift t+1
+    (reference temporal_shift op). x: [N*T, C, H, W]."""
+    assert data_format == "NCHW"
+
+    def fn(a):
+        NT, C, H, W = a.shape
+        T = seg_num
+        N = NT // T
+        v = a.reshape(N, T, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        bwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        return out.reshape(NT, C, H, W)
+    return apply_op(fn, x)
+
+
+__all__ += ["affine_grid", "grid_sample", "temporal_shift"]

@@ -242,3 +242,92 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
                                  blank_id=blank)
         return _reduce(per_seq, reduction)
     return apply_op(core, log_probs)
+
+
+# ---- round-2 breadth: remaining reference losses --------------------------
+# Parity: python/paddle/nn/functional/loss.py (2.6 surface).
+import math  # noqa: E402
+
+__all__ += ["gaussian_nll_loss", "poisson_nll_loss", "soft_margin_loss",
+            "multi_label_soft_margin_loss",
+            "triplet_margin_with_distance_loss", "npair_loss"]
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """0.5*(log(var) + (x-mu)^2/var) (+ 0.5*log(2π) when full)."""
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label, variance)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(y!) at y > 1
+            stir = (y * jnp.log(y) - y
+                    + 0.5 * jnp.log(2 * jnp.pi * y))
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-y * x)) with y in {-1, 1}."""
+    return apply_op(
+        lambda x, y: _reduce(jnp.logaddexp(0.0, -y * x), reduction),
+        input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def fn(x, y, *w):
+        per = (y * jax.nn.log_sigmoid(x)
+               + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            per = per * w[0]
+        return _reduce(-per.mean(axis=-1), reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(fn, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: paddle_norm(a - b))
+    d_ap = dist(input, positive)
+    d_an = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_an = apply_op(jnp.minimum, d_an, d_pn)
+    return apply_op(
+        lambda ap, an: _reduce(jnp.maximum(ap - an + margin, 0.0),
+                               reduction), d_ap, d_an)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference npair_loss): softmax CE over anchor·posᵀ
+    similarity with label-equality targets + L2 on embeddings."""
+    def fn(a, p, lab):
+        sim = a @ p.T                                   # [B,B]
+        same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        tgt = same / same.sum(-1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -(tgt * logp).sum(-1).mean()
+        reg = l2_reg * ((a * a).sum(-1) + (p * p).sum(-1)).mean() * 0.25
+        return ce + reg
+    return apply_op(fn, anchor, positive, labels)
+
+
+def paddle_norm(t):
+    return apply_op(lambda a: jnp.sqrt((a * a).sum(-1) + 1e-12), t)

@@ -96,6 +96,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        assert data_format == "NCHW" and not ceil_mode, \
+            "return_mask supports NCHW, ceil_mode=False"
+        return max_pool2d_with_mask(x, kernel_size, stride, padding)
     return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
                  data_format=data_format)
 
@@ -151,3 +155,102 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, "max")
+
+
+# ---- round-2 breadth: mask-returning max pool, unpool, lp_pool ------------
+# Parity: python/paddle/nn/functional/pooling.py :: max_pool2d(return_mask),
+# max_unpool2d, lp_pool2d (+ MaxUnPool2D/LPPool2D layers in nn/layer).
+
+def _patches2d(a, kh, kw, sh, sw, ph, pw, pad_value):
+    """a [N,C,H,W] → patches [N,C,Ho,Wo,kh*kw] + flat input index per tap."""
+    N, C, H, W = a.shape
+    ap = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=pad_value)
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    iy = jnp.arange(Ho)[:, None] * sh + jnp.arange(kh)[None, :]  # [Ho,kh]
+    ix = jnp.arange(Wo)[:, None] * sw + jnp.arange(kw)[None, :]  # [Wo,kw]
+    pat = ap[:, :, iy[:, None, :, None], ix[None, :, None, :]]
+    # → [N,C,Ho,Wo,kh,kw]
+    pat = pat.reshape(N, C, Ho, Wo, kh * kw)
+    # flat index into the UNPADDED input for each tap (clip to borders)
+    yy = jnp.clip(iy - ph, 0, H - 1)[:, None, :, None]
+    xx = jnp.clip(ix - pw, 0, W - 1)[None, :, None, :]
+    flat = (yy * W + xx).reshape(Ho, Wo, kh * kw)
+    return pat, flat, Ho, Wo
+
+
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0, name=None):
+    """→ (pooled, mask) where mask holds flat H*W argmax positions (the
+    reference's return_mask=True contract, consumed by max_unpool2d)."""
+    kh, kw = _tuple(kernel_size, 2)
+    sh, sw = _tuple(stride if stride is not None else kernel_size, 2)
+    ph, pw = _tuple(padding, 2)
+
+    def fn(a):
+        pat, flat, Ho, Wo = _patches2d(a, kh, kw, sh, sw, ph, pw, -jnp.inf)
+        best = jnp.argmax(pat, axis=-1)                   # [N,C,Ho,Wo]
+        pooled = jnp.take_along_axis(pat, best[..., None], axis=-1)[..., 0]
+        mask = flat[jnp.arange(Ho)[:, None], jnp.arange(Wo)[None, :],
+                    best]                                  # [N,C,Ho,Wo]
+        return pooled, mask.astype(jnp.int32)
+    return apply_op(fn, x, n_outputs=2)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions; everything
+    else zero (reference max_unpool2d)."""
+    assert data_format == "NCHW", "max_unpool2d supports NCHW"
+    kh, kw = _tuple(kernel_size, 2)
+    sh, sw = _tuple(stride if stride is not None else kernel_size, 2)
+    ph, pw = _tuple(padding, 2)
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(
+        indices)
+
+    def fn(a):
+        N, C, Ho, Wo = a.shape
+        if output_size is not None:
+            H, W = output_size[-2:]
+        else:
+            H = (Ho - 1) * sh - 2 * ph + kh
+            W = (Wo - 1) * sw - 2 * pw + kw
+        flat = jnp.zeros((N, C, H * W), a.dtype)
+        # .set, not .add: overlapping windows whose argmax is the same
+        # input cell all carry that cell's value — writing once is the
+        # reference semantics (summing would multiply it)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1)].set(a.reshape(N, C, -1))
+        return out.reshape(N, C, H, W)
+    return apply_op(fn, x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    """(sum over window |x|^p)^(1/p) (reference lp_pool2d). ceil_mode pads
+    zeros on the bottom/right (|0|^p adds nothing to the window sum)."""
+    assert data_format == "NCHW", "lp_pool2d supports NCHW"
+    p = float(norm_type)
+    kh, kw = _tuple(kernel_size, 2)
+    sh, sw = _tuple(stride if stride is not None else kernel_size, 2)
+    ph, pw = _tuple(padding, 2)
+
+    def fn(a):
+        H, W = a.shape[-2:]
+        extra_h = extra_w = 0
+        if ceil_mode:
+            out_h = -(-(H + 2 * ph - kh) // sh) + 1
+            out_w = -(-(W + 2 * pw - kw) // sw) + 1
+            extra_h = max((out_h - 1) * sh + kh - (H + 2 * ph), 0)
+            extra_w = max((out_w - 1) * sw + kw - (W + 2 * pw), 0)
+        powd = jnp.abs(a) ** p
+        s = jax.lax.reduce_window(
+            powd, 0.0, jax.lax.add, (1, 1, kh, kw), (1, 1, sh, sw),
+            ((0, 0), (0, 0), (ph, ph + extra_h), (pw, pw + extra_w)))
+        return s ** (1.0 / p)
+    return apply_op(fn, x)
+
+
+__all__ += ["max_pool2d_with_mask", "max_unpool2d", "lp_pool2d"]

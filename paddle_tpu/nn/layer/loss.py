@@ -146,3 +146,60 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self.args)
+
+
+# ---- round-2 breadth -------------------------------------------------------
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self.args)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, *self.args)
+
+
+__all__ += ["GaussianNLLLoss", "PoissonNLLLoss", "SoftMarginLoss",
+            "MultiLabelSoftMarginLoss", "TripletMarginWithDistanceLoss"]

@@ -82,3 +82,31 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 
 class AdaptiveMaxPool3D(_AdaptivePool):
     _fn = "adaptive_max_pool3d"
+
+
+# ---- round-2 breadth -------------------------------------------------------
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)
+
+
+__all__ += ["MaxUnPool2D", "LPPool2D"]

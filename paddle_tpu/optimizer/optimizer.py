@@ -38,6 +38,13 @@ class Optimizer:
         self._accumulators: dict[str, dict[int, Tensor]] = {}
         self._master_weights: dict[int, Tensor] = {}
         self._step_count = 0
+        if self._parameter_list:
+            # plain trainable Tensors must live in the persistent registry
+            # too: jit.to_static functionalizes persistent state, and an
+            # optimizer-updated tensor outside it would trap a tracer
+            for p in self._parameter_list:
+                if isinstance(p, Tensor):
+                    register_persistent(p)
 
     # ----------------------------------------------------------------- lr
     def get_lr(self) -> float:

@@ -101,7 +101,18 @@ def clear_tape() -> None:
 _persistent: "weakref.WeakSet[Tensor]" = weakref.WeakSet()
 
 
+_persistent_uids: set = set()
+
+
 def register_persistent(t: "Tensor") -> None:
+    # O(1) identity-idempotence via a parallel uid set: adding a weakref
+    # whose referent is already present would compare refs through
+    # Tensor.__eq__ (elementwise) — and a linear scan would make bulk
+    # registration quadratic
+    if t._uid in _persistent_uids:
+        return
+    _persistent_uids.add(t._uid)
+    weakref.finalize(t, _persistent_uids.discard, t._uid)
     _persistent.add(t)
 
 

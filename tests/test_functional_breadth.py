@@ -1,0 +1,180 @@
+"""Round-2 functional breadth: grid_sample/affine_grid, losses,
+unpool/lp_pool, temporal_shift — NumPy oracles.
+Reference: python/paddle/nn/functional/{vision,loss,pooling}.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def test_affine_grid_identity_and_grid_sample_roundtrip():
+    x = np.random.RandomState(0).randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(_t(theta), [2, 3, 5, 7], align_corners=True)
+    assert list(grid.shape) == [2, 5, 7, 2]
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._data), x, atol=1e-5)
+
+
+def test_grid_sample_translation_and_modes():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # shift sampling one pixel right: out[:, :, i, j] = x[:, :, i, j+1]
+    theta = np.array([[[1, 0, 2 / 3], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(_t(theta), [1, 1, 4, 4], align_corners=True)
+    out = np.asarray(F.grid_sample(_t(x), grid,
+                                   align_corners=True)._data)
+    np.testing.assert_allclose(out[0, 0, :, :3], x[0, 0, :, 1:], atol=1e-4)
+    # zeros padding beyond the right edge
+    np.testing.assert_allclose(out[0, 0, :, 3],
+                               x[0, 0, :, 3] * 0.0, atol=1e-4)
+    # border padding clamps instead
+    outb = np.asarray(F.grid_sample(_t(x), grid, padding_mode="border",
+                                    align_corners=True)._data)
+    np.testing.assert_allclose(outb[0, 0, :, 3], x[0, 0, :, 3], atol=1e-4)
+    # nearest mode on exact grid == bilinear
+    outn = np.asarray(F.grid_sample(_t(x), grid, mode="nearest",
+                                    align_corners=True)._data)
+    np.testing.assert_allclose(outn[0, 0, :, :3], x[0, 0, :, 1:],
+                               atol=1e-4)
+
+
+def test_grid_sample_grad():
+    x = _t(np.random.RandomState(1).randn(1, 2, 4, 4).astype(np.float32),
+           stop_gradient=False)
+    theta = _t(np.array([[[1, 0, 0.1], [0, 1, -0.1]]], np.float32),
+               stop_gradient=False)
+    grid = F.affine_grid(theta, [1, 2, 4, 4])
+    out = F.grid_sample(x, grid)
+    out.sum().backward()
+    assert x.grad is not None and theta.grad is not None
+    assert np.abs(np.asarray(theta.grad._data)).sum() > 0
+
+
+def test_gaussian_and_poisson_nll():
+    mu = np.array([0.0, 1.0], np.float32)
+    y = np.array([1.0, 1.0], np.float32)
+    var = np.array([1.0, 4.0], np.float32)
+    out = F.gaussian_nll_loss(_t(mu), _t(y), _t(var), reduction="none")
+    expect = 0.5 * (np.log(var) + (y - mu) ** 2 / var)
+    np.testing.assert_allclose(np.asarray(out._data), expect, rtol=1e-5)
+    lam_log = np.array([0.0, 1.0], np.float32)
+    pout = F.poisson_nll_loss(_t(lam_log), _t(y), reduction="none")
+    np.testing.assert_allclose(np.asarray(pout._data),
+                               np.exp(lam_log) - y * lam_log, rtol=1e-5)
+
+
+def test_margin_losses():
+    x = np.array([[1.0, -2.0]], np.float32)
+    y = np.array([[1.0, -1.0]], np.float32)
+    out = F.soft_margin_loss(_t(x), _t(y), reduction="none")
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.log1p(np.exp(-y * x)), rtol=1e-5)
+    lab = np.array([[1.0, 0.0]], np.float32)
+    ml = F.multi_label_soft_margin_loss(_t(x), _t(lab), reduction="none")
+    sig = 1 / (1 + np.exp(-x))
+    expect = -(lab * np.log(sig) + (1 - lab) * np.log(1 - sig)).mean(-1)
+    np.testing.assert_allclose(np.asarray(ml._data), expect, rtol=1e-4)
+    a = np.array([[0.0, 0.0]], np.float32)
+    p = np.array([[0.0, 1.0]], np.float32)
+    n = np.array([[0.0, 3.0]], np.float32)
+    tl = F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n),
+                                             margin=1.0, reduction="none")
+    np.testing.assert_allclose(np.asarray(tl._data), [0.0], atol=1e-5)
+    npl = F.npair_loss(_t(np.eye(2, dtype=np.float32)),
+                       _t(np.eye(2, dtype=np.float32)),
+                       _t(np.array([0, 1])))
+    assert np.isfinite(float(np.asarray(npl._data)))
+
+
+def test_max_unpool2d_roundtrip():
+    x = np.random.RandomState(2).randn(1, 2, 6, 6).astype(np.float32)
+    pooled, mask = F.max_pool2d(_t(x), 2, return_mask=True)
+    pa = np.asarray(pooled._data)
+    # oracle max pool
+    expect = x.reshape(1, 2, 3, 2, 3, 2).transpose(
+        0, 1, 2, 4, 3, 5).reshape(1, 2, 3, 3, 4).max(-1)
+    np.testing.assert_allclose(pa, expect, rtol=1e-6)
+    un = np.asarray(F.max_unpool2d(pooled, mask, 2)._data)
+    assert un.shape == (1, 2, 6, 6)
+    # each pooled value lands at its original argmax position
+    for c in range(2):
+        for i in range(3):
+            for j in range(3):
+                window = x[0, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                am = np.unravel_index(window.argmax(), (2, 2))
+                assert un[0, c, 2 * i + am[0], 2 * j + am[1]] == \
+                    pytest.approx(window.max(), rel=1e-6)
+    # everything else zero
+    assert (un != 0).sum() == 2 * 9
+
+
+def test_lp_pool2d_matches_oracle():
+    x = np.random.RandomState(3).randn(1, 1, 4, 4).astype(np.float32)
+    out = np.asarray(F.lp_pool2d(_t(x), 3, 2)._data)
+    win = np.abs(x.reshape(1, 1, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 3, 5).reshape(1, 1, 2, 2, 4)) ** 3
+    np.testing.assert_allclose(out, win.sum(-1) ** (1 / 3), rtol=1e-4)
+
+
+def test_temporal_shift_semantics():
+    # N=1, T=2, C=4 → ratio .25: ch0 shifts from future, ch1 from past
+    x = np.arange(2 * 4, dtype=np.float32).reshape(2, 4, 1, 1)
+    out = np.asarray(F.temporal_shift(_t(x), seg_num=2,
+                                      shift_ratio=0.25)._data)
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]   # t=0 ch0 ← t=1
+    assert out[1, 0, 0, 0] == 0               # t=1 ch0 ← zero pad
+    assert out[0, 1, 0, 0] == 0               # t=0 ch1 ← zero pad
+    assert out[1, 1, 0, 0] == x[0, 1, 0, 0]   # t=1 ch1 ← t=0
+    np.testing.assert_allclose(out[:, 2:], x[:, 2:])  # rest unshifted
+
+
+def test_loss_and_pool_layers():
+    gl = paddle.nn.GaussianNLLLoss()
+    v = _t(np.ones((2, 2), np.float32))
+    assert np.isfinite(float(np.asarray(gl(v, v, v)._data)))
+    pool = paddle.nn.LPPool2D(2, 2)
+    assert list(pool(_t(np.ones((1, 1, 4, 4), np.float32))).shape) \
+        == [1, 1, 2, 2]
+    x = _t(np.random.RandomState(4).randn(1, 1, 4, 4).astype(np.float32))
+    pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+    unpool = paddle.nn.MaxUnPool2D(2)
+    assert list(unpool(pooled, mask).shape) == [1, 1, 4, 4]
+
+
+def test_max_unpool2d_overlapping_windows_write_once():
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 5.0
+    pooled, mask = F.max_pool2d(_t(x), 2, stride=1, return_mask=True)
+    un = np.asarray(F.max_unpool2d(pooled, mask, 2, stride=1)._data)
+    # all four overlapping windows argmax at (1,1): value written once
+    assert un[0, 0, 1, 1] == 5.0
+    assert un.sum() == 5.0
+
+
+def test_grid_sample_reflection_half_pixel():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # sample far left of the image: reflection about the -0.5 pixel edge
+    grid = np.zeros((1, 1, 1, 2), np.float32)
+    grid[0, 0, 0] = [-1.4, -1.0]   # x beyond left edge, y at top
+    out = np.asarray(F.grid_sample(_t(x), _t(grid),
+                                   padding_mode="reflection",
+                                   align_corners=False)._data)
+    # fx = ((-1.4+1)*4-1)/2 = -1.3 → reflect over [-0.5, 3.5] → 0.3... wait
+    # reflect(-1.3) about -0.5 → 0.3; fy = -0.5 → clamp 0 → row 0
+    expect = 0.3 * x[0, 0, 0, 1] + 0.7 * x[0, 0, 0, 0]
+    np.testing.assert_allclose(out[0, 0, 0, 0], expect, atol=1e-5)
+
+
+def test_lp_pool2d_ceil_mode_shape():
+    x = np.ones((1, 1, 5, 5), np.float32)
+    out = np.asarray(F.lp_pool2d(_t(x), 2, 2, stride=2,
+                                 ceil_mode=True)._data)
+    assert out.shape == (1, 1, 3, 3)
+    out2 = np.asarray(F.lp_pool2d(_t(x), 2, 2, stride=2)._data)
+    assert out2.shape == (1, 1, 2, 2)
