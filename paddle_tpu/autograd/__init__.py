@@ -148,3 +148,9 @@ class PyLayer(metaclass=PyLayerMeta):
         )
         tape.nodes.append(node)
         return outs if multi else outs[0]
+
+
+# functional transforms (reference: autograd.py jacobian/hessian + incubate
+# jvp/vjp)
+from .functional import jacobian, hessian, jvp, vjp  # noqa: E402
+__all__ += ["jacobian", "hessian", "jvp", "vjp"]

@@ -1,0 +1,96 @@
+"""paddle.autograd functional transforms: jacobian, hessian, jvp, vjp.
+
+Parity: python/paddle/autograd/autograd.py :: jacobian, hessian (2.6 lazy
+Jacobian API exposed eagerly here) and python/paddle/incubate/autograd/
+:: jvp, vjp. TPU-first: these are direct jax.jacfwd/jacrev/jvp/vjp over a
+functionalized view of the user callable — one traced program instead of
+the reference's per-row double-backward loops."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp"]
+
+
+def _wrap_func(func, n_inputs):
+    """Lift a Tensor→Tensor(s) callable to arrays→arrays (pure)."""
+    def fn(*arrays):
+        outs = func(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data for o in outs)
+        return outs._data
+    return fn
+
+
+def _unpack(xs):
+    if isinstance(xs, (tuple, list)):
+        return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs], True
+    return [xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)], False
+
+
+def _tensorize(tree):
+    return jax.tree.map(lambda a: Tensor(a), tree)
+
+
+def jacobian(func, xs, is_batched: bool = False, mode: str = "rev"):
+    """d func(xs) / d xs. mode='rev' (jacrev, tall Jacobians) or 'fwd'
+    (jacfwd, wide Jacobians). Returns Tensor(s) mirroring the reference's
+    [*out_shape, *in_shape] layout (batched: diagonal over axis 0)."""
+    arrays, multi_in = _unpack(xs)
+    fn = _wrap_func(func, len(arrays))
+    jac_fn = jax.jacrev if mode == "rev" else jax.jacfwd
+    # single input: argnums=0 so the result mirrors the OUTPUT structure
+    # exactly (a tuple result then means multiple outputs, never argnums)
+    argnums = tuple(range(len(arrays))) if multi_in else 0
+    if is_batched:
+        jac = jax.vmap(jac_fn(fn, argnums=argnums))(*arrays)
+    else:
+        jac = jac_fn(fn, argnums=argnums)(*arrays)
+    return _tensorize(jac)
+
+
+def hessian(func, xs, is_batched: bool = False):
+    """d² scalar-func / d xs² via fwd-over-rev (the XLA-efficient
+    composition)."""
+    arrays, multi_in = _unpack(xs)
+    fn = _wrap_func(func, len(arrays))
+    argnums = tuple(range(len(arrays))) if multi_in else 0
+    hess_fn = jax.jacfwd(jax.jacrev(fn, argnums=argnums), argnums=argnums)
+    if is_batched:
+        hess = jax.vmap(hess_fn)(*arrays)
+    else:
+        hess = hess_fn(*arrays)
+    return _tensorize(hess)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J·v). v defaults to ones."""
+    arrays, multi_in = _unpack(xs)
+    fn = _wrap_func(func, len(arrays))
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents, _ = _unpack(v)
+    primal, tangent = jax.jvp(fn, tuple(arrays), tuple(tangents))
+    return _tensorize(primal), _tensorize(tangent)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ·J). v defaults to ones."""
+    arrays, multi_in = _unpack(xs)
+    fn = _wrap_func(func, len(arrays))
+    primal, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, primal)
+    else:
+        cots, _ = _unpack(v)
+        cot = tuple(cots) if isinstance(primal, tuple) else cots[0]
+    grads = vjp_fn(cot)
+    gout = _tensorize(grads)
+    if not multi_in:
+        gout = gout[0]
+    return _tensorize(primal), gout

@@ -1,1 +1,3 @@
 from . import nn
+from . import autograd
+from . import distributed

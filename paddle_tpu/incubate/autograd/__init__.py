@@ -1,0 +1,33 @@
+"""paddle.incubate.autograd — functional transforms (jvp/vjp/Jacobian/
+Hessian). Parity: python/paddle/incubate/autograd/__init__.py; the
+implementations live in paddle.autograd.functional (jax.jacfwd/jacrev)."""
+from ...autograd.functional import jacobian, hessian, jvp, vjp
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "Jacobian", "Hessian"]
+
+
+class Jacobian:
+    """Materialized Jacobian with [] indexing (the reference's lazy view —
+    computed eagerly here; XLA fuses the full jacrev anyway)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._jac = jacobian(func, xs, is_batched=is_batched)
+
+    def __getitem__(self, idx):
+        return self._jac[idx]
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        self._hess = hessian(func, xs, is_batched=is_batched)
+
+    def __getitem__(self, idx):
+        return self._hess[idx]
+
+    @property
+    def shape(self):
+        return self._hess.shape

@@ -99,6 +99,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     if return_mask:
         assert data_format == "NCHW" and not ceil_mode, \
             "return_mask supports NCHW, ceil_mode=False"
+        assert not isinstance(padding, str) and not (
+            isinstance(padding, (list, tuple)) and padding
+            and isinstance(padding[0], (list, tuple))), \
+            "return_mask supports int / (int, int) padding"
         return max_pool2d_with_mask(x, kernel_size, stride, padding)
     return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
                  data_format=data_format)

@@ -190,3 +190,88 @@ class TestRecompute:
         out.backward()
         assert lin.weight.grad is not None
         assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+# ---- functional transforms (round 2): jacobian/hessian/jvp/vjp ------------
+
+class TestAutogradFunctional:
+    def test_jacobian_rev_and_fwd(self):
+        def f(x):
+            return paddle.concat([x * 2, (x ** 2)])
+
+        x = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+        for mode in ("rev", "fwd"):
+            j = paddle.autograd.jacobian(f, x, mode=mode)
+            expect = np.vstack([np.diag([2.0, 2.0]), np.diag([2.0, 6.0])])
+            np.testing.assert_allclose(np.asarray(j._data), expect,
+                                       rtol=1e-5, err_msg=mode)
+
+    def test_jacobian_multi_input(self):
+        def f(a, b):
+            return a * b
+
+        a = paddle.to_tensor(np.array([2.0], np.float32))
+        b = paddle.to_tensor(np.array([5.0], np.float32))
+        ja, jb = paddle.autograd.jacobian(f, [a, b])
+        np.testing.assert_allclose(np.asarray(ja._data), [[5.0]])
+        np.testing.assert_allclose(np.asarray(jb._data), [[2.0]])
+
+    def test_hessian_quadratic(self):
+        A = np.array([[2.0, 1.0], [1.0, 4.0]], np.float32)
+
+        def f(x):
+            return 0.5 * (x.matmul(paddle.to_tensor(A)) * x).sum()
+
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        h = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(np.asarray(h._data),
+                                   0.5 * (A + A.T), rtol=1e-5)
+
+    def test_jvp_vjp_consistency(self):
+        def f(x):
+            return paddle.tanh(x)
+
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        _, jv = paddle.autograd.jvp(f, x, v)
+        _, vj = paddle.autograd.vjp(f, x, v)
+        # diagonal Jacobian: J·v == vᵀ·J
+        np.testing.assert_allclose(np.asarray(jv._data),
+                                   np.asarray(vj._data), rtol=1e-5)
+        d = 1 - np.tanh([0.3, -0.7]) ** 2
+        np.testing.assert_allclose(np.asarray(jv._data), d * [1.0, 2.0],
+                                   rtol=1e-5)
+
+    def test_batched_jacobian(self):
+        def f(x):
+            return (x ** 2).sum(-1)
+
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]],
+                                      np.float32))
+        j = paddle.autograd.jacobian(f, x, is_batched=True)
+        np.testing.assert_allclose(np.asarray(j._data),
+                                   2 * np.asarray(x._data), rtol=1e-5)
+
+    def test_incubate_lazy_wrappers(self):
+        from paddle_tpu.incubate.autograd import Hessian, Jacobian
+
+        def f(x):
+            return (x ** 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = Jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(J[1]._data), 12.0, rtol=1e-5)
+        H = Hessian(f, x)
+        np.testing.assert_allclose(np.asarray(H[1]._data)[1], 12.0,
+                                   rtol=1e-5)
+
+    def test_jacobian_multi_output_single_input(self):
+        def f(x):
+            return [x * 2, x ** 2]
+
+        x = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+        j1, j2 = paddle.autograd.jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(j1._data),
+                                   np.diag([2.0, 2.0]), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(j2._data),
+                                   np.diag([2.0, 6.0]), rtol=1e-5)

@@ -165,8 +165,8 @@ def test_grid_sample_reflection_half_pixel():
     out = np.asarray(F.grid_sample(_t(x), _t(grid),
                                    padding_mode="reflection",
                                    align_corners=False)._data)
-    # fx = ((-1.4+1)*4-1)/2 = -1.3 → reflect over [-0.5, 3.5] → 0.3... wait
-    # reflect(-1.3) about -0.5 → 0.3; fy = -0.5 → clamp 0 → row 0
+    # fx = ((-1.4+1)*4-1)/2 = -1.3; reflect about -0.5 → 0.3
+    # fy = -0.5 → clamp into [0, H-1] → row 0
     expect = 0.3 * x[0, 0, 0, 1] + 0.7 * x[0, 0, 0, 0]
     np.testing.assert_allclose(out[0, 0, 0, 0], expect, atol=1e-5)
 
