@@ -51,30 +51,59 @@ def _init_devices():
     ~4 minutes (outages are long), then fall back to CPU via jax.config
     (which wins over the baked-in JAX_PLATFORMS=axon env) so the bench
     still emits its one JSON line."""
-    delays = [0, 15, 45]  # worst case ~4 min incl. probes: leave margin
+    import threading
+
+    cache = "/tmp/paddle_tpu_probe_down"
+    if os.environ.get("BENCH_TPU_UNAVAILABLE") == "1" or (
+            os.path.exists(cache)
+            and time.time() - os.path.getmtime(cache) < 600):
+        print("bench: TPU marked unavailable (env/cache); skipping probes",
+              file=sys.stderr)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return jax, jax.devices()[0], True
+
+    # worst case: 3×75 s probes + 60 s sleeps + 120 s init watchdog ≈ 7 min
+    # before the CPU fallback; driver timeouts must budget for that
+    delays = [0, 15, 45]
     for i, delay in enumerate(delays):
         if delay:
             time.sleep(delay)
         if _probe_tpu(timeout_s=75):
             import jax
-            import signal
+            # a wedge inside native init never returns to the bytecode
+            # loop, so SIGALRM can't raise — a watchdog thread hard-exits
+            # instead (rc=3 tells the driver "init hang", vs hanging
+            # forever while holding the exclusive TPU grant)
+            done = threading.Event()
 
-            def _timeout_handler(signum, frame):
-                raise TimeoutError("in-process TPU init hung")
-            old = signal.signal(signal.SIGALRM, _timeout_handler)
-            signal.alarm(120)  # the probe-to-init window can still wedge
+            def _watchdog():
+                if not done.wait(120.0):
+                    print("bench: in-process TPU init hung after a good "
+                          "probe; exiting(3)", file=sys.stderr)
+                    os._exit(3)
+            threading.Thread(target=_watchdog, daemon=True).start()
             try:
-                return jax, jax.devices()[0], False
+                dev = jax.devices()[0]
+                done.set()
+                try:
+                    os.remove(cache)  # tunnel is back: clear the skip
+                except OSError:
+                    pass
+                return jax, dev, False
             except Exception as e:
+                done.set()
                 print(f"bench: init after good probe failed: {e}",
                       file=sys.stderr)
-            finally:
-                signal.alarm(0)
-                signal.signal(signal.SIGALRM, old)
         print(f"bench: TPU probe {i + 1}/{len(delays)} failed",
               file=sys.stderr)
     print("bench: accelerator unreachable; falling back to CPU (number "
           "is NOT comparable to TPU baselines)", file=sys.stderr)
+    try:  # let sibling benches skip the probe ladder for the next 10 min
+        with open(cache, "w") as f:
+            f.write(str(time.time()))
+    except OSError:
+        pass
     import jax
     jax.config.update("jax_platforms", "cpu")
     return jax, jax.devices()[0], True

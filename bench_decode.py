@@ -17,12 +17,10 @@ import numpy as np
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    import jax
-    try:
-        dev = jax.devices()[0]
-    except Exception:
-        jax.config.update("jax_platforms", "cpu")
-        dev = jax.devices()[0]
+    # tunnel-outage-safe init (subprocess probe + CPU fallback): shared
+    # with the headline bench
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
 
     import paddle_tpu as paddle
@@ -59,13 +57,16 @@ def main():
     float(np.asarray(out._data).sum())
     dt = time.perf_counter() - t0
     toks = batch * new_tokens
-    print(json.dumps({
+    record = {
         "metric": "fused_decode_tokens_per_sec",
         "value": round(toks / dt, 2),
         "unit": "tokens/s",
         "batch": batch, "new_tokens": new_tokens, "max_seq": smax,
         "layers": L, "hidden": E, "device": str(dev),
-    }))
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -28,3 +28,5 @@ __all__ = [
     "reduce_scatter", "new_group", "get_group", "ReduceOp", "fleet",
     "sharding", "shard_tensor", "ProcessMesh", "spawn", "is_initialized",
 ]
+from . import rpc  # noqa: E402  (reference: paddle.distributed.rpc)
+__all__.append("rpc")

@@ -1,0 +1,192 @@
+"""fleet.utils.fs — filesystem abstraction for checkpoint tooling.
+
+Parity: python/paddle/distributed/fleet/utils/fs.py :: FS, LocalFS,
+HDFSClient. LocalFS is fully functional; HDFSClient requires a hadoop
+client binary and degrades to a clear error when absent (zero-egress
+environment)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem with the reference's (dirs, files) ls contract."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if not overwrite and os.path.exists(dst):
+            raise ExecuteError(f"mv: destination exists: {dst}")
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path):
+            if not exist_ok:
+                raise ExecuteError(f"touch: exists: {path}")
+            return
+        with open(path, "a"):
+            pass
+
+    # upload/download are copies on a local fs
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """`hadoop fs` CLI wrapper (reference contract). Instantiation checks
+    the client exists so failures happen at setup, not mid-checkpoint."""
+
+    def __init__(self, hadoop_home: str, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._bin = os.path.join(hadoop_home, "bin", "hadoop")
+        self._configs = configs or {}
+        self._timeout_s = float(time_out) / 1000.0
+        if not os.path.exists(self._bin):
+            raise ExecuteError(
+                f"hadoop client not found at {self._bin}; HDFSClient "
+                f"requires a hadoop install (unavailable in this "
+                f"environment — use LocalFS)")
+
+    def _run(self, *args):
+        cmd = [self._bin, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=self._timeout_s)
+        except subprocess.TimeoutExpired:
+            raise ExecuteError(
+                f"hadoop {' '.join(args)}: timed out after "
+                f"{self._timeout_s:.0f}s")
+        if res.returncode != 0:
+            raise ExecuteError(f"hadoop {' '.join(args)}: {res.stderr}")
+        return res.stdout
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            self._run("-test", "-d", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if not exist_ok:
+                raise ExecuteError(f"touch: exists: {path}")
+            return
+        self._run("-touchz", path)

@@ -1,0 +1,253 @@
+"""paddle.distributed.rpc — remote procedure calls between workers.
+
+Parity: python/paddle/distributed/rpc/ :: init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info (the reference backs this with brpc; here the
+transport is the framework's own C++ TCPStore rendezvous + a per-worker
+TCP listener thread, keeping the runtime native where the reference's is).
+
+Security note (same contract as the reference): payloads are pickled —
+RPC peers are trusted cluster members, never untrusted input."""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _FutureResult:
+    """Minimal future for rpc_async (reference returns a FutureWrapper)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def _set(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._event.is_set()
+
+
+class _RpcAgent:
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", 0))  # reachable cross-host
+        self._server.listen(64)
+        self.port = self._server.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self.workers: dict[str, WorkerInfo] = {}
+
+    def start(self):
+        """Serve + rendezvous. Called AFTER the module-global agent slot is
+        assigned: a peer may invoke a remote fn that itself calls
+        get_worker_info() the instant our endpoint is published, so
+        publishing before the slot is set races."""
+        self._thread.start()
+        # advertise a peer-reachable address: explicit env wins (the
+        # launcher sets it multi-host), else the hostname's IP, else
+        # loopback (single-host)
+        my_ip = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").rsplit(
+            ":", 1)[0] or os.environ.get("POD_IP", "")
+        if not my_ip:
+            try:
+                my_ip = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                my_ip = "127.0.0.1"
+        self.store.set(f"rpc/{self.rank}",
+                       f"{self.name}|{my_ip}|{self.port}".encode())
+        for r in range(self.world_size):
+            raw = self._store_get_blocking(f"rpc/{r}")
+            n, ip, port = raw.decode().split("|")
+            self.workers[n] = WorkerInfo(n, r, ip, int(port))
+
+    def _store_get_blocking(self, key, timeout=60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                v = self.store.get(key)
+                if v:
+                    return v
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"rpc rendezvous: key {key} never appeared")
+
+    # --------------------------------------------------------- transport
+    @staticmethod
+    def _send_msg(sock, payload: bytes):
+        sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+    @staticmethod
+    def _recv_msg(sock) -> bytes:
+        hdr = b""
+        while len(hdr) < 8:
+            chunk = sock.recv(8 - len(hdr))
+            if not chunk:
+                raise ConnectionError("rpc peer closed")
+            hdr += chunk
+        (n,) = struct.unpack("<Q", hdr)
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("rpc peer closed mid-message")
+            buf += chunk
+        return bytes(buf)
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                self._server.settimeout(0.2)
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            fn, args, kwargs = pickle.loads(self._recv_msg(conn))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = (False, e)
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:  # unpicklable result/exception
+                payload = pickle.dumps(
+                    (False, RuntimeError(
+                        f"rpc result not picklable: {e!r}; "
+                        f"result/exception was {result[1]!r}")))
+            self._send_msg(conn, payload)
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    def call(self, to: str, fn, args, kwargs, timeout):
+        info = self.workers[to]
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            self._send_msg(sock, pickle.dumps((fn, args or (),
+                                               kwargs or {})))
+            ok, value = pickle.loads(self._recv_msg(sock))
+        if not ok:
+            raise value
+        return value
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+_agent: list = [None]
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """Start this worker's RPC agent. Env fallbacks mirror the reference:
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER."""
+    from ..core.native import TCPStore, TCPStoreServer
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                           "127.0.0.1:0")
+    host, port = ep.rsplit(":", 1)
+    server = None
+    if rank == 0:
+        # port 0 (ephemeral) only works when all agents share this
+        # process (tests); multi-process jobs must fix the port
+        server = TCPStoreServer(int(port))
+        port = server.port
+    store = TCPStore(host, int(port))
+    agent = _RpcAgent(name, rank, world_size, store)
+    agent._store_server = server
+    _agent[0] = agent
+    agent.start()
+    return agent
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent[0] is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+    return _agent[0]
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=30.0):
+    """Run fn(*args, **kwargs) on worker `to`; block for the result."""
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=30.0):
+    """Like rpc_sync but returns a future with .wait()."""
+    agent = _require_agent()
+    fut = _FutureResult()
+
+    def run():
+        try:
+            fut._set(value=agent.call(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut._set(exc=e)
+    threading.Thread(target=run, daemon=True).start()
+    return fut
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is None:
+        name = agent.name
+    return agent.workers[name]
+
+
+def get_all_worker_infos():
+    return list(_require_agent().workers.values())
+
+
+def shutdown():
+    if _agent[0] is not None:
+        agent = _agent[0]
+        agent.stop()
+        server = getattr(agent, "_store_server", None)
+        if server is not None:
+            server.stop()
+        _agent[0] = None
