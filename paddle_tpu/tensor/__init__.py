@@ -37,3 +37,92 @@ def _attach_methods():
 
 
 _attach_methods()
+
+
+# ---- in-place variants (round 2 tranche 3) --------------------------------
+# Parity: python/paddle/tensor/ inplace ops (`x.abs_()` etc. — the reference
+# generates these from the YAML; here they wrap the functional op and write
+# back through _data, which under jit.to_static functionalizes like any
+# other persistent-state write).
+
+# dtype/shape-changing ops are deliberately EXCLUDED (equal/logical_*/
+# signbit/norm/where): the reference rejects in-place forms that change
+# dtype or shape, and writing a bool into a float tensor corrupts it
+_INPLACE_BASES = [
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil",
+    "cos", "cosh", "digamma", "erf", "exp", "expm1", "floor", "lgamma",
+    "log", "log10", "log1p", "log2", "neg", "reciprocal", "round",
+    "rsqrt", "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "tan",
+    "tanh", "trunc", "frac", "i0",
+]
+_INPLACE_BINARY_BASES = [
+    "copysign", "gcd", "hypot", "lcm", "lerp", "nextafter", "pow",
+    "remainder", "mod", "floor_divide", "heaviside", "masked_fill",
+    "scatter", "put_along_axis", "renorm",
+]
+
+
+def _inplace_grad_guard(x, name):
+    # house convention (add_/clip_/scale_): in-place ops are data edits
+    # outside the tape. With grad recording active on x that would
+    # silently sever the chain — refuse, like the reference's "can't use
+    # inplace strategy" error, instead of producing wrong gradients.
+    from .tensor import _tape
+    if _tape.grad_enabled and not x.stop_gradient:
+        raise RuntimeError(
+            f"{name}(): in-place op on a tensor that requires grad is not "
+            f"supported (gradients would not flow through the mutation); "
+            f"use the out-of-place paddle.{name[:-1]} instead or wrap in "
+            f"paddle.no_grad()")
+
+
+def _make_inplace(base_name, fn, binary):
+    if binary:
+        def inplace(x, *args, **kwargs):
+            _inplace_grad_guard(x, base_name + "_")
+            with no_grad():
+                out = fn(x, *args, **kwargs)
+            x._data = out._data
+            return x
+    else:
+        def inplace(x, name=None):
+            _inplace_grad_guard(x, base_name + "_")
+            with no_grad():
+                out = fn(x)
+            x._data = out._data
+            return x
+    inplace.__name__ = base_name + "_"
+    inplace.__doc__ = (f"In-place variant of paddle.{base_name} "
+                       f"(data edit outside the autograd tape).")
+    return inplace
+
+
+def _gen_inplace():
+    import sys as _s
+    mod = _s.modules[__name__]
+    made = []
+    for base in _INPLACE_BASES + _INPLACE_BINARY_BASES:
+        nm = base + "_"
+        if hasattr(mod, nm):          # hand-written version wins
+            continue
+        fn = getattr(mod, base, None)
+        if fn is None or not callable(fn):
+            continue
+        ip = _make_inplace(base, fn, base in _INPLACE_BINARY_BASES)
+        setattr(mod, nm, ip)
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, ip)
+        made.append(nm)
+    # zero_: fill with zeros in place
+    def zero_(x, name=None):
+        import jax.numpy as _jnp
+        x._data = _jnp.zeros_like(x._data)
+        return x
+    mod.zero_ = zero_
+    if not hasattr(Tensor, "zero_"):
+        Tensor.zero_ = zero_
+    made.append("zero_")
+    return made
+
+
+_INPLACE_GENERATED = _gen_inplace()

@@ -219,3 +219,45 @@ def cholesky_inverse(x, upper=False, name=None):
 
 __all__ += ["matrix_exp", "householder_product", "vecdot",
             "cholesky_inverse"]
+
+
+def inverse(x, name=None):
+    return inv(x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack combined LU factors + pivots into (P, L, U); batched inputs
+    produce batched P/L/U."""
+    def fn_l(a):
+        m = a.shape[-2]
+        k = min(a.shape[-1], m)
+        return jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+
+    def fn_u(a):
+        k = min(a.shape[-1], a.shape[-2])
+        return jnp.triu(a[..., :k, :])
+
+    L = apply_op(fn_l, lu_data) if unpack_ludata else None
+    U = apply_op(fn_u, lu_data) if unpack_ludata else None
+    P = None
+    if unpack_pivots:
+        piv = np.asarray(lu_pivots._data if isinstance(lu_pivots, Tensor)
+                         else lu_pivots)
+        m = int(lu_data.shape[-2])
+        k = piv.shape[-1]
+        batch_shape = piv.shape[:-1]
+        flat = piv.reshape(-1, k)
+        pms = np.zeros((flat.shape[0], m, m), np.float32)
+        for b in range(flat.shape[0]):
+            perm = np.arange(m)
+            for i in range(min(k, m)):
+                j = int(flat[b, i])
+                perm[i], perm[j] = perm[j], perm[i]
+            pms[b, perm, np.arange(m)] = 1.0
+        P = Tensor(jnp.asarray(pms.reshape(*batch_shape, m, m)))
+    return P, L, U
+
+
+import numpy as np  # noqa: E402
+__all__ += ["inverse", "lu_unpack"]

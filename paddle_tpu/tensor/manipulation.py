@@ -736,3 +736,45 @@ __all__ += ["atleast_1d", "atleast_2d", "atleast_3d", "broadcast_tensors",
             "index_fill", "index_fill_", "masked_scatter",
             "masked_scatter_", "as_strided", "unflatten", "select_scatter",
             "slice_scatter", "diagonal_scatter"]
+
+
+def argwhere(x, name=None):
+    """Indices of nonzero elements, [n, ndim] (alias family of nonzero)."""
+    return nonzero(x)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools as _it
+    n = x.shape[0]
+    gen = _it.combinations_with_replacement(range(n), r) \
+        if with_replacement else _it.combinations(range(n), r)
+    idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+    return apply_op(lambda a: a[jnp.asarray(idx)], x)
+
+
+def matrix_transpose(x, name=None):
+    from .linalg import t
+    return t(x)
+
+
+def nonzero_static(x, size, fill_value=-1, name=None):
+    """Static-shape nonzero: first `size` indices, padded with fill_value
+    (the jit-safe variant the reference added for dynamic-shape-free
+    graphs — exactly the TPU-native contract). Output is ALWAYS
+    [size, ndim], padding past numel too."""
+    def fn(a):
+        flat = (a != 0).ravel()
+        order = jnp.argsort(~flat, stable=True)  # nonzeros first
+        n = flat.shape[0]
+        sel = jnp.pad(order, (0, max(size - n, 0)))[:size]
+        coords = jnp.stack(jnp.unravel_index(sel, a.shape), axis=-1)
+        in_range = jnp.arange(size) < n
+        valid = (jnp.pad(flat[order], (0, max(size - n, 0)))[:size]
+                 & in_range)[:, None]
+        return jnp.where(valid, coords,
+                         jnp.asarray(fill_value, coords.dtype))
+    return apply_op(fn, x)
+
+
+__all__ += ["argwhere", "combinations", "matrix_transpose",
+            "nonzero_static"]

@@ -544,3 +544,117 @@ def ldexp_(x, y, name=None):
 
 
 _export("ldexp_", ldexp_)
+
+
+# ---- round-2 tranche 3: pairwise distances, fused add-mul, misc -----------
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    return apply_op(lambda i, a, b: i + value * a * b, input, tensor1,
+                    tensor2)
+
+
+def addcdiv(input, tensor1, tensor2, value=1.0, name=None):
+    return apply_op(lambda i, a, b: i + value * a / b, input, tensor1,
+                    tensor2)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-distance between row batches [..., N, D] × [..., M, D]
+    → [..., N, M]. p=2 uses the MXU x·yᵀ expansion."""
+    def fn(a, b):
+        if p == 2.0:
+            a2 = (a * a).sum(-1)[..., :, None]
+            b2 = (b * b).sum(-1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        if jnp.isinf(p):
+            return diff.max(-1)
+        return (diff ** p).sum(-1) ** (1.0 / p)
+    return apply_op(fn, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] rows → [N*(N-1)/2]."""
+    import numpy as _np
+    iu, ju = _np.triu_indices(x.shape[0], k=1)
+    ii = jnp.asarray(iu.astype(_np.int32))
+    jj = jnp.asarray(ju.astype(_np.int32))
+
+    def fn(a):
+        diff = jnp.abs(a[ii] - a[jj])
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        if p == 2.0:
+            return jnp.sqrt((diff * diff).sum(-1))
+        if jnp.isinf(p):
+            return diff.max(-1)
+        return (diff ** p).sum(-1) ** (1.0 / p)
+    return apply_op(fn, x)
+
+
+def dist(x, y, p=2.0, name=None):
+    """p-norm of (x - y) (reference paddle.dist)."""
+    def fn(a, b):
+        d = jnp.abs(a - b).ravel()
+        if p == 0:
+            return (d != 0).sum().astype(a.dtype)
+        if jnp.isinf(p):
+            return d.max()
+        return (d ** p).sum() ** (1.0 / p)
+    return apply_op(fn, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(lambda a, v: a @ v, x, vec)
+
+
+def logaddexp2(x, y, name=None):
+    if isinstance(y, Tensor):
+        return apply_op(jnp.logaddexp2, x, y)
+    return apply_op(lambda a: jnp.logaddexp2(a, y), x)
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma: sum_i lgamma(x + (1-i)/2) + const."""
+    import math as _math
+
+    def fn(a):
+        c = 0.25 * p * (p - 1) * _math.log(_math.pi)
+        total = c
+        for i in range(1, p + 1):  # builtins.sum is shadowed by paddle.sum
+            total = total + jax.scipy.special.gammaln(a + (1 - i) / 2.0)
+        return total
+    return apply_op(fn, x)
+
+
+def isposinf(x, name=None):
+    return apply_op(jnp.isposinf, x)
+
+
+def isneginf(x, name=None):
+    return apply_op(jnp.isneginf, x)
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (broadcast inverse)."""
+    tshape = tuple(target.shape)
+
+    def fn(a):
+        out = a
+        while out.ndim > len(tshape):
+            out = out.sum(0)
+        for i, (od, td) in enumerate(zip(out.shape, tshape)):
+            if od != td:
+                out = out.sum(i, keepdims=True)
+        return out
+    return apply_op(fn, x)
+
+
+for _nm in ["addcmul", "addcdiv", "cdist", "pdist", "dist", "mv",
+            "logaddexp2", "multigammaln", "isposinf", "isneginf",
+            "reduce_as"]:
+    _export(_nm, globals()[_nm])

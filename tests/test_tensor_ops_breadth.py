@@ -319,3 +319,151 @@ def test_ldexp_inplace_mutates():
     out = paddle.ldexp_(x, _t(np.array([1.0, 2.0], np.float32)))
     assert out is x
     np.testing.assert_allclose(np.asarray(x._data), [2.0, 8.0])
+
+
+# ---- tranche 3: distances, in-place variants, misc ------------------------
+
+def test_cdist_pdist_dist():
+    rng = np.random.RandomState(40)
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(5, 3).astype(np.float32)
+    expect = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+    np.testing.assert_allclose(
+        np.asarray(paddle.cdist(_t(a), _t(b))._data), expect, rtol=1e-4,
+        atol=1e-5)
+    # p=1
+    np.testing.assert_allclose(
+        np.asarray(paddle.cdist(_t(a), _t(b), p=1.0)._data),
+        np.abs(a[:, None] - b[None]).sum(-1), rtol=1e-4, atol=1e-5)
+    pd = np.asarray(paddle.pdist(_t(a))._data)
+    k = 0
+    for i in range(4):
+        for j in range(i + 1, 4):
+            np.testing.assert_allclose(
+                pd[k], np.linalg.norm(a[i] - a[j]), rtol=1e-4)
+            k += 1
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.dist(_t(a), _t(a + 1.0), p=np.inf)._data)),
+        1.0, rtol=1e-5)
+
+
+def test_addcmul_addcdiv_mv_logaddexp2():
+    i = _t(np.array([1.0, 2.0], np.float32))
+    t1 = _t(np.array([2.0, 3.0], np.float32))
+    t2 = _t(np.array([4.0, 5.0], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.addcmul(i, t1, t2, value=0.5)._data),
+        [1 + 4.0, 2 + 7.5])
+    np.testing.assert_allclose(
+        np.asarray(paddle.addcdiv(i, t1, t2, value=2.0)._data),
+        [2.0, 3.2])
+    m = np.random.RandomState(41).randn(3, 4).astype(np.float32)
+    v = np.random.RandomState(42).randn(4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(paddle.mv(_t(m), _t(v))._data),
+                               m @ v, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.logaddexp2(_t(np.array([1.0], np.float32)),
+                                     _t(np.array([1.0], np.float32)))._data),
+        [2.0], rtol=1e-5)
+
+
+def test_inplace_variants_mutate_and_chain():
+    x = _t(np.array([4.0, 9.0], np.float32))
+    assert paddle.sqrt_(x) is x
+    np.testing.assert_allclose(np.asarray(x._data), [2.0, 3.0])
+    x.exp_()
+    np.testing.assert_allclose(np.asarray(x._data), np.exp([2.0, 3.0]),
+                               rtol=1e-5)
+    x.zero_()
+    np.testing.assert_allclose(np.asarray(x._data), 0.0)
+    y = _t(np.array([1.0, -2.0], np.float32))
+    y.abs_().log1p_()
+    np.testing.assert_allclose(np.asarray(y._data), np.log1p([1.0, 2.0]),
+                               rtol=1e-5)
+    z = _t(np.array([2.0], np.float32))
+    z.pow_(3.0)
+    np.testing.assert_allclose(np.asarray(z._data), [8.0])
+
+
+def test_nonzero_static_and_argwhere():
+    x = np.array([[0.0, 5.0], [7.0, 0.0]], np.float32)
+    nz = np.asarray(paddle.nonzero_static(_t(x), size=3)._data)
+    np.testing.assert_array_equal(nz, [[0, 1], [1, 0], [-1, -1]])
+    aw = np.asarray(paddle.argwhere(_t(x))._data)
+    np.testing.assert_array_equal(aw, [[0, 1], [1, 0]])
+
+
+def test_combinations_matrix_transpose_reduce_as():
+    x = _t(np.array([1.0, 2.0, 3.0], np.float32))
+    comb = np.asarray(paddle.combinations(x)._data)
+    np.testing.assert_allclose(comb, [[1, 2], [1, 3], [2, 3]])
+    combr = np.asarray(paddle.combinations(x, with_replacement=True)._data)
+    assert combr.shape == (6, 2)
+    m = np.random.RandomState(43).randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.matrix_transpose(_t(m))._data),
+        m.transpose(0, 2, 1))
+    big = _t(np.ones((2, 3), np.float32))
+    small = _t(np.zeros((1, 3), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.reduce_as(big, small)._data), [[2.0, 2.0, 2.0]])
+
+
+def test_multigammaln_isposneginf_inverse_lu_unpack():
+    import math
+    mg = float(np.asarray(paddle.multigammaln(
+        _t(np.array([3.0], np.float32)), 2)._data))
+    np.testing.assert_allclose(
+        mg, 0.5 * math.log(math.pi) + math.lgamma(3.0) + math.lgamma(2.5),
+        rtol=1e-5)
+    x = _t(np.array([np.inf, -np.inf, 1.0], np.float32))
+    np.testing.assert_array_equal(np.asarray(paddle.isposinf(x)._data),
+                                  [True, False, False])
+    np.testing.assert_array_equal(np.asarray(paddle.isneginf(x)._data),
+                                  [False, True, False])
+    m = np.random.RandomState(44).randn(4, 4).astype(np.float32) \
+        + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(paddle.inverse(_t(m))._data),
+                               np.linalg.inv(m), rtol=1e-3, atol=1e-4)
+    # lu_unpack reconstructs P @ L @ U == A
+    lu, piv = paddle.linalg.lu(_t(m))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = np.asarray(P._data) @ np.asarray(L._data) @ np.asarray(U._data)
+    np.testing.assert_allclose(rec, m, rtol=1e-3, atol=1e-3)
+
+
+def test_inplace_refuses_grad_recording():
+    x = _t(np.array([1.0], np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError, match="in-place"):
+        x.exp_()
+    # fine under no_grad, and fine on stop_gradient tensors
+    import paddle_tpu
+    with paddle_tpu.no_grad():
+        x.exp_()
+    y = _t(np.array([4.0], np.float32))
+    y.sqrt_()
+    np.testing.assert_allclose(np.asarray(y._data), [2.0])
+
+
+def test_nonzero_static_pads_past_numel():
+    x = _t(np.array([1.0, 0.0], np.float32))
+    nz = np.asarray(paddle.nonzero_static(x, size=5)._data)
+    np.testing.assert_array_equal(nz, [[0], [-1], [-1], [-1], [-1]])
+
+
+def test_pdist_p0():
+    a = _t(np.array([[0.0, 1.0], [0.0, 2.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(paddle.pdist(a, p=0.0)._data),
+                               [1.0])
+
+
+def test_lu_unpack_batched_with_pivoting():
+    rng = np.random.RandomState(45)
+    # force pivoting: tiny leading element
+    mats = rng.randn(2, 3, 3).astype(np.float32)
+    mats[1, 0, 0] = 1e-6
+    lu, piv = paddle.linalg.lu(_t(mats))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = (np.asarray(P._data) @ np.asarray(L._data)
+           @ np.asarray(U._data))
+    np.testing.assert_allclose(rec, mats, rtol=1e-3, atol=1e-3)
