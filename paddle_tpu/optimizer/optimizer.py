@@ -17,7 +17,8 @@ from ..tensor.tensor import Parameter, Tensor, no_grad, register_persistent
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adadelta", "RMSProp", "Lamb"]
+           "Adadelta", "RMSProp", "Lamb", "Adamax", "NAdam", "RAdam",
+           "ASGD", "Rprop"]
 
 
 class Optimizer:
@@ -69,7 +70,10 @@ class Optimizer:
         slot = self._accumulators.setdefault(name, {})
         key = id(p)
         if key not in slot:
-            arr = jnp.zeros_like(self._master(p)._data) if init is None else init
+            if init is None:
+                arr = jnp.zeros_like(self._master(p)._data)
+            else:  # callable init is lazy: only evaluated on first use
+                arr = init() if callable(init) else init
             t = Tensor(arr)
             t.persistable = True
             t.name = f"{p.name}_{name}"
@@ -401,3 +405,172 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         self._apply(p, mw._data - lr * trust * r)
+
+
+# ---- round-2 breadth: Adamax, NAdam, RAdam, ASGD, Rprop -------------------
+# Parity: python/paddle/optimizer/{adamax,nadam,radam,asgd,rprop}.py.
+
+class Adamax(Optimizer):
+    """Adam with infinity-norm second moment (no bias correction on v)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = self._decayed(p, g._data.astype(jnp.float32), mw._data)
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b1p._data = b1p._data * self._beta1
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        u._data = jnp.maximum(self._beta2 * u._data, jnp.abs(g32))
+        new = mw._data - (lr / (1 - b1p._data)) * m._data / (
+            u._data + self._epsilon)
+        self._apply(p, new)
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (reference nadam.py formulas)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = self._decayed(p, g._data.astype(jnp.float32), mw._data)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        step = self._acc("step", p, init=jnp.zeros((), jnp.float32))
+        mu_prod = self._acc("mu_prod", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        step._data = step._data + 1.0
+        t = step._data
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod_t = mu_prod._data * mu_t
+        mu_prod._data = mu_prod_t
+        b2p._data = b2p._data * self._beta2
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        v._data = self._beta2 * v._data + (1 - self._beta2) * g32 * g32
+        mhat = (mu_next * m._data / (1 - mu_prod_t * mu_next)
+                + (1 - mu_t) * g32 / (1 - mu_prod_t))
+        vhat = v._data / (1 - b2p._data)
+        self._apply(p, mw._data - lr * mhat
+                    / (jnp.sqrt(vhat) + self._epsilon))
+
+
+class RAdam(Optimizer):
+    """Rectified Adam: variance-rectification term gates between SGDm and
+    Adam (reference radam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = self._decayed(p, g._data.astype(jnp.float32), mw._data)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        step = self._acc("step", p, init=jnp.zeros((), jnp.float32))
+        step._data = step._data + 1.0
+        t = step._data
+        b1p = self._beta1 ** t
+        b2p = self._beta2 ** t
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        v._data = self._beta2 * v._data + (1 - self._beta2) * g32 * g32
+        mhat = m._data / (1 - b1p)
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1 - b2p)
+        # rectified branch when rho_t > 5 (reference threshold)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12),
+            0.0))
+        vhat = jnp.sqrt(v._data / (1 - b2p)) + self._epsilon
+        adam_step = r * mhat / vhat
+        sgd_step = mhat
+        self._apply(p, mw._data - lr * jnp.where(rho_t > 5.0, adam_step,
+                                                 sgd_step))
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference asgd.py): steps use the MEAN of the last
+    `batch_num` gradients via the d/ys recursion (d ← d − ys[i] + g;
+    ys[i] ← g), plus a running parameter average for inference."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._batch_num = int(batch_num)
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = self._decayed(p, g._data.astype(jnp.float32), mw._data)
+        n = self._batch_num
+        step = self._acc("step", p, init=lambda: jnp.zeros((), jnp.float32))
+        avg = self._acc("averaged", p, init=lambda: mw._data)
+        d = self._acc("d", p)
+        ys = self._acc("ys", p, init=lambda: jnp.zeros(
+            (n, *mw._data.shape), jnp.float32))
+        t = step._data
+        idx = (t % n).astype(jnp.int32)
+        d._data = d._data - ys._data[idx] + g32
+        ys._data = ys._data.at[idx].set(g32)
+        step._data = t + 1.0
+        seen = jnp.minimum(t + 1.0, float(n))
+        new = mw._data - lr * d._data / seen
+        avg._data = avg._data + (new - avg._data) / (t + 1.0)
+        self._apply(p, new)
+
+    def averaged_value(self, p):
+        return self._acc("averaged", p)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop: per-weight step sizes adapted by grad-sign
+    agreement (reference rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = g._data.astype(jnp.float32)
+        prev = self._acc("prev_grad", p)
+        # lr (from get_lr) honors schedulers; init only runs on first use
+        steps = self._acc("step_size", p,
+                          init=lambda: jnp.full_like(mw._data, lr))
+        sign = g32 * prev._data
+        grow = sign > 0
+        shrink = sign < 0
+        steps._data = jnp.clip(
+            jnp.where(grow, steps._data * self._eta_plus,
+                      jnp.where(shrink, steps._data * self._eta_minus,
+                                steps._data)),
+            self._lr_min, self._lr_max)
+        # on sign flip: zero the grad (classic Rprop- variant)
+        eff_g = jnp.where(shrink, 0.0, g32)
+        prev._data = eff_g
+        self._apply(p, mw._data - jnp.sign(eff_g) * steps._data)

@@ -76,21 +76,32 @@ def _inplace_grad_guard(x, name):
             f"paddle.no_grad()")
 
 
+def _assign_inplace(x, out, name):
+    # the reference rejects in-place results that change shape or dtype
+    # (broadcasting a (1,) tensor up, dtype promotion); enforce it
+    if tuple(out._data.shape) != tuple(x._data.shape) or \
+            out._data.dtype != x._data.dtype:
+        raise ValueError(
+            f"{name}(): in-place result would change shape/dtype "
+            f"{tuple(x._data.shape)}/{x._data.dtype} -> "
+            f"{tuple(out._data.shape)}/{out._data.dtype}")
+    x._data = out._data
+    return x
+
+
 def _make_inplace(base_name, fn, binary):
     if binary:
         def inplace(x, *args, **kwargs):
             _inplace_grad_guard(x, base_name + "_")
             with no_grad():
                 out = fn(x, *args, **kwargs)
-            x._data = out._data
-            return x
+            return _assign_inplace(x, out, base_name + "_")
     else:
         def inplace(x, name=None):
             _inplace_grad_guard(x, base_name + "_")
             with no_grad():
                 out = fn(x)
-            x._data = out._data
-            return x
+            return _assign_inplace(x, out, base_name + "_")
     inplace.__name__ = base_name + "_"
     inplace.__doc__ = (f"In-place variant of paddle.{base_name} "
                        f"(data edit outside the autograd tape).")
@@ -115,6 +126,7 @@ def _gen_inplace():
         made.append(nm)
     # zero_: fill with zeros in place
     def zero_(x, name=None):
+        _inplace_grad_guard(x, "zero_")
         import jax.numpy as _jnp
         x._data = _jnp.zeros_like(x._data)
         return x

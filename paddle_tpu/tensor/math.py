@@ -612,10 +612,9 @@ def mv(x, vec, name=None):
     return apply_op(lambda a, v: a @ v, x, vec)
 
 
-def logaddexp2(x, y, name=None):
-    if isinstance(y, Tensor):
-        return apply_op(jnp.logaddexp2, x, y)
-    return apply_op(lambda a: jnp.logaddexp2(a, y), x)
+_binary("logaddexp2", jnp.logaddexp2)
+_unary("isposinf", jnp.isposinf)
+_unary("isneginf", jnp.isneginf)
 
 
 def multigammaln(x, p, name=None):
@@ -629,14 +628,6 @@ def multigammaln(x, p, name=None):
             total = total + jax.scipy.special.gammaln(a + (1 - i) / 2.0)
         return total
     return apply_op(fn, x)
-
-
-def isposinf(x, name=None):
-    return apply_op(jnp.isposinf, x)
-
-
-def isneginf(x, name=None):
-    return apply_op(jnp.isneginf, x)
 
 
 def reduce_as(x, target, name=None):
@@ -655,6 +646,5 @@ def reduce_as(x, target, name=None):
 
 
 for _nm in ["addcmul", "addcdiv", "cdist", "pdist", "dist", "mv",
-            "logaddexp2", "multigammaln", "isposinf", "isneginf",
-            "reduce_as"]:
+            "multigammaln", "reduce_as"]:
     _export(_nm, globals()[_nm])

@@ -244,11 +244,22 @@ class Tensor:
         self.set_value(other._data if isinstance(other, Tensor) else other)
         return self
 
+    def _guard_inplace(self, name):
+        # data edits live outside the tape: refuse while grad recording is
+        # active on this tensor rather than silently severing the chain
+        if _tape.grad_enabled and not self.stop_gradient:
+            raise RuntimeError(
+                f"{name}(): in-place op on a tensor that requires grad is "
+                f"not supported; wrap in paddle.no_grad() or use the "
+                f"out-of-place op")
+
     def fill_(self, v) -> "Tensor":
+        self._guard_inplace("fill_")
         self._data = jnp.full_like(self._data, v)
         return self
 
     def zero_(self) -> "Tensor":
+        self._guard_inplace("zero_")
         self._data = jnp.zeros_like(self._data)
         return self
 

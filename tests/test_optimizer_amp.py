@@ -178,3 +178,95 @@ class TestAMP:
         scaler.update()
         np.testing.assert_array_equal(model.weight.numpy(), w0)
         assert scaler.get_loss_scaling() == pytest.approx(2.0)
+
+
+# ---- round-2 optimizer breadth: Adamax/NAdam/RAdam/ASGD/Rprop -------------
+
+class TestOptimizerBreadth:
+    def _fit_quadratic(self, opt_cls, steps=60, **kw):
+        paddle.seed(0)
+        target = np.array([3.0, -2.0], np.float32)
+        w = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(steps):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(w._data), float(np.asarray(loss._data))
+
+    def test_adamax_converges(self):
+        w, loss = self._fit_quadratic(paddle.optimizer.Adamax,
+                                      learning_rate=0.3)
+        np.testing.assert_allclose(w, [3.0, -2.0], atol=0.2)
+
+    def test_nadam_converges(self):
+        w, loss = self._fit_quadratic(paddle.optimizer.NAdam,
+                                      learning_rate=0.3)
+        np.testing.assert_allclose(w, [3.0, -2.0], atol=0.2)
+
+    def test_radam_converges(self):
+        w, loss = self._fit_quadratic(paddle.optimizer.RAdam,
+                                      learning_rate=0.3, steps=100)
+        np.testing.assert_allclose(w, [3.0, -2.0], atol=0.2)
+
+    def test_asgd_converges_and_averages(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.zeros(1, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.ASGD(learning_rate=0.1, parameters=[w])
+        for _ in range(100):
+            loss = ((w - 5.0) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(np.asarray(w._data), [5.0], atol=0.05)
+        avg = np.asarray(opt.averaged_value(w)._data)
+        assert 0.0 < avg[0] <= 5.01  # trailing average lags the iterate
+
+    def test_rprop_converges(self):
+        w, loss = self._fit_quadratic(paddle.optimizer.Rprop,
+                                      learning_rate=0.1, steps=80)
+        np.testing.assert_allclose(w, [3.0, -2.0], atol=0.1)
+
+    def test_new_optimizers_state_dict_roundtrip(self):
+        paddle.seed(1)
+        w = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.Adamax(learning_rate=0.1, parameters=[w])
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sd = opt.state_dict()
+        assert any("moment" in k for k in sd)
+        opt2 = paddle.optimizer.Adamax(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+    def test_asgd_batch_num_smooths(self):
+        """With batch_num=n and alternating gradients ±1 around a mean of
+        g0, the d/ys recursion steps with the n-gradient mean."""
+        paddle.seed(2)
+        w = paddle.to_tensor(np.zeros(1, np.float32), stop_gradient=False)
+        opt = paddle.optimizer.ASGD(learning_rate=1.0, batch_num=2,
+                                    parameters=[w])
+        # inject alternating gradients by hand: +2, 0, +2, 0 (mean 1)
+        from paddle_tpu.tensor.tensor import Tensor
+        import jax.numpy as jnp
+        positions = []
+        for i in range(4):
+            w.grad = Tensor(jnp.asarray([2.0 if i % 2 == 0 else 0.0]))
+            opt.step()
+            positions.append(float(np.asarray(w._data)[0]))
+        # steps 2..4 use the 2-grad mean (1.0): equal decrements of 1
+        np.testing.assert_allclose(positions[2] - positions[1], -1.0,
+                                   atol=1e-5)
+        np.testing.assert_allclose(positions[3] - positions[2], -1.0,
+                                   atol=1e-5)
+
+    def test_inplace_binary_shape_guard(self):
+        x = paddle.to_tensor(np.ones(1, np.float32))
+        with pytest.raises(ValueError, match="shape/dtype"):
+            x.pow_(paddle.to_tensor(np.ones(3, np.float32)))
+        y = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with pytest.raises(RuntimeError, match="in-place"):
+            y.zero_()
