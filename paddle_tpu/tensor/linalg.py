@@ -261,3 +261,79 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
 
 import numpy as np  # noqa: E402
 __all__ += ["inverse", "lu_unpack"]
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Vector p-norm over axis (reference paddle.linalg.vector_norm)."""
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        d = jnp.abs(a)
+        if p == 0:
+            return (d != 0).astype(a.dtype).sum(axis=ax, keepdims=keepdim)
+        if jnp.isinf(p):
+            return d.max(axis=ax, keepdims=keepdim) if p > 0 else \
+                d.min(axis=ax, keepdims=keepdim)
+        return (d ** p).sum(axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op(fn, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """Matrix norm over the trailing two axes: 'fro', 'nuc', 1, -1, 2, -2,
+    inf, -inf (reference paddle.linalg.matrix_norm)."""
+    ax = tuple(axis)
+
+    def _keep(out, a_ndim):
+        # re-insert the reduced axes (normalized, ascending) as size-1 dims
+        for axpos in sorted(d % a_ndim for d in ax):
+            out = jnp.expand_dims(out, axpos)
+        return out
+
+    def fn(a):
+        if p == "fro":
+            return jnp.sqrt((a * a).sum(axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(jnp.moveaxis(a, ax, (-2, -1)),
+                               compute_uv=False)
+            out = s.sum(-1)
+            return _keep(out, a.ndim) if keepdim else out
+        if p in (2, -2):
+            s = jnp.linalg.svd(jnp.moveaxis(a, ax, (-2, -1)),
+                               compute_uv=False)
+            out = s.max(-1) if p == 2 else s.min(-1)
+            return _keep(out, a.ndim) if keepdim else out
+        if p in (1, -1):
+            col = jnp.abs(a).sum(axis=ax[0], keepdims=True)
+            out = (col.max(axis=ax[1], keepdims=True) if p == 1
+                   else col.min(axis=ax[1], keepdims=True))
+        elif p in (jnp.inf, float("inf"), -jnp.inf, float("-inf")):
+            row = jnp.abs(a).sum(axis=ax[1], keepdims=True)
+            out = (row.max(axis=ax[0], keepdims=True)
+                   if p > 0 else row.min(axis=ax[0], keepdims=True))
+        else:
+            raise ValueError(f"unsupported matrix norm order {p!r}")
+        if not keepdim:
+            out = out.squeeze(ax)
+        return out
+    return apply_op(fn, x)
+
+
+def ormqr(input, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by the IMPLICIT full (m,m) Q of the reflectors.
+    Thin inputs are zero-padded to square (zero-tau reflectors are the
+    identity), so the product matches the reference for the usual
+    m > k case; XLA fuses the Q formation into the matmul."""
+    def fn(h, t, o):
+        m = h.shape[-2]
+        k = h.shape[-1]
+        if k < m:
+            pad_h = [(0, 0)] * (h.ndim - 1) + [(0, m - k)]
+            h = jnp.pad(h, pad_h)
+            pad_t = [(0, 0)] * (t.ndim - 1) + [(0, m - k)]
+            t = jnp.pad(t, pad_t)
+        q = jax.lax.linalg.householder_product(h, t)
+        qq = jnp.swapaxes(q, -1, -2) if transpose else q
+        return qq @ o if left else o @ qq
+    return apply_op(fn, input, tau, other)
+
+
+__all__ += ["vector_norm", "matrix_norm", "ormqr"]

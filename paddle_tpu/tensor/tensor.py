@@ -54,7 +54,8 @@ _tape = _TapeState()
 class _TapeNode:
     """One recorded op: output ids <- vjp_fn <- input tensors."""
 
-    __slots__ = ("inputs", "output_ids", "vjp_fn", "outputs_meta")
+    __slots__ = ("inputs", "output_ids", "vjp_fn", "outputs_meta",
+                 "__weakref__")
 
     def __init__(self, inputs, output_ids, vjp_fn, outputs_meta):
         self.inputs = inputs            # list[Tensor] (differentiable inputs only)
@@ -133,7 +134,7 @@ class Tensor:
 
     __slots__ = ("_data", "_uid", "stop_gradient", "grad", "name", "persistable",
                  "_hooks", "_is_leaf", "sharding_spec", "process_mesh",
-                 "__weakref__")
+                 "_grad_fn_ref", "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None,
                  dtype=None):
@@ -168,6 +169,33 @@ class Tensor:
     @property
     def T(self) -> "Tensor":
         return self.transpose(list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self) -> "Tensor":
+        from .linalg import t
+        return t(self)
+
+    @property
+    def itemsize(self) -> int:
+        return self._data.dtype.itemsize
+
+    def element_size(self) -> int:
+        """Bytes per element (the reference's METHOD spelling)."""
+        return self._data.dtype.itemsize
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.size) * self._data.dtype.itemsize
+
+    @property
+    def grad_fn(self):
+        """The tape node that produced this tensor (None for leaves) —
+        parity with the reference's grad_fn introspection. O(1): apply_op
+        stores a weakref to the producing node."""
+        if self._is_leaf:
+            return None
+        ref = getattr(self, "_grad_fn_ref", None)
+        return ref() if ref is not None else None
 
     @property
     def is_leaf(self) -> bool:
@@ -454,6 +482,8 @@ def apply_op(jax_fn: Callable, *tensors: Tensor, n_outputs: int = 1):
         vjp_fn=(vjp_fn if multi else (lambda g, f=vjp_fn: f(g[0]))),
         outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
     )
+    for o in outs:
+        o._grad_fn_ref = weakref.ref(node)  # O(1) Tensor.grad_fn
     _tape.nodes.append(node)
     _maybe_capture(jax_fn, tensors, outs)
     return outs if multi else outs[0]

@@ -467,3 +467,62 @@ def test_lu_unpack_batched_with_pivoting():
     rec = (np.asarray(P._data) @ np.asarray(L._data)
            @ np.asarray(U._data))
     np.testing.assert_allclose(rec, mats, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_vector_matrix_norm_and_ormqr():
+    rng = np.random.RandomState(50)
+    a = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.vector_norm(_t(a), p=2.0)._data)),
+        np.linalg.norm(a.ravel()), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.vector_norm(_t(a), p=1.0, axis=1)._data),
+        np.abs(a).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.matrix_norm(_t(a), p="fro")._data)),
+        np.linalg.norm(a, "fro"), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.matrix_norm(_t(a), p=1)._data)),
+        np.linalg.norm(a, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.matrix_norm(_t(a),
+                                                   p=np.inf)._data)),
+        np.linalg.norm(a, np.inf), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.matrix_norm(_t(a), p=2)._data)),
+        np.linalg.norm(a, 2), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.linalg.matrix_norm(_t(a), p="nuc")._data)),
+        np.linalg.svd(a, compute_uv=False).sum(), rtol=1e-4)
+    # ormqr against a hand-built single reflector: H = I - tau v v^T
+    # (v = [1, a, b] with implicit unit head, stored below the diagonal)
+    v = np.array([1.0, 0.5, -0.25], np.float32)
+    tau = np.float32(2.0 / (v @ v))          # makes H orthogonal
+    h_store = np.zeros((3, 1), np.float32)
+    h_store[1, 0], h_store[2, 0] = v[1], v[2]
+    other = rng.randn(3, 2).astype(np.float32)
+    H = np.eye(3, dtype=np.float32) - tau * np.outer(v, v)
+    got = np.asarray(paddle.linalg.ormqr(
+        _t(h_store), _t(np.array([tau], np.float32)), _t(other))._data)
+    np.testing.assert_allclose(got, H @ other, rtol=1e-4, atol=1e-5)
+    # right-multiplication and transpose flags
+    got_r = np.asarray(paddle.linalg.ormqr(
+        _t(h_store), _t(np.array([tau], np.float32)), _t(other.T),
+        left=False)._data)
+    np.testing.assert_allclose(got_r, other.T @ H, rtol=1e-4, atol=1e-5)
+    # keepdim shapes for svd-backed norms (2-D and batched)
+    kd = paddle.linalg.matrix_norm(_t(a), p="nuc", keepdim=True)
+    assert list(kd.shape) == [1, 1]
+    batched = rng.randn(2, 3, 4).astype(np.float32)
+    kb = paddle.linalg.matrix_norm(_t(batched), p=2, keepdim=True)
+    assert list(kb.shape) == [2, 1, 1]
+
+
+def test_tensor_properties():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    assert list(x.mT.shape) == [3, 2]
+    assert x.itemsize == 4 and x.nbytes == 24
+    assert x.element_size() == 4
+    assert x.grad_fn is None          # leaf
+    y = x * 2
+    assert y.grad_fn is not None      # produced by a tape node
