@@ -112,6 +112,50 @@ class BatchNorm(Layer):
                                coalesced=x._coalesced)
 
 
+_RULEBOOK_CACHE: dict = {}
+_RULEBOOK_CACHE_MAX = 16
+# total-byte budget: training on fresh coords every step must not pin
+# hundreds of MB of never-hit rulebooks; oversized entries skip the cache
+_RULEBOOK_CACHE_MAX_BYTES = 32 << 20
+_RULEBOOK_ENTRY_MAX_BYTES = 4 << 20
+_rulebook_cache_bytes = [0]
+
+
+def _rulebook_nbytes(key, out):
+    n = len(key[0])
+    _, rules, _ = out
+    for ins, outs in rules.values():
+        n += ins.nbytes + outs.nbytes
+    return n + out[0].nbytes
+
+
+def _build_rulebook_cached(coords: np.ndarray, spatial, ksize, stride,
+                           padding, subm: bool):
+    """Memoized rulebook build: point-cloud pipelines reuse the same active
+    site set across layers (every SubmConv3D on one input shares the
+    structure), so key on the coordinate bytes + geometry and skip the
+    O(nnz·k³) host walk on repeats. FIFO-bounded by entry count AND total
+    bytes; entries too large to plausibly repay caching are not kept."""
+    key = (coords.tobytes(), tuple(spatial), tuple(ksize), tuple(stride),
+           tuple(padding), subm)
+    hit = _RULEBOOK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    out = _build_rulebook(coords, spatial, ksize, stride, padding, subm)
+    size = _rulebook_nbytes(key, out)
+    if size > _RULEBOOK_ENTRY_MAX_BYTES:
+        return out
+    while _RULEBOOK_CACHE and (
+            len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX
+            or _rulebook_cache_bytes[0] + size > _RULEBOOK_CACHE_MAX_BYTES):
+        old_key = next(iter(_RULEBOOK_CACHE))  # FIFO (dict is ordered)
+        old_val = _RULEBOOK_CACHE.pop(old_key)
+        _rulebook_cache_bytes[0] -= _rulebook_nbytes(old_key, old_val)
+    _RULEBOOK_CACHE[key] = out
+    _rulebook_cache_bytes[0] += size
+    return out
+
+
 def _build_rulebook(coords: np.ndarray, spatial, ksize, stride, padding,
                     subm: bool):
     """Host-side rulebook: for each kernel offset, (input_slot, output_slot)
@@ -196,7 +240,7 @@ class _SparseConvBase(Layer):
             "sparse conv expects NDHWC coords [batch,z,y,x] + channel values"
         coords = np.asarray(x.indices).T  # [nnz, 4]
         spatial = x.shape[1:4]
-        out_coords, rules, out_spatial = _build_rulebook(
+        out_coords, rules, out_spatial = _build_rulebook_cached(
             coords, spatial, self.ksize, self.stride, self.padding,
             self.subm)
         m = len(out_coords)
@@ -252,7 +296,7 @@ class MaxPool3D(Layer):
     def forward(self, x):
         from . import SparseCooTensor
         coords = np.asarray(x.indices).T
-        out_coords, rules, out_spatial = _build_rulebook(
+        out_coords, rules, out_spatial = _build_rulebook_cached(
             coords, x.shape[1:4], self.ksize, self.stride, self.padding,
             subm=False)
         m = len(out_coords)

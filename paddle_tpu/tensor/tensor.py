@@ -172,6 +172,10 @@ class Tensor:
 
     @property
     def mT(self) -> "Tensor":
+        if self.ndim < 2:
+            raise ValueError(
+                "Tensor.mT requires at least 2 dimensions, got "
+                f"{self.ndim}")
         from .linalg import t
         return t(self)
 

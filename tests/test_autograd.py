@@ -275,3 +275,17 @@ class TestAutogradFunctional:
                                    np.diag([2.0, 2.0]), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(j2._data),
                                    np.diag([2.0, 6.0]), rtol=1e-5)
+
+    def test_grad_fn_set_for_pylayer_outputs(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = Double.apply(x)
+        assert y.grad_fn is not None and not y.is_leaf

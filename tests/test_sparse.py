@@ -291,3 +291,20 @@ def test_sparse_reshape_hybrid_preserves_dense_tail():
     dense = np.asarray(s.to_dense()._data)
     np.testing.assert_allclose(np.asarray(r.to_dense()._data),
                                dense.reshape(2, 2, 2), rtol=1e-6)
+
+
+def test_rulebook_cache_reused_across_layers():
+    from paddle_tpu.sparse import nn as snn
+    snn._RULEBOOK_CACHE.clear()
+    rng = np.random.RandomState(50)
+    coords = np.stack([np.zeros(6, np.int32), rng.randint(0, 4, 6),
+                       rng.randint(0, 4, 6), rng.randint(0, 4, 6)])
+    vals = rng.randn(6, 2).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords, vals, (1, 4, 4, 4, 2))
+    c1 = snn.SubmConv3D(2, 3, 3, padding=1)
+    c2 = snn.SubmConv3D(3, 2, 3, padding=1)
+    h = c1(x)
+    n_after_first = len(snn._RULEBOOK_CACHE)
+    out = c2(h)   # same active sites + geometry → cache hit
+    assert len(snn._RULEBOOK_CACHE) == n_after_first
+    assert np.isfinite(np.asarray(out.values._data)).all()
