@@ -60,6 +60,7 @@ from . import geometric
 from . import quantization
 from . import sysconfig
 from . import hub
+from . import onnx
 from . import reader
 from .reader import batch
 from .hapi.model import Model

@@ -72,10 +72,8 @@ def recompute(function: Callable, *args, **kwargs):
         vjp_fn=vjp_fn,
         outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
     )
-    import weakref as _weakref
-    for o in outs:
-        o._grad_fn_ref = _weakref.ref(node)  # Tensor.grad_fn parity
-    _tape.nodes.append(node)
+    from ....tensor.tensor import _register_node
+    _register_node(node, outs)
     return outs if multi else outs[0]
 
 

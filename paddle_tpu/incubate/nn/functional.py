@@ -304,3 +304,33 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             out = F.layer_norm(out, out.shape[-1:], ffn_ln_scales[i],
                                ffn_ln_biases[i], epsilon)
     return out, (new_caches if cache_kvs is not None else None)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused (x + mask) softmax over the last axis. Parity:
+    incubate/nn/functional/fused_softmax_mask.py (CUDA fused kernel) —
+    XLA fuses the add into the softmax reduction on TPU, so this wrapper
+    IS the fused form."""
+    return apply_op(
+        lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal-mask softmax (the reference's GPT-path kernel)."""
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.triu(jnp.full((s, s), -1e9, a.dtype), k=1)
+        return jax.nn.softmax(a + mask, axis=-1)
+    return apply_op(fn, x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one fused graph (reference
+    incubate/nn/functional/fused_dropout_add.py)."""
+    dropped = F.dropout(x, p=p, training=training, mode=mode)
+    return apply_op(jnp.add, dropped, y)
+
+
+__all__ += ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+            "fused_dropout_add"]

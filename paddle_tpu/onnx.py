@@ -1,0 +1,19 @@
+"""paddle.onnx. Parity: python/paddle/onnx/export.py :: export — the
+reference delegates to the external `paddle2onnx` converter.
+
+This build has no ONNX exporter dependency; `export` is gated with a
+clear error pointing at the portable-artifact path that DOES exist here
+(`paddle.static.save_inference_model` → StableHLO `.pdmodel`, loadable
+without Python model code)."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise RuntimeError(
+        "paddle.onnx.export requires the paddle2onnx converter, which is "
+        "not available in this environment. For a portable compiled "
+        "artifact use paddle.static.save_inference_model(path, feeds, "
+        "fetches) — it writes a StableHLO .pdmodel (plus .pdiparams) that "
+        "loads and runs without the Python model definition.")

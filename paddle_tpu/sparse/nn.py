@@ -112,13 +112,20 @@ class BatchNorm(Layer):
                                coalesced=x._coalesced)
 
 
-_RULEBOOK_CACHE: dict = {}
+_RULEBOOK_CACHE: dict = {}   # key -> (rulebook, nbytes)
 _RULEBOOK_CACHE_MAX = 16
 # total-byte budget: training on fresh coords every step must not pin
 # hundreds of MB of never-hit rulebooks; oversized entries skip the cache
 _RULEBOOK_CACHE_MAX_BYTES = 32 << 20
 _RULEBOOK_ENTRY_MAX_BYTES = 4 << 20
 _rulebook_cache_bytes = [0]
+
+
+def clear_rulebook_cache() -> None:
+    """Reset the cache AND its byte counter together (clearing the dict
+    alone would leave phantom bytes that starve future inserts)."""
+    _RULEBOOK_CACHE.clear()
+    _rulebook_cache_bytes[0] = 0
 
 
 def _rulebook_nbytes(key, out):
@@ -140,7 +147,7 @@ def _build_rulebook_cached(coords: np.ndarray, spatial, ksize, stride,
            tuple(padding), subm)
     hit = _RULEBOOK_CACHE.get(key)
     if hit is not None:
-        return hit
+        return hit[0]
     out = _build_rulebook(coords, spatial, ksize, stride, padding, subm)
     size = _rulebook_nbytes(key, out)
     if size > _RULEBOOK_ENTRY_MAX_BYTES:
@@ -149,9 +156,9 @@ def _build_rulebook_cached(coords: np.ndarray, spatial, ksize, stride,
             len(_RULEBOOK_CACHE) >= _RULEBOOK_CACHE_MAX
             or _rulebook_cache_bytes[0] + size > _RULEBOOK_CACHE_MAX_BYTES):
         old_key = next(iter(_RULEBOOK_CACHE))  # FIFO (dict is ordered)
-        old_val = _RULEBOOK_CACHE.pop(old_key)
-        _rulebook_cache_bytes[0] -= _rulebook_nbytes(old_key, old_val)
-    _RULEBOOK_CACHE[key] = out
+        _, old_size = _RULEBOOK_CACHE.pop(old_key)
+        _rulebook_cache_bytes[0] -= old_size
+    _RULEBOOK_CACHE[key] = (out, size)
     _rulebook_cache_bytes[0] += size
     return out
 

@@ -486,11 +486,18 @@ def apply_op(jax_fn: Callable, *tensors: Tensor, n_outputs: int = 1):
         vjp_fn=(vjp_fn if multi else (lambda g, f=vjp_fn: f(g[0]))),
         outputs_meta=[(tuple(o.shape), o.dtype) for o in outs],
     )
-    for o in outs:
-        o._grad_fn_ref = weakref.ref(node)  # O(1) Tensor.grad_fn
-    _tape.nodes.append(node)
+    _register_node(node, outs)
     _maybe_capture(jax_fn, tensors, outs)
     return outs if multi else outs[0]
+
+
+def _register_node(node, outs) -> None:
+    """Append a tape node and give each output its O(1) grad_fn backref —
+    the single registration tail shared by apply_op, PyLayer and
+    recompute."""
+    for o in outs:
+        o._grad_fn_ref = weakref.ref(node)
+    _tape.nodes.append(node)
 
 
 # static-graph capture hook: set by paddle_tpu.static when building a

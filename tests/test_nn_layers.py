@@ -242,3 +242,25 @@ class TestAttention:
         x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
         out = enc(x)
         assert out.shape == [2, 5, 16]
+
+
+def test_incubate_fused_softmax_and_dropout_add():
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(60)
+    x = paddle.to_tensor(rng.randn(2, 4, 6, 6).astype(np.float32))
+    m = paddle.to_tensor(np.full((2, 1, 6, 6), -1e9, np.float32)
+                         * (rng.rand(2, 1, 6, 6) < 0.3))
+    out = np.asarray(IF.softmax_mask_fuse(x, m)._data)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+    ut = np.asarray(IF.softmax_mask_fuse_upper_triangle(x)._data)
+    assert (np.triu(ut[0, 0], k=1) < 1e-6).all()
+    np.testing.assert_allclose(ut.sum(-1), 1.0, rtol=1e-5)
+    da = IF.fused_dropout_add(x, x, p=0.0)
+    np.testing.assert_allclose(np.asarray(da._data),
+                               2 * np.asarray(x._data), rtol=1e-6)
+
+
+def test_onnx_export_gated():
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="save_inference_model"):
+        paddle.onnx.export(None, "m")

@@ -295,7 +295,7 @@ def test_sparse_reshape_hybrid_preserves_dense_tail():
 
 def test_rulebook_cache_reused_across_layers():
     from paddle_tpu.sparse import nn as snn
-    snn._RULEBOOK_CACHE.clear()
+    snn.clear_rulebook_cache()
     rng = np.random.RandomState(50)
     coords = np.stack([np.zeros(6, np.int32), rng.randint(0, 4, 6),
                        rng.randint(0, 4, 6), rng.randint(0, 4, 6)])
