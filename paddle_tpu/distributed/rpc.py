@@ -6,11 +6,17 @@ transport is the framework's own C++ TCPStore rendezvous + a per-worker
 TCP listener thread, keeping the runtime native where the reference's is).
 
 Security note (same contract as the reference): payloads are pickled —
-RPC peers are trusted cluster members, never untrusted input."""
+RPC peers are trusted cluster members, never untrusted input. As a
+defense-in-depth layer a random session token is minted at rendezvous
+(rank 0 → store) and required as a message preamble BEFORE anything is
+unpickled, so network reach to the listener alone is not enough to
+execute code; reach to the rendezvous store is required."""
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
+import secrets
 import socket
 import struct
 import threading
@@ -60,9 +66,16 @@ class _RpcAgent:
         self.store = store
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._server.bind(("0.0.0.0", 0))  # reachable cross-host
+        # bind the advertised interface, not 0.0.0.0, so the listener is
+        # only reachable on the address peers are told about
+        self._ip = self._advertised_ip()
+        try:
+            self._server.bind((self._ip, 0))
+        except OSError:
+            self._server.bind(("0.0.0.0", 0))
         self._server.listen(64)
         self.port = self._server.getsockname()[1]
+        self._token = b""  # minted/fetched at rendezvous (start())
         self._stop = False
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self.workers: dict[str, WorkerInfo] = {}
@@ -72,10 +85,26 @@ class _RpcAgent:
         assigned: a peer may invoke a remote fn that itself calls
         get_worker_info() the instant our endpoint is published, so
         publishing before the slot is set races."""
+        # session token: rank 0 mints, everyone fetches via the store —
+        # possession proves rendezvous membership and gates unpickling
+        if self.rank == 0:
+            self._token = secrets.token_bytes(32)
+            self.store.set("rpc/token", self._token.hex().encode())
+        else:
+            self._token = bytes.fromhex(
+                self._store_get_blocking("rpc/token").decode())
         self._thread.start()
-        # advertise a peer-reachable address: explicit env wins (the
-        # launcher sets it multi-host), else the hostname's IP, else
-        # loopback (single-host)
+        self.store.set(f"rpc/{self.rank}",
+                       f"{self.name}|{self._ip}|{self.port}".encode())
+        for r in range(self.world_size):
+            raw = self._store_get_blocking(f"rpc/{r}")
+            n, ip, port = raw.decode().split("|")
+            self.workers[n] = WorkerInfo(n, r, ip, int(port))
+
+    @staticmethod
+    def _advertised_ip() -> str:
+        """Peer-reachable address: explicit env wins (the launcher sets it
+        multi-host), else the hostname's IP, else loopback (single-host)."""
         my_ip = os.environ.get("PADDLE_CURRENT_ENDPOINT", "").rsplit(
             ":", 1)[0] or os.environ.get("POD_IP", "")
         if not my_ip:
@@ -83,12 +112,7 @@ class _RpcAgent:
                 my_ip = socket.gethostbyname(socket.gethostname())
             except OSError:
                 my_ip = "127.0.0.1"
-        self.store.set(f"rpc/{self.rank}",
-                       f"{self.name}|{my_ip}|{self.port}".encode())
-        for r in range(self.world_size):
-            raw = self._store_get_blocking(f"rpc/{r}")
-            n, ip, port = raw.decode().split("|")
-            self.workers[n] = WorkerInfo(n, r, ip, int(port))
+        return my_ip
 
     def _store_get_blocking(self, key, timeout=60.0):
         deadline = time.time() + timeout
@@ -138,7 +162,12 @@ class _RpcAgent:
 
     def _handle(self, conn):
         try:
-            fn, args, kwargs = pickle.loads(self._recv_msg(conn))
+            msg = self._recv_msg(conn)
+            # constant-time token check BEFORE unpickling anything
+            if len(msg) < 32 or not hmac.compare_digest(msg[:32],
+                                                        self._token):
+                return
+            fn, args, kwargs = pickle.loads(msg[32:])
             try:
                 result = (True, fn(*args, **kwargs))
             except Exception as e:  # ship the exception back
@@ -161,8 +190,8 @@ class _RpcAgent:
         with socket.create_connection((info.ip, info.port),
                                       timeout=timeout) as sock:
             sock.settimeout(timeout)
-            self._send_msg(sock, pickle.dumps((fn, args or (),
-                                               kwargs or {})))
+            self._send_msg(sock, self._token + pickle.dumps(
+                (fn, args or (), kwargs or {})))
             ok, value = pickle.loads(self._recv_msg(sock))
         if not ok:
             raise value

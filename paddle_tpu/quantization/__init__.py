@@ -45,43 +45,72 @@ class BaseQuanter(Layer):
 
 
 class FakeQuanterWithAbsMaxObserver(BaseQuanter):
-    """Moving-average absmax fake quanter (the reference QAT default)."""
+    """Moving-average absmax fake quanter (the reference QAT default).
+
+    The scale and its initialized flag live as PERSISTENT in-graph state
+    (like optimizer accumulators), so a QAT run executed entirely under
+    jit/to_static functionalizes the moving-average update and ends with
+    a calibrated scale — the same numbers as the eager path."""
 
     def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
                  dtype: str = "float32", name=None):
         super().__init__()
+        from ..tensor.tensor import register_persistent
         self._moving_rate = float(moving_rate)
         self._bit_length = int(bit_length)
-        self._scale = 0.0
-        self._initialized = False
+        self._scale_state = Tensor(jnp.zeros((), jnp.float32))
+        self._init_state = Tensor(jnp.zeros((), jnp.float32))
+        for t in (self._scale_state, self._init_state):
+            t.stop_gradient = True
+            register_persistent(t)
+
+    # back-compat surface (PTQ.convert writes these as host values)
+    @property
+    def _scale(self):
+        return float(np.asarray(self._scale_state._data)) \
+            if not isinstance(self._scale_state._data, jax.core.Tracer) \
+            else self._scale_state._data
+
+    @_scale.setter
+    def _scale(self, v):
+        self._scale_state._data = jnp.asarray(v, jnp.float32)
+
+    @property
+    def _initialized(self):
+        d = self._init_state._data
+        return bool(np.asarray(d) > 0) \
+            if not isinstance(d, jax.core.Tracer) else d > 0
+
+    @_initialized.setter
+    def _initialized(self, v):
+        self._init_state._data = jnp.asarray(1.0 if v else 0.0, jnp.float32)
 
     def forward(self, x: Tensor) -> Tensor:
         bl = self._bit_length
-        if isinstance(x._data, jax.core.Tracer):
-            # inside jit/to_static: host-side moving-average state cannot
-            # update under trace — quantize with the in-graph absmax
-            # (dynamic per-batch quantization, trace-safe) in training, or
-            # the frozen calibrated scale in eval
-            if self.training or not self._initialized:
-                return apply_op(
-                    lambda a: _fake_quant(a, jnp.max(jnp.abs(a)), bl), x)
-            scale = jnp.asarray(self._scale, jnp.float32)
-            return apply_op(lambda a: _fake_quant(a, scale, bl), x)
-        if self.training or not self._initialized:
-            # eval before any calibration also initializes from this batch
+        r = self._moving_rate
+        init = self._init_state._data
+        prev = self._scale_state._data
+        if not self.training and not isinstance(init, jax.core.Tracer) \
+                and bool(np.asarray(init) > 0):
+            # calibrated + frozen: inference hot path — no absmax reduction,
+            # no state writes (keeps state out of traced eval graphs too)
+            return apply_op(lambda a: _fake_quant(a, prev, bl), x)
+        cur = jnp.max(jnp.abs(jax.lax.stop_gradient(x._data))).astype(
+            jnp.float32)
+        if self.training:
+            # moving-average update, branch-free so it traces: first batch
+            # seeds the scale, later batches blend
+            scale = jnp.where(init > 0, r * prev + (1 - r) * cur, cur)
+        else:
+            # eval before any calibration initializes from this batch
             # (never quantize with a zero scale)
-            cur = float(np.asarray(jnp.max(jnp.abs(x._data))))
-            if not self._initialized:
-                self._scale = cur
-                self._initialized = True
-            elif self.training:
-                r = self._moving_rate
-                self._scale = r * self._scale + (1 - r) * cur
-        scale = jnp.asarray(self._scale, jnp.float32)
+            scale = jnp.where(init > 0, prev, cur)
+        self._scale_state._data = scale
+        self._init_state._data = jnp.ones((), jnp.float32)
         return apply_op(lambda a: _fake_quant(a, scale, bl), x)
 
     def scales(self) -> Tensor:
-        return Tensor(jnp.asarray(self._scale, jnp.float32))
+        return Tensor(jnp.asarray(self._scale_state._data, jnp.float32))
 
 
 class AbsmaxObserver(BaseQuanter):

@@ -82,6 +82,10 @@ class SparseCooTensor:
     def to_dense(self) -> Tensor:
         idx = self.indices
         shape = self.shape
+        if idx.shape[0] == 0:
+            # 0 sparse dims (e.g. sparse.sum full reduction): nnz==1 and
+            # the dense dims ARE the whole shape — values[0] is the tensor
+            return apply_op(lambda v: v.reshape(shape), self.values)
 
         def densify(v):
             return jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v)
@@ -233,6 +237,13 @@ def coalesce(x: SparseCooTensor) -> SparseCooTensor:
     if x._coalesced:
         return x
     idx = np.asarray(x.indices)
+    if idx.shape[0] == 0:
+        # 0 sparse dims: every entry is a duplicate of the single empty
+        # cell — sum all values into one slot
+        vals = apply_op(lambda v: jnp.sum(v, axis=0, keepdims=True),
+                        x.values)
+        return SparseCooTensor(np.zeros((0, 1), np.int32), vals, x.shape,
+                               coalesced=True)
     flat = np.ravel_multi_index(idx, x.shape[:idx.shape[0]])
     order = np.argsort(flat, kind="stable")
     sorted_flat = flat[order]
@@ -355,15 +366,66 @@ def reshape(x: SparseCooTensor, shape):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
-    """Sum over axes; returns dense Tensor (reference returns 0-D sparse for
-    full reduction — dense is the XLA-natural result and densifies a scalar
-    anyway; per-axis sums densify like the reference's)."""
-    if isinstance(x, SparseCsrTensor):
+    """Sum over axes; returns a SPARSE tensor like the reference
+    (python/paddle/sparse/unary.py :: sum). Support is PRESERVED, never
+    re-derived from values: a row whose entries cancel to exactly 0 stays
+    a stored zero (segment-sum over the existing indices, no densify)."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    if was_csr:
         x = x.to_sparse_coo()
+    coo = coalesce(x)
+    sd = coo.indices.shape[0]
+    nd = len(coo.shape)
+
+    def _cast(t):
+        # cast BEFORE any segment-sum so accumulation runs in the target
+        # dtype (sum(int32, dtype='int64') must not wrap in int32)
+        return apply_op(lambda v: v.astype(dtype), t) if dtype else t
+
     if axis is None:
-        out = apply_op(lambda v: jnp.sum(v, dtype=dtype), x.values)
-        return out
-    return x.to_dense().sum(axis=axis, keepdim=keepdim)
+        n_dense = nd - sd
+        if keepdim:
+            vals = apply_op(
+                lambda v: jnp.sum(v, dtype=dtype).reshape(
+                    (1,) * (n_dense + 1)), coo.values)
+            out = SparseCooTensor(jnp.zeros((sd, 1), jnp.int32),
+                                  vals, (1,) * nd, coalesced=True)
+        else:
+            vals = apply_op(lambda v: jnp.sum(v, dtype=dtype).reshape(1),
+                            coo.values)
+            out = SparseCooTensor(jnp.zeros((0, 1), jnp.int32),
+                                  vals, (), coalesced=True)
+    else:
+        if isinstance(axis, (list, tuple)):
+            assert len(axis) == 1, "sparse.sum: one axis at a time"
+            axis = axis[0]
+        ax = axis % nd
+        if ax < sd:
+            # sparse axis: project it out of the index set; coalesce sums
+            # the now-duplicate cells (keeping cancelled-to-zero support)
+            if keepdim:
+                idx = coo.indices.at[ax].set(0)
+                shape = tuple(1 if i == ax else s
+                              for i, s in enumerate(coo.shape))
+                out = coalesce(SparseCooTensor(idx, _cast(coo.values),
+                                               shape))
+            else:
+                idx = jnp.delete(coo.indices, ax, axis=0)
+                shape = coo.shape[:ax] + coo.shape[ax + 1:]
+                out = coalesce(SparseCooTensor(idx, _cast(coo.values),
+                                               shape))
+        else:
+            # dense axis: reduce inside the values block; support unchanged
+            vax = ax - sd + 1
+            vals = apply_op(
+                lambda v: jnp.sum(v, axis=vax, keepdims=keepdim,
+                                  dtype=dtype), coo.values)
+            shape = tuple(1 if i == ax else s
+                          for i, s in enumerate(coo.shape)) if keepdim \
+                else coo.shape[:ax] + coo.shape[ax + 1:]
+            out = SparseCooTensor(coo.indices, _cast(vals), shape,
+                                  coalesced=True)
+    return out.to_sparse_csr() if was_csr and len(out.shape) == 2 else out
 
 
 # ---------------------------------------------------------------------------

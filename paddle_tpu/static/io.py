@@ -15,6 +15,7 @@ inference-deployment contract."""
 from __future__ import annotations
 
 import json
+import re
 import struct
 
 import jax
@@ -77,19 +78,25 @@ def normalize_program(program, feed_vars, fetch_vars):
 
 
 def _feed_shape_structs(program, feed_vars):
-    """ShapeDtypeStructs for export; None/-1 dims become symbolic. Dynamic
-    dims at the same AXIS share one symbol (axis-0 None on every feed is
-    the same batch size — the reference's feed contract), so multi-input
-    dynamic-batch programs unify and trace."""
+    """ShapeDtypeStructs for export; None/-1 dims become symbolic. Only the
+    BATCH axis (axis 0) shares one symbol across feeds (the reference's feed
+    contract: every feed carries the same batch size); every other dynamic
+    dim gets a per-feed symbol so two feeds with independent dynamic lengths
+    at the same axis (encoder [B,Ls] vs decoder [B,Lt]) stay independent."""
     dims_list = []
     any_sym = False
-    for t in feed_vars:
+    for fi, t in enumerate(feed_vars):
         name = getattr(t, "name", None)
         spec = program._feed_specs.get(name)
         dims = list(spec.shape if spec is not None else t.shape)
         for i, d in enumerate(dims):
             if d is None or d == -1:
-                dims[i] = f"_d{i}"
+                # feed names like 'fc_0.tmp_2' are not identifiers — keep
+                # the symbol name jax_export-legal
+                # sanitized name + feed INDEX: two names that sanitize to
+                # the same tag ('enc.len'/'enc_len') must not share symbols
+                tag = f"f{fi}_" + (re.sub(r"\W", "_", name) if name else "")
+                dims[i] = "_b" if i == 0 else f"_{tag}_d{i}"
                 any_sym = True
         dims_list.append(dims)
     specs = []

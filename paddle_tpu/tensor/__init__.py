@@ -58,7 +58,8 @@ _INPLACE_BASES = [
 _INPLACE_BINARY_BASES = [
     "copysign", "gcd", "hypot", "lcm", "lerp", "nextafter", "pow",
     "remainder", "mod", "floor_divide", "heaviside", "masked_fill",
-    "scatter", "put_along_axis", "renorm",
+    "scatter", "put_along_axis", "renorm", "index_fill", "masked_scatter",
+    "ldexp",
 ]
 
 

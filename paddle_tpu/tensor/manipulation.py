@@ -635,12 +635,6 @@ def index_fill(x, index, axis, value, name=None):
     return apply_op(f, x)
 
 
-def index_fill_(x, index, axis, value, name=None):
-    out = index_fill(x, index, axis, value)
-    x._data = out._data
-    return x
-
-
 def masked_scatter(x, mask, value, name=None):
     """Fill x where mask with consecutive elements of value (row-major)."""
     m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
@@ -662,12 +656,6 @@ def masked_scatter(x, mask, value, name=None):
     if isinstance(value, Tensor):
         return apply_op(f, x, value)
     return apply_op(lambda a: f(a, jnp.asarray(value)), x)
-
-
-def masked_scatter_(x, mask, value, name=None):
-    out = masked_scatter(x, mask, value)
-    x._data = out._data
-    return x
 
 
 def as_strided(x, shape, stride, offset=0, name=None):
@@ -733,8 +721,8 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
 __all__ += ["atleast_1d", "atleast_2d", "atleast_3d", "broadcast_tensors",
             "block_diag", "hstack", "vstack", "dstack", "column_stack",
             "row_stack", "tensor_split", "hsplit", "vsplit", "dsplit",
-            "index_fill", "index_fill_", "masked_scatter",
-            "masked_scatter_", "as_strided", "unflatten", "select_scatter",
+            "index_fill", "masked_scatter",
+            "as_strided", "unflatten", "select_scatter",
             "slice_scatter", "diagonal_scatter"]
 
 

@@ -537,15 +537,6 @@ def erfcx(x, name=None):
 _export("erfcx", erfcx)
 
 
-def ldexp_(x, y, name=None):
-    out = ldexp(x, y)
-    x._data = out._data
-    return x
-
-
-_export("ldexp_", ldexp_)
-
-
 # ---- round-2 tranche 3: pairwise distances, fused add-mul, misc -----------
 
 def addcmul(input, tensor1, tensor2, value=1.0, name=None):

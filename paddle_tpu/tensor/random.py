@@ -139,6 +139,8 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
 
 
 def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "log_normal_")
     arr = jax.random.normal(next_key(), tuple(x.shape),
                             dtype=x._data.dtype) * std + mean
     x._data = jnp.exp(arr)

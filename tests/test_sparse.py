@@ -156,8 +156,13 @@ def test_transpose_reshape_sum():
     np.testing.assert_allclose(np.asarray(r.to_dense()._data),
                                dense.reshape(2, 10), rtol=1e-6)
     total = sparse.sum(s)
-    np.testing.assert_allclose(float(np.asarray(total._data)), dense.sum(),
-                               rtol=1e-5)
+    assert total.is_sparse_coo() and total.shape == ()  # reference: sparse out
+    np.testing.assert_allclose(float(np.asarray(total.to_dense()._data)),
+                               dense.sum(), rtol=1e-5)
+    per_axis = sparse.sum(s, axis=1)
+    assert per_axis.is_sparse_coo() and per_axis.shape == (4,)
+    np.testing.assert_allclose(np.asarray(per_axis.to_dense()._data),
+                               dense.sum(axis=1), rtol=1e-5)
 
 
 def test_sparse_nn_activations_and_softmax():

@@ -169,3 +169,26 @@ def test_pdmodel_is_not_pickle(tmp_path, static_mode):
         pickle.loads(data)  # container is NOT a pickle payload
     with pytest.raises(ValueError, match="pdmodel"):
         paddle.static.deserialize_program(b"garbage")
+
+
+def test_export_independent_dynamic_seq_dims(tmp_path, static_mode):
+    """Two feeds with INDEPENDENT dynamic lengths at the same axis (encoder
+    [B,Ls,D] vs decoder [B,Lt,D]) must export and run with Ls != Lt; only
+    the batch axis shares a symbol. Feed names in paddle's dotted
+    'fc_0.tmp_1' style must survive symbol naming."""
+    a = paddle.static.data("enc_0.tmp_1", [None, None, 4], "float32")
+    b = paddle.static.data("dec", [None, None, 4], "float32")
+    h = paddle.add(a.mean(axis=1, keepdim=True), b)
+    out = paddle.mean(h)
+    exe = paddle.static.Executor()
+    prefix = str(tmp_path / "m_dyn")
+    paddle.static.save_inference_model(prefix, [a, b], [out], exe)
+    paddle.disable_static()
+
+    prog, feeds, fetches = paddle.static.load_inference_model(prefix, exe)
+    ea = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
+    eb = np.random.RandomState(1).randn(2, 7, 4).astype(np.float32)
+    got, = exe.run(prog, feed={"enc_0.tmp_1": ea, "dec": eb},
+                   fetch_list=fetches)
+    np.testing.assert_allclose(
+        got, (ea.mean(axis=1, keepdims=True) + eb).mean(), rtol=1e-5)
