@@ -45,7 +45,13 @@ def constraint(x: Tensor, *spec) -> Tensor:
         try:
             return jax.lax.with_sharding_constraint(a, sh)
         except Exception:
-            return a
+            # inside a partial-manual shard_map (the compiled pipeline) the
+            # concrete mesh's axis types mismatch the context mesh — a bare
+            # PartitionSpec binds to the context mesh instead
+            try:
+                return jax.lax.with_sharding_constraint(a, P(*spec))
+            except Exception:
+                return a
     return apply_op(f, x)
 
 

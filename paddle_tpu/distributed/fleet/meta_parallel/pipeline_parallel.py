@@ -189,14 +189,42 @@ class PipelineParallel(MetaParallelBase):
         for a in data_axes:
             data_world *= mesh.shape[a]
         mb_size = key[0][0] // max(micro, 1)
-        in_mb = P(None, data_axes) if (
-            data_axes and data_world > 1 and mb_size % data_world == 0) \
-            else P()
+        shard_mb = bool(data_axes) and data_world > 1 and \
+            mb_size % data_world == 0
+
+        # HYBRID COMPOSITION (mp×pp×sharding in ONE program): only the pp
+        # axis is manual (ppermute schedule); mp/sharding/dp stay GSPMD-
+        # auto inside the shard_map, so the TP layers' sharding constraints
+        # keep working inside stage bodies and the body params keep their
+        # at-rest specs ('mp' from Column/RowParallel, 'sharding' from
+        # stage 3) — XLA inserts the per-use all-gathers and the grad
+        # reduce-scatters the reference's GroupShardedStage3 hooks code by
+        # hand. Stacked body param k is [P, v, Lc, *shape]: P consumed by
+        # the manual pp spec, [v, Lc] replicated, then the param's own spec.
+        def _stacked_spec(p):
+            from ....parallel import _valid_spec
+            sp = getattr(p, "sharding_spec", None)
+            if sp is None or not _valid_spec(p._data, sp, mesh):
+                return None
+            return P(None, None, *sp)
+        stacked_specs = [_stacked_spec(p) for p in tparams]
 
         @functools.partial(shard_map, mesh=mesh,
-                           in_specs=(P("pp"), in_mb), out_specs=in_mb)
+                           in_specs=(P("pp"), P()), out_specs=P(),
+                           axis_names={"pp"}, check_vma=False)
         def run_pipe(stacked, h_mb):
+            # bare PartitionSpecs bind to the CONTEXT mesh (pp is Manual
+            # inside this shard_map) — a concrete-mesh NamedSharding here
+            # would mismatch axis types and fail to trace
             local = jax.tree.map(lambda a: a[0], stacked)   # [v, Lc, ...]
+            local = [
+                a if sp is None else
+                jax.lax.with_sharding_constraint(a, sp)
+                for a, sp in zip(local, stacked_specs)]
+            if shard_mb:
+                h_mb = jax.lax.with_sharding_constraint(
+                    h_mb, P(None, data_axes,
+                            *([None] * (h_mb.ndim - 2))))
             if v == 1:
                 local = jax.tree.map(lambda a: a[0], local)
                 return gpipe(chunk_apply, local, h_mb)

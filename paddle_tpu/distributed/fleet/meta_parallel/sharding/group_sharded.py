@@ -47,6 +47,34 @@ def shard_spec_for(t, axis_name: str = "sharding"):
     return P(*spec)
 
 
+def augment_spec_for(t, axis_name: str = "sharding"):
+    """Stage-3 spec COMPOSED with an existing one (e.g. a TP param whose
+    'mp' axis the ColumnParallel layer already claims): add axis_name on
+    the largest still-unsharded dim. Returns the combined spec, or None if
+    every dim is taken/0-d (caller keeps the original)."""
+    prior = getattr(t, "sharding_spec", None)
+    shape = tuple(t.shape)
+    if not shape:
+        return None
+    prior = list(prior) if prior is not None else [None] * len(shape)
+    prior += [None] * (len(shape) - len(prior))
+    degree = 1
+    try:
+        from .....parallel import current_mesh
+        mesh = current_mesh()
+        if mesh is not None:
+            degree = mesh.shape.get(axis_name, 1)
+    except Exception:
+        pass
+    free = [i for i in range(len(shape))
+            if prior[i] is None and (degree == 1 or shape[i] % degree == 0)]
+    if not free:
+        return None
+    ax = max(free, key=lambda i: shape[i])
+    prior[ax] = axis_name
+    return P(*prior)
+
+
 def annotate_optimizer_sharding(optimizer, axis_name: str = "sharding"):
     """Mark future + existing accumulators/masters for sharded placement."""
     optimizer._sharding_axis = axis_name
@@ -156,8 +184,16 @@ class GroupShardedStage3(Layer):
         self._layers = layer
         self._optimizer = optimizer
         for _, p in layer.named_parameters():
-            if p.ndim > 0 and p.sharding_spec is None:
+            if p.ndim == 0:
+                continue
+            if p.sharding_spec is None:
                 p.sharding_spec = shard_spec_for(p)
+            elif "sharding" not in str(p.sharding_spec):
+                # TP param: compose ZeRO-3 with the existing 'mp' axis so
+                # the at-rest shard is 1/(mp·sharding) per device
+                combined = augment_spec_for(p)
+                if combined is not None:
+                    p.sharding_spec = combined
         if optimizer is not None:
             annotate_optimizer_sharding(optimizer)
 

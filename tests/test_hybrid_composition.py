@@ -1,0 +1,150 @@
+"""The flagship hybrid: mp × pp × sharding(ZeRO-3) composed in ONE mesh and
+ONE compiled step (BASELINE configs[2] is exactly mp2·pp2·stage3).
+
+Reference parity: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py :: HybridParallelOptimizer composing
+TensorParallel + PipelineParallel + GroupShardedStage3 wrappers across NCCL
+groups. TPU-native: one shard_map with a MANUAL pp axis (ppermute schedule)
+and AUTO mp/sharding axes (GSPMD inserts the TP collectives and the ZeRO-3
+gather-at-use/reduce-scatter), over the fleet topology's 8-device CPU mesh.
+
+Oracle (SURVEY §4): serial-vs-hybrid allclose on losses and updated params.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear)
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    LayerDesc, PipelineLayer)
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.parallel import apply_shardings
+
+D = 16
+
+
+class TPBlock(paddle.nn.Layer):
+    """Megatron block: column-parallel up, row-parallel down, residual."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(D, 4 * D, gather_output=False)
+        self.fc2 = RowParallelLinear(4 * D, D, input_is_parallel=True)
+
+    def forward(self, x):
+        return x + self.fc2(paddle.nn.functional.gelu(self.fc1(x)))
+
+
+def _mse(out, label):
+    return ((out - label) ** 2).mean()
+
+
+def _build(stages):
+    return PipelineLayer([LayerDesc(TPBlock) for _ in range(4)],
+                         num_stages=stages, loss_fn=_mse)
+
+
+def test_mp_pp_stage3_one_mesh_matches_serial():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, D).astype(np.float32)
+    y = rng.randn(8, D).astype(np.float32)
+
+    # ---- serial reference ------------------------------------------------
+    paddle.seed(7)
+    serial = _build(stages=1)
+    init_sd = {k: np.asarray(v._data).copy()
+               for k, v in serial.state_dict().items()}
+    opt_s = paddle.optimizer.AdamW(learning_rate=0.01,
+                                   parameters=serial.parameters())
+    serial_losses = []
+    for _ in range(3):
+        loss = _mse(serial(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt_s.step()
+        opt_s.clear_grad()
+        serial_losses.append(float(np.asarray(loss._data)))
+
+    # ---- hybrid mp2×pp2×sharding2 (8 devices, dp=1) ----------------------
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 2,
+        "pp_configs": {"accumulate_steps": 2},
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+    model = _build(stages=2)
+    model.set_state_dict({k: paddle.to_tensor(v)
+                          for k, v in init_sd.items()})
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
+    _, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    wrapped = fleet.distributed_model(model)
+    apply_shardings()
+
+    hybrid_losses = []
+    for _ in range(3):
+        loss = wrapped.train_batch(
+            [paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+        hybrid_losses.append(float(np.asarray(loss._data)))
+
+    # the COMPILED hybrid pipeline must have run — a silent fallback to the
+    # sequential micro-batch loop would still pass numerically
+    assert wrapped._pp_cache.get("_ran"), \
+        "compiled mp×pp×stage3 path did not run (fell back)"
+
+    np.testing.assert_allclose(hybrid_losses, serial_losses,
+                               rtol=2e-4, atol=2e-5)
+    serial_sd = serial.state_dict()
+    for k, v in model.state_dict().items():
+        np.testing.assert_allclose(
+            np.asarray(v._data), np.asarray(serial_sd[k]._data),
+            rtol=5e-4, atol=5e-4, err_msg=k)
+
+
+def test_mp_pp_stage3_at_rest_placement():
+    """ZeRO-3 × TP at-rest placement: a TP body weight is sharded over BOTH
+    'mp' (its own axis) and 'sharding' (stage-3 composition) — 1/4 of the
+    elements per device on mp2×sharding2 — and AdamW moments are sharded
+    over 'sharding'."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 2,
+        "pp_configs": {"accumulate_steps": 2},
+    }
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(11)
+    model = _build(stages=2)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
+    _, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    wrapped = fleet.distributed_model(model)
+
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(8, D).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, D).astype(np.float32))
+    wrapped.train_batch([x, y], opt)   # slot-creation step
+    apply_shardings()
+    loss = wrapped.train_batch([x, y], opt)
+    assert np.isfinite(float(np.asarray(loss._data)))
+
+    w = model.run_function[0].fc1.weight
+    spec = str(w.sharding_spec)
+    assert "mp" in spec and "sharding" in spec, spec
+    shard_sizes = {int(np.prod(s.data.shape))
+                   for s in w._data.addressable_shards}
+    assert shard_sizes == {w.size // 4}, (shard_sizes, w.size)
+
+    m1 = opt._accumulators["moment1"]
+    for t in m1.values():
+        if t.ndim == 0 or t.sharding_spec is None:
+            continue
+        sizes = {int(np.prod(s.data.shape))
+                 for s in t._data.addressable_shards}
+        assert all(sz < t.size for sz in sizes), \
+            f"moment not sharded: {sizes} vs {t.size}"
+        break
+    else:
+        raise AssertionError("no sharded moment found")
