@@ -1,8 +1,19 @@
-"""Benchmark: GPT-2 124M causal-LM training throughput on one TPU chip.
+"""Benchmark: all five BASELINE configs on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-Self-baseline protocol per BASELINE.md (reference published numbers are
-unknown; vs_baseline tracks the last recorded run in bench_baseline.json).
+Prints ONE JSON line. The top-level fields are the headline config
+(GPT-2 124M train tokens/s/chip, the standing ratchet); the other four
+BASELINE configs (BERT DP+AMP-O2+stage2, LLaMA-proxy mp·pp·stage3,
+ViT-L/16, ERNIE-MoE EP) ride in the "configs" array of the same line,
+each with its own metric/value/unit. Self-baseline protocol per
+BASELINE.md (reference published numbers are unknown; vs_baseline tracks
+bench_baseline.json). Per-config progress goes to stderr.
+
+Time-budgeted BETWEEN configs: BENCH_BUDGET_S (default 1500 TPU /
+420 CPU) gates whether each extra config STARTS (per-config cost
+estimates); a started config runs to completion, so driver timeouts
+should budget BENCH_BUDGET_S plus one config overrun. Completed results
+are checkpointed to BENCH_partial.json after every config so a timeout
+kill cannot lose the finished numbers.
 """
 from __future__ import annotations
 
@@ -12,6 +23,8 @@ import sys
 import time
 
 import numpy as np
+
+_T0 = time.monotonic()
 
 
 def _probe_tpu(timeout_s: float) -> bool:
@@ -109,13 +122,38 @@ def _init_devices():
     return jax, jax.devices()[0], True
 
 
-def main():
-    jax, dev, tpu_unavailable = _init_devices()
-    import jax.numpy as jnp
+def _timed_steps(step_fn, fetch_loss, steps):
+    """Median per-step seconds over chained chunks with a device→host
+    fetch per chunk. NOTE: block_until_ready is NOT a completion barrier
+    on the axon tunnel backend (measured: returns ~100× early) — the host
+    fetch is the only reliable drain."""
+    chunk = max(1, steps // 5)
+    times = []
+    final_loss = None
+    done = 0
+    while done < steps:
+        n = min(chunk, steps - done)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = step_fn()
+        final_loss = fetch_loss(out)
+        times.append((time.perf_counter() - t0) / n)
+        done += n
+    return float(np.median(times)), final_loss
+
+
+def _budget_left(budget_s):
+    return budget_s - (time.monotonic() - _T0)
+
+
+# --------------------------------------------------------------------------
+# configs[0] — GPT-2 124M single-chip train (headline / ratchet)
+# --------------------------------------------------------------------------
+
+def bench_gpt2(on_tpu, peak_tflops):
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import gpt2_124m
 
-    on_tpu = dev.platform in ("tpu", "axon")
     batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
     seq = int(os.environ.get("BENCH_SEQ", "1024" if on_tpu else "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "3"))
@@ -149,32 +187,325 @@ def main():
         loss = train_step(x, y)
     float(np.asarray(loss._data))   # host fetch: drains the pipeline
 
-    # NOTE: block_until_ready is NOT a completion barrier on the axon
-    # tunnel backend (measured: it returns ~100x early). Time chained
-    # chunks (each step depends on the previous via the optimizer state),
-    # forcing a device->host fetch per chunk, and take the median chunk
-    # rate so a mid-run recompile can't skew the number.
-    chunk = max(1, steps // 5)
-    chunk_times = []
-    final_loss = None
-    done = 0
-    while done < steps:
-        n = min(chunk, steps - done)
-        t0 = time.perf_counter()
-        for _ in range(n):
-            loss = train_step(x, y)
-        final_loss = float(np.asarray(loss._data))
-        chunk_times.append((time.perf_counter() - t0) / n)
-        done += n
-    med = float(np.median(chunk_times))
+    med, final_loss = _timed_steps(
+        lambda: train_step(x, y),
+        lambda out: float(np.asarray(out._data)), steps)
     tokens_per_sec = batch * seq / med
 
-    # MFU: dense-transformer 6·N·tokens estimate + attention term
     cfg = model.config
     flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    mfu = (flops_per_token * tokens_per_sec) / (peak_tflops * 1e12)
+
+    return {
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "mfu": round(mfu, 4),
+        "median_step_s": round(med, 5),
+        "batch": batch, "seq": seq, "params": n_params,
+        "loss": final_loss,
+    }
+
+
+# --------------------------------------------------------------------------
+# configs[1] — BERT-base pretrain, DP + AMP-O2 + GroupSharded stage2
+# --------------------------------------------------------------------------
+
+def bench_bert(on_tpu, peak_tflops):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import (BertForPretraining, bert_base,
+                                        bert_tiny)
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "16" if on_tpu else "2"))
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "512" if on_tpu else "64"))
+    steps = 10 if on_tpu else 2
+
+    paddle.seed(0)
+    model = BertForPretraining(bert_base() if on_tpu else bert_tiny())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    # AMP-O2: bf16 params + fp32 master weights (the reference's fp16-O2
+    # on TPU hardware terms), stage-2 = optimizer+grad sharding specs
+    model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+    model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+    n_params = sum(p.size for p in model.parameters())
+
+    rng = np.random.RandomState(0)
+    vocab = model._layers.config.vocab_size if hasattr(model, "_layers") \
+        else model.config.vocab_size
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    labels = ids.copy()
+    labels[rng.rand(*labels.shape) > 0.15] = -100  # MLM: 15% predicted
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(labels)
+    nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y, nsp):
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = model(x, masked_lm_labels=y, next_sentence_labels=nsp)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3 if on_tpu else 1):
+        loss = train_step(x, y, nsp)
+    float(np.asarray(loss._data))
+
+    med, final_loss = _timed_steps(
+        lambda: train_step(x, y, nsp),
+        lambda out: float(np.asarray(out._data)), steps)
+    tokens_per_sec = batch * seq / med
+    mfu = (6 * n_params * tokens_per_sec) / (peak_tflops * 1e12)
+    return {
+        "metric": "bert_base_amp_o2_stage2_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2), "unit": "tokens/s",
+        "mfu": round(mfu, 4), "median_step_s": round(med, 5),
+        "batch": batch, "seq": seq, "params": n_params,
+        "loss": final_loss,
+    }
+
+
+# --------------------------------------------------------------------------
+# configs[2] — LLaMA proxy under Fleet hybrid mp·pp·stage3 (single-chip
+# degrees collapse to 1; the 8-device composition is proven by
+# dryrun_multichip phase 5 + tests/test_hybrid_composition.py)
+# --------------------------------------------------------------------------
+
+def bench_llama(on_tpu, peak_tflops):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    if on_tpu:
+        # ~350M proxy of the 7B architecture, scaled to one v5e chip
+        c = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=16,
+                        num_heads=16, intermediate_size=2816,
+                        max_position=1024)
+        batch, seq, steps = 8, 1024, 10
+    else:
+        c = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, intermediate_size=128, max_position=128)
+        batch, seq, steps = 2, 64, 2
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(c)
+    if on_tpu:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    n_params = sum(p.size for p in model.parameters())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, c.vocab_size, (batch, seq + 1)).astype(np.int32)
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3 if on_tpu else 1):
+        loss = train_step(x, y)
+    float(np.asarray(loss._data))
+
+    med, final_loss = _timed_steps(
+        lambda: train_step(x, y),
+        lambda out: float(np.asarray(out._data)), steps)
+    tokens_per_sec = batch * seq / med
+    flops_per_token = 6 * n_params + 12 * c.num_layers * c.hidden_size * seq
+    mfu = (flops_per_token * tokens_per_sec) / (peak_tflops * 1e12)
+    return {
+        "metric": "llama_proxy_stage3_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2), "unit": "tokens/s",
+        "mfu": round(mfu, 4), "median_step_s": round(med, 5),
+        "batch": batch, "seq": seq, "params": n_params,
+        "loss": final_loss,
+    }
+
+
+# --------------------------------------------------------------------------
+# configs[3] — ViT-L/16 ImageNet-shaped classification train
+# --------------------------------------------------------------------------
+
+def bench_vit(on_tpu, peak_tflops):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.vit import vit_l_16, vit_tiny
+
+    if on_tpu:
+        model = vit_l_16()
+        batch, size, steps = 32, 224, 10
+    else:
+        model = vit_tiny()
+        batch, size, steps = 2, 32, 2
+
+    paddle.seed(0)
+    if on_tpu:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    n_params = sum(p.size for p in model.parameters())
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(
+        0, 10, (batch,)).astype(np.int32))
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        logits = model(x)
+        loss = paddle.nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3 if on_tpu else 1):
+        loss = train_step(x, y)
+    float(np.asarray(loss._data))
+
+    med, final_loss = _timed_steps(
+        lambda: train_step(x, y),
+        lambda out: float(np.asarray(out._data)), steps)
+    images_per_sec = batch / med
+    # ViT-L/16 fwd ≈ 61 GFLOPs/image at 224², train ≈ 3×
+    flops_per_image = (61e9 * 3) if on_tpu else (6 * n_params)
+    mfu = (flops_per_image * images_per_sec) / (peak_tflops * 1e12)
+    return {
+        "metric": "vit_l16_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2), "unit": "images/s",
+        "mfu": round(mfu, 4), "median_step_s": round(med, 5),
+        "batch": batch, "image_size": size, "params": n_params,
+        "loss": final_loss,
+    }
+
+
+# --------------------------------------------------------------------------
+# configs[4] — ERNIE-MoE expert-parallel train step
+# --------------------------------------------------------------------------
+
+def bench_moe(on_tpu, peak_tflops):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.moe import ErnieMoEConfig, ErnieMoEForCausalLM
+
+    if on_tpu:
+        c = ErnieMoEConfig(vocab_size=30000, hidden_size=768, num_layers=6,
+                           num_heads=12, intermediate_size=3072,
+                           num_experts=8, max_position=1024, dropout=0.0)
+        batch, seq, steps = 8, 512, 10
+    else:
+        c = ErnieMoEConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                           num_heads=2, intermediate_size=128,
+                           num_experts=4, max_position=128, dropout=0.0)
+        batch, seq, steps = 2, 32, 2
+
+    paddle.seed(0)
+    model = ErnieMoEForCausalLM(c)
+    if on_tpu:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    n_params = sum(p.size for p in model.parameters())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, c.vocab_size, (batch, seq + 1)).astype(np.int32)
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3 if on_tpu else 1):
+        loss = train_step(x, y)
+    float(np.asarray(loss._data))
+
+    med, final_loss = _timed_steps(
+        lambda: train_step(x, y),
+        lambda out: float(np.asarray(out._data)), steps)
+    tokens_per_sec = batch * seq / med
+    return {
+        "metric": "ernie_moe_ep_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2), "unit": "tokens/s",
+        "median_step_s": round(med, 5),
+        "batch": batch, "seq": seq, "params": n_params,
+        "num_experts": c.num_experts, "loss": final_loss,
+    }
+
+
+# --------------------------------------------------------------------------
+
+def main():
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS",
                                        "197" if on_tpu else "1"))
-    mfu = (flops_per_token * tokens_per_sec) / (peak_tflops * 1e12)
+    budget_s = float(os.environ.get("BENCH_BUDGET_S",
+                                    "1500" if on_tpu else "420"))
+
+    headline = bench_gpt2(on_tpu, peak_tflops)
+    print(f"bench: gpt2 done {headline['value']} tok/s "
+          f"(mfu {headline['mfu']})", file=sys.stderr)
+
+    # (name, fn, stable metric key, rough compile+run cost estimate in s —
+    # a config only STARTS if the estimate fits the remaining budget; a
+    # started config runs to completion, so the driver's own timeout must
+    # budget BENCH_BUDGET_S + one config overrun)
+    extra_benches = [
+        ("bert", bench_bert,
+         "bert_base_amp_o2_stage2_tokens_per_sec_per_chip", 300),
+        ("llama", bench_llama,
+         "llama_proxy_stage3_tokens_per_sec_per_chip", 300),
+        ("vit", bench_vit, "vit_l16_train_images_per_sec_per_chip", 300),
+        ("moe", bench_moe, "ernie_moe_ep_tokens_per_sec_per_chip", 240),
+    ]
+    configs = []
+    partial_path = os.path.join(os.path.dirname(__file__),
+                                "BENCH_partial.json")
+
+    def _checkpoint():
+        # kill-safety: if the driver times the process out mid-config, the
+        # completed results survive in a side file
+        try:
+            with open(partial_path, "w") as f:
+                json.dump({"headline": headline, "configs": configs}, f)
+        except OSError:
+            pass
+
+    _checkpoint()
+    for name, fn, metric_key, est_s in extra_benches:
+        left = _budget_left(budget_s)
+        if left < (est_s if on_tpu else 90):
+            configs.append({"metric": metric_key, "skipped": "time budget",
+                            "budget_left_s": round(left, 1)})
+            print(f"bench: {name} skipped (budget)", file=sys.stderr)
+            continue
+        try:
+            rec = fn(on_tpu, peak_tflops)
+            configs.append(rec)
+            print(f"bench: {name} done {rec.get('value')} "
+                  f"{rec.get('unit')}", file=sys.stderr)
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            configs.append({"metric": metric_key,
+                            "error": f"{type(e).__name__}: {e}"})
+        _checkpoint()
 
     baseline_path = os.path.join(os.path.dirname(__file__),
                                  "bench_baseline.json")
@@ -183,20 +514,14 @@ def main():
         with open(baseline_path) as f:
             prev = json.load(f).get("value")
         if prev:
-            vs_baseline = round(tokens_per_sec / prev, 4)
+            vs_baseline = round(headline["value"] / prev, 4)
     except Exception:
         pass
 
-    record = {
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 2),
-        "unit": "tokens/s",
-        "vs_baseline": vs_baseline,
-        "mfu": round(mfu, 4),
-        "median_step_s": round(med, 5),
-        "batch": batch, "seq": seq, "params": n_params,
-        "device": str(dev), "loss": final_loss,
-    }
+    record = dict(headline)
+    record["vs_baseline"] = vs_baseline
+    record["device"] = str(dev)
+    record["configs"] = configs
     if tpu_unavailable:
         # honest flag: this run measured the CPU fallback because the TPU
         # tunnel was unreachable — not comparable to the TPU ratchet
