@@ -126,21 +126,54 @@ class DygraphShardingOptimizer:
 
 
 class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
-    """Stage 2 optimizer: + gradient sharding annotation at accumulate time."""
+    """Stage 2 optimizer: gradients sharded over the 'sharding' axis during
+    the accumulation phase (the reference's GradStorage reduce-scatter),
+    realized as placement: `reshard_grads()` annotates + device_puts each
+    grad to its sharded layout, so between backward and step each device
+    holds 1/N of every grad at rest. step() reshards then updates."""
 
     def __init__(self, params, optim, group=None, offload=False, device="tpu",
                  **kw):
+        if offload:
+            raise NotImplementedError(
+                "GroupShardedOptimizerStage2(offload=True): CPU offload is "
+                "not implemented on the TPU backend (HBM-resident sharded "
+                "state is the design; see group_sharded.py docstring)")
         super().__init__(optim)
         self._params = list(params)
 
-    def step(self):
+    def reshard_grads(self) -> int:
+        """Place every accumulated grad sharded-at-rest; returns #sharded."""
+        import jax
+        from jax.sharding import NamedSharding
+        from .....parallel import _valid_spec, current_mesh
+        mesh = current_mesh()
+        n = 0
         for p in self._params:
-            if p.grad is not None and p.grad.sharding_spec is None:
-                p.grad.sharding_spec = shard_spec_for(p.grad)
+            g = p.grad
+            if g is None:
+                continue
+            if g.sharding_spec is None:
+                g.sharding_spec = shard_spec_for(g)
+            if mesh is not None and g.sharding_spec is not None and \
+                    not isinstance(g._data, jax.core.Tracer) and \
+                    _valid_spec(g._data, g.sharding_spec, mesh):
+                g._data = jax.device_put(
+                    g._data, NamedSharding(mesh, g.sharding_spec))
+                n += 1
+        return n
+
+    def step(self):
+        self.reshard_grads()
         self._inner.step()
 
 
 class GroupShardedStage2(Layer):
+    """Stage-2 model wrapper. Knob semantics on TPU: `buffer_max_size`
+    (GradStorage bucketing) and comm/calc overlap are obviated — XLA fuses
+    and schedules collectives; they are accepted for API parity and
+    ignored. `offload` is NOT supported and raises (see optimizer)."""
+
     def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
                  device="tpu", dp_group=None):
@@ -181,6 +214,12 @@ class GroupShardedStage3(Layer):
                  offload=False, sync_comm=False, dp_group=None,
                  exclude_layer=None):
         super().__init__()
+        if offload:
+            raise NotImplementedError(
+                "GroupShardedStage3(offload=True): CPU offload is not "
+                "implemented on the TPU backend — parameters rest sharded "
+                "in HBM; a user porting reference offload configs must "
+                "drop the flag rather than silently lose the behavior")
         self._layers = layer
         self._optimizer = optimizer
         for _, p in layer.named_parameters():

@@ -17,6 +17,13 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            sync_comm=False, dp_group=None,
                            exclude_layer=None):
     assert level in ("os", "os_g", "p_g_os"), f"bad sharding level {level}"
+    if offload:
+        # loud at every level — a ported reference offload config must not
+        # silently lose the behavior (stage wrappers also raise; this
+        # covers level="os", whose optimizer wrapper has no offload knob)
+        raise NotImplementedError(
+            "group_sharded_parallel(offload=True): CPU offload is not "
+            "implemented on the TPU backend (sharded state is HBM-resident)")
     if level == "os":
         opt = DygraphShardingOptimizer(optimizer)
         return model, opt, scaler

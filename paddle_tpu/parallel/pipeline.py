@@ -28,6 +28,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -55,7 +56,7 @@ def unmicrobatch(x):
 
 
 def gpipe(stage_fn: Callable, stage_params, x_mb, axis_name: str = "pp",
-          remat: bool = True):
+          remat: bool = True, window: int | str | None = "auto"):
     """Run the micro-batch pipeline schedule; call inside shard_map.
 
     stage_fn(stage_params, h) -> h : applies ONE stage's layers (an inner
@@ -63,34 +64,60 @@ def gpipe(stage_fn: Callable, stage_params, x_mb, axis_name: str = "pp",
     stage_params: this device's stage slice (leading stage axis removed).
     x_mb: [M, mb, ...] microbatched stage-0 input (replicated over pp).
     Returns [M, mb, ...] final-stage outputs, identical on every pp rank.
+
+    Activation memory (the 1F1B-class bound the reference's schedule
+    exists for): ticks are grouped into `window`-sized blocks, each under
+    one jax.checkpoint — backward stores only the BLOCK-BOUNDARY carries
+    (one microbatch activation each) and replays a block's ticks when its
+    grads are needed. Stored boundary activations = T/W + W peak
+    (T = M+P-1 ticks), minimized at W=√T ("auto"). Recompute cost is ≤2
+    extra forwards: one for the block replay, one for the per-tick remat
+    that stays ON inside blocks so a replayed block holds W tick INPUTS
+    rather than W ticks' full within-stage intermediates (for multi-layer
+    stages the latter dominates peak memory). The outputs bank leaves the
+    scan carry entirely: every tick emits its state as a scan output and
+    the last-stage outputs are the contiguous tick slice [P-1, P-1+M) — a
+    linear gather that saves no residuals. window=None disables blocking
+    (single scan, per-tick remat only); remat=False disables BOTH remat
+    levels unless `window` is explicitly set to an int.
     """
     p = jax.lax.axis_size(axis_name)
     i = jax.lax.axis_index(axis_name)
     m = x_mb.shape[0]
+    total = m + p - 1
     perm = [(j, (j + 1) % p) for j in range(p)]
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     state0 = _pvary(jnp.zeros_like(x_mb[0]), axis_name)
-    outs0 = _pvary(jnp.zeros_like(x_mb), axis_name)
 
-    def tick(carry, t):
-        state, outs = carry
+    def tick(state, t):
         incoming = jax.lax.ppermute(state, axis_name, perm)
         mb = jax.lax.dynamic_index_in_dim(x_mb, jnp.minimum(t, m - 1), 0,
                                           keepdims=False)
         inp = jnp.where(i == 0, mb, incoming)
         new = fn(stage_params, inp)
-        # last stage banks microbatch t-(p-1) once it has flowed through
-        done = (i == p - 1) & (t >= p - 1)
-        oidx = jnp.clip(t - (p - 1), 0, m - 1)
-        cur = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
-        outs = jax.lax.dynamic_update_index_in_dim(
-            outs, jnp.where(done, new, cur), oidx, 0)
-        return (state := new, outs) and ((new, outs), None)
+        return new, new
 
-    (_, outs), _ = jax.lax.scan(tick, (state0, outs0),
-                                jnp.arange(m + p - 1))
+    if window == "auto":
+        # remat=False means "spend memory for backward speed" — don't
+        # silently reintroduce recompute via the block checkpoint
+        window = None if not remat else \
+            max(int(np.ceil(np.sqrt(total))), 1)
+    if window and 1 < window < total:
+        n_win = -(-total // window)           # ceil; tail ticks padded
+        ts = jnp.arange(n_win * window).reshape(n_win, window)
+
+        @jax.checkpoint
+        def run_window(state, t_block):
+            return jax.lax.scan(tick, state, t_block)
+
+        _, ys = jax.lax.scan(run_window, state0, ts)
+        ys = ys.reshape(n_win * window, *ys.shape[2:])
+    else:
+        _, ys = jax.lax.scan(tick, state0, jnp.arange(total))
+    # device p-1's tick t ≥ p-1 completed microbatch t-(p-1)
+    outs = jax.lax.slice_in_dim(ys, p - 1, p - 1 + m, axis=0)
     # broadcast the final-stage outputs to every rank (loss is computed
     # replicated, exactly like the reference's shared-loss broadcast)
     outs = jnp.where(i == p - 1, outs, jnp.zeros_like(outs))

@@ -321,6 +321,71 @@ class TestZeROPlacement:
         shapes = {tuple(s.data.shape) for s in p._data.addressable_shards}
         assert shapes == {tuple(p._data.shape)}
 
+    def test_stage2_grad_placement_during_accumulation(self):
+        """Stage-2's distinct semantics: after backward (the accumulation
+        phase) each device holds 1/8 of every grad AT REST — the
+        reference's GradStorage reduce-scatter, realized as placement via
+        reshard_grads() — while params stay replicated (that's stage 3's
+        job, not stage 2's)."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.parallel import apply_shardings
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(5)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(self.D, 2 * self.D),
+            paddle.nn.Tanh(),
+            paddle.nn.Linear(2 * self.D, self.D))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+        apply_shardings()
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, self.D).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, self.D).astype(np.float32))
+        # eager accumulation phase: two backwards, then reshard
+        for _ in range(2):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+        n = opt.reshard_grads()
+        grads = [p.grad for p in model.parameters()
+                 if p.grad is not None and p.grad.ndim > 0]
+        assert n >= len([g for g in grads])
+        for g in grads:
+            self._assert_one_eighth(g)
+        # params replicated at stage 2
+        p = model.parameters()[0]
+        shapes = {tuple(s.data.shape) for s in p._data.addressable_shards}
+        assert shapes == {tuple(p._data.shape)}
+        opt.step()
+        opt.clear_grad()
+        # numerics survive the resharded update
+        loss2 = ((model(x) - y) ** 2).mean()
+        assert np.isfinite(float(np.asarray(loss2._data)))
+
+    def test_stage2_offload_raises(self):
+        """offload=True must be loud, not a silent no-op (the TPU design
+        keeps sharded state HBM-resident)."""
+        import pytest
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        for level in ("os", "os_g", "p_g_os"):
+            with pytest.raises(NotImplementedError, match="offload"):
+                group_sharded_parallel(model, opt, level=level,
+                                       offload=True)
+
     def test_stage3_param_placement_and_memory(self):
         model, opt = self._setup("p_g_os")
         params = [p for p in model.parameters() if p.ndim > 0]
