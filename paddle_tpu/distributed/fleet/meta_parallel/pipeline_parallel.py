@@ -106,6 +106,11 @@ class PipelineParallel(MetaParallelBase):
             pp_cfg.get("accumulate_steps", 1) if hasattr(pp_cfg, "get") else 1)
         self.micro_batch_size = (
             pp_cfg.get("micro_batch_size", 1) if hasattr(pp_cfg, "get") else 1)
+        # remat window for the compiled schedule (gpipe block checkpointing);
+        # "auto" = sqrt(T), None = store every tick input (faster backward)
+        self.remat_window = (
+            pp_cfg.get("remat_window", "auto") if hasattr(pp_cfg, "get")
+            else "auto")
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.stage_id = hcg.get_stage_id()
         self.num_virtual = 1
@@ -227,7 +232,8 @@ class PipelineParallel(MetaParallelBase):
                             *([None] * (h_mb.ndim - 2))))
             if v == 1:
                 local = jax.tree.map(lambda a: a[0], local)
-                return gpipe(chunk_apply, local, h_mb)
+                return gpipe(chunk_apply, local, h_mb,
+                             window=self.remat_window)
             return gpipe_interleaved(chunk_apply, local, h_mb, num_chunks=v)
 
         from ....nn.layer.layers import substitute_param_arrays

@@ -143,23 +143,29 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
         self._params = list(params)
 
     def reshard_grads(self) -> int:
-        """Place every accumulated grad sharded-at-rest; returns #sharded."""
+        """Place every accumulated grad sharded-at-rest; returns #sharded.
+        Placement itself delegates to parallel.with_spec — the one
+        validate-then-device_put implementation — so stage-2 grads follow
+        the same rules (and the same failure tolerance) as every other
+        tensor."""
         import jax
-        from jax.sharding import NamedSharding
-        from .....parallel import _valid_spec, current_mesh
-        mesh = current_mesh()
+        from .....parallel import current_mesh, with_spec
+        if current_mesh() is None:
+            return 0
         n = 0
         for p in self._params:
             g = p.grad
-            if g is None:
+            if g is None or isinstance(g._data, jax.core.Tracer):
                 continue
-            if g.sharding_spec is None:
-                g.sharding_spec = shard_spec_for(g)
-            if mesh is not None and g.sharding_spec is not None and \
-                    not isinstance(g._data, jax.core.Tracer) and \
-                    _valid_spec(g._data, g.sharding_spec, mesh):
-                g._data = jax.device_put(
-                    g._data, NamedSharding(mesh, g.sharding_spec))
+            spec = g.sharding_spec or shard_spec_for(g)
+            if spec is None:
+                continue
+            before = g._data
+            try:
+                with_spec(g, *spec)
+            except Exception:
+                continue
+            if g._data is not before:
                 n += 1
         return n
 

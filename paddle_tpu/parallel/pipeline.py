@@ -195,10 +195,13 @@ def gpipe_interleaved(stage_fn: Callable, chunk_params, x_mb,
 
 
 def make_gpipe_fn(stage_fn: Callable, mesh: Mesh, axis_name: str = "pp",
-                  remat: bool = True, num_micro: int | None = None):
+                  remat: bool = True, num_micro: int | None = None,
+                  window: int | str | None = "auto"):
     """Global-view pipeline: params [P, ...] sharded over the pp axis,
     x either [M, mb, ...] pre-microbatched or [B, ...] with num_micro set.
-    Returns full-batch outputs replicated over pp. jit-compatible."""
+    Returns full-batch outputs replicated over pp. jit-compatible.
+    `window` passes through to gpipe (block-checkpoint size; None trades
+    memory for backward speed)."""
 
     pspec = P(axis_name)
 
@@ -207,7 +210,8 @@ def make_gpipe_fn(stage_fn: Callable, mesh: Mesh, axis_name: str = "pp",
         in_specs=(pspec, P()), out_specs=P())
     def run(stacked_params, x_mb):
         local = jax.tree.map(lambda a: a[0], stacked_params)
-        out = gpipe(stage_fn, local, x_mb, axis_name=axis_name, remat=remat)
+        out = gpipe(stage_fn, local, x_mb, axis_name=axis_name, remat=remat,
+                    window=window)
         return out
 
     def fn(stacked_params, x):
