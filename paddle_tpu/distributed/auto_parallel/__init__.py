@@ -1,3 +1,4 @@
 from .api import (ProcessMesh, shard_tensor, shard_op, get_mesh, set_mesh,
-                  dtensor_from_fn, reshard, Shard, Replicate, Partial)
+                  dtensor_from_fn, reshard, reshard_cost_log,
+                  clear_reshard_cost_log, Shard, Replicate, Partial)
 from .engine import Engine

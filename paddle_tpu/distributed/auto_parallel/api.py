@@ -116,8 +116,73 @@ def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
     return t
 
 
+# reshard pass bookkeeping: every reshard appends {shape, from, to,
+# bytes_moved} — the cost model the reference's reshard/cost_model.py
+# computes per-op; here a per-tensor estimate (full-buffer upper bound
+# when placements differ, 0 when they already match). Ring-buffered so a
+# reshard-per-step training loop cannot grow memory without bound.
+from collections import deque
+_reshard_log: "deque" = deque(maxlen=1000)
+
+
+def reshard_cost_log():
+    return list(_reshard_log)
+
+
+__all__ += ["reshard_cost_log", "clear_reshard_cost_log"]
+
+
+def clear_reshard_cost_log():
+    _reshard_log.clear()
+
+
+def _reshard_array(arr, jm, spec):
+    """Move a raw array to NamedSharding(jm, spec), tolerating mis-sharded
+    and cross-mesh inputs (host round-trip fallback). Returns
+    (array, bytes_moved_estimate)."""
+    target = NamedSharding(jm, spec)
+    cur = getattr(arr, "sharding", None)
+    try:
+        if cur is not None and cur.is_equivalent_to(target, np.ndim(arr)):
+            return arr, 0
+    except Exception:
+        pass
+    moved = int(getattr(arr, "nbytes", 0))
+    try:
+        out = jax.device_put(arr, target)
+    except Exception:
+        # cross-mesh / incompatible source placement: host round-trip is
+        # the universal reshard (the reference's send/recv reshard path)
+        out = jax.device_put(np.asarray(arr), target)
+    return out, moved
+
+
 def reshard(tensor, mesh: ProcessMesh, placements):
-    return shard_tensor(tensor, mesh, placements)
+    """The reshard pass (reference: auto_parallel/static/reshard.py ::
+    Resharder): move `tensor` to `placements` on `mesh`, accepting inputs
+    that are mis-sharded or live on a different mesh, and log a
+    bytes-moved estimate to the cost log."""
+    t = tensor if isinstance(tensor, Tensor) else Tensor(np.asarray(tensor))
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    jm = mesh.jax_mesh()
+    from_desc = str(getattr(getattr(t._data, "sharding", None), "spec",
+                            "host/unknown"))
+    from ...parallel import _valid_spec
+    if not _valid_spec(t._data, spec, jm):
+        # indivisible placement: degrade to unsharded rather than raise —
+        # the same tolerance every other placement path has
+        _reshard_log.append({"shape": tuple(t.shape), "from": from_desc,
+                             "to": str(spec), "bytes_moved": 0,
+                             "skipped": "indivisible"})
+        return t
+    if len(jax.devices()) >= int(np.prod(mesh.shape)):
+        t._data, moved = _reshard_array(t._data, jm, spec)
+    else:
+        moved = 0
+    t.sharding_spec = spec
+    _reshard_log.append({"shape": tuple(t.shape), "from": from_desc,
+                         "to": str(spec), "bytes_moved": moved})
+    return t
 
 
 def shard_op(op_fn, mesh: ProcessMesh = None, in_shardings=None,

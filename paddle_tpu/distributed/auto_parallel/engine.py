@@ -41,6 +41,15 @@ class Engine:
         self._step_fn = None
         self._eval_fn = None
         self._placed = False
+        self._reshard_log: list = []
+
+    @property
+    def reshard_cost_log(self):
+        """THIS engine's reshard records {shape, from, to, bytes_moved} —
+        the placement-aware cost accounting of the planner (per-instance;
+        the module-level api.reshard_cost_log() holds public reshard()
+        calls)."""
+        return list(self._reshard_log)
 
     # ------------------------------------------------------------ internals
     def _mesh(self):
@@ -73,10 +82,24 @@ class Engine:
         self._placed = True
 
     def _shard_batch(self, arr, mesh):
+        """Batch placement WITH the reshard pass: an input that arrives
+        mis-sharded (wrong spec, or a different mesh entirely) is moved to
+        the data-parallel layout rather than erroring; the move is costed
+        in the reshard log (reference: Resharder + cost model)."""
+        from .api import _reshard_array
         ax = self._data_axis(mesh)
         if arr.shape[0] % mesh.shape[ax] == 0:
-            sh = NamedSharding(mesh, P(ax, *([None] * (arr.ndim - 1))))
-            return jax.device_put(arr, sh)
+            spec = P(ax, *([None] * (arr.ndim - 1)))
+            cur = getattr(arr, "sharding", None)
+            out, moved = _reshard_array(arr, mesh, spec)
+            # cost-log only true reshards — a mesh-committed input whose
+            # placement disagreed — not routine host→device feeding
+            if moved and isinstance(cur, NamedSharding):
+                self._reshard_log.append({
+                    "shape": tuple(np.shape(arr)), "from": str(cur.spec),
+                    "to": str(spec), "bytes_moved": moved})
+                del self._reshard_log[:-1000]   # same bound as the module log
+            return out
         return arr
 
     def _build_step(self):

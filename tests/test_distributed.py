@@ -569,6 +569,72 @@ class TestAutoParallelEngine:
         np.testing.assert_allclose(h1.history["loss"], h2.history["loss"],
                                    rtol=1e-4)
 
+    def test_missharded_input_is_resharded_not_error(self):
+        """VERDICT r2 #10 (reference: auto_parallel/static/reshard.py ::
+        Resharder): an input batch deliberately committed with the WRONG
+        placement — feature-axis sharding, and even a different mesh —
+        must be moved to the data layout by the reshard pass, with a
+        bytes-moved record in the cost log, not raise."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.auto_parallel.api import (
+            clear_reshard_cost_log)
+
+        engine, model = self._mk(annotate=True)
+        clear_reshard_cost_log()
+        mesh = engine._mesh()
+        rng = np.random.RandomState(0)
+        x_np = rng.randn(8, 16).astype(np.float32)
+        y_np = (x_np @ rng.randn(16, 16).astype(np.float32) * 0.3)
+
+        engine.prepare()
+        y_t = paddle.to_tensor(y_np.astype(np.float32))
+
+        # wrong spec on the right mesh: sharded over the FEATURE axis by mp
+        bad = jax.device_put(x_np, NamedSharding(mesh, P(None, "mp")))
+        x, y = engine._prep_batch([paddle.to_tensor(bad), y_t], mesh)
+        loss = engine._step_fn(x, y)
+        assert np.isfinite(float(np.asarray(loss._data).mean()))
+        log = engine.reshard_cost_log
+        assert log and log[0]["bytes_moved"] == x_np.nbytes, log
+        assert "mp" in log[0]["from"] and "dp" in log[0]["to"], log
+        # and the input really landed in the dp layout
+        assert {s.data.shape for s in x._data.addressable_shards} == \
+            {(4, 16)}
+
+        # different mesh entirely: host round-trip reshard path
+        other = Mesh(np.array(jax.devices()[:4]).reshape(4), ("q",))
+        bad2 = jax.device_put(x_np, NamedSharding(other, P("q")))
+        x2, y2 = engine._prep_batch([paddle.to_tensor(bad2), y_t], mesh)
+        loss2 = engine._step_fn(x2, y2)
+        assert np.isfinite(float(np.asarray(loss2._data).mean()))
+
+    def test_reshard_api_moves_and_costs(self):
+        """paddle.distributed.auto_parallel.reshard: public reshard op
+        re-places a tensor across placements and logs the move."""
+        from paddle_tpu.distributed.auto_parallel import (ProcessMesh,
+                                                          Shard, Replicate,
+                                                          set_mesh)
+        from paddle_tpu.distributed.auto_parallel.api import (
+            reshard, clear_reshard_cost_log, reshard_cost_log)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "mp"])
+        set_mesh(mesh)
+        clear_reshard_cost_log()
+        t = paddle.to_tensor(np.random.RandomState(0).randn(8, 16)
+                             .astype(np.float32))
+        t = reshard(t, mesh, [Shard(0), Replicate()])
+        shapes = {s.data.shape for s in t._data.addressable_shards}
+        assert shapes == {(4, 16)}, shapes
+        t = reshard(t, mesh, [Replicate(), Shard(1)])
+        shapes = {s.data.shape for s in t._data.addressable_shards}
+        assert shapes == {(8, 4)}, shapes
+        log = reshard_cost_log()
+        assert len(log) == 2 and all(r["bytes_moved"] > 0 for r in log)
+        # already-in-place reshard is free
+        t = reshard(t, mesh, [Replicate(), Shard(1)])
+        assert reshard_cost_log()[-1]["bytes_moved"] == 0
+
     def test_evaluate_and_predict_and_save(self, tmp_path):
         engine, model = self._mk(annotate=True)
         ds = self._data(16)
