@@ -61,6 +61,7 @@ from . import quantization
 from . import sysconfig
 from . import hub
 from . import onnx
+from . import fluid
 from . import reader
 from .reader import batch
 from .hapi.model import Model

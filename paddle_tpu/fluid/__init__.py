@@ -1,0 +1,21 @@
+"""paddle.fluid — 1.x/2.x-transition compat namespace.
+
+Parity: python/paddle/fluid/ (the reference at the 2.5 vintage still ships
+this namespace; its migration guide maps each legacy `fluid.layers.*` name
+onto the modern `paddle.*` op). Only the subset whose SEMANTICS map 1:1 is
+aliased here — names whose 1.x behavior silently differs from the modern op
+(e.g. `layers.expand` = tile-semantics, `layers.cross_entropy` over
+probabilities) raise with the migration pointer instead of mis-computing.
+"""
+from __future__ import annotations
+
+from ..core.place import CPUPlace, CUDAPlace  # noqa: F401
+from ..framework.io import load, save  # noqa: F401
+from ..static import (Executor, Program, default_main_program,  # noqa: F401
+                      default_startup_program, program_guard)
+from . import layers  # noqa: F401
+from .layers import data  # noqa: F401
+
+__all__ = ["layers", "CPUPlace", "CUDAPlace", "Executor", "Program",
+           "default_main_program", "default_startup_program",
+           "program_guard", "data", "load", "save"]

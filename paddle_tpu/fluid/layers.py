@@ -1,0 +1,198 @@
+"""fluid.layers compat subset. Parity: python/paddle/fluid/layers/ (2.5-era
+legacy API surface the reference's own test corpus still exercises).
+
+Each alias is the migration-guide mapping; semantics-trap names raise."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import (creation as _creation, manipulation as _manip,
+                      math as _math, search as _search)
+from ..nn import functional as _F
+from ..static import data  # noqa: F401  (fluid.layers.data lived here)
+from ..tensor.tensor import Tensor, apply_op
+
+__all__ = [
+    "data", "fill_constant", "assign", "cast", "concat", "split", "reshape",
+    "transpose", "squeeze", "unsqueeze", "shape", "zeros", "ones",
+    "zeros_like", "ones_like", "gather", "gather_nd", "scatter",
+    "one_hot", "clip", "clip_by_norm", "mean", "mul", "matmul",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_all", "reduce_any", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "elementwise_max",
+    "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv", "relu", "leaky_relu", "sigmoid", "tanh",
+    "softmax", "log_softmax", "softplus", "softsign", "swish", "hard_swish",
+    "hard_sigmoid", "elu", "gelu", "square", "sqrt", "abs", "exp", "log",
+    "floor", "ceil", "round", "reciprocal", "reverse", "sign", "pad",
+    "expand", "cross_entropy", "accuracy", "increment", "cumsum", "topk",
+    "argmax", "argmin", "argsort", "where", "cond", "unstack", "stack",
+]
+
+
+def _reduce(modern):
+    def op(input, dim=None, keep_dim=False, name=None):
+        return modern(input, axis=dim, keepdim=keep_dim)
+    op.__name__ = f"reduce_{modern.__name__}"
+    return op
+
+
+reduce_sum = _reduce(_math.sum)
+reduce_mean = _reduce(_math.mean)
+reduce_max = _reduce(_math.max)
+reduce_min = _reduce(_math.min)
+reduce_prod = _reduce(_math.prod)
+reduce_all = _reduce(_math.all)
+reduce_any = _reduce(_math.any)
+
+
+def _elementwise(jfn, name):
+    def op(x, y, axis=-1, act=None, name=None):
+        def f(a, b):
+            if axis != -1 and b.ndim < a.ndim:
+                # 1.x broadcast contract: align y's dims starting at `axis`
+                shape = [1] * a.ndim
+                shape[axis:axis + b.ndim] = b.shape
+                b = b.reshape(shape)
+            return jfn(a, b)
+        out = apply_op(f, x, y) if isinstance(y, Tensor) else \
+            apply_op(lambda a: jfn(a, y), x)
+        if act:
+            out = getattr(_F, act)(out)
+        return out
+    op.__name__ = name
+    return op
+
+
+elementwise_add = _elementwise(jnp.add, "elementwise_add")
+elementwise_sub = _elementwise(jnp.subtract, "elementwise_sub")
+elementwise_mul = _elementwise(jnp.multiply, "elementwise_mul")
+elementwise_div = _elementwise(jnp.divide, "elementwise_div")
+elementwise_max = _elementwise(jnp.maximum, "elementwise_max")
+elementwise_min = _elementwise(jnp.minimum, "elementwise_min")
+elementwise_pow = _elementwise(jnp.power, "elementwise_pow")
+elementwise_mod = _elementwise(jnp.mod, "elementwise_mod")
+elementwise_floordiv = _elementwise(jnp.floor_divide,
+                                    "elementwise_floordiv")
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    return _creation.full(shape, value, dtype=dtype)
+
+
+
+
+
+def one_hot(input, depth, allow_out_of_range=False, name=None):
+    return _F.one_hot(input, depth)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def f(a):
+        norm = jnp.sqrt(jnp.sum(a * a))
+        return jnp.where(norm > max_norm, a * (max_norm / norm), a)
+    return apply_op(f, x)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    def f(a, b):
+        a2 = a.reshape((int(jnp.prod(jnp.asarray(a.shape[:x_num_col_dims]))),
+                        -1)) if a.ndim > 2 else a
+        b2 = b.reshape((int(jnp.prod(jnp.asarray(b.shape[:y_num_col_dims]))),
+                        -1)) if b.ndim > 2 else b
+        return a2 @ b2
+    return apply_op(f, x, y)
+
+
+def expand(x, expand_times=None, name=None):
+    raise RuntimeError(
+        "fluid.layers.expand has TILE semantics (repeat per-dim), not the "
+        "modern broadcast expand — use paddle.tile(x, expand_times) "
+        "(migration guide mapping) to avoid a silent behavior change")
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    raise RuntimeError(
+        "fluid.layers.cross_entropy consumes PROBABILITIES (post-softmax); "
+        "the modern paddle.nn.functional.cross_entropy consumes logits. "
+        "Use F.cross_entropy on logits, or paddle.log + nll composition "
+        "for the legacy probability contract")
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as acc
+    return acc(input, label, k=k)
+
+
+def where(condition, name=None):
+    """1.x fluid.layers.where = indices of true (modern paddle.nonzero);
+    the modern ternary where lives at paddle.where."""
+    return _manip.nonzero(condition)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Conditional: python-if on a concrete predicate, lax.cond under
+    trace (fluid.layers.cond's dynamic-graph contract)."""
+    import jax
+    p = pred._data if isinstance(pred, Tensor) else pred
+    if isinstance(p, jax.core.Tracer):
+        return jax.lax.cond(jnp.all(p), lambda _: true_fn(),
+                            lambda _: false_fn(), operand=None)
+    import numpy as np
+    return true_fn() if bool(np.asarray(p).all()) else false_fn()
+
+
+# direct-mapping aliases (identical semantics)
+shape = _manip.shape
+assign = _creation.assign
+cast = _manip.cast
+concat = _manip.concat
+split = _manip.split
+reshape = _manip.reshape
+transpose = _manip.transpose
+squeeze = _manip.squeeze
+unsqueeze = _manip.unsqueeze
+zeros = _creation.zeros
+ones = _creation.ones
+zeros_like = _creation.zeros_like
+ones_like = _creation.ones_like
+gather = _manip.gather
+gather_nd = _manip.gather_nd
+scatter = _manip.scatter
+clip = _math.clip
+mean = _math.mean
+matmul = _math.matmul
+increment = _math.increment
+cumsum = _math.cumsum
+topk = _search.topk
+argmax = _search.argmax
+argmin = _search.argmin
+argsort = _search.argsort
+unstack = _manip.unstack
+stack = _manip.stack
+reverse = _manip.reverse
+pad = _manip.pad
+sign = _math.sign
+square = _math.square
+sqrt = _math.sqrt
+abs = _math.abs  # noqa: A001
+exp = _math.exp
+log = _math.log
+floor = _math.floor
+ceil = _math.ceil
+round = _math.round  # noqa: A001
+reciprocal = _math.reciprocal
+relu = _F.relu
+leaky_relu = _F.leaky_relu
+sigmoid = _F.sigmoid
+tanh = _F.tanh
+softmax = _F.softmax
+log_softmax = _F.log_softmax
+softplus = _F.softplus
+softsign = _F.softsign
+swish = _F.swish
+hard_swish = _F.hardswish
+hard_sigmoid = _F.hardsigmoid
+elu = _F.elu
+gelu = _F.gelu

@@ -59,7 +59,7 @@ _INPLACE_BINARY_BASES = [
     "copysign", "gcd", "hypot", "lcm", "lerp", "nextafter", "pow",
     "remainder", "mod", "floor_divide", "heaviside", "masked_fill",
     "scatter", "put_along_axis", "renorm", "index_fill", "masked_scatter",
-    "ldexp",
+    "ldexp", "cumsum", "cumprod", "logit", "divide",
 ]
 
 
@@ -139,3 +139,18 @@ def _gen_inplace():
 
 
 _INPLACE_GENERATED = _gen_inplace()
+
+
+def _attach_random_inplace():
+    """Random in-place samplers are Tensor methods in the reference
+    (x.exponential_(), x.bernoulli_() …) — random isn't in
+    _METHOD_MODULES because its creation ops (rand/randn) take a shape,
+    not self."""
+    for nm in ("exponential_", "uniform_", "normal_", "log_normal_",
+               "bernoulli_", "cauchy_", "geometric_"):
+        fn = getattr(random, nm, None)
+        if fn is not None and not hasattr(Tensor, nm):
+            setattr(Tensor, nm, fn)
+
+
+_attach_random_inplace()

@@ -337,3 +337,17 @@ def ormqr(input, tau, other, left=True, transpose=False, name=None):
 
 
 __all__ += ["vector_norm", "matrix_norm", "ormqr"]
+
+
+def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
+    """Solve A x = b from lu()'s packed factorization (reference:
+    python/paddle/tensor/linalg.py :: lu_solve, 2.6)."""
+    import jax.scipy.linalg as jsl
+    t = {"N": 0, "T": 1, "H": 2}.get(trans, 0)
+
+    def f(lu_, piv, rhs):
+        return jsl.lu_solve((lu_, piv.astype(jnp.int32)), rhs, trans=t)
+    return apply_op(f, lu_data, lu_pivots, b)
+
+
+__all__ += ["lu_solve"]

@@ -766,3 +766,43 @@ def nonzero_static(x, size, fill_value=-1, name=None):
 
 __all__ += ["argwhere", "combinations", "matrix_transpose",
             "nonzero_static"]
+
+
+def reverse(x, axis, name=None):
+    """Legacy-compat alias of flip (reference: fluid.layers.reverse — the
+    2.5-era name the migration docs map to paddle.flip)."""
+    return flip(x, axis)
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """Legacy 1.x API: (unique values, index-of-each-element, counts).
+    Modern unique() covers it; kept for reference-corpus parity."""
+    out, inverse, counts = unique(x, return_inverse=True,
+                                  return_counts=True)
+    return out, inverse.astype(dtype), counts.astype(dtype)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    """In-place VIEW variant of flatten — same contract as the existing
+    reshape_/squeeze_/unsqueeze_ family: a metadata-only edit outside the
+    tape (the reference treats view in-place ops as always legal; use the
+    out-of-place flatten when the reshape must be differentiated)."""
+    out = flatten(x, start_axis, stop_axis)
+    x._data = out._data
+    return x
+
+
+__all__ += ["reverse", "unique_with_counts", "flatten_"]
+
+
+def shape(input, name=None):
+    """Shape as an int32 tensor (modern paddle.shape op)."""
+    return Tensor(jnp.asarray(np.asarray(input.shape), jnp.int32))
+
+
+def rank(input, name=None):
+    """Rank (ndim) as a 0-D int32 tensor (paddle.rank)."""
+    return Tensor(jnp.asarray(len(input.shape), jnp.int32))
+
+
+__all__ += ["shape", "rank"]

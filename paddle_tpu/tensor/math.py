@@ -12,7 +12,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .tensor import Tensor, apply_op
+from .tensor import Tensor, apply_op, no_grad
 
 __all__: list = []
 
@@ -639,3 +639,98 @@ def reduce_as(x, target, name=None):
 for _nm in ["addcmul", "addcdiv", "cdist", "pdist", "dist", "mv",
             "multigammaln", "reduce_as"]:
     _export(_nm, globals()[_nm])
+
+
+# ---- round-3 tranche: remaining modern-API parity ops ---------------------
+# Parity: python/paddle/tensor/math.py add_n/multiplex,
+# manipulation.py fill_diagonal(_)/fill_diagonal_tensor(_).
+
+def add_n(inputs, name=None):
+    """Element-wise sum of a tensor list (reference: sum_op / add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    assert len(inputs) > 0, "add_n needs at least one input"
+    return apply_op(lambda *arrs: functools.reduce(jnp.add, arrs), *inputs)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i] (reference:
+    multiplex_op). index: [batch, 1] or [batch]."""
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1).astype(jnp.int32)
+
+    def f(*arrs):
+        stacked = jnp.stack(arrs)                    # [K, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]
+    return apply_op(f, *inputs)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Out-of-place diagonal fill (basis of the reference's in-place op).
+    wrap=True re-wraps the diagonal for tall 2-D matrices — numpy's rule:
+    flat positions at stride m+1 (offset shifts the flat start)."""
+    def f(a):
+        if a.ndim == 2 and wrap and a.shape[0] > a.shape[1] + 1:
+            n, m = a.shape
+            flat = jnp.arange(n * m).reshape(n, m)
+            sel = (flat - offset) % (m + 1) == 0
+            if offset:
+                sel = sel & (flat >= offset)
+            return jnp.where(sel, jnp.asarray(value, a.dtype), a)
+        if a.ndim > 2:
+            # reference semantics: the HYPERCUBE diagonal a[i,i,...,i]
+            # (all dims must be equal), not a batch of 2-D diagonals
+            if len(set(a.shape)) != 1:
+                raise ValueError(
+                    "fill_diagonal on ndim>2 requires all dimensions "
+                    f"equal, got {a.shape}")
+            idx = jnp.arange(a.shape[0])
+            return a.at[tuple([idx] * a.ndim)].set(
+                jnp.asarray(value, a.dtype))
+        i = jnp.arange(a.shape[-2])[:, None]
+        j = jnp.arange(a.shape[-1])[None, :]
+        sel = (j - i) == offset
+        return jnp.where(sel, jnp.asarray(value, a.dtype), a)
+    return apply_op(f, x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor y along the (dim1, dim2) diagonal (reference:
+    fill_diagonal_tensor_op)."""
+    import builtins as _b
+
+    def f(a, v):
+        # NB: bare min/max here would hit the module's exported reduce ops
+        moved = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        n, m = moved.shape[-2:]
+        rows = jnp.arange(_b.max(_b.min(n, m - offset) if offset >= 0
+                                 else _b.min(n + offset, m), 0))
+        r = rows - _b.min(offset, 0)
+        c = rows + _b.max(offset, 0)
+        out = moved.at[..., r, c].set(v.astype(a.dtype))
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    if isinstance(y, Tensor):
+        return apply_op(f, x, y)
+    return apply_op(lambda a: f(a, jnp.asarray(y)), x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    from . import _inplace_grad_guard, _assign_inplace
+    _inplace_grad_guard(x, "fill_diagonal_")
+    with no_grad():
+        out = fill_diagonal(x, value, offset=offset, wrap=wrap)
+    return _assign_inplace(x, out, "fill_diagonal_")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from . import _inplace_grad_guard, _assign_inplace
+    _inplace_grad_guard(x, "fill_diagonal_tensor_")
+    with no_grad():
+        out = fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2)
+    return _assign_inplace(x, out, "fill_diagonal_tensor_")
+
+
+for _n in ("add_n", "multiplex", "fill_diagonal", "fill_diagonal_",
+           "fill_diagonal_tensor", "fill_diagonal_tensor_"):
+    _export(_n, globals()[_n])

@@ -86,18 +86,24 @@ def poisson(x, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "exponential_")
     x._data = jax.random.exponential(next_key(), x._data.shape,
                                      x._data.dtype) / lam
     return x
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "uniform_")
     x._data = jax.random.uniform(next_key(), x._data.shape, x._data.dtype,
                                  min, max)
     return x
 
 
 def normal_(x, mean=0.0, std=1.0, name=None):
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "normal_")
     x._data = mean + std * jax.random.normal(next_key(), x._data.shape,
                                              x._data.dtype)
     return x
@@ -165,3 +171,37 @@ def standard_gamma(alpha, name=None):
 
 
 __all__ += ["log_normal", "log_normal_", "binomial", "standard_gamma"]
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """In-place Bernoulli(p) fill (reference: paddle.Tensor.bernoulli_)."""
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "bernoulli_")
+    x._data = jax.random.bernoulli(
+        next_key(), p, tuple(x.shape)).astype(x._data.dtype)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """In-place Cauchy(loc, scale) fill (reference: paddle.Tensor.cauchy_)."""
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "cauchy_")
+    x._data = (jax.random.cauchy(next_key(), tuple(x.shape),
+                                 dtype=x._data.dtype) * scale + loc)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """In-place Geometric(probs) fill (reference: paddle.Tensor.geometric_)."""
+    from . import _inplace_grad_guard
+    _inplace_grad_guard(x, "geometric_")
+    u = jax.random.uniform(next_key(), tuple(x.shape),
+                           minval=1e-7, maxval=1.0)
+    p = probs._data if isinstance(probs, Tensor) else jnp.asarray(
+        probs, jnp.float32)
+    x._data = jnp.ceil(jnp.log1p(-u) / jnp.log1p(-p)).astype(
+        x._data.dtype)
+    return x
+
+
+__all__ += ["bernoulli_", "cauchy_", "geometric_"]

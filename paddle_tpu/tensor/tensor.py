@@ -213,6 +213,33 @@ class Tensor:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._data)
 
+    # ---- NumPy interop (VERDICT r2 #6: __array_ufunc__ interop) ----------
+    # np.asarray(t) works via __array__; np.sin(t) / np.add(x, t) route
+    # through __array_ufunc__ onto the DIFFERENTIABLE apply_op path (the
+    # jnp ufunc of the same name), so mixing NumPy idioms with Tensors
+    # neither breaks the tape nor silently drops to host math.
+    __array_priority__ = 100  # beat ndarray in mixed binary ops
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs.get("out") is not None:
+            return NotImplemented
+        import jax.numpy as _jnp
+        jfn = getattr(_jnp, ufunc.__name__, None)
+        if jfn is None:
+            return NotImplemented
+        tensors = [i for i in inputs if isinstance(i, Tensor)]
+
+        def f(*arrs):
+            it = iter(arrs)
+            args = [next(it) if isinstance(i, Tensor) else i
+                    for i in inputs]
+            return jfn(*args, **kwargs)
+        return apply_op(f, *tensors)
+
     def item(self):
         return self._data.item() if hasattr(self._data, "item") else np.asarray(self._data).item()
 

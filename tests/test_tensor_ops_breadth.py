@@ -526,3 +526,172 @@ def test_tensor_properties():
     assert x.grad_fn is None          # leaf
     y = x * 2
     assert y.grad_fn is not None      # produced by a tape node
+
+
+# ---- round-3 tranche: modern-API leftovers + interop ----------------------
+
+def test_add_n_and_multiplex():
+    xs = [np.random.RandomState(i).randn(3, 4).astype(np.float32)
+          for i in range(3)]
+    out = paddle.add_n([_t(a) for a in xs])
+    np.testing.assert_allclose(np.asarray(out._data), sum(xs), rtol=1e-6)
+    inputs = [np.arange(8, dtype=np.float32).reshape(4, 2) + 100 * k
+              for k in range(3)]
+    idx = np.array([2, 0, 1, 0], np.int32)
+    got = paddle.multiplex([_t(a) for a in inputs], _t(idx[:, None]))
+    want = np.stack([inputs[idx[i]][i] for i in range(4)])
+    np.testing.assert_allclose(np.asarray(got._data), want)
+
+
+def test_fill_diagonal_family():
+    x = np.zeros((4, 4), np.float32)
+    out = paddle.fill_diagonal(_t(x), 5.0)
+    np.testing.assert_allclose(np.asarray(out._data), np.eye(4) * 5)
+    # wrap on a tall matrix matches numpy's fill_diagonal(wrap=True)
+    tall = np.zeros((7, 3), np.float32)
+    want = tall.copy()
+    np.fill_diagonal(want, 9.0, wrap=True)
+    got = paddle.fill_diagonal(_t(tall), 9.0, wrap=True)
+    np.testing.assert_allclose(np.asarray(got._data), want)
+    # in-place guarded
+    g = _t(np.zeros((3, 3), np.float32))
+    g.stop_gradient = False
+    with pytest.raises(RuntimeError, match="in-place"):
+        g.fill_diagonal_(1.0)
+    t = _t(np.zeros((3, 3), np.float32))
+    t.fill_diagonal_(2.0)
+    np.testing.assert_allclose(np.asarray(t._data), np.eye(3) * 2)
+    # fill_diagonal_tensor writes a vector along the diagonal
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    ft = paddle.fill_diagonal_tensor(_t(np.zeros((3, 3), np.float32)),
+                                     _t(v))
+    np.testing.assert_allclose(np.asarray(ft._data), np.diag(v))
+
+
+def test_lu_solve_roundtrip():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 3
+    b = rng.randn(4, 2).astype(np.float32)
+    lu_t, piv = paddle.linalg.lu(_t(a))
+    x = paddle.linalg.lu_solve(_t(b), lu_t, piv)
+    np.testing.assert_allclose(a @ np.asarray(x._data), b, atol=1e-4)
+
+
+def test_legacy_reverse_unique_with_counts_flatten_():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.tensor.manipulation.reverse(_t(x), [0])._data),
+        x[::-1])
+    vals = np.array([3, 1, 3, 2, 1], np.int32)
+    u, inv, counts = paddle.tensor.manipulation.unique_with_counts(_t(vals))
+    np.testing.assert_array_equal(np.asarray(u._data), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(counts._data), [2, 1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(u._data)[np.asarray(inv._data)], vals)
+    t = _t(x)
+    t2 = paddle.tensor.manipulation.flatten_(t)
+    assert t2 is t and tuple(t.shape) == (6,)
+
+
+def test_inplace_random_samplers_guarded_and_distributed():
+    paddle.seed(0)
+    t = _t(np.zeros((2000,), np.float32))
+    t.bernoulli_(p=0.25)
+    frac = float(np.asarray(t._data).mean())
+    assert 0.18 < frac < 0.32, frac
+    t.geometric_(0.5)
+    m = float(np.asarray(t._data).mean())
+    assert 1.5 < m < 2.5, m          # E[Geom(0.5)] = 2 (1-indexed)
+    t.cauchy_(loc=1.0, scale=0.5)
+    med = float(np.median(np.asarray(t._data)))
+    assert 0.7 < med < 1.3, med      # Cauchy median = loc
+    g = _t(np.zeros((4,), np.float32))
+    g.stop_gradient = False
+    for name in ("bernoulli_", "cauchy_", "geometric_"):
+        with pytest.raises(RuntimeError, match="in-place"):
+            getattr(g, name)(0.5)
+
+
+def test_generated_inplace_cumsum_cumprod_logit():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    t = _t(x.copy())
+    t.cumsum_()
+    np.testing.assert_allclose(np.asarray(t._data), np.cumsum(x))
+    t2 = _t(x.copy())
+    t2.cumprod_(dim=0)
+    np.testing.assert_allclose(np.asarray(t2._data), np.cumprod(x))
+    p = _t(np.array([0.2, 0.5, 0.8], np.float32))
+    p.logit_()
+    np.testing.assert_allclose(np.asarray(p._data),
+                               np.log(np.array([0.2, 0.5, 0.8]) /
+                                      (1 - np.array([0.2, 0.5, 0.8]))),
+                               rtol=1e-5)
+
+
+def test_array_ufunc_interop_keeps_grads():
+    """np.sin(t) / np.add(ndarray, t) route through the tape (VERDICT r2
+    #6: __array_ufunc__ interop)."""
+    x = _t(np.array([0.3, 0.7], np.float32))
+    x.stop_gradient = False
+    out = np.sin(x)
+    assert isinstance(out, type(x))
+    np.testing.assert_allclose(np.asarray(out._data), np.sin([0.3, 0.7]),
+                               rtol=1e-6)
+    mixed = np.add(np.ones(2, np.float32), x)
+    assert isinstance(mixed, type(x))
+    (out.sum() + mixed.sum()).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               np.cos([0.3, 0.7]) + 1.0, rtol=1e-5)
+    # __array__ still gives plain numpy
+    assert isinstance(np.asarray(x), np.ndarray)
+
+
+def test_fluid_layers_compat_subset():
+    import paddle_tpu.fluid as fluid
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = _t(x)
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.reduce_sum(t, dim=1)._data), x.sum(1))
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.reduce_mean(t, keep_dim=True)._data),
+        x.mean(keepdims=True))
+    y = np.array([10.0, 20.0], np.float32)
+    got = fluid.layers.elementwise_add(t, _t(y), axis=0)
+    np.testing.assert_allclose(np.asarray(got._data), x + y[:, None])
+    fc = fluid.layers.fill_constant([2, 2], "float32", 7.0)
+    np.testing.assert_allclose(np.asarray(fc._data), np.full((2, 2), 7.0))
+    np.testing.assert_array_equal(
+        np.asarray(fluid.layers.shape(t)._data), [2, 3])
+    idx = fluid.layers.where(_t(np.array([False, True, True])))
+    np.testing.assert_array_equal(np.asarray(idx._data).ravel(), [1, 2])
+    with pytest.raises(RuntimeError, match="TILE"):
+        fluid.layers.expand(t, [2, 2])
+    with pytest.raises(RuntimeError, match="PROBABILITIES"):
+        fluid.layers.cross_entropy(t, _t(np.array([0, 1])))
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.clip_by_norm(_t(np.array([3.0, 4.0],
+                                                         np.float32)),
+                                             1.0)._data), [0.6, 0.8])
+
+
+def test_fill_diagonal_hypercube_and_guarded_samplers():
+    # ndim>2: hypercube diagonal x[i,i,i], equal dims required
+    t = paddle.fill_diagonal(_t(np.zeros((3, 3, 3), np.float32)), 1.0)
+    want = np.zeros((3, 3, 3), np.float32)
+    for i in range(3):
+        want[i, i, i] = 1.0
+    np.testing.assert_allclose(np.asarray(t._data), want)
+    with pytest.raises(ValueError, match="dimensions"):
+        paddle.fill_diagonal(_t(np.zeros((2, 3, 3), np.float32)), 1.0)
+    # legacy samplers now refuse on grad-enabled tensors like the rest
+    g = _t(np.zeros((4,), np.float32))
+    g.stop_gradient = False
+    for name in ("uniform_", "normal_", "exponential_"):
+        with pytest.raises(RuntimeError, match="in-place"):
+            getattr(g, name)()
+    # geometric_ accepts per-element probs tensors
+    paddle.seed(3)
+    t2 = _t(np.zeros((1000, 2), np.float32))
+    t2.geometric_(_t(np.array([0.9, 0.2], np.float32)))
+    means = np.asarray(t2._data).mean(axis=0)
+    assert means[1] > means[0] * 2, means   # E[Geom(p)] = 1/p
