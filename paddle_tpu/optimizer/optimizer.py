@@ -111,15 +111,103 @@ class Optimizer:
                 (p, p.grad) for p in self._params()
                 if getattr(p, "trainable", not p.stop_gradient)
                 and p.grad is not None]
-            if self._grad_clip is not None:
-                params_grads = self._grad_clip(params_grads)
             lr = self.get_lr()
             self._step_count += 1
-            for p, g in params_grads:
-                # per-param lr scaling from ParamAttr(learning_rate=...)
-                scale = getattr(p, "optimize_attr", None)
-                p_lr = lr * scale["learning_rate"] if scale else lr
-                self._update_param(p, g, p_lr)
+            if self._should_fuse(params_grads):
+                try:
+                    self._fused_eager_step(params_grads, lr)
+                    return
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        f"fused eager optimizer step failed "
+                        f"({type(e).__name__}: {e}); falling back to the "
+                        f"per-param loop")
+                    self._fuse_eager = False     # sticky disable
+                    self._purge_tracer_slots()   # drop half-built slots
+            self._step_core(params_grads, lr)
+
+    def _purge_tracer_slots(self):
+        """A fused trace that failed after lazily creating accumulator/
+        master slots leaves them holding escaped tracers — drop those so
+        the eager fallback (and every later to_static call) sees only
+        concrete state."""
+        import jax
+        for slot in self._accumulators.values():
+            for k in [k for k, t in slot.items()
+                      if isinstance(t._data, jax.core.Tracer)]:
+                del slot[k]
+        for k in [k for k, t in self._master_weights.items()
+                  if isinstance(t._data, jax.core.Tracer)]:
+            del self._master_weights[k]
+
+    def _step_core(self, params_grads, lr):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            # per-param lr scaling from ParamAttr(learning_rate=...)
+            scale = getattr(p, "optimize_attr", None)
+            p_lr = lr * scale["learning_rate"] if scale else lr
+            self._update_param(p, g, p_lr)
+
+    def _should_fuse(self, params_grads) -> bool:
+        """Fuse the EAGER step into one compiled program (the reference's
+        multi_tensor_adam: one kernel over all params instead of a
+        per-param dispatch storm). Inside an outer to_static trace the
+        step is already being compiled — run inline there."""
+        import jax
+        if getattr(self, "_fuse_eager", None) is None:
+            # tri-state: None = read env once; False stays sticky after a
+            # fallback so a deterministic failure doesn't retrace forever
+            import os
+            self._fuse_eager = os.environ.get(
+                "PADDLE_TPU_FUSE_EAGER_STEP", "1") != "0"
+        return bool(self._fuse_eager and params_grads
+                    and not isinstance(params_grads[0][0]._data,
+                                       jax.core.Tracer)
+                    and not isinstance(params_grads[0][1]._data,
+                                       jax.core.Tracer))
+
+    def _fused_eager_step(self, params_grads, lr):
+        """One jitted program per param-set: grads + lr travel as
+        arguments (no retrace when the scheduler moves the lr); state
+        writes functionalize through to_static's persistent-state
+        machinery, exactly like a compiled train step."""
+        key = (tuple(id(p) for p, _ in params_grads), self._hyper_key(
+            [p for p, _ in params_grads]))
+        cache = getattr(self, "_fused_cache", None)
+        if cache is None:
+            cache = self._fused_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            from ..jit import to_static
+            params = [p for p, _ in params_grads]
+
+            def run(grads, lr_t):
+                self._step_core(list(zip(params, grads)), lr_t._data)
+                return Tensor(jnp.zeros((), jnp.float32))
+            fn = cache[key] = to_static(run)
+            self._fused_fn = fn          # introspection/debug handle
+        fn([g for _, g in params_grads],
+           Tensor(jnp.asarray(lr, jnp.float32)))
+
+    def _hyper_key(self, params):
+        """Python-level hyperparameters the trace bakes in as constants —
+        part of the cache key so mutating them mid-training retraces
+        instead of silently keeping stale values (the eager loop re-read
+        them every step)."""
+        clip = self._grad_clip
+        clip_sig = None if clip is None else (
+            type(clip).__name__,
+            getattr(clip, "clip_norm", None), getattr(clip, "max", None),
+            getattr(clip, "min", None), getattr(clip, "clip_value", None))
+        lr_scales = tuple(
+            (getattr(p, "optimize_attr", None) or {}).get(
+                "learning_rate", 1.0) for p in params)
+        return (clip_sig, getattr(self, "_wd_coeff", None),
+                self._weight_decay if isinstance(self._weight_decay,
+                                                 (int, float)) else None,
+                lr_scales)
 
     def _update_param(self, p: Parameter, g: Tensor, lr: float):
         raise NotImplementedError
