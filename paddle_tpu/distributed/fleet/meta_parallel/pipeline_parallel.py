@@ -5,8 +5,9 @@ PipelineParallel.train_batch (1F1B), PipelineParallelWithInterleave
 
 TPU-native execution model: there are no per-stage OS processes or NCCL P2P
 queues. When the hybrid mesh has pp ≥ 2 and the PipelineLayer's middle is a
-homogeneous layer stack (the transformer case the reference's 1F1B exists
-for), `train_batch` compiles the WHOLE schedule into one SPMD program: the
+PERIODIC layer stack (homogeneous period-1 transformers, or period-k
+patterns like MoE-every-k / wide-narrow alternations), `train_batch`
+compiles the WHOLE schedule into one SPMD program: the
 stage bodies are stacked on a leading pp axis, `shard_map` places one stage
 per pp rank, and the `lax.scan`-of-`ppermute` engine in
 paddle_tpu.parallel.pipeline runs the micro-batch schedule (GPipe fill-drain;
@@ -16,7 +17,8 @@ so there is no SendRecvMeta handshake to replicate. Embedding/head layers
 outside the homogeneous run execute under GSPMD (replicated over pp, sharded
 over mp/dp per their annotations) before/after the pipelined section.
 
-Fallback (no mesh, pp == 1, or a non-uniform body): the reference's
+Fallback (no mesh, pp == 1, or a body with no usable periodic run): the
+reference's
 micro-batch loop — split into accumulate_steps micro-batches,
 forward/backward each, accumulate grads, one optimizer step — which is
 numerically identical to 1F1B.
@@ -42,32 +44,55 @@ class _NotPipelineable(Exception):
 def _param_sig(layer):
     """Structural identity for 'same stage body' detection: class (the
     forward fn) + parameter shapes/dtypes. Param shapes alone are not
-    enough — a stem Linear and a residual block can share shapes."""
-    return (type(layer).__qualname__,
-            tuple((tuple(p.shape), str(p.dtype)) for p in layer.parameters()))
+    enough — a stem Linear and a residual block can share shapes. For
+    PARAM-LESS layers the class name alone is not enough either: two
+    _FnLayers wrapping different callables (relu vs silu) or two Dropouts
+    with different rates would collide and chunk_apply would silently run
+    the template's behavior for both — so include config scalars and the
+    wrapped-callable identity (distinct lambdas never match: conservative
+    by construction)."""
+    params = tuple((tuple(p.shape), str(p.dtype))
+                   for p in layer.parameters())
+    if params:
+        return (type(layer).__qualname__, params)
+    cfg = tuple(sorted((k, str(v)) for k, v in vars(layer).items()
+                       if isinstance(v, (int, float, bool, str))))
+    fn = getattr(layer, "_fn", None)
+    return (type(layer).__qualname__, params, cfg,
+            None if fn is None else id(fn))
 
 
 def _find_body(layers, slots):
-    """Longest run of consecutive layers with identical non-empty parameter
-    signatures whose length is a (maximal) multiple of `slots`
-    (= pp_degree · virtual chunks). Returns (start, end)."""
-    best = None
-    i, n = 0, len(layers)
-    while i < n:
-        sig = _param_sig(layers[i])
-        j = i + 1
-        while j < n and _param_sig(layers[j]) == sig:
-            j += 1
-        run = j - i
-        if sig[1] and run >= slots:
-            length = (run // slots) * slots
-            if best is None or length > best[1] - best[0]:
-                best = (i, i + length)
-        i = j
+    """Longest run of consecutive layers whose parameter-signature sequence
+    is PERIODIC (period k ≤ 4; k=1 is the homogeneous case), usable length
+    a multiple of slots·k so every stage holds whole patterns
+    (slots = pp_degree · virtual chunks). Periodic bodies cover the
+    reference's non-uniform stacks — MoE-every-k blocks, Linear/Activation
+    alternations — that a strict homogeneity test would reject.
+    Returns (start, end, period)."""
+    sigs = [_param_sig(l) for l in layers]
+    n = len(layers)
+    best = None          # (usable_len, -period, start)
+    for k in (1, 2, 3, 4):
+        i = 0
+        while i < n:
+            j = i + k
+            while j < n and sigs[j] == sigs[j - k]:
+                j += 1
+            run = j - i
+            unit = slots * k
+            usable = (run // unit) * unit
+            # at least one position must carry params (something to stack)
+            if usable >= unit and any(sigs[i + t][1] for t in range(k)):
+                cand = (usable, -k, i)
+                if best is None or cand > best:
+                    best = cand
+            i = i + 1 if run < unit else j
     if best is None:
         raise _NotPipelineable(
-            f"no homogeneous layer run of length divisible by {slots}")
-    return best
+            f"no periodic layer run of length divisible by {slots}")
+    usable, neg_k, start = best
+    return start, start + usable, -neg_k
 
 
 def _substitute(params, arrays):
@@ -131,13 +156,13 @@ class PipelineParallel(MetaParallelBase):
         return None
 
     def _partition(self):
-        """Split run_function into (prologue, body, epilogue); the body is the
-        homogeneous stack that gets pipelined over pp (round-robin chunked
-        for virtual pp)."""
+        """Split run_function into (prologue, body, epilogue, period); the
+        body is the periodic stack that gets pipelined over pp (round-robin
+        chunked for virtual pp)."""
         layers = list(self._layers.run_function)
         slots = self.num_stages * self.num_virtual
-        b0, b1 = _find_body(layers, slots)
-        return layers[:b0], layers[b0:b1], layers[b1:]
+        b0, b1, period = _find_body(layers, slots)
+        return layers[:b0], layers[b0:b1], layers[b1:], period
 
     def _build_step(self, mesh, key):
         from ....parallel.pipeline import (gpipe, gpipe_interleaved,
@@ -145,11 +170,12 @@ class PipelineParallel(MetaParallelBase):
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
 
-        pro, body, epi = self._partition()
+        pro, body, epi, period = self._partition()
         pp, v = self.num_stages, self.num_virtual
-        lc = len(body) // (pp * v)
-        template = body[0]
-        tparams = template.parameters()
+        lc = len(body) // (pp * v)          # layers per chunk (k | lc)
+        reps = lc // period                 # pattern repeats per chunk
+        templates = body[:period]           # one live layer per position
+        tpar = [list(t.parameters()) for t in templates]
         # every param the prologue/epilogue touch — including tied weights
         # reached via _SharedForward — deduped so each Parameter is exactly
         # one jit argument (a tied weight used in both gets one grad slot
@@ -167,24 +193,33 @@ class PipelineParallel(MetaParallelBase):
         data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.shape)
 
         def stack_body():
-            """[L, ...] per-param stacks -> [P, v, Lc, ...]: global chunk
-            g = c·P + i (reference round-robin) holds layers
-            [g·Lc, (g+1)·Lc)."""
+            """Per pattern-position t, per param k: stacks of the layers at
+            that position -> [P, v, reps, ...]. Global chunk g = c·P + i
+            (reference round-robin) holds layers [g·Lc, (g+1)·Lc); since
+            period | Lc, layer index i has position i % period."""
             out = []
-            for k in range(len(tparams)):
-                a = jnp.stack([lay.parameters()[k]._data for lay in body])
-                a = a.reshape(v, pp, lc, *a.shape[1:])
-                out.append(jnp.moveaxis(a, 1, 0))
+            for t in range(period):
+                pos_layers = body[t::period]
+                pos = []
+                for k in range(len(tpar[t])):
+                    a = jnp.stack([lay.parameters()[k]._data
+                                   for lay in pos_layers])
+                    a = a.reshape(v, pp, reps, *a.shape[1:])
+                    pos.append(jnp.moveaxis(a, 1, 0))
+                out.append(pos)
             return out
 
         def chunk_apply(chunk_arrays, h):
-            def one(h, layer_arrays):
-                old = _substitute(tparams, layer_arrays)
-                try:
-                    with no_grad():
-                        return template(Tensor(h))._data, None
-                finally:
-                    _substitute(tparams, old)
+            # chunk_arrays: [position][param] leaves with leading `reps`
+            def one(h, rep_arrays):
+                for t, template in enumerate(templates):
+                    old = _substitute(tpar[t], rep_arrays[t])
+                    try:
+                        with no_grad():
+                            h = template(Tensor(h))._data
+                    finally:
+                        _substitute(tpar[t], old)
+                return h, None
             h, _ = jax.lax.scan(one, h, chunk_arrays)
             return h
 
@@ -204,15 +239,16 @@ class PipelineParallel(MetaParallelBase):
         # at-rest specs ('mp' from Column/RowParallel, 'sharding' from
         # stage 3) — XLA inserts the per-use all-gathers and the grad
         # reduce-scatters the reference's GroupShardedStage3 hooks code by
-        # hand. Stacked body param k is [P, v, Lc, *shape]: P consumed by
-        # the manual pp spec, [v, Lc] replicated, then the param's own spec.
+        # hand. Stacked body param k of pattern position t is
+        # [P, v, reps, *shape]: P consumed by the manual pp spec,
+        # [v, reps] replicated, then the param's own spec.
         def _stacked_spec(p):
             from ....parallel import _valid_spec
             sp = getattr(p, "sharding_spec", None)
             if sp is None or not _valid_spec(p._data, sp, mesh):
                 return None
             return P(None, None, *sp)
-        stacked_specs = [_stacked_spec(p) for p in tparams]
+        stacked_specs = [[_stacked_spec(p) for p in pos] for pos in tpar]
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P("pp"), P()), out_specs=P(),
@@ -221,11 +257,12 @@ class PipelineParallel(MetaParallelBase):
             # bare PartitionSpecs bind to the CONTEXT mesh (pp is Manual
             # inside this shard_map) — a concrete-mesh NamedSharding here
             # would mismatch axis types and fail to trace
-            local = jax.tree.map(lambda a: a[0], stacked)   # [v, Lc, ...]
+            local = jax.tree.map(lambda a: a[0], stacked)   # [v, reps, ...]
             local = [
-                a if sp is None else
-                jax.lax.with_sharding_constraint(a, sp)
-                for a, sp in zip(local, stacked_specs)]
+                [a if sp is None else
+                 jax.lax.with_sharding_constraint(a, sp)
+                 for a, sp in zip(pos, pos_specs)]
+                for pos, pos_specs in zip(local, stacked_specs)]
             if shard_mb:
                 h_mb = jax.lax.with_sharding_constraint(
                     h_mb, P(None, data_axes,
@@ -255,7 +292,7 @@ class PipelineParallel(MetaParallelBase):
 
         grad_fn = jax.jit(jax.value_and_grad(pure_step, argnums=(0, 1),
                                              has_aux=True))
-        self._pp_cache[key] = (grad_fn, stack_body, seq_params, body, tparams)
+        self._pp_cache[key] = (grad_fn, stack_body, seq_params, body, period)
         return self._pp_cache[key]
 
     def _compiled_pipeline(self, x, y, scaler):
@@ -268,7 +305,7 @@ class PipelineParallel(MetaParallelBase):
         key = (tuple(x_arr.shape), str(x_arr.dtype),
                None if y_arr is None else tuple(y_arr.shape))
         entry = self._pp_cache.get(key) or self._build_step(mesh, key)
-        grad_fn, stack_body, seq_params, body, tparams = entry
+        grad_fn, stack_body, seq_params, body, period = entry
 
         scale = jnp.asarray(1.0 if scaler is None else scaler._scale,
                             jnp.float32)
@@ -283,14 +320,17 @@ class PipelineParallel(MetaParallelBase):
 
         for p, g in zip(seq_params, g_seq):
             add_grad(p, g)
-        pp, v, lc = self.num_stages, self.num_virtual, \
-            len(body) // (self.num_stages * self.num_virtual)
-        for k, gs in enumerate(g_stack):
-            # [P, v, Lc, ...] -> [L, ...] inverse of stack_body
-            flat = jnp.moveaxis(gs, 0, 1).reshape(pp * v * lc,
-                                                  *gs.shape[3:])
-            for li, lay in enumerate(body):
-                add_grad(lay.parameters()[k], flat[li])
+        pp, v = self.num_stages, self.num_virtual
+        lc = len(body) // (pp * v)
+        reps = lc // period
+        for t in range(period):
+            pos_layers = body[t::period]    # ordered (chunk g, repeat r)
+            for k, gs in enumerate(g_stack[t]):
+                # [P, v, reps, ...] -> [g·reps + r, ...] inverse of stack
+                flat = jnp.moveaxis(gs, 0, 1).reshape(pp * v * reps,
+                                                      *gs.shape[3:])
+                for li, lay in enumerate(pos_layers):
+                    add_grad(lay.parameters()[k], flat[li])
         self._pp_cache["_ran"] = True
         return Tensor(loss)
 
