@@ -474,6 +474,11 @@ def main():
         ("vit", bench_vit, "vit_l16_train_images_per_sec_per_chip", 300),
         ("moe", bench_moe, "ernie_moe_ep_tokens_per_sec_per_chip", 240),
     ]
+    only = os.environ.get("BENCH_ONLY")
+    if only:
+        # tuning-sweep mode (tools/tpu_session.sh): headline config only,
+        # skip the four extras so each sweep point costs one compile+run
+        extra_benches = [e for e in extra_benches if e[0] == only]
     configs = []
     partial_path = os.path.join(os.path.dirname(__file__),
                                 "BENCH_partial.json")

@@ -74,6 +74,13 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    from ...nn.layer.layers import in_dynamic_mode
+    if not in_dynamic_mode():
+        # static graph: strategy flags select program-rewrite passes
+        # (reference: fleet._minimize → meta-optimizer pass stack)
+        from .meta_optimizers.static_meta import StaticMetaOptimizer
+        return StaticMetaOptimizer(optimizer, strategy or _get_strategy(),
+                                   _fleet_state.get("hcg"))
     from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
         HybridParallelOptimizer)
     hcg = get_hybrid_communicate_group()

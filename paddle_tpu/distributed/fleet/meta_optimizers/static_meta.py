@@ -1,0 +1,267 @@
+"""Static-graph meta-optimizers: strategy-driven Program rewrites.
+
+Parity: python/paddle/distributed/fleet/meta_optimizers/{amp_optimizer,
+recompute_optimizer,gradient_merge_optimizer,sharding_optimizer,
+lamb_optimizer,lars_optimizer}.py — the reference's static pass zoo rewrites
+ProgramDesc op-by-op (insert cast ops, checkpoint subgraphs, merge loops).
+
+TPU-first: our captured Program is a list of jnp-closure op records, so each
+"pass" is a rewrite at that level instead of protobuf surgery:
+
+- AMP       → cast captured parameters to the AMP dtype wholesale (the pure
+              bf16/fp16 recipe — on TPU bf16 is the MXU-native dtype, so the
+              reference's per-op white/black-list cast insertion degenerates
+              to "run the graph low-precision, keep fp32 masters"), seed fp32
+              master weights from the ORIGINAL fp32 values, and loss-scale
+              through amp.GradScaler for fp16.
+- Recompute → group the op list into segments bounded by user checkpoints;
+              each segment replays as ONE tape node through fleet's
+              ``recompute`` (forward under no_grad, re-run in backward), so
+              live activations scale with segment boundaries, not ops.
+- GradientMerge → k-step micro-batch accumulation around the registered
+              minimize hook (grads accumulate across Executor.run calls;
+              the update fires every k-th run).
+- Sharding  → wrap the inner optimizer in DygraphShardingOptimizer (the same
+              PartitionSpec placement machinery the dygraph path proves).
+- Lamb/Lars → swap the update rule, preserving lr/params/decay.
+
+`fleet.distributed_optimizer(opt, strategy)` returns StaticMetaOptimizer in
+static mode; its `minimize(loss)` applies the stack then registers itself so
+Executor.run drives `_static_apply` each iteration.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....optimizer.optimizer import Lamb, Momentum, Optimizer
+
+
+class LarsMomentum(Momentum):
+    """LARS: layerwise trust-ratio-scaled momentum update.
+
+    Parity: LarsMomentumOptimizer (lars_momentum_op) — local_lr =
+    lr · coeff · ||w|| / (||g|| + λ·||w||), then the momentum rule.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, multi_precision=False):
+        super().__init__(learning_rate, momentum, parameters,
+                         grad_clip=grad_clip, multi_precision=multi_precision)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+
+    def _update_param(self, p, g, lr):
+        m = self._master(p)
+        w32 = m._data.astype(jnp.float32)
+        graw = g._data.astype(jnp.float32)
+        g32 = graw + self._lars_wd * w32
+        wn = jnp.linalg.norm(w32)
+        gn = jnp.linalg.norm(graw)
+        # denominator is ||g|| + λ·||w|| (NOT ||g + λw||, which can cancel
+        # to ~0 near convergence and blow the ratio up unboundedly)
+        trust = jnp.where(
+            (wn > 0) & (gn > 0),
+            self._lars_coeff * wn / (gn + self._lars_wd * wn + 1e-12), 1.0)
+        vel = self._acc("velocity", p)
+        v_new = self._momentum * vel._data + lr * trust * g32
+        vel._data = v_new
+        self._apply(p, w32 - v_new)
+
+
+def _swap_update_rule(inner: Optimizer, strategy):
+    """lamb/lars passes: replace the update rule, keep lr + param list.
+    Parity: LambOptimizer/LarsOptimizer _can_apply → minimize-with-swap."""
+    if strategy.lamb:
+        cfg = getattr(strategy, "lamb_configs", {}) or {}
+        return Lamb(learning_rate=inner._lr,
+                    lamb_weight_decay=float(cfg.get("lamb_weight_decay", 0.01)),
+                    parameters=inner._parameter_list,
+                    grad_clip=inner._grad_clip,
+                    multi_precision=inner._multi_precision)
+    if strategy.lars:
+        cfg = getattr(strategy, "lars_configs", {}) or {}
+        return LarsMomentum(
+            learning_rate=inner._lr,
+            momentum=float(cfg.get("momentum", 0.9)),
+            lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+            lars_weight_decay=float(cfg.get("lars_weight_decay", 0.0005)),
+            parameters=inner._parameter_list,
+            grad_clip=inner._grad_clip,
+            multi_precision=inner._multi_precision)
+    return inner
+
+
+def _apply_amp_pass(program, optimizer, amp_configs):
+    """Pure-low-precision AMP over a captured Program.
+
+    Seeds fp32 masters from the pre-cast values (the reference's
+    cast_model_to_fp16 + master-grad path keeps the fp32 originals too),
+    then casts every captured float32 parameter down. Returns a GradScaler
+    for fp16 (bf16 needs none — its exponent range matches fp32).
+    """
+    dtype = jnp.float16 if (
+        amp_configs.get("dtype") in ("float16", "fp16")
+        or amp_configs.get("use_pure_fp16")) else jnp.bfloat16
+    optimizer._multi_precision = True
+    for p in program.all_parameters():
+        if p.dtype != jnp.float32:
+            continue
+        optimizer._seed_master(p, p._data)
+        p._data = p._data.astype(dtype)
+    if dtype == jnp.float16 and amp_configs.get(
+            "use_dynamic_loss_scaling", True):
+        from ....amp.grad_scaler import GradScaler
+        return GradScaler(
+            init_loss_scaling=float(
+                amp_configs.get("init_loss_scaling", 2.0 ** 15)),
+            incr_every_n_steps=int(
+                amp_configs.get("incr_every_n_steps", 1000)),
+            decr_every_n_nan_or_inf=int(
+                amp_configs.get("decr_every_n_nan_or_inf", 2)))
+    return None
+
+
+def _apply_recompute_pass(program, checkpoints, loss):
+    """Rewrite program.ops into recompute segments bounded by checkpoints.
+
+    checkpoints: Tensors (or their .name strings) marking the activations to
+    KEEP; everything between two checkpoints is re-run during backward.
+    Constraint (same as the reference's recompute pass): fetches must be
+    boundary vars — intermediates inside a segment are freed.
+    """
+    from ....static import _OpRecord, _RecomputeSegment
+
+    ck_uids = set()
+    by_name = {}
+    for op in program.ops:
+        for t in op.inputs:
+            if getattr(t, "name", None):
+                by_name[t.name] = t._uid
+    for c in checkpoints:
+        if isinstance(c, str):
+            if c in by_name:
+                ck_uids.add(by_name[c])
+            else:
+                # a typo'd/unnamed checkpoint must not silently disable
+                # segmentation — the user believes memory is bounded
+                raise ValueError(
+                    f"recompute checkpoint {c!r} does not name any "
+                    f"recorded tensor; known names: {sorted(by_name)[:20]}")
+        else:
+            ck_uids.add(c._uid)
+    loss_uid = loss._uid
+
+    # uid -> index of the last op (or hook) consuming it, for output pruning
+    last_use: dict[int, int] = {}
+    for i, op in enumerate(program.ops):
+        for t in op.inputs:
+            last_use[t._uid] = i
+
+    new_ops: list = []
+    cur: list[_OpRecord] = []
+
+    def _close(end_idx):
+        if not cur:
+            return
+        if len(cur) == 1:
+            new_ops.append(cur[0])
+            cur.clear()
+            return
+        produced = set()
+        for op in cur:
+            produced.update(op.output_ids)
+        ins, seen = [], set()
+        for op in cur:
+            for t in op.inputs:
+                if t._uid not in produced and t._uid not in seen:
+                    seen.add(t._uid)
+                    ins.append(t)
+        outs = [u for u in dict.fromkeys(
+            u for op in cur for u in op.output_ids)
+            if u == loss_uid or u in ck_uids
+            or last_use.get(u, -1) > end_idx]
+        if not outs:  # dead tail segment (e.g. metrics after loss): keep raw
+            new_ops.extend(cur)
+        else:
+            new_ops.append(_RecomputeSegment(cur[:], ins, outs))
+        cur.clear()
+
+    for i, op in enumerate(program.ops):
+        cur.append(op)
+        if any(u in ck_uids or u == loss_uid for u in op.output_ids):
+            _close(i)
+    _close(len(program.ops) - 1)
+    program.ops = new_ops
+
+
+class StaticMetaOptimizer:
+    """fleet.distributed_optimizer(...) in static mode.
+
+    Applies the strategy's pass stack at minimize() time, then registers
+    itself as the program's minimize hook; Executor.run calls
+    `_static_apply(loss)` once per iteration.
+    """
+
+    def __init__(self, optimizer, strategy, hcg=None):
+        self._user_opt = optimizer
+        self._strategy = strategy
+        self._hcg = hcg
+        self._opt = optimizer
+        self._scaler = None
+        self._k_steps = 1
+        self._merge_avg = True
+        self._accum = 0
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ....static import default_main_program
+        program = default_main_program()
+        s = self._strategy
+        opt = _swap_update_rule(self._user_opt, s)
+        if s.recompute:
+            ckpts = s.recompute_configs.get("checkpoints", []) or []
+            if ckpts:
+                _apply_recompute_pass(program, ckpts, loss)
+        if s.amp:
+            self._scaler = _apply_amp_pass(program, opt, s.amp_configs)
+        if s.sharding and s.sharding_configs.get("sharding_degree", 1) > 1:
+            from ..meta_parallel.sharding.group_sharded import (
+                DygraphShardingOptimizer)
+            opt = DygraphShardingOptimizer(opt, self._hcg)
+        if s.gradient_merge:
+            self._k_steps = max(1, int(
+                s.gradient_merge_configs.get("k_steps", 1)))
+            self._merge_avg = bool(s.gradient_merge_configs.get("avg", True))
+        if s.dgc or s.localsgd:
+            raise NotImplementedError(
+                "strategy.dgc/localsgd: gradient compression and periodic "
+                "averaging are GPU-interconnect optimizations; on TPU the "
+                "ICI-scheduled XLA collectives they work around do not "
+                "exist. Unset the flag.")
+        self._opt = opt
+        program._add_minimize(self, loss)
+        return None, None
+
+    # Executor entry point (one training iteration's backward+update)
+    def _static_apply(self, loss):
+        if self._scaler is not None:
+            loss = self._scaler.scale(loss)
+        loss.backward()
+        self._accum += 1
+        if self._accum % self._k_steps:
+            return  # merge phase: keep accumulating, no update
+        if self._k_steps > 1 and self._merge_avg:
+            inv = 1.0 / self._k_steps
+            for p in self._opt._params():
+                if p.grad is not None:
+                    p.grad._data = p.grad._data * inv
+        if self._scaler is not None:
+            self._scaler.step(self._opt)
+            self._scaler.update()
+        else:
+            self._opt.step()
+        self._opt.clear_grad()

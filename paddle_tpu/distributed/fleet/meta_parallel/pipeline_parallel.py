@@ -53,11 +53,12 @@ def _param_sig(layer):
     by construction)."""
     params = tuple((tuple(p.shape), str(p.dtype))
                    for p in layer.parameters())
-    if params:
-        return (type(layer).__qualname__, params)
     cfg = tuple(sorted((k, str(v)) for k, v in vars(layer).items()
                        if isinstance(v, (int, float, bool, str))))
     fn = getattr(layer, "_fn", None)
+    # cfg applies to PARAM-BEARING layers too: same class + same shapes but
+    # a different behavior flag (e.g. act='relu' vs 'gelu') must not match,
+    # or chunk_apply would run the template's forward for both positions
     return (type(layer).__qualname__, params, cfg,
             None if fn is None else id(fn))
 

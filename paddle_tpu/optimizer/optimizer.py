@@ -81,18 +81,24 @@ class Optimizer:
             slot[key] = t
         return slot[key]
 
-    def _master(self, p: Parameter) -> Tensor:
-        """fp32 master weight when multi_precision and p is low-precision."""
-        if not self._multi_precision or p.dtype == jnp.float32:
-            return p
+    def _seed_master(self, p: Parameter, value) -> Tensor:
+        """Create + register the fp32 master slot for ``p`` from ``value``
+        (idempotent). The static AMP pass seeds from the pre-cast fp32
+        weights; the lazy path below seeds from the current values."""
         key = id(p)
         if key not in self._master_weights:
-            t = Tensor(p._data.astype(jnp.float32))
+            t = Tensor(jnp.asarray(value).astype(jnp.float32))
             t.persistable = True
             t.name = f"{p.name}_master"
             register_persistent(t)
             self._master_weights[key] = t
         return self._master_weights[key]
+
+    def _master(self, p: Parameter) -> Tensor:
+        """fp32 master weight when multi_precision and p is low-precision."""
+        if not self._multi_precision or p.dtype == jnp.float32:
+            return p
+        return self._seed_master(p, p._data)
 
     def _params(self) -> list[Parameter]:
         if self._parameter_list is not None:

@@ -64,6 +64,44 @@ class _OpRecord:
         self.output_ids = output_ids
 
 
+class _RecomputeSegment(_OpRecord):
+    """A run of recorded ops replayed as ONE tape node (fleet recompute).
+
+    Built by the static recompute pass (fleet/meta_optimizers/static_meta).
+    inputs = boundary tensors consumed from outside the segment; output_ids
+    = the produced uids that later ops (or the loss) still need. During a
+    training replay the whole segment goes through fleet's ``recompute`` so
+    only boundaries stay live; backward re-runs the inner ops.
+    """
+
+    __slots__ = ("inner_ops",)
+
+    def __init__(self, inner_ops, inputs, output_ids):
+        super().__init__(None, inputs, output_ids)
+        self.inner_ops = inner_ops
+
+    def replay(self, ins, training):
+        from ..tensor.tensor import apply_op
+
+        def seg_fn(*boundary):
+            local = {t._uid: v for t, v in zip(self.inputs, boundary)}
+            for iop in self.inner_ops:
+                iins = [local.get(t._uid, t) for t in iop.inputs]
+                iouts = apply_op(iop.fn, *iins)
+                iouts = iouts if isinstance(iouts, tuple) else (iouts,)
+                for uid, o in zip(iop.output_ids, iouts):
+                    local[uid] = o
+            return tuple(local[u] for u in self.output_ids)
+
+        if training:
+            from ..distributed.fleet.utils.recompute_mod import recompute
+            outs = recompute(seg_fn, *ins)
+        else:
+            with no_grad():
+                outs = seg_fn(*ins)
+        return outs if isinstance(outs, tuple) else (outs,)
+
+
 class Program:
     """Recorded op graph (the reference's ProgramDesc, with jnp closures as
     the op bodies)."""
@@ -247,7 +285,9 @@ class Executor:
             training = bool(program._minimize_hooks)
             for op in program.ops:
                 ins = [env.get(t._uid, t) for t in op.inputs]
-                if training:
+                if isinstance(op, _RecomputeSegment):
+                    outs = op.replay(ins, training)
+                elif training:
                     outs = apply_op(op.fn, *ins)
                 else:
                     with no_grad():
@@ -258,9 +298,14 @@ class Executor:
             for optimizer, loss_uid in program._minimize_hooks:
                 loss = env.get(loss_uid)
                 if loss is not None:
-                    loss.backward()
-                    optimizer.step()
-                    optimizer.clear_grad()
+                    if hasattr(optimizer, "_static_apply"):
+                        # meta-optimizer stack (amp scaling, gradient
+                        # merge, sharding) drives its own backward+update
+                        optimizer._static_apply(loss)
+                    else:
+                        loss.backward()
+                        optimizer.step()
+                        optimizer.clear_grad()
             results = []
             for f in fetch_list:
                 uid = f._uid if isinstance(f, Tensor) else None

@@ -1,0 +1,231 @@
+"""Static meta-optimizer pass stack (fleet.distributed_optimizer in static
+mode). Parity model: python/paddle/distributed/fleet/meta_optimizers/* —
+amp / recompute / gradient_merge / lamb / lars as captured-Program rewrites.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+from paddle_tpu.distributed import fleet
+
+import jax.numpy as jnp
+
+
+def _build_program(seed=0):
+    """y = relu(x@W1)@W2; mse loss vs label. Returns (program, feeds,
+    loss, params, mid) with mid = the hidden activation (checkpoint)."""
+    rng = np.random.default_rng(seed)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 8])
+        label = static.data("label", [4, 2])
+        w1 = paddle.to_tensor(rng.normal(size=(8, 16)).astype("float32") * 0.2)
+        w1.stop_gradient = False
+        w1 = _as_param(w1, "w1")
+        w2 = paddle.to_tensor(rng.normal(size=(16, 2)).astype("float32") * 0.2)
+        w2.stop_gradient = False
+        w2 = _as_param(w2, "w2")
+        mid = F.relu(paddle.matmul(x, w1))
+        y = paddle.matmul(mid, w2)
+        loss = paddle.mean((y - label) ** 2)
+    return main, loss, [w1, w2], mid
+
+
+def _as_param(t, name):
+    from paddle_tpu.tensor.tensor import Parameter
+    return Parameter(t._data, name=name)
+
+
+def _feeds(seed=1):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.normal(size=(4, 8)).astype("float32"),
+            "label": rng.normal(size=(4, 2)).astype("float32")}
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_plain_minimize_baseline():
+    main, loss, params, _ = _build_program()
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=params)
+        opt.minimize(loss)
+    exe = static.Executor()
+    l0 = exe.run(main, feed=_feeds(), fetch_list=[loss])[0]
+    l1 = exe.run(main, feed=_feeds(), fetch_list=[loss])[0]
+    assert float(l1) < float(l0)
+
+
+def test_amp_pass_casts_params_and_keeps_masters():
+    main, loss, params, _ = _build_program()
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+        dist_opt = fleet.distributed_optimizer(opt, strategy)
+        dist_opt.minimize(loss)
+    # pass ran at minimize: params are now bf16, masters seeded fp32
+    for p in params:
+        assert p.dtype == jnp.bfloat16
+        m = opt._master_weights[id(p)]
+        assert m.dtype == jnp.float32
+    exe = static.Executor()
+    l0 = exe.run(main, feed=_feeds(), fetch_list=[loss])[0]
+    l1 = exe.run(main, feed=_feeds(), fetch_list=[loss])[0]
+    assert np.isfinite(float(l0)) and float(l1) < float(l0)
+    # update wrote through master: params still bf16 afterwards
+    assert all(p.dtype == jnp.bfloat16 for p in params)
+
+
+def test_recompute_pass_segments_and_matches_dense():
+    # dense reference run
+    main_a, loss_a, params_a, _ = _build_program(seed=3)
+    with static.program_guard(main_a):
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=params_a).minimize(loss_a)
+    exe = static.Executor()
+    la = [float(exe.run(main_a, feed=_feeds(k), fetch_list=[loss_a])[0])
+          for k in range(3)]
+
+    # recompute run: checkpoint at the hidden activation
+    main_b, loss_b, params_b, mid = _build_program(seed=3)
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs = {"checkpoints": [mid]}
+    with static.program_guard(main_b):
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params_b)
+        fleet.distributed_optimizer(opt, strategy).minimize(loss_b)
+    from paddle_tpu.static import _RecomputeSegment
+    seg_ops = [op for op in main_b.ops if isinstance(op, _RecomputeSegment)]
+    assert seg_ops, "recompute pass produced no segments"
+    assert len(main_b.ops) < 5  # ops grouped, not left op-per-record
+    lb = [float(exe.run(main_b, feed=_feeds(k), fetch_list=[loss_b])[0])
+          for k in range(3)]
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_gradient_merge_k2_matches_full_batch():
+    # two half-batches with k_steps=2+avg == one update on the mean grad
+    feeds = _feeds(7)
+    half0 = {k: v[:2] for k, v in feeds.items()}
+    half1 = {k: v[2:] for k, v in feeds.items()}
+
+    main_a, loss_a, params_a, _ = _build_program(seed=5)
+    with static.program_guard(main_a):
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=params_a).minimize(loss_a)
+    exe = static.Executor()
+    exe.run(main_a, feed=feeds, fetch_list=[loss_a])
+    full_w = [np.asarray(p._data) for p in params_a]
+
+    main_b, loss_b, params_b, _ = _build_program(seed=5)
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    with static.program_guard(main_b):
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params_b)
+        fleet.distributed_optimizer(opt, strategy).minimize(loss_b)
+    w_before = [np.asarray(p._data) for p in params_b]
+    exe.run(main_b, feed=half0, fetch_list=[loss_b])
+    # merge phase: no update yet
+    for p, w0 in zip(params_b, w_before):
+        np.testing.assert_array_equal(np.asarray(p._data), w0)
+    exe.run(main_b, feed=half1, fetch_list=[loss_b])
+    merged_w = [np.asarray(p._data) for p in params_b]
+    # mean-of-half-batch grads == full-batch grad for MSE mean loss
+    for wa, wb in zip(full_w, merged_w):
+        np.testing.assert_allclose(wa, wb, rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_and_lars_swap():
+    from paddle_tpu.distributed.fleet.meta_optimizers.static_meta import (
+        LarsMomentum)
+    main, loss, params, _ = _build_program(seed=9)
+    strategy = fleet.DistributedStrategy()
+    strategy.lamb = True
+    with static.program_guard(main):
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=params)
+        dist = fleet.distributed_optimizer(opt, strategy)
+        dist.minimize(loss)
+    assert type(dist._opt).__name__ == "Lamb"
+    exe = static.Executor()
+    l0 = exe.run(main, feed=_feeds(2), fetch_list=[loss])[0]
+    l1 = exe.run(main, feed=_feeds(2), fetch_list=[loss])[0]
+    assert float(l1) < float(l0)
+
+    main2, loss2, params2, _ = _build_program(seed=9)
+    strategy2 = fleet.DistributedStrategy()
+    strategy2.lars = True
+    with static.program_guard(main2):
+        opt2 = paddle.optimizer.Momentum(learning_rate=0.1,
+                                         parameters=params2)
+        dist2 = fleet.distributed_optimizer(opt2, strategy2)
+        dist2.minimize(loss2)
+    assert isinstance(dist2._opt, LarsMomentum)
+    l0 = exe.run(main2, feed=_feeds(2), fetch_list=[loss2])[0]
+    l1 = exe.run(main2, feed=_feeds(2), fetch_list=[loss2])[0]
+    assert float(l1) < float(l0)
+
+
+def test_sharding_pass_wraps_and_trains():
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded \
+        import DygraphShardingOptimizer
+    main, loss, params, _ = _build_program(seed=17)
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = {"sharding_degree": 2, "stage": 1}
+    # hcg=None path: distributed_optimizer must work without fleet.init
+    with static.program_guard(main):
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=params)
+        dist = fleet.distributed_optimizer(opt, strategy)
+        dist.minimize(loss)
+    assert isinstance(dist._opt, DygraphShardingOptimizer)
+    exe = static.Executor()
+    l0 = exe.run(main, feed=_feeds(4), fetch_list=[loss])[0]
+    l1 = exe.run(main, feed=_feeds(4), fetch_list=[loss])[0]
+    assert float(l1) < float(l0)
+
+
+def test_recompute_unknown_checkpoint_raises():
+    main, loss, params, _ = _build_program(seed=19)
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs = {"checkpoints": ["no_such_tensor"]}
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params)
+        with pytest.raises(ValueError, match="no_such_tensor"):
+            fleet.distributed_optimizer(opt, strategy).minimize(loss)
+
+
+def test_dgc_raises_loudly():
+    main, loss, params, _ = _build_program(seed=11)
+    strategy = fleet.DistributedStrategy()
+    strategy.dgc = True
+    with static.program_guard(main):
+        opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=params)
+        with pytest.raises(NotImplementedError, match="dgc"):
+            fleet.distributed_optimizer(opt, strategy).minimize(loss)
+
+
+def test_amp_with_gradient_merge_composition():
+    main, loss, params, _ = _build_program(seed=13)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+        fleet.distributed_optimizer(opt, strategy).minimize(loss)
+    exe = static.Executor()
+    losses = [float(exe.run(main, feed=_feeds(k), fetch_list=[loss])[0])
+              for k in range(4)]
+    assert all(np.isfinite(losses))
+    assert all(p.dtype == jnp.bfloat16 for p in params)

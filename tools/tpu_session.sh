@@ -1,0 +1,34 @@
+#!/bin/bash
+# One-shot TPU measurement session. Run when the tunnel is up; every phase is
+# timeboxed so a mid-session outage can't wedge the driver. Results land in
+# /tmp/tpu_session/. Order is by value-per-minute: headline ratchet first.
+set -u
+OUT=${1:-/tmp/tpu_session}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) ===" | tee -a "$OUT/session.log"
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "exit=$? $(tail -c 400 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
+}
+
+# 1. Headline bench, all five configs (writes BENCH_partial.json as it goes)
+run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
+cp BENCH_partial.json "$OUT/" 2>/dev/null
+
+# 2. Donation A/B on the headline config only (historically hung the tunnel
+#    backend — hard 600s timeout; a hang here must not eat the session)
+run bench_donate 600 env PADDLE_TPU_DONATE=1 BENCH_ONLY=gpt2 python bench.py
+
+# 3. Flash block sweep (fwd+bwd step time under each tiling)
+for bq in 256 512 1024; do for bk in 256 512 1024; do
+  run "sweep_${bq}x${bk}" 420 env PADDLE_TPU_FLASH_BQ=$bq PADDLE_TPU_FLASH_BK=$bk \
+      BENCH_ONLY=gpt2 BENCH_STEPS=30 python bench.py
+done; done
+
+# 4. Decode ratchet
+run bench_decode 900 python bench_decode.py
+
+echo "session complete; grep tokens_per_sec $OUT/*.log" | tee -a "$OUT/session.log"
