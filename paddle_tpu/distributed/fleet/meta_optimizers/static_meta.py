@@ -104,7 +104,10 @@ def _apply_amp_pass(program, optimizer, amp_configs):
         amp_configs.get("dtype") in ("float16", "fp16")
         or amp_configs.get("use_pure_fp16")) else jnp.bfloat16
     optimizer._multi_precision = True
-    for p in program.all_parameters():
+    # scope: the optimizer's own params (a user list, or the global set for
+    # list-less optimizers) — NOT program.all_parameters(), which reads the
+    # process-global registry and would downcast co-resident models
+    for p in optimizer._params():
         if p.dtype != jnp.float32:
             continue
         optimizer._seed_master(p, p._data)

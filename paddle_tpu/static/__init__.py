@@ -307,8 +307,27 @@ class Executor:
                         optimizer.step()
                         optimizer.clear_grad()
             results = []
+            pruned = None
             for f in fetch_list:
                 uid = f._uid if isinstance(f, Tensor) else None
+                if uid is not None and uid not in env:
+                    # the env fallback below rightly serves live refs
+                    # (params, captured constants) — but an op OUTPUT
+                    # missing from env was recompute-pruned, and silently
+                    # returning its stale capture-time value is wrong data
+                    if pruned is None:
+                        pruned = set()
+                        for op in program.ops:
+                            if isinstance(op, _RecomputeSegment):
+                                inner = {u for i in op.inner_ops
+                                         for u in i.output_ids}
+                                pruned |= inner - set(op.output_ids)
+                    if uid in pruned:
+                        raise RuntimeError(
+                            f"fetch target {getattr(f, 'name', uid)!r} is "
+                            "an intermediate inside a recompute segment "
+                            "and was freed; fetch checkpoint/boundary "
+                            "variables or disable strategy.recompute")
                 out = env.get(uid, f if isinstance(f, Tensor) else None)
                 if out is None:
                     results.append(None)

@@ -111,6 +111,41 @@ def test_recompute_pass_segments_and_matches_dense():
     np.testing.assert_allclose(la, lb, rtol=1e-5)
 
 
+def test_recompute_pruned_fetch_raises():
+    main, loss, params, mid = _build_program(seed=21)
+    with static.program_guard(main):
+        # y (the pre-loss matmul output) lives INSIDE the tail segment
+        y_holder = main.ops[-2]  # matmul producing pred
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs = {"checkpoints": [mid]}
+    with static.program_guard(main):
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params)
+        fleet.distributed_optimizer(opt, strategy).minimize(loss)
+    exe = static.Executor()
+    # fetching a freed intermediate must raise, not return stale data
+    class _Fake:
+        pass
+    fake = _Fake()
+    fake._uid = y_holder.output_ids[0]
+    from paddle_tpu.tensor.tensor import Tensor
+
+    pruned_uid = None
+    from paddle_tpu.static import _RecomputeSegment
+    for op in main.ops:
+        if isinstance(op, _RecomputeSegment):
+            inner = {u for i in op.inner_ops for u in i.output_ids}
+            dropped = inner - set(op.output_ids)
+            if dropped:
+                pruned_uid = next(iter(dropped))
+    if pruned_uid is None:
+        pytest.skip("no pruned intermediate in this segmentation")
+    probe = Tensor(np.zeros((1,), np.float32))
+    probe._uid = pruned_uid
+    with pytest.raises(RuntimeError, match="recompute segment"):
+        exe.run(main, feed=_feeds(0), fetch_list=[probe])
+
+
 def test_gradient_merge_k2_matches_full_batch():
     # two half-batches with k_steps=2+avg == one update on the mean grad
     feeds = _feeds(7)

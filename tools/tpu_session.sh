@@ -22,10 +22,13 @@ cp BENCH_partial.json "$OUT/" 2>/dev/null
 #    backend — hard 600s timeout; a hang here must not eat the session)
 run bench_donate 600 env PADDLE_TPU_DONATE=1 BENCH_ONLY=gpt2 python bench.py
 
-# 3. Flash block sweep (fwd+bwd step time under each tiling)
+# 3. Flash block sweep (fwd+bwd step time under each tiling).
+#    BENCH_DONATE_PROBE=0 pins every point undonated: the 1h verdict cache
+#    can expire mid-sweep and a re-probe would eat the point's timeout and
+#    flip the A/B mode between tilings.
 for bq in 256 512 1024; do for bk in 256 512 1024; do
   run "sweep_${bq}x${bk}" 420 env PADDLE_TPU_FLASH_BQ=$bq PADDLE_TPU_FLASH_BK=$bk \
-      BENCH_ONLY=gpt2 BENCH_STEPS=30 python bench.py
+      BENCH_DONATE_PROBE=0 BENCH_ONLY=gpt2 BENCH_STEPS=30 python bench.py
 done; done
 
 # 4. Decode ratchet
