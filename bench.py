@@ -462,6 +462,8 @@ def bench_vit(on_tpu, peak_tflops):
 
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype(np.float32))
+    if on_tpu:
+        x = x.astype("bfloat16")   # match the bf16 params: conv on the MXU
     y = paddle.to_tensor(rng.randint(
         0, 10, (batch,)).astype(np.int32))
 

@@ -18,6 +18,21 @@ import threading
 import jax
 
 
+def _impl() -> str:
+    """PRNG implementation for all framework keys. Default "rbg": threefry
+    split/fold semantics, but the bit draws lower to XLA RngBitGenerator —
+    the TPU's hardware generator, far cheaper than threefry's ALU rounds and
+    fusable into the consuming elementwise op (dropout masks cost ~0 extra
+    HBM). Override with PADDLE_TPU_PRNG_IMPL=threefry2x32 for JAX-default
+    bitstreams."""
+    import os
+    return os.environ.get("PADDLE_TPU_PRNG_IMPL", "rbg")
+
+
+def _key(s: int):
+    return jax.random.key(int(s), impl=_impl())
+
+
 class _RngState(threading.local):
     def __init__(self):
         self.key_tensor = None
@@ -26,7 +41,7 @@ class _RngState(threading.local):
     def ensure(self):
         if self.key_tensor is None:
             from ..tensor.tensor import Tensor, register_persistent
-            self.key_tensor = Tensor(jax.random.key(0))
+            self.key_tensor = Tensor(_key(0))
             self.key_tensor.name = "global_rng_key"
             self.key_tensor.persistable = True
             register_persistent(self.key_tensor)
@@ -38,7 +53,7 @@ _rng = _RngState()
 
 def seed(s: int):
     t = _rng.ensure()
-    t._data = jax.random.key(int(s))
+    t._data = _key(s)
     _rng.seed_value = int(s)
     return t
 
@@ -55,6 +70,17 @@ def next_key():
     return k2
 
 
+def next_threefry_key():
+    """Fresh subkey guaranteed to be threefry — for the few jax.random
+    samplers (poisson) not implemented for the rbg impl. Derived
+    deterministically from the global stream regardless of its impl."""
+    k = next_key()
+    if str(jax.random.key_impl(k)) == "threefry2x32":
+        return k
+    bits = jax.random.bits(k, (2,), "uint32")
+    return jax.random.wrap_key_data(bits, impl="threefry2x32")
+
+
 def get_rng_state():
     return _rng.ensure()._data
 
@@ -62,7 +88,7 @@ def get_rng_state():
 def set_rng_state(state):
     t = _rng.ensure()
     if isinstance(state, int):
-        t._data = jax.random.key(state)
+        t._data = _key(state)
     else:
         t._data = state
 
@@ -88,7 +114,7 @@ class RNGStatesTracker:
         if name in self.states_:
             raise ValueError(f"state {name} already exists")
         self.seeds_.add(seed_)
-        self.states_[name] = jax.random.key(int(seed_))
+        self.states_[name] = _key(seed_)
 
     def get_states_tracker(self):
         return dict(self.states_)

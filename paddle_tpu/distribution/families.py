@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.rng import next_key
+from ..core.rng import next_key, next_threefry_key
 from ..tensor.tensor import Tensor
 
 __all__ = ["Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
@@ -308,7 +308,7 @@ class Poisson(Distribution):
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.rate.shape
-        return Tensor(jax.random.poisson(next_key(), self.rate,
+        return Tensor(jax.random.poisson(next_threefry_key(), self.rate,
                                          shape).astype(jnp.float32))
 
     def log_prob(self, value):

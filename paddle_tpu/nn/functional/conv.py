@@ -14,6 +14,15 @@ __all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
            "conv2d_transpose", "conv3d_transpose"]
 
 
+def _promote(a, w):
+    """lax.conv requires equal dtypes; apply numpy-style promotion to match
+    the jnp.dot path in Linear instead of raising."""
+    if a.dtype != w.dtype:
+        ct = jnp.result_type(a, w)
+        a, w = a.astype(ct), w.astype(ct)
+    return a, w
+
+
 def _tuple(v, n):
     if isinstance(v, int):
         return (v,) * n
@@ -50,6 +59,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
     def f(a, w, *b):
         from ...amp.auto_cast import cast_if_amp
         a, w = cast_if_amp("conv", a, w)
+        a, w = _promote(a, w)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
@@ -105,6 +115,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             pads.append((eff - p[i][0], eff - p[i][1] + opad[i]))
 
     def f(a, w, *b):
+        a, w = _promote(a, w)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=(1,) * n, padding=pads,
             lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
@@ -122,6 +133,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
 
     if groups > 1:
         def fg(a, w, *b):
+            a, w = _promote(a, w)
             a_gs = jnp.split(a, groups, axis=1)
             w_gs = jnp.split(w, groups, axis=0)
             outs = []

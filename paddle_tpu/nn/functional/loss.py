@@ -28,25 +28,52 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
 
     def core(logits, *w):
+        # HBM discipline: the hard-label softmax path never materializes the
+        # full log-softmax (or a one-hot) over the class axis — for an LM
+        # head that array is [B*S, vocab] fp32, several GB of traffic per
+        # step. loss = logsumexp(row) - logit[label]; autodiff of logsumexp
+        # regenerates softmax inside the same fusion.
         lg = logits.astype(jnp.float32)
-        if use_softmax:
-            logp = jax.nn.log_softmax(lg, axis=axis)
-        else:
-            logp = jnp.log(jnp.clip(lg, 1e-15, None))
-        n_class = logp.shape[axis]
+        n_class = lg.shape[axis]
         if soft_label:
             tgt = lab.astype(jnp.float32)
             if label_smoothing > 0:
                 tgt = (1 - label_smoothing) * tgt + label_smoothing / n_class
-            loss = -jnp.sum(tgt * logp, axis=axis)
+            if use_softmax:
+                lse = jax.scipy.special.logsumexp(lg, axis=axis)
+                loss = lse * jnp.sum(tgt, axis=axis) \
+                    - jnp.sum(tgt * lg, axis=axis)
+            else:
+                logp = jnp.log(jnp.clip(lg, 1e-15, None))
+                loss = -jnp.sum(tgt * logp, axis=axis)
         else:
             ids = lab
-            if ids.ndim == logp.ndim:
+            if ids.ndim == lg.ndim:
                 ids = jnp.squeeze(ids, axis=axis)
-            onehot = jax.nn.one_hot(ids, n_class, dtype=logp.dtype, axis=axis)
-            if label_smoothing > 0:
-                onehot = (1 - label_smoothing) * onehot + label_smoothing / n_class
-            loss = -jnp.sum(onehot * logp, axis=axis)
+            if not jnp.issubdtype(ids.dtype, jnp.integer):
+                ids = ids.astype(jnp.int32)   # one_hot accepted float labels
+            # out-of-range labels (e.g. -1 padding when ignore_index is the
+            # default -100) match one_hot semantics: zero hard-label term,
+            # smoothing term still applies; they stay in the mean denominator
+            in_range = (ids >= 0) & (ids < n_class)
+            safe = jnp.clip(ids, 0, n_class - 1)
+
+            def _gather(arr):
+                return jnp.squeeze(jnp.take_along_axis(
+                    arr, jnp.expand_dims(safe, axis), axis=axis), axis=axis)
+
+            if use_softmax:
+                lse = jax.scipy.special.logsumexp(lg, axis=axis)
+                loss = jnp.where(in_range, lse - _gather(lg), 0.0)
+                if label_smoothing > 0:
+                    loss = (1 - label_smoothing) * loss + label_smoothing * (
+                        lse - jnp.mean(lg, axis=axis))
+            else:
+                logp = jnp.log(jnp.clip(lg, 1e-15, None))
+                loss = jnp.where(in_range, -_gather(logp), 0.0)
+                if label_smoothing > 0:
+                    loss = (1 - label_smoothing) * loss - label_smoothing * \
+                        jnp.mean(logp, axis=axis)
             valid = (ids != ignore_index)
             loss = jnp.where(valid, loss, 0.0)
             if w:

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dtype import convert_dtype, get_default_dtype
-from ..core.rng import next_key
+from ..core.rng import next_key, next_threefry_key
 from .creation import _shape
 from .tensor import Tensor
 
@@ -82,7 +82,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 
 
 def poisson(x, name=None):
-    return Tensor(jax.random.poisson(next_key(), x._data).astype(x.dtype))
+    return Tensor(jax.random.poisson(next_threefry_key(), x._data).astype(x.dtype))
 
 
 def exponential_(x, lam=1.0, name=None):

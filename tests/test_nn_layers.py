@@ -177,6 +177,27 @@ class TestLosses:
         expect = per[[0, 2]].mean()
         np.testing.assert_allclose(loss, expect, rtol=1e-5)
 
+    def test_cross_entropy_out_of_range_and_float_labels(self):
+        # one_hot semantics: an out-of-range label (e.g. -1 padding while
+        # ignore_index stays -100) contributes zero hard-label loss but
+        # stays in the mean denominator; float-dtype hard labels work
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([1, -1, 2, 7])           # -1 and 7 out of range
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels)).numpy()
+        m = logits.max(-1, keepdims=True)
+        lse = m + np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        per = lse.squeeze(-1) - logits[np.arange(4), np.clip(labels, 0, 2)]
+        per[[1, 3]] = 0.0
+        np.testing.assert_allclose(loss, per.mean(), rtol=1e-5)
+
+        flabels = np.array([1.0, 0.0, 2.0, 1.0], np.float32)
+        lf = F.cross_entropy(paddle.to_tensor(logits),
+                             paddle.to_tensor(flabels)).numpy()
+        li = F.cross_entropy(paddle.to_tensor(logits),
+                             paddle.to_tensor(flabels.astype(np.int32))).numpy()
+        np.testing.assert_allclose(lf, li, rtol=1e-6)
+
     def test_mse_l1(self):
         a = np.random.randn(4, 3).astype(np.float32)
         b = np.random.randn(4, 3).astype(np.float32)

@@ -1,0 +1,244 @@
+"""Per-op time breakdown of the headline GPT-2 train step.
+
+Runs the bench-identical step under jax.profiler.trace and aggregates the
+device-track op durations from the perfetto JSON the profiler writes, so
+kernel work (matmul fusions, attention, copies, collectives) can be ranked
+by per-step cost. Falls back to ablation timing (variants of the step with
+parts removed) when the backend produces no usable trace.
+
+Usage:  python tools/tpu_profile.py [outdir]
+Env:    PROF_STEPS (default 10), PROF_MODE=trace|ablate|both (default both),
+        BENCH_BATCH/BENCH_SEQ as in bench.py.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("PROF_CPU") == "1":
+    # The container bakes JAX_PLATFORMS=axon in and sitecustomize registers
+    # the tunnel plugin; only the jax.config override reliably wins. Must
+    # happen before any backend init or the tool steals the exclusive TPU
+    # grant from a concurrently-running bench.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=1").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _build_step(donate):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
+
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    paddle.seed(0)
+    model = gpt2_tiny() if os.environ.get("PROF_MODEL") == "tiny" \
+        else gpt2_124m()
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
+    x = paddle.to_tensor(ids[:, :-1])
+    y = paddle.to_tensor(ids[:, 1:])
+
+    def _step(x, y):
+        loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(_step, donate_state=donate)
+    return step, x, y, batch * seq
+
+
+def _drain(loss):
+    return float(np.asarray(loss._data))
+
+
+def profile_trace(outdir, steps):
+    import jax
+    step, x, y, _ = _build_step(donate=os.environ.get(
+        "PADDLE_TPU_DONATE", "1") == "1")
+    for _ in range(3):
+        loss = step(x, y)
+    _drain(loss)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            loss = step(x, y)
+        _drain(loss)
+    wall = (time.perf_counter() - t0) / steps
+    print(f"profiled {steps} steps, {wall * 1e3:.1f} ms/step wall",
+          file=sys.stderr)
+
+    paths = glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True)
+    if not paths:
+        print("no trace json produced", file=sys.stderr)
+        return None
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+
+    # device-track pids: process_name metadata containing TPU/device
+    dev_pids = set()
+    names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            nm = ev.get("args", {}).get("name", "")
+            names[ev.get("pid")] = nm
+            if any(k in nm.lower() for k in ("tpu", "device")):
+                dev_pids.add(ev.get("pid"))
+    by_cat = defaultdict(lambda: [0.0, 0.0, 0.0])  # ms, flops, bytes
+    by_op = defaultdict(lambda: [0.0, 0.0, "", ""])  # ms, flops, tf_op, src
+    total = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in dev_pids:
+            continue
+        a = ev.get("args", {})
+        dur = ev.get("dur", 0) / 1e3  # us -> ms
+        cat = a.get("hlo_category", "?")
+        fl = float(a.get("model_flops", 0) or 0)
+        by_cat[cat][0] += dur
+        by_cat[cat][1] += fl
+        by_cat[cat][2] += float(a.get("raw_bytes_accessed", 0) or 0)
+        # strip trailing .N so repeated instances of one HLO aggregate
+        base = ev.get("name", "?").rsplit(".", 1)[0]
+        rec = by_op[base]
+        rec[0] += dur
+        rec[1] += fl
+        if not rec[2]:
+            rec[2] = a.get("tf_op", "")
+            rec[3] = a.get("source", "")
+        total += dur
+    print(f"\n== by hlo_category over {steps} steps "
+          f"(tracks: {sorted(names[p] for p in dev_pids)}) ==")
+    for cat, (ms, fl, by) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
+        tf = fl / (ms * 1e-3) / 1e12 if ms else 0
+        gb = by / (ms * 1e-3) / 1e9 if ms else 0
+        print(f"{ms / steps:9.3f} ms/step {ms / max(total, 1e-9) * 100:5.1f}%"
+              f"  {tf:7.1f} TF/s {gb:8.1f} GB/s  {cat}")
+    print(f"{total / steps:9.3f} ms/step  TOTAL device time")
+    print(f"\n== top ops ==")
+    rows = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:25]
+    for name, (ms, fl, tf_op, src) in rows:
+        tfs = fl / (ms * 1e-3) / 1e12 if ms else 0
+        print(f"{ms / steps:9.3f} ms/step {tfs:7.1f} TF/s  {name[:40]:40s}"
+              f" {tf_op[:60]:60s} {src.replace('/root/repo/', '')[:50]}")
+    return {"wall_ms": wall * 1e3, "device_ms": total / steps,
+            "by_cat": {c: [v / steps for v in vals[:1]] + vals[1:]
+                       for c, vals in by_cat.items()},
+            "top": [[n, v[0] / steps, v[2], v[3]] for n, v in rows]}
+
+
+def profile_ablate(steps):
+    """Ablation timing: build step variants with pieces disabled and diff
+    the medians. Robust when the profiler can't see the tunnel device."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt2_124m
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
+
+    def timed(make_step):
+        paddle.seed(0)
+        model = gpt2_124m()
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+        x = paddle.to_tensor(ids[:, :-1])
+        y = paddle.to_tensor(ids[:, 1:])
+        step = paddle.jit.to_static(make_step(model, opt),
+                                    donate_state=False)
+        for _ in range(3):
+            loss = step(x, y)
+        _drain(loss)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(x, y)
+            _drain(loss)
+            ts.append((time.perf_counter() - t0) / steps)
+        return float(np.median(ts)) * 1e3
+
+    def full(model, opt):
+        def f(x, y):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return f
+
+    def no_opt(model, opt):  # fwd+bwd only
+        def f(x, y):
+            loss = model(x, labels=y)
+            loss.backward()
+            return loss
+        return f
+
+    def fwd_only(model, opt):
+        def f(x, y):
+            return model(x, labels=y)
+        return f
+
+    def fwd_no_ce(model, opt):  # body without LM head + CE
+        def f(x, y):
+            h = model.gpt(x)
+            return h.sum()
+        return f
+
+    out = {}
+    for name, mk in [("full", full), ("fwd+bwd", no_opt),
+                     ("fwd", fwd_only), ("fwd_no_ce", fwd_no_ce)]:
+        out[name] = timed(mk)
+        print(f"{name:10s} {out[name]:8.2f} ms/step", file=sys.stderr)
+    print("\n== ablation deltas ==")
+    print(f"optimizer+writeback : {out['full'] - out['fwd+bwd']:8.2f} ms")
+    print(f"backward            : {out['fwd+bwd'] - out['fwd']:8.2f} ms")
+    print(f"LM head + CE (fwd)  : {out['fwd'] - out['fwd_no_ce']:8.2f} ms")
+    print(f"body fwd            : {out['fwd_no_ce']:8.2f} ms")
+    print(f"full step           : {out['full']:8.2f} ms")
+    return out
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/paddle_tpu_prof"
+    os.makedirs(outdir, exist_ok=True)
+    steps = int(os.environ.get("PROF_STEPS", "10"))
+    mode = os.environ.get("PROF_MODE", "both")
+    rec = {}
+    if mode in ("trace", "both"):
+        try:
+            rec["trace"] = profile_trace(outdir, steps)
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            print(f"trace profiling failed: {e}", file=sys.stderr)
+    if mode in ("ablate", "both"):
+        rec["ablate"] = profile_ablate(steps)
+    with open(os.path.join(outdir, "summary.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
