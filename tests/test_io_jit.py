@@ -194,6 +194,61 @@ class TestToStatic:
         np.testing.assert_allclose(eager_losses, jit_losses, rtol=1e-4,
                                    atol=1e-5)
 
+    def test_run_steps_matches_sequential(self):
+        """k steps in one scanned device program == k sequential compiled
+        calls: same per-step losses, same final params, state written back."""
+        def build():
+            paddle.seed(11)
+            m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+            o = paddle.optimizer.AdamW(learning_rate=0.01,
+                                       parameters=m.parameters())
+
+            @paddle.jit.to_static
+            def step(x, y):
+                l = paddle.nn.functional.mse_loss(m(x), y)
+                l.backward()
+                o.step()
+                o.clear_grad()
+                return l
+            return m, step
+
+        rng = np.random.RandomState(3)
+        xs = rng.randn(5, 6, 4).astype(np.float32)
+        ys = rng.randn(5, 6, 2).astype(np.float32)
+
+        m1, s1 = build()
+        seq = [float(s1(paddle.to_tensor(xs[i]),
+                        paddle.to_tensor(ys[i])).numpy()) for i in range(5)]
+
+        m2, s2 = build()
+        first = float(s2(paddle.to_tensor(xs[0]),
+                         paddle.to_tensor(ys[0])).numpy())
+        outs = s2.run_steps(4, paddle.to_tensor(xs[1:]),
+                            paddle.to_tensor(ys[1:]))
+        got = [first] + [float(v) for v in np.asarray(outs._data)]
+        np.testing.assert_allclose(seq, got, rtol=1e-5, atol=1e-6)
+        for p, q in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(np.asarray(p._data),
+                                       np.asarray(q._data),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_run_steps_unsteady_state_raises(self):
+        paddle.seed(12)
+        m = nn.Linear(3, 3)
+        o = paddle.optimizer.AdamW(learning_rate=0.01,
+                                   parameters=m.parameters())
+
+        @paddle.jit.to_static
+        def step(x):
+            l = m(x).sum()
+            l.backward()
+            o.step()
+            o.clear_grad()
+            return l
+        xs = paddle.to_tensor(np.ones((3, 2, 3), np.float32))
+        with pytest.raises(RuntimeError, match="persistent state"):
+            step.run_steps(3, xs)
+
     def test_dropout_differs_across_jit_calls(self):
         """RNG key threads through the compiled step as state — two calls
         must produce different masks (trace-time constant would repeat)."""

@@ -38,32 +38,80 @@ if os.environ.get("PROF_CPU") == "1":
 
 
 def _build_step(donate):
+    """Bench-identical train step for PROF_MODEL ∈ {gpt2 (default), tiny,
+    bert, llama}; returns (step, args...) matching bench.py's shapes."""
     import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
 
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    target = os.environ.get("PROF_MODEL", "gpt2")
     paddle.seed(0)
-    model = gpt2_tiny() if os.environ.get("PROF_MODEL") == "tiny" \
-        else gpt2_124m()
-    model.bfloat16()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters(),
-                                 multi_precision=True)
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
-    x = paddle.to_tensor(ids[:, :-1])
-    y = paddle.to_tensor(ids[:, 1:])
+    if target == "bert":
+        from paddle_tpu.models.bert import BertForPretraining, bert_base
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
+        seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
+        model = BertForPretraining(bert_base())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+        vocab = model._layers.config.vocab_size if hasattr(model, "_layers") \
+            else model.config.vocab_size
+        ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+        labels = ids.copy()
+        labels[rng.rand(*labels.shape) > 0.15] = -100
+        args = (paddle.to_tensor(ids), paddle.to_tensor(labels),
+                paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32)))
 
-    def _step(x, y):
-        loss = model(x, labels=y)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+        def _step(x, y, nsp):
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = model(x, masked_lm_labels=y, next_sentence_labels=nsp)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+    elif target == "llama":
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        c = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=16,
+                        num_heads=16, intermediate_size=2816,
+                        max_position=1024)
+        batch, seq = 8, 1024
+        model = LlamaForCausalLM(c)
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+        ids = rng.randint(0, c.vocab_size, (batch, seq + 1)).astype(np.int32)
+        args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
+
+        def _step(x, y):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+    else:
+        from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        model = gpt2_tiny() if target == "tiny" else gpt2_124m()
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+        ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
+        args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
+
+        def _step(x, y):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
 
     step = paddle.jit.to_static(_step, donate_state=donate)
-    return step, x, y, batch * seq
+    return step, args, batch * seq
 
 
 def _drain(loss):
@@ -72,15 +120,15 @@ def _drain(loss):
 
 def profile_trace(outdir, steps):
     import jax
-    step, x, y, _ = _build_step(donate=os.environ.get(
+    step, args, _ = _build_step(donate=os.environ.get(
         "PADDLE_TPU_DONATE", "1") == "1")
     for _ in range(3):
-        loss = step(x, y)
+        loss = step(*args)
     _drain(loss)
     t0 = time.perf_counter()
     with jax.profiler.trace(outdir):
         for _ in range(steps):
-            loss = step(x, y)
+            loss = step(*args)
         _drain(loss)
     wall = (time.perf_counter() - t0) / steps
     print(f"profiled {steps} steps, {wall * 1e3:.1f} ms/step wall",
@@ -142,7 +190,9 @@ def profile_trace(outdir, steps):
         print(f"{ms / steps:9.3f} ms/step {tfs:7.1f} TF/s  {name[:40]:40s}"
               f" {tf_op[:60]:60s} {src.replace('/root/repo/', '')[:50]}")
     return {"wall_ms": wall * 1e3, "device_ms": total / steps,
-            "by_cat": {c: [v / steps for v in vals[:1]] + vals[1:]
+            "by_cat": {c: {"ms_per_step": vals[0] / steps,
+                           "flops_per_step": vals[1] / steps,
+                           "bytes_per_step": vals[2] / steps}
                        for c, vals in by_cat.items()},
             "top": [[n, v[0] / steps, v[2], v[3]] for n, v in rows]}
 

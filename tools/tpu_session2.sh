@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-3 second-window TPU session. Priorities (value/minute):
+#   1. headline re-measure with the new CE + rbg PRNG (donated default)
+#   2. scan-steps A/B (run_steps(8): per-dispatch RPC amortization)
+#   3. per-op trace profiles: gpt2 + bert (names the next bottleneck)
+#   4. flash block sweep (reduced grid)
+#   5. decode ratchet, MoE isolated (wedge risk contained)
+# Each phase timeboxed; BENCH_partial.json checkpoints inside bench.py.
+set -u
+OUT=${1:-/tmp/tpu_session2}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) $(date +%H:%M:%S) ===" | tee -a "$OUT/session.log"
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "exit=$? $(tail -c 400 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
+}
+
+# 1. headline + bert + llama + vit (new CE/rbg); moe EXCLUDED (isolated at 6)
+run bench_main 1800 env BENCH_BUDGET_S=1200 BENCH_SKIP=moe python bench.py
+cp BENCH_partial.json "$OUT/bench_main.json" 2>/dev/null
+
+# 2. scan A/B on the headline config
+run bench_scan 700 env BENCH_SCAN=8 BENCH_ONLY=none BENCH_STEPS=24 python bench.py
+
+# 3. trace profiles (per-op table to stderr→log; summary.json per target)
+run prof_gpt2 700 env PROF_STEPS=10 PROF_MODE=trace python tools/tpu_profile.py "$OUT/prof_gpt2"
+run prof_bert 700 env PROF_MODEL=bert PROF_STEPS=10 PROF_MODE=trace python tools/tpu_profile.py "$OUT/prof_bert"
+
+# 4. flash block sweep (reduced: diagonal + the two asymmetric best-bets)
+for pt in "256 256" "512 512" "1024 1024" "512 1024" "256 512"; do
+  set -- $pt
+  run "sweep_$1x$2" 420 env PADDLE_TPU_FLASH_BQ=$1 PADDLE_TPU_FLASH_BK=$2 \
+      BENCH_DONATE_PROBE=0 BENCH_ONLY=none BENCH_STEPS=30 python bench.py
+done
+
+# 5. decode ratchet
+run bench_decode 900 python bench_decode.py
+
+# 6. MoE isolated (wedged last session when the tunnel dropped mid-compile)
+run bench_moe 900 env BENCH_ONLY=moe BENCH_DONATE_PROBE=0 python bench.py
+
+echo "session complete $(date +%H:%M:%S); grep -h tokens_per_sec $OUT/*.log" | tee -a "$OUT/session.log"
