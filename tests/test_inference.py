@@ -94,3 +94,61 @@ class TestGeneration:
         out = generate(model, paddle.to_tensor(x), max_new_tokens=8,
                        eos_token_id=eos)
         assert out.shape[1] <= 4
+
+
+class TestInt8Precision:
+    def test_int8_weight_only_predictor(self):
+        """Int8 precision mode swaps Linears for weight-only-int8 twins:
+        high-cosine logits vs fp32, exact on grid-aligned weights."""
+        from paddle_tpu import inference
+        from paddle_tpu.models.gpt import gpt2_tiny
+
+        paddle.seed(0)
+        m = gpt2_tiny(); m.eval()
+        x = np.random.RandomState(0).randint(0, 1024, (2, 16)).astype(np.int32)
+        cfg = inference.Config(); cfg.set_model_obj(m)
+        ref = inference.create_predictor(cfg).run([x])[0]
+
+        paddle.seed(0)
+        m8 = gpt2_tiny(); m8.eval()
+        cfg8 = inference.Config(); cfg8.set_model_obj(m8)
+        cfg8.enable_tensorrt_engine(
+            precision_mode=inference.PrecisionType.Int8)
+        q = inference.create_predictor(cfg8).run([x])[0]
+
+        cos = (ref * q).sum() / (np.linalg.norm(ref) * np.linalg.norm(q))
+        assert cos > 0.999
+        assert (ref.argmax(-1) == q.argmax(-1)).mean() > 0.9
+
+    def test_int8_twin_exact_on_grid(self):
+        from paddle_tpu.inference import _int8_twin
+        import paddle_tpu.nn as nn
+        rng = np.random.RandomState(1)
+        scales = np.array([0.5, 0.25, 1.0], np.float32)
+        ints = rng.randint(-127, 128, (4, 3)).astype(np.float32)
+        ints[np.abs(ints).argmax(0), np.arange(3)] = 127
+        lin = nn.Linear(4, 3)
+        lin.weight._data = paddle.to_tensor(ints * scales)._data
+        tw = _int8_twin(lin)
+        xi = paddle.to_tensor(rng.randn(5, 4).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(lin(xi)._data),
+                                   np.asarray(tw(xi)._data),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_int8_swap_releases_fp32_weights(self):
+        """The int8 twin must not retain the original Linear — the swapped
+        fp32 weight must drop out of the persistent registry (WeakSet)."""
+        import gc
+        import weakref
+        from paddle_tpu import inference
+        from paddle_tpu.models.gpt import gpt2_tiny
+
+        paddle.seed(0)
+        m = gpt2_tiny(); m.eval()
+        w_ref = weakref.ref(m.gpt.h[0].attn.qkv_proj.weight)
+        cfg = inference.Config(); cfg.set_model_obj(m)
+        cfg.enable_tensorrt_engine(
+            precision_mode=inference.PrecisionType.Int8)
+        inference.create_predictor(cfg)
+        gc.collect()
+        assert w_ref() is None

@@ -84,13 +84,7 @@ def _build_step(donate):
                                      multi_precision=True)
         ids = rng.randint(0, c.vocab_size, (batch, seq + 1)).astype(np.int32)
         args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
-
-        def _step(x, y):
-            loss = model(x, labels=y)
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
-            return loss
+        _step = None     # shared LM step defined below
     else:
         from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
         batch = int(os.environ.get("BENCH_BATCH", "8"))
@@ -102,7 +96,9 @@ def _build_step(donate):
                                      multi_precision=True)
         ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
         args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
+        _step = None
 
+    if _step is None:
         def _step(x, y):
             loss = model(x, labels=y)
             loss.backward()
