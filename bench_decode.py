@@ -47,8 +47,11 @@ def main():
     prompt = np.random.RandomState(0).randint(
         1, V, (batch, 16)).astype(np.int32)
 
-    # warm (compiles prefill + the decode step)
-    out = dec.generate(paddle.to_tensor(prompt), max_new_tokens=4)
+    # warm with the SAME token count as the timed run: the chunked-scan
+    # decode compiles one variant per power-of-two chunk size, and a
+    # different count in warmup would leave variants to compile inside the
+    # timed region
+    out = dec.generate(paddle.to_tensor(prompt), max_new_tokens=new_tokens)
     float(np.asarray(out._data).sum())
 
     t0 = time.perf_counter()

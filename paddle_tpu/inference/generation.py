@@ -120,8 +120,7 @@ class FusedDecoder:
             embed, Layer) else []
         self._head_params = list(head.parameters()) if isinstance(
             head, Layer) else []
-        self._step = None
-        self._step_key = None
+        self._scan_cache = {}      # (sample cfg, mesh, chunk, eos) -> jitted scan
         self._stk_cache = None
 
     # ------------------------------------------------------------ stacking
@@ -160,7 +159,38 @@ class FusedDecoder:
             return mesh
         return None
 
-    def _build_step(self, do_sample, top_k, top_p, temperature):
+    def _build_scan_step(self, do_sample, top_k, top_p, temperature,
+                         chunk, eos):
+        """chunk tokens per device program: lax.scan over the per-token
+        step, KV cache + last token + finished mask in the carry. One host
+        dispatch per chunk instead of per token — the decode-side analogue
+        of jit.run_steps (the tunnel backend pays a round-trip per
+        dispatch). eos is static (baked into the trace): finished rows keep
+        emitting eos on-device."""
+        core = self._build_step_core(do_sample, top_k, top_p, temperature)
+
+        def scan_step(stk, e_arrays, h_arrays, caches, tok, t0, keys,
+                      finished):
+            def body(carry, xs):
+                tok, caches, finished = carry
+                i, key = xs
+                nxt, caches = core(stk, e_arrays, h_arrays, caches, tok,
+                                   t0 + i, key)
+                if eos is not None:
+                    nxt = jnp.where(finished, eos, nxt)
+                    finished = finished | (nxt == eos)
+                return (nxt, caches, finished), nxt
+            (tok, caches, finished), toks = jax.lax.scan(
+                body, (tok, caches, finished),
+                (jnp.arange(chunk, dtype=jnp.int32), keys))
+            return toks, caches, finished
+        # donate the KV cache (in-place ring update, no per-token copy of
+        # the [L,2,B,H,Smax,D] buffer) — except through the axon tunnel,
+        # where buffer donation is observed to hang (see BASELINE.md r2)
+        tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        return jax.jit(scan_step, donate_argnums=() if tunneled else (3,))
+
+    def _build_step_core(self, do_sample, top_k, top_p, temperature):
         f = self.fmt
         eps = f.epsilon
         pre_ln = f.normalize_before
@@ -282,11 +312,7 @@ class FusedDecoder:
                 nxt = jnp.argmax(logits, axis=-1)
             return nxt.astype(jnp.int32), caches
 
-        # donate the KV cache (in-place ring update, no per-token copy of
-        # the [L,2,B,H,Smax,D] buffer) — except through the axon tunnel,
-        # where buffer donation is observed to hang (see BASELINE.md r2)
-        tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-        return jax.jit(step, donate_argnums=() if tunneled else (3,))
+        return step
 
     # --------------------------------------------------------------- drive
     @no_grad()
@@ -317,35 +343,61 @@ class FusedDecoder:
         nxt = _sample_next(logits[:, -1], do_sample, top_k, top_p,
                            temperature)
 
-        # ---- compiled decode loop (cache key includes the active mesh:
-        # entering/leaving an mp mesh must rebuild the step)
-        key = (do_sample, top_k, top_p, temperature, id(self._mesh_mp()))
-        if self._step is None or self._step_key != key:
-            self._step = self._build_step(*key[:4])
-            self._step_key = key
+        # ---- compiled decode: CHUNKED scan dispatch. Without eos, all
+        # remaining tokens run in one device program; with eos, fixed-size
+        # chunks with on-device finished-masking and a host early-exit
+        # check between chunks. Cache key includes the active mesh
+        # (entering/leaving an mp mesh must rebuild) and the chunk size.
         stk = self._stacked()
         e_arrays = [p._data for p in self._embed_params]
         h_arrays = [p._data for p in self._head_params]
         toks = [nxt]
-        _zero_key = jax.random.PRNGKey(0)   # unused in greedy (argmax branch)
         finished = jnp.zeros((b,), bool)
-        if eos_token_id is not None:
-            finished = finished | (nxt == eos_token_id)
+        eos = None if eos_token_id is None else int(eos_token_id)
+        remaining = max_new_tokens - 1
+        if eos is not None:
+            finished = finished | (nxt == eos)
             if bool(jnp.all(finished)):
-                max_new_tokens = 1            # everything ended at prefill
-        for i in range(1, max_new_tokens):
-            t = jnp.asarray(prompt + i - 1, jnp.int32)
-            k_i = next_key() if do_sample else _zero_key
-            nxt, caches = self._step(stk, e_arrays, h_arrays, caches,
-                                     toks[-1], t, k_i)
-            if eos_token_id is not None:
-                nxt = jnp.where(finished, eos_token_id, nxt)
-                finished = finished | (nxt == eos_token_id)
-            toks.append(nxt)
-            if eos_token_id is not None and bool(jnp.all(finished)):
+                remaining = 0                 # everything ended at prefill
+        # chunk sizes come from a power-of-two ladder so arbitrary
+        # max_new_tokens values reuse a bounded set of compiled scan
+        # variants (a fresh scan length would otherwise recompile inside
+        # the generation loop). eos runs cap the chunk for early exit.
+        chunk_env = int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "0"))
+        cap = chunk_env or (8 if eos is not None else 64)
+        t0 = prompt
+        while remaining > 0:
+            chunk = cap
+            while chunk > remaining:
+                chunk //= 2
+            key = (do_sample, top_k, top_p, temperature,
+                   self._mesh_mp(), chunk, eos)
+            step = self._scan_cache.get(key)
+            if step is None:
+                step = self._build_scan_step(*key[:4], chunk, eos)
+                self._scan_cache[key] = step
+            # one split per chunk: per-token subkeys ride the scan xs
+            base = next_key() if do_sample else jax.random.PRNGKey(0)
+            keys = jax.random.split(base, chunk)
+            ck, caches, finished = step(
+                stk, e_arrays, h_arrays, caches, toks[-1],
+                jnp.asarray(t0, jnp.int32), keys, finished)
+            toks.extend(ck[i] for i in range(chunk))
+            t0 += chunk
+            remaining -= chunk
+            if eos is not None and bool(jnp.all(finished)):
                 break
-        return Tensor(jnp.concatenate(
-            [ids] + [tk[:, None] for tk in toks], axis=1))
+        out = np.concatenate(
+            [np.asarray(ids)] + [np.asarray(tk)[:, None] for tk in toks],
+            axis=1)
+        if eos is not None and bool(jnp.all(finished)):
+            # per-token early-stop semantics (matches generate()): the
+            # output ends at the step where the LAST row emitted its first
+            # eos; any later all-eos padding the chunk produced is trimmed
+            gen = out[:, prompt:]
+            first_eos = np.argmax(gen == eos, axis=1)   # rows all have one
+            out = out[:, : prompt + int(first_eos.max()) + 1]
+        return Tensor(out)
 
 
 def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,

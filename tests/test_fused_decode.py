@@ -57,12 +57,34 @@ class TestFusedDecode:
         dec = FusedDecoder(m.fmt, m.embed, m.head, max_seq_len=32)
         ids = _prompt(seed=1)
         out1 = dec.generate(paddle.to_tensor(ids), max_new_tokens=4)
-        step1 = dec._step
+        cache1 = dict(dec._scan_cache)
+        assert cache1                       # scan variants compiled
         out2 = dec.generate(paddle.to_tensor(_prompt(seed=2)),
                             max_new_tokens=4)
-        assert dec._step is step1          # same compiled step reused
+        # same chunk ladder -> every compiled scan variant reused, none added
+        assert dec._scan_cache == cache1 and all(
+            dec._scan_cache[k] is cache1[k] for k in cache1)
         assert out1.shape[1] == ids.shape[1] + 4
         assert out2.shape[1] == ids.shape[1] + 4
+
+    def test_eos_mid_chunk_matches_generate(self):
+        """Force eos to fire INSIDE a scan chunk: the trailing all-eos
+        padding the chunk produces must be trimmed so output matches
+        generate()'s per-token early stop exactly."""
+        paddle.seed(9)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=4)
+        free = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                              head=m.head, max_new_tokens=8)
+        eos = int(np.asarray(free._data)[0, ids.shape[1] + 2])  # 3rd token
+        ref = generate(m, paddle.to_tensor(ids), max_new_tokens=8,
+                       eos_token_id=eos)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8,
+                             eos_token_id=eos)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
 
     def test_eos_early_stop(self):
         paddle.seed(5)
