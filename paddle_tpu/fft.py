@@ -9,6 +9,7 @@ from .tensor.tensor import Tensor, apply_op
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
            "fftn", "ifftn", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft2", "ihfft2", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -44,6 +45,37 @@ rfft2 = _wrap2("rfft2")
 irfft2 = _wrap2("irfft2")
 rfftn = _wrap2("rfftn", axes_default=None)
 irfftn = _wrap2("irfftn", axes_default=None)
+
+
+def _swap_norm(norm):
+    # the standard Hermitian-FFT identity flips the normalization direction:
+    # hfft(x, n, norm) == irfft(conj(x), n, swapped(norm)); norm=None means
+    # "backward" everywhere in the numpy API, so it must swap too
+    if norm is None:
+        norm = "backward"
+    return {"backward": "forward", "forward": "backward"}.get(norm, norm)
+
+
+def _wrap_hermitian2(name, real_fn, conj_in, conj_out, axes_default=(-2, -1)):
+    def fn(x, s=None, axes=axes_default, norm="backward", name=None):
+        def f(a):
+            a = jnp.conj(a) if conj_in else a
+            out = getattr(jnp.fft, real_fn)(a, s=s, axes=axes,
+                                            norm=_swap_norm(norm))
+            return jnp.conj(out) if conj_out else out
+        return apply_op(f, x)
+    fn.__name__ = name
+    return fn
+
+
+# Hermitian-input FFTs with real output (and their inverses) in 2/N dims:
+# jnp.fft has no hfft2/hfftn family, so build them from the identities
+# hfftn(x) = irfftn(conj(x), swapped norm) and ihfftn(x) = conj(rfftn(x,
+# swapped norm)) — the N-d generalization of numpy's own hfft/ihfft.
+hfft2 = _wrap_hermitian2("hfft2", "irfft2", True, False)
+hfftn = _wrap_hermitian2("hfftn", "irfftn", True, False, axes_default=None)
+ihfft2 = _wrap_hermitian2("ihfft2", "rfft2", False, True)
+ihfftn = _wrap_hermitian2("ihfftn", "rfftn", False, True, axes_default=None)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -351,7 +351,11 @@ class FusedDecoder:
         stk = self._stacked()
         e_arrays = [p._data for p in self._embed_params]
         h_arrays = [p._data for p in self._head_params]
-        toks = [nxt]
+        # host-side accumulation: ONE [chunk, B] device->host transfer per
+        # chunk (not per token); only the last token stays on device as the
+        # next dispatch's input
+        host_parts = [np.asarray(nxt)[:, None]]
+        last_tok = nxt
         finished = jnp.zeros((b,), bool)
         eos = None if eos_token_id is None else int(eos_token_id)
         remaining = max_new_tokens - 1
@@ -380,16 +384,15 @@ class FusedDecoder:
             base = next_key() if do_sample else jax.random.PRNGKey(0)
             keys = jax.random.split(base, chunk)
             ck, caches, finished = step(
-                stk, e_arrays, h_arrays, caches, toks[-1],
+                stk, e_arrays, h_arrays, caches, last_tok,
                 jnp.asarray(t0, jnp.int32), keys, finished)
-            toks.extend(ck[i] for i in range(chunk))
+            host_parts.append(np.asarray(ck).T)        # [B, chunk]
+            last_tok = ck[-1]
             t0 += chunk
             remaining -= chunk
             if eos is not None and bool(jnp.all(finished)):
                 break
-        out = np.concatenate(
-            [np.asarray(ids)] + [np.asarray(tk)[:, None] for tk in toks],
-            axis=1)
+        out = np.concatenate([np.asarray(ids)] + host_parts, axis=1)
         if eos is not None and bool(jnp.all(finished)):
             # per-token early-stop semantics (matches generate()): the
             # output ends at the step where the LAST row emitted its first

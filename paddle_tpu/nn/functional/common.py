@@ -369,3 +369,61 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
 
 
 __all__ += ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+# paddle exposes pad both as paddle.pad and nn.functional.pad — same op
+from ...tensor.manipulation import pad  # noqa: E402,F401
+
+__all__ += ["pad", "pairwise_distance", "sequence_mask", "gather_tree"]
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last axis (reference pairwise_distance)."""
+    def fn(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if p == float("inf"):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        elif p == float("-inf"):
+            out = jnp.min(d, axis=-1, keepdims=keepdim)
+        else:
+            out = jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+    return apply_op(fn, x, y)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...] (reference sequence_mask). maxlen defaults
+    to max(x) — which forces a host sync for the output shape, so pass a
+    static maxlen under jit."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(xd))
+    from ...core.dtype import convert_dtype
+    jdt = convert_dtype(dtype)
+
+    def fn(lens):
+        j = jnp.arange(maxlen, dtype=lens.dtype)
+        return (j < lens[..., None]).astype(jdt)
+    return apply_op(fn, x if isinstance(x, Tensor) else Tensor(xd))
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (reference gather_tree): from the last
+    step, follow parent pointers backwards so each beam's output is its
+    full token path. ids/parents: [max_time, batch, beam_size]. The walk
+    is a reversed lax.scan — one fused program, no host loop."""
+    def fn(idv, par):
+        t = idv.shape[0]
+        beams = jnp.arange(idv.shape[2])
+
+        def step(carry, xs):
+            idv_t, par_t = xs            # [batch, beam]
+            tok = jnp.take_along_axis(idv_t, carry, axis=1)
+            nxt = jnp.take_along_axis(par_t, carry, axis=1)
+            return nxt, tok
+
+        init = jnp.broadcast_to(beams[None, :], idv.shape[1:]).astype(
+            par.dtype)
+        _, toks = jax.lax.scan(step, init, (idv, par), reverse=True)
+        return toks                      # [max_time, batch, beam]
+    return apply_op(fn, ids, parents)

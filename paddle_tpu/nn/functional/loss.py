@@ -11,7 +11,8 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy"
            "mse_loss", "smooth_l1_loss", "kl_div", "margin_ranking_loss",
            "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss",
            "triplet_margin_loss", "log_loss", "square_error_cost",
-           "sigmoid_focal_loss"]
+           "sigmoid_focal_loss", "dice_loss", "multi_margin_loss",
+           "margin_cross_entropy", "hsigmoid_loss"]
 
 
 def _reduce(out, reduction):
@@ -358,3 +359,123 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
 
 def paddle_norm(t):
     return apply_op(lambda a: jnp.sqrt((a * a).sum(-1) + 1e-12), t)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over class probabilities (reference dice_loss): label is
+    one-hotted in-graph; per-sample dice over all non-batch dims, then
+    mean. input [N,...,C] probabilities, label [N,...,1] int."""
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(p):
+        lz = jnp.squeeze(lab, -1) if lab.shape[-1:] == (1,) else lab
+        oh = jax.nn.one_hot(lz, p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inse = jnp.sum(p * oh, axis=red)
+        denom = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+    return apply_op(fn, input)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss: mean_j max(0, margin - x[y] + x[j])^p over
+    j != y (reference multi_margin_loss)."""
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def core(x, *w):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, lab[:, None], axis=1)       # [N,1]
+        m = jnp.maximum(margin - xy + x, 0.0) ** p
+        if w:
+            m = m * w[0][lab][:, None]
+        # the j == y term is margin^p exactly; drop it from the mean
+        m = m * (1.0 - jax.nn.one_hot(lab, c, dtype=x.dtype))
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+    if weight is not None:
+        return apply_op(core, input, weight)
+    return apply_op(core, input)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """Combined-margin (ArcFace-family) softmax CE on cosine logits:
+    target-class logit cos(t) -> cos(m1*t + m2) - m3, all scaled by s
+    (reference margin_cross_entropy). Single-shard path; for a
+    vocab/class-parallel variant compose with mp_ops' parallel CE."""
+    if group is not None:
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...) model-parallel class split is "
+            "not wired; shard classes with fleet mp_ops instead")
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def core(x):
+        xf = x.astype(jnp.float32)
+        n, c = xf.shape
+        oh = jax.nn.one_hot(lab, c, dtype=jnp.float32)
+        # clip strictly inside (-1, 1): arccos' derivative is infinite at
+        # +/-1, and a saturated cosine logit (common in ArcFace training)
+        # would otherwise produce NaN gradients for the whole row
+        eps = 1e-6
+        cos_t = jnp.clip(xf, -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos_t)
+        modified = jnp.cos(margin1 * theta + margin2) - margin3
+        z = scale * jnp.where(oh > 0, modified, xf)
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -jnp.sum(oh * logp, axis=-1)
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+    if return_softmax:
+        return apply_op(core, logits, n_outputs=2)
+    return apply_op(core, logits)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference hsigmoid_loss). Default mode
+    walks the complete binary tree over num_classes leaves (internal nodes
+    1..num_classes-1, weight row = node-1) with a STATIC ceil(log2)-length
+    loop so the walk traces into one fused program; custom path_table /
+    path_code rows (negative entries = padding) cover Huffman trees.
+    Returns [N, 1] per-sample losses like the reference."""
+    lab = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    pt = path_table._data if isinstance(path_table, Tensor) else path_table
+    pc = path_code._data if isinstance(path_code, Tensor) else path_code
+
+    def core(x, w, *b):
+        bv = b[0] if b else None
+        xf = x.astype(jnp.float32)
+        if pt is not None:
+            rows = jnp.asarray(pt)                      # [N, L] node ids
+            codes = jnp.asarray(pc).astype(jnp.float32)
+            active = (rows >= 0).astype(jnp.float32)
+            safe = jnp.maximum(rows, 0)
+            logits = jnp.einsum("nd,nld->nl", xf,
+                                w[safe].astype(jnp.float32))
+            if bv is not None:
+                logits = logits + bv[safe].astype(jnp.float32)
+            sign = 1.0 - 2.0 * codes
+            loss = jnp.sum(active * jax.nn.softplus(-sign * logits), axis=1)
+            return loss[:, None]
+        steps = max(1, int(math.ceil(math.log2(max(num_classes, 2)))) + 1)
+        c = lab.astype(jnp.int32) + num_classes         # leaf node ids
+        loss = jnp.zeros(xf.shape[0], jnp.float32)
+        for _ in range(steps):
+            parent = c >> 1
+            active = (c > 1) & (parent >= 1)
+            row = jnp.maximum(parent - 1, 0)
+            logit = jnp.sum(xf * w[row].astype(jnp.float32), axis=-1)
+            if bv is not None:
+                logit = logit + bv[row].astype(jnp.float32)
+            sign = 1.0 - 2.0 * (c & 1).astype(jnp.float32)
+            loss = loss + active.astype(jnp.float32) * \
+                jax.nn.softplus(-sign * logit)
+            c = parent
+        return loss[:, None]
+    if bias is not None:
+        return apply_op(core, input, weight, bias)
+    return apply_op(core, input, weight)

@@ -90,6 +90,15 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        assert not ceil_mode, "return_mask supports ceil_mode=False"
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        pooled, mask = max_pool2d_with_mask(
+            Tensor(xd[:, :, None, :]), (1, _tuple(kernel_size, 1)[0]),
+            (1, _tuple(stride if stride is not None else kernel_size, 1)[0]),
+            (0, _tuple(padding, 1)[0]))
+        return (apply_op(lambda a: a[:, :, 0, :], pooled),
+                apply_op(lambda a: a[:, :, 0, :], mask))
     return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode,
                  data_format="NCH")
 
@@ -110,6 +119,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        assert data_format == "NCDHW" and not ceil_mode, \
+            "return_mask supports NCDHW, ceil_mode=False"
+        return max_pool3d_with_mask(x, kernel_size, stride, padding)
     return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode,
                  data_format=data_format)
 
@@ -257,4 +270,84 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     return apply_op(fn, x)
 
 
-__all__ += ["max_pool2d_with_mask", "max_unpool2d", "lp_pool2d"]
+__all__ += ["max_pool2d_with_mask", "max_pool3d_with_mask", "max_unpool2d", "lp_pool2d",
+            "max_unpool1d", "max_unpool3d"]
+
+
+def max_pool3d_with_mask(x, kernel_size, stride=None, padding=0, name=None):
+    """→ (pooled, mask) with flat D*H*W argmax positions, consumed by
+    max_unpool3d (reference max_pool3d return_mask=True contract)."""
+    kd, kh, kw = _tuple(kernel_size, 3)
+    sd, sh, sw = _tuple(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _tuple(padding, 3)
+
+    def fn(a):
+        N, C, D, H, W = a.shape
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                     constant_values=-jnp.inf)
+        Do = (D + 2 * pd - kd) // sd + 1
+        Ho = (H + 2 * ph - kh) // sh + 1
+        Wo = (W + 2 * pw - kw) // sw + 1
+        iz = jnp.arange(Do)[:, None] * sd + jnp.arange(kd)[None, :]
+        iy = jnp.arange(Ho)[:, None] * sh + jnp.arange(kh)[None, :]
+        ix = jnp.arange(Wo)[:, None] * sw + jnp.arange(kw)[None, :]
+        pat = ap[:, :,
+                 iz[:, None, None, :, None, None],
+                 iy[None, :, None, None, :, None],
+                 ix[None, None, :, None, None, :]]
+        # → [N,C,Do,Ho,Wo,kd,kh,kw]
+        pat = pat.reshape(N, C, Do, Ho, Wo, kd * kh * kw)
+        best = jnp.argmax(pat, axis=-1)
+        pooled = jnp.take_along_axis(pat, best[..., None], axis=-1)[..., 0]
+        zz = jnp.clip(iz - pd, 0, D - 1)[:, None, None, :, None, None]
+        yy = jnp.clip(iy - ph, 0, H - 1)[None, :, None, None, :, None]
+        xx = jnp.clip(ix - pw, 0, W - 1)[None, None, :, None, None, :]
+        flat = ((zz * H + yy) * W + xx).reshape(Do, Ho, Wo, kd * kh * kw)
+        mask = flat[jnp.arange(Do)[:, None, None],
+                    jnp.arange(Ho)[None, :, None],
+                    jnp.arange(Wo)[None, None, :], best]
+        return pooled, mask.astype(jnp.int32)
+    return apply_op(fn, x, n_outputs=2)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """1-D unpool via the 2-D scatter path on a width-1 spatial axis."""
+    assert data_format == "NCL", "max_unpool1d supports NCL"
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(
+        indices)
+    out2 = max_unpool2d(
+        Tensor(xd[:, :, None, :]), Tensor(idx[:, :, None, :]),
+        (1, _tuple(kernel_size, 1)[0]),
+        (1, _tuple(stride if stride is not None else kernel_size, 1)[0]),
+        (0, _tuple(padding, 1)[0]),
+        output_size=(1, output_size[-1]) if output_size is not None else None)
+    return apply_op(lambda a: a[:, :, 0, :], out2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions in D*H*W."""
+    assert data_format == "NCDHW", "max_unpool3d supports NCDHW"
+    kd, kh, kw = _tuple(kernel_size, 3)
+    sd, sh, sw = _tuple(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _tuple(padding, 3)
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(
+        indices)
+
+    def fn(a):
+        N, C, Do, Ho, Wo = a.shape
+        if output_size is not None:
+            D, H, W = output_size[-3:]
+        else:
+            D = (Do - 1) * sd - 2 * pd + kd
+            H = (Ho - 1) * sh - 2 * ph + kh
+            W = (Wo - 1) * sw - 2 * pw + kw
+        flat = jnp.zeros((N, C, D * H * W), a.dtype)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1)].set(a.reshape(N, C, -1))
+        return out.reshape(N, C, D, H, W)
+    return apply_op(fn, x)

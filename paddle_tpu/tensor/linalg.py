@@ -9,7 +9,8 @@ from .tensor import Tensor, apply_op
 __all__ = ["norm", "cond", "cholesky", "cholesky_solve", "det", "slogdet",
            "inv", "pinv", "matrix_power", "matrix_rank", "qr", "lu", "svd",
            "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
-           "triangular_solve", "cross", "histogramdd", "t", "transpose_last"]
+           "triangular_solve", "cross", "histogramdd", "t", "transpose_last",
+           "matrix_transpose", "pca_lowrank", "svd_lowrank"]
 
 
 def norm(x, p=None, axis=None, keepdim=False, name=None):
@@ -98,6 +99,46 @@ def lu(x, pivot=True, get_infos=False, name=None):
 def svd(x, full_matrices=False, name=None):
     u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
     return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def matrix_transpose(x, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Rank-q randomized SVD (reference svd_lowrank / Halko et al.): a
+    random range sketch refined by `niter` power iterations, then an exact
+    SVD of the small projected matrix. All dense ops — MXU-friendly
+    [m,n]x[n,q] dots; q stays static so XLA sees fixed shapes."""
+    a = x._data if M is None else x._data - (
+        M._data if isinstance(M, Tensor) else jnp.asarray(M))
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(int(q), m, n)
+    from ..core.rng import next_key
+    omega = jax.random.normal(next_key(), a.shape[:-2] + (n, q), jnp.float32)
+    y = a @ omega.astype(a.dtype)
+    qm, _ = jnp.linalg.qr(y)
+    for _ in range(int(niter)):
+        z = jnp.swapaxes(a, -1, -2) @ qm
+        z, _ = jnp.linalg.qr(z)
+        y = a @ z
+        qm, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qm, -1, -2) @ a          # [q, n]
+    ub, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    u = qm @ ub
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Principal components via randomized SVD on the (optionally centered)
+    data (reference pca_lowrank)."""
+    a = x._data
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    return svd_lowrank(Tensor(a), q=q, niter=niter)
 
 
 def eig(x, name=None):

@@ -12,7 +12,7 @@ __all__ = [
     "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes",
     "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "concat", "stack",
     "split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
-    "flip", "roll", "rot90", "gather", "gather_nd", "scatter", "scatter_nd",
+    "flip", "fliplr", "flipud", "roll", "rot90", "gather", "gather_nd", "scatter", "scatter_nd",
     "scatter_nd_add", "index_select", "index_sample", "take_along_axis",
     "put_along_axis", "slice", "strided_slice", "unbind", "unstack",
     "repeat_interleave", "masked_select", "masked_fill", "where", "pad",
@@ -168,6 +168,14 @@ def broadcast_to(x, shape, name=None):
 def flip(x, axis, name=None):
     ax = _int_tuple(axis)
     return apply_op(lambda a: jnp.flip(a, axis=ax), x)
+
+
+def fliplr(x, name=None):
+    return apply_op(jnp.fliplr, x)
+
+
+def flipud(x, name=None):
+    return apply_op(jnp.flipud, x)
 
 
 def roll(x, shifts, axis=None, name=None):

@@ -73,6 +73,9 @@ def bitwise_not(x, name=None):
 
 
 _export("bitwise_not", bitwise_not)
+_export("bitwise_invert", bitwise_not)
+_export("gammaln", lambda x, name=None: apply_op(
+    jax.scipy.special.gammaln, x))
 
 
 def _axis(axis):
@@ -140,6 +143,16 @@ def bmm(x, y, name=None):
 
 
 _export("bmm", bmm)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) over batched matrices (reference
+    baddbmm); one fused XLA dot + scaled add."""
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+_export("baddbmm", baddbmm)
 
 
 def dot(x, y, name=None):

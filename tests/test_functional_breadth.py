@@ -178,3 +178,189 @@ def test_lp_pool2d_ceil_mode_shape():
     assert out.shape == (1, 1, 3, 3)
     out2 = np.asarray(F.lp_pool2d(_t(x), 2, 2, stride=2)._data)
     assert out2.shape == (1, 1, 2, 2)
+
+
+# ---- round-3 tranche: unpool 1d/3d, new losses, extension fns --------------
+
+def test_max_pool_unpool_1d_3d_torch_oracle():
+    import torch
+    import torch.nn.functional as TF
+    x = np.random.RandomState(1).randn(2, 3, 8).astype(np.float32)
+    pooled, idx = F.max_pool1d(_t(x), 2, return_mask=True)
+    tp, ti = TF.max_pool1d(torch.tensor(x), 2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(pooled._data), tp.numpy())
+    np.testing.assert_array_equal(np.asarray(idx._data), ti.numpy())
+    up = F.max_unpool1d(pooled, idx, 2)
+    np.testing.assert_allclose(np.asarray(up._data),
+                               TF.max_unpool1d(tp, ti, 2).numpy())
+    x3 = np.random.RandomState(2).randn(2, 3, 4, 6, 6).astype(np.float32)
+    p3, i3 = F.max_pool3d(_t(x3), 2, return_mask=True)
+    t3, ti3 = TF.max_pool3d(torch.tensor(x3), 2, return_indices=True)
+    np.testing.assert_allclose(np.asarray(p3._data), t3.numpy())
+    np.testing.assert_array_equal(np.asarray(i3._data), ti3.numpy())
+    u3 = F.max_unpool3d(p3, i3, 2)
+    np.testing.assert_allclose(np.asarray(u3._data),
+                               TF.max_unpool3d(t3, ti3, 2).numpy())
+
+
+def test_multi_margin_and_pairwise_distance_torch_oracle():
+    import torch
+    import torch.nn.functional as TF
+    x = np.random.RandomState(3).randn(5, 7).astype(np.float32)
+    y = np.array([1, 0, 6, 3, 2], np.int64)
+    ours = float(np.asarray(F.multi_margin_loss(_t(x), _t(y))._data))
+    ref = float(TF.multi_margin_loss(torch.tensor(x), torch.tensor(y)))
+    assert abs(ours - ref) < 1e-5
+    w = np.random.RandomState(4).rand(7).astype(np.float32)
+    ours_w = float(np.asarray(F.multi_margin_loss(
+        _t(x), _t(y), weight=_t(w), reduction="sum")._data))
+    ref_w = float(TF.multi_margin_loss(torch.tensor(x), torch.tensor(y),
+                                       weight=torch.tensor(w),
+                                       reduction="sum"))
+    assert abs(ours_w - ref_w) < 1e-4
+    a = np.random.RandomState(5).randn(4, 9).astype(np.float32)
+    b = np.random.RandomState(6).randn(4, 9).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(F.pairwise_distance(_t(a), _t(b))._data),
+        TF.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy(),
+        atol=1e-5)
+
+
+def test_dice_loss_hand_value():
+    p = np.zeros((1, 2, 3), np.float32)
+    p[0, :, 0] = 1.0          # predicts class 0 everywhere
+    lab = np.array([[[0], [0]]], np.int64)
+    # perfect prediction: inse=2, denom=4 -> dice = 1 - 4/(4+eps) ~ 0
+    out = float(np.asarray(F.dice_loss(_t(p), _t(lab))._data))
+    assert abs(out) < 1e-4
+    lab_bad = np.array([[[1], [1]]], np.int64)
+    out_bad = float(np.asarray(F.dice_loss(_t(p), _t(lab_bad))._data))
+    assert out_bad > 0.9
+
+
+def test_margin_cross_entropy_reduces_to_ce_at_zero_margin():
+    rs = np.random.RandomState(7)
+    logits = (rs.rand(6, 10) * 2 - 1).astype(np.float32)
+    lab = rs.randint(0, 10, (6,))
+    # m1=1, m2=0, m3=0 => modified target logit == original: plain scaled CE
+    ours = np.asarray(F.margin_cross_entropy(
+        _t(logits), _t(lab), margin1=1.0, margin2=0.0, margin3=0.0,
+        scale=8.0, reduction="none")._data)
+    z = 8.0 * logits
+    lse = np.log(np.exp(z - z.max(1, keepdims=True)).sum(1)) + z.max(1)
+    ref = lse - z[np.arange(6), lab]
+    np.testing.assert_allclose(ours, ref, atol=1e-4)
+    # margin2 > 0 strictly increases the loss
+    harder = np.asarray(F.margin_cross_entropy(
+        _t(logits), _t(lab), margin2=0.5, scale=8.0,
+        reduction="none")._data)
+    assert (harder >= ours - 1e-5).all() and harder.mean() > ours.mean()
+
+
+def test_hsigmoid_loss_matches_dense_walk():
+    rs = np.random.RandomState(8)
+    n_cls, feat = 8, 16
+    x = rs.randn(3, feat).astype(np.float32)
+    lab = np.array([0, 5, 7], np.int64)
+    w = rs.randn(n_cls - 1, feat).astype(np.float32)
+    b = rs.randn(n_cls - 1).astype(np.float32)
+    out = np.asarray(F.hsigmoid_loss(_t(x), _t(lab), n_cls, _t(w),
+                                     _t(b))._data)
+    assert out.shape == (3, 1)
+
+    def ref_one(xi, li):
+        c, total = li + n_cls, 0.0
+        while c > 1:
+            parent = c // 2
+            row = parent - 1
+            logit = xi @ w[row] + b[row]
+            sign = 1.0 - 2.0 * (c & 1)
+            total += np.log1p(np.exp(-sign * logit))
+            c = parent
+        return total
+    ref = np.array([[ref_one(x[i], int(lab[i]))] for i in range(3)])
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+    # custom path mode agrees with the default tree when given the same paths
+    rows, codes = [], []
+    for li in lab:
+        r, cd, c = [], [], int(li) + n_cls
+        while c > 1:
+            r.append(c // 2 - 1)
+            cd.append(c & 1)
+            c //= 2
+        rows.append(r + [-1] * (4 - len(r)))
+        codes.append(cd + [0] * (4 - len(cd)))
+    out2 = np.asarray(F.hsigmoid_loss(
+        _t(x), _t(lab), n_cls, _t(w), _t(b),
+        path_table=_t(np.array(rows, np.int32)),
+        path_code=_t(np.array(codes, np.int32)))._data)
+    np.testing.assert_allclose(out2, ref, rtol=1e-4)
+
+
+def test_sequence_mask_and_gather_tree():
+    lens = np.array([2, 3, 1], np.int64)
+    m = np.asarray(F.sequence_mask(_t(lens), maxlen=4)._data)
+    np.testing.assert_array_equal(
+        m, [[1, 1, 0, 0], [1, 1, 1, 0], [1, 0, 0, 0]])
+    # beam walk: step-1 parents say beam0<-1, beam1<-0 for batch 0
+    ids = np.array([[[1, 2], [3, 4]], [[5, 6], [7, 8]]], np.int32)
+    par = np.array([[[0, 0], [0, 0]], [[1, 0], [0, 1]]], np.int32)
+    out = np.asarray(F.gather_tree(_t(ids), _t(par))._data)
+    np.testing.assert_array_equal(
+        out, [[[2, 1], [3, 4]], [[5, 6], [7, 8]]])
+
+
+def test_fft_hermitian_2d_nd_roundtrip():
+    import paddle_tpu.fft as pfft
+    rs = np.random.RandomState(9)
+    z = rs.randn(4, 6).astype(np.float32)
+    h = pfft.ihfftn(_t(z))
+    np.testing.assert_allclose(np.asarray(pfft.hfftn(h, s=(4, 6))._data),
+                               z, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(pfft.hfft2(pfft.ihfft2(_t(z)), s=(4, 6))._data),
+        z, atol=1e-4)
+    # ortho norm matches numpy's 1-d hfft on the last axis of a 1-row input
+    x1 = (rs.randn(8) + 1j * rs.randn(8)).astype(np.complex64)
+    ours = np.asarray(pfft.hfft(_t(x1), n=14, norm="ortho")._data)
+    np.testing.assert_allclose(ours, np.fft.hfft(x1, n=14, norm="ortho"),
+                               atol=1e-4)
+
+
+def test_svd_pca_lowrank_and_matrix_transpose():
+    rs = np.random.RandomState(10)
+    m = (rs.randn(8, 5) @ rs.randn(5, 6)).astype(np.float32)  # rank 5
+    u, s, v = paddle.linalg.svd_lowrank(_t(m), q=5)
+    rec = np.asarray(u._data) @ np.diag(np.asarray(s._data)) \
+        @ np.asarray(v._data).T
+    np.testing.assert_allclose(rec, m, atol=1e-3)
+    u2, s2, v2 = paddle.linalg.pca_lowrank(_t(m), q=3)
+    assert list(u2.shape) == [8, 3] and list(v2.shape) == [6, 3]
+    # pca is the svd of the centered matrix: singular values must match
+    sc = np.linalg.svd(m - m.mean(0), compute_uv=False)[:3]
+    np.testing.assert_allclose(np.asarray(s2._data), sc, rtol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.matrix_transpose(_t(m))._data), m.T)
+
+
+def test_misc_new_tensor_ops():
+    rs = np.random.RandomState(11)
+    a = rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(paddle.fliplr(_t(a))._data),
+                               np.fliplr(a))
+    np.testing.assert_allclose(np.asarray(paddle.flipud(_t(a))._data),
+                               np.flipud(a))
+    i, x, y = (rs.randn(2, 3, 4).astype(np.float32),
+               rs.randn(2, 3, 5).astype(np.float32),
+               rs.randn(2, 5, 4).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.baddbmm(_t(i), _t(x), _t(y), beta=0.5,
+                                  alpha=2.0)._data),
+        0.5 * i + 2.0 * (x @ y), atol=1e-5)
+    from scipy.special import gammaln as sgammaln
+    v = np.array([0.5, 1.0, 4.2], np.float32)
+    np.testing.assert_allclose(np.asarray(paddle.gammaln(_t(v))._data),
+                               sgammaln(v), atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_invert(_t(np.array([0, 5], np.int32)))._data),
+        [-1, -6])

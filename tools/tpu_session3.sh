@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-3 third-window TPU session. Priorities (value/minute):
+#   1. full bench: headline (donated, streaming-CE + rbg-PRNG now in) +
+#      bert + llama + vit (first ViT number; conv dtype fix landed)
+#   2. moe ISOLATED (wedged the tunnel last window — own process + timeout)
+#   3. scan-steps A/B (run_steps(8) dispatch amortization, landed unmeasured)
+#   4. decode ratchet (bench_decode.py has no recorded number yet)
+#   5. per-op trace profile: names the next bottleneck for the MFU push
+# Each phase timeboxed; BENCH_partial.json checkpoints inside bench.py.
+set -u
+OUT=${1:-/tmp/tpu_session3}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) $(date +%H:%M:%S) ===" | tee -a "$OUT/session.log"
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "exit=$? $(tail -c 400 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
+}
+
+# 1. headline + bert + llama + vit; moe excluded (isolated at 2)
+run bench_main 1800 env BENCH_BUDGET_S=1200 BENCH_SKIP=moe python bench.py
+cp BENCH_partial.json "$OUT/bench_main.json" 2>/dev/null
+
+# 2. moe isolated so a compile wedge can't eat the session
+run bench_moe 900 env BENCH_ONLY=moe BENCH_DONATE_PROBE=0 python bench.py
+
+# 3. scan A/B on the headline config
+run bench_scan 700 env BENCH_SCAN=8 BENCH_ONLY=none BENCH_DONATE_PROBE=0 \
+    BENCH_STEPS=24 python bench.py
+
+# 4. decode ratchet
+run bench_decode 900 python bench_decode.py
+
+# 5. trace profile (per-op table -> log; summary.json)
+run prof_gpt2 700 env PROF_STEPS=10 PROF_MODE=trace python tools/tpu_profile.py "$OUT/prof_gpt2"
+
+echo "session complete; grep tokens_per_sec $OUT/*.log" | tee -a "$OUT/session.log"
