@@ -39,9 +39,12 @@ class _RngState(threading.local):
         self.seed_value = 0
 
     def ensure(self):
-        if self.key_tensor is None:
+        # `_data is None` = the key was lazily created inside a to_static
+        # trace that failed; the rollback (jit _execute) killed it. Rebuild
+        # from the last seed so the retry reruns with live, tracked state.
+        if self.key_tensor is None or self.key_tensor._data is None:
             from ..tensor.tensor import Tensor, register_persistent
-            self.key_tensor = Tensor(_key(0))
+            self.key_tensor = Tensor(_key(self.seed_value))
             self.key_tensor.name = "global_rng_key"
             self.key_tensor.persistable = True
             register_persistent(self.key_tensor)

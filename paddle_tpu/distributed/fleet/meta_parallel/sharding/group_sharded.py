@@ -80,9 +80,11 @@ def annotate_optimizer_sharding(optimizer, axis_name: str = "sharding"):
     optimizer._sharding_axis = axis_name
     for slot in optimizer._accumulators.values():
         for t in slot.values():
-            t.sharding_spec = shard_spec_for(t, axis_name)
+            if t._data is not None:   # skip failed-trace-rollback corpses
+                t.sharding_spec = shard_spec_for(t, axis_name)
     for t in optimizer._master_weights.values():
-        t.sharding_spec = shard_spec_for(t, axis_name)
+        if t._data is not None:
+            t.sharding_spec = shard_spec_for(t, axis_name)
     orig_acc = optimizer._acc
 
     def acc(name, p, init=None):

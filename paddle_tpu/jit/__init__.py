@@ -176,10 +176,12 @@ class StaticFunction:
             _spec_key(spec),
         )
         entry = self._cache.get(key)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build(state, spec, key)
         out_arrays, state_after, new_state = self._execute(
-            entry, state, arg_arrays, scan=False)
+            entry, state, arg_arrays, scan=False, entry_key=key,
+            fresh_entry=fresh)
         # state_after may be a superset of state: persistent tensors created
         # during tracing (e.g. lazily-built optimizer slots) are captured as
         # extra outputs; the next call's key sees the superset and recompiles
@@ -215,13 +217,15 @@ class StaticFunction:
             return out_arrays, new_state
         return pure
 
-    def _execute(self, entry, state, call_arrays, scan):
+    def _execute(self, entry, state, call_arrays, scan, entry_key=None,
+                 fresh_entry=True):
         """Run a compiled entry with tape/grad save-restore and the
         donation-aware error contract shared by __call__ and run_steps."""
         jitted, out_spec_box, state_after_box = entry
         state_arrays = [t._data for t in state]
         saved_nodes = _tape.nodes[:]
         saved_grads = [(t, t.grad) for t in state]
+        pre_existing = {id(t) for t in state}
         try:
             out_arrays, new_state = jitted(state_arrays, call_arrays)
         except Exception as e:
@@ -230,6 +234,26 @@ class StaticFunction:
                 t._data = arr
             for t, g in saved_grads:
                 t.grad = g
+            # Persistent tensors CREATED during the failed trace/compile
+            # (lazily-built optimizer slots, master weights) hold escaped
+            # tracers; left registered they poison every later to_static
+            # call in the process with UnexpectedTracerError. Their true
+            # values never existed, so roll them back hard: drop from the
+            # registry and mark dead (_data=None) — owners that cache them
+            # (Optimizer._acc/_seed_master) recreate dead slots on reuse.
+            from ..tensor.tensor import (persistent_tensors,
+                                         unregister_persistent_many)
+            killed = [t for t in persistent_tensors()
+                      if id(t) not in pre_existing]
+            unregister_persistent_many(killed)
+            for t in killed:
+                t._data = None
+            if killed or fresh_entry:
+                # only evict when this call's trace may be inconsistent —
+                # a transient EXECUTE failure of a long-good compiled entry
+                # must not force a retrace (remote compiles cost minutes)
+                state_after_box[0] = None
+                self._cache.pop(entry_key, None)
             if scan and "carry" in str(e):
                 raise RuntimeError(
                     "run_steps traced new persistent state (e.g. "
@@ -315,10 +339,12 @@ class StaticFunction:
                tuple((tuple(a.shape), str(a.dtype)) for a in stacked),
                tuple(id(t) for t in state), _spec_key(spec))
         entry = self._cache.get(key)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build_scan(k, state, spec, key)
         out_arrays, state_after, new_state = self._execute(
-            entry, state, stacked, scan=True)
+            entry, state, stacked, scan=True, entry_key=key,
+            fresh_entry=fresh)
         for t, arr in zip(state_after, new_state):
             t._data = arr
         return _unflatten_out(entry[1][0], out_arrays)

@@ -69,6 +69,8 @@ class Optimizer:
     def _acc(self, name: str, p: Parameter, init=None) -> Tensor:
         slot = self._accumulators.setdefault(name, {})
         key = id(p)
+        if key in slot and slot[key]._data is None:
+            del slot[key]  # dead slot: failed-trace rollback invalidated it
         if key not in slot:
             if init is None:
                 arr = jnp.zeros_like(self._master(p)._data)
@@ -86,6 +88,9 @@ class Optimizer:
         (idempotent). The static AMP pass seeds from the pre-cast fp32
         weights; the lazy path below seeds from the current values."""
         key = id(p)
+        if (key in self._master_weights
+                and self._master_weights[key]._data is None):
+            del self._master_weights[key]  # dead: failed-trace rollback
         if key not in self._master_weights:
             t = Tensor(jnp.asarray(value).astype(jnp.float32))
             t.persistable = True
@@ -139,12 +144,17 @@ class Optimizer:
         the eager fallback (and every later to_static call) sees only
         concrete state."""
         import jax
+
+        def dead(t):
+            # tracer = escaped from this (fused-eager) failure path;
+            # None = already killed by the jit failed-trace rollback
+            return t._data is None or isinstance(t._data, jax.core.Tracer)
+
         for slot in self._accumulators.values():
-            for k in [k for k, t in slot.items()
-                      if isinstance(t._data, jax.core.Tracer)]:
+            for k in [k for k, t in slot.items() if dead(t)]:
                 del slot[k]
         for k in [k for k, t in self._master_weights.items()
-                  if isinstance(t._data, jax.core.Tracer)]:
+                  if dead(self._master_weights[k])]:
             del self._master_weights[k]
 
     def _step_core(self, params_grads, lr):
@@ -253,11 +263,15 @@ class Optimizer:
     def state_dict(self) -> dict:
         sd: dict = {}
         params = {id(p): name_of(p) for p in self._params()}
+        # skip dead slots (_data=None): a failed-trace rollback killed them
+        # before they ever held a value — they are semantically absent
         for acc_name, slot in self._accumulators.items():
             for pid, t in slot.items():
-                sd[f"{params.get(pid, pid)}_{acc_name}"] = t
+                if t._data is not None:
+                    sd[f"{params.get(pid, pid)}_{acc_name}"] = t
         for pid, t in self._master_weights.items():
-            sd[f"{params.get(pid, pid)}_master"] = t
+            if t._data is not None:
+                sd[f"{params.get(pid, pid)}_master"] = t
         if isinstance(self._lr, LRScheduler):
             sd["LR_Scheduler"] = self._lr.state_dict()
         sd["@step"] = self._step_count

@@ -35,6 +35,7 @@ __all__ = [
     "set_grad_enabled",
     "apply_op",
     "register_persistent",
+    "unregister_persistent",
     "persistent_tensors",
     "clear_tape",
 ]
@@ -115,6 +116,32 @@ def register_persistent(t: "Tensor") -> None:
     _persistent_uids.add(t._uid)
     weakref.finalize(t, _persistent_uids.discard, t._uid)
     _persistent.add(t)
+
+
+def unregister_persistent(t: "Tensor") -> None:
+    """Remove ``t`` from the persistent-state registry (rollback of a
+    lazily-created tensor whose value never materialized — see
+    jit.StaticFunction._execute's failed-trace rollback)."""
+    unregister_persistent_many([t])
+
+
+def unregister_persistent_many(ts) -> None:
+    """Batch unregister: ONE sweep of the registry for any number of
+    tensors (a failed first step of a big model rolls back ~4 slots per
+    param — per-tensor scans would be O(registry²)).
+
+    NOT WeakSet.discard(t): that compares candidates through
+    Tensor.__eq__ (elementwise — and raises on tracer-valued data, the
+    very state this rollback removes). Drop matching weakrefs by referent
+    identity from the underlying ref set instead."""
+    doomed = {id(t) for t in ts}
+    if not doomed:
+        return
+    for t in ts:
+        _persistent_uids.discard(t._uid)
+    for ref in list(getattr(_persistent, "data", ())):
+        if id(ref()) in doomed:
+            _persistent.data.discard(ref)
 
 
 def persistent_tensors() -> list["Tensor"]:
