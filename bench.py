@@ -290,7 +290,10 @@ def bench_gpt2(on_tpu, peak_tflops):
     if watchdog_done is not None:
         watchdog_done.set()
 
-    scan_k = int(os.environ.get("BENCH_SCAN", "0"))
+    # default on TPU: 8 steps per device program (lax.scan over the step) —
+    # the tunnel backend pays a host RPC per dispatch, worth ~6.5 ms/step
+    # at the headline shape (measured r3 s4: 98.2 → 91.7 ms/step)
+    scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "0"))
     if scan_k > 0:
         # k steps per device program (lax.scan over the compiled step):
         # amortizes per-call dispatch/RPC latency — the tunnel backend pays
@@ -464,8 +467,12 @@ def bench_vit(on_tpu, peak_tflops):
     from paddle_tpu.models.vit import vit_l_16, vit_tiny
 
     if on_tpu:
-        model = vit_l_16()
-        batch, size, steps = 32, 224, 10
+        # recompute: ViT-L b32 saved-residuals OOMed the tunnel chip twice
+        # (r3 s3) — remat the 24 blocks, trading ~1/3 extra FLOPs for O(1)
+        # per-block activation memory
+        model = vit_l_16(recompute=True)
+        batch, size, steps = int(os.environ.get("BENCH_VIT_BATCH", "32")), \
+            224, 10
     else:
         model = vit_tiny()
         batch, size, steps = 2, 32, 2

@@ -66,8 +66,9 @@ class Block(Layer):
 class ViT(Layer):
     def __init__(self, image_size=224, patch_size=16, dim=768, depth=12,
                  heads=12, mlp_ratio=4.0, num_classes=1000, dropout=0.0,
-                 in_channels=3):
+                 in_channels=3, recompute=False):
         super().__init__()
+        self.recompute = recompute
         self.patch_embed = Conv2D(in_channels, dim, patch_size,
                                   stride=patch_size)
         n_patches = (image_size // patch_size) ** 2
@@ -90,8 +91,13 @@ class ViT(Layer):
         cls = expand(self.cls_token, [b, 1, d])
         x = concat([cls, x], axis=1)
         x = self.pos_drop(x + self.pos_embed)
-        for blk in self.blocks:
-            x = blk(x)
+        if self.recompute and self.training:
+            from ..distributed.fleet.utils.recompute_mod import recompute
+            for blk in self.blocks:
+                x = recompute(blk, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         x = self.norm(x)
         cls_out = x[:, 0]
         if self.head is not None:

@@ -18,10 +18,21 @@ __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
 def _pallas_ln_ok(x, normalized_shape, weight, bias, need_bias=True) -> bool:
     """Fused-kernel gate: last-dim norm, affine params matching x's dtype,
     on TPU (the composite promotes mixed dtypes; the kernel keeps x.dtype,
-    so mixed-dtype configs must take the composite for backend parity)."""
+    so mixed-dtype configs must take the composite for backend parity).
+
+    OPT-IN (PADDLE_TPU_PALLAS_LN=1): the r3 s4 profile measured the Pallas
+    LN pair at ~22.6 ms/step on the GPT-2 headline (fwd 5.9 + bwd 16.8) vs
+    <2 ms for the XLA composite — a pallas_call is a fusion barrier, so
+    every LN pays its own HBM round-trip, while XLA fuses the composite
+    into the surrounding matmul/elementwise epilogues. The kernel stays
+    (capability parity for layer_norm_kernel.cu + direct callers/tests);
+    the F.layer_norm hot path defaults to the composite."""
     try:
         import jax
         import os
+        if os.environ.get("PADDLE_TPU_PALLAS_LN") != "1" and \
+                os.environ.get("PADDLE_TPU_FORCE_PALLAS") != "1":
+            return False
         if jax.default_backend() != "tpu" and \
                 os.environ.get("PADDLE_TPU_FORCE_PALLAS") != "1":
             return False

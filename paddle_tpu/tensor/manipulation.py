@@ -748,6 +748,19 @@ def combinations(x, r=2, with_replacement=False, name=None):
     return apply_op(lambda a: a[jnp.asarray(idx)], x)
 
 
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (reference: paddle.cartesian_prod).
+    Returns [prod(len_i), n] (or 1-D for a single input)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def fn(*arrs):
+        if len(arrs) == 1:
+            return arrs[0]
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.ravel() for g in grids], axis=-1)
+    return apply_op(fn, *xs)
+
+
 def matrix_transpose(x, name=None):
     from .linalg import t
     return t(x)
@@ -772,8 +785,8 @@ def nonzero_static(x, size, fill_value=-1, name=None):
     return apply_op(fn, x)
 
 
-__all__ += ["argwhere", "combinations", "matrix_transpose",
-            "nonzero_static"]
+__all__ += ["argwhere", "cartesian_prod", "combinations",
+            "matrix_transpose", "nonzero_static"]
 
 
 def reverse(x, axis, name=None):

@@ -393,6 +393,16 @@ def test_nonzero_static_and_argwhere():
     np.testing.assert_array_equal(aw, [[0, 1], [1, 0]])
 
 
+def test_cartesian_prod():
+    a = _t(np.array([1, 2, 3], np.int32))
+    b = _t(np.array([4, 5], np.int32))
+    out = np.asarray(paddle.cartesian_prod([a, b])._data)
+    exp = np.array([[x, y] for x in (1, 2, 3) for y in (4, 5)], np.int32)
+    np.testing.assert_array_equal(out, exp)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.cartesian_prod([a])._data), [1, 2, 3])
+
+
 def test_combinations_matrix_transpose_reduce_as():
     x = _t(np.array([1.0, 2.0, 3.0], np.float32))
     comb = np.asarray(paddle.combinations(x)._data)

@@ -253,16 +253,54 @@ def profile_ablate(steps):
             return h.sum()
         return f
 
+    def full_id_attn(model, opt):
+        # attention ablated to identity (out = q): isolates the full
+        # fwd+bwd cost of the flash kernels inside the real train step
+        from paddle_tpu.nn import functional as F
+        real = F.scaled_dot_product_attention
+
+        def fake_sdpa(q, k, v, *a, **kw):
+            return q
+
+        def f(x, y):
+            # the gpt module's `F` is this same module object, so one
+            # attribute swap reroutes the model's call
+            F.scaled_dot_product_attention = fake_sdpa
+            try:
+                loss = model(x, labels=y)
+            finally:
+                F.scaled_dot_product_attention = real
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return f
+
+    def full_no_dropout(model, opt):
+        def f(x, y):
+            model.eval()   # dropout off; still runs backward+opt
+            loss = model(x, labels=y)
+            model.train()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return f
+
     out = {}
     for name, mk in [("full", full), ("fwd+bwd", no_opt),
-                     ("fwd", fwd_only), ("fwd_no_ce", fwd_no_ce)]:
+                     ("fwd", fwd_only), ("fwd_no_ce", fwd_no_ce),
+                     ("full_id_attn", full_id_attn),
+                     ("full_no_drop", full_no_dropout)]:
         out[name] = timed(mk)
-        print(f"{name:10s} {out[name]:8.2f} ms/step", file=sys.stderr)
+        print(f"{name:12s} {out[name]:8.2f} ms/step", file=sys.stderr)
     print("\n== ablation deltas ==")
     print(f"optimizer+writeback : {out['full'] - out['fwd+bwd']:8.2f} ms")
     print(f"backward            : {out['fwd+bwd'] - out['fwd']:8.2f} ms")
     print(f"LM head + CE (fwd)  : {out['fwd'] - out['fwd_no_ce']:8.2f} ms")
     print(f"body fwd            : {out['fwd_no_ce']:8.2f} ms")
+    print(f"attention fwd+bwd   : {out['full'] - out['full_id_attn']:8.2f} ms")
+    print(f"all dropout         : {out['full'] - out['full_no_drop']:8.2f} ms")
     print(f"full step           : {out['full']:8.2f} ms")
     return out
 
