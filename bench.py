@@ -220,7 +220,95 @@ def _budget_left(budget_s):
     return budget_s - (time.monotonic() - _T0)
 
 
+def _release_memory():
+    """Free the previous config's HBM before the next one starts.
+
+    Observed r3 s4: ViT-L and MoE RESOURCE_EXHAUSTED only when run AFTER
+    gpt2+bert+llama in one process (each ran fine alone) — dead models'
+    buffers linger until a gc pass breaks the Layer/tape reference cycles,
+    and compiled executables can pin donated buffers. Configs cannot run
+    in subprocesses (the tunnel's TPU grant is exclusive and the parent
+    holds it), so: collect cycles, then hard-delete every remaining live
+    device array. Each bench function rebuilds all state from scratch and
+    reseeds (paddle.seed overwrites the global RNG key's array), so no
+    cross-config array survives legitimately."""
+    import gc
+    gc.collect()
+    try:
+        import jax
+        n = 0
+        for a in jax.live_arrays():
+            a.delete()
+            n += 1
+        if n:
+            print(f"bench: released {n} live device arrays",
+                  file=sys.stderr)
+    except Exception as e:   # release is best-effort; never kill the bench
+        print(f"bench: memory release failed: {e}", file=sys.stderr)
+
+
 _DONATE_OK = False  # set by _init_devices after a successful probe
+
+
+def _first_call_watchdog(enabled, timeout_s=900.0):
+    """Guard the first (compiling) call of a donated step: the donation
+    probe validates the mechanism on a tiny model, but a big-model-only
+    hang would wedge the bench while it holds the exclusive TPU grant.
+    On timeout: poison the donation cache so the driver's retry runs
+    undonated, then exit(3) like the init watchdog. Returns a disarm
+    callable; call it after the first step's host fetch."""
+    if not enabled:
+        return lambda: None
+    import threading
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(timeout_s):
+            try:
+                with open("/tmp/paddle_tpu_donate_bad", "w") as f:
+                    f.write(str(time.time()))
+                os.remove("/tmp/paddle_tpu_donate_ok")
+            except OSError:
+                pass
+            print("bench: donated step hung on first call; poisoned "
+                  "donation cache for the retry; exiting(3)",
+                  file=sys.stderr)
+            os._exit(3)
+    threading.Thread(target=_watch, daemon=True).start()
+    return done.set
+
+
+def _warm(train_step, args, n, donate):
+    """Warmup calls with the donation first-call watchdog armed; the
+    watchdog is ALWAYS disarmed on exit — a warmup exception is a failure
+    the per-config retry handles, not a hang, and an orphaned watchdog
+    would poison the donation cache and exit(3) a healthy later config."""
+    disarm = _first_call_watchdog(donate)
+    try:
+        for _ in range(n):
+            loss = train_step(*args)
+        float(np.asarray(loss._data))   # host fetch: drains the pipeline
+    finally:
+        disarm()
+
+
+def _timed_train(train_step, args, make_stacked, steps, scan_k):
+    """Median per-step seconds for a compiled train step, scan-amortized
+    when scan_k > 0 (k steps per device program via run_steps).
+    make_stacked() builds the [k, ...]-stacked per-step batches — called
+    only on the scan path so BENCH_SCAN=0 A/B runs don't upload unused
+    device buffers. Returns (med_s, loss)."""
+    if scan_k > 0:
+        stacked_args = make_stacked()
+        out = train_step.run_steps(scan_k, *stacked_args)  # compile + warm
+        float(np.asarray(out._data[-1]))
+        med_chunk, loss = _timed_steps(
+            lambda: train_step.run_steps(scan_k, *stacked_args),
+            lambda o: float(np.asarray(o._data[-1])),
+            max(steps // scan_k, 3))
+        return med_chunk / scan_k, loss
+    return _timed_steps(lambda: train_step(*args),
+                        lambda out: float(np.asarray(out._data)), steps)
 
 
 # --------------------------------------------------------------------------
@@ -260,59 +348,23 @@ def bench_gpt2(on_tpu, peak_tflops):
     donate = _DONATE_OK and on_tpu
     train_step = paddle.jit.to_static(_step, donate_state=donate)
 
-    # The probe validated donation on a tiny model; a big-model-only hang
-    # would still wedge us holding the exclusive TPU grant, so guard the
-    # first (compiling) call: on timeout, poison the donation cache so the
-    # driver's retry runs undonated, then exit(3) like the init watchdog.
-    watchdog_done = None
-    if donate:
-        import threading as _t
-        watchdog_done = _t.Event()
-
-        def _first_step_watchdog():
-            if not watchdog_done.wait(900.0):
-                try:
-                    with open("/tmp/paddle_tpu_donate_bad", "w") as f:
-                        f.write(str(time.time()))
-                    os.remove("/tmp/paddle_tpu_donate_ok")
-                except OSError:
-                    pass
-                print("bench: donated train_step hung; poisoned donation "
-                      "cache for the retry; exiting(3)", file=sys.stderr)
-                os._exit(3)
-        _t.Thread(target=_first_step_watchdog, daemon=True).start()
-
     # First call traces with slot creation (state superset), second call
     # recompiles into the steady signature — no eager per-op compile storm.
-    for _ in range(warmup):
-        loss = train_step(x, y)
-    float(np.asarray(loss._data))   # host fetch: drains the pipeline
-    if watchdog_done is not None:
-        watchdog_done.set()
+    _warm(train_step, (x, y), warmup, donate)
 
     # default on TPU: 8 steps per device program (lax.scan over the step) —
     # the tunnel backend pays a host RPC per dispatch, worth ~6.5 ms/step
-    # at the headline shape (measured r3 s4: 98.2 → 91.7 ms/step)
+    # at the headline shape (measured r3 s4: 98.2 → 91.7 ms/step).
+    # Distinct batches per step, stacked on a [k, ...] leading axis.
     scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "0"))
-    if scan_k > 0:
-        # k steps per device program (lax.scan over the compiled step):
-        # amortizes per-call dispatch/RPC latency — the tunnel backend pays
-        # a round-trip per dispatch. Distinct batches per step, stacked.
+
+    def make_stacked():
         sids = rng.randint(0, 50000,
                            (scan_k, batch, seq + 1)).astype(np.int32)
-        xs = paddle.to_tensor(sids[:, :, :-1])
-        ys = paddle.to_tensor(sids[:, :, 1:])
-        out = train_step.run_steps(scan_k, xs, ys)   # compile + warm
-        float(np.asarray(out._data[-1]))
-        med_chunk, final_loss = _timed_steps(
-            lambda: train_step.run_steps(scan_k, xs, ys),
-            lambda o: float(np.asarray(o._data[-1])),
-            max(steps // scan_k, 3))
-        med = med_chunk / scan_k
-    else:
-        med, final_loss = _timed_steps(
-            lambda: train_step(x, y),
-            lambda out: float(np.asarray(out._data)), steps)
+        return (paddle.to_tensor(sids[:, :, :-1]),
+                paddle.to_tensor(sids[:, :, 1:]))
+    med, final_loss = _timed_train(train_step, (x, y), make_stacked,
+                                   steps, scan_k)
     tokens_per_sec = batch * seq / med
 
     cfg = model.config
@@ -367,8 +419,7 @@ def bench_bert(on_tpu, peak_tflops):
     y = paddle.to_tensor(labels)
     nsp = paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32))
 
-    @paddle.jit.to_static
-    def train_step(x, y, nsp):
+    def _step(x, y, nsp):
         with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
             loss = model(x, masked_lm_labels=y, next_sentence_labels=nsp)
         loss.backward()
@@ -376,13 +427,21 @@ def bench_bert(on_tpu, peak_tflops):
         opt.clear_grad()
         return loss
 
-    for _ in range(3 if on_tpu else 1):
-        loss = train_step(x, y, nsp)
-    float(np.asarray(loss._data))
+    donate = _DONATE_OK and on_tpu
+    train_step = paddle.jit.to_static(_step, donate_state=donate)
+    _warm(train_step, (x, y, nsp), 3 if on_tpu else 1, donate)
 
-    med, final_loss = _timed_steps(
-        lambda: train_step(x, y, nsp),
-        lambda out: float(np.asarray(out._data)), steps)
+    scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "0"))
+
+    def make_stacked():
+        sids = rng.randint(0, vocab, (scan_k, batch, seq)).astype(np.int32)
+        slabels = sids.copy()
+        slabels[rng.rand(*slabels.shape) > 0.15] = -100
+        return (paddle.to_tensor(sids), paddle.to_tensor(slabels),
+                paddle.to_tensor(rng.randint(
+                    0, 2, (scan_k, batch)).astype(np.int32)))
+    med, final_loss = _timed_train(train_step, (x, y, nsp), make_stacked,
+                                   steps, scan_k)
     tokens_per_sec = batch * seq / med
     mfu = (6 * n_params * tokens_per_sec) / (peak_tflops * 1e12)
     return {
@@ -431,21 +490,26 @@ def bench_llama(on_tpu, peak_tflops):
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
 
-    @paddle.jit.to_static
-    def train_step(x, y):
+    def _step(x, y):
         loss = model(x, labels=y)
         loss.backward()
         opt.step()
         opt.clear_grad()
         return loss
 
-    for _ in range(3 if on_tpu else 1):
-        loss = train_step(x, y)
-    float(np.asarray(loss._data))
+    donate = _DONATE_OK and on_tpu
+    train_step = paddle.jit.to_static(_step, donate_state=donate)
+    _warm(train_step, (x, y), 3 if on_tpu else 1, donate)
 
-    med, final_loss = _timed_steps(
-        lambda: train_step(x, y),
-        lambda out: float(np.asarray(out._data)), steps)
+    scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "0"))
+
+    def make_stacked():
+        sids = rng.randint(0, c.vocab_size,
+                           (scan_k, batch, seq + 1)).astype(np.int32)
+        return (paddle.to_tensor(sids[:, :, :-1]),
+                paddle.to_tensor(sids[:, :, 1:]))
+    med, final_loss = _timed_train(train_step, (x, y), make_stacked,
+                                   steps, scan_k)
     tokens_per_sec = batch * seq / med
     flops_per_token = 6 * n_params + 12 * c.num_layers * c.hidden_size * seq
     mfu = (flops_per_token * tokens_per_sec) / (peak_tflops * 1e12)
@@ -466,6 +530,7 @@ def bench_vit(on_tpu, peak_tflops):
     import paddle_tpu as paddle
     from paddle_tpu.models.vit import vit_l_16, vit_tiny
 
+    paddle.seed(0)   # BEFORE model build: initializers draw from the key
     if on_tpu:
         # recompute: ViT-L b32 saved-residuals OOMed the tunnel chip twice
         # (r3 s3) — remat the 24 blocks, trading ~1/3 extra FLOPs for O(1)
@@ -477,7 +542,6 @@ def bench_vit(on_tpu, peak_tflops):
         model = vit_tiny()
         batch, size, steps = 2, 32, 2
 
-    paddle.seed(0)
     if on_tpu:
         model.bfloat16()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -492,8 +556,7 @@ def bench_vit(on_tpu, peak_tflops):
     y = paddle.to_tensor(rng.randint(
         0, 10, (batch,)).astype(np.int32))
 
-    @paddle.jit.to_static
-    def train_step(x, y):
+    def _step(x, y):
         logits = model(x)
         loss = paddle.nn.functional.cross_entropy(logits, y)
         loss.backward()
@@ -501,13 +564,23 @@ def bench_vit(on_tpu, peak_tflops):
         opt.clear_grad()
         return loss
 
-    for _ in range(3 if on_tpu else 1):
-        loss = train_step(x, y)
-    float(np.asarray(loss._data))
+    donate = _DONATE_OK and on_tpu
+    train_step = paddle.jit.to_static(_step, donate_state=donate)
+    _warm(train_step, (x, y), 3 if on_tpu else 1, donate)
 
-    med, final_loss = _timed_steps(
-        lambda: train_step(x, y),
-        lambda out: float(np.asarray(out._data)), steps)
+    # scan capped at 4: the stacked image batches are the one large input
+    # ([k, B, 3, 224, 224]); k=8 would hold ~150 MB of inputs resident
+    scan_k = min(int(os.environ.get("BENCH_SCAN", "4" if on_tpu else "0")), 4)
+
+    def make_stacked():
+        sx = rng.randn(scan_k, batch, 3, size, size).astype(np.float32)
+        xs = paddle.to_tensor(sx)
+        if on_tpu:
+            xs = xs.astype("bfloat16")
+        return (xs, paddle.to_tensor(
+            rng.randint(0, 10, (scan_k, batch)).astype(np.int32)))
+    med, final_loss = _timed_train(train_step, (x, y), make_stacked,
+                                   steps, scan_k)
     images_per_sec = batch / med
     # ViT-L/16 fwd ≈ 61 GFLOPs/image at 224², train ≈ 3×
     flops_per_image = (61e9 * 3) if on_tpu else (6 * n_params)
@@ -554,21 +627,26 @@ def bench_moe(on_tpu, peak_tflops):
     x = paddle.to_tensor(ids[:, :-1])
     y = paddle.to_tensor(ids[:, 1:])
 
-    @paddle.jit.to_static
-    def train_step(x, y):
+    def _step(x, y):
         loss = model(x, labels=y)
         loss.backward()
         opt.step()
         opt.clear_grad()
         return loss
 
-    for _ in range(3 if on_tpu else 1):
-        loss = train_step(x, y)
-    float(np.asarray(loss._data))
+    donate = _DONATE_OK and on_tpu
+    train_step = paddle.jit.to_static(_step, donate_state=donate)
+    _warm(train_step, (x, y), 3 if on_tpu else 1, donate)
 
-    med, final_loss = _timed_steps(
-        lambda: train_step(x, y),
-        lambda out: float(np.asarray(out._data)), steps)
+    scan_k = int(os.environ.get("BENCH_SCAN", "8" if on_tpu else "0"))
+
+    def make_stacked():
+        sids = rng.randint(0, c.vocab_size,
+                           (scan_k, batch, seq + 1)).astype(np.int32)
+        return (paddle.to_tensor(sids[:, :, :-1]),
+                paddle.to_tensor(sids[:, :, 1:]))
+    med, final_loss = _timed_train(train_step, (x, y), make_stacked,
+                                   steps, scan_k)
     tokens_per_sec = batch * seq / med
     return {
         "metric": "ernie_moe_ep_tokens_per_sec_per_chip",
@@ -643,6 +721,7 @@ def main():
         rec = None
         for attempt in (1, 2):
             try:
+                _release_memory()
                 rec = fn(on_tpu, peak_tflops)
                 break
             except Exception as e:

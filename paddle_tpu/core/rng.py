@@ -42,7 +42,16 @@ class _RngState(threading.local):
         # `_data is None` = the key was lazily created inside a to_static
         # trace that failed; the rollback (jit _execute) killed it. Rebuild
         # from the last seed so the retry reruns with live, tracked state.
-        if self.key_tensor is None or self.key_tensor._data is None:
+        # A DELETED device array (bench.py's inter-config memory release
+        # hard-deletes all live arrays) rebuilds the same way.
+        dead = (self.key_tensor is None or self.key_tensor._data is None)
+        if not dead:
+            is_del = getattr(self.key_tensor._data, "is_deleted", None)
+            try:
+                dead = bool(is_del()) if callable(is_del) else False
+            except Exception:
+                dead = False   # tracer mid-trace: live by definition
+        if dead:
             from ..tensor.tensor import Tensor, register_persistent
             self.key_tensor = Tensor(_key(self.seed_value))
             self.key_tensor.name = "global_rng_key"

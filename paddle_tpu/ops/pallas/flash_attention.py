@@ -57,7 +57,14 @@ def _block_sizes(sq: int, sk: int):
     step 91.7 -> 86.6 ms). VMEM per program at 1024 tiles is ~6 MB
     (s/p [1024,1024] f32 + q/k/v/acc tiles), still < the ~16 MB budget."""
     def pick(n, cap):
-        return min(cap, max(8, 1 << (n - 1).bit_length() if n < cap else cap))
+        if n < cap:
+            return max(8, 1 << (n - 1).bit_length())
+        # n >= cap: prefer the block size that minimizes ceil-padding —
+        # e.g. S=1536 under a 1024 cap would pad to 2048 (+78% masked
+        # tile compute) while 512 tiles fit exactly; ties go to the
+        # larger (more MXU-efficient) block
+        cands = [c for c in (cap, cap // 2) if c >= 256] or [cap]
+        return min(cands, key=lambda c: (math.ceil(n / c) * c, -c))
 
     import os
 

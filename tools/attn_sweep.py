@@ -53,9 +53,11 @@ def main():
     for bq, bk in points:
         os.environ["PADDLE_TPU_FLASH_BQ"] = str(bq)
         os.environ["PADDLE_TPU_FLASH_BK"] = str(bk)
-        # block sizes are read at trace time via _padded_sizes; import
-        # fresh each point and retrace (jit cache keys don't see env, so
-        # build the fn inside the loop with a distinct static arg)
+        # block sizes are read from env at TRACE time (_padded_sizes), and
+        # jit caches key on function identity — loss_fn/grad_fn MUST be
+        # rebuilt inside this loop so each point retraces and picks up the
+        # new env. Hoisting them out would silently pin every point to the
+        # first tiling.
         from paddle_tpu.ops.pallas import flash_attention as fa
 
         def loss_fn(q, k, v, seed):
