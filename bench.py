@@ -399,7 +399,11 @@ def bench_bert(on_tpu, peak_tflops):
     steps = 10 if on_tpu else 2
 
     paddle.seed(0)
-    model = BertForPretraining(bert_base() if on_tpu else bert_tiny())
+    # vocab padded 30522 -> 30720 (240x128): MXU lane alignment for the
+    # MLM decoder matmul, same trick as GPT-2's 50304 default; labels
+    # never index the 198 pad slots
+    model = BertForPretraining(bert_base(vocab_size=30720) if on_tpu
+                               else bert_tiny())
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     # AMP-O2: bf16 params + fp32 master weights (the reference's fp16-O2
