@@ -91,6 +91,33 @@ def _merge(o_a, lse_a, o_b, lse_b):
     return o_a * wa + o_b * wb, lse_new
 
 
+def _use_ring_kernel(q, k) -> bool:
+    """Dispatch the per-step chunk to the Pallas flash kernel on real TPU
+    only (PADDLE_TPU_RING_COMPOSITE=1 forces the dense composite).
+
+    Never on CPU — ring always runs inside shard_map, and interpret-mode
+    pallas inside shard_map trips a jax-0.9 check_vma limitation
+    (dynamic_slice with mixed varying-manual-axes; jax asks for an issue
+    + check_vma=False). The kernel itself is interpret-tested OUTSIDE
+    shard_map in tests/test_pallas_kernels.py; the ring schedule is
+    composite-tested on the CPU mesh; the combined path needs a real
+    chip."""
+    import os
+    if os.environ.get("PADDLE_TPU_RING_COMPOSITE") == "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from ..ops.pallas.ring_chunk_attention import is_supported
+        # is_supported takes kernel layout [B, H, S, D]; ring holds
+        # [B, S, H, D]
+        qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
+        ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
+        return is_supported(qs, ks, q.dtype)
+    except Exception:
+        return False
+
+
 def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
                    scale: Optional[float] = None, remat: bool = True):
     """Exact ring attention over a named mesh axis; call inside shard_map.
@@ -112,13 +139,30 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
         cols = src * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
         return cols <= rows
 
+    use_kernel = _use_ring_kernel(q, k)
+
     def step(carry, t):
         o_acc, lse_acc, k_cur, v_cur = carry
         src = (my - t) % n          # which rank's chunk is visiting
-        mask = causal_mask(src) if causal else None
 
-        def compute(q_, k_, v_):
-            return _chunk_attn(q_, k_, v_, scale, mask)
+        if use_kernel:
+            # Pallas flash chunk (ops/pallas/ring_chunk_attention): the
+            # visiting diagonal is the traced offset (my - src) * sq —
+            # one compiled kernel serves every ring step; lse is a
+            # differentiated output so merge weights backprop exactly
+            def compute(q_, k_, v_):
+                from ..ops.pallas.ring_chunk_attention import \
+                    ring_chunk_attention
+                off = (my - src) * sq if causal else k_.shape[1]
+                o_t, lse_t = ring_chunk_attention(
+                    jnp.swapaxes(q_, 1, 2), jnp.swapaxes(k_, 1, 2),
+                    jnp.swapaxes(v_, 1, 2), off, scale)
+                return jnp.swapaxes(o_t, 1, 2).astype(jnp.float32), lse_t
+        else:
+            mask = causal_mask(src) if causal else None
+
+            def compute(q_, k_, v_):
+                return _chunk_attn(q_, k_, v_, scale, mask)
 
         if remat:
             compute = jax.checkpoint(compute)

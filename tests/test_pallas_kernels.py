@@ -65,6 +65,121 @@ def test_flash_attention_fwd_bwd(b, sq, h, hk, d, causal, sk):
                                    atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_multiblock_split_bwd(monkeypatch, causal):
+    """Force 64-wide tiles so a 128/160-seq case runs the MULTI-block
+    grids and the split dKV/dQ backward (every default-tiling test shape
+    is single-block now that caps are 1024, and the fused single-block
+    backward handles those)."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "64")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BK", "64")
+    rng = np.random.RandomState(1)
+    b, sq, h, hk, d, sk = 1, 128, 4, 2, 32, 160
+    q = jnp.asarray(rng.randn(b, sq, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk, hk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk, hk, d), jnp.float32)
+
+    o = fa.flash_attention(q, k, v, causal=causal)
+    o_ref = _sdpa_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                               atol=2e-5, rtol=1e-4)
+    g1 = jax.grad(lambda *a: jnp.sum(
+        fa.flash_attention(*a, causal=causal) * 0.1), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_sdpa_ref(*a, causal) * 0.1),
+                  (0, 1, 2))(q, k, v)
+    for a, bb in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_flash_fused_vs_split_bwd_dropout(monkeypatch):
+    """The fused single-block backward and the split backward must produce
+    IDENTICAL gradients for the same dropout seed — both regenerate the
+    forward's mask from (seed, b, h, q-block, k-block) tile seeding, and a
+    drift here corrupts training only on one dispatch path."""
+    rng = np.random.RandomState(2)
+    b, s, h, d = 1, 64, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    seed = jnp.asarray(7, jnp.int32)
+
+    def g(path_split):
+        if path_split:
+            monkeypatch.setenv("PADDLE_TPU_FLASH_SPLIT_BWD", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_FLASH_SPLIT_BWD", raising=False)
+        return jax.grad(lambda *a: jnp.sum(fa.flash_attention(
+            *a, causal=True, dropout_p=0.3, dropout_seed=seed) * 0.1),
+            (0, 1, 2))(q, k, v)
+
+    for a, bb in zip(g(False), g(True)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.parametrize("off", [64, 0, -17, -64])
+def test_ring_chunk_attention_vs_composite(off):
+    """ops/pallas/ring_chunk_attention: one ring step's (o, lse) with a
+    TRACED diagonal offset, differentiable through BOTH outputs (the ring
+    merge weights chunks by lse, so dlse != 0). Checked against a dense
+    composite, GQA included; the loss routes through o AND a bounded
+    function of lse to exercise the delta_eff = rowsum(dO*O) - dlse
+    fold."""
+    from paddle_tpu.ops.pallas.ring_chunk_attention import \
+        ring_chunk_attention
+
+    def composite(q, k, v, offset, scale):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        sq, sk = q.shape[2], k.shape[2]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = cols <= rows + offset
+        s = jnp.where(mask[None, None], s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.where(mask[None, None], jnp.exp(s - m), 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        lsafe = jnp.where(l == 0, 1.0, l)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p / lsafe, v.astype(jnp.float32))
+        lse = jnp.where(l[..., 0] == 0, -1e30, (m + jnp.log(lsafe))[..., 0])
+        return o.astype(q.dtype), lse
+
+    rng = np.random.RandomState(0)
+    B, H, Hk, S, D = 1, 4, 2, 64, 32
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Hk, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Hk, S, D), jnp.float32)
+    g = H // Hk
+    kr, vr = jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+
+    o1, lse1 = ring_chunk_attention(q, k, v, off)
+    o2, lse2 = composite(q, kr, vr, off, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(lse1), np.asarray(lse2),
+                               atol=2e-4, rtol=1e-4)
+
+    def loss(fn):
+        def f(q, k, v):
+            o, lse = fn(q, k, v)
+            lsec = jnp.clip(lse, -30.0, 30.0)
+            return jnp.sum(o * jax.nn.sigmoid(lsec)[..., None] * 0.1)
+        return f
+
+    g1 = jax.grad(loss(lambda q, k, v: ring_chunk_attention(
+        q, k, v, off)), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: composite(
+        q, k, v, off, D ** -0.5)), (0, 1, 2))(q, kr, vr)
+    np.testing.assert_allclose(np.asarray(g1[0]), np.asarray(g2[0]),
+                               atol=2e-4, rtol=1e-3)
+    # GQA: composite grads are per-q-head — segment-sum to kv heads
+    for gi, gref in ((1, g2[1]), (2, g2[2])):
+        gref = gref.reshape(B, Hk, g, S, D).sum(axis=2)
+        np.testing.assert_allclose(np.asarray(g1[gi]), np.asarray(gref),
+                                   atol=2e-4, rtol=1e-3)
+
+
 def test_flash_attention_bf16():
     rng = np.random.RandomState(1)
     q = jnp.asarray(rng.randn(1, 128, 2, 64), jnp.bfloat16)
