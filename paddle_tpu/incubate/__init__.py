@@ -1,3 +1,6 @@
 from . import nn
+from . import asp
 from . import autograd
+from . import autotune
 from . import distributed
+from . import optimizer

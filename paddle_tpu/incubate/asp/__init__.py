@@ -1,0 +1,161 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Capability parity: python/paddle/incubate/asp/ (``prune_model``,
+``decorate``, ``set_excluded_layers``, ``reset_excluded_layers``,
+``calculate_density``, mask-check utilities backed by
+``asp/utils.py :: create_mask / check_sparsity``). The reference prunes
+FC/conv weights to 2:4 (or n:m) patterns so NVIDIA sparse tensor cores
+can consume them.
+
+TPU-native design (NOT a port): the MXU has no sparse mode, so the value
+here is (a) API parity for training recipes that prune-then-finetune and
+(b) the mask discipline itself — masks are applied as elementwise
+multiplies that XLA fuses into the consumer matmul's producer, and the
+``decorate``'d optimizer re-applies masks after every step (the
+reference's ASPHelper inserts the same masked-update ops). Masks are
+plain bf16/f32 0/1 tensors registered as non-trainable state; n:m
+selection is magnitude-based along the input dim, vectorized with one
+reshape+top-k per weight (no Python loops over rows).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers"]
+
+_excluded: set = set()          # layer (full) names excluded from pruning
+# Masks keyed by the pruned Parameter's uid, with a weakref.finalize
+# dropping the entry when the param dies (Tensor has __slots__, so the
+# mask can't ride on the object; a name-keyed dict would pin every pruned
+# model's masks for the process lifetime). Same pattern as the tensor
+# module's persistent-uid registry.
+_masks_by_uid: dict = {}
+
+
+def _set_mask(w, mask):
+    import weakref
+    if w._uid not in _masks_by_uid:
+        weakref.finalize(w, _masks_by_uid.pop, w._uid, None)
+    _masks_by_uid[w._uid] = mask
+
+
+def _get_mask(w):
+    return _masks_by_uid.get(w._uid)
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros in ``x`` (reference: asp/utils.py)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return float(jnp.count_nonzero(arr) / arr.size)
+
+
+def _nm_mask_2d(w: jnp.ndarray, n: int, m: int) -> jnp.ndarray:
+    """n:m mask along the INPUT (row) dim of a [in, out] weight: within
+    every group of m consecutive input rows per output column, keep the n
+    largest |w|. Vectorized: [in, out] -> [in//m, m, out] -> top-n per
+    group."""
+    rows, cols = w.shape
+    pad = (-rows) % m
+    wa = jnp.abs(jnp.pad(w, ((0, pad), (0, 0))))
+    g = wa.reshape(-1, m, cols)                       # [G, m, out]
+    # rank within each group: keep the n largest magnitudes
+    order = jnp.argsort(g, axis=1)                    # ascending
+    ranks = jnp.argsort(order, axis=1)                # rank of each entry
+    keep = ranks >= (m - n)
+    mask = keep.reshape(-1, cols)[:rows]
+    return mask.astype(w.dtype)
+
+
+def create_mask(w, func_name: str = "get_mask_2d_best", n: int = 2,
+                m: int = 4):
+    """n:m sparsity mask for a weight (2-D or conv kernels flattened to
+    2-D on the last dim, like the reference's mask helpers)."""
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(np.asarray(w))
+    shape = arr.shape
+    if arr.ndim < 2:
+        return jnp.ones_like(arr)
+    w2 = arr.reshape(-1, shape[-1])
+    mask = _nm_mask_2d(w2, n, m)
+    return mask.reshape(shape)
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group along the input dim has <= (m - n) zeros'
+    complement — i.e. at most n nonzeros (reference: check_mask_2d)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if arr.ndim < 2:
+        return True
+    w2 = arr.reshape(-1, arr.shape[-1])
+    rows, cols = w2.shape
+    pad = (-rows) % m
+    g = jnp.pad(w2, ((0, pad), (0, 0))).reshape(-1, m, cols)
+    nnz = jnp.sum((g != 0).astype(jnp.int32), axis=1)
+    return bool(jnp.all(nnz <= n))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Exclude layers/params (by name prefix) from pruning."""
+    for n in (param_names or []):
+        _excluded.add(str(n))
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(model):
+    from ...nn.layer.common import Linear
+    from ...nn.layer.conv import Conv2D
+    for lname, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, (Linear, Conv2D)):
+            continue
+        if any(lname.startswith(e) or getattr(layer, "full_name", lambda: "")()
+               .startswith(e) for e in _excluded):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is not None and w._data is not None and w._data.ndim >= 2:
+            yield lname, layer, w
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str =
+                "mask_1d", with_mask: bool = True):
+    """Prune every Linear/Conv2D weight to n:m sparsity in place and
+    remember the masks so a ``decorate``'d optimizer keeps them applied.
+    Returns {param_name: mask} like the reference."""
+    out = {}
+    for lname, layer, w in _prunable(model):
+        mask = create_mask(w, n=n, m=m)
+        w._data = w._data * mask
+        _set_mask(w, mask)
+        out[getattr(w, "name", None) or f"{lname}.weight"] = mask
+    return out
+
+
+class _ASPOptimizer:
+    """Wrapper re-applying sparsity masks after every step (the
+    reference's ASPHelper-decorated optimizer)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def step(self):
+        self._inner.step()
+        params = getattr(self._inner, "_parameter_list", None) or []
+        for p in params:
+            mask = _get_mask(p)
+            if mask is not None and p._data is not None:
+                p._data = p._data * mask.astype(p._data.dtype)
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so masked weights stay masked through updates."""
+    return _ASPOptimizer(optimizer)

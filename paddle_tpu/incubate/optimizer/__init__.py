@@ -1,0 +1,167 @@
+"""Incubate optimizers: LookAhead and ModelAverage.
+
+Capability parity: python/paddle/incubate/optimizer/{lookahead.py ::
+LookAhead, modelaverage.py :: ModelAverage}. TPU-style: the slow-weight /
+running-average state lives in plain jnp arrays registered as persistent
+(so the wrappers functionalize under jit.to_static like optimizer
+accumulators), and the k-step / window logic is host-side Python — it
+gates which compiled step runs, it is not data-dependent control flow
+inside a trace.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, register_persistent
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead wrapper: every k fast steps, slow <- slow + alpha *
+    (fast - slow) and fast <- slow (reference: lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {}          # param uid -> slow-weight Tensor
+        # seed slow weights from the INITIAL params now — lazily creating
+        # them at the first sync would seed slow = fast and silently drop
+        # the whole first-window pullback toward w0
+        for p in self._params():
+            self._slow_for(p)
+
+    def __getattr__(self, kk):
+        return getattr(self.inner_optimizer, kk)
+
+    def _params(self):
+        return getattr(self.inner_optimizer, "_parameter_list", None) or []
+
+    def _slow_for(self, p):
+        s = self._slow.get(p._uid)
+        if s is None or s._data is None:
+            s = Tensor(p._data)
+            s.name = (getattr(p, "name", None) or "param") + "@SLOW"
+            s.persistable = True
+            s.stop_gradient = True
+            register_persistent(s)
+            self._slow[p._uid] = s
+        return s
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            a = self.alpha
+            for p in self._params():
+                s = self._slow_for(p)
+                new_slow = s._data.astype(jnp.float32) * (1 - a) + \
+                    p._data.astype(jnp.float32) * a
+                s._data = new_slow.astype(s._data.dtype)
+                p._data = new_slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@LookAhead.step_count"] = self._step_count
+        for p in self._params():
+            s = self._slow.get(p._uid)
+            if s is not None:
+                sd[s.name] = s
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = int(sd.pop("@LookAhead.step_count",
+                                      self._step_count))
+        for p in self._params():
+            key = (getattr(p, "name", None) or "param") + "@SLOW"
+            if key in sd:
+                t = sd.pop(key)
+                self._slow_for(p)._data = jnp.asarray(
+                    t._data if isinstance(t, Tensor) else t)
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Running parameter average with apply()/restore() swap windows
+    (reference: modelaverage.py — simplified to a uniform running mean
+    over an accumulation window, the dominant use: evaluate with averaged
+    weights, restore, continue training)."""
+
+    def __init__(self, average_window_rate: float = 0.15, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000000, name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = list(parameters) if parameters is not None else []
+        self._sum = {}
+        self._count = 0
+        self._backup = None
+
+    def _acc_for(self, p):
+        s = self._sum.get(p._uid)
+        if s is None or s._data is None:
+            s = Tensor(jnp.zeros_like(p._data, jnp.float32))
+            s.name = (getattr(p, "name", None) or "param") + "@AVG_SUM"
+            s.persistable = True
+            s.stop_gradient = True
+            register_persistent(s)
+            self._sum[p._uid] = s
+        return s
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step);
+        the window restarts when it exceeds max_average_window * rate or
+        max_average_window, per the reference's window rules simplified
+        to a hard cap."""
+        cap = max(self.min_w, min(self.max_w,
+                                  int(self.max_w * self.rate) or 1))
+        if self._count >= cap:
+            self._count = 0
+            for p in self._params:
+                self._acc_for(p)._data = jnp.zeros_like(
+                    p._data, jnp.float32)
+        for p in self._params:
+            s = self._acc_for(p)
+            s._data = s._data + p._data.astype(jnp.float32)
+        self._count += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap the averaged weights in (context-manager compatible).
+        Idempotent: a second apply() without restore() is a no-op — it
+        must NOT overwrite the backup with the averaged weights."""
+        if self._count == 0 or self._backup is not None:
+            return self
+        self._backup = {p._uid: p._data for p in self._params}
+        inv = 1.0 / float(self._count)
+        for p in self._params:
+            s = self._sum[p._uid]
+            p._data = (s._data * inv).astype(p._data.dtype)
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            if p._uid in self._backup:
+                p._data = self._backup[p._uid]
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False

@@ -107,15 +107,15 @@ def _use_ring_kernel(q, k) -> bool:
         return False
     if jax.default_backend() != "tpu":
         return False
-    try:
-        from ..ops.pallas.ring_chunk_attention import is_supported
-        # is_supported takes kernel layout [B, H, S, D]; ring holds
-        # [B, S, H, D]
-        qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
-        ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
-        return is_supported(qs, ks, q.dtype)
-    except Exception:
-        return False
+    # deliberately NOT a blanket except: an ImportError/regression in the
+    # kernel module must surface, not silently downgrade every TPU ring
+    # step to the O(S^2) dense composite
+    from ..ops.pallas.ring_chunk_attention import is_supported
+    # is_supported takes kernel layout [B, H, S, D]; ring holds
+    # [B, S, H, D]
+    qs = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
+    ks = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
+    return is_supported(qs, ks, q.dtype)
 
 
 def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
