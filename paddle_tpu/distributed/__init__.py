@@ -11,9 +11,11 @@ from .communication.all_reduce import all_reduce
 from .communication.group import (new_group, get_group, destroy_process_group,
                                   is_initialized, ReduceOp, Group)
 from .communication.ops import (all_gather, all_gather_object, broadcast,
-                                reduce, scatter, alltoall, alltoall_single,
-                                send, recv, isend, irecv, barrier,
-                                reduce_scatter, stream)
+                                broadcast_object_list, reduce, scatter,
+                                scatter_object_list, gather, alltoall,
+                                alltoall_single, send, recv, isend, irecv,
+                                P2POp, batch_isend_irecv, barrier,
+                                reduce_scatter, get_backend, stream)
 from . import fleet
 from . import sharding
 from .auto_parallel.api import shard_tensor, ProcessMesh, shard_op

@@ -16,9 +16,11 @@ import jax.numpy as jnp
 from ...tensor.tensor import Tensor
 from .group import ReduceOp, Task, _default_group
 
-__all__ = ["all_gather", "all_gather_object", "broadcast", "reduce",
-           "scatter", "alltoall", "alltoall_single", "send", "recv", "isend",
-           "irecv", "barrier", "reduce_scatter", "stream"]
+__all__ = ["all_gather", "all_gather_object", "broadcast",
+           "broadcast_object_list", "reduce", "scatter",
+           "scatter_object_list", "gather", "alltoall", "alltoall_single",
+           "send", "recv", "isend", "irecv", "P2POp", "batch_isend_irecv",
+           "barrier", "reduce_scatter", "get_backend", "stream"]
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
@@ -199,6 +201,96 @@ def isend(tensor, dst=0, group=None):
 
 def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast a list of picklable objects from src (reference:
+    communication/broadcast.py :: broadcast_object_list). Realized over
+    all_gather_object — on the ICI torus a gather-and-pick costs the same
+    ring traversal as a broadcast for the small control payloads this
+    API carries."""
+    g = group or _default_group()
+    if g.nranks <= 1:
+        return
+    if g.ranks and g.rank < 0:
+        return                      # not a member of this group: no-op
+    src_gr = g.get_group_rank(src) if g.ranks else src
+    if src_gr < 0:
+        raise ValueError(f"src {src} is not in the group")
+    gathered = []
+    all_gather_object(gathered, list(object_list), group=g)
+    object_list[:] = gathered[src_gr]
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter one picklable object per rank from src (reference:
+    communication/scatter.py :: scatter_object_list)."""
+    g = group or _default_group()
+    if g.nranks <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return
+    if g.ranks and g.rank < 0:
+        return                      # not a member of this group: no-op
+    payload = list(in_object_list or [None] * g.nranks)
+    broadcast_object_list(payload, src=src, group=g)
+    me = max(g.rank, 0)
+    out_object_list[:] = [payload[me]]
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors onto dst (reference: communication/gather.py).
+    All-ranks allgather + keep-on-dst: XLA collectives are SPMD — every
+    rank executes the same program, and non-dst ranks simply drop the
+    result (dead code at their slice)."""
+    g = group or _default_group()
+    if g.nranks <= 1:
+        if gather_list is not None:
+            gather_list.clear()
+            gather_list.append(Tensor(tensor._data))
+        return Task()
+    if g.ranks and g.rank < 0:
+        return Task()               # not a member of this group: no-op
+    dst_gr = g.get_group_rank(dst) if g.ranks else dst
+    if dst_gr < 0:
+        raise ValueError(f"dst {dst} is not in the group")
+    outs = []
+    t = all_gather(outs, tensor, group=g)
+    if gather_list is not None and max(g.rank, 0) == dst_gr:
+        gather_list.clear()
+        gather_list.extend(outs)
+    return t
+
+
+class P2POp:
+    """One batched point-to-point descriptor (reference:
+    communication/batch_isend_irecv.py :: P2POp): op is the module-level
+    isend/irecv function; executed by batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend "
+                             "or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps; returns their Tasks. On TPU each pair
+    lowers to a ppermute — XLA fuses/pipelines the batch over ICI, so
+    batching here is API parity (the reference batches to share one NCCL
+    group call)."""
+    if not p2p_op_list:
+        return []
+    return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
+
+
+def get_backend(group=None):
+    """Backend name of the group (reference returns 'NCCL'/'GLOO'): the
+    TPU realization is XLA collectives over ICI/DCN."""
+    return "XLA"
 
 
 def barrier(group=None):

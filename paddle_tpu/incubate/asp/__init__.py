@@ -125,14 +125,20 @@ def _prunable(model):
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str =
                 "mask_1d", with_mask: bool = True):
-    """Prune every Linear/Conv2D weight to n:m sparsity in place and
-    remember the masks so a ``decorate``'d optimizer keeps them applied.
+    """Prune every Linear/Conv2D weight to n:m sparsity in place.
+    with_mask=True registers the masks so a ``decorate``'d optimizer
+    keeps them applied during finetuning; with_mask=False is one-shot
+    (inference-style) pruning with no retained masks. All mask_algo
+    variants select by magnitude along the input dim here (the
+    reference's 1d/2d_greedy/2d_best differ in pattern geometry tuned
+    for sparse tensor cores, which the MXU does not have).
     Returns {param_name: mask} like the reference."""
     out = {}
     for lname, layer, w in _prunable(model):
         mask = create_mask(w, n=n, m=m)
         w._data = w._data * mask
-        _set_mask(w, mask)
+        if with_mask:
+            _set_mask(w, mask)
         out[getattr(w, "name", None) or f"{lname}.weight"] = mask
     return out
 

@@ -29,7 +29,11 @@ def set_config(config=None):
     at trace time."""
     global _config
     if config is None:
+        # reset-to-enabled-defaults: no tuning_range anymore, so the tile
+        # caps must unpin too (kernels read env at trace time)
         _config = {k: {"enable": True} for k in _config}
+        os.environ.pop("PADDLE_TPU_FLASH_BQ", None)
+        os.environ.pop("PADDLE_TPU_FLASH_BK", None)
         return
     if isinstance(config, str):
         with open(config) as f:

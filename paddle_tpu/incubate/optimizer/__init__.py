@@ -80,6 +80,8 @@ class LookAhead:
         return sd
 
     def set_state_dict(self, sd):
+        sd = dict(sd)   # never mutate the caller's dict: it may feed a
+        #                 second consumer or be re-saved
         self._step_count = int(sd.pop("@LookAhead.step_count",
                                       self._step_count))
         for p in self._params():

@@ -117,6 +117,31 @@ class TestEagerCollectivesSingleWorld:
         paddle.distributed.barrier()
         np.testing.assert_allclose(t.numpy(), [5.0])
 
+    def test_gather_world1(self):
+        outs = []
+        paddle.distributed.gather(paddle.to_tensor([7.0]), outs, dst=0)
+        assert len(outs) == 1
+        np.testing.assert_allclose(outs[0].numpy(), [7.0])
+
+    def test_object_list_collectives_world1(self):
+        objs = [{"a": 1}, "x"]
+        paddle.distributed.broadcast_object_list(objs, src=0)
+        assert objs == [{"a": 1}, "x"]
+        out = []
+        paddle.distributed.scatter_object_list(out, [("p", 2)], src=0)
+        assert out == [("p", 2)]
+
+    def test_p2pop_batch_and_backend(self):
+        dist = paddle.distributed
+        assert dist.get_backend() == "XLA"
+        t = paddle.to_tensor([1.0])
+        ops = [dist.P2POp(dist.isend, t, 0), dist.P2POp(dist.irecv, t, 0)]
+        tasks = dist.batch_isend_irecv(ops)
+        assert len(tasks) == 2
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            dist.P2POp(dist.all_reduce, t, 0)
+
 
 class TestTopology:
     def test_rank_coord_mapping(self):
