@@ -215,10 +215,14 @@ def broadcast_object_list(object_list, src=0, group=None):
     if g.ranks and g.rank < 0:
         return                      # not a member of this group: no-op
     src_gr = g.get_group_rank(src) if g.ranks else src
-    if src_gr < 0:
+    if src_gr < 0 or src_gr >= g.nranks:
         raise ValueError(f"src {src} is not in the group")
+    # only src's payload is serialized — non-src ranks contribute None so
+    # their placeholder contents need not be picklable (reference
+    # semantics); the gather costs one payload + (n-1) None pickles
+    mine = list(object_list) if max(g.rank, 0) == src_gr else None
     gathered = []
-    all_gather_object(gathered, list(object_list), group=g)
+    all_gather_object(gathered, mine, group=g)
     object_list[:] = gathered[src_gr]
 
 
@@ -252,7 +256,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     if g.ranks and g.rank < 0:
         return Task()               # not a member of this group: no-op
     dst_gr = g.get_group_rank(dst) if g.ranks else dst
-    if dst_gr < 0:
+    if dst_gr < 0 or dst_gr >= g.nranks:
         raise ValueError(f"dst {dst} is not in the group")
     outs = []
     t = all_gather(outs, tensor, group=g)

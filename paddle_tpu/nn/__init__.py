@@ -1,7 +1,9 @@
 """paddle.nn namespace. Parity: python/paddle/nn/__init__.py."""
 from . import functional
+from . import utils
 from . import initializer
-from .layer.layers import Layer, LayerList, ParameterList, Sequential
+from .layer.layers import (Layer, LayerDict, LayerList, ParameterList,
+                           Sequential)
 from .layer.common import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403

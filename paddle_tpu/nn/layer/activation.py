@@ -7,7 +7,7 @@ from ...tensor.tensor import Parameter
 from .. import functional as F
 from .layers import Layer
 
-__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish",
+__all__ = ["Softmax2D", "ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "Swish",
            "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh", "Hardshrink",
            "Softshrink", "Tanhshrink", "LeakyReLU", "PReLU", "RReLU",
            "LogSigmoid", "LogSoftmax", "Softmax", "Softplus", "Softsign",
@@ -149,6 +149,20 @@ class Softmax(Layer):
 
     def forward(self, x):
         return F.softmax(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW / CHW inputs (reference:
+    nn.Softmax2D): each spatial location's channel vector sums to 1."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3-D or 4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)
 
 
 class Softplus(Layer):

@@ -14,7 +14,8 @@ __all__ = ["Linear", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
            "Embedding", "Flatten", "Upsample", "UpsamplingBilinear2D",
            "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "CosineSimilarity", "Bilinear", "Identity", "Unfold", "Fold",
-           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle"]
+           "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+           "Unflatten", "PairwiseDistance"]
 
 
 def _resolve_init(attr, default):
@@ -273,3 +274,37 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """Reshape one axis into the given shape (reference: nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_ = int(axis), tuple(int(s) for s in shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape_)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row vectors (reference: nn.PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = float(p), epsilon, keepdim
+
+    def forward(self, x, y):
+        from ...tensor.tensor import apply_op
+        import jax.numpy as jnp
+
+        def f(a, b):
+            d = (a - b).astype(jnp.float32) + self.epsilon
+            if self.p == float("inf"):
+                out = jnp.max(jnp.abs(d), axis=-1, keepdims=self.keepdim)
+            else:
+                out = jnp.sum(jnp.abs(d) ** self.p, axis=-1,
+                              keepdims=self.keepdim) ** (1.0 / self.p)
+            return out.astype(a.dtype)
+        return apply_op(f, x, y)

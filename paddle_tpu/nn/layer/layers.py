@@ -15,7 +15,7 @@ import numpy as np
 from ...core.dtype import convert_dtype
 from ...tensor.tensor import Parameter, Tensor, no_grad
 
-__all__ = ["Layer", "LayerList", "ParameterList", "Sequential",
+__all__ = ["Layer", "LayerList", "LayerDict", "ParameterList", "Sequential",
            "enable_static", "disable_static", "in_dynamic_mode"]
 
 _dynamic_mode = [True]
@@ -386,6 +386,57 @@ class LayerList(Layer):
 
     def forward(self, *a, **k):
         raise NotImplementedError("LayerList is a container")
+
+
+class LayerDict(Layer):
+    """Ordered dict of sublayers (reference: nn.LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(str(key), layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        if isinstance(sublayers, dict):
+            sublayers = sublayers.items()
+        for k, v in sublayers:
+            self.add_sublayer(str(k), v)
+        return self
 
 
 class ParameterList(Layer):

@@ -1,8 +1,11 @@
 """Loss layers. Parity: python/paddle/nn/layer/loss.py."""
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from .. import functional as F
-from .layers import Layer
+from .layers import Layer, LayerList, Sequential
 
 __all__ = ["CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
            "L1Loss", "MSELoss", "SmoothL1Loss", "KLDivLoss",
@@ -203,3 +206,92 @@ class TripletMarginWithDistanceLoss(Layer):
 
 __all__ += ["GaussianNLLLoss", "PoissonNLLLoss", "SoftMarginLoss",
             "MultiLabelSoftMarginLoss", "TripletMarginWithDistanceLoss"]
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Hierarchical (adaptive) softmax (reference:
+    nn.AdaptiveLogSoftmaxWithLoss): frequent classes in a head softmax,
+    rare classes in down-projected tail clusters entered through one head
+    slot each. TPU-first: every token computes head + ALL tail clusters
+    (static shapes — no data-dependent gather of "which cluster"), with
+    the per-token cluster selected by jnp.where masks; the extra tail
+    FLOPs are dwarfed by the head matmul at realistic cutoffs and keep
+    the step jit-compilable."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .common import Linear
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError("cutoffs must be unique, positive, "
+                             "increasing, and < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = float(div_value)
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=head_bias)
+        self.tail = LayerList()
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (self.div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            self.tail.append(Sequential(
+                ("proj", Linear(in_features, hsz, bias_attr=False)),
+                ("out", Linear(hsz, osz, bias_attr=False)),
+            ))
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        from ...tensor.tensor import apply_op
+        head_out = self.head(input)
+        tails = [t(input) for t in self.tail]
+
+        def f(h, *ts):
+            hl = jax.nn.log_softmax(h.astype(jnp.float32), axis=-1)
+            parts = [hl[..., : self.cutoffs[0]]]
+            for i, t in enumerate(ts):
+                tl = jax.nn.log_softmax(t.astype(jnp.float32), axis=-1)
+                parts.append(tl + hl[..., self.cutoffs[0] + i:
+                                     self.cutoffs[0] + i + 1])
+            return jnp.concatenate(parts, axis=-1)
+        return apply_op(f, head_out, *tails)
+
+    def forward(self, input, label):
+        """Returns (output [N] = per-sample TARGET log-prob, scalar mean
+        NLL) — the reference's contract (output is not the full
+        distribution; use log_prob for that)."""
+        from ...tensor.tensor import apply_op
+        logp = self.log_prob(input)
+
+        def tok_logp(lp, y):
+            return jnp.take_along_axis(
+                lp, y.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        out = apply_op(tok_logp, logp, label)
+        loss = apply_op(lambda t: -jnp.mean(t), out)
+        return out, loss
+
+    def predict(self, input):
+        from ...tensor.tensor import apply_op
+        logp = self.log_prob(input)
+        return apply_op(lambda lp: jnp.argmax(lp, axis=-1).astype(
+            jnp.int32), logp)
+
+
+__all__ += ["MultiMarginLoss", "AdaptiveLogSoftmaxWithLoss"]

@@ -1,0 +1,202 @@
+"""nn.utils — weight/spectral norm reparametrizations and param helpers.
+
+Parity: python/paddle/nn/utils/{weight_norm_hook.py :: weight_norm /
+remove_weight_norm, spectral_norm_hook.py :: spectral_norm,
+clip_grad_norm_.py, clip_grad_value_.py, transform_parameters.py ::
+parameters_to_vector / vector_to_parameters}.
+
+TPU-style: reparametrizations are forward-pre-hooks recomputing the
+effective weight from the decomposed parameters each call — under
+jit.to_static the recompute traces into the step and XLA fuses it; the
+decomposed params (g, v / weight_orig) are what the optimizer sees.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Parameter, Tensor, apply_op
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    """L2 norm over all axes except `dim` (dim=None: over everything)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Decompose layer.<name> into magnitude g and direction v with
+    W = g * v / ||v|| (norm over every axis except `dim`). Returns the
+    layer; optimizer trains g and v."""
+    w = getattr(layer, name)
+    wd = w._data.astype(jnp.float32)
+    g0 = _norm_except(wd, dim)
+    g = Parameter(g0.astype(w._data.dtype))
+    g.name = (getattr(w, "name", None) or name) + "_g"
+    v = Parameter(w._data)
+    v.name = (getattr(w, "name", None) or name) + "_v"
+    # replace the trained param: remove W, add (g, v)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def _recompute(lay, inputs):
+        def f(ga, va):
+            va32 = va.astype(jnp.float32)
+            nrm = jnp.maximum(_norm_except(va32, dim), 1e-12)
+            return (ga.astype(jnp.float32) * va32 / nrm).astype(va.dtype)
+        setattr(lay, name, apply_op(f, getattr(lay, name + "_g"),
+                                    getattr(lay, name + "_v")))
+        return None
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_state = (name, dim, handle)
+    _recompute(layer, None)            # effective weight valid pre-call too
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g*v/||v|| back into a single trained weight."""
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"weight_norm was not applied to '{name}'")
+    _, dim, handle = state
+    handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    v32 = v._data.astype(jnp.float32)
+    w = (g._data.astype(jnp.float32) * v32 /
+         jnp.maximum(_norm_except(v32, dim), 1e-12)).astype(v._data.dtype)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    p = Parameter(w)
+    p.name = name
+    layer.add_parameter(name, p)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Reparametrize layer.<name> as W / sigma_max(W), sigma estimated by
+    power iteration with persistent u (reference spectral_norm_hook).
+    The power-iteration state updates eagerly per call (stop-gradient),
+    matching the reference's buffer semantics."""
+    w = getattr(layer, name)
+    shape = w._data.shape
+    h = shape[dim]
+    u0 = jax.random.normal(jax.random.PRNGKey(0), (h,), jnp.float32)
+    u_t = Tensor(u0 / jnp.maximum(jnp.linalg.norm(u0), eps))
+    u_t.stop_gradient = True
+    layer.register_buffer(name + "_u", u_t) if hasattr(
+        layer, "register_buffer") else setattr(layer, name + "_u_buf", u_t)
+
+    orig = Parameter(w._data)
+    orig.name = (getattr(w, "name", None) or name) + "_orig"
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+
+    def _mat(wd):
+        if dim != 0:
+            perm = (dim,) + tuple(i for i in range(wd.ndim) if i != dim)
+            wd = jnp.transpose(wd, perm)
+        return wd.reshape(wd.shape[0], -1)
+
+    def _power_iter(wm, u):
+        vv = None
+        for _ in range(max(n_power_iterations, 1)):
+            vv = wm.T @ u
+            vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+            u = wm @ vv
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        return u, vv
+
+    def _recompute(lay, inputs):
+        wo = getattr(lay, name + "_orig")
+        # ONE power iteration per call: advance u eagerly (stop-gradient
+        # buffer semantics), then reuse the converged (u, v) inside the
+        # traced sigma computation
+        wm_host = _mat(jax.lax.stop_gradient(wo._data).astype(jnp.float32))
+        u_new, v_new = _power_iter(wm_host, u_t._data)
+        u_t._data = u_new
+
+        def f(wo_):
+            wm = _mat(wo_.astype(jnp.float32))
+            sigma = u_new @ (wm @ v_new)
+            return (wo_.astype(jnp.float32) / jnp.maximum(sigma, eps)
+                    ).astype(wo_.dtype)
+        setattr(lay, name, apply_op(f, wo))
+        return None
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_state = (name, handle)
+    _recompute(layer, None)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """In-place global-norm clip of .grad across parameters; returns the
+    total norm (reference clip_grad_norm_)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    max_norm = float(max_norm)
+    if math.isinf(norm_type):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data.astype(jnp.float32))) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of order {norm_type} is non-finite")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for g in grads:
+        g._data = (g._data.astype(jnp.float32) * scale).astype(g._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = abs(float(clip_value))
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -cv, cv)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten-and-concat parameters into one 1-D tensor in the
+    parameters' common (promoted) dtype — no forced f32 cast."""
+    params = list(parameters)
+    dtype = jnp.result_type(*(p._data.dtype for p in params)) if params \
+        else jnp.float32
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1).astype(dtype) for p in params]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Inverse of parameters_to_vector: writes slices back in place.
+    Validates the length BEFORE mutating anything — a failed call must
+    not leave the model half-overwritten."""
+    params = list(parameters)
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    need = sum(p.size for p in params)
+    if need != data.size:
+        raise ValueError(f"vector has {data.size} elements; parameters "
+                         f"need {need}")
+    off = 0
+    for p in params:
+        n = p.size
+        p._data = data[off:off + n].reshape(p.shape).astype(p._data.dtype)
+        off += n

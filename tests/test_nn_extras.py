@@ -1,0 +1,168 @@
+"""nn additions: Unflatten, PairwiseDistance, Softmax2D, LayerDict,
+MultiMarginLoss, AdaptiveLogSoftmaxWithLoss, nn.utils (weight/spectral
+norm, clip_grad, parameter<->vector)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.utils import (clip_grad_norm_, clip_grad_value_,
+                                 parameters_to_vector, remove_weight_norm,
+                                 spectral_norm, vector_to_parameters,
+                                 weight_norm)
+
+
+def test_unflatten_layer():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 12))
+    out = paddle.nn.Unflatten(1, (3, 4))(x)
+    assert out.shape == [2, 3, 4]
+    np.testing.assert_allclose(np.asarray(out._data).ravel(),
+                               np.arange(24, dtype=np.float32))
+
+
+def test_pairwise_distance():
+    rng = np.random.RandomState(0)
+    a, b = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(
+        np.float32)
+    out = paddle.nn.PairwiseDistance(p=2.0)(paddle.to_tensor(a),
+                                            paddle.to_tensor(b))
+    ref = np.linalg.norm((a - b) + 1e-6, axis=-1)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5)
+
+
+def test_softmax2d():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 4, 5)
+                         .astype(np.float32))
+    out = np.asarray(paddle.nn.Softmax2D()(x)._data)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones((2, 4, 5)),
+                               rtol=1e-5)
+    with pytest.raises(ValueError):
+        paddle.nn.Softmax2D()(paddle.to_tensor(np.zeros((2, 3),
+                                                        np.float32)))
+
+
+def test_layer_dict():
+    d = paddle.nn.LayerDict({"a": paddle.nn.Linear(4, 4),
+                             "b": paddle.nn.ReLU()})
+    assert "a" in d and len(d) == 2
+    assert list(d.keys()) == ["a", "b"]
+    layer = d.pop("b")
+    assert isinstance(layer, paddle.nn.ReLU) and len(d) == 1
+    d["c"] = paddle.nn.Linear(4, 2)
+    assert [k for k in d] == ["a", "c"]
+    # params of contained layers are registered
+    assert len(list(d.parameters())) == 4
+
+
+def test_multi_margin_loss_layer():
+    logits = paddle.to_tensor(np.array([[0.5, 1.5, 0.1],
+                                        [2.0, 0.3, 0.2]], np.float32))
+    y = paddle.to_tensor(np.array([1, 0], np.int32))
+    loss = paddle.nn.MultiMarginLoss()(logits, y)
+    x = np.array([[0.5, 1.5, 0.1], [2.0, 0.3, 0.2]], np.float32)
+    ref = np.mean([np.mean([max(0, 1 - x[0, 1] + x[0, j]) for j in (0, 2)]),
+                   np.mean([max(0, 1 - x[1, 0] + x[1, j]) for j in (1, 2)])])
+    np.testing.assert_allclose(float(np.asarray(loss._data)), ref,
+                               rtol=1e-5)
+
+
+def test_adaptive_log_softmax_with_loss():
+    paddle.seed(0)
+    N, D, C = 6, 16, 20
+    als = paddle.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[5, 12])
+    x = paddle.to_tensor(np.random.RandomState(2).randn(N, D)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 4, 6, 11, 13, 19], np.int32))
+    logp = np.asarray(als.log_prob(x)._data)
+    assert logp.shape == (N, C)
+    # rows are valid log-distributions over all classes
+    np.testing.assert_allclose(np.exp(logp).sum(-1), np.ones(N), rtol=1e-4)
+    out, loss = als(x, y)
+    tgt = logp[np.arange(N), np.asarray(y._data)]
+    # reference contract: output is the [N] per-sample TARGET log-prob
+    np.testing.assert_allclose(np.asarray(out._data), tgt, rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(loss._data)),
+                               -np.mean(tgt), rtol=1e-5)
+    pred = np.asarray(als.predict(x)._data)
+    np.testing.assert_array_equal(pred, logp.argmax(-1))
+    with pytest.raises(ValueError):
+        paddle.nn.AdaptiveLogSoftmaxWithLoss(D, C, cutoffs=[12, 5])
+
+
+def test_weight_norm_roundtrip():
+    paddle.seed(3)
+    lin = paddle.nn.Linear(8, 4)
+    w0 = np.asarray(lin.weight._data).copy()
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 8)
+                         .astype(np.float32))
+    y0 = np.asarray(lin(x)._data)
+    weight_norm(lin, "weight", dim=0)
+    names = [n for n, _ in lin.named_parameters()]
+    assert any(n.endswith("weight_g") for n in names)
+    assert any(n.endswith("weight_v") for n in names)
+    y1 = np.asarray(lin(x)._data)
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-6)
+    # g scales the effective weight norm
+    remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(np.asarray(lin.weight._data), w0,
+                               rtol=1e-5, atol=1e-6)
+    y2 = np.asarray(lin(x)._data)
+    np.testing.assert_allclose(y2, y0, rtol=1e-5, atol=1e-6)
+
+
+def test_weight_norm_trains_g_v():
+    paddle.seed(4)
+    lin = paddle.nn.Linear(6, 3)
+    weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.RandomState(4).randn(4, 6)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    g0 = np.asarray(lin.weight_g._data).copy()
+    for _ in range(2):
+        loss = paddle.nn.functional.cross_entropy(lin(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert not np.allclose(np.asarray(lin.weight_g._data), g0)
+
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(5)
+    lin = paddle.nn.Linear(8, 8)
+    spectral_norm(lin, n_power_iterations=20)
+    x = paddle.to_tensor(np.eye(8, dtype=np.float32))
+    lin(x)   # triggers recompute with converged power iteration
+    w = np.asarray(lin.weight._data)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
+
+
+def test_clip_grad_helpers():
+    p = paddle.to_tensor(np.zeros(4, np.float32))
+    p.stop_gradient = False
+    from paddle_tpu.tensor.tensor import Tensor
+    import jax.numpy as jnp
+    p.grad = Tensor(jnp.asarray(np.array([3.0, 4.0, 0.0, 0.0],
+                                         np.float32)))
+    total = clip_grad_norm_([p], max_norm=2.5)
+    np.testing.assert_allclose(float(np.asarray(total._data)), 5.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(p.grad._data)),
+                               2.5, rtol=1e-5)
+    clip_grad_value_([p], 0.5)
+    assert np.abs(np.asarray(p.grad._data)).max() <= 0.5 + 1e-7
+
+
+def test_parameters_vector_roundtrip():
+    paddle.seed(6)
+    lin = paddle.nn.Linear(5, 3)
+    params = list(lin.parameters())
+    vec = parameters_to_vector(params)
+    assert vec.shape == [5 * 3 + 3]
+    newv = np.asarray(vec._data) * 2.0
+    from paddle_tpu.tensor.tensor import Tensor
+    import jax.numpy as jnp
+    vector_to_parameters(Tensor(jnp.asarray(newv)), params)
+    np.testing.assert_allclose(np.asarray(lin.weight._data).ravel(),
+                               newv[:15], rtol=1e-6)
