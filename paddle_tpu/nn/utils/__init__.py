@@ -76,6 +76,9 @@ def remove_weight_norm(layer, name: str = "weight"):
          jnp.maximum(_norm_except(v32, dim), 1e-12)).astype(v._data.dtype)
     del layer._parameters[name + "_g"]
     del layer._parameters[name + "_v"]
+    # the pre-hook stored the effective weight as a PLAIN attr in
+    # __dict__; it would shadow the re-registered Parameter on lookup
+    layer.__dict__.pop(name, None)
     p = Parameter(w)
     p.name = name
     layer.add_parameter(name, p)

@@ -123,3 +123,499 @@ class Transpose:
     def __call__(self, img):
         arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
         return Tensor(arr.transpose(self.order))
+
+
+# ---------------------------------------------------------------------------
+# Functional API (host-side numpy: these run in the input pipeline before
+# device transfer, like the reference's transforms.functional on ndarray)
+# ---------------------------------------------------------------------------
+
+def _to_arr(img):
+    """ndarray view of the input + whether it was a Tensor + CHW flag."""
+    was_tensor = isinstance(img, Tensor)
+    arr = img.numpy() if was_tensor else np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3)
+    return arr, was_tensor, chw
+
+
+def _wrap(arr, was_tensor):
+    return Tensor(np.ascontiguousarray(arr)) if was_tensor else arr
+
+
+def _hwc(arr, chw):
+    return arr.transpose(1, 2, 0) if chw else arr
+
+
+def _unhwc(arr, chw):
+    return arr.transpose(2, 0, 1) if chw else arr
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def hflip(img):
+    arr, wt, chw = _to_arr(img)
+    return _wrap(arr[..., ::-1] if (chw or arr.ndim == 2) else
+                 arr[:, ::-1], wt)
+
+
+def vflip(img):
+    arr, wt, chw = _to_arr(img)
+    if chw:
+        return _wrap(arr[:, ::-1], wt)
+    return _wrap(arr[::-1], wt)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img) if isinstance(img, Tensor) \
+        else np.asarray(Resize(size, interpolation)(img).numpy())
+
+
+def crop(img, top, left, height, width):
+    arr, wt, chw = _to_arr(img)
+    h_ax, w_ax = (1, 2) if chw else (0, 1)
+    sl = [slice(None)] * arr.ndim
+    sl[h_ax] = slice(top, top + height)
+    sl[w_ax] = slice(left, left + width)
+    return _wrap(arr[tuple(sl)], wt)
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr, wt, chw = _to_arr(img)
+    if isinstance(padding, int):
+        l = r = t = b = padding
+    elif len(padding) == 2:
+        l = r = padding[0]
+        t = b = padding[1]
+    else:
+        l, t, r, b = padding
+    h_ax, w_ax = (1, 2) if chw else (0, 1)
+    pads = [(0, 0)] * arr.ndim
+    pads[h_ax] = (t, b)
+    pads[w_ax] = (l, r)
+    mode = {"constant": "constant", "reflect": "reflect",
+            "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return _wrap(np.pad(arr, pads, mode=mode, **kw), wt)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, wt, chw = _to_arr(img)
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0,
+                  255 if arr.dtype == np.uint8 else None)
+    return _wrap(out.astype(arr.dtype), wt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, wt, chw = _to_arr(img)
+    f = arr.astype(np.float32)
+    hw = _hwc(f, chw) if f.ndim == 3 else f
+    gray = hw @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if f.ndim == 3 and hw.shape[-1] == 3 else hw
+    mean = gray.mean()
+    out = mean + contrast_factor * (f - mean)
+    out = np.clip(out, 0, 255 if arr.dtype == np.uint8 else None)
+    return _wrap(out.astype(arr.dtype), wt)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, wt, chw = _to_arr(img)
+    f = arr.astype(np.float32)
+    hw = _hwc(f, chw)
+    gray = (hw @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+    out = gray + saturation_factor * (hw - gray)
+    out = np.clip(out, 0, 255 if arr.dtype == np.uint8 else None)
+    return _wrap(_unhwc(out, chw).astype(arr.dtype), wt)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, -1)
+    minc = np.min(rgb, -1)
+    v = maxc
+    diff = maxc - minc
+    s = np.where(maxc > 0, diff / np.maximum(maxc, 1e-12), 0.0)
+    diff_safe = np.maximum(diff, 1e-12)
+    rc = (maxc - r) / diff_safe
+    gc = (maxc - g) / diff_safe
+    bc = (maxc - b) / diff_safe
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(diff > 0, (h / 6.0) % 1.0, 0.0)
+    return np.stack([h, s, v], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    out = np.choose(i[..., None] * 0 + i[..., None],
+                    [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+                     np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+                     np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    arr, wt, chw = _to_arr(img)
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    hw = _hwc(arr.astype(np.float32), chw) / scale
+    hsv = _rgb_to_hsv(hw)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return _wrap(_unhwc(out, chw).astype(arr.dtype), wt)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, wt, chw = _to_arr(img)
+    hw = _hwc(arr.astype(np.float32), chw)
+    gray = hw @ np.array([0.299, 0.587, 0.114], np.float32)
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return _wrap(_unhwc(out, chw).astype(arr.dtype), wt)
+
+
+def _inv_affine_matrix(angle, translate, scale, shear, center):
+    """Inverse of the affine transform (output->input coords), matching
+    the reference's rotation-about-center + shear + scale + translate."""
+    rot = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list,
+              tuple)) else (shear, 0.0)))
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a * scale, b * scale, 0.0],
+                  [c * scale, d * scale, 0.0],
+                  [0.0, 0.0, 1.0]], np.float64)
+    m[0, 2] = cx + tx - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + ty - m[1, 0] * cx - m[1, 1] * cy
+    return np.linalg.inv(m)
+
+
+def _warp(img, inv3, fill=0.0, interpolation="bilinear"):
+    """Inverse warp with a 3x3 output->input homography; bilinear or
+    nearest sampling (nearest preserves label values on integer masks)."""
+    arr, wt, chw = _to_arr(img)
+    f = _hwc(arr.astype(np.float32), chw)
+    if f.ndim == 2:
+        f = f[..., None]
+        squeeze = True
+    else:
+        squeeze = False
+    H, W, C = f.shape
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float64)
+    src = inv3 @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    def sample(yy, xx):
+        valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = np.clip(yy, 0, H - 1)
+        xc = np.clip(xx, 0, W - 1)
+        vals = f[yc, xc]
+        return np.where(valid[:, None], vals, np.float32(fill))
+
+    if interpolation == "nearest":
+        out = sample(np.round(sy).astype(np.int64),
+                     np.round(sx).astype(np.int64))
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        fx = (sx - x0).astype(np.float32)[:, None]
+        fy = (sy - y0).astype(np.float32)[:, None]
+        out = (sample(y0, x0) * (1 - fx) * (1 - fy)
+               + sample(y0, x0 + 1) * fx * (1 - fy)
+               + sample(y0 + 1, x0) * (1 - fx) * fy
+               + sample(y0 + 1, x0 + 1) * fx * fy)
+    out = out.reshape(H, W, C)
+    if squeeze:
+        out = out[..., 0]
+    return _wrap(_unhwc(out, chw).astype(arr.dtype), wt)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="bilinear",
+           fill=0, center=None):
+    arr, _, chw = _to_arr(img)
+    h_ax, w_ax = (1, 2) if chw else (0, 1)
+    H, W = arr.shape[h_ax], arr.shape[w_ax]
+    c = center if center is not None else ((W - 1) * 0.5, (H - 1) * 0.5)
+    return _warp(img, _inv_affine_matrix(angle, translate, scale, shear,
+                                         c), fill, interpolation)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Counter-clockwise rotation (reference convention: rotate(angle) ==
+    affine(-angle)). expand=True (grow the canvas to fit) is not
+    implemented — the output keeps the input size."""
+    return affine(img, -angle, (0, 0), 1.0, (0.0, 0.0), interpolation,
+                  fill, center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp mapping startpoints -> endpoints (4 corners)."""
+    _interp = interpolation
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    coeff = np.linalg.solve(np.asarray(a, np.float64),
+                            np.asarray(bvec, np.float64))
+    inv3 = np.array([[coeff[0], coeff[1], coeff[2]],
+                     [coeff[3], coeff[4], coeff[5]],
+                     [coeff[6], coeff[7], 1.0]])
+    return _warp(img, inv3, fill, _interp)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr, wt, chw = _to_arr(img)
+    out = arr if inplace else arr.copy()
+    h_ax = 1 if chw else 0
+    sl = [slice(None)] * out.ndim
+    sl[h_ax] = slice(i, i + h)
+    sl[h_ax + 1] = slice(j, j + w)
+    vv = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+    out[tuple(sl)] = vv.astype(out.dtype)
+    return _wrap(out, wt)
+
+
+# ---------------------------------------------------------------------------
+# Transform classes over the functional API
+# ---------------------------------------------------------------------------
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.rand() < self.prob else img
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        for t in np.random.permutation(self.ts):
+            img = t(img)
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.center, self.fill = center, fill
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, False, self.center,
+                      self.fill)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate, self.scale_rng = translate, scale
+        self.shear = shear
+        self.fill, self.center = fill, center
+
+    def __call__(self, img):
+        arr, _, chw = _to_arr(img)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        H, W = arr.shape[h_ax], arr.shape[w_ax]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * H
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            sh = (np.random.uniform(-s, s), 0.0) if np.isscalar(s) else \
+                (np.random.uniform(s[0], s[1]), 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob, self.d = prob, distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr, _, chw = _to_arr(img)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        H, W = arr.shape[h_ax], arr.shape[w_ax]
+        dx, dy = self.d * W / 2, self.d * H / 2
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [(np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (W - 1 - np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (W - 1 - np.random.uniform(0, dx),
+                H - 1 - np.random.uniform(0, dy)),
+               (np.random.uniform(0, dx), H - 1 - np.random.uniform(0, dy))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr, _, chw = _to_arr(img)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        H, W = arr.shape[h_ax], arr.shape[w_ax]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                top = np.random.randint(0, H - h + 1)
+                left = np.random.randint(0, W - w + 1)
+                return resize(crop(img, top, left, h, w), self.size,
+                              self.interpolation)
+        # fallback: center crop to the valid aspect
+        return resize(center_crop(img, min(H, W)), self.size,
+                      self.interpolation)
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr, _, chw = _to_arr(img)
+        h_ax = 1 if chw else 0
+        H, W = arr.shape[h_ax], arr.shape[h_ax + 1]
+        for _ in range(10):
+            target = H * W * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                val = np.asarray(self.value, np.float32)
+                if arr.ndim == 2:
+                    shape, val_r = (h, w), val
+                elif chw:
+                    shape = (arr.shape[0], h, w)
+                    val_r = val.reshape(-1, 1, 1) if val.ndim else val
+                else:
+                    shape = (h, w, arr.shape[-1])
+                    val_r = val
+                v = np.broadcast_to(val_r, shape)
+                return erase(img, i, j, h, w, v, self.inplace)
+        return img
+
+
+__all__ += ["RandomVerticalFlip", "ColorJitter", "RandomRotation",
+            "RandomResizedCrop", "Pad", "Grayscale", "BrightnessTransform",
+            "ContrastTransform", "SaturationTransform", "HueTransform",
+            "RandomAffine", "RandomPerspective", "RandomErasing",
+            "adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue", "affine", "center_crop", "crop", "erase",
+            "hflip", "normalize", "pad", "perspective", "resize", "rotate",
+            "to_grayscale", "to_tensor", "vflip"]

@@ -1,0 +1,125 @@
+"""vision.transforms functional API + transform zoo (host-side numpy
+pipeline stage, NumPy-oracle checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+
+def _img_hwc(h=8, w=10, c=3, dtype=np.uint8, seed=0):
+    rng = np.random.RandomState(seed)
+    if dtype == np.uint8:
+        return rng.randint(0, 256, (h, w, c)).astype(np.uint8)
+    return rng.rand(h, w, c).astype(np.float32)
+
+
+def test_flips():
+    img = _img_hwc()
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    chw = paddle.to_tensor(img.transpose(2, 0, 1).astype(np.float32))
+    out = T.hflip(chw)
+    np.testing.assert_array_equal(np.asarray(out._data),
+                                  img.transpose(2, 0, 1)[..., ::-1])
+
+
+def test_crop_pad():
+    img = _img_hwc(8, 10)
+    c = T.crop(img, 2, 3, 4, 5)
+    np.testing.assert_array_equal(c, img[2:6, 3:8])
+    p = T.pad(img, (1, 2), fill=7)
+    assert p.shape == (12, 12, 3)
+    assert (p[0] == 7).all() and (p[:, 0] == 7).all()
+    p2 = T.pad(img, 2, padding_mode="reflect")
+    np.testing.assert_array_equal(p2[0, 2:-2], img[2])
+
+
+def test_adjusts_match_identity():
+    img = _img_hwc(dtype=np.float32)
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+    np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                               atol=1e-5)
+    np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                               atol=1e-5)
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1e-3)
+    np.testing.assert_allclose(T.adjust_brightness(img, 2.0), img * 2,
+                               atol=1e-5)
+    with pytest.raises(ValueError):
+        T.adjust_hue(img, 0.7)
+
+
+def test_hue_rolls_channels():
+    # pure red rotated by 1/3 becomes pure green (hue is cyclic)
+    img = np.zeros((2, 2, 3), np.float32)
+    img[..., 0] = 1.0
+    out = T.adjust_hue(img, 1.0 / 3.0)
+    np.testing.assert_allclose(out[..., 1], 1.0, atol=1e-4)
+    np.testing.assert_allclose(out[..., 0], 0.0, atol=1e-4)
+
+
+def test_grayscale():
+    img = _img_hwc(dtype=np.float32)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape
+    np.testing.assert_allclose(g[..., 0], g[..., 1])
+    ref = img @ np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose(g[..., 0], ref, atol=1e-5)
+
+
+def test_rotate_90_matches_numpy():
+    img = _img_hwc(9, 9, dtype=np.float32)
+    out = T.rotate(img, 90.0)
+    # rotating by 90° about the center == np.rot90 (up to sampling): check
+    # the center 5x5 block exactly
+    ref = np.rot90(img, k=1, axes=(1, 0))  # CW vs CCW convention probe
+    ref_ccw = np.rot90(img, k=1, axes=(0, 1))
+    match = min(np.abs(out[2:7, 2:7] - ref[2:7, 2:7]).max(),
+                np.abs(out[2:7, 2:7] - ref_ccw[2:7, 2:7]).max())
+    assert match < 1e-3
+
+
+def test_rotate_zero_identity():
+    img = _img_hwc(dtype=np.float32)
+    np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-4)
+    np.testing.assert_allclose(T.affine(img, 0.0, (0, 0), 1.0, 0.0), img,
+                               atol=1e-4)
+
+
+def test_perspective_identity():
+    img = _img_hwc(dtype=np.float32)
+    pts = [(0, 0), (9, 0), (9, 7), (0, 7)]
+    np.testing.assert_allclose(T.perspective(img, pts, pts), img,
+                               atol=1e-4)
+
+
+def test_erase():
+    img = _img_hwc(dtype=np.float32)
+    out = T.erase(img, 1, 2, 3, 4, np.zeros((3, 4, 3), np.float32))
+    assert (out[1:4, 2:6] == 0).all()
+    assert (out[0] == img[0]).all()
+
+
+def test_transform_classes_shapes():
+    img = _img_hwc(32, 32, dtype=np.float32)
+    assert T.RandomVerticalFlip(1.0)(img).shape == img.shape
+    assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == img.shape
+    assert T.Pad(2)(img).shape == (36, 36, 3)
+    assert T.Grayscale(1)(img).shape == (32, 32, 1)
+    assert T.RandomRotation(15.0)(img).shape == img.shape
+    assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                          shear=5)(img).shape == img.shape
+    assert T.RandomPerspective(1.0)(img).shape == img.shape
+    out = T.RandomResizedCrop(16)(img)
+    out = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    assert out.shape[:2] == (16, 16)
+    assert T.RandomErasing(1.0)(img).shape == img.shape
+
+
+def test_compose_pipeline_to_tensor():
+    img = _img_hwc(32, 32)
+    pipe = T.Compose([T.RandomResizedCrop(16), T.RandomHorizontalFlip(),
+                      T.ToTensor(), T.Normalize(mean=[0.5] * 3,
+                                                std=[0.5] * 3)])
+    out = pipe(img)
+    assert list(out.shape) == [3, 16, 16]
