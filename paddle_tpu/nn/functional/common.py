@@ -85,7 +85,19 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    ids = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # ids ride as a real apply_op INPUT (not a closure capture): a Tensor
+    # input replays with fresh feeds under static Program capture, while a
+    # closure would pin the capture-time ids forever. Integer inputs are
+    # grad-safe (vjp cotangent is float0; the engine skips stop_gradient
+    # inputs).
+    if isinstance(x, Tensor):
+        def f2(ids, w):
+            out = jnp.take(w, ids, axis=0)
+            if padding_idx is not None:
+                out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+            return out
+        return apply_op(f2, x, weight)
+    ids = jnp.asarray(x)
 
     def f(w):
         out = jnp.take(w, ids, axis=0)

@@ -110,6 +110,7 @@ class Program:
         self.ops: list[_OpRecord] = []
         self.feed_holders: dict[int, str] = {}   # tensor uid -> feed name
         self._feed_specs: dict[str, InputSpec] = {}
+        self._feeds_requiring_grad: set = set()  # names (static gradients())
         self._minimize_hooks: list = []          # (optimizer, loss_uid)
         self.random_seed = 0
 
@@ -131,6 +132,7 @@ class Program:
         p.ops = list(self.ops)
         p.feed_holders = dict(self.feed_holders)
         p._feed_specs = dict(self._feed_specs)
+        p._feeds_requiring_grad = set(self._feeds_requiring_grad)
         if not for_test:
             p._minimize_hooks = list(self._minimize_hooks)
         return p
@@ -273,6 +275,12 @@ class Executor:
                     v = feed[name]
                     t = v if isinstance(v, Tensor) else \
                         Tensor(np.asarray(v))
+                    if name in program._feeds_requiring_grad:
+                        if t is v:
+                            # never mutate a caller's Tensor permanently:
+                            # wrap its array in a fresh run-local Tensor
+                            t = Tensor(v._data)
+                        t.stop_gradient = False
                     if data_parallel:
                         # static-dp pass: shard the feed's batch dim over
                         # the hybrid mesh's data axes (the reference's
@@ -305,6 +313,9 @@ class Executor:
                     else:
                         loss.backward()
                         optimizer.step()
+                        post = getattr(optimizer, "_post_run", None)
+                        if post is not None:
+                            post(env)   # bind @GRAD handles before clear
                         optimizer.clear_grad()
             results = []
             pruned = None
@@ -364,3 +375,221 @@ from .io import (save_inference_model, load_inference_model,  # noqa: E402
                  serialize_program, deserialize_program, normalize_program,
                  save, load)
 from . import io  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Legacy static-graph API additions (r3): append_backward / gradients /
+# scope_guard / places / device_guard / program state / EMA / py_func
+# ---------------------------------------------------------------------------
+
+class _BackwardHook:
+    """Minimize-hook shaped object that ONLY runs the backward (reference
+    append_backward: grads are materialized, updates are the caller's
+    business). Grad handles registered here are bound into the run env
+    by _post_run so they can be fetched."""
+
+    def __init__(self, pairs):
+        self._pairs = pairs        # [(param, grad_handle)]
+
+    def step(self):
+        pass
+
+    def clear_grad(self):
+        pass                            # clearing happens in _post_run,
+        #                                 which can resolve the LIVE tensor
+
+    def _post_run(self, env):
+        # bind then clear on the RUN-time tensor (env holds fed tensors;
+        # params resolve to themselves) — without the clear, grads would
+        # ACCUMULATE across Executor.run calls (backward is +=)
+        for p, gh in self._pairs:
+            live = env.get(p._uid, p)
+            if live.grad is not None:
+                env[gh._uid] = live.grad
+            live.grad = None
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Register backward for `loss` in the active Program; returns
+    [(param, grad_handle)] — fetch a grad_handle from Executor.run to
+    read the gradient (reference: paddle.static.append_backward)."""
+    prog = default_main_program()
+    params = list(parameter_list) if parameter_list else \
+        prog.all_parameters()
+    pairs = []
+    for p in params:
+        gh = Tensor(np.zeros((), np.float32))
+        gh.name = (getattr(p, "name", None) or "param") + "@GRAD"
+        pairs.append((p, gh))
+    hook = _BackwardHook(pairs)
+    prog._minimize_hooks.append((hook, loss._uid))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static d(sum(targets))/d(inputs) handles (reference:
+    paddle.static.gradients); realized through the same backward hook —
+    inputs must require grad (stop_gradient=False)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    prog = default_main_program()
+    pairs = []
+    for x in inputs:
+        gh = Tensor(np.zeros((), np.float32))
+        gh.name = (getattr(x, "name", None) or "x") + "@GRAD"
+        pairs.append((x, gh))
+        fname = prog.feed_holders.get(x._uid)
+        if fname is not None:   # feed input: the RUN-time tensor must
+            prog._feeds_requiring_grad.add(fname)   # require grad
+    # ONE hook on the summed target: backward() clears the tape when it
+    # finishes, so per-target hooks would leave every target after the
+    # first with nothing to differentiate
+    if len(targets) == 1:
+        loss_t = targets[0]
+    else:
+        from ..tensor.math import add_n
+        loss_t = add_n([t.sum() for t in targets])
+    prog._minimize_hooks.append((_BackwardHook(pairs), loss_t._uid))
+    return [gh for _, gh in pairs]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Bind `scope` as the global scope within the context (reference:
+    paddle.static.scope_guard)."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (the reference's GPU places = TPU chips here)."""
+    from ..core.place import CUDAPlace
+    if device_ids is None:
+        import jax
+        device_ids = range(len(jax.devices()))
+    return [CUDAPlace(i) for i in device_ids]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op-placement guard. XLA owns placement on TPU; the guard is accepted
+    for parity and is a no-op (documented deviation)."""
+    yield
+
+
+def set_program_state(program, state_dict):
+    """Write a {name: ndarray} state into the program's parameters."""
+    import numpy as _np
+    by_name = {getattr(p, "name", None): p
+               for p in program.all_parameters()}
+    for k, v in state_dict.items():
+        p = by_name.get(k)
+        if p is not None:
+            arr = v.numpy() if hasattr(v, "numpy") else _np.asarray(v)
+            import jax.numpy as jnp
+            p._data = jnp.asarray(arr, p._data.dtype)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with decay (+ optional Adam-style bias-correction
+    via thres_steps ignored); apply()/restore() swap windows (reference:
+    paddle.static.ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._ema = {}
+        self._backup = None
+        self._params = None
+        self._step = 0
+
+    def _ensure(self):
+        if self._params is None:
+            self._params = default_main_program().all_parameters()
+            import jax.numpy as jnp
+            for p in self._params:
+                # zero-init + bias correction in apply() (the reference's
+                # scheme); seeding with the live value AND dividing by
+                # 1-decay^t would double-count
+                self._ema[p._uid] = jnp.zeros_like(p._data, jnp.float32)
+
+    def update(self):
+        import jax.numpy as jnp
+        self._ensure()
+        self._step += 1
+        d = self.decay
+        for p in self._params:
+            self._ema[p._uid] = d * self._ema[p._uid] + \
+                (1 - d) * p._data.astype(jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._ensure()
+        self._backup = {p._uid: p._data for p in self._params}
+        bias = 1.0 - self.decay ** max(self._step, 1)
+        for p in self._params:
+            p._data = (self._ema[p._uid] / bias).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            if p._uid in self._backup:
+                p._data = self._backup[p._uid]
+        self._backup = None
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host-side python function as an op (reference:
+    paddle.static.py_func). Lowered via jax.pure_callback so the call
+    survives jit/Program replay; `out` provides the result template
+    (shape/dtype). backward_func is not supported (raise if given)."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func is not supported on the TPU build; "
+            "define a custom op via paddle.autograd.PyLayer instead")
+    import jax
+    import jax.numpy as jnp
+    from ..tensor.tensor import apply_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    templates = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+                 for o in outs]
+
+    def fn(*arrs):
+        def host(*hs):
+            res = func(*hs)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r) for r in res)
+        res = jax.pure_callback(host, tuple(templates), *arrs)
+        return res if len(res) > 1 else res[0]
+    result = apply_op(fn, *xs)
+    results = result if isinstance(result, tuple) else (result,)
+    for o, r in zip(outs, results):
+        o._data = r._data
+        _alias_capture_output(r, o)   # replay binds the result to `out`
+    return out
+
+
+from . import nn  # noqa: E402
+
+__all__ += ["append_backward", "gradients", "scope_guard", "cpu_places",
+            "cuda_places", "device_guard", "set_program_state",
+            "ExponentialMovingAverage", "py_func", "nn"]

@@ -1,0 +1,132 @@
+"""paddle.static.nn — legacy static-graph layer builders.
+
+Parity: python/paddle/static/nn/common.py (fc, conv2d, batch_norm,
+layer_norm, embedding, ...) — the 1.x-style functions that CREATE
+parameters on call and record ops into the active Program. Here each
+builder instantiates the corresponding nn.Layer (parameter registration
+rides the persistent registry) and applies it, so the op records into the
+Program capture exactly like dygraph layers under program_guard.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["fc", "embedding", "conv2d", "conv3d", "batch_norm",
+           "layer_norm", "dropout", "conv2d_transpose", "prelu"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..nn.layer.common import Linear
+    from ..tensor.tensor import apply_op
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    if num_flatten_dims != len(x.shape) - 1 or in_dim != x.shape[-1]:
+        # flatten trailing dims with a shape computed FROM THE ARRAY at
+        # replay time — reshape() would bake the capture-time batch (the
+        # None placeholder dim materializes as 1) into the recorded op
+        k = num_flatten_dims
+        x = apply_op(lambda a: a.reshape(a.shape[:k] + (-1,)), x)
+    lin = Linear(in_dim, size, weight_attr=weight_attr,
+                 bias_attr=bias_attr)
+    out = lin(x)
+    if activation:
+        from ..nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..nn.layer.common import Embedding
+    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    from ..nn.layer.conv import Conv2D
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    conv = Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                  padding=padding, dilation=dilation, groups=groups,
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  data_format=data_format)
+    out = conv(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    from ..nn.layer.conv import Conv3D
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    conv = Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                  padding=padding, dilation=dilation, groups=groups,
+                  weight_attr=param_attr, bias_attr=bias_attr)
+    out = conv(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    from ..nn.layer.conv import Conv2DTranspose
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    conv = Conv2DTranspose(in_ch, num_filters, filter_size, stride=stride,
+                           padding=padding, dilation=dilation,
+                           groups=groups, weight_attr=param_attr,
+                           bias_attr=bias_attr)
+    out = conv(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None):
+    from ..nn.layer.norm import BatchNorm
+    bn = BatchNorm(input.shape[1] if data_layout == "NCHW"
+                   else input.shape[-1], momentum=momentum,
+                   epsilon=epsilon)
+    if is_test:
+        bn.eval()
+    out = bn(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn.layer.norm import LayerNorm
+    shape = list(input.shape[begin_norm_axis:])
+    ln = LayerNorm(shape, epsilon=epsilon)
+    out = ln(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
+    from ..nn import functional as F
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..nn.layer.activation import PReLU
+    num = 1 if mode == "all" else (x.shape[1] if mode == "channel" else
+                                   int(np.prod(x.shape[1:])))
+    return PReLU(num_parameters=num)(x)

@@ -506,6 +506,7 @@ class RandomAffine:
             else tuple(degrees)
         self.translate, self.scale_rng = translate, scale
         self.shear = shear
+        self.interpolation = interpolation
         self.fill, self.center = fill, center
 
     def __call__(self, img):
@@ -523,7 +524,8 @@ class RandomAffine:
             s = self.shear
             sh = (np.random.uniform(-s, s), 0.0) if np.isscalar(s) else \
                 (np.random.uniform(s[0], s[1]), 0.0)
-        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+        return affine(img, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
                       center=self.center)
 
 
@@ -531,6 +533,7 @@ class RandomPerspective:
     def __init__(self, prob=0.5, distortion_scale=0.5,
                  interpolation="nearest", fill=0):
         self.prob, self.d = prob, distortion_scale
+        self.interpolation = interpolation
         self.fill = fill
 
     def __call__(self, img):
@@ -546,7 +549,9 @@ class RandomPerspective:
                (W - 1 - np.random.uniform(0, dx),
                 H - 1 - np.random.uniform(0, dy)),
                (np.random.uniform(0, dx), H - 1 - np.random.uniform(0, dy))]
-        return perspective(img, start, end, fill=self.fill)
+        return perspective(img, start, end,
+                           interpolation=self.interpolation,
+                           fill=self.fill)
 
 
 class RandomResizedCrop:

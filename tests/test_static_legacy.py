@@ -1,0 +1,193 @@
+"""Legacy static-graph API: append_backward/gradients grad handles,
+static.nn builders, scope_guard, places, EMA, py_func,
+set_program_state."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as S
+
+
+def _fresh_programs():
+    main, startup = S.Program(), S.Program()
+    return main, startup
+
+
+def test_append_backward_grad_fetch():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with S.program_guard(main, startup):
+            x = S.data("x", [None, 4], "float32")
+            w = paddle.create_parameter([4, 1], "float32")
+            y = paddle.matmul(x, w)
+            loss = paddle.mean(y)
+            pairs = S.append_backward(loss, parameter_list=[w])
+        exe = S.Executor()
+        xs = np.ones((3, 4), np.float32)
+        (gw,) = exe.run(main, feed={"x": xs}, fetch_list=[pairs[0][1]])
+        # d(mean(x@w))/dw = mean over batch of x rows = column of 1s / 1
+        np.testing.assert_allclose(gw.ravel(), np.full(4, 1.0), rtol=1e-5)
+        # second run must give identical grads (no accumulation)
+        (gw2,) = exe.run(main, feed={"x": xs}, fetch_list=[pairs[0][1]])
+        np.testing.assert_allclose(gw2, gw, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_gradients_wrt_input():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with S.program_guard(main, startup):
+            x = S.data("x", [2, 3], "float32")
+            x.stop_gradient = False
+            y = paddle.sum(x * x)
+            (gx,) = S.gradients([y], [x])
+        exe = S.Executor()
+        xs = np.arange(6, dtype=np.float32).reshape(2, 3)
+        (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+        np.testing.assert_allclose(g, 2 * xs, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_gradients_multi_target_sums():
+    paddle.enable_static()
+    try:
+        main, _ = _fresh_programs()
+        with S.program_guard(main):
+            x = S.data("x", [2, 2], "float32")
+            x.stop_gradient = False
+            y1 = paddle.sum(x * x)      # d/dx = 2x
+            y2 = paddle.sum(3.0 * x)    # d/dx = 3
+            (gx,) = S.gradients([y1, y2], [x])
+        exe = S.Executor()
+        xs = np.arange(4, dtype=np.float32).reshape(2, 2)
+        (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+        np.testing.assert_allclose(g, 2 * xs + 3.0, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_fc_dynamic_batch_with_flatten():
+    paddle.enable_static()
+    try:
+        main, _ = _fresh_programs()
+        with S.program_guard(main):
+            x = S.data("x", [None, 4, 4], "float32")
+            out = S.nn.fc(x, 16)     # flattens trailing dims at replay
+        exe = S.Executor()
+        res = exe.run(main, feed={"x": np.ones((3, 4, 4), np.float32)},
+                      fetch_list=[out])
+        assert res[0].shape == (3, 16)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_builders():
+    paddle.enable_static()
+    try:
+        main, startup = _fresh_programs()
+        with S.program_guard(main, startup):
+            x = S.data("x", [None, 8], "float32")
+            h = S.nn.fc(x, 16, activation="relu")
+            h = S.nn.dropout(h, 0.0)
+            out = S.nn.fc(h, 3)
+        exe = S.Executor()
+        res = exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                      fetch_list=[out])
+        assert res[0].shape == (2, 3)
+
+        main2, _ = _fresh_programs()
+        with S.program_guard(main2):
+            img = S.data("img", [None, 3, 8, 8], "float32")
+            c = S.nn.conv2d(img, 4, 3, padding=1, act="relu")
+            c = S.nn.batch_norm(c)
+            c = S.nn.layer_norm(c, begin_norm_axis=1)
+        res2 = exe.run(main2, feed={"img": np.random.RandomState(0)
+                                    .rand(2, 3, 8, 8).astype(np.float32)},
+                       fetch_list=[c])
+        assert res2[0].shape == (2, 4, 8, 8)
+
+        main3, _ = _fresh_programs()
+        with S.program_guard(main3):
+            ids = S.data("ids", [None, 5], "int32")
+            e = S.nn.embedding(ids, (100, 16))
+        res3 = exe.run(main3, feed={"ids": np.zeros((2, 5), np.int32)},
+                       fetch_list=[e])
+        assert res3[0].shape == (2, 5, 16)
+    finally:
+        paddle.disable_static()
+
+
+def test_scope_guard_and_places():
+    sc = S.Scope()
+    with S.scope_guard(sc):
+        assert S.global_scope() is sc
+    assert S.global_scope() is not sc
+    assert len(S.cpu_places(2)) == 2
+    with S.device_guard("cpu"):
+        pass
+
+
+def test_set_program_state():
+    paddle.enable_static()
+    try:
+        main, _ = _fresh_programs()
+        with S.program_guard(main):
+            x = S.data("x", [1, 2], "float32")
+            w = paddle.create_parameter([2, 2], "float32")
+            w.name = "w0"
+            y = paddle.matmul(x, w)
+        new_w = np.eye(2, dtype=np.float32) * 3
+        S.set_program_state(main, {"w0": new_w})
+        exe = S.Executor()
+        (out,) = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                         fetch_list=[y])
+        np.testing.assert_allclose(out, np.full((1, 2), 3.0))
+    finally:
+        paddle.disable_static()
+
+
+def test_exponential_moving_average():
+    paddle.enable_static()
+    try:
+        main, _ = _fresh_programs()
+        with S.program_guard(main):
+            w = paddle.create_parameter([2], "float32")
+        import jax.numpy as jnp
+        ema = S.ExponentialMovingAverage(decay=0.5)
+        ema._params = [w]
+        ema._ema[w._uid] = jnp.zeros(2, jnp.float32)
+        w._data = jnp.asarray([3.0, 4.0], jnp.float32)
+        ema.update()       # ema = .5*0 + .5*[3,4] = [1.5, 2]
+        w._data = jnp.asarray([5.0, 6.0], jnp.float32)
+        ema.update()       # ema = .5*[1.5,2] + .5*[5,6] = [3.25, 4]
+        cur = np.asarray(w._data).copy()
+        with ema.apply():
+            # bias correction 1 - .5^2 = .75 -> [3.25,4]/.75
+            np.testing.assert_allclose(np.asarray(w._data),
+                                       [3.25 / 0.75, 4.0 / 0.75],
+                                       rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(w._data), cur)
+    finally:
+        paddle.disable_static()
+
+
+def test_py_func():
+    paddle.enable_static()
+    try:
+        main, _ = _fresh_programs()
+        with S.program_guard(main):
+            x = S.data("x", [2, 3], "float32")
+            out = paddle.zeros([2, 3], "float32")
+            S.py_func(lambda a: a * 2.0, x, out)
+        exe = S.Executor()
+        xs = np.arange(6, dtype=np.float32).reshape(2, 3)
+        (o,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        np.testing.assert_allclose(o, xs * 2)
+        with pytest.raises(NotImplementedError):
+            S.py_func(lambda a: a, x, out, backward_func=lambda g: g)
+    finally:
+        paddle.disable_static()

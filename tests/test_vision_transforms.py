@@ -67,16 +67,24 @@ def test_grayscale():
     np.testing.assert_allclose(g[..., 0], ref, atol=1e-5)
 
 
-def test_rotate_90_matches_numpy():
-    img = _img_hwc(9, 9, dtype=np.float32)
+def test_rotate_90_direction_pinned():
+    """rotate() is COUNTER-clockwise (reference convention): a marked
+    pixel at right-center must land at top-center — this pins the sign of
+    the angle negation, which a both-directions check would miss."""
+    img = np.zeros((5, 5, 1), np.float32)
+    img[2, 4] = 1.0                      # right-center
     out = T.rotate(img, 90.0)
-    # rotating by 90° about the center == np.rot90 (up to sampling): check
-    # the center 5x5 block exactly
-    ref = np.rot90(img, k=1, axes=(1, 0))  # CW vs CCW convention probe
-    ref_ccw = np.rot90(img, k=1, axes=(0, 1))
-    match = min(np.abs(out[2:7, 2:7] - ref[2:7, 2:7]).max(),
-                np.abs(out[2:7, 2:7] - ref_ccw[2:7, 2:7]).max())
-    assert match < 1e-3
+    assert out[0, 2] == pytest.approx(1.0, abs=1e-4)   # top-center
+    assert out[2, 4] == pytest.approx(0.0, abs=1e-4)
+    # and the full-image agreement with the matching np.rot90 direction
+    img2 = _img_hwc(9, 9, dtype=np.float32)
+    out2 = T.rotate(img2, 90.0)
+    k_dir = None
+    for k, axes in ((1, (0, 1)), (1, (1, 0))):
+        if np.abs(out2[2:7, 2:7] - np.rot90(img2, k, axes)[2:7, 2:7]).max() \
+                < 1e-3:
+            k_dir = axes
+    assert k_dir is not None
 
 
 def test_rotate_zero_identity():
