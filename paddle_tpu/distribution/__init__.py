@@ -202,7 +202,9 @@ def _kl_uniform(p, q):
 # ---- extended families + transforms (separate modules) --------------------
 from .families import (Beta, Dirichlet, Exponential, Gamma,  # noqa: E402
                        Geometric, Gumbel, Laplace, LogNormal, Multinomial,
-                       Poisson, StudentT, Binomial, Cauchy)
+                       Poisson, StudentT, Binomial, Cauchy,
+                       ExponentialFamily, Chi2, ContinuousBernoulli,
+                       MultivariateNormal)
 from .transform import (Transform, AffineTransform, ExpTransform,  # noqa: E402
                         SigmoidTransform, TanhTransform, PowerTransform,
                         AbsTransform, ChainTransform,

@@ -393,3 +393,163 @@ class Cauchy(Distribution):
     def entropy(self):
         return Tensor(jnp.log(4 * math.pi * self.scale)
                       + jnp.zeros_like(self.loc))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    distribution/exponential_family.py): subclasses expose natural
+    parameters + log-normalizer and inherit a Bregman-divergence entropy
+    via autodiff of the log normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """H = logZ - sum(eta_i * d(logZ)/d(eta_i)) + E[carrier]."""
+        nats = [jnp.asarray(n, jnp.float32) for n in self._natural_parameters]
+        grads = jax.grad(
+            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+            argnums=tuple(range(len(nats))))(*nats)
+        ent = self._log_normalizer(*nats) - self._mean_carrier_measure
+        for n, g in zip(nats, grads):
+            ent = ent - n * g
+        return Tensor(ent)
+
+
+class Chi2(Gamma):
+    """Chi-squared with df degrees of freedom == Gamma(df/2, 1/2)
+    (reference: distribution/chi2.py)."""
+
+    def __init__(self, df):
+        self.df = _arr(df).astype(jnp.float32)   # int df must not make
+        super().__init__(self.df / 2.0,          # rate truncate to 0
+                         jnp.full_like(self.df, 0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lambda) on [0, 1] (reference: continuous_bernoulli.py):
+    p(x) = C(l) l^x (1-l)^(1-x) with the closed-form normalizer; the
+    l == 0.5 removable singularity handled by a Taylor guard."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.p = _arr(probs)
+        self.lims = lims
+
+    def _outside(self):
+        return (self.p < self.lims[0]) | (self.p > self.lims[1])
+
+    def _log_norm(self):
+        # log C = log( 2 atanh(1-2l) / (1-2l) ) for l != 1/2, -> log 2
+        p_safe = jnp.where(self._outside(), self.p, 0.25)
+        x = 1 - 2 * p_safe
+        out = jnp.log(2.0 * jnp.arctanh(x) / x)
+        return jnp.where(self._outside(), out, jnp.log(2.0)
+                         + jnp.log1p((1 - 2 * self.p) ** 2 / 3))
+
+    @property
+    def mean(self):
+        p_safe = jnp.where(self._outside(), self.p, 0.25)
+        m = p_safe / (2 * p_safe - 1) + \
+            1 / (2 * jnp.arctanh(1 - 2 * p_safe))
+        return Tensor(jnp.where(self._outside(), m,
+                                0.5 + (self.p - 0.5) / 3))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.p.shape
+        u = jax.random.uniform(next_key(), shape)
+        return self.icdf(Tensor(u))
+
+    def icdf(self, value):
+        # F(x) = (1-l)(r^x - 1)/(2l-1) with r = l/(1-l); inverting:
+        # x = log(1 + u(2l-1)/(1-l)) / log(l/(1-l))
+        u = _arr(value)
+        p_safe = jnp.where(self._outside(), self.p, 0.25)
+        num = jnp.log1p(u * (2 * p_safe - 1)
+                        / jnp.maximum(1 - p_safe, 1e-12))
+        den = jnp.log(p_safe / jnp.maximum(1 - p_safe, 1e-12))
+        out = num / den
+        return Tensor(jnp.where(self._outside(), out, u))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(self._log_norm() + v * jnp.log(
+            jnp.maximum(self.p, 1e-12)) + (1 - v) * jnp.log(
+            jnp.maximum(1 - self.p, 1e-12)))
+
+
+class MultivariateNormal(Distribution):
+    """MVN(loc, covariance_matrix) (reference:
+    distribution/multivariate_normal.py); scale_tril/precision accepted
+    like the reference, internally Cholesky-parameterized."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _arr(loc)
+        given = [a is not None for a in (covariance_matrix,
+                                         precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril required")
+        if scale_tril is not None:
+            self.scale_tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            prec = _arr(precision_matrix)
+            self.scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self.scale_tril @ jnp.swapaxes(self.scale_tril,
+                                                     -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self.scale_tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        d = self.loc.shape[-1]
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc.shape[:-1], self.scale_tril.shape[:-2]) + (d,)
+        z = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self.scale_tril, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        d = self.loc.shape[-1]
+        diff = v - self.loc
+        # solve L y = diff; logdet from the Cholesky diagonal
+        y = jax.scipy.linalg.solve_triangular(self.scale_tril, diff[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril,
+                                                   axis1=-2, axis2=-1)),
+                              axis=-1)
+        return Tensor(-0.5 * jnp.sum(y * y, -1) - half_logdet
+                      - 0.5 * d * jnp.log(2 * jnp.asarray(math.pi)))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril,
+                                                   axis1=-2, axis2=-1)),
+                              axis=-1)
+        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.asarray(math.pi)))
+                      + half_logdet)
+
+
+__all__ += ["ExponentialFamily", "Chi2", "ContinuousBernoulli",
+            "MultivariateNormal"]

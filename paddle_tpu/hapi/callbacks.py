@@ -6,7 +6,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "VisualDL", "config_callbacks"]
+           "LRScheduler", "ReduceLROnPlateau", "VisualDL",
+           "config_callbacks"]
 
 
 class Callback:
@@ -177,6 +178,72 @@ class VisualDL(Callback):
         if self._writer is not None:
             self._writer.close()
             self._writer = None          # a later fit() reopens cleanly
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the optimizer LR when the monitored metric plateaus
+    (reference: hapi/callbacks.py :: ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        if self.factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau factor must be < 1.0")
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _reduce(self):
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler as Sched
+        lr = opt._learning_rate
+        if isinstance(lr, Sched):
+            new = max(lr.last_lr * self.factor, self.min_lr)
+            lr.base_lr = new
+            lr.last_lr = new
+        else:
+            opt.set_lr(max(float(lr) * self.factor, self.min_lr))
+        if self.verbose:
+            print(f"ReduceLROnPlateau: lr reduced by {self.factor}")
+
+    def on_eval_end(self, logs=None):
+        val = (logs or {}).get(self.monitor)
+        if val is None:
+            return
+        if isinstance(val, (list, tuple)):
+            val = val[0]
+        if self.cooldown_counter > 0:
+            # in cooldown: track the best but never count waits/reduce
+            self.cooldown_counter -= 1
+            self.wait = 0
+            if (self.best is None or
+                    (val < self.best - self.min_delta
+                     if self.mode == "min"
+                     else val > self.best + self.min_delta)):
+                self.best = val
+            return
+        better = (self.best is None or
+                  (val < self.best - self.min_delta if self.mode == "min"
+                   else val > self.best + self.min_delta))
+        if better:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self._reduce()
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
 
 
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,

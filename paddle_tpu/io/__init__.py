@@ -26,7 +26,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "ConcatDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn",
+           "SubsetRandomSampler", "default_convert_fn"]
 
 
 class Dataset:
@@ -179,6 +180,24 @@ class WeightedRandomSampler(Sampler):
         return self.num_samples
 
 
+class SubsetRandomSampler(Sampler):
+    """Sample WITHOUT replacement from a fixed index subset (reference:
+    io/sampler.py :: SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        rng = self.generator if isinstance(
+            self.generator, np.random.Generator) else np.random
+        perm = rng.permutation(len(self.indices))
+        yield from (self.indices[i] for i in perm)
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class BatchSampler(Sampler):
     def __init__(self, dataset=None, sampler=None, shuffle=False,
                  batch_size=1, drop_last=False):
@@ -293,6 +312,25 @@ def _proc_worker_main(dataset, task_q, res_q, wid, num_workers,
             res_q.put((i, True, samples))
         except BaseException:
             res_q.put((i, False, traceback.format_exc()))
+
+
+def default_convert_fn(batch):
+    """Identity-structure conversion: ndarrays/scalars -> Tensors without
+    batching (reference: dataloader/collate.py :: default_convert_fn)."""
+    from ..tensor.tensor import Tensor
+    if isinstance(batch, (list, tuple)):
+        out = [default_convert_fn(b) for b in batch]
+        if isinstance(batch, tuple):
+            return type(batch)(*out) if hasattr(batch, "_fields") \
+                else tuple(out)          # namedtuple vs plain tuple
+        return out
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, Tensor):
+        return batch
+    if isinstance(batch, (np.ndarray, np.generic, int, float)):
+        return Tensor(np.asarray(batch))
+    return batch
 
 
 def default_collate_fn(batch):

@@ -430,9 +430,18 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """Static d(sum(targets))/d(inputs) handles (reference:
     paddle.static.gradients); realized through the same backward hook —
-    inputs must require grad (stop_gradient=False)."""
+    inputs must require grad (stop_gradient=False). target_gradients
+    (custom output cotangents) are not supported — raise loudly rather
+    than silently differentiating the unweighted sum."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "static.gradients target_gradients is not supported; weight "
+            "the targets before calling (loss = sum(w_i * y_i))")
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if no_grad_set:
+        ban = {id(t) for t in no_grad_set}
+        inputs = [x for x in inputs if id(x) not in ban]
     prog = default_main_program()
     pairs = []
     for x in inputs:
@@ -537,6 +546,10 @@ class ExponentialMovingAverage:
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
         self._ensure()
+        if self._step == 0:
+            # nothing accumulated: applying would zero every parameter
+            yield
+            return
         self._backup = {p._uid: p._data for p in self._params}
         bias = 1.0 - self.decay ** max(self._step, 1)
         for p in self._params:

@@ -66,7 +66,8 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
     in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
     conv = Conv3D(in_ch, num_filters, filter_size, stride=stride,
                   padding=padding, dilation=dilation, groups=groups,
-                  weight_attr=param_attr, bias_attr=bias_attr)
+                  weight_attr=param_attr, bias_attr=bias_attr,
+                  data_format=data_format)
     out = conv(input)
     if act:
         from ..nn import functional as F
@@ -83,7 +84,11 @@ def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
     conv = Conv2DTranspose(in_ch, num_filters, filter_size, stride=stride,
                            padding=padding, dilation=dilation,
                            groups=groups, weight_attr=param_attr,
-                           bias_attr=bias_attr)
+                           bias_attr=bias_attr, data_format=data_format)
+    if output_size is not None:
+        raise NotImplementedError(
+            "static.nn.conv2d_transpose output_size is not supported; "
+            "size the transpose via filter_size/stride/padding")
     out = conv(input)
     if act:
         from ..nn import functional as F
@@ -97,7 +102,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     from ..nn.layer.norm import BatchNorm
     bn = BatchNorm(input.shape[1] if data_layout == "NCHW"
                    else input.shape[-1], momentum=momentum,
-                   epsilon=epsilon)
+                   epsilon=epsilon, data_format=data_layout)
     if is_test:
         bn.eval()
     out = bn(input)

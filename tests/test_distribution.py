@@ -181,3 +181,54 @@ def test_transformed_sampling_statistics():
     s = np.asarray(td.sample([50000])._data)
     np.testing.assert_allclose(s.mean(), 5.0, atol=0.05)
     np.testing.assert_allclose(s.std(), 3.0, atol=0.05)
+
+
+def test_multivariate_normal():
+    cov = np.array([[2.0, 0.3], [0.3, 1.0]], np.float32)
+    loc = np.array([1.0, -1.0], np.float32)
+    mvn = D.MultivariateNormal(loc, covariance_matrix=cov)
+    paddle.seed(0)
+    s = np.asarray(mvn.sample((20000,))._data)
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.05)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+    from scipy.stats import multivariate_normal as ref
+    pt = np.array([0.5, 0.5], np.float32)
+    np.testing.assert_allclose(
+        float(np.asarray(mvn.log_prob(paddle.to_tensor(pt))._data)),
+        ref(loc, cov).logpdf(pt), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mvn.covariance_matrix._data),
+                               cov, rtol=1e-5)
+    ent = float(np.asarray(mvn.entropy()._data))
+    np.testing.assert_allclose(ent, ref(loc, cov).entropy(), rtol=1e-5)
+    with pytest.raises(ValueError):
+        D.MultivariateNormal(loc)
+
+
+def test_chi2_matches_gamma_and_scipy():
+    c2 = D.Chi2(4.0)
+    from scipy.stats import chi2 as ref
+    v = np.array([1.0, 3.0, 7.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(c2.log_prob(paddle.to_tensor(v))._data),
+        ref(4.0).logpdf(v), rtol=1e-4)
+    assert float(np.asarray(c2.mean._data)) == pytest.approx(4.0)
+    assert float(np.asarray(c2.variance._data)) == pytest.approx(8.0)
+
+
+def test_continuous_bernoulli():
+    cb = D.ContinuousBernoulli(0.3)
+    paddle.seed(1)
+    s = np.asarray(cb.sample((40000,))._data)
+    assert 0.0 <= s.min() and s.max() <= 1.0
+    np.testing.assert_allclose(s.mean(),
+                               float(np.asarray(cb.mean._data)), atol=0.01)
+    # log_prob integrates to ~1 over [0,1]
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+    lp = np.asarray(cb.log_prob(paddle.to_tensor(xs))._data)
+    integral = np.trapezoid(np.exp(lp), xs)
+    np.testing.assert_allclose(integral, 1.0, atol=1e-3)
+    # near-0.5 Taylor branch stays finite and ~Uniform
+    cb5 = D.ContinuousBernoulli(0.5)
+    lp5 = np.asarray(cb5.log_prob(paddle.to_tensor(
+        np.array([0.25, 0.75], np.float32)))._data)
+    np.testing.assert_allclose(lp5, 0.0, atol=1e-2)

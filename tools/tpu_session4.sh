@@ -1,0 +1,46 @@
+#!/bin/bash
+# r3 session-4+ TPU window plan. Run when the tunnel is up; phases ordered
+# by value-per-minute and individually timeboxed so a mid-window outage
+# can't wedge anything. Results land in $OUT.
+set -u
+OUT=${1:-/tmp/tpu_session4}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) ===" | tee -a "$OUT/session.log"
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "exit=$? $(tail -c 300 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
+}
+
+# 1. Ring-chunk kernel first on-chip validation (never Mosaic-compiled yet:
+#    traced SMEM offset + vjp). Small shapes; seconds once compiled.
+run ring_kernel 600 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ring_chunk_attention import ring_chunk_attention
+B,H,Hk,S,D = 2,8,4,512,64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B,H,S,D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B,Hk,S,D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B,Hk,S,D), jnp.bfloat16)
+for off in (S, 0, -S//2):
+    o, lse = ring_chunk_attention(q, k, v, off)
+    g = jax.grad(lambda *a: jnp.sum(ring_chunk_attention(*a, off)[0].astype(jnp.float32)), (0,1,2))(q, k, v)
+    print("off", off, "o_norm", float(jnp.linalg.norm(o.astype(jnp.float32))),
+          "dq_norm", float(jnp.linalg.norm(g[0].astype(jnp.float32))))
+print("RING_KERNEL_OK")
+EOF
+
+# 2. Full 5-config bench (validates scan-in-all-configs + vocab-padded
+#    BERT + memory release under the new code; writes BENCH_partial.json)
+run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
+cp BENCH_partial.json "$OUT/" 2>/dev/null
+
+# 3. Decode cost localization (full / dense-attend / two-layer / short)
+run decode_profile 1500 python tools/decode_profile.py
+
+# 4. Decode ratchet refresh
+run bench_decode 900 python bench_decode.py
+
+echo "session complete" | tee -a "$OUT/session.log"
