@@ -1,4 +1,15 @@
 """paddle.amp namespace."""
 from . import debugging
-from .auto_cast import auto_cast, amp_guard, decorate
+from .auto_cast import auto_cast, amp_guard, decorate, white_list, black_list
 from .grad_scaler import GradScaler, AmpScaler
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is native on every TPU generation (and XLA:CPU emulates)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """fp16 compute is supported via XLA (TPU prefers bf16; the MXU runs
+    fp16 at the same rate)."""
+    return True
