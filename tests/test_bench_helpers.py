@@ -50,6 +50,38 @@ def test_timed_train_scan_reports_effective_k(monkeypatch):
     bench._BUDGET_S[0] = 1500.0            # restore default
 
 
+def test_tpu_record_append_and_standing_ratchet(monkeypatch, tmp_path):
+    """BENCH_tpu.json is append-only: new windows land after earlier ones,
+    and the standing ratchet is the NEWEST entry (what the CPU-fallback
+    JSON embeds as standing_tpu_ratchet)."""
+    log = tmp_path / "BENCH_tpu.json"
+    monkeypatch.setitem(bench.__dict__, "_TPU_LOG", str(log))
+    assert bench._load_standing_ratchet() is None   # missing file -> None
+
+    bench._append_tpu_record({"value": 100.0, "window_utc": "w1"})
+    bench._append_tpu_record({"value": 200.0, "window_utc": "w2"})
+    import json
+    entries = json.loads(log.read_text())
+    assert [e["value"] for e in entries] == [100.0, 200.0]
+    assert bench._load_standing_ratchet()["window_utc"] == "w2"
+
+    # corrupt file: loader degrades to None, appender must not raise
+    log.write_text("{not json")
+    assert bench._load_standing_ratchet() is None
+    bench._append_tpu_record({"value": 1.0})   # prints a warning, no raise
+
+
+def test_probe_cache_ttl_keyed_on_kind():
+    """The probe-down cache TTL depends on the recorded failure kind:
+    'timeout' (real outage) honors the long TTL; 'error'/'init-flake'
+    (transient class) expires after the short TTL so a recovering tunnel
+    is retried instead of written off for 10 minutes."""
+    assert bench._probe_cache_ttl("timeout") == 600
+    assert bench._probe_cache_ttl("error") == 150
+    assert bench._probe_cache_ttl("init-flake") == 150
+    assert bench._probe_cache_ttl(None) == 150   # unparseable cache file
+
+
 def test_first_call_watchdog_disarms_on_exception():
     # disabled: returns a no-op disarm
     disarm = bench._first_call_watchdog(False)
