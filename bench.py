@@ -314,8 +314,15 @@ def _append_tpu_record(record):
     try:
         entries = []
         if os.path.exists(_TPU_LOG):
-            with open(_TPU_LOG) as f:
-                entries = json.load(f)
+            try:
+                with open(_TPU_LOG) as f:
+                    entries = json.load(f)
+            except ValueError:
+                # corrupt (bad merge): preserve the old bytes aside and
+                # start a fresh list — NEVER drop a measured TPU window
+                os.replace(_TPU_LOG, _TPU_LOG + ".corrupt")
+                print(f"bench: {os.path.basename(_TPU_LOG)} unparseable; "
+                      "moved aside to .corrupt", file=sys.stderr)
         if not isinstance(entries, list):  # hand edit / bad merge: keep
             entries = [entries]            # the old content, don't crash
         entries.append(record)

@@ -236,6 +236,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         return
     if g.ranks and g.rank < 0:
         return                      # not a member of this group: no-op
+    src_gr = g.get_group_rank(src) if g.ranks else src
+    if max(g.rank, 0) == src_gr and len(in_object_list or []) != g.nranks:
+        # loud at the call site (reference errors here too) — a short
+        # list would broadcast fine and only fail ranks >= len(payload)
+        # later with an opaque IndexError
+        raise ValueError(
+            f"scatter_object_list: src needs one object per rank "
+            f"(got {len(in_object_list or [])}, nranks {g.nranks})")
     payload = list(in_object_list or [None] * g.nranks)
     broadcast_object_list(payload, src=src, group=g)
     me = max(g.rank, 0)

@@ -182,10 +182,21 @@ class GroupShardedStage2(Layer):
     and schedules collectives; they are accepted for API parity and
     ignored. `offload` is NOT supported and raises (see optimizer)."""
 
+    _warned_ignored = False
+
     def __init__(self, layer, sharding_optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
                  device="tpu", dp_group=None):
         super().__init__()
+        if ((sync_buffers or buffer_max_size != 2 ** 23)
+                and not GroupShardedStage2._warned_ignored):
+            GroupShardedStage2._warned_ignored = True
+            import warnings
+            warnings.warn(
+                "GroupShardedStage2: buffer_max_size/sync_buffers are "
+                "accepted for API parity but ignored on TPU — XLA fuses "
+                "gradient collectives and schedules overlap itself",
+                UserWarning, stacklevel=2)
         self._layers = layer
         self._sharding_optimizers = (sharding_optimizer
                                      if isinstance(sharding_optimizer, list)

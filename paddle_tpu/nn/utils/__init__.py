@@ -125,10 +125,16 @@ def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
         wo = getattr(lay, name + "_orig")
         # ONE power iteration per call: advance u eagerly (stop-gradient
         # buffer semantics), then reuse the converged (u, v) inside the
-        # traced sigma computation
+        # traced sigma computation. Under a jit.to_static trace the
+        # weight (hence u_new) is a tracer — persisting it into the u
+        # buffer would leak the tracer into post-trace calls (the same
+        # failure class the jit rollback guards for optimizer slots), so
+        # the power-iteration STATE freezes under tracing and only
+        # eager/concrete calls advance it.
         wm_host = _mat(jax.lax.stop_gradient(wo._data).astype(jnp.float32))
         u_new, v_new = _power_iter(wm_host, u_t._data)
-        u_t._data = u_new
+        if not isinstance(u_new, jax.core.Tracer):
+            u_t._data = u_new
 
         def f(wo_):
             wm = _mat(wo_.astype(jnp.float32))

@@ -194,6 +194,22 @@ class Optimizer:
         cache = getattr(self, "_fused_cache", None)
         if cache is None:
             cache = self._fused_cache = {}
+        if len(cache) == 8 and key not in cache:
+            # cache-size guard (r3 weak #8): a churning key means some
+            # Python-level hyperparameter (clip config, wd groups, per-
+            # param lr scales) mutates every step — each step then pays a
+            # full retrace. Warn once; keep stepping correctly.
+            import warnings
+            warnings.warn(
+                "fused eager step: 9th distinct (param-set, hyperparam) "
+                "signature — per-step hyperparameter churn causes a "
+                "retrace every step; set PADDLE_TPU_FUSE_EAGER_STEP=0 or "
+                "hold hyperparameters constant between steps",
+                UserWarning, stacklevel=3)
+        if len(cache) >= 16 and key not in cache:
+            # bound host memory under churn: evict the oldest compiled
+            # program (insertion order); warn-once above already fired
+            del cache[next(iter(cache))]
         fn = cache.get(key)
         if fn is None:
             from ..jit import to_static

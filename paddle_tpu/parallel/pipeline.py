@@ -143,7 +143,8 @@ def gpipe_interleaved(stage_fn: Callable, chunk_params, x_mb,
     dynamic_index over the local [v, ...] chunk stack. Pipeline bubble is
     P-1 ticks total (vs v·(P-1) for running v sequential gpipe passes),
     matching the interleaved-1F1B bubble reduction. M not divisible by P
-    wastes the masked tail slots of the last wave.
+    leaves masked tail slots in the last wave; their TICKS are irreducible
+    (ring latency), but their compute is skipped via lax.cond in the tick.
 
     chunk_params: this device's chunks, leading axis v (chunk c = global
         chunk c·P + i). stage_fn(one_chunk_params, h) -> h.
@@ -179,9 +180,17 @@ def gpipe_interleaved(stage_fn: Callable, chunk_params, x_mb,
         params_c = jax.tree.map(
             lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
             chunk_params)
-        new = fn(params_c, inp)
-        # don't let garbage from invalid slots contaminate the ring
-        new = jnp.where(valid, new, incoming)
+        # Invalid slots (ramp-up/down + the masked tail when M % P != 0)
+        # SKIP the stage compute entirely — a real lax.cond, not a select:
+        # inside shard_map the predicate is a per-device scalar, so the
+        # false branch is a true no-op that passes the ring value through
+        # instead of computing garbage and discarding it. The tail TICKS
+        # themselves are irreducible: a chunk wave must span P ticks
+        # because that is the ring latency before (mb, c+1) can re-enter
+        # device 0, so a "shorter last wave" would ask for activations
+        # that have not completed the ring yet.
+        new = jax.lax.cond(valid, lambda: fn(params_c, inp),
+                           lambda: incoming)
 
         done = (i == p - 1) & (c == v - 1) & valid
         cur = jax.lax.dynamic_index_in_dim(outs, mb_idx, 0, keepdims=False)

@@ -47,20 +47,41 @@ class Normalize:
 
 
 class Resize:
+    # reference interpolation names (and the cv2-backend int codes ported
+    # code passes) -> jax.image.resize methods; 'area' has no jax.image
+    # equivalent and raises loudly rather than silently bilinear-sampling
+    # (which corrupts e.g. integer label masks)
+    _METHODS = {"nearest": "nearest", "bilinear": "linear",
+                "bicubic": "cubic", "lanczos": "lanczos3",
+                0: "nearest", 1: "linear", 2: "cubic", 4: "lanczos3"}
+
     def __init__(self, size, interpolation="bilinear"):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
+        if interpolation not in self._METHODS:
+            raise ValueError(
+                f"Resize: unsupported interpolation {interpolation!r}; "
+                f"supported: {sorted(map(str, self._METHODS))}")
+        self.interpolation = interpolation
 
     def __call__(self, img):
         import jax
-        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img,
-                                                                     np.float32)
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        dtype = arr.dtype
         chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
         if chw:
             new_shape = (arr.shape[0],) + self.size
         else:
             new_shape = self.size + (arr.shape[-1],) if arr.ndim == 3 else self.size
-        out = jax.image.resize(arr, new_shape, method="bilinear")
-        return Tensor(out)
+        method = self._METHODS[self.interpolation]
+        if method == "nearest":   # exact-copy sampling: any dtype directly
+            out = jax.image.resize(arr, new_shape, method="nearest")
+        else:
+            out = jax.image.resize(arr.astype(np.float32), new_shape,
+                                   method=method)
+            if np.issubdtype(dtype, np.integer):
+                info = np.iinfo(dtype)
+                out = np.clip(np.rint(np.asarray(out)), info.min, info.max)
+        return Tensor(np.asarray(out).astype(dtype, copy=False))
 
 
 class RandomCrop:

@@ -138,6 +138,29 @@ def test_spectral_norm_unit_sigma():
     np.testing.assert_allclose(sigma, 1.0, rtol=1e-2)
 
 
+def test_spectral_norm_no_tracer_leak_under_to_static():
+    """r3 advisor: the power-iteration buffer must FREEZE under a
+    jit.to_static trace — persisting the traced u would poison every
+    later call (leaked-tracer class). Also: eager calls still advance it."""
+    import jax
+    paddle.seed(6)
+    lin = paddle.nn.Linear(8, 8)
+    spectral_norm(lin)
+    u_buf = lin.weight_u
+
+    lin(paddle.to_tensor(np.eye(8, dtype=np.float32)))   # eager: advances
+    u_after_eager = np.asarray(u_buf._data).copy()
+
+    fwd = paddle.jit.to_static(lambda x: lin(x))
+    out = fwd(paddle.to_tensor(np.eye(8, dtype=np.float32)))
+    assert not isinstance(u_buf._data, jax.core.Tracer)
+    np.testing.assert_allclose(np.asarray(u_buf._data), u_after_eager)
+    # the traced forward still produced a normalized weight
+    assert np.isfinite(np.asarray(out._data)).all()
+    # a second compiled call must not blow up on a stale tracer
+    fwd(paddle.to_tensor(np.eye(8, dtype=np.float32)))
+
+
 def test_clip_grad_helpers():
     p = paddle.to_tensor(np.zeros(4, np.float32))
     p.stop_gradient = False

@@ -313,3 +313,29 @@ class TestFusedEagerStep:
             "fused path never engaged"
         # one trace signature despite the LR changing mid-run
         assert len(opt._fused_fn._cache) <= 2   # slot-creation + steady
+
+    def test_cache_churn_warns_once(self):
+        """r3 weak #8: per-step hyperparameter churn (e.g. mutating a
+        param's lr scale every step) silently retraces every step — the
+        9th distinct cache signature must warn once."""
+        import os
+        import warnings
+        os.environ["PADDLE_TPU_FUSE_EAGER_STEP"] = "1"
+        paddle.seed(12)
+        m = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        opt._fuse_eager = None
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            for i in range(10):
+                for p in m.parameters():   # churn the hyper key each step
+                    p.optimize_attr = {"learning_rate": 1.0 + i * 0.01}
+                loss = (m(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        msgs = [w for w in rec
+                if "hyperparameter churn" in str(w.message)]
+        assert len(msgs) == 1

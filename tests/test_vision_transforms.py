@@ -124,6 +124,27 @@ def test_transform_classes_shapes():
     assert T.RandomErasing(1.0)(img).shape == img.shape
 
 
+def test_resize_honors_interpolation_and_dtype():
+    """r3 advisor: 'nearest' must not silently bilinear-sample (corrupts
+    integer label masks), and integer inputs must keep their dtype."""
+    import pytest
+    mask = np.zeros((8, 8), np.uint8)
+    mask[:, 4:] = 7                         # two flat label regions
+    out = T.resize(mask, 16, interpolation="nearest")
+    assert out.dtype == np.uint8
+    assert set(np.unique(out)) == {0, 7}    # no interpolated labels
+
+    up = T.resize(mask, 16, interpolation="bilinear")
+    assert up.dtype == np.uint8             # dtype preserved (rounded)
+    assert up.min() >= 0 and up.max() <= 7
+
+    f32 = np.linspace(0, 1, 64, dtype=np.float32).reshape(8, 8)
+    assert T.resize(f32, 4).dtype == np.float32
+
+    with pytest.raises(ValueError, match="unsupported interpolation"):
+        T.Resize(16, interpolation="area")
+
+
 def test_compose_pipeline_to_tensor():
     img = _img_hwc(32, 32)
     pipe = T.Compose([T.RandomResizedCrop(16), T.RandomHorizontalFlip(),
