@@ -90,9 +90,15 @@ class FusedDecoder:
       * the KV cache is a layer-stacked static ring buffer
         [L, 2, B, H, Smax, D] in kernel layout (no per-step transposes or
         reallocation; position is data, so one executable serves every t);
-      * the layer loop is a lax.scan over stacked layer params — the Pallas
-        flash-decode kernel (ops/pallas/decode_attention.py) compiles once
-        and streams KV blocks for each of the L layers;
+      * the cache is IN-PLACE: it rides the layer scan as carry with one
+        tiny dynamic_update_slice per layer (the reference's in-place
+        per-step cache write in fused_multi_transformer_op.cu), and the
+        Pallas flash-decode kernel reads layer l's blocks straight out of
+        the stacked buffer via a scalar-prefetch layer index
+        (decode_attention_stacked) — the full stack is never copied per
+        token;
+      * the layer loop is a lax.scan over stacked layer params — the
+        kernel compiles once and streams KV blocks for each layer;
       * under an active mesh with mp >= 2 the attention falls back to a
         dense masked form whose head dimension GSPMD shards over 'mp'
         (TP-sharded decode; the manual shard_map kernel path is a
@@ -108,7 +114,11 @@ class FusedDecoder:
         self.fmt = fmt
         self.embed = embed
         self.head = head
-        self.smax = int(max_seq_len)
+        # ring capacity rounds up to a 128-multiple: the stacked-cache
+        # Pallas kernel tiles Smax exactly (padding the stacked buffer
+        # per call would copy every layer), and extra capacity only means
+        # a slightly longer ring — callers still get >= max_seq_len
+        self.smax = -(-int(max_seq_len) // 128) * 128
         self.use_rotary = use_rotary
         if use_rotary and float(rope_base) != 10000.0:
             raise NotImplementedError(
@@ -252,19 +262,23 @@ class FusedDecoder:
             rot = jnp.concatenate([-x2, x1], axis=-1)
             return (x * cc.astype(x.dtype) + rot * ss.astype(x.dtype))
 
-        def attend(q, cache, t):
-            # q: [B, 1, H, D]; cache: [2, B, H, Smax, D]
+        def attend(q, caches, l, t):
+            # q: [B, 1, H, D]; caches: [L, 2, B, H, Smax, D] (full stack —
+            # the kernel addresses layer l via scalar prefetch, zero-copy)
             qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
             if mesh is None:
                 from ..ops.pallas.decode_attention import (
-                    decode_attention_bhsd, is_supported)
-                if is_supported((q.shape[0], 1, nh, hd),
-                                (q.shape[0], smax, nh, hd), q.dtype):
+                    decode_attention_stacked, stacked_is_supported)
+                if stacked_is_supported((q.shape[0], 1, nh, hd),
+                                        caches.shape, q.dtype):
                     lens = jnp.full((q.shape[0],), t, jnp.int32)
-                    o = decode_attention_bhsd(qt, cache[0], cache[1], lens)
+                    o = decode_attention_stacked(qt, caches, l, lens)
                     return jnp.swapaxes(o, 1, 2)
             # dense masked fallback — under a mesh the head dim ('mp')
-            # shards this einsum Megatron-style
+            # shards this einsum Megatron-style; the layer slice fuses
+            # into the einsum operand read (no materialized copy)
+            cache = jax.lax.dynamic_index_in_dim(caches, l, 0,
+                                                 keepdims=False)
             s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
                            cache[0].astype(jnp.float32)) * (hd ** -0.5)
             mask = jnp.arange(smax)[None, None, None, :] <= t
@@ -274,8 +288,7 @@ class FusedDecoder:
                            cache[1].astype(jnp.float32))
             return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
-        def layer_step(x, xs, t):
-            p, cache = xs
+        def layer_step(x, p, caches, l, t):
             residual = x
             h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
             emb = h.shape[-1]
@@ -288,13 +301,17 @@ class FusedDecoder:
             if use_rotary:
                 q = rope1(q, t)
                 k = rope1(k, t)
-            # write-then-attend at ring position t
-            knew = jnp.swapaxes(k, 1, 2)[None]          # [1, B, H, 1, D]
-            vnew = jnp.swapaxes(v, 1, 2)[None]
-            cache = jax.lax.dynamic_update_slice(
-                cache, jnp.concatenate([knew, vnew], 0).astype(cache.dtype),
-                (0, 0, 0, t, 0))
-            attn = attend(q, cache, t)
+            # write-then-attend: ONE tiny [1, 2, B, H, 1, D] in-place
+            # update at (l, :, :, :, t, :) on the scan-carried buffer —
+            # the full stack is never copied per step (the old layout
+            # emitted the updated cache as stacked scan ys, rewriting the
+            # entire [L, 2, B, H, Smax, D] buffer every token)
+            kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                jnp.swapaxes(v, 1, 2)])  # [2, B, H, 1, D]
+            caches = jax.lax.dynamic_update_slice(
+                caches, kv_new[None].astype(caches.dtype),
+                (l, 0, 0, 0, t, 0))
+            attn = attend(q, caches, l, t)
             attn = attn.reshape(b, 1, nh * hd)
             attn = attn @ p["lin_w"].astype(attn.dtype) + \
                 p["lin_b"].astype(attn.dtype)
@@ -309,7 +326,7 @@ class FusedDecoder:
             x = residual + h
             if not pre_ln:
                 x = ln(x, p["fln_s"], p["fln_b"])
-            return x, cache
+            return x, caches
 
         embed, head = self.embed, self.head
         e_params, h_params = self._embed_params, self._head_params
@@ -323,7 +340,11 @@ class FusedDecoder:
 
         def hidden(stk, e_arrays, caches, tok, t):
             # tok: [B] int32; t: scalar int32; caches: [L, 2, B, H, Smax, D]
-            # -> (x [B, 1, E], caches) with caches updated at position t
+            # -> (x [B, 1, E], caches) with caches updated at position t.
+            # The cache rides the layer scan as CARRY (in-place dynamic
+            # updates on one buffer), not as xs->ys (which rewrote the
+            # whole stack per token — the r3 decode profile's ~10 ms/token
+            # vs ~1 ms bandwidth-floor gap).
             x = call_layerlike(embed, e_params, e_arrays, tok[:, None])
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -331,9 +352,14 @@ class FusedDecoder:
                     caches, NamedSharding(
                         mesh, P(None, None, None, "mp", None, None)))
 
-            def body(x, xs):
-                return layer_step(x, xs, t)
-            x, caches = jax.lax.scan(body, x, (stk, caches))
+            def body(carry, xs):
+                x, caches = carry
+                p, l = xs
+                x, caches = layer_step(x, p, caches, l, t)
+                return (x, caches), None
+            nl = caches.shape[0]
+            (x, caches), _ = jax.lax.scan(
+                body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
             return x, caches
 
         def sample_head(h_arrays, x, key):

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["decode_attention", "is_supported"]
+__all__ = ["decode_attention", "decode_attention_stacked", "is_supported",
+           "stacked_is_supported"]
 
 NEG_INF = -1e30
 
@@ -42,6 +43,32 @@ def is_supported(q_shape, cache_shape, dtype) -> bool:
     if q_shape[2] % cache_shape[2] != 0:
         return False
     return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _online_softmax_block(q, k, v, n_valid, k_start, acc_sc, m_sc, l_sc,
+                          *, scale, sq, bq, bk):
+    """One KV block's update of the running (acc, m, l) flash state —
+    shared by the per-layer and stacked-cache kernels (the only thing
+    that differs between them is how refs address their blocks)."""
+    # dots in input dtype (bf16 MXU full rate), f32 accumulation/softmax
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    # row r is the token at global position n_valid + r: attends the
+    # prefix (cols < n_valid) and itself/earlier new tokens (causal)
+    mask = (rows < sq) & (cols <= n_valid + rows)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_sc[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+    l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_sc[:] = m_new
+    acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
@@ -62,28 +89,9 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
 
     @pl.when(run)
     def _():
-        # dots in input dtype (bf16 MXU full rate), f32 accumulation/softmax
-        q = q_ref[0, 0]                                # [bq, d]
-        k = k_ref[0, 0]                                # [bk, d]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
-        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        # row r is the token at global position n_valid + r: attends the
-        # prefix (cols < n_valid) and itself/earlier new tokens (causal)
-        mask = (rows < sq) & (cols <= n_valid + rows)
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev = m_sc[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_sc[:] = m_new
-        v = v_ref[0, 0]
-        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _online_softmax_block(q_ref[0, 0], k_ref[0, 0], v_ref[0, 0],
+                              n_valid, k_start, acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq, bq=bq, bk=bk)
 
     @pl.when(ki == nk - 1)
     def _():
@@ -157,3 +165,125 @@ def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
         interpret=_interpret(),
     )(lens, qt, kt, vt)
     return out[:, :, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Stacked-cache variant: the multi-layer decode loop's KV cache is ONE
+# [L, 2, B, Hk, Smax, D] buffer carried through the layer scan. Slicing
+# caches[l] on the host side materializes a full per-layer copy as the
+# kernel operand every (token, layer); here the LAYER INDEX rides in as a
+# scalar-prefetch argument and the BlockSpec index_map addresses layer l's
+# blocks directly in the stacked buffer — zero-copy reads, which is what
+# makes the carry-with-in-place-update cache design actually bandwidth-
+# minimal (reference anchor: fused_multi_transformer_op.cu's per-step
+# in-place cache write).
+# ---------------------------------------------------------------------------
+
+def stacked_is_supported(q_shape, caches_shape, dtype) -> bool:
+    """caches: [L, 2, B, Hk, Smax, D]; q: [B, Sq, H, D] (layout as
+    decode_attention). The Smax axis must tile exactly (padding the
+    stacked buffer would copy all layers)."""
+    if len(q_shape) != 4 or len(caches_shape) != 6:
+        return False
+    if q_shape[-1] > 256 or q_shape[1] > 128:
+        return False
+    if q_shape[2] % caches_shape[3] != 0:
+        return False
+    smax = caches_shape[4]
+    if not any(smax % bk == 0 for bk in (256, 128)):
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _stacked_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                    acc_sc, m_sc, l_sc, *, scale, sq, bq, bk):
+    # same flash math as _kernel (shared _online_softmax_block); k/v
+    # blocks come out of the stacked buffer addressed by the prefetched
+    # layer scalar, so their block rank is 6 (leading (1, 1) layer/kv)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = k_start < n_valid + sq
+
+    @pl.when(run)
+    def _():
+        _online_softmax_block(q_ref[0, 0], k_ref[0, 0, 0, 0],
+                              v_ref[0, 0, 0, 0], n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq, bq=bq, bk=bk)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
+    """qt: [B, H, Sq, D] (kernel layout); caches: [L, 2, B, Hk, Smax, D]
+    (kv axis: 0 = K, 1 = V); layer: scalar int32 (traced OK — it is a
+    scalar-prefetch operand); cache_lens: [B] int32. Returns
+    [B, H, Sq, D] — attention of the new queries over layer `layer`'s
+    cache prefix + the just-written new positions."""
+    b, h, sq, d = qt.shape
+    hk, smax = caches.shape[3], caches.shape[4]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    out_dtype = qt.dtype          # mixed-precision contract: output in
+    if caches.dtype != qt.dtype:  # the CALLER's query dtype, like
+        qt = qt.astype(caches.dtype)  # decode_attention_bhsd
+
+    bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
+    if smax % 256 == 0:
+        bk = 256
+    elif smax % 128 == 0:
+        bk = 128
+    else:
+        # padding the stacked buffer would copy every layer; callers gate
+        # on stacked_is_supported or size the ring to a 128-multiple
+        raise ValueError(
+            f"decode_attention_stacked: Smax {smax} must be a multiple "
+            "of 128 (pad the ring buffer at init, not per call)")
+    if bq != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    grid = (b, h, smax // bk)
+    kidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, j, 0)
+    vidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        lay_r[0], 1, b_, h_ // g, j, 0)
+    out = pl.pallas_call(
+        functools.partial(_stacked_kernel, scale=float(scale), sq=sq,
+                          bq=bq, bk=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
+                pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, bq, d), lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), caches.dtype),
+        interpret=_interpret(),
+    )(lay, lens, qt, caches, caches)
+    return out[:, :, :sq].astype(out_dtype)

@@ -329,6 +329,36 @@ def test_decode_attention_vs_dense(sq, group):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("group", [1, 2])
+def test_decode_attention_stacked_vs_unstacked(group):
+    """Stacked-cache variant (scalar-prefetch layer index into the full
+    [L,2,B,Hk,Smax,D] buffer — the zero-copy read half of the in-place
+    decode cache design) must match the per-layer kernel, including with
+    a TRACED layer index inside a scan (the real multi-layer decode)."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    L, b, h, d, smax = 3, 2, 4, 32, 128
+    hk = h // group
+    rng = np.random.RandomState(1)
+    caches = jnp.asarray(rng.randn(L, 2, b, hk, smax, d), jnp.float32)
+    q = jnp.asarray(rng.randn(b, h, 1, d), jnp.float32)
+    lens = jnp.asarray([9, 77], jnp.int32)
+    assert da.stacked_is_supported((b, 1, h, d), caches.shape, q.dtype)
+
+    for l in range(L):
+        ref = da.decode_attention_bhsd(q, caches[l, 0], caches[l, 1], lens)
+        got = da.decode_attention_stacked(q, caches, l, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def body(carry, l):
+        return carry, da.decode_attention_stacked(q, caches, l, lens)
+    _, outs = jax.jit(lambda: jax.lax.scan(body, 0, jnp.arange(L)))()
+    for l in range(L):
+        ref = da.decode_attention_bhsd(q, caches[l, 0], caches[l, 1], lens)
+        np.testing.assert_allclose(np.asarray(outs[l]), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
 class TestFlashDropout:
     """Flash attention with seed-regenerated dropout (fwd/bwd mask parity)."""
 
