@@ -42,6 +42,7 @@ class Engine:
         self._eval_fn = None
         self._placed = False
         self._reshard_log: list = []
+        self._conflict_plan: dict = {}
 
     @property
     def reshard_cost_log(self):
@@ -81,15 +82,72 @@ class Engine:
                 pass
         self._placed = True
 
-    def _shard_batch(self, arr, mesh):
+    def _axis_conflict_plan(self, arr, mesh):
+        """The planner decision the reference's cost model makes
+        (auto_parallel/static/cost_model.py + Resharder): when the batch's
+        data axis is ALSO claimed by parameter placements (one mesh axis
+        cannot shard both the batch and the weights), choose the cheaper
+        repair by bytes-moved and LOG the decision:
+
+          reshard_input  — keep the annotated model-parallel placements,
+                           replicate the batch (costs input bytes/step);
+          reshard_params — strip the conflicting parameter shardings to
+                           replicated, keep the batch data-parallel
+                           (costs the conflicting params' bytes).
+
+        The decision is made ONCE per input signature — from the MODEL
+        INPUT only; labels follow the input's batch placement rather than
+        voting with their own sizes (two arrays reaching contradictory
+        plans in one step would undo each other). Returns the plan name:
+        'data_parallel' (no conflict), 'reshard_input', or
+        'reshard_params'."""
+        from ...parallel import _valid_spec
+        ax = self._data_axis(mesh)
+        # only REAL on-device conflicts count: a spec _place rejected as
+        # indivisible left the param replicated — no repair needed
+        conflicts = [p for p in self.model.parameters()
+                     if p.sharding_spec is not None
+                     and ax in tuple(p.sharding_spec)
+                     and _valid_spec(p._data, p.sharding_spec, mesh)]
+        if not conflicts:
+            return "data_parallel"
+        input_bytes = int(getattr(arr, "nbytes", np.asarray(arr).nbytes))
+        key = (ax, input_bytes)
+        plan = self._conflict_plan.get(key)
+        if plan is None:
+            param_bytes = sum(int(p._data.nbytes) for p in conflicts)
+            plan = ("reshard_input" if input_bytes <= param_bytes
+                    else "reshard_params")
+            self._conflict_plan[key] = plan
+            self._reshard_log.append({
+                "decision": plan, "axis": ax,
+                "input_bytes": input_bytes, "param_bytes": param_bytes,
+                "conflicting_params": len(conflicts)})
+            if plan == "reshard_params":
+                for p in conflicts:
+                    try:
+                        p._data = jax.device_put(
+                            p._data, NamedSharding(mesh, P()))
+                    except Exception:
+                        continue   # still sharded: keep spec + no log
+                    p.sharding_spec = None
+                    self._reshard_log.append({
+                        "shape": tuple(p.shape), "from": "annotated",
+                        "to": "P()", "bytes_moved": int(p._data.nbytes)})
+        return plan
+
+    def _shard_batch(self, arr, mesh, replicate=False):
         """Batch placement WITH the reshard pass: an input that arrives
         mis-sharded (wrong spec, or a different mesh entirely) is moved to
         the data-parallel layout rather than erroring; the move is costed
-        in the reshard log (reference: Resharder + cost model)."""
+        in the reshard log (reference: Resharder + cost model).
+        replicate=True (the planner chose reshard_input) places the array
+        replicated instead of data-sharded."""
         from .api import _reshard_array
         ax = self._data_axis(mesh)
         if arr.shape[0] % mesh.shape[ax] == 0:
-            spec = P(ax, *([None] * (arr.ndim - 1)))
+            spec = P(*([None] * arr.ndim)) if replicate else \
+                P(ax, *([None] * (arr.ndim - 1)))
             cur = getattr(arr, "sharding", None)
             out, moved = _reshard_array(arr, mesh, spec)
             # cost-log only true reshards — a mesh-committed input whose
@@ -140,12 +198,17 @@ class Engine:
         else:
             x, y = batch, None
         if mesh is not None:
-            x = Tensor(self._shard_batch(
-                x._data if isinstance(x, Tensor) else np.asarray(x), mesh))
+            x_arr = x._data if isinstance(x, Tensor) else np.asarray(x)
+            # ONE planner decision per step, made from the model input;
+            # labels follow the input's batch placement (their own size
+            # must not cast a contradictory vote)
+            replicate = self._axis_conflict_plan(
+                x_arr, mesh) == "reshard_input"
+            x = Tensor(self._shard_batch(x_arr, mesh, replicate))
             if y is not None:
                 y = Tensor(self._shard_batch(
                     y._data if isinstance(y, Tensor) else np.asarray(y),
-                    mesh))
+                    mesh, replicate))
         return x, y
 
     # ------------------------------------------------------------ public

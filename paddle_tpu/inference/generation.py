@@ -270,7 +270,8 @@ class FusedDecoder:
                 from ..ops.pallas.decode_attention import (
                     decode_attention_stacked, stacked_is_supported)
                 if stacked_is_supported((q.shape[0], 1, nh, hd),
-                                        caches.shape, q.dtype):
+                                        caches.shape, q.dtype,
+                                        cache_dtype=caches.dtype):
                     lens = jnp.full((q.shape[0],), t, jnp.int32)
                     o = decode_attention_stacked(qt, caches, l, lens)
                     return jnp.swapaxes(o, 1, 2)

@@ -179,10 +179,14 @@ def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
 # in-place cache write).
 # ---------------------------------------------------------------------------
 
-def stacked_is_supported(q_shape, caches_shape, dtype) -> bool:
+def stacked_is_supported(q_shape, caches_shape, dtype,
+                         cache_dtype=None) -> bool:
     """caches: [L, 2, B, Hk, Smax, D]; q: [B, Sq, H, D] (layout as
     decode_attention). The Smax axis must tile exactly (padding the
-    stacked buffer would copy all layers)."""
+    stacked buffer would copy all layers), and q/cache dtypes must MATCH:
+    unlike decode_attention_bhsd (which upcasts the cache to the query
+    dtype), upcasting the stacked buffer would copy every layer — mixed
+    precision goes to the unstacked or dense path instead."""
     if len(q_shape) != 4 or len(caches_shape) != 6:
         return False
     if q_shape[-1] > 256 or q_shape[1] > 128:
@@ -191,6 +195,8 @@ def stacked_is_supported(q_shape, caches_shape, dtype) -> bool:
         return False
     smax = caches_shape[4]
     if not any(smax % bk == 0 for bk in (256, 128)):
+        return False
+    if cache_dtype is not None and jnp.dtype(cache_dtype) != jnp.dtype(dtype):
         return False
     return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
 
@@ -238,9 +244,16 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
     group = h // hk
     if scale is None:
         scale = d ** -0.5
-    out_dtype = qt.dtype          # mixed-precision contract: output in
-    if caches.dtype != qt.dtype:  # the CALLER's query dtype, like
-        qt = qt.astype(caches.dtype)  # decode_attention_bhsd
+    if caches.dtype != qt.dtype:
+        # downcasting q would silently lose dot/softmax precision and
+        # upcasting the stacked cache would copy every layer — the mixed-
+        # precision cases belong on decode_attention_bhsd (which upcasts
+        # the single-layer cache) or the dense path
+        raise ValueError(
+            f"decode_attention_stacked: query dtype {qt.dtype} != cache "
+            f"dtype {caches.dtype}; gate with stacked_is_supported(..., "
+            "cache_dtype=...) and use the unstacked/dense path instead")
+    out_dtype = qt.dtype
 
     bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
     if smax % 256 == 0:
