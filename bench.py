@@ -771,13 +771,57 @@ def bench_moe(on_tpu, peak_tflops):
     med, final_loss, scan_k = _timed_train(train_step, (x, y),
                                            make_stacked, steps, scan_k)
     tokens_per_sec = batch * seq / med
-    return {
+
+    # MFU from the COMPUTED flops (capacity-padded expert compute, the
+    # flops the chip actually runs): per token fwd = attn block matmuls
+    # + dense-FFN layers + (E·C/S)-weighted expert FFN + tied LM head.
+    e_dim, i_dim = c.hidden_size, c.intermediate_size
+    # the gate's own capacity rule — not a re-derivation that could drift
+    cap = next(blk.ffn.gate for blk in model.blocks
+               if blk.use_moe).capacity(seq)
+    n_moe = sum(1 for i in range(c.num_layers)
+                if i % c.moe_every == c.moe_every - 1)
+    n_dense = c.num_layers - n_moe
+    per_tok_fwd = (
+        c.num_layers * (8 * e_dim * e_dim + 4 * seq * e_dim)   # attn+proj
+        + n_dense * 4 * e_dim * i_dim                          # dense FFN
+        + n_moe * (c.num_experts * cap / seq) * 4 * e_dim * i_dim
+        + 2 * e_dim * c.vocab_size)                            # LM head
+    mfu = (3 * per_tok_fwd * tokens_per_sec) / (peak_tflops * 1e12)
+
+    # decomposition (BASELINE configs[4]'s real metric): identity-dispatch
+    # twin keeps the expert compute identical but removes gate + dispatch/
+    # combine einsums (the alltoall path under EP) — the delta IS the
+    # dispatch cost. One extra compile; gated on remaining budget.
+    dispatch_ms = None
+    if _budget_left(_BUDGET_S[0]) > (240 if on_tpu else 60):
+        os.environ["PADDLE_TPU_MOE_IDENTITY_DISPATCH"] = "1"
+        try:
+            twin_step = paddle.jit.to_static(_step, donate_state=False)
+            _warm(twin_step, (x, y), 2 if on_tpu else 1, False)
+            med_twin, _ = _timed_steps(
+                lambda: twin_step(x, y),
+                lambda out: float(np.asarray(out._data)),
+                max(steps // 2, 2))
+            dispatch_ms = round((med - med_twin) * 1000, 3)
+        except Exception as e:
+            print(f"bench: moe decomposition probe failed: {e}",
+                  file=sys.stderr)
+        finally:
+            os.environ.pop("PADDLE_TPU_MOE_IDENTITY_DISPATCH", None)
+
+    rec = {
         "metric": "ernie_moe_ep_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2), "unit": "tokens/s",
+        "mfu": round(mfu, 4),
         "median_step_s": round(med, 5),
         "batch": batch, "seq": seq, "params": n_params,
         "num_experts": c.num_experts, "loss": final_loss,
     }
+    if dispatch_ms is not None:
+        rec["gate_dispatch_combine_ms"] = dispatch_ms
+        rec["expert_compute_step_ms"] = round(med_twin * 1000, 3)
+    return rec
 
 
 # --------------------------------------------------------------------------

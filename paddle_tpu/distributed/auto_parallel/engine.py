@@ -100,9 +100,21 @@ class Engine:
         voting with their own sizes (two arrays reaching contradictory
         plans in one step would undo each other). Returns the plan name:
         'data_parallel' (no conflict), 'reshard_input', or
-        'reshard_params'."""
-        from ...parallel import _valid_spec
+        'reshard_params'.
+
+        The cached plan short-circuits BEFORE the O(n_params) conflict
+        scan (the scan would otherwise run in the hot input path every
+        step). A reshard_params decision is only cached once every strip
+        succeeded — a transient device_put failure leaves the plan
+        uncached so the next batch retries the remaining strips instead
+        of silently training with the conflict unrepaired."""
+        input_bytes = int(getattr(arr, "nbytes", np.asarray(arr).nbytes))
         ax = self._data_axis(mesh)
+        key = (ax, input_bytes)
+        plan = self._conflict_plan.get(key)
+        if plan is not None:
+            return plan
+        from ...parallel import _valid_spec
         # only REAL on-device conflicts count: a spec _place rejected as
         # indivisible left the param replicated — no repair needed
         conflicts = [p for p in self.model.parameters()
@@ -110,30 +122,34 @@ class Engine:
                      and ax in tuple(p.sharding_spec)
                      and _valid_spec(p._data, p.sharding_spec, mesh)]
         if not conflicts:
+            self._conflict_plan[key] = "data_parallel"
             return "data_parallel"
-        input_bytes = int(getattr(arr, "nbytes", np.asarray(arr).nbytes))
-        key = (ax, input_bytes)
-        plan = self._conflict_plan.get(key)
-        if plan is None:
-            param_bytes = sum(int(p._data.nbytes) for p in conflicts)
-            plan = ("reshard_input" if input_bytes <= param_bytes
-                    else "reshard_params")
+        param_bytes = sum(int(p._data.nbytes) for p in conflicts)
+        plan = ("reshard_input" if input_bytes <= param_bytes
+                else "reshard_params")
+        self._reshard_log.append({
+            "decision": plan, "axis": ax,
+            "input_bytes": input_bytes, "param_bytes": param_bytes,
+            "conflicting_params": len(conflicts)})
+        failed = 0
+        if plan == "reshard_params":
+            for p in conflicts:
+                try:
+                    p._data = jax.device_put(
+                        p._data, NamedSharding(mesh, P()))
+                except Exception:
+                    failed += 1
+                    continue   # still sharded: keep spec + no log
+                p.sharding_spec = None
+                self._reshard_log.append({
+                    "shape": tuple(p.shape), "from": "annotated",
+                    "to": "P()", "bytes_moved": int(p._data.nbytes)})
+            if failed:
+                self._reshard_log.append({
+                    "decision": plan, "strip_failed": failed,
+                    "note": "plan not cached; retried next batch"})
+        if not failed:
             self._conflict_plan[key] = plan
-            self._reshard_log.append({
-                "decision": plan, "axis": ax,
-                "input_bytes": input_bytes, "param_bytes": param_bytes,
-                "conflicting_params": len(conflicts)})
-            if plan == "reshard_params":
-                for p in conflicts:
-                    try:
-                        p._data = jax.device_put(
-                            p._data, NamedSharding(mesh, P()))
-                    except Exception:
-                        continue   # still sharded: keep spec + no log
-                    p.sharding_spec = None
-                    self._reshard_log.append({
-                        "shape": tuple(p.shape), "from": "annotated",
-                        "to": "P()", "bytes_moved": int(p._data.nbytes)})
         return plan
 
     def _shard_batch(self, arr, mesh, replicate=False):

@@ -84,6 +84,16 @@ class GPTMLP(Layer):
                               math.sqrt(2 * c.num_layers))))
 
     def forward(self, x):
+        import os
+        if os.environ.get("PADDLE_TPU_FUSED_FFN") == "1":
+            # Pallas fused bias+gelu+matmul (ops/pallas/fused_ffn.py):
+            # the [M, F] gelu intermediate never touches HBM. Opt-in
+            # pending the on-TPU A/B vs the XLA composite (LN lesson:
+            # pallas_call is a fusion barrier — measure first).
+            from ..ops.pallas.fused_ffn import fused_ffn
+            from ..tensor.tensor import apply_op
+            return apply_op(fused_ffn, x, self.fc1.weight, self.fc1.bias,
+                            self.fc2.weight, self.fc2.bias)
         return self.fc2(F.gelu(self.fc1(x), approximate=True))
 
 

@@ -66,9 +66,19 @@ class Block(Layer):
 class ViT(Layer):
     def __init__(self, image_size=224, patch_size=16, dim=768, depth=12,
                  heads=12, mlp_ratio=4.0, num_classes=1000, dropout=0.0,
-                 in_channels=3, recompute=False):
+                 in_channels=3, recompute=False, patch_matmul=True):
         super().__init__()
         self.recompute = recompute
+        # patch_matmul: realize the stride-P patch conv as space-to-depth
+        # + ONE matmul (mathematically identical — non-overlapping patches
+        # make the conv a blocked matmul). The Conv2D layer still owns the
+        # weights (state-dict parity with the conv formulation); only the
+        # compute path changes: [B,C,H,W] -> [B,N,C·P²] @ [C·P²,D] hits
+        # the MXU as a plain GEMM instead of relying on XLA's NCHW
+        # strided-conv lowering (r3: ViT at 11.2% MFU, patch-conv layout a
+        # named suspect). PADDLE_TPU_PATCH_CONV=1 forces the conv for A/B.
+        self.patch_matmul = patch_matmul
+        self.patch_size = patch_size
         self.patch_embed = Conv2D(in_channels, dim, patch_size,
                                   stride=patch_size)
         n_patches = (image_size // patch_size) ** 2
@@ -82,11 +92,30 @@ class ViT(Layer):
         self.head = Linear(dim, num_classes) if num_classes > 0 else None
 
     def forward(self, x, labels=None):
+        import os
         b = x.shape[0]
-        x = self.patch_embed(x)                 # [B, D, H', W']
-        d = x.shape[1]
-        x = reshape(x, [b, d, -1])
-        x = transpose(x, [0, 2, 1])             # [B, N, D]
+        if self.patch_matmul and \
+                os.environ.get("PADDLE_TPU_PATCH_CONV") != "1":
+            # space-to-depth: [B,C,H,W] -> [B, N, C·P²] in the conv's
+            # (c, ph, pw) flatten order, then one GEMM with the conv
+            # weight viewed as [C·P², D]
+            p = self.patch_size
+            c, hh, ww = x.shape[1], x.shape[2], x.shape[3]
+            gh, gw = hh // p, ww // p
+            xp = reshape(x, [b, c, gh, p, gw, p])
+            xp = transpose(xp, [0, 2, 4, 1, 3, 5])     # [B,gh,gw,C,p,p]
+            xp = reshape(xp, [b, gh * gw, c * p * p])
+            w = self.patch_embed.weight                # [D, C, P, P]
+            d = w.shape[0]
+            wm = transpose(reshape(w, [d, c * p * p]), [1, 0])
+            x = xp @ wm
+            if self.patch_embed.bias is not None:
+                x = x + self.patch_embed.bias          # [B, N, D]
+        else:
+            x = self.patch_embed(x)                 # [B, D, H', W']
+            d = x.shape[1]
+            x = reshape(x, [b, d, -1])
+            x = transpose(x, [0, 2, 1])             # [B, N, D]
         from ..tensor.manipulation import expand
         cls = expand(self.cls_token, [b, 1, d])
         x = concat([cls, x], axis=1)

@@ -1,0 +1,181 @@
+"""Fused transformer FFN as one TPU Pallas kernel.
+
+Capability parity: paddle/fluid/operators/fused/fused_feedforward_op.cu
+(the training-side fused FFN block the BASELINE north-star names). NOT a
+port: one pallas_call computes  out = gelu(x @ W1 + b1) @ W2 + b2  with
+the [bm, bf] activation tile living ONLY in VMEM — the [M, F] gelu
+intermediate (50 MB at the GPT-2 headline shape) is never written to or
+read back from HBM. Grid: (M/bm, F/bf) with the F axis innermost; the
+fp32 output accumulator is revisited across F blocks and written once.
+
+Backward (custom_vjp) recomputes the intermediate from x (flash-style
+residual discipline: only the INPUTS are saved) and runs the five grad
+matmuls as plain jnp — XLA already schedules those well; the fwd fusion
+is where the intermediate-traffic win lives. A/B'd against the XLA
+composite on TPU before becoming any default (the r3 LayerNorm lesson:
+pallas_call is a fusion barrier, composites sometimes win — measure).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_ffn", "ffn_is_supported"]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _gelu_tanh(x):
+    # GPT-2's approximate gelu, computed in fp32 inside the kernel
+    c = math.sqrt(2.0 / math.pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+
+
+def ffn_is_supported(m, k, f, dtype) -> bool:
+    """x: [M, K], W1: [K, F], W2: [F, K]. Lane-dim tiling: K and F must
+    be 128-multiples (the bench shapes are: 768/3072, 1024/2816...)."""
+    if k % 128 or f % 128:
+        return False
+    if m < 8:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_sc,
+            *, bm, bf, nf):
+    fi = pl.program_id(1)
+
+    @pl.when(fi == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    x = x_ref[...]                                   # [bm, K]
+    w1 = w1_ref[...]                                 # [K, bf]
+    pre = jax.lax.dot_general(x, w1, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    pre = pre + b1_ref[...].astype(jnp.float32)      # [bm, bf]
+    t = _gelu_tanh(pre).astype(x.dtype)
+    w2 = w2_ref[...]                                 # [bf, K]
+    acc_sc[:] += jax.lax.dot_general(t, w2, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(fi == nf - 1)
+    def _():
+        o_ref[...] = (acc_sc[:] +
+                      b2_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _fwd_kernel_call(x, w1, b1, w2, b2, bm, bf):
+    m, k = x.shape
+    f = w1.shape[1]
+    nf = f // bf
+    grid = (m // bm, nf)
+    return pl.pallas_call(
+        functools.partial(_kernel, bm=bm, bf=bf, nf=nf),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda mi, fi: (mi, 0)),
+            pl.BlockSpec((k, bf), lambda mi, fi: (0, fi)),
+            pl.BlockSpec((1, bf), lambda mi, fi: (0, fi)),
+            pl.BlockSpec((bf, k), lambda mi, fi: (fi, 0)),
+            pl.BlockSpec((1, k), lambda mi, fi: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, k), lambda mi, fi: (mi, 0)),
+        scratch_shapes=[pltpu.VMEM((bm, k), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((m, k), x.dtype),
+        interpret=_interpret(),
+    )(x, w1, b1.reshape(1, f), w2, b2.reshape(1, k))
+
+
+def _pick_bm(m, k, f, bf, dtype):
+    """Row-tile: big enough to feed the MXU, small enough that
+    x + w1/w2 blocks + fp32 acc fit VMEM (~16 MB budget)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    for bm in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if m % bm:
+            continue
+        vmem = (bm * k * itemsize          # x tile
+                + 2 * k * bf * itemsize    # w1 + w2 blocks
+                + bm * bf * 4              # pre/t tile (fp32)
+                + bm * k * 4)              # accumulator
+        if vmem <= 12 * 1024 * 1024:
+            return bm
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_ffn(x, w1, b1, w2, b2):
+    """out = gelu_tanh(x @ w1 + b1) @ w2 + b2, x: [..., K] flattened to
+    [M, K] internally. Falls back to the XLA composite when shapes don't
+    tile (callers may also gate on ffn_is_supported)."""
+    out, _ = _fused_ffn_fwd(x, w1, b1, w2, b2)
+    return out
+
+
+def _composite(x2, w1, b1, w2, b2):
+    t = _gelu_tanh((x2 @ w1 + b1).astype(jnp.float32)).astype(x2.dtype)
+    return t @ w2 + b2
+
+
+def _fused_ffn_fwd(x, w1, b1, w2, b2):
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    f = w1.shape[1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    # bf must DIVIDE f exactly — nf = f // bf would silently drop the
+    # tail columns otherwise (f % 128 == 0 guarantees a divisor exists)
+    bf = next((c for c in (512, 256, 128) if f % c == 0), None)
+    bm = _pick_bm(m, k, f, bf or 128, x.dtype)
+    if not ffn_is_supported(m, k, f, x.dtype) or bm is None or bf is None:
+        out = _composite(x2, w1, b1, w2, b2)
+    else:
+        out = _fwd_kernel_call(x2, w1, b1, w2, b2, bm, bf)
+    return out.reshape(*lead, k), (x, w1, b1, w2, b2)
+
+
+def _fused_ffn_bwd(res, g):
+    x, w1, b1, w2, b2 = res
+    k = x.shape[-1]
+    f = w1.shape[1]
+    x2 = x.reshape(-1, k)
+    g2 = g.reshape(-1, k)
+    # recompute the intermediate (inputs-only residuals); grads as plain
+    # XLA matmuls — fp32 accumulation via preferred_element_type
+    pre = (jax.lax.dot_general(x2, w1, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+           + b1.astype(jnp.float32))
+    t = _gelu_tanh(pre)
+    # d gelu_tanh / d pre
+    c = math.sqrt(2.0 / math.pi)
+    u = c * (pre + 0.044715 * pre ** 3)
+    th = jnp.tanh(u)
+    dgelu = 0.5 * (1.0 + th) + 0.5 * pre * (1.0 - th * th) * c * (
+        1.0 + 3 * 0.044715 * pre ** 2)
+    dt = jax.lax.dot_general(g2, w2, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dpre = dt * dgelu
+    dx = jax.lax.dot_general(dpre.astype(x2.dtype), w1,
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dw1 = jax.lax.dot_general(x2, dpre.astype(x2.dtype),
+                              (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    dw2 = jax.lax.dot_general(t.astype(x2.dtype), g2,
+                              (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    db1 = jnp.sum(dpre, axis=0)
+    db2 = jnp.sum(g2.astype(jnp.float32), axis=0)
+    return (dx.astype(x.dtype).reshape(x.shape),
+            dw1.astype(w1.dtype), db1.astype(b1.dtype),
+            dw2.astype(w2.dtype), db2.astype(b2.dtype))
+
+
+fused_ffn.defvjp(_fused_ffn_fwd, _fused_ffn_bwd)

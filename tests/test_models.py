@@ -136,6 +136,32 @@ class TestViT:
         losses = _train_steps(m, lambda: (x, y), n=5)
         assert losses[-1] < losses[0]
 
+    def test_patch_matmul_matches_conv(self, monkeypatch):
+        """Space-to-depth patch embedding (one GEMM on the conv's own
+        weights) must match the strided-conv formulation exactly — fwd
+        logits AND the patch-embed weight grad (r4 ViT perf lever)."""
+        from paddle_tpu.models.vit import vit_tiny
+
+        def run(force_conv):
+            if force_conv:
+                monkeypatch.setenv("PADDLE_TPU_PATCH_CONV", "1")
+            else:
+                monkeypatch.delenv("PADDLE_TPU_PATCH_CONV", raising=False)
+            paddle.seed(9)
+            m = vit_tiny()
+            x = paddle.to_tensor(np.random.RandomState(4).randn(
+                2, 3, 32, 32).astype(np.float32))
+            y = paddle.to_tensor(np.array([2, 7], np.int64))
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            return (float(np.asarray(loss._data)),
+                    np.asarray(m.patch_embed.weight.grad._data))
+
+        l_mm, g_mm = run(force_conv=False)
+        l_cv, g_cv = run(force_conv=True)
+        np.testing.assert_allclose(l_mm, l_cv, rtol=1e-5)
+        np.testing.assert_allclose(g_mm, g_cv, atol=1e-4, rtol=1e-4)
+
 
 class TestMoE:
     def test_moe_layer_capacity_routing(self):

@@ -429,3 +429,82 @@ class TestFlashDropout:
                                np.asarray(o_ref._data))
         o_drop.sum().backward()
         assert q.grad is not None
+
+
+class TestFusedFFN:
+    """Pallas fused bias+gelu+matmul FFN (reference anchor:
+    fused_feedforward_op.cu) vs the XLA composite — fwd, grads, and the
+    GPTMLP opt-in dispatch."""
+
+    def _args(self, M=64, K=128, F=256, dtype=jnp.float32):
+        rng = np.random.RandomState(0)
+        return (jnp.asarray(rng.randn(M, K), dtype),
+                jnp.asarray(rng.randn(K, F) * 0.05, dtype),
+                jnp.asarray(rng.randn(F) * 0.1, dtype),
+                jnp.asarray(rng.randn(F, K) * 0.05, dtype),
+                jnp.asarray(rng.randn(K) * 0.1, dtype))
+
+    def test_fwd_and_grads_match_composite(self):
+        from paddle_tpu.ops.pallas.fused_ffn import (_composite,
+                                                     ffn_is_supported,
+                                                     fused_ffn)
+        args = self._args()
+        assert ffn_is_supported(64, 128, 256, jnp.float32)
+        np.testing.assert_allclose(np.asarray(fused_ffn(*args)),
+                                   np.asarray(_composite(*args)),
+                                   atol=1e-5, rtol=1e-5)
+        lf = lambda fn: (lambda *a: jnp.sum(fn(*a) ** 2))
+        g1 = jax.grad(lf(fused_ffn), argnums=(0, 1, 2, 3, 4))(*args)
+        g2 = jax.grad(lf(_composite), argnums=(0, 1, 2, 3, 4))(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3, rtol=1e-3)
+
+    def test_llama_shape_f_not_multiple_of_512(self):
+        """F=2816 (the LLaMA 1024/2816 shape) is a 128- but not
+        512-multiple: bf must step down to a divisor — a truncating
+        nf = f // bf would silently drop the last 256 columns."""
+        from paddle_tpu.ops.pallas.fused_ffn import _composite, fused_ffn
+        rng = np.random.RandomState(3)
+        M, K, F = 16, 128, 2816
+        x = jnp.asarray(rng.randn(M, K), jnp.float32)
+        w1 = jnp.asarray(rng.randn(K, F) * 0.03, jnp.float32)
+        b1 = jnp.asarray(rng.randn(F) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.randn(F, K) * 0.03, jnp.float32)
+        b2 = jnp.asarray(rng.randn(K) * 0.1, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(fused_ffn(x, w1, b1, w2, b2)),
+            np.asarray(_composite(x, w1, b1, w2, b2)),
+            atol=2e-4, rtol=1e-4)
+
+    def test_fallback_on_untileable_shapes(self):
+        from paddle_tpu.ops.pallas.fused_ffn import _composite, fused_ffn
+        rng = np.random.RandomState(1)
+        # K=96 not a 128-multiple: must fall back, not crash
+        x = jnp.asarray(rng.randn(16, 96), jnp.float32)
+        w1 = jnp.asarray(rng.randn(96, 192) * 0.05, jnp.float32)
+        b1 = jnp.zeros(192, jnp.float32)
+        w2 = jnp.asarray(rng.randn(192, 96) * 0.05, jnp.float32)
+        b2 = jnp.zeros(96, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(fused_ffn(x, w1, b1, w2, b2)),
+            np.asarray(_composite(x, w1, b1, w2, b2)), atol=1e-5)
+
+    def test_gptmlp_dispatch_matches(self, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.gpt import GPTConfig, GPTMLP
+        c = GPTConfig(hidden_size=128, intermediate_size=256, num_layers=2)
+        paddle.seed(13)
+        mlp = GPTMLP(c)
+        x = paddle.to_tensor(np.random.RandomState(2).randn(
+            2, 16, 128).astype(np.float32))
+        monkeypatch.delenv("PADDLE_TPU_FUSED_FFN", raising=False)
+        ref = mlp(x)
+        monkeypatch.setenv("PADDLE_TPU_FUSED_FFN", "1")
+        out = mlp(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), atol=1e-5)
+        # and grads flow through the tape
+        loss = (out ** 2).mean()
+        loss.backward()
+        assert mlp.fc1.weight.grad is not None

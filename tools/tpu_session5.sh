@@ -1,0 +1,66 @@
+#!/bin/bash
+# r4 TPU window plan. Run when the tunnel is up; phases ordered by
+# value-per-minute, individually timeboxed. Results land in $OUT.
+# After a full run: commit BENCH_tpu.json (auto-appended by bench.py),
+# BENCH_decode JSON, and paste the A/B rows into BASELINE.md.
+set -u
+OUT=${1:-/tmp/tpu_session5}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name (timeout ${to}s) ===" | tee -a "$OUT/session.log"
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "exit=$? $(tail -c 300 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
+}
+
+# 1. Ring-chunk kernel first on-chip validation (carried over from r3 s4;
+#    still never Mosaic-compiled).
+run ring_kernel 600 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ring_chunk_attention import ring_chunk_attention
+B,H,Hk,S,D = 2,8,4,512,64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B,H,S,D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B,Hk,S,D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B,Hk,S,D), jnp.bfloat16)
+for off in (S, 0, -S//2):
+    o, lse = ring_chunk_attention(q, k, v, off)
+    g = jax.grad(lambda *a: jnp.sum(ring_chunk_attention(*a, off)[0].astype(jnp.float32)), (0,1,2))(q, k, v)
+    print("off", off, "o_norm", float(jnp.linalg.norm(o.astype(jnp.float32))),
+          "dq_norm", float(jnp.linalg.norm(g[0].astype(jnp.float32))))
+print("RING_KERNEL_OK")
+EOF
+
+# 2. Decode ratchet with the NEW in-place KV cache (scan-carried stacked
+#    buffer + scalar-prefetch kernel). r3 ratchet: 418 tok/s; target 2x.
+run bench_decode 900 python bench_decode.py
+cp "$OUT/bench_decode.log" "$OUT/BENCH_decode_candidate.json" 2>/dev/null
+
+# 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
+#    vs XLA composite, few steps each, scan off for clean per-step time.
+run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
+run ffn_ab_fused 1200 env PADDLE_TPU_FUSED_FFN=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
+
+# 4. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
+run vit_matmul 1200 env BENCH_ONLY=vit python bench.py
+run vit_conv 1200 env PADDLE_TPU_PATCH_CONV=1 BENCH_ONLY=vit python bench.py
+
+# 5. Full 5-config bench — appends the window record to BENCH_tpu.json
+#    (commit it!). MoE now reports MFU + gate/dispatch decomposition.
+run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
+cp BENCH_partial.json "$OUT/" 2>/dev/null
+
+# 6. Long-context flash ratchet S=8k/16k.
+run longctx 900 python tools/longctx_bench.py
+
+# 7. Decode cost localization (only if the window is still alive).
+run decode_profile 1500 python tools/decode_profile.py
+
+# 8. 1B stage-3 single-chip attempt (expected: OOM analysis; the CPU-mesh
+#    placement proof is tools/llama_1b.py without --tpu).
+run llama_1b_tpu 1500 python tools/llama_1b.py --tpu
+
+echo "session complete" | tee -a "$OUT/session.log"
+echo "REMEMBER: git add BENCH_tpu.json + paste ratchet rows into BASELINE.md" | tee -a "$OUT/session.log"
