@@ -792,18 +792,26 @@ def bench_moe(on_tpu, peak_tflops):
     # decomposition (BASELINE configs[4]'s real metric): identity-dispatch
     # twin keeps the expert compute identical but removes gate + dispatch/
     # combine einsums (the alltoall path under EP) — the delta IS the
-    # dispatch cost. One extra compile; gated on remaining budget.
+    # dispatch cost. BOTH sides of the subtraction are timed PER-DISPATCH
+    # (the main `med` above is scan-amortized on TPU; subtracting a
+    # per-dispatch twin from it would fold the ~6.5 ms tunnel RPC into
+    # the delta and could even go negative). Two extra timings; gated on
+    # remaining budget.
     dispatch_ms = None
-    if _budget_left(_BUDGET_S[0]) > (240 if on_tpu else 60):
-        os.environ["PADDLE_TPU_MOE_IDENTITY_DISPATCH"] = "1"
+    if _budget_left(_BUDGET_S[0]) > (300 if on_tpu else 60):
         try:
+            med_plain, _ = _timed_steps(          # real step, per-dispatch
+                lambda: train_step(x, y),
+                lambda out: float(np.asarray(out._data)),
+                max(steps // 2, 2))
+            os.environ["PADDLE_TPU_MOE_IDENTITY_DISPATCH"] = "1"
             twin_step = paddle.jit.to_static(_step, donate_state=False)
             _warm(twin_step, (x, y), 2 if on_tpu else 1, False)
             med_twin, _ = _timed_steps(
                 lambda: twin_step(x, y),
                 lambda out: float(np.asarray(out._data)),
                 max(steps // 2, 2))
-            dispatch_ms = round((med - med_twin) * 1000, 3)
+            dispatch_ms = round((med_plain - med_twin) * 1000, 3)
         except Exception as e:
             print(f"bench: moe decomposition probe failed: {e}",
                   file=sys.stderr)

@@ -145,9 +145,17 @@ class Engine:
                     "shape": tuple(p.shape), "from": "annotated",
                     "to": "P()", "bytes_moved": int(p._data.nbytes)})
             if failed:
+                attempts = self._strip_attempts = getattr(
+                    self, "_strip_attempts", 0) + 1
                 self._reshard_log.append({
                     "decision": plan, "strip_failed": failed,
-                    "note": "plan not cached; retried next batch"})
+                    "attempt": attempts,
+                    "note": "plan not cached; retried next batch"
+                    if attempts < 3 else
+                    "giving up after 3 attempts; conflict unrepaired"})
+                del self._reshard_log[:-1000]
+                if attempts >= 3:   # bound the per-step rescan + log
+                    self._conflict_plan[key] = plan
         if not failed:
             self._conflict_plan[key] = plan
         return plan

@@ -95,7 +95,10 @@ class ViT(Layer):
         import os
         b = x.shape[0]
         if self.patch_matmul and \
-                os.environ.get("PADDLE_TPU_PATCH_CONV") != "1":
+                os.environ.get("PADDLE_TPU_PATCH_CONV") != "1" and \
+                x.shape[2] % self.patch_size == 0 and \
+                x.shape[3] % self.patch_size == 0:
+            # (non-multiple H/W fall through to the conv, which floors)
             # space-to-depth: [B,C,H,W] -> [B, N, C·P²] in the conv's
             # (c, ph, pw) flatten order, then one GEMM with the conv
             # weight viewed as [C·P², D]
