@@ -1,8 +1,8 @@
 """paddle.optimizer namespace."""
 from . import lr
-from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
-                        Adadelta, RMSProp, Lamb, Adamax, NAdam, RAdam,
-                        ASGD, Rprop)
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adafactor,
+                        Adagrad, Adadelta, RMSProp, Lamb, Adamax, NAdam,
+                        RAdam, ASGD, Rprop)
 # single source of truth for regularizers (paddle.regularizer); re-exported
 # here for the legacy paddle.optimizer.L1Decay/L2Decay spelling
 from ..regularizer import L1Decay, L2Decay

@@ -16,9 +16,9 @@ import jax.numpy as jnp
 from ..tensor.tensor import Parameter, Tensor, no_grad, register_persistent
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adadelta", "RMSProp", "Lamb", "Adamax", "NAdam", "RAdam",
-           "ASGD", "Rprop"]
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adafactor",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Adamax", "NAdam",
+           "RAdam", "ASGD", "Rprop"]
 
 
 class Optimizer:
@@ -427,6 +427,77 @@ class AdamW(Adam):
         if self._lr_ratio is not None:
             lr = lr * self._lr_ratio(p)
         self._adam_update(p, g, lr, wd)
+
+
+class Adafactor(Optimizer):
+    """Factored-second-moment Adam (Shazeer & Stern 2018).
+
+    The fix the 1B single-chip OOM analysis drives (LLAMA1B_cpu_mesh.json
+    / tools/llama_1b.py): AdamW's two full fp32 moments cost 10 GB at
+    1.26B params, pushing total state past the 16 GB v5e HBM; Adafactor
+    keeps row+col statistics instead (KBs per matrix), so state =
+    params (+ optional fp32 master) + ~0. Matrices (and the last two
+    axes of higher-rank params, e.g. stacked experts) are factored;
+    vectors keep a full second moment (negligible).
+
+    Follows the paper's recommended config: beta2_t = 1 - t^-decay_rate,
+    update clipped to clip_threshold by RMS, optional parameter-scaled
+    lr (scale_parameter). relative_step is intentionally NOT implemented
+    — lr comes from this framework's scheduler machinery like every
+    other optimizer here."""
+
+    def __init__(self, learning_rate=1e-3, beta1=None, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clip_threshold=1.0,
+                 scale_parameter=True, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._decay_rate = decay_rate
+        self._eps1 = epsilon1
+        self._eps2 = epsilon2
+        self._clip_threshold = clip_threshold
+        self._scale_parameter = scale_parameter
+
+    def _update_param(self, p, g, lr):
+        mw = self._master(p)
+        g32 = self._decayed(p, g._data.astype(jnp.float32), mw._data)
+        step = self._acc("step", p, init=jnp.zeros((), jnp.float32))
+        step._data = step._data + 1.0
+        t = step._data
+        beta2_t = 1.0 - t ** (-self._decay_rate)
+        g2 = g32 * g32 + self._eps1
+
+        if p.ndim >= 2:
+            # factor the last two axes; leading axes ride along (stacked
+            # experts / conv kernels)
+            vr = self._acc("vrow", p,
+                           init=jnp.zeros(p.shape[:-1], jnp.float32))
+            vc = self._acc("vcol", p, init=jnp.zeros(
+                tuple(p.shape[:-2]) + (p.shape[-1],), jnp.float32))
+            vr._data = beta2_t * vr._data + (1 - beta2_t) * jnp.mean(
+                g2, axis=-1)
+            vc._data = beta2_t * vc._data + (1 - beta2_t) * jnp.mean(
+                g2, axis=-2)
+            denom = jnp.mean(vr._data, axis=-1, keepdims=True)
+            vhat = (vr._data / jnp.maximum(denom, self._eps1))[..., None] \
+                * vc._data[..., None, :]
+        else:
+            v = self._acc("moment2", p)
+            v._data = beta2_t * v._data + (1 - beta2_t) * g2
+            vhat = v._data
+        u = g32 / jnp.sqrt(jnp.maximum(vhat, self._eps1))
+        rms_u = jnp.sqrt(jnp.mean(u * u) + self._eps1)
+        u = u / jnp.maximum(1.0, rms_u / self._clip_threshold)
+        if self._beta1 is not None:
+            m = self._acc("moment1", p)
+            m._data = self._beta1 * m._data + (1 - self._beta1) * u
+            u = m._data
+        alpha = lr
+        if self._scale_parameter:
+            rms_p = jnp.sqrt(jnp.mean(mw._data * mw._data))
+            alpha = lr * jnp.maximum(rms_p, self._eps2)
+        self._apply(p, mw._data - alpha * u)
 
 
 class Adagrad(Optimizer):

@@ -339,3 +339,56 @@ class TestFusedEagerStep:
         msgs = [w for w in rec
                 if "hyperparameter churn" in str(w.message)]
         assert len(msgs) == 1
+
+
+class TestAdafactor:
+    """Factored second moment (the fix the 1B OOM analysis drives):
+    converges, and its stats are ROW+COL sized, not full-matrix."""
+
+    def test_converges_and_factored_state(self):
+        paddle.seed(17)
+        m = paddle.nn.Linear(16, 8)
+        opt = paddle.optimizer.Adafactor(learning_rate=0.3,
+                                         parameters=m.parameters())
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        w = rng.randn(16, 8).astype(np.float32)
+        y = paddle.to_tensor((np.asarray(x._data) @ w).astype(np.float32))
+        losses = []
+        for _ in range(60):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        assert losses[-1] < 0.2 * losses[0], losses[::6]
+
+        slots = opt._accumulators
+        vr = slots["vrow"][id(m.weight)]
+        vc = slots["vcol"][id(m.weight)]
+        assert tuple(vr._data.shape) == (16,)       # rows of [16, 8]
+        assert tuple(vc._data.shape) == (8,)        # cols
+        assert "moment2" not in slots or id(m.weight) not in slots.get(
+            "moment2", {})  # matrix keeps NO full moment
+        # bias (1-D) keeps a full (tiny) second moment
+        assert id(m.bias) in slots["moment2"]
+
+    def test_beta1_and_to_static(self):
+        paddle.seed(18)
+        m = paddle.nn.Linear(8, 8)
+        opt = paddle.optimizer.Adafactor(learning_rate=0.02, beta1=0.9,
+                                         parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(4).randn(
+            4, 8).astype(np.float32))
+
+        def step(xb):
+            loss = (m(xb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        comp = paddle.jit.to_static(step)
+        l0 = float(np.asarray(comp(x)._data))
+        for _ in range(5):
+            ln = float(np.asarray(comp(x)._data))
+        assert ln < l0

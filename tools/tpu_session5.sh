@@ -58,9 +58,12 @@ run longctx 900 python tools/longctx_bench.py
 # 7. Decode cost localization (only if the window is still alive).
 run decode_profile 1500 python tools/decode_profile.py
 
-# 8. 1B stage-3 single-chip attempt (expected: OOM analysis; the CPU-mesh
-#    placement proof is tools/llama_1b.py without --tpu).
-run llama_1b_tpu 1500 python tools/llama_1b.py --tpu
+# 8. 1B single-chip: Adafactor first (analytic ~7 GB state — expected to
+#    FIT and produce the >=1B single-chip row), then the AdamW attempt
+#    (analytic 16.45 GB — expected RESOURCE_EXHAUSTED, recorded as the
+#    OOM half of VERDICT #7).
+run llama_1b_adafactor 2400 python tools/llama_1b.py --tpu --adafactor
+run llama_1b_adamw 1500 python tools/llama_1b.py --tpu
 
 echo "session complete" | tee -a "$OUT/session.log"
 echo "REMEMBER: git add BENCH_tpu.json + paste ratchet rows into BASELINE.md" | tee -a "$OUT/session.log"
