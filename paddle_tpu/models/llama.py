@@ -119,9 +119,28 @@ class LlamaAttention(Layer):
 
     def forward(self, x, kv_cache=None, time_step=None):
         b, s = x.shape[0], x.shape[1]
-        q = reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
-        k = reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        v = reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        hq = self.num_heads * self.head_dim
+        hkv = self.num_kv_heads * self.head_dim
+        if type(self.q_proj) is Linear:
+            # non-TP fast path: ONE [h, hq+2·hkv] GEMM instead of three
+            # narrow ones (shared AMP-aware helper; params stay separate
+            # for state-dict parity, grads split through the concat)
+            qkv = F.fused_concat_linear(
+                x, [self.q_proj.weight, self.k_proj.weight,
+                    self.v_proj.weight])
+            q = reshape(qkv[:, :, :hq],
+                        [b, s, self.num_heads, self.head_dim])
+            k = reshape(qkv[:, :, hq:hq + hkv],
+                        [b, s, self.num_kv_heads, self.head_dim])
+            v = reshape(qkv[:, :, hq + hkv:],
+                        [b, s, self.num_kv_heads, self.head_dim])
+        else:
+            q = reshape(self.q_proj(x),
+                        [b, s, self.num_heads, self.head_dim])
+            k = reshape(self.k_proj(x),
+                        [b, s, self.num_kv_heads, self.head_dim])
+            v = reshape(self.v_proj(x),
+                        [b, s, self.num_kv_heads, self.head_dim])
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, rotary_emb_base=self.rope_base)
         if self.num_kv_heads != self.num_heads:
@@ -175,6 +194,15 @@ class LlamaMLP(Layer):
                                     bias_attr=False)
 
     def forward(self, x):
+        if type(self.gate_proj) is Linear:
+            # non-TP fast path: gate+up as ONE [h, 2·inter] GEMM (the
+            # SwiGLU pair reads the same activations; one wide matmul
+            # feeds the MXU better than two narrow ones)
+            inter = self.gate_proj.weight.shape[1]
+            gu = F.fused_concat_linear(
+                x, [self.gate_proj.weight, self.up_proj.weight])
+            return self.down_proj(F.silu(gu[:, :, :inter])
+                                  * gu[:, :, inter:])
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

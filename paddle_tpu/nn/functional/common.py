@@ -10,7 +10,8 @@ import jax.numpy as jnp
 from ...core.rng import next_key
 from ...tensor.tensor import Tensor, apply_op
 
-__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+__all__ = ["linear", "fused_concat_linear", "dropout", "dropout2d",
+           "dropout3d", "alpha_dropout",
            "embedding", "one_hot", "label_smooth", "unfold", "fold",
            "interpolate", "upsample", "bilinear", "cosine_similarity",
            "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "zeropad2d",
@@ -34,6 +35,36 @@ def linear(x, weight, bias=None, name=None):
         out = jnp.matmul(a, w)
         return out + b.astype(out.dtype)
     return apply_op(f, x, weight, bias)
+
+
+def fused_concat_linear(x, weights, biases=None):
+    """ONE GEMM over horizontally-concatenated projection weights — the
+    compute-time fusion behind the self-attention QKV and SwiGLU gate/up
+    fast paths (MultiHeadAttention, LlamaAttention, LlamaMLP). The
+    parameters stay separate (state-dict parity with the reference
+    layers); autograd splits the grads back through the concat. AMP
+    semantics are EXACTLY F.linear's (cast_if_amp 'linear'), so the
+    fused matmul runs in the amp dtype under auto_cast instead of
+    silently upcasting to fp32."""
+    from ...amp.auto_cast import cast_if_amp
+    if biases is not None and any(b is None for b in biases):
+        biases = None
+    n = len(weights)
+
+    if biases is None:
+        def f(a, *ws):
+            w = jnp.concatenate(ws, axis=1)
+            a, w = cast_if_amp("linear", a, w)
+            return jnp.matmul(a, w)
+        return apply_op(f, x, *weights)
+
+    def f(a, *wbs):
+        w = jnp.concatenate(wbs[:n], axis=1)
+        b = jnp.concatenate(wbs[n:])
+        a, w = cast_if_amp("linear", a, w)
+        out = jnp.matmul(a, w)
+        return out + b.astype(out.dtype)
+    return apply_op(f, x, *weights, *biases)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

@@ -68,32 +68,14 @@ class MultiHeadAttention(Layer):
         if self_attn and cache is None and self.kdim == self.embed_dim \
                 and self.vdim == self.embed_dim:
             # SELF-attention fast path: one [E, 3E] GEMM instead of three
-            # [E, E] GEMMs. The three projection weights stay separate
-            # parameters (state-dict parity with the reference layer);
-            # they are concatenated at compute time — a few-MB fusible
-            # copy that buys a 3x-wider MXU matmul (BERT's encoder was
-            # paying 3 narrow GEMMs per layer where GPT pays one wide
-            # one). Autograd splits the grad back through the concat.
-            from ...tensor.tensor import apply_op
-
-            def fused(x, wq, wk, wv, bq, bk, bv):
-                import jax.numpy as jnp
-                w = jnp.concatenate([wq, wk, wv], axis=1)   # [E, 3E]
-                out = x @ w.astype(x.dtype)
-                if bq is not None:
-                    b = jnp.concatenate([bq, bk, bv])
-                    out = out + b.astype(x.dtype)
-                return out
-            biases = [self.q_proj.bias, self.k_proj.bias, self.v_proj.bias]
-            if any(b is None for b in biases):
-                qkv = apply_op(lambda x, a, b, c: fused(
-                    x, a, b, c, None, None, None), query,
-                    self.q_proj.weight, self.k_proj.weight,
-                    self.v_proj.weight)
-            else:
-                qkv = apply_op(fused, query, self.q_proj.weight,
-                               self.k_proj.weight, self.v_proj.weight,
-                               *biases)
+            # [E, E] GEMMs (BERT's encoder was paying 3 narrow GEMMs per
+            # layer where GPT pays one wide one). Shared AMP-aware helper:
+            # params stay separate (state-dict parity), grads split back
+            # through the concat.
+            qkv = F.fused_concat_linear(
+                query, [self.q_proj.weight, self.k_proj.weight,
+                        self.v_proj.weight],
+                [self.q_proj.bias, self.k_proj.bias, self.v_proj.bias])
             b_, s_ = qkv.shape[0], qkv.shape[1]
             qkv = reshape(qkv, [b_, s_, 3, self.num_heads, self.head_dim])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]

@@ -356,3 +356,56 @@ class TestBertPerfPaths:
         np.testing.assert_allclose(float(np.asarray(gathered._data)),
                                    float(np.asarray(full._data)),
                                    rtol=1e-5)
+
+
+class TestLlamaFusedProjections:
+    """r4: the fused QKV / gate-up fast paths must be numerically
+    transparent incl. GQA slicing, and must honor AMP autocast."""
+
+    def test_gqa_fused_slicing_matches_separate(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        c = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_heads=8, num_kv_heads=2, intermediate_size=96,
+                        max_position=64)
+        paddle.seed(20)
+        m = LlamaForCausalLM(c)
+        m.eval()
+        ids = paddle.to_tensor(np.random.RandomState(7).randint(
+            0, 128, (2, 12)).astype(np.int32))
+        fused = m(ids)
+
+        # oracle: same weights through the separate projections — force
+        # the slow path by disguising the Linear type check
+        import paddle_tpu.models.llama as llama_mod
+        attn = m.llama.layers[0].self_attn
+
+        class NotLinear(type(attn.q_proj)):
+            pass
+        orig_types = []
+        for blk in m.llama.layers:
+            a, mlp = blk.self_attn, blk.mlp
+            orig_types.append((a.q_proj.__class__, mlp.gate_proj.__class__))
+            a.q_proj.__class__ = NotLinear
+            mlp.gate_proj.__class__ = NotLinear
+        sep = m(ids)
+        for blk, (ta, tm) in zip(m.llama.layers, orig_types):
+            blk.self_attn.q_proj.__class__ = ta
+            blk.mlp.gate_proj.__class__ = tm
+        np.testing.assert_allclose(np.asarray(fused._data),
+                                   np.asarray(sep._data),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_fused_paths_honor_autocast(self):
+        """r4 review: the fused GEMMs must run in the amp dtype under
+        auto_cast O1, exactly like F.linear — not silently in fp32."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        import jax.numpy as jnp
+        c = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=4, intermediate_size=48, max_position=32)
+        paddle.seed(21)
+        m = LlamaForCausalLM(c)
+        ids = paddle.to_tensor(np.random.RandomState(8).randint(
+            0, 64, (1, 8)).astype(np.int32))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = m(ids)
+        assert out._data.dtype == jnp.bfloat16, out._data.dtype
