@@ -292,18 +292,34 @@ _TPU_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _load_standing_ratchet():
-    """Latest committed TPU window record from BENCH_tpu.json (append-only
-    array, newest last). On a CPU fallback this rides in the output as
-    `standing_tpu_ratchet` so the driver's JSON is never information-free
-    about TPU perf (r3 verdict ask #1b)."""
+    """Latest committed HEADLINE window record from BENCH_tpu.json
+    (append-only, newest last; decode windows also append, so filter to
+    entries carrying the 5-config array — the driver must not regress-
+    gate headline MFU against a decode tokens/s record). On a CPU
+    fallback this rides in the output as `standing_tpu_ratchet` so the
+    driver's JSON is never information-free about TPU perf."""
     try:
         with open(_TPU_LOG) as f:
             entries = json.load(f)
-        if not isinstance(entries, list) or not entries:
+        if not isinstance(entries, list):
             return None
-        return entries[-1]
+        for e in reversed(entries):
+            if isinstance(e, dict) and "configs" in e:
+                return e
+        return entries[-1] if entries else None
     except (OSError, ValueError):
         return None
+
+
+def _append_tpu_window(record):
+    """Stamp a completed on-TPU record with the window timestamp and
+    append it to BENCH_tpu.json — the one shared convention for every
+    bench that logs TPU windows (bench.py, bench_decode.py)."""
+    import datetime
+    window = dict(record)
+    window["window_utc"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    _append_tpu_record(window)
 
 
 def _append_tpu_record(record):
@@ -954,11 +970,7 @@ def main():
         if standing is not None:
             record["standing_tpu_ratchet"] = standing
     elif on_tpu:
-        import datetime
-        window = dict(record)
-        window["window_utc"] = datetime.datetime.now(
-            datetime.timezone.utc).isoformat(timespec="seconds")
-        _append_tpu_record(window)
+        _append_tpu_window(record)
     print(json.dumps(record))
 
 

@@ -69,6 +69,10 @@ def main():
     }
     if tpu_unavailable:
         record["tpu_unavailable"] = True
+    else:
+        # decode windows join the machine-readable ratchet log too
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
     print(json.dumps(record))
 
 

@@ -57,15 +57,25 @@ def _sample_next(logits, do_sample, top_k, top_p, temperature, key=None):
 @no_grad()
 def generate(model, input_ids, max_new_tokens: int = 20,
              eos_token_id: Optional[int] = None, do_sample: bool = False,
-             top_k: int = 0, top_p: float = 1.0, temperature: float = 1.0):
+             top_k: int = 0, top_p: float = 1.0, temperature: float = 1.0,
+             num_beams: int = 1, length_penalty: float = 1.0):
     """Causal-LM generation; input_ids [B, S] Tensor/ndarray -> [B, S+T].
 
     Greedy by default; sampling with top-k/top-p/temperature when
-    do_sample=True. Stops early only when every sequence emitted eos.
+    do_sample=True; beam search when num_beams > 1 (reference:
+    generation's beam_search decode strategy / fluid beam_search op —
+    length-penalized GNMT scoring, finished beams frozen on eos). Stops
+    early only when every sequence (or every beam) emitted eos.
     """
     model.eval()
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError("beam search (num_beams>1) is deterministic; "
+                             "do_sample=True is not supported with it")
+        return _beam_search(model, ids, max_new_tokens, eos_token_id,
+                            num_beams, length_penalty)
     finished = jnp.zeros((ids.shape[0],), bool)
     for _ in range(max_new_tokens):
         logits = model(Tensor(ids))
@@ -79,6 +89,91 @@ def generate(model, input_ids, max_new_tokens: int = 20,
         if eos_token_id is not None and bool(jnp.all(finished)):
             break
     return Tensor(ids)
+
+
+def _beam_search(model, ids, max_new_tokens, eos_token_id, num_beams,
+                 length_penalty):
+    """Model-agnostic beam search: re-runs the forward on the growing
+    prefix (correct for any causal LM; XLA caches one executable per
+    prefix length, shared across steps since all beams batch together).
+    Finished beams are frozen: they may only continue with eos at zero
+    added score. Final selection is GNMT length-penalized."""
+    b, s0 = ids.shape
+    k = int(num_beams)
+    eos = None if eos_token_id is None else int(eos_token_id)
+    beams = jnp.repeat(ids[:, None], k, axis=1)          # [B, K, S]
+    # only beam 0 is live at step one, else K identical top picks
+    scores = jnp.full((b, k), -1e9, jnp.float32).at[:, 0].set(0.0)
+    finished = jnp.zeros((b, k), bool)
+    gen_len = jnp.zeros((b, k), jnp.int32)               # generated length
+    # separate FINISHED pool (standard beam search): a completed
+    # hypothesis must survive even if live continuations transiently
+    # out-score it and evict it from the top-k — track the best
+    # length-penalized finished sequence per batch row, eos-padded to the
+    # current length each step
+    best_fin_score = jnp.full((b,), -jnp.inf, jnp.float32)
+    best_fin_seq = beams[:, 0]                           # [B, S] placeholder
+
+    for _ in range(max_new_tokens):
+        flat = beams.reshape(b * k, beams.shape[-1])
+        logits = model(Tensor(flat))
+        logits = (logits._data if isinstance(logits, Tensor)
+                  else logits)[:, -1]
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(b, k, v)
+        if eos is not None:
+            only_eos = jnp.where(jnp.arange(v)[None, None, :] == eos,
+                                 0.0, -jnp.inf)
+            logp = jnp.where(finished[..., None], only_eos, logp)
+        cand = scores[..., None] + logp                  # [B, K, V]
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * v), k)
+        beam_idx = top_idx // v                          # [B, K]
+        tok = (top_idx % v).astype(beams.dtype)
+        beams = jnp.take_along_axis(beams, beam_idx[..., None], axis=1)
+        beams = jnp.concatenate([beams, tok[..., None]], axis=-1)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        gen_len = jnp.take_along_axis(gen_len, beam_idx, axis=1)
+        gen_len = jnp.where(finished, gen_len, gen_len + 1)
+        scores = top_scores
+        if eos is not None:
+            newly = ~finished & (tok == eos)
+            finished = finished | newly
+            # admit newly finished hypotheses into the finished pool
+            pen = jnp.maximum(gen_len, 1).astype(jnp.float32) \
+                ** length_penalty
+            cand_fin = jnp.where(newly, scores / pen, -jnp.inf)
+            row_best = jnp.argmax(cand_fin, axis=1)              # [B]
+            row_score = jnp.take_along_axis(
+                cand_fin, row_best[:, None], axis=1)[:, 0]
+            better = row_score > best_fin_score
+            best_fin_seq = jnp.concatenate(                       # pad
+                [best_fin_seq,
+                 jnp.full((b, 1), eos, beams.dtype)], axis=-1)
+            chosen = jnp.take_along_axis(
+                beams, row_best[:, None, None], axis=1)[:, 0]
+            best_fin_seq = jnp.where(better[:, None], chosen,
+                                     best_fin_seq)
+            best_fin_score = jnp.maximum(best_fin_score, row_score)
+            if bool(jnp.all(finished)):
+                break
+
+    lp = jnp.maximum(gen_len, 1).astype(jnp.float32) ** length_penalty
+    norm = scores / lp
+    best = jnp.argmax(norm, axis=1)                      # [B]
+    live_score = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+    out = jnp.take_along_axis(
+        beams, best[:, None, None], axis=1)[:, 0]
+    if eos is not None:
+        # pad the finished pool to the final length and take the winner
+        pad = out.shape[-1] - best_fin_seq.shape[-1]
+        if pad > 0:
+            best_fin_seq = jnp.concatenate(
+                [best_fin_seq, jnp.full((b, pad), eos, beams.dtype)],
+                axis=-1)
+        use_fin = best_fin_score > live_score
+        out = jnp.where(use_fin[:, None], best_fin_seq, out)
+    return Tensor(out)
 
 
 class FusedDecoder:

@@ -95,6 +95,52 @@ class TestGeneration:
                        eos_token_id=eos)
         assert out.shape[1] <= 4
 
+    def test_beam_search_beats_or_ties_greedy_logprob(self):
+        """num_beams>1: the returned sequence's total log-prob must be >=
+        greedy's (beam search explores a superset); num_beams=1-equivalent
+        check: beams are deterministic and keep the prefix."""
+        import jax
+        import jax.numpy as jnp
+        model = small_lm()
+        x = np.random.RandomState(9).randint(0, 97, (2, 3)).astype(np.int32)
+        g = generate(model, paddle.to_tensor(x), max_new_tokens=5)
+        bm = generate(model, paddle.to_tensor(x), max_new_tokens=5,
+                      num_beams=4)
+        assert bm.shape == [2, 8]
+        np.testing.assert_array_equal(np.asarray(bm._data)[:, :3], x)
+
+        def seq_logprob(seq):
+            arr = jnp.asarray(seq)
+            logits = model(paddle.to_tensor(arr[:, :-1]))._data
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tgt = arr[:, 1:]
+            # score only the generated region (last 5 tokens)
+            pick = jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+            return np.asarray(pick[:, -5:].sum(-1))
+
+        lp_g = seq_logprob(np.asarray(g._data))
+        lp_b = seq_logprob(np.asarray(bm._data))
+        assert (lp_b >= lp_g - 1e-4).all(), (lp_b, lp_g)
+
+        bm2 = generate(model, paddle.to_tensor(x), max_new_tokens=5,
+                       num_beams=4)
+        np.testing.assert_array_equal(np.asarray(bm._data),
+                                      np.asarray(bm2._data))
+
+    def test_beam_search_eos_freezes_finished(self):
+        model = small_lm()
+        x = np.zeros((1, 2), np.int32)
+        first = generate(model, paddle.to_tensor(x), max_new_tokens=1)
+        eos = int(np.asarray(first._data)[0, -1])
+        out = generate(model, paddle.to_tensor(x), max_new_tokens=6,
+                       eos_token_id=eos, num_beams=3)
+        arr = np.asarray(out._data)[0]
+        # after the first eos, a frozen beam only ever continues with eos
+        gen = arr[2:]
+        if eos in gen.tolist():
+            i = gen.tolist().index(eos)
+            assert all(t == eos for t in gen.tolist()[i:])
+
 
 class TestInt8Precision:
     def test_int8_weight_only_predictor(self):
