@@ -306,7 +306,7 @@ def _load_standing_ratchet():
         for e in reversed(entries):
             if isinstance(e, dict) and "configs" in e:
                 return e
-        return entries[-1] if entries else None
+        return None     # decode-only log: NO headline ratchet to report
     except (OSError, ValueError):
         return None
 

@@ -55,15 +55,34 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       pre_layer_norm=False, training=True, mode="upscale_in_train",
                       name=None):
     """Parity: fused_feedforward_op.cu — LN→linear→act→dropout→linear→dropout
-    →residual(+LN)."""
+    →residual(+LN). When PADDLE_TPU_FUSED_FFN=1, the activation is exact
+    gelu, the dropouts are inert, and both biases exist, the middle
+    linear→gelu→linear runs as the ONE-kernel Pallas fused FFN
+    (ops/pallas/fused_ffn.py) — the [*, F] intermediate never touches
+    HBM."""
+    import os
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1:], ln1_scale, ln1_bias, ln1_epsilon)
-    out = F.linear(x, linear1_weight, linear1_bias)
-    out = getattr(F, activation)(out)
-    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
-    out = F.linear(out, linear2_weight, linear2_bias)
-    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    # inert = the composite's dropouts are identity: zero rates always,
+    # or eval mode under upscale_in_train (downscale_in_infer SCALES at
+    # inference — not inert)
+    drop_inert = (dropout1_rate == 0.0 and dropout2_rate == 0.0) or (
+        not training and mode == "upscale_in_train")
+    if (os.environ.get("PADDLE_TPU_FUSED_FFN") == "1"
+            and activation == "gelu" and drop_inert
+            and linear1_bias is not None and linear2_bias is not None):
+        from ...ops.pallas.fused_ffn import fused_ffn
+        from ...tensor.tensor import apply_op as _apply
+        out = _apply(lambda a, w1, b1, w2, b2: fused_ffn(
+            a, w1, b1, w2, b2, "gelu"), x, linear1_weight, linear1_bias,
+            linear2_weight, linear2_bias)
+    else:
+        out = F.linear(x, linear1_weight, linear1_bias)
+        out = getattr(F, activation)(out)
+        out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+        out = F.linear(out, linear2_weight, linear2_bias)
+        out = F.dropout(out, dropout2_rate, training=training, mode=mode)
     out = residual + out
     if not pre_layer_norm:
         out = F.layer_norm(out, out.shape[-1:], ln2_scale, ln2_bias,

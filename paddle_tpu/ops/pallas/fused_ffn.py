@@ -38,6 +38,14 @@ def _gelu_tanh(x):
     return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
 
 
+def _gelu_erf(x):
+    # exact gelu (the reference fused_feedforward_op's "gelu")
+    return 0.5 * x * (1.0 + jax.lax.erf(x * (2.0 ** -0.5)))
+
+
+_ACTS = {"gelu_tanh": _gelu_tanh, "gelu": _gelu_erf}
+
+
 def ffn_is_supported(m, k, f, dtype) -> bool:
     """x: [M, K], W1: [K, F], W2: [F, K]. Lane-dim tiling: K and F must
     be 128-multiples (the bench shapes are: 768/3072, 1024/2816...)."""
@@ -49,7 +57,7 @@ def ffn_is_supported(m, k, f, dtype) -> bool:
 
 
 def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_sc,
-            *, bm, bf, nf):
+            *, bm, bf, nf, act="gelu_tanh"):
     fi = pl.program_id(1)
 
     @pl.when(fi == 0)
@@ -61,7 +69,7 @@ def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_sc,
     pre = jax.lax.dot_general(x, w1, (((1,), (0,)), ((), ())),
                               preferred_element_type=jnp.float32)
     pre = pre + b1_ref[...].astype(jnp.float32)      # [bm, bf]
-    t = _gelu_tanh(pre).astype(x.dtype)
+    t = _ACTS[act](pre).astype(x.dtype)
     w2 = w2_ref[...]                                 # [bf, K]
     acc_sc[:] += jax.lax.dot_general(t, w2, (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -72,13 +80,13 @@ def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_sc,
                       b2_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _fwd_kernel_call(x, w1, b1, w2, b2, bm, bf):
+def _fwd_kernel_call(x, w1, b1, w2, b2, bm, bf, act):
     m, k = x.shape
     f = w1.shape[1]
     nf = f // bf
     grid = (m // bm, nf)
     return pl.pallas_call(
-        functools.partial(_kernel, bm=bm, bf=bf, nf=nf),
+        functools.partial(_kernel, bm=bm, bf=bf, nf=nf, act=act),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, k), lambda mi, fi: (mi, 0)),
@@ -110,21 +118,23 @@ def _pick_bm(m, k, f, bf, dtype):
     return None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def fused_ffn(x, w1, b1, w2, b2):
-    """out = gelu_tanh(x @ w1 + b1) @ w2 + b2, x: [..., K] flattened to
-    [M, K] internally. Falls back to the XLA composite when shapes don't
-    tile (callers may also gate on ffn_is_supported)."""
-    out, _ = _fused_ffn_fwd(x, w1, b1, w2, b2)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_ffn(x, w1, b1, w2, b2, activation="gelu_tanh"):
+    """out = act(x @ w1 + b1) @ w2 + b2 with act in {gelu_tanh, gelu
+    (exact/erf)}; x: [..., K] flattened to [M, K] internally. Falls back
+    to the XLA composite when shapes don't tile (callers may also gate
+    on ffn_is_supported)."""
+    out, _ = _fused_ffn_fwd(x, w1, b1, w2, b2, activation)
     return out
 
 
-def _composite(x2, w1, b1, w2, b2):
-    t = _gelu_tanh((x2 @ w1 + b1).astype(jnp.float32)).astype(x2.dtype)
+def _composite(x2, w1, b1, w2, b2, activation="gelu_tanh"):
+    t = _ACTS[activation]((x2 @ w1 + b1).astype(jnp.float32)) \
+        .astype(x2.dtype)
     return t @ w2 + b2
 
 
-def _fused_ffn_fwd(x, w1, b1, w2, b2):
+def _fused_ffn_fwd(x, w1, b1, w2, b2, activation="gelu_tanh"):
     lead = x.shape[:-1]
     k = x.shape[-1]
     f = w1.shape[1]
@@ -135,13 +145,13 @@ def _fused_ffn_fwd(x, w1, b1, w2, b2):
     bf = next((c for c in (512, 256, 128) if f % c == 0), None)
     bm = _pick_bm(m, k, f, bf or 128, x.dtype)
     if not ffn_is_supported(m, k, f, x.dtype) or bm is None or bf is None:
-        out = _composite(x2, w1, b1, w2, b2)
+        out = _composite(x2, w1, b1, w2, b2, activation)
     else:
-        out = _fwd_kernel_call(x2, w1, b1, w2, b2, bm, bf)
+        out = _fwd_kernel_call(x2, w1, b1, w2, b2, bm, bf, activation)
     return out.reshape(*lead, k), (x, w1, b1, w2, b2)
 
 
-def _fused_ffn_bwd(res, g):
+def _fused_ffn_bwd(activation, res, g):
     x, w1, b1, w2, b2 = res
     k = x.shape[-1]
     f = w1.shape[1]
@@ -152,13 +162,17 @@ def _fused_ffn_bwd(res, g):
     pre = (jax.lax.dot_general(x2, w1, (((1,), (0,)), ((), ())),
                                preferred_element_type=jnp.float32)
            + b1.astype(jnp.float32))
-    t = _gelu_tanh(pre)
-    # d gelu_tanh / d pre
-    c = math.sqrt(2.0 / math.pi)
-    u = c * (pre + 0.044715 * pre ** 3)
-    th = jnp.tanh(u)
-    dgelu = 0.5 * (1.0 + th) + 0.5 * pre * (1.0 - th * th) * c * (
-        1.0 + 3 * 0.044715 * pre ** 2)
+    t = _ACTS[activation](pre)
+    if activation == "gelu_tanh":
+        c = math.sqrt(2.0 / math.pi)
+        u = c * (pre + 0.044715 * pre ** 3)
+        th = jnp.tanh(u)
+        dgelu = 0.5 * (1.0 + th) + 0.5 * pre * (1.0 - th * th) * c * (
+            1.0 + 3 * 0.044715 * pre ** 2)
+    else:   # exact gelu: d/dx = Phi(x) + x*phi(x)
+        dgelu = (0.5 * (1.0 + jax.lax.erf(pre * (2.0 ** -0.5)))
+                 + pre * jnp.exp(-0.5 * pre * pre)
+                 * (1.0 / math.sqrt(2.0 * math.pi)))
     dt = jax.lax.dot_general(g2, w2, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
     dpre = dt * dgelu

@@ -58,11 +58,18 @@ def test_tpu_record_append_and_standing_ratchet(monkeypatch, tmp_path):
     monkeypatch.setitem(bench.__dict__, "_TPU_LOG", str(log))
     assert bench._load_standing_ratchet() is None   # missing file -> None
 
-    bench._append_tpu_record({"value": 100.0, "window_utc": "w1"})
-    bench._append_tpu_record({"value": 200.0, "window_utc": "w2"})
+    bench._append_tpu_record({"value": 100.0, "configs": [],
+                              "window_utc": "w1"})
+    bench._append_tpu_record({"value": 200.0, "configs": [],
+                              "window_utc": "w2"})
     import json
     entries = json.loads(log.read_text())
     assert [e["value"] for e in entries] == [100.0, 200.0]
+    assert bench._load_standing_ratchet()["window_utc"] == "w2"
+    # decode windows (no 5-config array) never become the standing
+    # HEADLINE ratchet — even when they are the newest (or only) entries
+    bench._append_tpu_record({"value": 999.0, "window_utc": "w3",
+                              "metric": "fused_decode_tokens_per_sec"})
     assert bench._load_standing_ratchet()["window_utc"] == "w2"
 
     # corrupt file: loader degrades to None, appender must not raise

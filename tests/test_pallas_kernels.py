@@ -508,3 +508,42 @@ class TestFusedFFN:
         loss = (out ** 2).mean()
         loss.backward()
         assert mlp.fc1.weight.grad is not None
+
+    def test_exact_gelu_activation_matches(self):
+        """activation='gelu' (exact/erf — the reference
+        fused_feedforward_op's act) must match the composite, fwd+bwd."""
+        from paddle_tpu.ops.pallas.fused_ffn import _composite, fused_ffn
+        args = self._args()
+        np.testing.assert_allclose(
+            np.asarray(fused_ffn(*args, "gelu")),
+            np.asarray(_composite(*args, "gelu")), atol=1e-5, rtol=1e-5)
+        lf = lambda fn: (lambda *a: jnp.sum(fn(*a, "gelu") ** 2))
+        g1 = jax.grad(lf(fused_ffn), argnums=(0, 1, 2, 3, 4))(*args)
+        g2 = jax.grad(lf(_composite), argnums=(0, 1, 2, 3, 4))(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3, rtol=1e-3)
+
+    def test_incubate_fused_feedforward_routes_to_kernel(self, monkeypatch):
+        """incubate.nn.functional.fused_feedforward under the opt-in env
+        must equal its composite path exactly (inert dropout, exact
+        gelu)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+        rng = np.random.RandomState(5)
+        x = paddle.to_tensor(rng.randn(2, 16, 128).astype(np.float32))
+        w1 = paddle.to_tensor((rng.randn(128, 256) * 0.05).astype(np.float32))
+        b1 = paddle.to_tensor((rng.randn(256) * 0.1).astype(np.float32))
+        w2 = paddle.to_tensor((rng.randn(256, 128) * 0.05).astype(np.float32))
+        b2 = paddle.to_tensor((rng.randn(128) * 0.1).astype(np.float32))
+        kw = dict(dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+                  pre_layer_norm=True,
+                  ln1_scale=paddle.to_tensor(np.ones(128, np.float32)),
+                  ln1_bias=paddle.to_tensor(np.zeros(128, np.float32)))
+        monkeypatch.delenv("PADDLE_TPU_FUSED_FFN", raising=False)
+        ref = fused_feedforward(x, w1, w2, b1, b2, **kw)
+        monkeypatch.setenv("PADDLE_TPU_FUSED_FFN", "1")
+        out = fused_feedforward(x, w1, w2, b1, b2, **kw)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data),
+                                   atol=1e-5, rtol=1e-5)
