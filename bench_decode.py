@@ -66,6 +66,10 @@ def main():
         "unit": "tokens/s",
         "batch": batch, "new_tokens": new_tokens, "max_seq": smax,
         "layers": L, "hidden": E, "device": str(dev),
+        # provenance for the append-only ratchet log: int8-cache windows
+        # must never be silently compared against fp-cache windows
+        "cache_mode": ("int8" if os.environ.get(
+            "PADDLE_TPU_DECODE_INT8_CACHE") == "1" else "fp"),
     }
     if tpu_unavailable:
         record["tpu_unavailable"] = True

@@ -73,8 +73,7 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
             and activation == "gelu" and drop_inert
             and linear1_bias is not None and linear2_bias is not None):
         from ...ops.pallas.fused_ffn import fused_ffn
-        from ...tensor.tensor import apply_op as _apply
-        out = _apply(lambda a, w1, b1, w2, b2: fused_ffn(
+        out = apply_op(lambda a, w1, b1, w2, b2: fused_ffn(
             a, w1, b1, w2, b2, "gelu"), x, linear1_weight, linear1_bias,
             linear2_weight, linear2_bias)
     else:

@@ -250,11 +250,35 @@ class FusedDecoder:
         self._stk_cache = (version, out)
         return out
 
+    @staticmethod
+    def _int8_cache() -> bool:
+        """Opt-in int8 KV cache (reference: fused_multi_transformer's
+        cache_kv int8 serving mode). Decode is bandwidth-bound — int8
+        halves the cache bytes streamed per token; rows are absmax-
+        quantized per (layer, kv, batch, head, position) with fp32
+        scales, dequantized in VMEM by the stacked kernel."""
+        return os.environ.get("PADDLE_TPU_DECODE_INT8_CACHE") == "1"
+
     def init_cache(self, batch, dtype=None):
         f = self.fmt
         dtype = dtype or self.fmt.qkv_weights[0]._data.dtype
-        return jnp.zeros((f.num_layers, 2, batch, f.num_heads, self.smax,
-                          f.head_dim), dtype)
+        shape = (f.num_layers, 2, batch, f.num_heads, self.smax,
+                 f.head_dim)
+        if self._int8_cache():
+            if self._mesh_mp() is not None:
+                # the int8 win is the stacked KERNEL streaming half the
+                # bytes; the mp path runs the dense fallback, where int8
+                # would add quantization noise with zero bandwidth gain
+                import warnings
+                warnings.warn(
+                    "PADDLE_TPU_DECODE_INT8_CACHE ignored under an mp "
+                    "mesh: the sharded decode path is dense (kernel-only "
+                    "feature) — using the fp cache", UserWarning,
+                    stacklevel=2)
+            else:
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(shape[:-1] + (1,), jnp.float32))
+        return jnp.zeros(shape, dtype)
 
     # ------------------------------------------------------------ the step
     def _mesh_mp(self):
@@ -360,21 +384,37 @@ class FusedDecoder:
         def attend(q, caches, l, t):
             # q: [B, 1, H, D]; caches: [L, 2, B, H, Smax, D] (full stack —
             # the kernel addresses layer l via scalar prefetch, zero-copy)
+            # or (int8 stack, fp32 scales) in cache-quant mode
             qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
+            quant = isinstance(caches, tuple)
             if mesh is None:
                 from ..ops.pallas.decode_attention import (
-                    decode_attention_stacked, stacked_is_supported)
-                if stacked_is_supported((q.shape[0], 1, nh, hd),
-                                        caches.shape, q.dtype,
-                                        cache_dtype=caches.dtype):
+                    decode_attention_stacked, decode_attention_stacked_i8,
+                    stacked_i8_is_supported, stacked_is_supported)
+                if quant and stacked_i8_is_supported(
+                        (q.shape[0], 1, nh, hd), caches[0].shape, q.dtype):
+                    lens = jnp.full((q.shape[0],), t, jnp.int32)
+                    o = decode_attention_stacked_i8(qt, caches[0],
+                                                    caches[1], l, lens)
+                    return jnp.swapaxes(o, 1, 2)
+                if not quant and stacked_is_supported(
+                        (q.shape[0], 1, nh, hd), caches.shape, q.dtype,
+                        cache_dtype=caches.dtype):
                     lens = jnp.full((q.shape[0],), t, jnp.int32)
                     o = decode_attention_stacked(qt, caches, l, lens)
                     return jnp.swapaxes(o, 1, 2)
             # dense masked fallback — under a mesh the head dim ('mp')
             # shards this einsum Megatron-style; the layer slice fuses
             # into the einsum operand read (no materialized copy)
-            cache = jax.lax.dynamic_index_in_dim(caches, l, 0,
-                                                 keepdims=False)
+            if quant:
+                ci = jax.lax.dynamic_index_in_dim(caches[0], l, 0,
+                                                  keepdims=False)
+                sc = jax.lax.dynamic_index_in_dim(caches[1], l, 0,
+                                                  keepdims=False)
+                cache = ci.astype(jnp.float32) * sc
+            else:
+                cache = jax.lax.dynamic_index_in_dim(caches, l, 0,
+                                                     keepdims=False)
             s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
                            cache[0].astype(jnp.float32)) * (hd ** -0.5)
             mask = jnp.arange(smax)[None, None, None, :] <= t
@@ -404,9 +444,23 @@ class FusedDecoder:
             # entire [L, 2, B, H, Smax, D] buffer every token)
             kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
                                 jnp.swapaxes(v, 1, 2)])  # [2, B, H, 1, D]
-            caches = jax.lax.dynamic_update_slice(
-                caches, kv_new[None].astype(caches.dtype),
-                (l, 0, 0, 0, t, 0))
+            if isinstance(caches, tuple):
+                # cache-quant write: per-row absmax int8 + fp32 scale
+                kv32 = kv_new.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
+                sc_new = amax / 127.0
+                q_new = jnp.clip(
+                    jnp.round(kv32 / jnp.maximum(sc_new, 1e-8)),
+                    -127, 127).astype(jnp.int8)
+                ci8 = jax.lax.dynamic_update_slice(
+                    caches[0], q_new[None], (l, 0, 0, 0, t, 0))
+                scs = jax.lax.dynamic_update_slice(
+                    caches[1], sc_new[None], (l, 0, 0, 0, t, 0))
+                caches = (ci8, scs)
+            else:
+                caches = jax.lax.dynamic_update_slice(
+                    caches, kv_new[None].astype(caches.dtype),
+                    (l, 0, 0, 0, t, 0))
             attn = attend(q, caches, l, t)
             attn = attn.reshape(b, 1, nh * hd)
             attn = attn @ p["lin_w"].astype(attn.dtype) + \
@@ -444,16 +498,21 @@ class FusedDecoder:
             x = call_layerlike(embed, e_params, e_arrays, tok[:, None])
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                caches = jax.lax.with_sharding_constraint(
-                    caches, NamedSharding(
-                        mesh, P(None, None, None, "mp", None, None)))
+                sh = NamedSharding(mesh,
+                                   P(None, None, None, "mp", None, None))
+                if isinstance(caches, tuple):
+                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
+                                   for c in caches)
+                else:
+                    caches = jax.lax.with_sharding_constraint(caches, sh)
 
             def body(carry, xs):
                 x, caches = carry
                 p, l = xs
                 x, caches = layer_step(x, p, caches, l, t)
                 return (x, caches), None
-            nl = caches.shape[0]
+            nl = (caches[0] if isinstance(caches, tuple)
+                  else caches).shape[0]
             (x, caches), _ = jax.lax.scan(
                 body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
             return x, caches

@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["decode_attention", "decode_attention_stacked", "is_supported",
-           "stacked_is_supported"]
+__all__ = ["decode_attention", "decode_attention_stacked",
+           "decode_attention_stacked_i8", "is_supported",
+           "stacked_is_supported", "stacked_i8_is_supported"]
 
 NEG_INF = -1e30
 
@@ -179,6 +180,39 @@ def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
 # in-place cache write).
 # ---------------------------------------------------------------------------
 
+def _stacked_setup(qt, hk, smax, group):
+    """Shared host-side setup for the stacked-cache kernels: block sizes,
+    q padding, grid, and the layer/kv-addressed index maps. ONE owner for
+    the tiling rules so the fp and int8 wrappers cannot diverge."""
+    b, h, sq, d = qt.shape
+    bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
+    if smax % 256 == 0:
+        bk = 256
+    elif smax % 128 == 0:
+        bk = 128
+    else:
+        raise ValueError(
+            f"stacked decode kernels: Smax {smax} must be a multiple of "
+            "128 (pad the ring buffer at init, not per call)")
+    if bq != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
+    grid = (b, h, smax // bk)
+    kidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, j, 0)
+    vidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        lay_r[0], 1, b_, h_ // g, j, 0)
+    qidx = lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)  # noqa: E731
+    return qt, bq, bk, grid, kidx, vidx, qidx
+
+
+def stacked_i8_is_supported(q_shape, caches_shape, dtype) -> bool:
+    """Support predicate for decode_attention_stacked_i8: same layout and
+    tiling rules as the fp stacked kernel, cache dtype is int8 by
+    construction (scales ride separately), compute dtype is the query's."""
+    return stacked_is_supported(q_shape, caches_shape, dtype,
+                                cache_dtype=None)
+
+
 def stacked_is_supported(q_shape, caches_shape, dtype,
                          cache_dtype=None) -> bool:
     """caches: [L, 2, B, Hk, Smax, D]; q: [B, Sq, H, D] (layout as
@@ -255,27 +289,10 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
             "cache_dtype=...) and use the unstacked/dense path instead")
     out_dtype = qt.dtype
 
-    bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
-    if smax % 256 == 0:
-        bk = 256
-    elif smax % 128 == 0:
-        bk = 128
-    else:
-        # padding the stacked buffer would copy every layer; callers gate
-        # on stacked_is_supported or size the ring to a 128-multiple
-        raise ValueError(
-            f"decode_attention_stacked: Smax {smax} must be a multiple "
-            "of 128 (pad the ring buffer at init, not per call)")
-    if bq != sq:
-        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
+    qt, bq, bk, grid, kidx, vidx, qidx = _stacked_setup(qt, hk, smax,
+                                                        group)
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
-
-    grid = (b, h, smax // bk)
-    kidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, j, 0)
-    vidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
-        lay_r[0], 1, b_, h_ // g, j, 0)
     out = pl.pallas_call(
         functools.partial(_stacked_kernel, scale=float(scale), sq=sq,
                           bq=bq, bk=bk),
@@ -283,13 +300,11 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, bq, d),
-                             lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, bq, d), qidx),
                 pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
                 pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
             ],
-            out_specs=pl.BlockSpec(
-                (1, 1, bq, d), lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)),
+            out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
             scratch_shapes=[
                 pltpu.VMEM((bq, d), jnp.float32),
                 pltpu.VMEM((bq, 1), jnp.float32),
@@ -300,3 +315,92 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
         interpret=_interpret(),
     )(lay, lens, qt, caches, caches)
     return out[:, :, :sq].astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# int8-quantized stacked cache: the serving-side cache-quant mode of
+# fused_multi_transformer_op.cu (cache_kv int8). Decode is HBM-bandwidth
+# bound — an int8 cache halves the bytes the kernel streams per token.
+# K/V rows are quantized per (layer, kv, batch, head, position) with an
+# fp32 absmax scale; the kernel dequantizes blocks in VMEM right before
+# the dots (which still run in the query dtype on the MXU).
+# ---------------------------------------------------------------------------
+
+def _stacked_i8_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, acc_sc, m_sc, l_sc,
+                       *, scale, sq, bq, bk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = k_start < n_valid + sq
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]                                     # [bq, d]
+        # dequant in VMEM: int8 block * per-row scale -> query dtype
+        k = (k_ref[0, 0, 0, 0].astype(jnp.float32)
+             * ks_ref[0, 0, 0, 0]).astype(q.dtype)          # [bk, d]
+        v = (v_ref[0, 0, 0, 0].astype(jnp.float32)
+             * vs_ref[0, 0, 0, 0]).astype(q.dtype)
+        _online_softmax_block(q, k, v, n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq, bq=bq, bk=bk)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
+                                cache_lens, scale=None):
+    """qt: [B, H, Sq, D] (query dtype = compute dtype); caches_i8:
+    [L, 2, B, Hk, Smax, D] int8; cache_scales: [L, 2, B, Hk, Smax, 1]
+    fp32 per-row absmax scales; layer: scalar int32 (scalar-prefetch).
+    Returns [B, H, Sq, D] in the query dtype."""
+    b, h, sq, d = qt.shape
+    hk, smax = caches_i8.shape[3], caches_i8.shape[4]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    if caches_i8.dtype != jnp.int8:
+        raise ValueError("decode_attention_stacked_i8: cache must be int8")
+
+    out_dtype = qt.dtype
+    qt, bq, bk, grid, kidx, vidx, qidx = _stacked_setup(qt, hk, smax,
+                                                        group)
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(_stacked_i8_kernel, scale=float(scale), sq=sq,
+                          bq=bq, bk=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), qidx),
+                pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
+                pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
+                pl.BlockSpec((1, 1, 1, 1, bk, 1), kidx),
+                pl.BlockSpec((1, 1, 1, 1, bk, 1), vidx),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), out_dtype),
+        interpret=_interpret(),
+    )(lay, lens, qt, caches_i8, caches_i8, cache_scales, cache_scales)
+    return out[:, :, :sq]

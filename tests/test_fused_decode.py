@@ -176,3 +176,23 @@ class TestFusedDecodeHygiene:
         loss.backward()                      # must still produce grads
         assert lin.weight.grad is not None
         assert float(np.abs(np.asarray(lin.weight.grad._data)).sum()) > 0
+
+
+class TestInt8Cache:
+    def test_int8_cache_decode_matches_fp(self, monkeypatch):
+        """PADDLE_TPU_DECODE_INT8_CACHE=1 (the reference's cache_kv int8
+        serving mode): generated tokens must match the fp cache run on a
+        well-separated-logits model — quantization noise (cos>0.999 at
+        the kernel level) must not flip greedy argmax here."""
+        paddle.seed(12)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=12)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_CACHE", raising=False)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))

@@ -373,6 +373,25 @@ class TestAdafactor:
         # bias (1-D) keeps a full (tiny) second moment
         assert id(m.bias) in slots["moment2"]
 
+    def test_state_dict_roundtrip(self):
+        paddle.seed(19)
+        m = paddle.nn.Linear(8, 4)
+        opt = paddle.optimizer.Adafactor(learning_rate=0.1,
+                                         parameters=m.parameters())
+        loss = (m(paddle.to_tensor(np.ones((2, 8), np.float32))) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sd = opt.state_dict()
+        assert any("vrow" in k for k in sd) and any("vcol" in k for k in sd)
+        opt2 = paddle.optimizer.Adafactor(learning_rate=0.1,
+                                          parameters=m.parameters())
+        opt2.set_state_dict(sd)
+        vr = opt._accumulators["vrow"][id(m.weight)]
+        vr2 = opt2._accumulators["vrow"][id(m.weight)]
+        np.testing.assert_allclose(np.asarray(vr2._data),
+                                   np.asarray(vr._data))
+
     def test_beta1_and_to_static(self):
         paddle.seed(18)
         m = paddle.nn.Linear(8, 8)

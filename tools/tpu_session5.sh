@@ -38,6 +38,10 @@ EOF
 run bench_decode 900 python bench_decode.py
 cp "$OUT/bench_decode.log" "$OUT/BENCH_decode_candidate.json" 2>/dev/null
 
+# 2b. int8-cache decode A/B (halves cache bytes/token — the bandwidth
+#     floor itself). Token parity with fp is CPU-asserted already.
+run bench_decode_i8 900 env PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
+
 # 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
 #    vs XLA composite, few steps each, scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
