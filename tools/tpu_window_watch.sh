@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon tunnel hang-safely every ~4 min; the moment it answers,
+# run the queued r4 measurement session (tools/tpu_session5.sh) ONCE and
+# exit. Writes /tmp/tpu_window_active while the session runs so other
+# processes don't contend for the exclusive TPU grant.
+set -u
+LOG=${1:-/tmp/tpu_watch.log}
+echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+while :; do
+  if [ -f /tmp/tpu_window_active ]; then
+    sleep 240; continue
+  fi
+  if timeout 75 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null | grep -qE "tpu|axon"; then
+    echo "$(date -u +%FT%TZ) TUNNEL UP -> running session5" >> "$LOG"
+    touch /tmp/tpu_window_active
+    rm -f /tmp/paddle_tpu_probe_down
+    bash /root/repo/tools/tpu_session5.sh /tmp/tpu_session5 >> "$LOG" 2>&1
+    rm -f /tmp/tpu_window_active
+    echo "$(date -u +%FT%TZ) session5 complete" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) down" >> "$LOG"
+  sleep 240
+done
