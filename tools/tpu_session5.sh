@@ -63,6 +63,7 @@ run longctx 900 python tools/longctx_bench.py
 #     (42.1%) steps actually go? Ablation mode ranks fwd/bwd/opt parts.
 run prof_bert 1200 env PROF_MODEL=bert PROF_MODE=ablate python tools/tpu_profile.py
 run prof_llama 1200 env PROF_MODEL=llama PROF_MODE=ablate python tools/tpu_profile.py
+run prof_vit 1500 python tools/vit_profile.py
 
 # 7. Decode cost localization (only if the window is still alive).
 run decode_profile 1500 python tools/decode_profile.py

@@ -1,0 +1,106 @@
+"""ViT-L/16 step ablation: localize the r3 11.2%-MFU laggard.
+
+Times bench-identical ViT-L variants and diffs medians:
+  full          train step (fwd+bwd+AdamW), remat ON (bench config)
+  no_remat      same without recompute (memory-permitting at this batch)
+  no_opt        fwd+bwd only
+  fwd           forward only
+  full_remat_convpatch   full step with the patch CONV forced
+                (PADDLE_TPU_PATCH_CONV=1) — the A/B against the new
+                space-to-depth matmul default
+Prints one JSON line. Run on the chip:  python tools/vit_profile.py
+Env: PROF_STEPS (default 8), BENCH_VIT_BATCH (default 32).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import paddle_tpu as paddle
+    from paddle_tpu.models.vit import vit_l_16, vit_tiny
+
+    steps = int(os.environ.get("PROF_STEPS", "8" if on_tpu else "2"))
+    batch = int(os.environ.get("BENCH_VIT_BATCH", "32")) if on_tpu else 2
+    size = 224 if on_tpu else 32
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(batch, 3, size, size).astype(np.float32)
+    y_np = rng.randint(0, 10, (batch,)).astype(np.int32)
+
+    def build(recompute=True):
+        paddle.seed(0)
+        m = vit_l_16(recompute=recompute) if on_tpu else vit_tiny()
+        if on_tpu:
+            m.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=m.parameters(),
+                                     multi_precision=on_tpu)
+        x = paddle.to_tensor(x_np)
+        if on_tpu:
+            x = x.astype("bfloat16")
+        return m, opt, x, paddle.to_tensor(y_np)
+
+    def timed(make_step, recompute=True):
+        m, opt, x, y = build(recompute)
+        step = paddle.jit.to_static(make_step(m, opt))
+        try:
+            for _ in range(2):
+                out = step(x, y)
+            float(np.asarray(out._data).sum())
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = step(x, y)
+            float(np.asarray(out._data).sum())
+            return round((time.perf_counter() - t0) / steps * 1e3, 2)
+        except Exception as e:
+            print(f"vit_profile: variant failed: {e}", file=sys.stderr)
+            return None
+
+    def full(m, opt):
+        def f(x, y):
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return f
+
+    def no_opt(m, opt):
+        def f(x, y):
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            return loss
+        return f
+
+    def fwd(m, opt):
+        def f(x, y):
+            return paddle.nn.functional.cross_entropy(m(x), y)
+        return f
+
+    rec = {"metric": "vit_l16_step_ablation_ms", "batch": batch,
+           "device": str(dev)}
+    rec["full_remat"] = timed(full, recompute=True)
+    rec["no_opt"] = timed(no_opt, recompute=True)
+    rec["fwd"] = timed(fwd, recompute=True)
+    rec["full_no_remat"] = timed(full, recompute=False)
+    # patch-embed A/B inside the full step: conv vs space-to-depth matmul
+    os.environ["PADDLE_TPU_PATCH_CONV"] = "1"
+    rec["full_remat_convpatch"] = timed(full, recompute=True)
+    os.environ.pop("PADDLE_TPU_PATCH_CONV", None)
+    if tpu_unavailable:
+        rec["tpu_unavailable"] = True
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
