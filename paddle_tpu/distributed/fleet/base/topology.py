@@ -113,15 +113,49 @@ class HybridCommunicateGroup:
                 "mp": self._mp_degree}
         devs = np.asarray(jax.devices())
         need = int(np.prod(list(dims.values())))
+        axes = ("pp", "dp", "sharding", "sep", "mp")
+        shape = (dims["pp"], dims["dp"], dims["sharding"], dims["sep"],
+                 dims["mp"])
+        if devs.size >= need:
+            # multi-slice pods: per-chip ICI only spans a slice; traffic
+            # between slices rides DCN. Put the DATA axis across slices
+            # (dp's gradient allreduce is the least latency-sensitive,
+            # once-per-step collective — the reference runs its NCCL dp
+            # ring over the inter-node network for the same reason) and
+            # keep sharding/sep/mp inside each slice's ICI.
+            # create_hybrid_device_mesh needs real slice topology info —
+            # absent (CPU, single slice), fall through to the flat mesh.
+            try:
+                slices = {getattr(d, "slice_index", 0)
+                          for d in devs[:need].tolist()}
+                n_slices = len(slices)
+                if n_slices > 1 and dims["dp"] % n_slices == 0:
+                    from jax.experimental import mesh_utils
+                    # signature: (mesh_shape, dcn_mesh_shape, devices=...)
+                    # — mesh_shape is the per-slice (ICI) factorization
+                    hyb = mesh_utils.create_hybrid_device_mesh(
+                        (dims["pp"], dims["dp"] // n_slices,
+                         dims["sharding"], dims["sep"], dims["mp"]),
+                        (1, n_slices, 1, 1, 1),
+                        devices=devs[:need].tolist())
+                    return Mesh(hyb, axes)
+            except Exception as e:
+                # flat reshape below is always correct, just not
+                # DCN-placement-optimal — but NEVER silently: a failure
+                # here on a real pod means dp gradient traffic may cross
+                # DCN unplanned
+                import warnings
+                warnings.warn(
+                    f"hybrid (ICI/DCN) mesh construction failed, using "
+                    f"flat device order: {type(e).__name__}: {e}",
+                    RuntimeWarning, stacklevel=2)
         if devs.size < need:
             # virtual topology (tests / dry-run on fewer chips): tile devices
             devs = np.tile(devs, -(-need // devs.size))
         devs = devs[:need]
         # axis order outer→inner: pp (cross-slice ok) → dp → sharding → sep →
         # mp (innermost: highest-bandwidth ICI neighbors)
-        shape = (dims["pp"], dims["dp"], dims["sharding"], dims["sep"],
-                 dims["mp"])
-        return Mesh(devs.reshape(shape), ("pp", "dp", "sharding", "sep", "mp"))
+        return Mesh(devs.reshape(shape), axes)
 
     @property
     def mesh(self) -> Mesh:

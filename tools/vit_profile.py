@@ -1,6 +1,8 @@
 """ViT-L/16 step ablation: localize the r3 11.2%-MFU laggard.
 
-Times bench-identical ViT-L variants and diffs medians:
+Times bench-shaped ViT-L variants (UNDONATED by default — set
+PROF_DONATE=1 for bench's donated stepping; a donation hang here would
+eat the window slot) and diffs chunk-medians:
   full          train step (fwd+bwd+AdamW), remat ON (bench config)
   no_remat      same without recompute (memory-permitting at this batch)
   no_opt        fwd+bwd only
@@ -50,18 +52,28 @@ def main():
             x = x.astype("bfloat16")
         return m, opt, x, paddle.to_tensor(y_np)
 
+    donate = os.environ.get("PROF_DONATE") == "1"
+
     def timed(make_step, recompute=True):
-        m, opt, x, y = build(recompute)
-        step = paddle.jit.to_static(make_step(m, opt))
+        # EVERYTHING inside the try: a variant that fails to build (e.g.
+        # no_remat OOM — it killed the tunnel chip twice in r3) must
+        # yield None, not lose the already-measured variants
         try:
+            m, opt, x, y = build(recompute)
+            step = paddle.jit.to_static(make_step(m, opt),
+                                        donate_state=donate)
             for _ in range(2):
                 out = step(x, y)
             float(np.asarray(out._data).sum())
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                out = step(x, y)
-            float(np.asarray(out._data).sum())
-            return round((time.perf_counter() - t0) / steps * 1e3, 2)
+            ts = []
+            chunk = max(steps // 3, 1)
+            for _ in range(3):          # median of chunks, like bench.py
+                t0 = time.perf_counter()
+                for _ in range(chunk):
+                    out = step(x, y)
+                float(np.asarray(out._data).sum())
+                ts.append((time.perf_counter() - t0) / chunk)
+            return round(float(np.median(ts)) * 1e3, 2)
         except Exception as e:
             print(f"vit_profile: variant failed: {e}", file=sys.stderr)
             return None
