@@ -387,7 +387,12 @@ class FusedDecoder:
             # or (int8 stack, fp32 scales) in cache-quant mode
             qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
             quant = isinstance(caches, tuple)
-            if mesh is None:
+            # escape hatch: PADDLE_TPU_STACKED_KERNEL=0 forces the dense
+            # path — the stacked kernels' first on-chip Mosaic compile
+            # happens inside a driver bench window; a compile failure
+            # there must be recoverable without a code change
+            if mesh is None and os.environ.get(
+                    "PADDLE_TPU_STACKED_KERNEL", "1") != "0":
                 from ..ops.pallas.decode_attention import (
                     decode_attention_stacked, decode_attention_stacked_i8,
                     stacked_i8_is_supported, stacked_is_supported)
@@ -562,12 +567,16 @@ class FusedDecoder:
         caches = self.init_cache(b)
         toks_tm = jnp.swapaxes(ids.astype(jnp.int32), 0, 1)  # [S, B]
         mesh_now = self._mesh_mp()
+        # the stacked-kernel escape hatch is trace-time state: it must be
+        # part of every compiled-step cache key, or flipping it after a
+        # compile failure would silently reuse the failing trace
+        sk_flag = os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
         pos, last_x = 0, None
         while pos < prompt:
             chunk = 64
             while chunk > prompt - pos:
                 chunk //= 2
-            pkey = ("prefill", mesh_now, chunk)
+            pkey = ("prefill", mesh_now, chunk, sk_flag)
             pstep = self._scan_cache.get(pkey)
             if pstep is None:
                 pstep = self._build_prefill_scan(chunk)
@@ -614,7 +623,7 @@ class FusedDecoder:
             while chunk > remaining:
                 chunk //= 2
             key = (do_sample, top_k, top_p, temperature,
-                   self._mesh_mp(), chunk, eos)
+                   self._mesh_mp(), chunk, eos, sk_flag)
             step = self._scan_cache.get(key)
             if step is None:
                 step = self._build_scan_step(*key[:4], chunk, eos)
