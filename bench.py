@@ -165,9 +165,10 @@ def _init_devices():
     ttl = _probe_cache_ttl(cached_kind)
     if os.environ.get("BENCH_TPU_UNAVAILABLE") == "1" or (
             cache_age is not None and cache_age < ttl):
+        age_s = f"{round(cache_age)}s" if cache_age is not None else "env"
         print(f"bench: TPU marked unavailable (env/cache "
-              f"kind={cached_kind} age={cache_age and round(cache_age)}s "
-              f"ttl={ttl}s); skipping probes", file=sys.stderr)
+              f"kind={cached_kind} age={age_s} ttl={ttl}s); "
+              "skipping probes", file=sys.stderr)
         import jax
         jax.config.update("jax_platforms", "cpu")
         return jax, jax.devices()[0], True
