@@ -10,6 +10,16 @@ cd /root/repo
 
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 to=$2; shift 2
+  # mid-window tunnel-death guard: a dead tunnel makes every later phase
+  # hang to its full timeout — probe (~10 s when up) and stop the session
+  # instead, so the driver/operator sees the partial results immediately.
+  # Skipped when BENCH_TPU_UNAVAILABLE=1 (CPU rehearsal mode).
+  if [ "${BENCH_TPU_UNAVAILABLE:-0}" != "1" ]; then
+    if ! timeout 70 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      echo "=== $name SKIPPED: tunnel lost mid-window; stopping session ===" | tee -a "$OUT/session.log"
+      exit 1
+    fi
+  fi
   echo "=== $name (timeout ${to}s) ===" | tee -a "$OUT/session.log"
   timeout "$to" "$@" > "$OUT/$name.log" 2>&1
   echo "exit=$? $(tail -c 300 "$OUT/$name.log" | tr '\n' ' ')" | tee -a "$OUT/session.log"
