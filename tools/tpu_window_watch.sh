@@ -1,23 +1,55 @@
 #!/bin/bash
-# Probe the axon tunnel hang-safely every ~4 min; the moment it answers,
-# run the queued r4 measurement session (tools/tpu_session5.sh) ONCE and
-# exit. Writes /tmp/tpu_window_active while the session runs so other
-# processes don't contend for the exclusive TPU grant.
+# Probe the axon tunnel hang-safely every ~4 min; whenever it answers,
+# run the queued measurement session (tools/tpu_session5.sh). Re-arming:
+# if the session dies mid-window (tunnel flap, kill), the watcher goes
+# back to probing and the NEXT window runs only the remaining phases
+# (session5 skips its done/ markers). Exits only when session5 reports
+# full completion ($OUT/done/ALL) — partial windows are the norm.
+# The exclusive-grant lock (/tmp/tpu_window_active) is owned by session5
+# itself (PID-holding + trap-cleaned + stale-detected); the watcher only
+# respects it to avoid probing during someone else's window.
 set -u
 LOG=${1:-/tmp/tpu_watch.log}
-echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+OUT=${2:-/tmp/tpu_session5}
+LOCK=/tmp/tpu_window_active
+PIDFILE=/tmp/tpu_watch.pid
+
+# single-watcher guard: a second copy exits instead of double-probing
+if [ -f "$PIDFILE" ]; then
+  old=$(cat "$PIDFILE" 2>/dev/null)
+  if [ -n "$old" ] && [ "$old" != "$$" ] && kill -0 "$old" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) watcher pid $old already running; exiting" >> "$LOG"
+    exit 0
+  fi
+fi
+echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT INT TERM
+
+echo "$(date -u +%FT%TZ) watcher start (pid $$)" >> "$LOG"
 while :; do
-  if [ -f /tmp/tpu_window_active ]; then
-    sleep 240; continue
+  if [ -f "$OUT/done/ALL" ]; then
+    echo "$(date -u +%FT%TZ) session5 fully complete — watcher exiting" >> "$LOG"
+    break
+  fi
+  if [ -f "$LOCK" ]; then
+    holder=$(cat "$LOCK" 2>/dev/null)
+    if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
+      sleep 240; continue
+    fi
+    # dead-PID lock is stale (acquisition is atomic ln, so an empty file
+    # can only be a crashed legacy writer). mv aside, never rm in place —
+    # a racing fresh acquirer's lock can't be deleted by the loser.
+    echo "$(date -u +%FT%TZ) clearing stale lock (pid ${holder:-?} dead)" >> "$LOG"
+    mv "$LOCK" "$LOCK.stale.$$" 2>/dev/null && rm -f "$LOCK.stale.$$"
   fi
   if timeout 75 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null | grep -qE "tpu|axon"; then
     echo "$(date -u +%FT%TZ) TUNNEL UP -> running session5" >> "$LOG"
-    touch /tmp/tpu_window_active
     rm -f /tmp/paddle_tpu_probe_down
-    bash /root/repo/tools/tpu_session5.sh /tmp/tpu_session5 >> "$LOG" 2>&1
-    rm -f /tmp/tpu_window_active
-    echo "$(date -u +%FT%TZ) session5 complete" >> "$LOG"
-    break
+    bash /root/repo/tools/tpu_session5.sh "$OUT" >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) session5 exited rc=$rc" >> "$LOG"
+    # fall through: loop re-checks done/ALL, else re-arms for the rest
+    sleep 60; continue
   fi
   echo "$(date -u +%FT%TZ) down" >> "$LOG"
   sleep 240
