@@ -815,6 +815,8 @@ def bench_moe(on_tpu, peak_tflops):
     # the delta and could even go negative). Two extra timings; gated on
     # remaining budget.
     dispatch_ms = None
+    dispatch_raw_ms = None
+    noise_floor_ms = None
     if _budget_left(_BUDGET_S[0]) > (300 if on_tpu else 60):
         try:
             med_plain, _ = _timed_steps(          # real step, per-dispatch
@@ -828,7 +830,21 @@ def bench_moe(on_tpu, peak_tflops):
                 lambda: twin_step(x, y),
                 lambda out: float(np.asarray(out._data)),
                 max(steps // 2, 2))
-            dispatch_ms = round((med_plain - med_twin) * 1000, 3)
+            # repeat the plain side (already compiled, cheap): the spread
+            # between its two medians is the run-to-run noise floor. At
+            # tiny CPU shapes the twin can time SLOWER than the real step
+            # (r4 emitted -0.193 ms into the driver artifact); a delta
+            # below the floor is indistinguishable from noise and must
+            # not be published as a (let alone negative) cost.
+            med_plain2, _ = _timed_steps(
+                lambda: train_step(x, y),
+                lambda out: float(np.asarray(out._data)),
+                max(steps // 2, 2))
+            noise_floor_ms = round(abs(med_plain - med_plain2) * 1000, 3)
+            raw = (med_plain + med_plain2) / 2 - med_twin
+            dispatch_raw_ms = round(raw * 1000, 3)
+            dispatch_ms = (dispatch_raw_ms
+                           if dispatch_raw_ms > noise_floor_ms else 0.0)
         except Exception as e:
             print(f"bench: moe decomposition probe failed: {e}",
                   file=sys.stderr)
@@ -845,6 +861,8 @@ def bench_moe(on_tpu, peak_tflops):
     }
     if dispatch_ms is not None:
         rec["gate_dispatch_combine_ms"] = dispatch_ms
+        rec["gate_dispatch_combine_raw_ms"] = dispatch_raw_ms
+        rec["dispatch_noise_floor_ms"] = noise_floor_ms
         rec["expert_compute_step_ms"] = round(med_twin * 1000, 3)
     return rec
 
@@ -972,7 +990,35 @@ def main():
             record["standing_tpu_ratchet"] = standing
     elif on_tpu:
         _append_tpu_window(record)
-    print(json.dumps(record))
+    _emit_record(record)
+
+
+def _emit_record(record):
+    """Driver contract: stdout gets ONE compact, bounded JSON line; the
+    full record goes to BENCH_RESULT.json. The r4 driver artifact showed
+    the driver keeps only a bounded TAIL of output — the full record
+    (configs + embedded standing ratchet) overflowed it and parsed as
+    null. The compact line stays well under any plausible tail buffer;
+    anything that doesn't fit lives in the canonical file."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_RESULT.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+    except Exception as e:
+        print(f"bench: could not write BENCH_RESULT.json: {e}",
+              file=sys.stderr)
+    compact = {k: record[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "mfu",
+                "device", "tpu_unavailable", "smoke", "error")
+               if k in record}
+    standing = record.get("standing_tpu_ratchet")
+    if standing:   # fallback runs still surface the real TPU headline
+        compact["standing_tpu"] = {
+            k: standing[k] for k in ("value", "unit", "mfu")
+            if k in standing}
+    compact["full_record"] = "BENCH_RESULT.json"
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":
@@ -984,9 +1030,13 @@ if __name__ == "__main__":
         # instead of a bare traceback with parsed=null.
         import traceback
         traceback.print_exc()
-        print(json.dumps({
+        _emit_record({
             "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": None,
             "error": f"{type(e).__name__}: {e}",
-        }))
-        sys.exit(0)
+        })
+        # nonzero: the record is emitted for the driver's parser, but a
+        # crashed bench must not read as success (tpu_session5.sh marks
+        # phases done on rc==0 — exit 0 here would permanently skip a
+        # bench phase that actually failed)
+        sys.exit(4)

@@ -21,38 +21,32 @@ mkdir -p "$OUT" "$OUT/done"
 cd /root/repo
 mkdir -p tpu_windows
 
-# --- exclusive-grant lock: PID-holding, stale-safe, trap-cleaned -------
-# Acquisition is ATOMIC: the PID is written to a private temp file and
-# hard-linked into place (ln fails if the lock exists), so no reader can
-# ever observe a half-written/empty lock and no two acquirers can both
-# win. Stale locks (dead holder) are mv'd aside, never rm'd in place —
-# mv is atomic and fails for the loser, so a racing acquirer can't
-# delete a lock that was just freshly taken by someone else.
-acquire_lock() {
-  local i holder
-  for i in 1 2 3; do
-    echo $$ > "$LOCK.$$.tmp"
-    if ln "$LOCK.$$.tmp" "$LOCK" 2>/dev/null; then rm -f "$LOCK.$$.tmp"; return 0; fi
-    rm -f "$LOCK.$$.tmp"
-    holder=$(cat "$LOCK" 2>/dev/null)
-    if [ -n "$holder" ] && [ "$holder" != "$$" ] && kill -0 "$holder" 2>/dev/null; then
-      return 1
-    fi
-    echo "clearing stale lock (pid ${holder:-?} dead)" | tee -a "$OUT/session.log"
-    mv "$LOCK" "$LOCK.stale.$$" 2>/dev/null && rm -f "$LOCK.stale.$$"
-  done
-  return 1
-}
-if ! acquire_lock; then
-  echo "window holder pid $(cat "$LOCK" 2>/dev/null) still alive; aborting" | tee -a "$OUT/session.log"
+# --- exclusive-grant lock: kernel flock, zero staleness ----------------
+# The TRUE mutex is a kernel flock on $LOCK.flock: acquisition is atomic,
+# and the kernel releases it on ANY process death (kill -9 included), so
+# stale locks cannot exist and no clear-by-name race is possible. The
+# legacy presence file $LOCK (holder PID) is kept purely for human
+# observers ("is a window active?"); machinery must test the flock, not
+# the file. Phase children inherit the lock fd: if THIS shell is
+# kill -9'd mid-phase, the grant stays locked until the orphaned phase
+# process (which may still be using the TPU) exits — every phase runs
+# under `timeout`, so that hold is bounded and correct.
+exec 200>"$LOCK.flock"
+if ! flock -n 200; then
+  echo "window holder still active (flock busy); aborting" | tee -a "$OUT/session.log"
   exit 2
 fi
+echo $$ > "$LOCK"
 trap 'rm -f "$LOCK"' EXIT INT TERM
 
 PHASES=""   # registry, filled by run(); used for the ALL marker
 
 commit_phase() {  # commit_phase <name> [extra repo paths...]
   local name=$1; shift
+  # only commit for a phase that EXECUTED in this pass — a done-skipped
+  # phase must not sweep up a stale BENCH_RESULT.json some later
+  # interrupted phase left dirty (mislabeled artifact in history)
+  [ "$(cat "$OUT/ran_$name" 2>/dev/null)" = "$$" ] || return 0
   local paths=()
   if [ -f "$OUT/$name.log" ]; then
     cp "$OUT/$name.log" "tpu_windows/$name.log" && paths+=("tpu_windows/$name.log")
@@ -98,6 +92,7 @@ run() {  # run <name> <timeout_s> <cmd...>  — then caller commit_phase's
     fi
   fi
   echo $((att+1)) > "$OUT/att_$name"
+  echo $$ > "$OUT/ran_$name"   # pass-scoped: unlocks commit_phase
   echo "=== $name (timeout ${to}s, attempt $((att+1))) ===" | tee -a "$OUT/session.log"
   timeout "$to" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
@@ -149,20 +144,20 @@ commit_phase bench_decode_i8
 # 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
 #    vs XLA composite, few steps each, scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
-commit_phase ffn_ab_composite
+commit_phase ffn_ab_composite BENCH_RESULT.json
 run ffn_ab_fused 1200 env PADDLE_TPU_FUSED_FFN=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
-commit_phase ffn_ab_fused
+commit_phase ffn_ab_fused BENCH_RESULT.json
 
 # 4. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
 run vit_matmul 1200 env BENCH_ONLY=vit python bench.py
-commit_phase vit_matmul
+commit_phase vit_matmul BENCH_RESULT.json
 run vit_conv 1200 env PADDLE_TPU_PATCH_CONV=1 BENCH_ONLY=vit python bench.py
-commit_phase vit_conv
+commit_phase vit_conv BENCH_RESULT.json
 
 # 5. Full 5-config bench — appends the window record to BENCH_tpu.json.
 run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
 cp BENCH_partial.json "$OUT/" 2>/dev/null
-commit_phase bench_all BENCH_tpu.json BENCH_partial.json
+commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
 
 # 6. Long-context flash ratchet S=8k/16k.
 run longctx 900 python tools/longctx_bench.py

@@ -5,9 +5,9 @@
 # back to probing and the NEXT window runs only the remaining phases
 # (session5 skips its done/ markers). Exits only when session5 reports
 # full completion ($OUT/done/ALL) — partial windows are the norm.
-# The exclusive-grant lock (/tmp/tpu_window_active) is owned by session5
-# itself (PID-holding + trap-cleaned + stale-detected); the watcher only
-# respects it to avoid probing during someone else's window.
+# The exclusive grant is a kernel flock on /tmp/tpu_window_active.flock
+# owned by session5 (auto-released on any death — staleness-free); the
+# watcher flock-probes it to avoid probing during someone else's window.
 set -u
 LOG=${1:-/tmp/tpu_watch.log}
 OUT=${2:-/tmp/tpu_session5}
@@ -31,16 +31,11 @@ while :; do
     echo "$(date -u +%FT%TZ) session5 fully complete — watcher exiting" >> "$LOG"
     break
   fi
-  if [ -f "$LOCK" ]; then
-    holder=$(cat "$LOCK" 2>/dev/null)
-    if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
-      sleep 240; continue
-    fi
-    # dead-PID lock is stale (acquisition is atomic ln, so an empty file
-    # can only be a crashed legacy writer). mv aside, never rm in place —
-    # a racing fresh acquirer's lock can't be deleted by the loser.
-    echo "$(date -u +%FT%TZ) clearing stale lock (pid ${holder:-?} dead)" >> "$LOG"
-    mv "$LOCK" "$LOCK.stale.$$" 2>/dev/null && rm -f "$LOCK.stale.$$"
+  # the true mutex is the kernel flock (auto-released on holder death —
+  # no staleness possible); probe it non-destructively. The presence
+  # file $LOCK is informational only.
+  if ! flock -n "$LOCK.flock" -c true 2>/dev/null; then
+    sleep 240; continue
   fi
   if timeout 75 python -c "import jax; d=jax.devices()[0]; print(d.platform)" 2>/dev/null | grep -qE "tpu|axon"; then
     echo "$(date -u +%FT%TZ) TUNNEL UP -> running session5" >> "$LOG"
