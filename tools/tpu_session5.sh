@@ -37,7 +37,9 @@ if ! flock -n 200; then
   exit 2
 fi
 echo $$ > "$LOCK"
-trap 'rm -f "$LOCK"' EXIT INT TERM
+# remove only OUR presence file — a late-exiting older session must not
+# delete one a newer holder has since written
+trap '[ "$(cat "$LOCK" 2>/dev/null)" = "$$" ] && rm -f "$LOCK"' EXIT INT TERM
 
 PHASES=""   # registry, filled by run(); used for the ALL marker
 

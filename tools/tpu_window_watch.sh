@@ -23,7 +23,9 @@ if [ -f "$PIDFILE" ]; then
   fi
 fi
 echo $$ > "$PIDFILE"
-trap 'rm -f "$PIDFILE"' EXIT INT TERM
+# remove only OUR pidfile — an exiting stale watcher must not delete the
+# pidfile a newer instance has already written over it
+trap '[ "$(cat "$PIDFILE" 2>/dev/null)" = "$$" ] && rm -f "$PIDFILE"' EXIT INT TERM
 
 echo "$(date -u +%FT%TZ) watcher start (pid $$)" >> "$LOG"
 while :; do
