@@ -97,10 +97,26 @@ class Partial:
 __all__ += ["Shard", "Replicate", "Partial"]
 
 
+# Placement-generation counter: every (re)annotation bumps it, and the
+# Engine folds it into its conflict-plan cache key — a plan computed
+# against one set of parameter placements must not outlive them
+# (advisor r4: a stale plan left a NEW conflict unrepaired forever).
+_placement_gen = [0]
+
+
+def bump_placement_generation():
+    _placement_gen[0] += 1
+
+
+def placement_generation() -> int:
+    return _placement_gen[0]
+
+
 def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
                  stop_gradient=None):
     t = data if isinstance(data, Tensor) else Tensor(np.asarray(data))
     spec = _placements_to_spec(placements, mesh, t.ndim)
+    bump_placement_generation()
     t.sharding_spec = spec if not isinstance(t, Tensor) else spec
     try:
         t.split_axis = None
@@ -180,6 +196,7 @@ def reshard(tensor, mesh: ProcessMesh, placements):
     else:
         moved = 0
     t.sharding_spec = spec
+    bump_placement_generation()
     _reshard_log.append({"shape": tuple(t.shape), "from": from_desc,
                          "to": str(spec), "bytes_moved": moved})
     return t

@@ -110,6 +110,19 @@ class Engine:
         of silently training with the conflict unrepaired."""
         input_bytes = int(getattr(arr, "nbytes", np.asarray(arr).nbytes))
         ax = self._data_axis(mesh)
+        # the placement generation invalidates cached plans whenever any
+        # annotation API re-shards a tensor: a plan computed against old
+        # placements must not suppress repair of a NEW conflict. The
+        # generation is compared (and the cache cleared on mismatch)
+        # rather than folded into the key — per-step annotation traffic
+        # (e.g. stage-2 reshard_grads calling with_spec per gradient)
+        # would otherwise make every lookup miss AND grow the dict one
+        # stale entry per batch.
+        from .api import placement_generation
+        gen = placement_generation()
+        if getattr(self, "_plan_gen", None) != gen:
+            self._conflict_plan.clear()
+            self._plan_gen = gen
         key = (ax, input_bytes)
         plan = self._conflict_plan.get(key)
         if plan is not None:

@@ -69,9 +69,11 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     # inference — not inert)
     drop_inert = (dropout1_rate == 0.0 and dropout2_rate == 0.0) or (
         not training and mode == "upscale_in_train")
+    from ...parallel import no_mp_mesh   # mesh query only, no pallas
     if (os.environ.get("PADDLE_TPU_FUSED_FFN") == "1"
             and activation == "gelu" and drop_inert
-            and linear1_bias is not None and linear2_bias is not None):
+            and linear1_bias is not None and linear2_bias is not None
+            and no_mp_mesh()):   # pallas_call is an SPMD barrier
         from ...ops.pallas.fused_ffn import fused_ffn
         out = apply_op(lambda a, w1, b1, w2, b2: fused_ffn(
             a, w1, b1, w2, b2, "gelu"), x, linear1_weight, linear1_bias,

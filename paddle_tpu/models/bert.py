@@ -135,12 +135,25 @@ class BertForPretraining(Layer):
             # truncation contract as the reference's max_predictions.
             s_len = seq.shape[1]
             kmax = max(1, -(-22 * s_len // 100))
+            overflow = None
             if os.environ.get("PADDLE_TPU_MLM_GATHER", "1") != "0" \
                     and kmax < s_len:
                 lab_arr = (masked_lm_labels._data
                            if isinstance(masked_lm_labels, Tensor)
                            else jnp.asarray(masked_lm_labels))
                 import jax as _jax
+                if isinstance(lab_arr, _jax.core.Tracer):
+                    # traced path (to_static/Engine): the concrete
+                    # density check below cannot run on a Tracer, and a
+                    # row with more labels than the gather budget would
+                    # silently lose loss terms. Enforce the budget
+                    # INSIDE the trace instead: overflow NaN-poisons the
+                    # loss (below), so truncation is never silent — the
+                    # reference's max_predictions_per_seq contract makes
+                    # overflow inexpressible by construction; dense-label
+                    # training here requires PADDLE_TPU_MLM_GATHER=0.
+                    overflow = jnp.max(
+                        jnp.sum(lab_arr != -100, axis=1)) > kmax
                 if not isinstance(lab_arr, _jax.core.Tracer):
                     # concrete labels (eager path): detect rows denser
                     # than the gather budget — truncating them would
@@ -181,6 +194,12 @@ class BertForPretraining(Layer):
             mlm_loss = F.cross_entropy(
                 reshape(logits, [-1, self.config.vocab_size]),
                 reshape(labels_sel, [-1]), ignore_index=-100)
+            if overflow is not None:
+                # budget violation in a traced run: poison instead of
+                # silently under-counting (labels carry no gradient, so
+                # the multiplier is 1.0 on every legal batch)
+                mlm_loss = mlm_loss * Tensor(jnp.where(
+                    overflow, jnp.float32(jnp.nan), jnp.float32(1.0)))
             loss = mlm_loss
             if next_sentence_labels is not None:
                 loss = loss + F.cross_entropy(nsp_logits,

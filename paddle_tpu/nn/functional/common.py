@@ -47,8 +47,18 @@ def fused_concat_linear(x, weights, biases=None):
     fused matmul runs in the amp dtype under auto_cast instead of
     silently upcasting to fp32."""
     from ...amp.auto_cast import cast_if_amp
-    if biases is not None and any(b is None for b in biases):
-        biases = None
+    if biases is not None:
+        n_none = sum(1 for b in biases if b is None)
+        if n_none == len(biases):
+            biases = None
+        elif n_none:
+            # a mixed list would previously drop ALL biases silently —
+            # wrong result with no error. Refuse instead; callers with a
+            # genuinely mixed layout should pass explicit zeros.
+            raise ValueError(
+                "fused_concat_linear: biases must be all None or all "
+                f"set, got {n_none}/{len(biases)} None. Pass explicit "
+                "zero biases for the bias-less projections.")
     n = len(weights)
 
     if biases is None:

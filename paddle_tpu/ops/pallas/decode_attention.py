@@ -47,13 +47,23 @@ def is_supported(q_shape, cache_shape, dtype) -> bool:
 
 
 def _online_softmax_block(q, k, v, n_valid, k_start, acc_sc, m_sc, l_sc,
-                          *, scale, sq, bq, bk):
+                          *, scale, sq, bq, bk,
+                          k_col_scale=None, v_col_scale=None):
     """One KV block's update of the running (acc, m, l) flash state —
     shared by the per-layer and stacked-cache kernels (the only thing
-    that differs between them is how refs address their blocks)."""
+    that differs between them is how refs address their blocks).
+
+    k_col_scale / v_col_scale ([1, bk] fp32, optional) are the int8
+    cache's per-row dequant scales applied COLUMN-wise to the score
+    matrix instead of row-wise to k/v: scales factor out of the dots
+    (q·(c·k) == c·(q·k), p·(c·v) == (c·p)·v), and a [1, bk] lane-major
+    operand is a Mosaic-legal layout whereas the previous [bk, 1]
+    (lane dim 1) scale block was a known compile risk on real TPUs."""
     # dots in input dtype (bf16 MXU full rate), f32 accumulation/softmax
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    if k_col_scale is not None:
+        s = s * k_col_scale          # [bq, bk] * [1, bk]
     rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     # row r is the token at global position n_valid + r: attends the
@@ -67,6 +77,8 @@ def _online_softmax_block(q, k, v, n_valid, k_start, acc_sc, m_sc, l_sc,
     p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
     l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
     m_sc[:] = m_new
+    if v_col_scale is not None:
+        p = p * v_col_scale          # fold v dequant into p (fp32)
     acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -345,14 +357,17 @@ def _stacked_i8_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
     @pl.when(run)
     def _():
         q = q_ref[0, 0]                                     # [bq, d]
-        # dequant in VMEM: int8 block * per-row scale -> query dtype
-        k = (k_ref[0, 0, 0, 0].astype(jnp.float32)
-             * ks_ref[0, 0, 0, 0]).astype(q.dtype)          # [bk, d]
-        v = (v_ref[0, 0, 0, 0].astype(jnp.float32)
-             * vs_ref[0, 0, 0, 0]).astype(q.dtype)
+        # int8 -> compute dtype conversion only (values in [-127, 127]
+        # are exact in bf16); the per-row dequant scales are applied
+        # column-wise to the SCORE matrix inside the softmax block,
+        # where they arrive as Mosaic-legal [1, bk] lane-major tiles
+        k = k_ref[0, 0, 0, 0].astype(q.dtype)               # [bk, d]
+        v = v_ref[0, 0, 0, 0].astype(q.dtype)
         _online_softmax_block(q, k, v, n_valid, k_start,
                               acc_sc, m_sc, l_sc,
-                              scale=scale, sq=sq, bq=bq, bk=bk)
+                              scale=scale, sq=sq, bq=bq, bk=bk,
+                              k_col_scale=ks_ref[0, 0, 0, 0],   # [1, bk]
+                              v_col_scale=vs_ref[0, 0, 0, 0])
 
     @pl.when(ki == nk - 1)
     def _():
@@ -364,8 +379,10 @@ def _stacked_i8_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
 def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
                                 cache_lens, scale=None):
     """qt: [B, H, Sq, D] (query dtype = compute dtype); caches_i8:
-    [L, 2, B, Hk, Smax, D] int8; cache_scales: [L, 2, B, Hk, Smax, 1]
-    fp32 per-row absmax scales; layer: scalar int32 (scalar-prefetch).
+    [L, 2, B, Hk, Smax, D] int8; cache_scales: [L, 2, B, Hk, 1, Smax]
+    fp32 per-row absmax scales (positions on the LAST axis so scale
+    blocks are [1, bk] lane-major — Mosaic-legal, unlike a [bk, 1]
+    lane-1 block); layer: scalar int32 (scalar-prefetch).
     Returns [B, H, Sq, D] in the query dtype."""
     b, h, sq, d = qt.shape
     hk, smax = caches_i8.shape[3], caches_i8.shape[4]
@@ -375,9 +392,19 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
     if caches_i8.dtype != jnp.int8:
         raise ValueError("decode_attention_stacked_i8: cache must be int8")
 
+    if cache_scales.shape != caches_i8.shape[:4] + (1, smax):
+        raise ValueError(
+            "decode_attention_stacked_i8: scales must be "
+            f"[L, 2, B, Hk, 1, Smax], got {cache_scales.shape}")
+
     out_dtype = qt.dtype
     qt, bq, bk, grid, kidx, vidx, qidx = _stacked_setup(qt, hk, smax,
                                                         group)
+    group_ = group
+    ksidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, 0, j)
+    vsidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
+        lay_r[0], 1, b_, h_ // g, 0, j)
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
@@ -390,8 +417,8 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
                 pl.BlockSpec((1, 1, bq, d), qidx),
                 pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
                 pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, 1), kidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, 1), vidx),
+                pl.BlockSpec((1, 1, 1, 1, 1, bk), ksidx),
+                pl.BlockSpec((1, 1, 1, 1, 1, bk), vsidx),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
             scratch_shapes=[

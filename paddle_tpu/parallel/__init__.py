@@ -96,9 +96,22 @@ def shard_batch(x, mesh: Optional[Mesh] = None):
     return Tensor(out) if not isinstance(x, Tensor) else Tensor(out)
 
 
+def no_mp_mesh() -> bool:
+    """Guard for opt-in Pallas fast paths (fused FFN & co.): a
+    pallas_call is an SPMD/fusion barrier, so kernels must not receive
+    mp-sharded operands (forced replication or partitioning failure).
+    Callers route to the XLA composite whenever a model-parallel mesh is
+    active. Lives here (a pure mesh query) so consulting it never drags
+    in the pallas import chain while the feature flag is off."""
+    mesh = current_mesh()
+    return mesh is None or dict(mesh.shape).get("mp", 1) < 2
+
+
 def with_spec(t: Tensor, *spec) -> Tensor:
     """Attach + apply a PartitionSpec to a tensor on the current mesh."""
     t.sharding_spec = P(*spec)
+    from ..distributed.auto_parallel.api import bump_placement_generation
+    bump_placement_generation()
     mesh = current_mesh()
     if mesh is not None and _valid_spec(t._data, t.sharding_spec, mesh):
         t._data = jax.device_put(t._data,

@@ -758,3 +758,39 @@ class TestAutoParallelEngine:
         assert len(outs) == 2 and outs[0].shape == [8, 16]
         engine.save(str(tmp_path / "engine.pdparams"))
         engine.load(str(tmp_path / "engine.pdparams"))
+
+
+class TestPlannerInvalidation:
+    def test_reannotation_invalidates_cached_plan(self):
+        """Advisor r4: _axis_conflict_plan cached its decision forever on
+        (axis, input_nbytes) — re-annotating parameter shardings after
+        the first batch left a NEW conflict unrepaired. The placement
+        generation (bumped by every annotation API) must invalidate the
+        cached plan."""
+        from paddle_tpu.distributed.auto_parallel import (Engine,
+                                                          ProcessMesh,
+                                                          Shard, set_mesh,
+                                                          shard_tensor)
+        mesh = ProcessMesh(np.arange(8), dim_names=["dp"])
+        set_mesh(mesh)
+        paddle.seed(33)
+        model = paddle.nn.Linear(16, 8)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        eng = Engine(model, lambda o, y: ((o - y) ** 2).mean(), opt)
+        from paddle_tpu.io import TensorDataset
+        x_np = np.random.RandomState(3).randn(8, 16).astype(np.float32)
+        y_np = (x_np @ np.ones((16, 8), np.float32) * 0.01)
+        ds = TensorDataset([paddle.to_tensor(x_np), paddle.to_tensor(y_np)])
+
+        # batch 1: no conflict -> 'data_parallel' cached, nothing logged
+        eng.fit(ds, epochs=1, batch_size=8)
+        assert not [r for r in eng.reshard_cost_log if "decision" in r]
+
+        # re-annotate AFTER the first batch: weight rows claim 'dp'
+        shard_tensor(model.weight, mesh, [Shard(0)])
+        # same input signature (same axis, same nbytes) — without the
+        # generation in the key this would silently reuse the stale plan
+        eng.fit(ds, epochs=1, batch_size=8)
+        dec = [r for r in eng.reshard_cost_log if "decision" in r]
+        assert dec, "re-annotated conflict was never re-planned"
+        assert dec[0]["decision"] in ("reshard_input", "reshard_params")

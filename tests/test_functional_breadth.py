@@ -364,3 +364,23 @@ def test_misc_new_tensor_ops():
     np.testing.assert_array_equal(
         np.asarray(paddle.bitwise_invert(_t(np.array([0, 5], np.int32)))._data),
         [-1, -6])
+
+
+class TestFusedConcatLinearContract:
+    def test_mixed_none_biases_raises(self):
+        """Advisor r4: a mixed None/non-None biases list used to drop ALL
+        biases silently (wrong result, no error)."""
+        import pytest
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        w1 = paddle.to_tensor(np.ones((4, 3), np.float32))
+        w2 = paddle.to_tensor(np.ones((4, 3), np.float32))
+        b = paddle.to_tensor(np.ones((3,), np.float32))
+        with pytest.raises(ValueError, match="all None or all set"):
+            F.fused_concat_linear(x, [w1, w2], [b, None])
+        # all-None still means no bias; all-set still applies them
+        out_nb = F.fused_concat_linear(x, [w1, w2], [None, None])
+        np.testing.assert_allclose(np.asarray(out_nb._data),
+                                   np.full((2, 6), 4.0), rtol=1e-6)
+        out_b = F.fused_concat_linear(x, [w1, w2], [b, b])
+        np.testing.assert_allclose(np.asarray(out_b._data),
+                                   np.full((2, 6), 5.0), rtol=1e-6)

@@ -196,3 +196,133 @@ class TestInt8Cache:
                              head=m.head, max_new_tokens=8)
         np.testing.assert_array_equal(np.asarray(out._data),
                                       np.asarray(ref._data))
+
+
+class TestWeightSwapRestack:
+    def test_weight_swap_releases_old_stack(self):
+        """r4 verdict weak #7: the stacked-param cache must not pin the
+        PREVIOUS parameter arrays alive across a weight swap (loading a
+        new checkpoint into the same decoder) — at serving scale that is
+        a full dead model copy held in HBM. The identity anchors are
+        weakrefs: after a swap the old arrays must be collectable, and a
+        restack must produce the new values."""
+        import gc
+        import weakref
+        from paddle_tpu.inference.generation import FusedDecoder
+        paddle.seed(21)
+        m = TinyFusedLM()
+        dec = FusedDecoder(m.fmt, m.embed, m.head, max_seq_len=32)
+        stk1 = dec._stacked()
+        old_w = m.fmt.qkv_weights[0]._data
+        wr = weakref.ref(old_w)
+        v1 = np.asarray(stk1["qkv_w"][0])
+
+        # swap every parameter to a fresh array (checkpoint-load shape)
+        for p in m.fmt.parameters():
+            p._data = p._data + 1.0
+        del old_w, stk1
+        gc.collect()
+        assert wr() is None, (
+            "old parameter array still pinned after weight swap")
+
+        stk2 = dec._stacked()
+        v2 = np.asarray(stk2["qkv_w"][0])
+        np.testing.assert_allclose(v2, v1 + 1.0, rtol=1e-6)
+        # cache hit on the NEW identities (no rebuild churn)
+        assert dec._stacked() is stk2
+
+
+class TestTPKernelDecode:
+    @needs8
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_mp2_streams_kernel_not_fallback(self, monkeypatch, int8):
+        """r5 (reference: mp-sharded heads in fused_multi_transformer_op
+        .cu): under an mp>=2 mesh the stacked decode kernel must run
+        TP-sharded via shard_map — numeric token parity with the no-mesh
+        run AND the kernel path (not the dense fallback) taken. The int8
+        cache composes (stack + scales both shard on the head axis)."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.ops.pallas import decode_attention as da
+        if int8:
+            monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_CACHE",
+                               raising=False)
+        paddle.seed(22)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=9)
+        # smax=128 so the kernel's Smax tiling rule holds (bk in 256/128)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6,
+                             max_seq_len=128)
+
+        kernel_calls = []
+        real = (da.decode_attention_stacked_i8 if int8
+                else da.decode_attention_stacked)
+        name = ("decode_attention_stacked_i8" if int8
+                else "decode_attention_stacked")
+
+        def spy(*a, **k):
+            kernel_calls.append(1)
+            return real(*a, **k)
+        monkeypatch.setattr(da, name, spy)
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6,
+                             max_seq_len=128)
+        assert kernel_calls, (
+            "mp decode took the dense fallback, not the shard_map kernel")
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+
+class TestBeamOverCache:
+    """r5 (reference: fluid beam_search op + fused_multi_transformer
+    cache): beam search runs AGAINST the decode cache — beams share the
+    prefill cache, each step's beam reorder is one gather on the
+    batch*beam dim inside the compiled step, no prefix re-forward."""
+
+    @pytest.mark.parametrize("seed,toks", [(11, 6), (41, 16), (43, 16)])
+    def test_fused_beam_matches_generate(self, seed, toks):
+        # 16-token runs matter: a cache-position off-by-one only flips
+        # top-k picks once divergence accumulates (review r5 found the
+        # t0=prompt+1 bug exactly this way)
+        paddle.seed(23 + seed)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=seed)
+        ref = generate(m, paddle.to_tensor(ids), max_new_tokens=toks,
+                       num_beams=4)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=toks, num_beams=4)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_fused_beam_matches_generate_with_eos(self):
+        paddle.seed(24)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=13)
+        # a mid-vocab eos makes some beams finish early: exercises the
+        # finished pool + eos-frozen continuations + trim semantics
+        eos = 7
+        ref = generate(m, paddle.to_tensor(ids), max_new_tokens=10,
+                       num_beams=3, eos_token_id=eos, length_penalty=0.8)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=10, num_beams=3,
+                             eos_token_id=eos, length_penalty=0.8)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_beam_rejects_sampling(self):
+        paddle.seed(25)
+        m = TinyFusedLM()
+        with pytest.raises(ValueError, match="deterministic"):
+            generate_fused(m.fmt, paddle.to_tensor(_prompt()),
+                           embed=m.embed, head=m.head, num_beams=2,
+                           do_sample=True)

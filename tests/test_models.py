@@ -409,3 +409,40 @@ class TestLlamaFusedProjections:
         with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
             out = m(ids)
         assert out._data.dtype == jnp.bfloat16, out._data.dtype
+
+
+class TestMLMTracedBudget:
+    def test_traced_overflow_poisons_loss(self, monkeypatch):
+        """Advisor r4 (medium): under tracing the concrete density check
+        cannot run, so a row denser than the 22% gather budget must
+        NaN-poison the loss (loud) instead of silently dropping loss
+        terms; a legal-density batch through the same trace stays
+        finite."""
+        from paddle_tpu.models.bert import BertForPretraining, bert_tiny
+        monkeypatch.delenv("PADDLE_TPU_MLM_GATHER", raising=False)
+        paddle.seed(17)
+        m = BertForPretraining(bert_tiny())
+        m.eval()
+        rng = np.random.RandomState(7)
+        ids = rng.randint(0, 500, (2, 32)).astype(np.int32)
+
+        def make_labels(n_masked):
+            lab = np.full_like(ids, -100)
+            for b in range(2):
+                pos = rng.choice(32, n_masked, replace=False)
+                lab[b, pos] = rng.randint(0, 500, n_masked)
+            return lab
+
+        step = paddle.jit.to_static(
+            lambda i, l: m(i, masked_lm_labels=l))
+        # budget = ceil(22% of 32) = 8: a 12-label row overflows
+        legal = float(np.asarray(step(
+            paddle.to_tensor(ids),
+            paddle.to_tensor(make_labels(5)))._data))
+        assert np.isfinite(legal)
+        poisoned = float(np.asarray(step(
+            paddle.to_tensor(ids),
+            paddle.to_tensor(make_labels(12)))._data))
+        assert np.isnan(poisoned), (
+            "over-budget MLM row must poison the traced loss, got "
+            f"{poisoned}")

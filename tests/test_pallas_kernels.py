@@ -547,3 +547,43 @@ class TestFusedFFN:
         np.testing.assert_allclose(np.asarray(out._data),
                                    np.asarray(ref._data),
                                    atol=1e-5, rtol=1e-5)
+
+
+class TestFusedFFNMeshGuard:
+    def test_mp_mesh_routes_to_composite(self, monkeypatch):
+        """Advisor r4: PADDLE_TPU_FUSED_FFN=1 under a model-parallel mesh
+        must NOT hand sharded operands to a pallas_call (SPMD barrier) —
+        both the GPTMLP and incubate fused_feedforward env paths route to
+        the XLA composite whenever an mp>=2 mesh is active."""
+        import paddle_tpu as paddle
+        import paddle_tpu.ops.pallas.fused_ffn as ffn_mod
+        from paddle_tpu.models.gpt import GPTConfig, GPTMLP
+
+        class FakeMesh:
+            shape = {"mp": 2}
+        monkeypatch.setattr("paddle_tpu.parallel.current_mesh",
+                            lambda: FakeMesh())
+
+        def boom(*a, **k):
+            raise AssertionError("fused_ffn kernel reached under mp mesh")
+        monkeypatch.setattr(ffn_mod, "fused_ffn", boom)
+        monkeypatch.setenv("PADDLE_TPU_FUSED_FFN", "1")
+
+        c = GPTConfig(hidden_size=128, intermediate_size=256, num_layers=2)
+        paddle.seed(14)
+        mlp = GPTMLP(c)
+        x = paddle.to_tensor(np.random.RandomState(3).randn(
+            2, 8, 128).astype(np.float32))
+        out = mlp(x)   # must take the composite, not raise
+        assert np.isfinite(np.asarray(out._data)).all()
+
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+        rng = np.random.RandomState(6)
+        w1 = paddle.to_tensor((rng.randn(128, 256) * .05).astype(np.float32))
+        b1 = paddle.to_tensor(np.zeros(256, np.float32))
+        w2 = paddle.to_tensor((rng.randn(256, 128) * .05).astype(np.float32))
+        b2 = paddle.to_tensor(np.zeros(128, np.float32))
+        out2 = fused_feedforward(x, w1, w2, b1, b2, dropout1_rate=0.0,
+                                 dropout2_rate=0.0, activation="gelu",
+                                 pre_layer_norm=False)
+        assert np.isfinite(np.asarray(out2._data)).all()

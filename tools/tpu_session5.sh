@@ -143,6 +143,12 @@ commit_phase bench_decode
 run bench_decode_i8 900 env PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_i8
 
+# 2c. Cache-backed beam search ratchet (r5: beams share the prefill
+#     cache, per-step reorder is one compiled gather). TP-sharded kernel
+#     decode (also r5) cannot A/B here: mp>=2 needs more than one chip.
+run bench_decode_beam 900 env BENCH_BEAMS=4 python bench_decode.py
+commit_phase bench_decode_beam
+
 # 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
 #    vs XLA composite, few steps each, scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
