@@ -156,6 +156,12 @@ commit_phase ffn_ab_composite BENCH_RESULT.json
 run ffn_ab_fused 1200 env PADDLE_TPU_FUSED_FFN=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
 commit_phase ffn_ab_fused BENCH_RESULT.json
 
+# 3b. fwd+bwd fused FFN (r5: two-kernel Pallas backward keeps pre/t/dt/
+#     dpre out of HBM) — the train-step A/B row verdict #5 asks for:
+#     composite vs fwd-only vs fwd+bwd.
+run ffn_ab_fwdbwd 1200 env PADDLE_TPU_FUSED_FFN=1 PADDLE_TPU_FUSED_FFN_BWD=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
+commit_phase ffn_ab_fwdbwd BENCH_RESULT.json
+
 # 4. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
 run vit_matmul 1200 env BENCH_ONLY=vit python bench.py
 commit_phase vit_matmul BENCH_RESULT.json
