@@ -124,6 +124,15 @@ class Engine:
             w1, w2 = lins[0].weight, lins[1].weight
             if w1.shape[1] != w2.shape[0]:
                 continue        # not a chained pair
+            # declaration order is not dataflow order: for an FFN-shaped
+            # pair ([K, F] expand, [F, K] contract — both chain either
+            # way) orient so the EXPANDING Linear takes the column
+            # placement (the Megatron rule); reversed orientation would
+            # silently apply the 2x-worse plan while logging the cheap
+            # name. Square pairs keep declaration order.
+            if int(w1.shape[1]) < int(w1.shape[0]) and \
+                    int(w2.shape[1]) > int(w2.shape[0]):
+                w1, w2 = w2, w1
             k = int(w1.shape[0])
             itemsize = w1._data.dtype.itemsize
             act_bytes = tokens * k * itemsize
@@ -141,15 +150,32 @@ class Engine:
             best = min(valid, key=lambda nm: valid[nm]
                        ["comm_bytes_per_step"])
             plan = valid[best]
-            moved = 0
+            moved, done = 0, []
             for w, spec in ((w1, plan["w1"]), (w2, plan["w2"])):
                 try:
                     w._data = jax.device_put(
                         w._data, NamedSharding(mesh, spec))
                 except Exception:
-                    continue
+                    break
                 w.sharding_spec = spec
                 moved += int(w._data.nbytes)
+                done.append(w)
+            if len(done) != 2:
+                # half-applied placement is worse than none (the log
+                # would claim a memory win reality doesn't have): roll
+                # back the half that landed and record the failure
+                for w in done:
+                    try:
+                        w._data = jax.device_put(
+                            w._data, NamedSharding(mesh, P()))
+                    except Exception:
+                        pass
+                    w.sharding_spec = None
+                self._reshard_log.append({
+                    "decision": "mp_placement:failed", "block": name,
+                    "why": "device_put failed mid-pair; rolled back"})
+                del self._reshard_log[:-1000]
+                continue
             from .api import bump_placement_generation
             bump_placement_generation()
             pair_bytes = int(w1._data.nbytes) + int(w2._data.nbytes)
@@ -165,6 +191,7 @@ class Engine:
                         + ", ".join(f"{nm}={c['comm_bytes_per_step']}"
                                     for nm, c in valid.items()
                                     if nm != best) + ")")})
+            del self._reshard_log[:-1000]
             n_sharded += 1
         return n_sharded
 

@@ -243,7 +243,11 @@ class FusedDecoder:
         # in HBM until the next restack completed (r4 verdict weak #7).
         import weakref
         version = [p._data for p in f.parameters()]
+        # trace-time env state is part of the cache identity: flipping
+        # the weight-quant flag must rebuild the stack, not reuse it
+        env_sig = os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1"
         if self._stk_cache is not None and \
+                self._stk_cache[2] == env_sig and \
                 len(self._stk_cache[0]) == len(version) and \
                 all(r() is b for r, b in zip(self._stk_cache[0], version)):
             return self._stk_cache[1]
@@ -261,13 +265,45 @@ class FusedDecoder:
             "f1_w": stk(f.ffn1_weights), "f1_b": stk(f.ffn1_biases),
             "f2_w": stk(f.ffn2_weights), "f2_b": stk(f.ffn2_biases),
         }
+        if env_sig:
+            # weight-only int8 decode (reference: Predictor's weight-only
+            # mode applied to the fused decode stack): at decode batch
+            # sizes the step is WEIGHT-traffic bound (~2 bytes/param/token
+            # in bf16 — ~250 MB/token for GPT-2-124M), so int8 storage
+            # halves the dominant HBM stream. Per-(layer, out-channel)
+            # absmax scales over the contracted axis; dequant is applied
+            # AFTER each dot as a per-column scale (exact factoring: the
+            # int values are exact in bf16, products accumulate fp32), so
+            # no dequantized weight copy ever materializes. LN params,
+            # biases, embed and LM head stay fp.
+            def q_left(w3):          # used as h @ W.T: [L, O, I]
+                a = w3.astype(jnp.float32)
+                s = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0
+                q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
+                             -127, 127).astype(jnp.int8)
+                return q, jnp.swapaxes(s, -1, -2)     # [L, 1, O]
+
+            def q_right(w3):         # used as h @ W: [L, I, O]
+                a = w3.astype(jnp.float32)
+                s = jnp.max(jnp.abs(a), axis=1, keepdims=True) / 127.0
+                q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
+                             -127, 127).astype(jnp.int8)
+                return q, s                           # [L, 1, O]
+
+            nl = out["qkv_w"].shape[0]
+            emb = out["qkv_w"].shape[-1]
+            out["qkv_w"], out["qkv_w_s"] = q_left(
+                out["qkv_w"].reshape(nl, -1, emb))
+            out["lin_w"], out["lin_w_s"] = q_right(out["lin_w"])
+            out["f1_w"], out["f1_w_s"] = q_right(out["f1_w"])
+            out["f2_w"], out["f2_w_s"] = q_right(out["f2_w"])
         try:
             anchors = [weakref.ref(a) for a in version]
         except TypeError:
             # non-weakrefable leaves (shouldn't happen for jax arrays):
             # degrade to always-rebuild rather than pin
             anchors = [(lambda: None)] * len(version)
-        self._stk_cache = (anchors, out)
+        self._stk_cache = (anchors, out, env_sig)
         return out
 
     @staticmethod
@@ -598,12 +634,26 @@ class FusedDecoder:
             return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
         def layer_step(x, p, caches, l, t):
+            quant_w = "qkv_w_s" in p
+
+            def mm(a, w, s=None):
+                # weight-only int8: dot on the exact int-valued weights
+                # (bf16-exact in [-127, 127], fp32 accumulation), then
+                # the per-out-channel dequant scale on the [B, O] result
+                out_ = a @ w.astype(a.dtype)
+                return out_ * s.astype(a.dtype) if s is not None else out_
+
             residual = x
             h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
             emb = h.shape[-1]
-            w = p["qkv_w"].reshape(3 * nh * hd, emb).T
-            qkv = h @ w.astype(h.dtype) + \
-                p["qkv_b"].reshape(-1).astype(h.dtype)
+            if quant_w:
+                # pre-reshaped to [O, I] at stack time
+                qkv = mm(h, p["qkv_w"].T, p["qkv_w_s"]) + \
+                    p["qkv_b"].reshape(-1).astype(h.dtype)
+            else:
+                w = p["qkv_w"].reshape(3 * nh * hd, emb).T
+                qkv = h @ w.astype(h.dtype) + \
+                    p["qkv_b"].reshape(-1).astype(h.dtype)
             b = h.shape[0]
             qkv = qkv.reshape(b, 1, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -638,16 +688,16 @@ class FusedDecoder:
                     (l, 0, 0, 0, t, 0))
             attn = attend(q, caches, l, t)
             attn = attn.reshape(b, 1, nh * hd)
-            attn = attn @ p["lin_w"].astype(attn.dtype) + \
+            attn = mm(attn, p["lin_w"], p.get("lin_w_s")) + \
                 p["lin_b"].astype(attn.dtype)
             x = residual + attn
             if not pre_ln:
                 x = ln(x, p["ln_s"], p["ln_b"])
             residual = x
             h = ln(x, p["fln_s"], p["fln_b"]) if pre_ln else x
-            h = h @ p["f1_w"].astype(h.dtype) + p["f1_b"].astype(h.dtype)
+            h = mm(h, p["f1_w"], p.get("f1_w_s")) + p["f1_b"].astype(h.dtype)
             h = getattr(jax.nn, act)(h)
-            h = h @ p["f2_w"].astype(h.dtype) + p["f2_b"].astype(h.dtype)
+            h = mm(h, p["f2_w"], p.get("f2_w_s")) + p["f2_b"].astype(h.dtype)
             x = residual + h
             if not pre_ln:
                 x = ln(x, p["fln_s"], p["fln_b"])

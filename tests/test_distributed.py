@@ -846,3 +846,35 @@ class TestPlacementSearch:
         eng.fit(ds, epochs=1, batch_size=8)
         assert np.isfinite(eng._history.history["loss"][-1]
                            if hasattr(eng, "_history") else 0.0)
+
+    def test_reversed_declaration_order_still_col_shards_expander(self):
+        """Review r5: declaration order is not dataflow order — the
+        EXPANDING Linear must take the column placement regardless of
+        which attribute was declared first."""
+        from paddle_tpu.distributed.auto_parallel import (Engine,
+                                                          ProcessMesh,
+                                                          set_mesh)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "mp"])
+        set_mesh(mesh)
+        paddle.seed(36)
+
+        class RevFFN(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc2 = paddle.nn.Linear(64, 16)   # declared FIRST
+                self.fc1 = paddle.nn.Linear(16, 64)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.gelu(self.fc1(x)))
+
+        model = RevFFN()
+        eng = Engine(model, lambda o, y: ((o - y) ** 2).mean(),
+                     paddle.optimizer.AdamW(
+                         1e-2, parameters=model.parameters()))
+        assert eng.search_mp_placements((8,), mp_axis="mp") == 1
+        # expander fc1 [16, 64] column-sharded; contractor fc2 row-sharded
+        s1 = {s.data.shape for s in model.fc1.weight._data.addressable_shards}
+        s2 = {s.data.shape for s in model.fc2.weight._data.addressable_shards}
+        assert s1 == {(16, 16)}, s1
+        assert s2 == {(16, 16)}, s2

@@ -9,6 +9,7 @@ import pytest
 
 import paddle_tpu as paddle
 import jax
+import jax.numpy as jnp
 
 from paddle_tpu.incubate.nn import FusedMultiTransformer
 from paddle_tpu.inference.generation import (generate, generate_fused,
@@ -326,3 +327,56 @@ class TestBeamOverCache:
             generate_fused(m.fmt, paddle.to_tensor(_prompt()),
                            embed=m.embed, head=m.head, num_beams=2,
                            do_sample=True)
+
+
+class TestInt8Weights:
+    def test_int8_weight_decode_matches_fp(self, monkeypatch):
+        """PADDLE_TPU_DECODE_INT8_WEIGHTS=1 (reference: Predictor's
+        weight-only int8 applied to the fused decode stack): greedy
+        tokens must match the fp-weight run on a well-separated-logits
+        model — per-out-channel absmax noise must not flip argmax."""
+        paddle.seed(26)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=15)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", raising=False)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_int8_weights_compose_with_int8_cache_and_beams(
+            self, monkeypatch):
+        """Both quant modes on simultaneously, under beam search — the
+        full serving-lever stack must still match the fp beam run."""
+        paddle.seed(27)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=17)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", raising=False)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_CACHE", raising=False)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6, num_beams=3)
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6, num_beams=3)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_env_flip_rebuilds_stack(self, monkeypatch):
+        """The stacked-param cache is keyed on the quant env flag: a flip
+        must rebuild (old behavior would silently reuse the fp stack)."""
+        from paddle_tpu.inference.generation import FusedDecoder
+        paddle.seed(28)
+        m = TinyFusedLM()
+        dec = FusedDecoder(m.fmt, m.embed, m.head, max_seq_len=32)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", raising=False)
+        s_fp = dec._stacked()
+        assert "qkv_w_s" not in s_fp
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
+        s_q = dec._stacked()
+        assert "qkv_w_s" in s_q and s_q["qkv_w"].dtype == jnp.int8

@@ -149,6 +149,14 @@ commit_phase bench_decode_i8
 run bench_decode_beam 900 env BENCH_BEAMS=4 python bench_decode.py
 commit_phase bench_decode_beam
 
+# 2d. Weight-only int8 decode (r5: halves the ~250 MB/token weight
+#     stream — the dominant decode traffic at small batch). Also the
+#     combined int8 weights + int8 cache mode (full serving stack).
+run bench_decode_w8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 python bench_decode.py
+commit_phase bench_decode_w8
+run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
+commit_phase bench_decode_w8c8
+
 # 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
 #    vs XLA composite, few steps each, scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
