@@ -82,6 +82,41 @@ class Engine:
                 pass
         self._placed = True
 
+    def _log(self, entry):
+        """Append to the per-engine reshard log under the shared
+        1000-entry bound (one place owns the cap)."""
+        self._reshard_log.append(entry)
+        del self._reshard_log[:-1000]
+
+    @staticmethod
+    def _probe_pair_order(sub, lins):
+        """Determine a Linear pair's DATAFLOW order by running the owning
+        block's forward on a dummy batch with forward-pre hooks recording
+        which Linear fires first. Returns (ordered_pair | None,
+        'probed' | 'heuristic'). The dummy's feature dim is tried from
+        both candidates' in_features (a wrong guess shape-errors and the
+        other is tried)."""
+        import numpy as _np
+        order: list = []
+        handles = [lin.register_forward_pre_hook(
+            lambda layer, inp: order.append(layer)) for lin in lins]
+        try:
+            for first in lins:
+                order.clear()
+                dummy = Tensor(_np.zeros(
+                    (2, int(first.weight.shape[0])), _np.float32))
+                try:
+                    with no_grad():
+                        sub(dummy)
+                except Exception:
+                    continue
+                if len(order) >= 2 and order[0] is not order[1]:
+                    return [order[0], order[1]], "probed"
+        finally:
+            for h in handles:
+                h.remove()
+        return None, "heuristic"
+
     # ------------------------------------------------- placement search
     def search_mp_placements(self, sample_batch_shape, mp_axis="mp"):
         """Placement SEARCH over candidate model-parallel shardings (r5
@@ -124,13 +159,17 @@ class Engine:
             w1, w2 = lins[0].weight, lins[1].weight
             if w1.shape[1] != w2.shape[0]:
                 continue        # not a chained pair
-            # declaration order is not dataflow order: for an FFN-shaped
-            # pair ([K, F] expand, [F, K] contract — both chain either
-            # way) orient so the EXPANDING Linear takes the column
-            # placement (the Megatron rule); reversed orientation would
-            # silently apply the 2x-worse plan while logging the cheap
-            # name. Square pairs keep declaration order.
-            if int(w1.shape[1]) < int(w1.shape[0]) and \
+            # declaration order is not dataflow order, and shapes alone
+            # cannot distinguish a reversed FFN from an in-order
+            # bottleneck ([K,F],[F,K] chains either way). PROBE the real
+            # order: forward-pre hooks on both Linears + a dummy forward
+            # of the owning block record which fires first. Only when the
+            # probe fails fall back to the expander-first heuristic —
+            # and say so in the log instead of asserting the cheap name.
+            ordered, orientation = self._probe_pair_order(sub, lins)
+            if ordered is not None:
+                w1, w2 = ordered[0].weight, ordered[1].weight
+            elif int(w1.shape[1]) < int(w1.shape[0]) and \
                     int(w2.shape[1]) > int(w2.shape[0]):
                 w1, w2 = w2, w1
             k = int(w1.shape[0])
@@ -150,6 +189,11 @@ class Engine:
             best = min(valid, key=lambda nm: valid[nm]
                        ["comm_bytes_per_step"])
             plan = valid[best]
+            # snapshot for exact rollback: restoring the saved arrays
+            # restores the PRE-ATTEMPT placement (which may itself have
+            # been sharded by an earlier pass — forcing P() would
+            # destroy it)
+            snap = [(w, w._data, w.sharding_spec) for w in (w1, w2)]
             moved, done = 0, []
             for w, spec in ((w1, plan["w1"]), (w2, plan["w2"])):
                 try:
@@ -160,27 +204,27 @@ class Engine:
                 w.sharding_spec = spec
                 moved += int(w._data.nbytes)
                 done.append(w)
+            from .api import bump_placement_generation
             if len(done) != 2:
                 # half-applied placement is worse than none (the log
-                # would claim a memory win reality doesn't have): roll
-                # back the half that landed and record the failure
-                for w in done:
-                    try:
-                        w._data = jax.device_put(
-                            w._data, NamedSharding(mesh, P()))
-                    except Exception:
-                        pass
-                    w.sharding_spec = None
-                self._reshard_log.append({
+                # would claim a memory win reality doesn't have):
+                # restore the pre-attempt state exactly, and bump the
+                # generation anyway — a weight may have moved and moved
+                # back, and plan caches must not assume nothing changed
+                for w, data, spec in snap:
+                    w._data = data
+                    w.sharding_spec = spec
+                bump_placement_generation()
+                self._log({
                     "decision": "mp_placement:failed", "block": name,
-                    "why": "device_put failed mid-pair; rolled back"})
-                del self._reshard_log[:-1000]
+                    "why": "device_put failed mid-pair; restored "
+                           "pre-attempt placements"})
                 continue
-            from .api import bump_placement_generation
             bump_placement_generation()
             pair_bytes = int(w1._data.nbytes) + int(w2._data.nbytes)
-            self._reshard_log.append({
+            self._log({
                 "decision": f"mp_placement:{best}", "block": name,
+                "orientation": orientation,
                 "candidates": {nm: c["comm_bytes_per_step"]
                                for nm, c in valid.items()},
                 "comm_bytes_per_step": plan["comm_bytes_per_step"],
@@ -190,8 +234,11 @@ class Engine:
                         f"({plan['comm_bytes_per_step']} vs "
                         + ", ".join(f"{nm}={c['comm_bytes_per_step']}"
                                     for nm, c in valid.items()
-                                    if nm != best) + ")")})
-            del self._reshard_log[:-1000]
+                                    if nm != best)
+                        + ("; orientation probed from dataflow" if
+                           orientation == "probed" else
+                           "; orientation ASSUMED by shape heuristic")
+                        + ")")})
             n_sharded += 1
         return n_sharded
 
@@ -253,7 +300,7 @@ class Engine:
         param_bytes = sum(int(p._data.nbytes) for p in conflicts)
         plan = ("reshard_input" if input_bytes <= param_bytes
                 else "reshard_params")
-        self._reshard_log.append({
+        self._log({
             "decision": plan, "axis": ax,
             "input_bytes": input_bytes, "param_bytes": param_bytes,
             "conflicting_params": len(conflicts)})
@@ -267,19 +314,18 @@ class Engine:
                     failed += 1
                     continue   # still sharded: keep spec + no log
                 p.sharding_spec = None
-                self._reshard_log.append({
+                self._log({
                     "shape": tuple(p.shape), "from": "annotated",
                     "to": "P()", "bytes_moved": int(p._data.nbytes)})
             if failed:
                 attempts = self._strip_attempts = getattr(
                     self, "_strip_attempts", 0) + 1
-                self._reshard_log.append({
+                self._log({
                     "decision": plan, "strip_failed": failed,
                     "attempt": attempts,
                     "note": "plan not cached; retried next batch"
                     if attempts < 3 else
                     "giving up after 3 attempts; conflict unrepaired"})
-                del self._reshard_log[:-1000]
                 if attempts >= 3:   # bound the per-step rescan + log
                     self._conflict_plan[key] = plan
         if not failed:
@@ -303,10 +349,9 @@ class Engine:
             # cost-log only true reshards — a mesh-committed input whose
             # placement disagreed — not routine host→device feeding
             if moved and isinstance(cur, NamedSharding):
-                self._reshard_log.append({
+                self._log({
                     "shape": tuple(np.shape(arr)), "from": str(cur.spec),
                     "to": str(spec), "bytes_moved": moved})
-                del self._reshard_log[:-1000]   # same bound as the module log
             return out
         return arr
 

@@ -878,3 +878,39 @@ class TestPlacementSearch:
         s2 = {s.data.shape for s in model.fc2.weight._data.addressable_shards}
         assert s1 == {(16, 16)}, s1
         assert s2 == {(16, 16)}, s2
+
+    def test_bottleneck_pair_probed_orientation(self):
+        """Review r5 round 2: an adapter/bottleneck block (contract THEN
+        expand, declared in dataflow order) must not be mis-oriented by
+        a shape heuristic — the probe reads the real dataflow, so the
+        DOWN projection (first in flow) gets the column placement."""
+        from paddle_tpu.distributed.auto_parallel import (Engine,
+                                                          ProcessMesh,
+                                                          set_mesh)
+        mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                           dim_names=["dp", "mp"])
+        set_mesh(mesh)
+        paddle.seed(37)
+
+        class Adapter(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.down = paddle.nn.Linear(64, 16)
+                self.up = paddle.nn.Linear(16, 64)
+
+            def forward(self, x):
+                return self.up(paddle.nn.functional.gelu(self.down(x)))
+
+        model = Adapter()
+        eng = Engine(model, lambda o, y: ((o - y) ** 2).mean(),
+                     paddle.optimizer.AdamW(
+                         1e-2, parameters=model.parameters()))
+        assert eng.search_mp_placements((8,), mp_axis="mp") == 1
+        dec = [r for r in eng.reshard_cost_log
+               if str(r.get("decision", "")).startswith("mp_placement")]
+        assert dec[0]["orientation"] == "probed"
+        # down [64, 16] is first in dataflow -> column (out axis) shard
+        sd = {s.data.shape for s in model.down.weight._data.addressable_shards}
+        su = {s.data.shape for s in model.up.weight._data.addressable_shards}
+        assert sd == {(64, 4)}, sd       # [64, 16/4]
+        assert su == {(4, 64)}, su       # [16/4, 64]
