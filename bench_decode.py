@@ -90,6 +90,8 @@ def main():
             "PADDLE_TPU_DECODE_INT8_CACHE") == "1" else "fp"),
         "weight_mode": ("int8" if os.environ.get(
             "PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1" else "fp"),
+        "head_mode": ("int8" if os.environ.get(
+            "PADDLE_TPU_DECODE_INT8_HEAD") == "1" else "fp"),
         "attention_path": ("dense-fallback" if os.environ.get(
             "PADDLE_TPU_STACKED_KERNEL") == "0" else "stacked"),
         "num_beams": max(beams, 1),

@@ -100,6 +100,14 @@ class Engine:
         order: list = []
         handles = [lin.register_forward_pre_hook(
             lambda layer, inp: order.append(layer)) for lin in lins]
+        # probe in EVAL mode: no_grad() does not stop buffer updates — a
+        # train-mode BatchNorm between the Linears would blend its
+        # running stats toward the zero dummy, and dropout would consume
+        # global RNG draws. Restore each layer's own flag afterwards
+        # (states may be mixed).
+        modes = [(lay, lay.training)
+                 for lay in sub.sublayers(include_self=True)]
+        sub.eval()
         try:
             for first in lins:
                 order.clear()
@@ -115,6 +123,8 @@ class Engine:
         finally:
             for h in handles:
                 h.remove()
+            for lay, was in modes:
+                lay.training = was
         return None, "heuristic"
 
     # ------------------------------------------------- placement search

@@ -28,6 +28,18 @@ from ..tensor.tensor import Tensor, no_grad
 __all__ = ["generate", "generate_fused", "FusedDecoder"]
 
 
+def _absmax_int8(w, axis):
+    """Per-slice absmax int8 quantization (one recipe for ALL weight-only
+    quant sites: layer stacks + LM head): scales = absmax/127 over the
+    CONTRACTED axis with a zero-column guard; values clip/round to int8.
+    Returns (int8 array, fp32 scales with the reduced axis kept)."""
+    a = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(a), axis=axis, keepdims=True) / 127.0
+    q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
 def _filter_logits(logits, do_sample, top_k, top_p, temperature):
     if not do_sample:
         return logits
@@ -277,18 +289,11 @@ class FusedDecoder:
             # no dequantized weight copy ever materializes. LN params,
             # biases, embed and LM head stay fp.
             def q_left(w3):          # used as h @ W.T: [L, O, I]
-                a = w3.astype(jnp.float32)
-                s = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0
-                q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
-                             -127, 127).astype(jnp.int8)
+                q, s = _absmax_int8(w3, -1)
                 return q, jnp.swapaxes(s, -1, -2)     # [L, 1, O]
 
             def q_right(w3):         # used as h @ W: [L, I, O]
-                a = w3.astype(jnp.float32)
-                s = jnp.max(jnp.abs(a), axis=1, keepdims=True) / 127.0
-                q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
-                             -127, 127).astype(jnp.int8)
-                return q, s                           # [L, 1, O]
+                return _absmax_int8(w3, 1)            # scales [L, 1, O]
 
             nl = out["qkv_w"].shape[0]
             emb = out["qkv_w"].shape[-1]
@@ -304,6 +309,28 @@ class FusedDecoder:
             # degrade to always-rebuild rather than pin
             anchors = [(lambda: None)] * len(version)
         self._stk_cache = (anchors, out, env_sig)
+        return out
+
+    def _maybe_quant_head(self, h_arrays):
+        """PADDLE_TPU_DECODE_INT8_HEAD=1 + plain Linear head: return
+        [W_int8, scales(, bias)] with per-out-channel (vocab column)
+        absmax scales — head_logits detects the structure and applies
+        dequant after the dot. Cached on (env flag, weight identity);
+        non-Linear heads pass through untouched (call_layerlike path)."""
+        from ..nn.layer.common import Linear
+        if os.environ.get("PADDLE_TPU_DECODE_INT8_HEAD") != "1" or \
+                type(self.head) is not Linear or not h_arrays:
+            return h_arrays
+        import weakref
+        cached = getattr(self, "_head_q_cache", None)
+        if cached is not None and len(cached[0]) == len(h_arrays) and \
+                all(r() is a for r, a in zip(cached[0], h_arrays)):
+            return cached[1]
+        q, s = _absmax_int8(h_arrays[0], 0)            # weight [E, V]
+        out = [q, s] + list(h_arrays[1:])
+        # key on EVERY source array (a bias-only swap must invalidate,
+        # not serve the stale cached bias)
+        self._head_q_cache = ([weakref.ref(a) for a in h_arrays], out)
         return out
 
     @staticmethod
@@ -419,11 +446,10 @@ class FusedDecoder:
         Mirrors _beam_search's first iteration (scores [0, -inf...] make
         the K picks come from beam 0's distribution)."""
         core = self._build_step_core(False, 0, 1.0, 1.0)
-        call_layerlike = core.call_layerlike
-        head, h_params = self.head, self._head_params
+        head_logits = core.head_logits
 
         def init(h_arrays, last_x):
-            logits = call_layerlike(head, h_params, h_arrays, last_x)
+            logits = head_logits(h_arrays, last_x)
             logits = logits.reshape(logits.shape[0], -1)
             b, v = logits.shape
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
@@ -453,8 +479,7 @@ class FusedDecoder:
         at zero added score; GNMT length penalty at finish admission)."""
         core = self._build_step_core(False, 0, 1.0, 1.0)
         hidden = core.hidden
-        call_layerlike = core.call_layerlike
-        head, h_params = self.head, self._head_params
+        head_logits = core.head_logits
 
         def beam_chunk(stk, e_arrays, h_arrays, caches, tok_flat, t0,
                        scores, finished, gen_len):
@@ -464,7 +489,7 @@ class FusedDecoder:
                 caches, tok_flat, scores, finished, gen_len = carry
                 x, caches = hidden(stk, e_arrays, caches, tok_flat,
                                    t0 + i)
-                logits = call_layerlike(head, h_params, h_arrays, x)
+                logits = head_logits(h_arrays, x)
                 logits = logits.reshape(b * kk, -1)
                 v = logits.shape[-1]
                 logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
@@ -742,8 +767,26 @@ class FusedDecoder:
                 body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
             return x, caches
 
+        def head_logits(h_arrays, x_arr):
+            # weight-only int8 LM head (PADDLE_TPU_DECODE_INT8_HEAD):
+            # h_arrays arrives as [W_int8, scales(, bias...)] from
+            # _maybe_quant_head — detect by dtype (trace-time python,
+            # retraced per pytree structure) and apply the same
+            # dequant-after-dot factoring as the layer stacks. The head
+            # read (~[E, V], 77 MB/token bf16 for GPT-2) is the largest
+            # single stream of the decode step.
+            if h_arrays and getattr(h_arrays[0], "dtype", None) == \
+                    jnp.int8:
+                w_q, s = h_arrays[0], h_arrays[1]
+                out = (x_arr @ w_q.astype(x_arr.dtype)) * \
+                    s.astype(x_arr.dtype)
+                if len(h_arrays) > 2:
+                    out = out + h_arrays[2].astype(out.dtype)
+                return out
+            return call_layerlike(head, h_params, h_arrays, x_arr)
+
         def sample_head(h_arrays, x, key):
-            logits = call_layerlike(head, h_params, h_arrays, x)
+            logits = head_logits(h_arrays, x)
             logits = logits.reshape(logits.shape[0], -1)
             logits = _filter_logits(logits, do_sample, top_k, top_p,
                                     temperature)
@@ -760,6 +803,7 @@ class FusedDecoder:
         step.hidden = hidden
         step.sample_head = sample_head
         step.call_layerlike = call_layerlike
+        step.head_logits = head_logits
         return step
 
     def _generate_beam(self, ids, last_x, caches, stk, e_arrays, h_arrays,
@@ -876,7 +920,8 @@ class FusedDecoder:
         # decode), then ONE jitted head+sample on the final hidden state
         stk = self._stacked()
         e_arrays = [p._data for p in self._embed_params]
-        h_arrays = [p._data for p in self._head_params]
+        h_arrays = self._maybe_quant_head(
+            [p._data for p in self._head_params])
         caches = self.init_cache(b)
         toks_tm = jnp.swapaxes(ids.astype(jnp.int32), 0, 1)  # [S, B]
         mesh_now = self._mesh_mp()

@@ -380,3 +380,61 @@ class TestInt8Weights:
         monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
         s_q = dec._stacked()
         assert "qkv_w_s" in s_q and s_q["qkv_w"].dtype == jnp.int8
+
+
+class TestInt8Head:
+    def test_int8_head_logits_near_exact_tokens_agree(self, monkeypatch):
+        """PADDLE_TPU_DECODE_INT8_HEAD=1: the LM head (the largest single
+        weight stream of the decode step) quantizes per vocab column.
+        Unlike the cache/weight modes (whose noise washes through layer
+        norms), head quant perturbs LOGITS directly, so on a random tiny
+        model with near-uniform logits exact argmax match is not the
+        contract — assert logits cosine ~1 and high token agreement."""
+        paddle.seed(29)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=19)
+        monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_HEAD", raising=False)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_HEAD", "1")
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8)
+        a, b = np.asarray(out._data), np.asarray(ref._data)
+        agree = float((a == b).mean())
+        assert agree >= 0.75, f"token agreement {agree}"
+        # logits-level: dequantized head is near-exact
+        from paddle_tpu.inference.generation import FusedDecoder
+        dec = FusedDecoder(m.fmt, m.embed, m.head, max_seq_len=32)
+        w = m.head.weight._data.astype(jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 1, w.shape[0]),
+                        jnp.float32)
+        qa = dec._maybe_quant_head([m.head.weight._data])
+        assert qa[0].dtype == jnp.int8
+        lq = (x @ qa[0].astype(x.dtype)) * qa[1].astype(x.dtype)
+        lf = x @ w
+        cos = float(jnp.sum(lq * lf) /
+                    (jnp.linalg.norm(lq) * jnp.linalg.norm(lf)))
+        assert cos > 0.9995, cos
+
+    def test_full_int8_serving_stack_beams(self, monkeypatch):
+        """Weights + cache quant under beam search — the exact-match
+        half of the serving stack (head quant perturbs logits directly;
+        its contract is the agreement test above)."""
+        paddle.seed(30)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=21)
+        for k in ("PADDLE_TPU_DECODE_INT8_HEAD",
+                  "PADDLE_TPU_DECODE_INT8_CACHE",
+                  "PADDLE_TPU_DECODE_INT8_WEIGHTS"):
+            monkeypatch.delenv(k, raising=False)
+        ref = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6, num_beams=3)
+        for k in ("PADDLE_TPU_DECODE_INT8_CACHE",
+                  "PADDLE_TPU_DECODE_INT8_WEIGHTS"):
+            monkeypatch.setenv(k, "1")
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=6, num_beams=3)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))

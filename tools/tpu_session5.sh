@@ -157,6 +157,12 @@ commit_phase bench_decode_w8
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_w8c8
 
+# 2e. Full int8 serving stack incl. the LM head (the largest single
+#     stream: [E, V] ~77 MB/token bf16 at GPT-2 shape). Head quant
+#     perturbs logits (tokens may differ); the ratchet metric is tok/s.
+run bench_decode_full8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 PADDLE_TPU_DECODE_INT8_HEAD=1 python bench_decode.py
+commit_phase bench_decode_full8
+
 # 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
 #    vs XLA composite, few steps each, scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
