@@ -114,9 +114,9 @@ run() {  # run <name> <timeout_s> <cmd...>  — then caller commit_phase's
   return 0
 }
 
-# 1. Ring-chunk kernel first on-chip validation (carried from r3 s4;
-#    still never Mosaic-compiled).
-run ring_kernel 600 python - <<'EOF'
+# 1. Ring-chunk kernel on-chip validation (carried from r3 s4; quick,
+#    and the first Mosaic compile of the kernel family de-risks the rest).
+run ring_kernel 600 python - <<'XEOF'
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.ops.pallas.ring_chunk_attention import ring_chunk_attention
 B,H,Hk,S,D = 2,8,4,512,64
@@ -130,7 +130,7 @@ for off in (S, 0, -S//2):
     print("off", off, "o_norm", float(jnp.linalg.norm(o.astype(jnp.float32))),
           "dq_norm", float(jnp.linalg.norm(g[0].astype(jnp.float32))))
 print("RING_KERNEL_OK")
-EOF
+XEOF
 commit_phase ring_kernel
 
 # 2. Decode ratchet with the in-place KV cache (scan-carried stacked
@@ -138,60 +138,56 @@ commit_phase ring_kernel
 run bench_decode 900 python bench_decode.py
 commit_phase bench_decode
 
-# 2b. int8-cache decode A/B (halves cache bytes/token — the bandwidth
-#     floor itself). Token parity with fp is CPU-asserted already.
+# 3. Full 5-config bench — the MFU-spread scoreboard; appends the window
+#    record to BENCH_tpu.json. Early: short windows must land this.
+run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
+cp BENCH_partial.json "$OUT/" 2>/dev/null
+commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
+
+# 4. int8 decode ladder: cache (halves KV stream), weights (halves the
+#    dominant ~250 MB/token weight stream), full stack incl. LM head.
 run bench_decode_i8 900 env PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_i8
-
-# 2c. Cache-backed beam search ratchet (r5: beams share the prefill
-#     cache, per-step reorder is one compiled gather). TP-sharded kernel
-#     decode (also r5) cannot A/B here: mp>=2 needs more than one chip.
-run bench_decode_beam 900 env BENCH_BEAMS=4 python bench_decode.py
-commit_phase bench_decode_beam
-
-# 2d. Weight-only int8 decode (r5: halves the ~250 MB/token weight
-#     stream — the dominant decode traffic at small batch). Also the
-#     combined int8 weights + int8 cache mode (full serving stack).
 run bench_decode_w8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 python bench_decode.py
 commit_phase bench_decode_w8
-run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
-commit_phase bench_decode_w8c8
-
-# 2e. Full int8 serving stack incl. the LM head (the largest single
-#     stream: [E, V] ~77 MB/token bf16 at GPT-2 shape). Head quant
-#     perturbs logits (tokens may differ); the ratchet metric is tok/s.
 run bench_decode_full8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 PADDLE_TPU_DECODE_INT8_HEAD=1 python bench_decode.py
 commit_phase bench_decode_full8
 
-# 3. Fused-FFN A/B at the headline shape (PADDLE_TPU_FUSED_FFN): kernel
-#    vs XLA composite, few steps each, scan off for clean per-step time.
+# 5. 1B single-chip: Adafactor (analytic ~7 GB state — expected to FIT,
+#    the >=1B single-chip row), then AdamW (expected RESOURCE_EXHAUSTED,
+#    recorded as the OOM half of verdict #7).
+run llama_1b_adafactor 2400 python tools/llama_1b.py --tpu --adafactor
+commit_phase llama_1b_adafactor LLAMA1B_tpu.json
+run llama_1b_adamw 1500 python tools/llama_1b.py --tpu
+commit_phase llama_1b_adamw LLAMA1B_tpu.json
+
+# 6. Long-context flash ratchet S=8k/16k (verdict missing #4).
+run longctx 900 python tools/longctx_bench.py
+commit_phase longctx
+
+# 7. Fused-FFN A/B at the headline shape: composite vs fwd-kernel vs
+#    fwd+bwd kernels (r5), scan off for clean per-step time.
 run ffn_ab_composite 1200 env BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
 commit_phase ffn_ab_composite BENCH_RESULT.json
 run ffn_ab_fused 1200 env PADDLE_TPU_FUSED_FFN=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
 commit_phase ffn_ab_fused BENCH_RESULT.json
-
-# 3b. fwd+bwd fused FFN (r5: two-kernel Pallas backward keeps pre/t/dt/
-#     dpre out of HBM) — the train-step A/B row verdict #5 asks for:
-#     composite vs fwd-only vs fwd+bwd.
 run ffn_ab_fwdbwd 1200 env PADDLE_TPU_FUSED_FFN=1 PADDLE_TPU_FUSED_FFN_BWD=1 BENCH_ONLY=none BENCH_SCAN=0 BENCH_STEPS=10 python bench.py
 commit_phase ffn_ab_fwdbwd BENCH_RESULT.json
 
-# 4. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
+# 8. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
 run vit_matmul 1200 env BENCH_ONLY=vit python bench.py
 commit_phase vit_matmul BENCH_RESULT.json
 run vit_conv 1200 env PADDLE_TPU_PATCH_CONV=1 BENCH_ONLY=vit python bench.py
 commit_phase vit_conv BENCH_RESULT.json
 
-# 5. Full 5-config bench — appends the window record to BENCH_tpu.json.
-run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
-cp BENCH_partial.json "$OUT/" 2>/dev/null
-commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
+# 9. Remaining decode ratchets: cache-backed beam search + w8c8 combo.
+#    (TP-sharded kernel decode cannot A/B here: mp>=2 needs >1 chip.)
+run bench_decode_beam 900 env BENCH_BEAMS=4 python bench_decode.py
+commit_phase bench_decode_beam
+run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
+commit_phase bench_decode_w8c8
 
-# 6. Long-context flash ratchet S=8k/16k.
-run longctx 900 python tools/longctx_bench.py
-commit_phase longctx
-
-# 6b. Laggard-config profiles: where do BERT's (24.6%) and llama's
+# 10. Laggard-config profiles: where do BERT's (24.6%) and llama's
 #     (42.1%) steps actually go? Ablation mode ranks fwd/bwd/opt parts.
 run prof_bert 1200 env PROF_MODEL=bert PROF_MODE=ablate python tools/tpu_profile.py
 commit_phase prof_bert
@@ -200,18 +196,9 @@ commit_phase prof_llama
 run prof_vit 1500 python tools/vit_profile.py
 commit_phase prof_vit
 
-# 7. Decode cost localization.
+# 11. Decode cost localization.
 run decode_profile 1500 python tools/decode_profile.py
 commit_phase decode_profile
-
-# 8. 1B single-chip: Adafactor first (analytic ~7 GB state — expected to
-#    FIT and produce the >=1B single-chip row), then the AdamW attempt
-#    (analytic 16.45 GB — expected RESOURCE_EXHAUSTED, recorded as the
-#    OOM half of r4 VERDICT #7... now r5 #7).
-run llama_1b_adafactor 2400 python tools/llama_1b.py --tpu --adafactor
-commit_phase llama_1b_adafactor LLAMA1B_tpu.json
-run llama_1b_adamw 1500 python tools/llama_1b.py --tpu
-commit_phase llama_1b_adamw LLAMA1B_tpu.json
 
 # --- completion marker -------------------------------------------------
 all=1
