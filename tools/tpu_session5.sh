@@ -45,6 +45,8 @@ PHASES=""   # registry, filled by run(); used for the ALL marker
 
 commit_phase() {  # commit_phase <name> [extra repo paths...]
   local name=$1; shift
+  # CPU rehearsals must never publish "tpu window" commits
+  [ "${BENCH_TPU_UNAVAILABLE:-0}" = "1" ] && return 0
   # only commit for a phase that EXECUTED in this pass — a done-skipped
   # phase must not sweep up a stale BENCH_RESULT.json some later
   # interrupted phase left dirty (mislabeled artifact in history)
