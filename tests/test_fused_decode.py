@@ -438,3 +438,35 @@ class TestInt8Head:
                              head=m.head, max_new_tokens=6, num_beams=3)
         np.testing.assert_array_equal(np.asarray(out._data),
                                       np.asarray(ref._data))
+
+
+class TestTPDecodeHLO:
+    @needs8
+    def test_mp_decode_compiles_without_gathering_cache(self):
+        """Compiled-HLO guard (pattern of test_moe_ep's all-to-all
+        assertion): with q/cache sharded over 'mp' on the head axis, the
+        shard_map'd stacked kernel must compile with ZERO all-gathers —
+        head-parallel attention needs no collectives, and an all-gather
+        would mean GSPMD replicated the cache (the exact failure the
+        shard_map path exists to prevent)."""
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.ops.pallas import decode_attention as da
+        L, b, h, d, smax = 2, 2, 4, 32, 128
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("mp",))
+        hsp = P(None, "mp", None, None)
+        csp = P(None, None, None, "mp", None, None)
+        fn = jax.jit(shard_map(
+            da.decode_attention_stacked, mesh=mesh,
+            in_specs=(hsp, csp, P(), P()), out_specs=hsp,
+            check_vma=False))
+        q = jax.ShapeDtypeStruct((b, h, 1, d), jnp.float32,
+                                 sharding=NamedSharding(mesh, hsp))
+        caches = jax.ShapeDtypeStruct((L, 2, b, h, smax, d), jnp.float32,
+                                      sharding=NamedSharding(mesh, csp))
+        lay = jax.ShapeDtypeStruct((), jnp.int32)
+        lens = jax.ShapeDtypeStruct((b,), jnp.int32)
+        hlo = fn.lower(q, caches, lay, lens).compile().as_text()
+        assert "all-gather" not in hlo, "cache was gathered/replicated"
+        assert "all-reduce" not in hlo.replace("all-reduce-scatter", "")
