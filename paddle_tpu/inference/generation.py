@@ -57,6 +57,30 @@ def _filter_logits(logits, do_sample, top_k, top_p, temperature):
     return logits
 
 
+def _penalize(logits, presence, repetition_penalty, nt, min_length, eos):
+    """Reference generate() logit controls (PaddleNLP GenerationMixin):
+    repetition_penalty divides positive / multiplies negative logits of
+    every token already in the context (prompt + generated), and
+    min_length suppresses eos until `nt` generated tokens exist. Pure
+    jnp — usable inside compiled decode steps."""
+    if repetition_penalty != 1.0 and presence is not None:
+        logits = jnp.where(
+            presence,
+            jnp.where(logits > 0, logits / repetition_penalty,
+                      logits * repetition_penalty),
+            logits)
+    if min_length and eos is not None:
+        logits = logits.at[:, eos].set(
+            jnp.where(nt < min_length, -1e30, logits[:, eos]))
+    return logits
+
+
+def _presence_from(ids, vocab):
+    p = jnp.zeros((ids.shape[0], vocab), bool)
+    rows = jnp.arange(ids.shape[0])[:, None]
+    return p.at[rows, ids].set(True)
+
+
 def _sample_next(logits, do_sample, top_k, top_p, temperature, key=None):
     """logits: [B, V] jnp array -> [B] int32 token ids."""
     if not do_sample:
@@ -70,7 +94,8 @@ def _sample_next(logits, do_sample, top_k, top_p, temperature, key=None):
 def generate(model, input_ids, max_new_tokens: int = 20,
              eos_token_id: Optional[int] = None, do_sample: bool = False,
              top_k: int = 0, top_p: float = 1.0, temperature: float = 1.0,
-             num_beams: int = 1, length_penalty: float = 1.0):
+             num_beams: int = 1, length_penalty: float = 1.0,
+             min_length: int = 0, repetition_penalty: float = 1.0):
     """Causal-LM generation; input_ids [B, S] Tensor/ndarray -> [B, S+T].
 
     Greedy by default; sampling with top-k/top-p/temperature when
@@ -86,17 +111,32 @@ def generate(model, input_ids, max_new_tokens: int = 20,
         if do_sample:
             raise ValueError("beam search (num_beams>1) is deterministic; "
                              "do_sample=True is not supported with it")
+        if min_length or repetition_penalty != 1.0:
+            raise NotImplementedError(
+                "min_length/repetition_penalty with beam search is not "
+                "supported; use greedy/sampling generation")
         return _beam_search(model, ids, max_new_tokens, eos_token_id,
                             num_beams, length_penalty)
     finished = jnp.zeros((ids.shape[0],), bool)
-    for _ in range(max_new_tokens):
+    presence = None
+    eos_i = None if eos_token_id is None else int(eos_token_id)
+    rep_on = repetition_penalty != 1.0
+    for nt in range(max_new_tokens):
         logits = model(Tensor(ids))
-        logits = logits._data if isinstance(logits, Tensor) else logits
-        nxt = _sample_next(logits[:, -1], do_sample, top_k, top_p,
+        logits = (logits._data if isinstance(logits, Tensor)
+                  else logits)[:, -1]
+        if min_length or rep_on:
+            if rep_on and presence is None:
+                presence = _presence_from(ids, logits.shape[-1])
+            logits = _penalize(logits, presence, repetition_penalty,
+                               nt, min_length, eos_i)
+        nxt = _sample_next(logits, do_sample, top_k, top_p,
                            temperature)
         if eos_token_id is not None:
             nxt = jnp.where(finished, eos_token_id, nxt)
             finished = finished | (nxt == eos_token_id)
+        if presence is not None:
+            presence = presence.at[jnp.arange(nxt.shape[0]), nxt].set(True)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
         if eos_token_id is not None and bool(jnp.all(finished)):
             break
@@ -368,30 +408,59 @@ class FusedDecoder:
         return None
 
     def _build_scan_step(self, do_sample, top_k, top_p, temperature,
-                         chunk, eos):
+                         chunk, eos, min_length=0, repetition_penalty=1.0):
         """chunk tokens per device program: lax.scan over the per-token
         step, KV cache + last token + finished mask in the carry. One host
         dispatch per chunk instead of per token — the decode-side analogue
         of jit.run_steps (the tunnel backend pays a round-trip per
         dispatch). eos is static (baked into the trace): finished rows keep
-        emitting eos on-device."""
+        emitting eos on-device. min_length / repetition_penalty apply
+        inside the compiled step (reference: generation's logit
+        processors); ONLY repetition_penalty needs the [B, V]
+        context-presence mask in the carry — min_length alone just
+        compares the generated count against the eos column."""
         core = self._build_step_core(do_sample, top_k, top_p, temperature)
+        rep_on = repetition_penalty != 1.0
+        pen_on = bool(min_length) or rep_on
+        hidden, head_logits = core.hidden, core.head_logits
+
+        def next_token(stk, e_arrays, h_arrays, caches, tok, t, key,
+                       presence, nt):
+            if not pen_on:
+                return core(stk, e_arrays, h_arrays, caches, tok, t, key)
+            x, caches = hidden(stk, e_arrays, caches, tok, t)
+            logits = head_logits(h_arrays, x)
+            logits = logits.reshape(logits.shape[0], -1)
+            logits = _penalize(logits, presence if rep_on else None,
+                               repetition_penalty, nt, min_length, eos)
+            return _sample_next(logits, do_sample, top_k, top_p,
+                                temperature, key), caches
 
         def scan_step(stk, e_arrays, h_arrays, caches, tok, t0, keys,
-                      finished):
+                      finished, presence=None, nt0=None):
+            carry0 = (tok, caches, finished) + (
+                (presence,) if rep_on else ())
+
             def body(carry, xs):
-                tok, caches, finished = carry
+                tok, caches, finished = carry[:3]
+                presence = carry[3] if rep_on else None
                 i, key = xs
-                nxt, caches = core(stk, e_arrays, h_arrays, caches, tok,
-                                   t0 + i, key)
+                nxt, caches = next_token(
+                    stk, e_arrays, h_arrays, caches, tok, t0 + i, key,
+                    presence, (nt0 + i) if pen_on else None)
                 if eos is not None:
                     nxt = jnp.where(finished, eos, nxt)
                     finished = finished | (nxt == eos)
-                return (nxt, caches, finished), nxt
-            (tok, caches, finished), toks = jax.lax.scan(
-                body, (tok, caches, finished),
-                (jnp.arange(chunk, dtype=jnp.int32), keys))
-            return toks, caches, finished
+                out = (nxt, caches, finished)
+                if rep_on:
+                    out += (presence.at[jnp.arange(nxt.shape[0]),
+                                        nxt].set(True),)
+                return out, nxt
+            carry, toks = jax.lax.scan(
+                body, carry0, (jnp.arange(chunk, dtype=jnp.int32), keys))
+            if rep_on:
+                return toks, carry[1], carry[2], carry[3]
+            return toks, carry[1], carry[2]
         # donate the KV cache (in-place ring update, no per-token copy of
         # the [L,2,B,H,Smax,D] buffer) — except through the axon tunnel,
         # where buffer donation is observed to hang (see BASELINE.md r2)
@@ -424,10 +493,30 @@ class FusedDecoder:
         tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
         return jax.jit(prefill, donate_argnums=() if tunneled else (2,))
 
-    def _build_head_sample(self, do_sample, top_k, top_p, temperature):
-        """Jitted LM head + filter + sample on one hidden state [B,1,E]."""
+    def _build_head_sample(self, do_sample, top_k, top_p, temperature,
+                           eos=None, min_length=0,
+                           repetition_penalty=1.0):
+        """Jitted LM head + filter + sample on one hidden state [B,1,E];
+        with penalties active the logit controls apply at nt=0 (prompt
+        presence only when repetition_penalty is on). min_length is
+        consumed as a BOOL here — nt is baked to 0, so every positive
+        value behaves identically (callers key their cache that way to
+        avoid gratuitous recompiles)."""
         core = self._build_step_core(do_sample, top_k, top_p, temperature)
-        return jax.jit(core.sample_head)
+        rep_on = repetition_penalty != 1.0
+        if not min_length and not rep_on:
+            return jax.jit(core.sample_head)
+        head_logits = core.head_logits
+
+        def head_sample(h_arrays, x, key, presence=None):
+            logits = head_logits(h_arrays, x)
+            logits = logits.reshape(logits.shape[0], -1)
+            logits = _penalize(logits, presence if rep_on else None,
+                               repetition_penalty, 0,
+                               1 if min_length else 0, eos)
+            return _sample_next(logits, do_sample, top_k, top_p,
+                                temperature, key)
+        return jax.jit(head_sample)
 
     # ------------------------------------------------- beam over the cache
     # Reference: fluid beam_search op driving generation against
@@ -897,16 +986,34 @@ class FusedDecoder:
     @no_grad()
     def generate(self, input_ids, max_new_tokens=20, eos_token_id=None,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
-                 num_beams=1, length_penalty=1.0):
+                 num_beams=1, length_penalty=1.0, min_length=0,
+                 repetition_penalty=1.0):
         """Prefill the prompt via compiled chunked scans of the hidden
         core (LM head applied once at the end), then run the compiled
         chunked decode. Every device dispatch is a jitted scan — the
         tunnel backend pays a host RPC per dispatch, so nothing runs
         eagerly here. num_beams > 1 runs beam search AGAINST the decode
-        cache (see the beam builders above)."""
+        cache (see the beam builders above). min_length /
+        repetition_penalty apply INSIDE the compiled steps via a [B, V]
+        context-presence carry."""
         if num_beams > 1 and do_sample:
             raise ValueError("beam search (num_beams>1) is deterministic; "
                              "do_sample=True is not supported with it")
+        rep_on = repetition_penalty != 1.0
+        pen_on = bool(min_length) or rep_on
+        if pen_on and num_beams > 1:
+            raise NotImplementedError(
+                "min_length/repetition_penalty with beam search is not "
+                "supported; use greedy/sampling generation")
+        if rep_on:
+            # only the repetition penalty needs the [B, V] presence mask
+            # (and therefore a known vocab size); min_length alone works
+            # with any head
+            from ..nn.layer.common import Linear
+            if type(self.head) is not Linear:
+                raise NotImplementedError(
+                    "repetition_penalty needs a Linear LM head (vocab "
+                    "size must be known for the presence mask)")
         ids = input_ids._data if isinstance(input_ids, Tensor) else \
             jnp.asarray(np.asarray(input_ids))
         b, prompt = ids.shape
@@ -948,14 +1055,30 @@ class FusedDecoder:
                 ids, last_x, caches, stk, e_arrays, h_arrays,
                 max_new_tokens, eos_token_id, int(num_beams),
                 float(length_penalty), mesh_now, sk_flag, prompt)
-        hkey = ("head", do_sample, top_k, top_p, temperature, mesh_now)
+        eos_i = None if eos_token_id is None else int(eos_token_id)
+        presence = None
+        if rep_on:
+            vocab = int(self._head_params[0].shape[1])
+            presence = _presence_from(ids.astype(jnp.int32), vocab)
+        # the head step bakes nt=0, so min_length enters as a BOOL (every
+        # positive value compiles identically — avoid recompile churn)
+        hkey = ("head", do_sample, top_k, top_p, temperature, mesh_now,
+                eos_i if pen_on else None, bool(min_length),
+                repetition_penalty)
         hstep = self._scan_cache.get(hkey)
         if hstep is None:
             hstep = self._build_head_sample(do_sample, top_k, top_p,
-                                            temperature)
+                                            temperature, eos_i,
+                                            bool(min_length),
+                                            repetition_penalty)
             self._scan_cache[hkey] = hstep
-        nxt = hstep(h_arrays, last_x,
-                    next_key() if do_sample else jax.random.PRNGKey(0))
+        hkey_rng = next_key() if do_sample else jax.random.PRNGKey(0)
+        if pen_on:
+            nxt = hstep(h_arrays, last_x, hkey_rng, presence)
+            if rep_on:
+                presence = presence.at[jnp.arange(b), nxt].set(True)
+        else:
+            nxt = hstep(h_arrays, last_x, hkey_rng)
 
         # ---- compiled decode: CHUNKED scan dispatch. Without eos, all
         # remaining tokens run in one device program; with eos, fixed-size
@@ -986,17 +1109,32 @@ class FusedDecoder:
             while chunk > remaining:
                 chunk //= 2
             key = (do_sample, top_k, top_p, temperature,
-                   self._mesh_mp(), chunk, eos, sk_flag)
+                   self._mesh_mp(), chunk, eos, sk_flag,
+                   min_length, repetition_penalty)
             step = self._scan_cache.get(key)
             if step is None:
-                step = self._build_scan_step(*key[:4], chunk, eos)
+                step = self._build_scan_step(*key[:4], chunk, eos,
+                                             min_length,
+                                             repetition_penalty)
                 self._scan_cache[key] = step
             # one split per chunk: per-token subkeys ride the scan xs
             base = next_key() if do_sample else jax.random.PRNGKey(0)
             keys = jax.random.split(base, chunk)
-            ck, caches, finished = step(
-                stk, e_arrays, h_arrays, caches, last_tok,
-                jnp.asarray(t0, jnp.int32), keys, finished)
+            if rep_on:
+                ck, caches, finished, presence = step(
+                    stk, e_arrays, h_arrays, caches, last_tok,
+                    jnp.asarray(t0, jnp.int32), keys, finished,
+                    presence,
+                    jnp.asarray(t0 - prompt + 1, jnp.int32))
+            elif pen_on:
+                ck, caches, finished = step(
+                    stk, e_arrays, h_arrays, caches, last_tok,
+                    jnp.asarray(t0, jnp.int32), keys, finished, None,
+                    jnp.asarray(t0 - prompt + 1, jnp.int32))
+            else:
+                ck, caches, finished = step(
+                    stk, e_arrays, h_arrays, caches, last_tok,
+                    jnp.asarray(t0, jnp.int32), keys, finished)
             host_parts.append(np.asarray(ck).T)        # [B, chunk]
             last_tok = ck[-1]
             t0 += chunk
@@ -1017,7 +1155,8 @@ class FusedDecoder:
 def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
                    max_seq_len=None, eos_token_id=None, do_sample=False,
                    top_k=0, top_p=1.0, temperature=1.0, use_rotary=False,
-                   num_beams=1, length_penalty=1.0):
+                   num_beams=1, length_penalty=1.0, min_length=0,
+                   repetition_penalty=1.0):
     """One-shot driver over FusedDecoder (see class docstring)."""
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
@@ -1025,4 +1164,6 @@ def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
     dec = FusedDecoder(fmt, embed, head, smax, use_rotary=use_rotary)
     return dec.generate(input_ids, max_new_tokens, eos_token_id, do_sample,
                         top_k, top_p, temperature, num_beams=num_beams,
-                        length_penalty=length_penalty)
+                        length_penalty=length_penalty,
+                        min_length=min_length,
+                        repetition_penalty=repetition_penalty)

@@ -469,4 +469,59 @@ class TestTPDecodeHLO:
         lens = jax.ShapeDtypeStruct((b,), jnp.int32)
         hlo = fn.lower(q, caches, lay, lens).compile().as_text()
         assert "all-gather" not in hlo, "cache was gathered/replicated"
-        assert "all-reduce" not in hlo.replace("all-reduce-scatter", "")
+        assert "all-reduce" not in hlo
+
+
+class TestLogitControls:
+    """r5: reference generate() logit processors — min_length suppresses
+    eos until N generated tokens; repetition_penalty penalizes every
+    context token. Fused decode applies them INSIDE the compiled step
+    (presence-mask carry) and must match the model-agnostic path."""
+
+    def test_fused_matches_generate_with_controls(self):
+        paddle.seed(31)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=23)
+        kw = dict(max_new_tokens=10, eos_token_id=7, min_length=5,
+                  repetition_penalty=1.3)
+        ref = generate(m, paddle.to_tensor(ids), **kw)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, **kw)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_min_length_delays_eos(self):
+        """Force a model whose argmax is eos immediately: min_length must
+        hold eos out for exactly min_length tokens."""
+        paddle.seed(32)
+        m = TinyFusedLM()
+        m.eval()
+        # bias the head so eos (id 7) wins every step
+        bias_w = np.asarray(m.head.weight._data).copy()
+        bias_w[:, 7] += 100.0
+        m.head.weight.set_value(bias_w)
+        ids = _prompt(seed=25)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=8,
+                             eos_token_id=7, min_length=4)
+        gen = np.asarray(out._data)[:, ids.shape[1]:]
+        assert (gen[:, :4] != 7).all(), gen   # suppressed while nt < 4
+        assert (gen[:, 4] == 7).all(), gen    # first allowed step: eos
+
+    def test_repetition_penalty_reduces_repeats(self):
+        paddle.seed(33)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=27)
+        plain = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                               head=m.head, max_new_tokens=12)
+        pen = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, max_new_tokens=12,
+                             repetition_penalty=2.0)
+
+        def rep_frac(a):
+            g = np.asarray(a._data)
+            return np.mean([len(r) - len(set(r.tolist()))
+                            for r in g]) / g.shape[1]
+        assert rep_frac(pen) <= rep_frac(plain) + 1e-9
