@@ -163,8 +163,13 @@ def _init_devices():
     except IndexError:
         cached_kind = "timeout"
     ttl = _probe_cache_ttl(cached_kind)
+    # oneshot mode's premise is "the CALLER probed successfully moments
+    # ago" (tpu_session5 run() probes before every phase) — a stale
+    # probe-down cache from an earlier flap must not override that fresh
+    # evidence, so oneshot ignores the cache read entirely
+    oneshot = os.environ.get("BENCH_PROBE_ONESHOT") == "1"
     if os.environ.get("BENCH_TPU_UNAVAILABLE") == "1" or (
-            cache_age is not None and cache_age < ttl):
+            not oneshot and cache_age is not None and cache_age < ttl):
         age_s = f"{round(cache_age)}s" if cache_age is not None else "env"
         print(f"bench: TPU marked unavailable (env/cache "
               f"kind={cached_kind} age={age_s} ttl={ttl}s); "
@@ -174,8 +179,11 @@ def _init_devices():
         return jax, jax.devices()[0], True
 
     # worst case: 3×75 s probes + 60 s sleeps + 120 s init watchdog ≈ 7 min
-    # before the CPU fallback; driver timeouts must budget for that
-    delays = [0, 15, 45]
+    # before the CPU fallback; driver timeouts must budget for that.
+    # BENCH_PROBE_ONESHOT=1 (session tools whose caller ALREADY probed —
+    # e.g. tpu_session5 run() probes right before each phase): one probe,
+    # no retry sleeps — a mid-phase tunnel death fails in ~75 s.
+    delays = [0] if oneshot else [0, 15, 45]
     fail_kinds = []
     for i, delay in enumerate(delays):
         if delay:
@@ -189,9 +197,12 @@ def _init_devices():
             global _DONATE_OK
             if os.environ.get("PADDLE_TPU_DONATE") == "1":
                 _DONATE_OK = True   # explicit override: skip the probe
-            elif os.environ.get("BENCH_DONATE_PROBE", "1") != "0" \
+            elif not oneshot \
+                    and os.environ.get("BENCH_DONATE_PROBE", "1") != "0" \
                     and _budget_left(float(os.environ.get(
                         "BENCH_BUDGET_S", "1500"))) > 900:
+                # oneshot callers (llama_1b & co) never donate — the
+                # up-to-420 s donation probe would undercut the fast path
                 _DONATE_OK = _probe_donation(timeout_s=420)
             import jax
             # a wedge inside native init never returns to the bytecode
@@ -224,7 +235,15 @@ def _init_devices():
     print("bench: accelerator unreachable; falling back to CPU (number "
           "is NOT comparable to TPU baselines)", file=sys.stderr)
     # cache kind = timeout only if EVERY failure was a wedge; any
-    # fast-error or init-flake in the mix gets the short TTL
+    # fast-error or init-flake in the mix gets the short TTL. A oneshot
+    # run never WRITES the cache either: its single sample lacks the
+    # 3-probe consensus this classification was designed around, and a
+    # 600 s cache from one flaky probe would silently send the rest of
+    # the window's phases to CPU fallback.
+    if oneshot:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return jax, jax.devices()[0], True
     kind = "timeout" if fail_kinds and all(
         k == "timeout" for k in fail_kinds) else "error"
     try:  # let sibling benches skip the probe ladder for the TTL window

@@ -54,6 +54,16 @@ def analytic_table(n_params: int) -> dict:
 
 def main():
     tpu = "--tpu" in sys.argv
+    if tpu:
+        # hang-safe init via the bench harness (subprocess probe with a
+        # hard timeout): a dead tunnel must fail in seconds, not burn the
+        # session phase's full 40-min timeout holding the window lock
+        from bench import _init_devices
+        _jax, dev, unavailable = _init_devices()
+        if unavailable or dev.platform not in ("tpu", "axon"):
+            print(json.dumps({"ok": False,
+                              "error": "tpu_unreachable (probe)"}))
+            sys.exit(3)
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fleet

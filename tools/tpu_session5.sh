@@ -158,9 +158,9 @@ commit_phase bench_decode_full8
 # 5. 1B single-chip: Adafactor (analytic ~7 GB state — expected to FIT,
 #    the >=1B single-chip row), then AdamW (expected RESOURCE_EXHAUSTED,
 #    recorded as the OOM half of verdict #7).
-run llama_1b_adafactor 2400 python tools/llama_1b.py --tpu --adafactor
+run llama_1b_adafactor 2400 env BENCH_PROBE_ONESHOT=1 python tools/llama_1b.py --tpu --adafactor
 commit_phase llama_1b_adafactor LLAMA1B_tpu.json
-run llama_1b_adamw 1500 python tools/llama_1b.py --tpu
+run llama_1b_adamw 1500 env BENCH_PROBE_ONESHOT=1 python tools/llama_1b.py --tpu
 commit_phase llama_1b_adamw LLAMA1B_tpu.json
 
 # 6. Long-context flash ratchet S=8k/16k (verdict missing #4).
