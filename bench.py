@@ -240,17 +240,14 @@ def _init_devices():
     # 3-probe consensus this classification was designed around, and a
     # 600 s cache from one flaky probe would silently send the rest of
     # the window's phases to CPU fallback.
-    if oneshot:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        return jax, jax.devices()[0], True
-    kind = "timeout" if fail_kinds and all(
-        k == "timeout" for k in fail_kinds) else "error"
-    try:  # let sibling benches skip the probe ladder for the TTL window
-        with open(cache, "w") as f:
-            f.write(f"{kind} {time.time()}")
-    except OSError:
-        pass
+    if not oneshot:
+        kind = "timeout" if fail_kinds and all(
+            k == "timeout" for k in fail_kinds) else "error"
+        try:  # let sibling benches skip the probe ladder for the TTL
+            with open(cache, "w") as f:
+                f.write(f"{kind} {time.time()}")
+        except OSError:
+            pass
     import jax
     jax.config.update("jax_platforms", "cpu")
     return jax, jax.devices()[0], True

@@ -95,17 +95,17 @@ def _use_ring_kernel(q, k) -> bool:
     """Dispatch the per-step chunk to the Pallas flash kernel on real TPU
     only (PADDLE_TPU_RING_COMPOSITE=1 forces the dense composite).
 
-    Never on CPU — ring always runs inside shard_map, and interpret-mode
-    pallas inside shard_map trips a jax-0.9 check_vma limitation
-    (dynamic_slice with mixed varying-manual-axes; jax asks for an issue
-    + check_vma=False). The kernel itself is interpret-tested OUTSIDE
-    shard_map in tests/test_pallas_kernels.py; the ring schedule is
-    composite-tested on the CPU mesh; the combined path needs a real
-    chip."""
+    On CPU the composite stays the default (interpret-mode pallas is
+    orders slower), but PADDLE_TPU_RING_KERNEL_CPU=1 forces the kernel —
+    _cp_fn's check_vma=False lifted the jax-0.9 limitation that used to
+    make pallas-inside-shard_map impossible on CPU, so the COMBINED
+    ring+kernel path is now CPU-testable (r4 weak #3); on-chip
+    validation still happens in the session window."""
     import os
     if os.environ.get("PADDLE_TPU_RING_COMPOSITE") == "1":
         return False
-    if jax.default_backend() != "tpu":
+    if jax.default_backend() != "tpu" and \
+            os.environ.get("PADDLE_TPU_RING_KERNEL_CPU") != "1":
         return False
     # deliberately NOT a blanket except: an ImportError/regression in the
     # kernel module must surface, not silently downgrade every TPU ring
@@ -233,8 +233,14 @@ def _cp_fn(impl, mesh: Mesh, axis_name: str, causal: bool,
            scale: Optional[float]):
     spec = P(None, axis_name, None, None)
 
+    # check_vma=False: the varying-manual-axes static check trips on
+    # interpret-mode pallas_call inside shard_map (jax-0.9; the error
+    # itself prescribes this flag). The ring has no cross-axis aliasing
+    # the check would catch, and disabling it makes the COMBINED
+    # ring+kernel path testable on the CPU mesh (r4 weak #3).
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     def fn(q, k, v):
         return impl(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
 

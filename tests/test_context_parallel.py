@@ -167,3 +167,48 @@ class TestLlamaContextParallel:
             from paddle_tpu.distributed.fleet.base.topology import \
                 _HYBRID_GROUP
             _HYBRID_GROUP[0] = None
+
+
+class TestRingKernelCombinedCPU:
+    def test_ring_with_pallas_kernel_matches_composite(self, monkeypatch):
+        """r4 weak #3: the COMBINED ring-schedule + Pallas chunk-kernel
+        path used to be untestable off-chip (pallas-in-shard_map tripped
+        jax's check_vma); with check_vma=False in _cp_fn it runs on the
+        CPU mesh — fwd AND bwd must match the composite ring."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        import paddle_tpu.parallel.context_parallel as cp
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sep",))
+        B, S, H, D = 2, 256, 4, 64
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+
+        # use the REAL production wrapper so _cp_fn's check_vma=False
+        # is what the test exercises (hand-rolling shard_map here would
+        # let a _cp_fn regression pass silently)
+        def build():
+            return jax.jit(cp.make_ring_attention_fn(mesh, causal=True))
+
+        monkeypatch.setenv("PADDLE_TPU_RING_KERNEL_CPU", "1")
+        # pin that the kernel path is actually taken (not a vacuous
+        # composite-vs-composite comparison)
+        assert cp._use_ring_kernel(
+            jnp.zeros((B, S // 4, H, D), jnp.float32),
+            jnp.zeros((B, S // 4, H, D), jnp.float32))
+        fn_k = build()
+        out_k = fn_k(q, k, v)
+        gk = jax.grad(lambda *a: jnp.sum(fn_k(*a) ** 2), (0, 1, 2))(
+            q, k, v)
+        monkeypatch.delenv("PADDLE_TPU_RING_KERNEL_CPU")
+        monkeypatch.setenv("PADDLE_TPU_RING_COMPOSITE", "1")
+        fn_c = build()
+        out_c = fn_c(q, k, v)
+        gc_ = jax.grad(lambda *a: jnp.sum(fn_c(*a) ** 2), (0, 1, 2))(
+            q, k, v)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_c),
+                                   atol=2e-5, rtol=2e-5)
+        for a, b in zip(gk, gc_):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)

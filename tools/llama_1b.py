@@ -57,7 +57,11 @@ def main():
     if tpu:
         # hang-safe init via the bench harness (subprocess probe with a
         # hard timeout): a dead tunnel must fail in seconds, not burn the
-        # session phase's full 40-min timeout holding the window lock
+        # session phase's full 40-min timeout holding the window lock.
+        # This tool never donates and its caller (or a human) wants the
+        # fast verdict — default to oneshot mode; an env that explicitly
+        # sets it still wins.
+        os.environ.setdefault("BENCH_PROBE_ONESHOT", "1")
         from bench import _init_devices
         _jax, dev, unavailable = _init_devices()
         if unavailable or dev.platform not in ("tpu", "axon"):
