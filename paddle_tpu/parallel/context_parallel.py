@@ -219,6 +219,21 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    # the per-device attention runs over the FULL sequence — exactly
+    # where the dense composite's O(S^2) score materialization hurts
+    # (S=16k => gigabytes of [B, H/n, S, S] fp32). Stream the flash
+    # kernel instead whenever it tiles (TPU; PADDLE_TPU_ULYSSES_FLASH_CPU
+    # =1 exercises the same path in interpret mode for tests), with the
+    # dense composite as the untileable-shape fallback.
+    import os
+    from ..ops.pallas import flash_attention as fa
+    use_flash = (jax.default_backend() == "tpu"
+                 or os.environ.get("PADDLE_TPU_ULYSSES_FLASH_CPU") == "1")
+    if use_flash and os.environ.get(
+            "PADDLE_TPU_ULYSSES_COMPOSITE") != "1" and \
+            fa.is_supported(qh.shape, qh.dtype):
+        o = fa.flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        return gather_heads(o.astype(q.dtype))
     sq = qh.shape[1]
     mask = None
     if causal:
@@ -233,14 +248,21 @@ def _cp_fn(impl, mesh: Mesh, axis_name: str, causal: bool,
            scale: Optional[float]):
     spec = P(None, axis_name, None, None)
 
-    # check_vma=False: the varying-manual-axes static check trips on
-    # interpret-mode pallas_call inside shard_map (jax-0.9; the error
-    # itself prescribes this flag). The ring has no cross-axis aliasing
-    # the check would catch, and disabling it makes the COMBINED
-    # ring+kernel path testable on the CPU mesh (r4 weak #3).
+    # The varying-manual-axes static check trips on interpret-mode
+    # pallas_call inside shard_map (jax-0.9; the error itself prescribes
+    # check_vma=False) — that limitation is interpret-only, so the check
+    # stays LIVE on real TPU (it catches wrong out_spec / replication
+    # bugs at trace time) and is disabled off-chip, which makes the
+    # combined ring+kernel path testable on the CPU mesh (r4 weak #3).
+    # PADDLE_TPU_CP_CHECK_VMA=0 force-disables it everywhere — the
+    # escape hatch if the first on-chip compile trips it after all.
+    import os
+    vma = (jax.default_backend() == "tpu"
+           and os.environ.get("PADDLE_TPU_CP_CHECK_VMA") != "0")
+
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        check_vma=vma)
     def fn(q, k, v):
         return impl(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
 
