@@ -104,17 +104,28 @@ class LlamaAttention(Layer):
                                  bias_attr=False)
 
     def _ring_fn(self):
-        """Ring attention over the active mesh's 'sep' axis (cached per
-        mesh); None when no sep-parallel mesh is active."""
+        """Sequence-parallel attention over the active mesh's 'sep' axis
+        (cached per mesh); None when no sep-parallel mesh is active.
+        context_parallel=True/'ring' runs exact ring attention (K/V
+        chunks rotate on ICI); context_parallel='ulysses' runs the
+        reference sep scheme (head-scatter all_to_all, full-sequence
+        flash per device) — requires kv_heads % sep == 0, so GQA configs
+        with few kv heads use ring."""
         from ..parallel import current_mesh
         mesh = current_mesh()
         if mesh is None or "sep" not in mesh.shape or mesh.shape["sep"] < 2:
             return None
+        scheme = ("ulysses" if self.context_parallel == "ulysses"
+                  else "ring")
         if getattr(self, "_ring_cache", None) is None or \
-                self._ring_cache[0] is not mesh:
-            from ..parallel.context_parallel import make_ring_attention_fn
-            self._ring_cache = (mesh, make_ring_attention_fn(
-                mesh, axis_name="sep", causal=True))
+                self._ring_cache[0] is not mesh or \
+                self._ring_cache[2] != scheme:
+            from ..parallel.context_parallel import (
+                make_ring_attention_fn, make_ulysses_attention_fn)
+            mk = (make_ulysses_attention_fn if scheme == "ulysses"
+                  else make_ring_attention_fn)
+            self._ring_cache = (mesh, mk(mesh, axis_name="sep",
+                                         causal=True), scheme)
         return self._ring_cache[1]
 
     def forward(self, x, kv_cache=None, time_step=None):

@@ -273,3 +273,40 @@ class TestUlyssesFlash:
         for a, b in zip(gk, gc_):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4)
+
+
+class TestModelUlyssesOption:
+    @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+    def test_llama_context_parallel_ulysses_matches_dense(self):
+        """r5: context_parallel='ulysses' at the model level runs the
+        reference sep scheme (head-scatter all_to_all) — loss must match
+        the no-mesh dense run."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        def build(cp):
+            paddle.seed(40)
+            return LlamaForCausalLM(LlamaConfig(
+                vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                intermediate_size=128, max_position=256,
+                context_parallel=cp))
+
+        ids = np.random.RandomState(8).randint(0, 128, (2, 64)).astype(
+            np.int32)
+        x, y = paddle.to_tensor(ids), paddle.to_tensor(ids)
+        ref = build(False)
+        loss_ref = float(np.asarray(ref(x, labels=y)._data))
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        m = build("ulysses")
+        loss_u = float(np.asarray(m(x, labels=y)._data))
+        np.testing.assert_allclose(loss_u, loss_ref, rtol=2e-5)
+        # and the scheme actually selected ulysses
+        attn = m.llama.layers[0].self_attn
+        attn._ring_fn()
+        assert attn._ring_cache[2] == "ulysses"
