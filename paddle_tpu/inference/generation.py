@@ -95,7 +95,8 @@ def generate(model, input_ids, max_new_tokens: int = 20,
              eos_token_id: Optional[int] = None, do_sample: bool = False,
              top_k: int = 0, top_p: float = 1.0, temperature: float = 1.0,
              num_beams: int = 1, length_penalty: float = 1.0,
-             min_length: int = 0, repetition_penalty: float = 1.0):
+             min_length: int = 0, repetition_penalty: float = 1.0,
+             no_repeat_ngram_size: int = 0):
     """Causal-LM generation; input_ids [B, S] Tensor/ndarray -> [B, S+T].
 
     Greedy by default; sampling with top-k/top-p/temperature when
@@ -130,6 +131,22 @@ def generate(model, input_ids, max_new_tokens: int = 20,
                 presence = _presence_from(ids, logits.shape[-1])
             logits = _penalize(logits, presence, repetition_penalty,
                                nt, min_length, eos_i)
+        if no_repeat_ngram_size:
+            # reference no_repeat_ngram logits processor: ban every token
+            # that would complete an already-seen n-gram. Host-side (this
+            # path re-runs the forward per step anyway); the fused decoder
+            # documents it as unsupported.
+            n = int(no_repeat_ngram_size)
+            ids_np = np.asarray(ids)
+            if ids_np.shape[1] >= n - 1:
+                banned = np.zeros(logits.shape, bool)
+                for b_ in range(ids_np.shape[0]):
+                    row = ids_np[b_].tolist()
+                    tail = tuple(row[len(row) - (n - 1):]) if n > 1 else ()
+                    for s_ in range(len(row) - n + 1):
+                        if tuple(row[s_:s_ + n - 1]) == tail:
+                            banned[b_, row[s_ + n - 1]] = True
+                logits = jnp.where(jnp.asarray(banned), -1e30, logits)
         nxt = _sample_next(logits, do_sample, top_k, top_p,
                            temperature)
         if eos_token_id is not None:

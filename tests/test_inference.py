@@ -198,3 +198,35 @@ class TestInt8Precision:
         inference.create_predictor(cfg)
         gc.collect()
         assert w_ref() is None
+
+
+class TestNoRepeatNgram:
+    def test_no_repeat_bigram_bans_repeats(self):
+        """Reference no_repeat_ngram logits processor: with n=2, any
+        bigram may appear at most once in the generated sequence."""
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.generation import generate
+        from paddle_tpu.nn.layer.common import Embedding, Linear
+        from paddle_tpu.nn.layer.layers import Layer
+        import numpy as np
+
+        class TinyLM(Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = Embedding(50, 16)
+                self.head = Linear(16, 50)
+
+            def forward(self, ids):
+                return self.head(self.emb(ids))
+
+        paddle.seed(44)
+        m = TinyLM()
+        ids = np.random.RandomState(31).randint(1, 50, (2, 4)).astype(
+            np.int32)
+        out = generate(m, paddle.to_tensor(ids), max_new_tokens=16,
+                       no_repeat_ngram_size=2)
+        g = np.asarray(out._data)
+        for row in g:
+            bigrams = list(zip(row[:-1].tolist(), row[1:].tolist()))
+            assert len(bigrams) == len(set(bigrams)), (
+                f"repeated bigram in {row}")
