@@ -577,12 +577,21 @@ class FusedDecoder:
                     gen_len)
         return jax.jit(init)
 
-    def _build_beam_scan(self, k, chunk, eos, length_penalty):
+    def _build_beam_scan(self, k, chunk, eos, length_penalty, split=0):
         """chunk beam steps per device program. Carry: (caches, flat tok
         [B*K], scores/finished/gen_len [B,K]); ys: the per-step lineage +
         bookkeeping snapshots the host backtracks over. Semantics match
         _beam_search step-for-step (finished beams continue only with eos
-        at zero added score; GNMT length penalty at finish admission)."""
+        at zero added score; GNMT length penalty at finish admission).
+
+        split (static): the prompt's KV region [0, split) is IDENTICAL
+        across the beams of a batch row forever (written at prefill,
+        before beam replication, never re-written), so reordering it is a
+        semantic no-op — the per-step beam gather only touches positions
+        >= split and writes them back in place (dynamic_update_slice on
+        the donated buffer). For long prompts that removes most of the
+        reorder's HBM traffic. split is a pow-2 bucket of the prompt
+        length so executables stay bounded."""
         core = self._build_step_core(False, 0, 1.0, 1.0)
         hidden = core.hidden
         head_logits = core.head_logits
@@ -610,14 +619,28 @@ class FusedDecoder:
                 beam_idx = top_idx // v                      # [B, K]
                 tok = (top_idx % v).astype(jnp.int32)
                 # THE cache gather: reorder the batch*beam axis to each
-                # winner's parent (both stack and int8 scales)
+                # winner's parent (both stack and int8 scales), touching
+                # only positions >= split (the shared-prompt region needs
+                # no reorder — identical rows)
                 flat_src = (jnp.arange(b)[:, None] * kk
                             + beam_idx).reshape(-1)
+
+                def reorder(c, pos_axis):
+                    if not split:
+                        return jnp.take(c, flat_src, axis=2)
+                    tail = jax.lax.slice_in_dim(
+                        c, split, c.shape[pos_axis], axis=pos_axis)
+                    tail = jnp.take(tail, flat_src, axis=2)
+                    starts = [0] * c.ndim
+                    starts[pos_axis] = split
+                    return jax.lax.dynamic_update_slice(
+                        c, tail, tuple(starts))
                 if isinstance(caches, tuple):
-                    caches = tuple(jnp.take(c, flat_src, axis=2)
-                                   for c in caches)
+                    # stack positions ride axis 4; scale positions axis 5
+                    caches = (reorder(caches[0], 4),
+                              reorder(caches[1], 5))
                 else:
-                    caches = jnp.take(caches, flat_src, axis=2)
+                    caches = reorder(caches, 4)
                 finished = jnp.take_along_axis(finished, beam_idx, 1)
                 gen_len = jnp.take_along_axis(gen_len, beam_idx, 1)
                 gen_len = jnp.where(finished, gen_len, gen_len + 1)
@@ -946,6 +969,11 @@ class FusedDecoder:
         remaining = max_new_tokens - 1
         cap = int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "0")) or (
             8 if eos is not None else 64)
+        # static shared-prefix split: largest power of two <= prompt
+        # (bounded executable variants); below 64 the saving is noise
+        split = 0
+        if prompt >= 64:
+            split = 1 << (int(prompt).bit_length() - 1)
         while remaining > 0:
             if eos is not None and bool(jnp.all(finished)):
                 break
@@ -953,11 +981,11 @@ class FusedDecoder:
             while chunk > remaining:
                 chunk //= 2
             key = ("beam", k, chunk, eos, length_penalty, mesh_now,
-                   sk_flag)
+                   sk_flag, split)
             step = self._scan_cache.get(key)
             if step is None:
                 step = self._build_beam_scan(k, chunk, eos,
-                                             length_penalty)
+                                             length_penalty, split)
                 self._scan_cache[key] = step
             caches, last_flat, scores, finished, gen_len, ys = step(
                 stk, e_arrays, h_arrays, caches, last_flat,

@@ -525,3 +525,37 @@ class TestLogitControls:
             return np.mean([len(r) - len(set(r.tolist()))
                             for r in g]) / g.shape[1]
         assert rep_frac(pen) <= rep_frac(plain) + 1e-9
+
+
+class TestBeamPrefixSplit:
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_long_prompt_split_reorder_matches_generate(self, int8,
+                                                        monkeypatch):
+        """r5: with prompt >= 64 the beam reorder only gathers cache
+        positions past the shared-prefix split (the prompt region is
+        identical across beams — reordering it is a no-op). Token
+        parity with the model-agnostic beam must hold through the split
+        path, fp and int8."""
+        if int8:
+            monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+            monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_CACHE",
+                               raising=False)
+            monkeypatch.delenv("PADDLE_TPU_DECODE_INT8_WEIGHTS",
+                               raising=False)
+        paddle.seed(45)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(b=2, s=80, seed=33)   # split = 64
+        kw = dict(max_new_tokens=8, num_beams=3, max_seq_len=128)
+        out = generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                             head=m.head, **kw)
+        # oracle: the model-agnostic beam (no cache, no split machinery)
+        for k_ in ("PADDLE_TPU_DECODE_INT8_CACHE",
+                   "PADDLE_TPU_DECODE_INT8_WEIGHTS"):
+            monkeypatch.delenv(k_, raising=False)
+        ref = generate(m, paddle.to_tensor(ids), max_new_tokens=8,
+                       num_beams=3)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
