@@ -184,7 +184,7 @@ commit_phase vit_conv BENCH_RESULT.json
 
 # 9. Remaining decode ratchets: cache-backed beam search + w8c8 combo.
 #    (TP-sharded kernel decode cannot A/B here: mp>=2 needs >1 chip.)
-run bench_decode_beam 900 env BENCH_BEAMS=4 python bench_decode.py
+run bench_decode_beam 900 env BENCH_BEAMS=4 BENCH_PROMPT=256 python bench_decode.py
 commit_phase bench_decode_beam
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_w8c8
