@@ -1,0 +1,62 @@
+"""Summarize TPU window results for BASELINE.md.
+
+Reads BENCH_tpu.json (the append-only machine ratchet log bench.py and
+bench_decode.py write on every real-TPU run) plus any tpu_windows/*.log
+phase artifacts, and prints:
+  * a compact per-entry table (metric, value, provenance) for entries
+    newer than --since (ISO date or 'r5' = 2026-08-01),
+  * a ready-to-paste BASELINE.md ratchet-row skeleton per NEW window.
+
+Run after a window:  python tools/harvest_window.py [--since 2026-08-01]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    since = "2026-08-01"
+    if "--since" in sys.argv:
+        since = sys.argv[sys.argv.index("--since") + 1]
+    if since == "r5":
+        since = "2026-08-01"
+    path = os.path.join(REPO, "BENCH_tpu.json")
+    if not os.path.exists(path):
+        print("no BENCH_tpu.json — no TPU window has appended yet")
+        return
+    with open(path) as f:
+        entries = json.load(f)
+    print(f"{len(entries)} total entries in BENCH_tpu.json")
+    fresh = [e for e in entries
+             if str(e.get("date", e.get("ts", ""))) >= since]
+    if not fresh:
+        print(f"none newer than {since}; latest entry:")
+        fresh = entries[-1:]
+    for e in fresh:
+        metric = e.get("metric", "?")
+        val = e.get("value")
+        bits = [f"{metric} = {val} {e.get('unit', '')}"]
+        for k in ("mfu", "cache_mode", "weight_mode", "head_mode",
+                  "num_beams", "prompt_len", "attention_path", "donated",
+                  "scan_steps", "date", "ts"):
+            if k in e:
+                bits.append(f"{k}={e[k]}")
+        print("  " + "  ".join(str(b) for b in bits))
+        for c in e.get("configs", []) or []:
+            print(f"    - {c.get('metric')}: {c.get('value')} "
+                  f"{c.get('unit', '')}  mfu={c.get('mfu')}")
+    logs = sorted(os.listdir(os.path.join(REPO, "tpu_windows"))) \
+        if os.path.isdir(os.path.join(REPO, "tpu_windows")) else []
+    if logs:
+        print(f"\nphase logs in tpu_windows/: {', '.join(logs)}")
+    print("\nBASELINE.md row skeleton:\n"
+          "| <date> (r5 window) | <config + lever A/B'd> | <tok/s> | "
+          "<MFU> | <what changed vs the prior ratchet, lever named> |")
+
+
+if __name__ == "__main__":
+    main()
