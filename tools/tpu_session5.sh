@@ -142,9 +142,16 @@ commit_phase bench_decode
 
 # 3. Full 5-config bench — the MFU-spread scoreboard; appends the window
 #    record to BENCH_tpu.json. Early: short windows must land this.
-run bench_all 2400 env BENCH_BUDGET_S=1500 python bench.py
+run bench_all 2400 env BENCH_BUDGET_S=1500 BENCH_RESUME=1 python bench.py
 cp BENCH_partial.json "$OUT/" 2>/dev/null
 commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
+
+# 3b. Decode attention-path A/B: the stacked kernel measured BELOW the
+#     r3 dense ratchet (399 vs 418 tok/s) — measure the dense fallback
+#     in the same build to localize whether the kernel or something else
+#     (e.g. the in-place scan cache) regressed.
+run bench_decode_dense 900 env PADDLE_TPU_STACKED_KERNEL=0 python bench_decode.py
+commit_phase bench_decode_dense
 
 # 4. int8 decode ladder: cache (halves KV stream), weights (halves the
 #    dominant ~250 MB/token weight stream), full stack incl. LM head.
