@@ -918,6 +918,11 @@ def main():
             if time.time() - os.path.getmtime(partial_path) < 6 * 3600:
                 with open(partial_path) as f:
                     prior = json.load(f)
+            # provenance: only TPU-run partials may be reused — a CPU
+            # rehearsal's smoke numbers must never be republished as a
+            # TPU window row (the partial records its own on_tpu)
+            if prior is not None and prior.get("on_tpu") is not True:
+                prior = None
         except Exception:
             prior = None
 
@@ -965,10 +970,24 @@ def main():
 
     def _checkpoint():
         # kill-safety: if the driver times the process out mid-config, the
-        # completed results survive in a side file
+        # completed results survive in a side file. Reused-but-not-yet-
+        # reached rows are merged in so a SECOND flap can't destroy what
+        # the first flap's run already measured (the loop only appends
+        # rows as it passes them).
+        if not on_tpu:
+            # CPU fallback/rehearsal runs must not clobber a real TPU
+            # window's partial waiting for its resume (observed live:
+            # a smoke run overwrote the flap-saved TPU headline)
+            return
+        merged = list(configs)
+        have = {r.get("metric") for r in merged if isinstance(r, dict)}
+        for mk, rec in done_metrics.items():
+            if mk not in have:
+                merged.append(rec)
         try:
             with open(partial_path, "w") as f:
-                json.dump({"headline": headline, "configs": configs}, f)
+                json.dump({"headline": headline, "configs": merged,
+                           "on_tpu": True}, f)
         except OSError:
             pass
 
@@ -1040,6 +1059,13 @@ def main():
             record["standing_tpu_ratchet"] = standing
     elif on_tpu:
         _append_tpu_window(record)
+        # this run's rows are now published as a window record — a later
+        # BENCH_RESUME must re-measure, not republish them as a second
+        # "new" window (stale-partial trap)
+        try:
+            os.remove(partial_path)
+        except OSError:
+            pass
     _emit_record(record)
 
 

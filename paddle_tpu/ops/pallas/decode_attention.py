@@ -157,23 +157,38 @@ def decode_attention_bhsd(qt, kt, vt, cache_lens, scale=None):
 
     lens = cache_lens.astype(jnp.int32).reshape(b)
     grid = (b, h, sk_p // bk)
+
+    # Same last-valid-block clamp as the stacked kernels (see
+    # _stacked_setup): blocks past n_valid + sq re-address the last valid
+    # block so the pipeline elides their HBM copies — without it, a long
+    # ring buffer with a short prefix streams mostly padding. lens rides
+    # in as a scalar-prefetch operand so the index maps can read it.
+    def _cl(j, len_r, b_):
+        return jnp.minimum(j, (len_r[b_] + sq - 1) // bk)
+
     out = pl.pallas_call(
         functools.partial(_kernel, scale=float(scale), sq=sq, bq=bq, bk=bk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, j, g=group: (b_, h_ // g, j, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda b_, h_, j, g=group: (b_, h_ // g, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h_, j, len_r: (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda b_, h_, j, len_r, g=group:
+                             (b_, h_ // g, _cl(j, len_r, b_), 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda b_, h_, j, len_r, g=group:
+                             (b_, h_ // g, _cl(j, len_r, b_), 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d),
+                                   lambda b_, h_, j, len_r: (b_, h_, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct((b, h, bq, d), qt.dtype),
         interpret=_interpret(),
     )(lens, qt, kt, vt)
@@ -209,12 +224,25 @@ def _stacked_setup(qt, hk, smax, group):
     if bq != sq:
         qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
     grid = (b, h, smax // bk)
+
+    # Clamp the sequence-block coordinate at this batch row's LAST valid
+    # block. The kernel body already pl.when-skips compute for blocks past
+    # n_valid + sq, but a monotone index map would still DMA every one of
+    # the Smax//bk blocks from HBM — at serving shapes (short prefix,
+    # Smax-sized ring) that is almost all padding traffic and decode is
+    # bandwidth-bound. With the clamp, every grid step past the last valid
+    # block re-addresses that same block, and the Pallas pipeline elides
+    # copies whose block index is unchanged — only the valid prefix is
+    # ever streamed (splash/paged-attention style).
+    def _clamp(j, len_r, b_):
+        return jnp.minimum(j, (len_r[b_] + sq - 1) // bk)
+
     kidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, j, 0)
+        lay_r[0], 0, b_, h_ // g, _clamp(j, len_r, b_), 0)
     vidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
-        lay_r[0], 1, b_, h_ // g, j, 0)
+        lay_r[0], 1, b_, h_ // g, _clamp(j, len_r, b_), 0)
     qidx = lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)  # noqa: E731
-    return qt, bq, bk, grid, kidx, vidx, qidx
+    return qt, bq, bk, grid, kidx, vidx, qidx, _clamp
 
 
 def stacked_i8_is_supported(q_shape, caches_shape, dtype) -> bool:
@@ -301,8 +329,8 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
             "cache_dtype=...) and use the unstacked/dense path instead")
     out_dtype = qt.dtype
 
-    qt, bq, bk, grid, kidx, vidx, qidx = _stacked_setup(qt, hk, smax,
-                                                        group)
+    qt, bq, bk, grid, kidx, vidx, qidx, _ = _stacked_setup(qt, hk, smax,
+                                                            group)
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
@@ -398,13 +426,13 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
             f"[L, 2, B, Hk, 1, Smax], got {cache_scales.shape}")
 
     out_dtype = qt.dtype
-    qt, bq, bk, grid, kidx, vidx, qidx = _stacked_setup(qt, hk, smax,
-                                                        group)
+    qt, bq, bk, grid, kidx, vidx, qidx, clamp = _stacked_setup(
+        qt, hk, smax, group)
     group_ = group
     ksidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, 0, j)
+        lay_r[0], 0, b_, h_ // g, 0, clamp(j, len_r, b_))
     vsidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
-        lay_r[0], 1, b_, h_ // g, 0, j)
+        lay_r[0], 1, b_, h_ // g, 0, clamp(j, len_r, b_))
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(

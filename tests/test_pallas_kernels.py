@@ -359,6 +359,54 @@ def test_decode_attention_stacked_vs_unstacked(group):
                                    atol=2e-5, rtol=2e-5)
 
 
+def test_decode_attention_clamped_index_multiblock():
+    """The last-valid-block index-map clamp (DMA elision for padding
+    blocks) must be numerically invisible. Exercised where it ENGAGES:
+    Smax=512 -> bk=256 -> 2 sequence blocks, with short per-batch lens so
+    block 1 is clamped back to block 0 for every row — an off-by-one in
+    the clamp would mis-address the last valid block and corrupt the
+    output. Covers fp stacked, int8 stacked, and the bhsd fallback."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    L, b, h, d, smax, sq = 2, 3, 4, 32, 512, 1
+    rng = np.random.RandomState(7)
+    caches = jnp.asarray(rng.randn(L, 2, b, h, smax, d), jnp.float32)
+    q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
+    # lens straddle the block-0/block-1 boundary: 30 (block 0 only),
+    # 255/256 (the exact edge: the new token lands at position len)
+    lens = jnp.asarray([30, 255, 256], jnp.int32)
+
+    def dense_ref(kc, vc):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc) * (d ** -0.5)
+        rows = jnp.arange(sq)[None, None, :, None]
+        cols = jnp.arange(smax)[None, None, None, :]
+        mask = cols <= (lens[:, None, None, None] + rows)
+        p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+
+    for l in range(L):
+        ref = dense_ref(caches[l, 0], caches[l, 1])
+        got = da.decode_attention_stacked(q, caches, l, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        got_bhsd = da.decode_attention_bhsd(q, caches[l, 0],
+                                            caches[l, 1], lens)
+        np.testing.assert_allclose(np.asarray(got_bhsd), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    # int8: per-row absmax quant, scales [L, 2, B, Hk, 1, Smax]
+    absmax = jnp.max(jnp.abs(caches), axis=-1, keepdims=True)
+    scales = jnp.maximum(absmax, 1e-8) / 127.0
+    caches_i8 = jnp.round(caches / scales).astype(jnp.int8)
+    scales = jnp.swapaxes(scales, -1, -2)       # [L,2,B,Hk,1,Smax]
+    deq = caches_i8.astype(jnp.float32) * jnp.swapaxes(scales, -1, -2)
+    for l in range(L):
+        ref = dense_ref(deq[l, 0], deq[l, 1])
+        got = da.decode_attention_stacked_i8(q, caches_i8, scales, l,
+                                             lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+
 class TestFlashDropout:
     """Flash attention with seed-regenerated dropout (fwd/bwd mask parity)."""
 
