@@ -1,0 +1,74 @@
+"""Promote a flap-stranded BENCH_partial.json into a BENCH_tpu.json
+window record.
+
+bench.py only appends a window record when a run reaches its end; a run
+killed mid-config (tunnel flap, wedged config) leaves its measured rows
+ONLY in the partial. The session's last phase runs this so a window that
+never managed a clean bench_all still publishes everything it measured,
+honestly marked partial_window=true.
+
+No-op (exit 0) when there is no partial, the partial lacks TPU
+provenance, or it holds no measured rows.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    partial_path = os.path.join(REPO, "BENCH_partial.json")
+    if not os.path.exists(partial_path):
+        print("publish_partial: no partial on disk; nothing to do")
+        return 0
+    try:
+        with open(partial_path) as f:
+            partial = json.load(f)
+    except ValueError as e:
+        print(f"publish_partial: unreadable partial ({e}); leaving it")
+        return 0
+    if partial.get("on_tpu") is not True:
+        print("publish_partial: partial lacks TPU provenance; refusing")
+        return 0
+    # same 6 h freshness gate as bench.py's resume: a day-old partial
+    # promoted with window_utc=now would misdate the ratchet log
+    import time
+    age = time.time() - os.path.getmtime(partial_path)
+    if age > 6 * 3600:
+        print(f"publish_partial: partial is {age / 3600:.1f} h old "
+              "(> 6 h); refusing to stamp it as this window")
+        return 0
+    headline = partial.get("headline") or {}
+    configs = [r for r in partial.get("configs") or []
+               if isinstance(r, dict) and r.get("value") is not None
+               and "error" not in r]
+    if headline.get("value") is None and not configs:
+        print("publish_partial: no measured rows; nothing to publish")
+        return 0
+
+    sys.path.insert(0, REPO)
+    from bench import _append_tpu_window
+
+    record = dict(headline)
+    record["configs"] = partial.get("configs") or []
+    record["partial_window"] = True
+    record["source"] = ("flap-stranded BENCH_partial.json promoted by "
+                        "tools/publish_partial.py — the run that measured "
+                        "these rows never reached bench.py's own append")
+    if not _append_tpu_window(record):
+        # append failed (disk/permissions): the partial is the ONLY copy
+        # of these measurements — keep it
+        print("publish_partial: append FAILED; partial kept for retry")
+        return 1
+    os.remove(partial_path)
+    n = len(configs) + (1 if headline.get("value") is not None else 0)
+    print(f"publish_partial: appended partial window ({n} measured rows) "
+          "to BENCH_tpu.json and removed the partial")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
