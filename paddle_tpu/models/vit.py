@@ -125,8 +125,15 @@ class ViT(Layer):
         x = self.pos_drop(x + self.pos_embed)
         if self.recompute and self.training:
             from ..distributed.fleet.utils.recompute_mod import recompute
-            for blk in self.blocks:
-                x = recompute(blk, x)
+            # recompute=True: every block (max memory saving, +~33%
+            # forward recompute). recompute=N (int>=2): every Nth block —
+            # the blanket remat was added for a b32 OOM (r3s4); granular
+            # remat trades some of that headroom back for the recompute
+            # overhead, A/B'd on-chip via BENCH_VIT_REMAT.
+            stride = 1 if self.recompute is True else max(
+                1, int(self.recompute))
+            for i, blk in enumerate(self.blocks):
+                x = recompute(blk, x) if i % stride == 0 else blk(x)
         else:
             for blk in self.blocks:
                 x = blk(x)

@@ -136,6 +136,29 @@ class TestViT:
         losses = _train_steps(m, lambda: (x, y), n=5)
         assert losses[-1] < losses[0]
 
+    def test_granular_remat_matches(self):
+        """recompute=N (every Nth block) must be numerically identical to
+        no remat — it only changes what is saved vs recomputed."""
+        from paddle_tpu.models.vit import vit_tiny
+
+        def run(rc):
+            paddle.seed(11)
+            m = vit_tiny(recompute=rc)
+            m.train()
+            x = paddle.to_tensor(np.random.RandomState(3).randn(
+                2, 3, 32, 32).astype(np.float32))
+            y = paddle.to_tensor(np.array([0, 5], np.int64))
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            return (float(np.asarray(loss._data)),
+                    np.asarray(m.blocks[0].mlp.fc1.weight.grad._data))
+
+        l0, g0 = run(False)
+        for rc in (True, 2, 3):
+            l1, g1 = run(rc)
+            assert l0 == l1
+            np.testing.assert_allclose(g0, g1, atol=1e-6, rtol=1e-6)
+
     def test_patch_matmul_matches_conv(self, monkeypatch):
         """Space-to-depth patch embedding (one GEMM on the conv's own
         weights) must match the strided-conv formulation exactly — fwd

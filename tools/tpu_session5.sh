@@ -184,10 +184,17 @@ run ffn_ab_fwdbwd 1200 env PADDLE_TPU_FUSED_FFN=1 PADDLE_TPU_FUSED_FFN_BWD=1 BEN
 commit_phase ffn_ab_fwdbwd BENCH_RESULT.json
 
 # 8. ViT A/B: space-to-depth patch matmul (new default) vs strided conv.
-run vit_matmul 1200 env BENCH_ONLY=vit python bench.py
+run vit_matmul 1200 env BENCH_HEADLINE=0 BENCH_ONLY=vit python bench.py
 commit_phase vit_matmul BENCH_RESULT.json
-run vit_conv 1200 env PADDLE_TPU_PATCH_CONV=1 BENCH_ONLY=vit python bench.py
+run vit_conv 1200 env BENCH_HEADLINE=0 PADDLE_TPU_PATCH_CONV=1 BENCH_ONLY=vit python bench.py
 commit_phase vit_conv BENCH_RESULT.json
+# 8b. Granular-remat A/B: every-2nd-block, then none (OOM risk accepted —
+#     RESOURCE_EXHAUSTED here is itself the measurement; r3s4's HBM
+#     hygiene may have cured the original b32 OOM)
+run vit_remat2 1200 env BENCH_HEADLINE=0 BENCH_VIT_REMAT=2 BENCH_ONLY=vit python bench.py
+commit_phase vit_remat2 BENCH_RESULT.json
+run vit_remat0 1200 env BENCH_HEADLINE=0 BENCH_VIT_REMAT=0 BENCH_ONLY=vit python bench.py
+commit_phase vit_remat0 BENCH_RESULT.json
 
 # 9. Remaining decode ratchets: cache-backed beam search + w8c8 combo.
 #    (TP-sharded kernel decode cannot A/B here: mp>=2 needs >1 chip.)
