@@ -8,7 +8,9 @@ parts removed) when the backend produces no usable trace.
 
 Usage:  python tools/tpu_profile.py [outdir]
 Env:    PROF_STEPS (default 10), PROF_MODE=trace|ablate|both (default both),
-        BENCH_BATCH/BENCH_SEQ as in bench.py.
+        PROF_MODEL=gpt2|tiny|bert|llama (default gpt2),
+        BENCH_BATCH/BENCH_SEQ, BENCH_BERT_BATCH/BENCH_BERT_SEQ as in
+        bench.py, PROF_CPU=1 to force the CPU backend.
 """
 from __future__ import annotations
 
@@ -37,9 +39,12 @@ if os.environ.get("PROF_CPU") == "1":
     jax.config.update("jax_platforms", "cpu")
 
 
-def _build_step(donate):
-    """Bench-identical train step for PROF_MODEL ∈ {gpt2 (default), tiny,
-    bert, llama}; returns (step, args...) matching bench.py's shapes."""
+def _build_parts():
+    """Bench-identical pieces for PROF_MODEL ∈ {gpt2 (default), tiny,
+    bert, llama}, shared by the trace and ablate modes:
+    (model, opt, args, loss_call, body_call, tokens_per_step) where
+    loss_call(*args) returns the full loss (heads + CE) and
+    body_call(*args) a scalar over the backbone only (no heads/CE)."""
     import paddle_tpu as paddle
 
     target = os.environ.get("PROF_MODEL", "gpt2")
@@ -50,64 +55,91 @@ def _build_step(donate):
         from paddle_tpu.distributed.sharding import group_sharded_parallel
         batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
         seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
-        model = BertForPretraining(bert_base())
+        # bench-identical: vocab padded 30522 -> 30720 (240x128 MXU
+        # lanes) with ids sampled from the REAL vocab (bench.py bert)
+        model = BertForPretraining(bert_base(vocab_size=30720))
         opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                      parameters=model.parameters())
         model, opt = paddle.amp.decorate(model, opt, level="O2",
                                          dtype="bfloat16")
         model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
-        vocab = model._layers.config.vocab_size if hasattr(model, "_layers") \
-            else model.config.vocab_size
-        ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+        ids = rng.randint(0, 30522, (batch, seq)).astype(np.int32)
         labels = ids.copy()
         labels[rng.rand(*labels.shape) > 0.15] = -100
         args = (paddle.to_tensor(ids), paddle.to_tensor(labels),
                 paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int32)))
 
-        def _step(x, y, nsp):
+        def loss_call(x, y, nsp):
             with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
-                loss = model(x, masked_lm_labels=y, next_sentence_labels=nsp)
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
-            return loss
-    elif target == "llama":
-        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-        c = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=16,
-                        num_heads=16, intermediate_size=2816,
-                        max_position=1024)
-        batch, seq = 8, 1024
-        model = LlamaForCausalLM(c)
-        model.bfloat16()
-        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                     parameters=model.parameters(),
-                                     multi_precision=True)
-        ids = rng.randint(0, c.vocab_size, (batch, seq + 1)).astype(np.int32)
-        args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
-        _step = None     # shared LM step defined below
-    else:
-        from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
-        seq = int(os.environ.get("BENCH_SEQ", "1024"))
-        model = gpt2_tiny() if target == "tiny" else gpt2_124m()
-        model.bfloat16()
-        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                     parameters=model.parameters(),
-                                     multi_precision=True)
-        ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
-        args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
-        _step = None
+                return model(x, masked_lm_labels=y,
+                             next_sentence_labels=nsp)
 
-    if _step is None:
-        def _step(x, y):
-            loss = model(x, labels=y)
-            loss.backward()
-            opt.step()
-            opt.clear_grad()
-            return loss
+        def body_call(x, y, nsp):
+            inner = getattr(model, "_layers", model)
+            with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+                seq_out, _pooled = inner.bert(x)
+            return seq_out.sum()
+    else:
+        if target == "llama":
+            from paddle_tpu.models.llama import (LlamaConfig,
+                                                 LlamaForCausalLM)
+            c = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                            num_layers=16, num_heads=16,
+                            intermediate_size=2816, max_position=1024)
+            batch, seq = 8, 1024
+            model = LlamaForCausalLM(c)
+            vocab = c.vocab_size
+            body = "llama"
+            stage3 = True
+        else:
+            from paddle_tpu.models.gpt import gpt2_124m, gpt2_tiny
+            batch = int(os.environ.get("BENCH_BATCH", "8"))
+            seq = int(os.environ.get("BENCH_SEQ", "1024"))
+            model = gpt2_tiny() if target == "tiny" else gpt2_124m()
+            # bench-identical id range; gpt2_tiny's vocab is far smaller
+            # than 50000 and out-of-range ids profile a clamped workload
+            vocab = min(model.config.vocab_size, 50000)
+            body = "gpt"
+            stage3 = False
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+        if stage3:
+            # bench-identical: bench_llama wraps stage-3 sharding (1-dev
+            # collapse on a single chip, but step() goes through the
+            # sharded optimizer path being profiled)
+            from paddle_tpu.distributed.sharding import (
+                group_sharded_parallel)
+            model, opt, _ = group_sharded_parallel(model, opt,
+                                                   level="p_g_os")
+        ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
+        args = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
+
+        def loss_call(x, y):
+            return model(x, labels=y)
+
+        def body_call(x, y):
+            inner = getattr(model, "_layers", model)
+            return getattr(inner, body)(x).sum()
+
+    return model, opt, args, loss_call, body_call, batch * seq
+
+
+def _build_step(donate):
+    """Bench-identical train step; returns (step, args, tokens/step)."""
+    import paddle_tpu as paddle
+    model, opt, args, loss_call, _body, tokens = _build_parts()
+
+    def _step(*a):
+        loss = loss_call(*a)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
 
     step = paddle.jit.to_static(_step, donate_state=donate)
-    return step, args, batch * seq
+    return step, args, tokens
 
 
 def _drain(loss):
@@ -194,111 +226,86 @@ def profile_trace(outdir, steps):
 
 
 def profile_ablate(steps):
-    """Ablation timing: build step variants with pieces disabled and diff
-    the medians. Robust when the profiler can't see the tunnel device."""
+    """Ablation timing for PROF_MODEL (gpt2 default; bert/llama are the
+    MFU laggards this mode exists for): build step variants with pieces
+    disabled and diff the medians. Robust when the profiler can't see the
+    tunnel device."""
     import paddle_tpu as paddle
-    from paddle_tpu.models.gpt import gpt2_124m
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, 50000, (batch, seq + 1)).astype(np.int32)
 
-    def timed(make_step):
-        paddle.seed(0)
-        model = gpt2_124m()
-        model.bfloat16()
-        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                     parameters=model.parameters(),
-                                     multi_precision=True)
-        x = paddle.to_tensor(ids[:, :-1])
-        y = paddle.to_tensor(ids[:, 1:])
-        step = paddle.jit.to_static(make_step(model, opt),
-                                    donate_state=False)
-        for _ in range(3):
-            loss = step(x, y)
-        _drain(loss)
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = step(x, y)
-            _drain(loss)
-            ts.append((time.perf_counter() - t0) / steps)
-        return float(np.median(ts)) * 1e3
+    def timed(variant):
+        # fresh build per variant: donation off, optimizer state fresh
+        model, opt, args, loss_call, body_call, _tok = _build_parts()
 
-    def full(model, opt):
-        def f(x, y):
-            loss = model(x, labels=y)
+        def full(*a):
+            loss = loss_call(*a)
             loss.backward()
             opt.step()
             opt.clear_grad()
             return loss
-        return f
 
-    def no_opt(model, opt):  # fwd+bwd only
-        def f(x, y):
-            loss = model(x, labels=y)
+        def no_opt(*a):          # fwd+bwd only
+            loss = loss_call(*a)
             loss.backward()
             return loss
-        return f
 
-    def fwd_only(model, opt):
-        def f(x, y):
-            return model(x, labels=y)
-        return f
+        def fwd(*a):
+            return loss_call(*a)
 
-    def fwd_no_ce(model, opt):  # body without LM head + CE
-        def f(x, y):
-            h = model.gpt(x)
-            return h.sum()
-        return f
+        def fwd_no_head(*a):     # backbone without heads + CE
+            return body_call(*a)
 
-    def full_id_attn(model, opt):
-        # attention ablated to identity (out = q): isolates the full
-        # fwd+bwd cost of the flash kernels inside the real train step
-        from paddle_tpu.nn import functional as F
-        real = F.scaled_dot_product_attention
-
-        def fake_sdpa(q, k, v, *a, **kw):
-            return q
-
-        def f(x, y):
-            # the gpt module's `F` is this same module object, so one
-            # attribute swap reroutes the model's call
-            F.scaled_dot_product_attention = fake_sdpa
+        def id_attn(*a):
+            # attention ablated to identity (out = q): isolates the full
+            # fwd+bwd cost of the flash kernels inside the real train
+            # step — every model family routes through F.sdpa
+            from paddle_tpu.nn import functional as F
+            real = F.scaled_dot_product_attention
+            F.scaled_dot_product_attention = lambda q, *r, **kw: q
             try:
-                loss = model(x, labels=y)
+                loss = loss_call(*a)
             finally:
                 F.scaled_dot_product_attention = real
             loss.backward()
             opt.step()
             opt.clear_grad()
             return loss
-        return f
 
-    def full_no_dropout(model, opt):
-        def f(x, y):
-            model.eval()   # dropout off; still runs backward+opt
-            loss = model(x, labels=y)
+        def no_drop(*a):
+            model.eval()         # dropout off; still runs backward+opt
+            loss = loss_call(*a)
             model.train()
             loss.backward()
             opt.step()
             opt.clear_grad()
             return loss
-        return f
+
+        fn = {"full": full, "fwd+bwd": no_opt, "fwd": fwd,
+              "fwd_no_head": fwd_no_head, "full_id_attn": id_attn,
+              "full_no_drop": no_drop}[variant]
+        step = paddle.jit.to_static(fn, donate_state=False)
+        for _ in range(3):
+            loss = step(*args)
+        _drain(loss)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = step(*args)
+            _drain(loss)
+            ts.append((time.perf_counter() - t0) / steps)
+        return float(np.median(ts)) * 1e3
 
     out = {}
-    for name, mk in [("full", full), ("fwd+bwd", no_opt),
-                     ("fwd", fwd_only), ("fwd_no_ce", fwd_no_ce),
-                     ("full_id_attn", full_id_attn),
-                     ("full_no_drop", full_no_dropout)]:
-        out[name] = timed(mk)
+    for name in ("full", "fwd+bwd", "fwd", "fwd_no_head",
+                 "full_id_attn", "full_no_drop"):
+        out[name] = timed(name)
         print(f"{name:12s} {out[name]:8.2f} ms/step", file=sys.stderr)
-    print("\n== ablation deltas ==")
+    print(f"\n== ablation deltas (PROF_MODEL="
+          f"{os.environ.get('PROF_MODEL', 'gpt2')}) ==")
     print(f"optimizer+writeback : {out['full'] - out['fwd+bwd']:8.2f} ms")
     print(f"backward            : {out['fwd+bwd'] - out['fwd']:8.2f} ms")
-    print(f"LM head + CE (fwd)  : {out['fwd'] - out['fwd_no_ce']:8.2f} ms")
-    print(f"body fwd            : {out['fwd_no_ce']:8.2f} ms")
+    print(f"heads + CE (fwd)    : {out['fwd'] - out['fwd_no_head']:8.2f} ms")
+    print(f"body fwd            : {out['fwd_no_head']:8.2f} ms")
     print(f"attention fwd+bwd   : {out['full'] - out['full_id_attn']:8.2f} ms")
     print(f"all dropout         : {out['full'] - out['full_no_drop']:8.2f} ms")
     print(f"full step           : {out['full']:8.2f} ms")
