@@ -237,12 +237,16 @@ def _stacked_setup(qt, hk, smax, group):
     def _clamp(j, len_r, b_):
         return jnp.minimum(j, (len_r[b_] + sq - 1) // bk)
 
-    kidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+    # ONE kv-block operand: the (1, 2, 1, 1, bk, d) block spans BOTH the
+    # K and V planes of the kv axis, so the cache rides in as a single
+    # operand. Passing the same buffer twice (separate K and V specs) was
+    # observed to defeat XLA's in-place aliasing of the scan-carried
+    # cache update — the compiled decode step materialized TWO full-cache
+    # copies per layer (HLO inspected 2026-08-01).
+    kvidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
         lay_r[0], 0, b_, h_ // g, _clamp(j, len_r, b_), 0)
-    vidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
-        lay_r[0], 1, b_, h_ // g, _clamp(j, len_r, b_), 0)
     qidx = lambda b_, h_, j, lay_r, len_r: (b_, h_, 0, 0)  # noqa: E731
-    return qt, bq, bk, grid, kidx, vidx, qidx, _clamp
+    return qt, bq, bk, grid, kvidx, qidx, _clamp
 
 
 def stacked_i8_is_supported(q_shape, caches_shape, dtype) -> bool:
@@ -275,11 +279,11 @@ def stacked_is_supported(q_shape, caches_shape, dtype,
     return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
 
 
-def _stacked_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+def _stacked_kernel(lay_ref, len_ref, q_ref, kv_ref, o_ref,
                     acc_sc, m_sc, l_sc, *, scale, sq, bq, bk):
-    # same flash math as _kernel (shared _online_softmax_block); k/v
-    # blocks come out of the stacked buffer addressed by the prefetched
-    # layer scalar, so their block rank is 6 (leading (1, 1) layer/kv)
+    # same flash math as _kernel (shared _online_softmax_block); the
+    # (1, 2, 1, 1, bk, d) kv block comes out of the stacked buffer
+    # addressed by the prefetched layer scalar — K is plane 0, V plane 1
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     n_valid = len_ref[pl.program_id(0)]
@@ -295,8 +299,8 @@ def _stacked_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(run)
     def _():
-        _online_softmax_block(q_ref[0, 0], k_ref[0, 0, 0, 0],
-                              v_ref[0, 0, 0, 0], n_valid, k_start,
+        _online_softmax_block(q_ref[0, 0], kv_ref[0, 0, 0, 0],
+                              kv_ref[0, 1, 0, 0], n_valid, k_start,
                               acc_sc, m_sc, l_sc,
                               scale=scale, sq=sq, bq=bq, bk=bk)
 
@@ -329,8 +333,8 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
             "cache_dtype=...) and use the unstacked/dense path instead")
     out_dtype = qt.dtype
 
-    qt, bq, bk, grid, kidx, vidx, qidx, _ = _stacked_setup(qt, hk, smax,
-                                                            group)
+    qt, bq, bk, grid, kvidx, qidx, _ = _stacked_setup(qt, hk, smax,
+                                                      group)
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
@@ -341,8 +345,7 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, bq, d), qidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvidx),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
             scratch_shapes=[
@@ -353,7 +356,7 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, bq, d), caches.dtype),
         interpret=_interpret(),
-    )(lay, lens, qt, caches, caches)
+    )(lay, lens, qt, caches)
     return out[:, :, :sq].astype(out_dtype)
 
 
@@ -366,8 +369,8 @@ def decode_attention_stacked(qt, caches, layer, cache_lens, scale=None):
 # the dots (which still run in the query dtype on the MXU).
 # ---------------------------------------------------------------------------
 
-def _stacked_i8_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
-                       vs_ref, o_ref, acc_sc, m_sc, l_sc,
+def _stacked_i8_kernel(lay_ref, len_ref, q_ref, kv_ref, kvs_ref,
+                       o_ref, acc_sc, m_sc, l_sc,
                        *, scale, sq, bq, bk):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -388,14 +391,17 @@ def _stacked_i8_kernel(lay_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
         # int8 -> compute dtype conversion only (values in [-127, 127]
         # are exact in bf16); the per-row dequant scales are applied
         # column-wise to the SCORE matrix inside the softmax block,
-        # where they arrive as Mosaic-legal [1, bk] lane-major tiles
-        k = k_ref[0, 0, 0, 0].astype(q.dtype)               # [bk, d]
-        v = v_ref[0, 0, 0, 0].astype(q.dtype)
+        # where they arrive as Mosaic-legal [1, bk] lane-major tiles.
+        # Like the fp kernel, cache and scales each ride in as ONE
+        # operand whose block spans both kv planes (single-pass buffers
+        # keep the scan-carry update aliasable).
+        k = kv_ref[0, 0, 0, 0].astype(q.dtype)              # [bk, d]
+        v = kv_ref[0, 1, 0, 0].astype(q.dtype)
         _online_softmax_block(q, k, v, n_valid, k_start,
                               acc_sc, m_sc, l_sc,
                               scale=scale, sq=sq, bq=bq, bk=bk,
-                              k_col_scale=ks_ref[0, 0, 0, 0],   # [1, bk]
-                              v_col_scale=vs_ref[0, 0, 0, 0])
+                              k_col_scale=kvs_ref[0, 0, 0, 0],  # [1, bk]
+                              v_col_scale=kvs_ref[0, 1, 0, 0])
 
     @pl.when(ki == nk - 1)
     def _():
@@ -426,13 +432,10 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
             f"[L, 2, B, Hk, 1, Smax], got {cache_scales.shape}")
 
     out_dtype = qt.dtype
-    qt, bq, bk, grid, kidx, vidx, qidx, clamp = _stacked_setup(
+    qt, bq, bk, grid, kvidx, qidx, clamp = _stacked_setup(
         qt, hk, smax, group)
-    group_ = group
-    ksidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
+    kvsidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
         lay_r[0], 0, b_, h_ // g, 0, clamp(j, len_r, b_))
-    vsidx = lambda b_, h_, j, lay_r, len_r, g=group_: (  # noqa: E731
-        lay_r[0], 1, b_, h_ // g, 0, clamp(j, len_r, b_))
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
     out = pl.pallas_call(
@@ -443,10 +446,8 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, bq, d), qidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, d), kidx),
-                pl.BlockSpec((1, 1, 1, 1, bk, d), vidx),
-                pl.BlockSpec((1, 1, 1, 1, 1, bk), ksidx),
-                pl.BlockSpec((1, 1, 1, 1, 1, bk), vsidx),
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, bk), kvsidx),
             ],
             out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
             scratch_shapes=[
@@ -457,5 +458,5 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, bq, d), out_dtype),
         interpret=_interpret(),
-    )(lay, lens, qt, caches_i8, caches_i8, cache_scales, cache_scales)
+    )(lay, lens, qt, caches_i8, cache_scales)
     return out[:, :, :sq]
