@@ -1,0 +1,109 @@
+"""Compile-only probe: does XLA:TPU alias the scan-carried KV-cache
+update in place, or does it copy the full cache per layer step?
+
+The CPU backend's copy-insertion differs from TPU's, so the 2026-08-01
+CPU HLO findings (two full-cache copies per layer with the old
+double-operand kernel, one residual copy with the single-operand one)
+need on-chip ground truth before investing in an in-kernel cache write
+(pallas input_output_aliases + dynamic store). This compiles four tiny
+scan bodies on the real backend — no step is executed, so it costs only
+compile time — and counts cache-shaped copies in the optimized HLO:
+
+  dus_only    : carry = DUS(carry)                  (aliasing baseline)
+  dus_dense   : carry = DUS(carry); read dense      (the dense fallback)
+  dus_kernel1 : carry = DUS(carry); pallas(carry)   (current design)
+  dus_kernel2 : carry = DUS(carry); pallas(c, c)    (pre-r5s2 design)
+
+Prints one JSON line. Run:  python tools/decode_alias_probe.py
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    L, B, H, S, D = 3, 2, 4, 256, 32
+    shape = (L, 2, B, H, S, D)
+    cache_re = re.compile(
+        r"f32\[" + ",".join(str(d) for d in shape) + r"\][^\n]*copy\(")
+    interpret = jax.default_backend() != "tpu"
+
+    def kern1(kv_ref, o_ref):
+        o_ref[...] = kv_ref[0, 0] + kv_ref[0, 1]
+
+    def pallas1(buf):
+        return pl.pallas_call(
+            kern1,
+            grid=(B,),
+            in_specs=[pl.BlockSpec((1, 2, 1, 1, S, D),
+                                   lambda b: (0, 0, b, 0, 0, 0))],
+            out_specs=pl.BlockSpec((1, 1, S, D), lambda b: (b, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, 1, S, D), jnp.float32),
+            interpret=interpret)(buf)
+
+    def kern2(k_ref, v_ref, o_ref):
+        o_ref[...] = k_ref[0, 0] + v_ref[0, 0]
+
+    def pallas2(buf):
+        return pl.pallas_call(
+            kern2,
+            grid=(B,),
+            in_specs=[pl.BlockSpec((1, 1, 1, 1, S, D),
+                                   lambda b: (0, 0, b, 0, 0, 0)),
+                      pl.BlockSpec((1, 1, 1, 1, S, D),
+                                   lambda b: (0, 1, b, 0, 0, 0))],
+            out_specs=pl.BlockSpec((1, 1, S, D), lambda b: (b, 0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, 1, S, D), jnp.float32),
+            interpret=interpret)(buf, buf)
+
+    def dus(buf, i):
+        return jax.lax.dynamic_update_slice(
+            buf, jnp.ones((1, 1, B, 1, 1, D)), (i, 0, 0, 0, 5, 0))
+
+    def body_only(buf, i):
+        buf = dus(buf, i)
+        return buf, jnp.float32(0)
+
+    def body_dense(buf, i):
+        buf = dus(buf, i)
+        o = jax.lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)
+        return buf, o.sum()
+
+    def body_k1(buf, i):
+        buf = dus(buf, i)
+        return buf, pallas1(buf).sum()
+
+    def body_k2(buf, i):
+        buf = dus(buf, i)
+        return buf, pallas2(buf).sum()
+
+    out = {"device": str(dev), "tpu_unavailable": bool(tpu_unavailable),
+           "cache_bytes": int(np.prod(shape)) * 4}
+    for name, body in (("dus_only", body_only), ("dus_dense", body_dense),
+                       ("dus_kernel1", body_k1), ("dus_kernel2", body_k2)):
+        try:
+            fn = jax.jit(functools.partial(jax.lax.scan, body,
+                                           xs=jnp.arange(L)))
+            txt = fn.lower(jnp.zeros(shape, jnp.float32)).compile().as_text()
+            out[name] = {"full_cache_copies": len(cache_re.findall(txt))}
+        except Exception as e:  # a compile failure is itself a finding
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,12 @@ print("RING_KERNEL_OK")
 XEOF
 commit_phase ring_kernel
 
+# 1b. Compile-only aliasing ground truth (~1 min): does XLA:TPU copy the
+#     scan-carried cache per layer? Decides whether the in-kernel cache
+#     write is worth building. No TPU execution — compile time only.
+run alias_probe 600 python tools/decode_alias_probe.py
+commit_phase alias_probe
+
 # 2. Decode ratchet with the in-place KV cache (scan-carried stacked
 #    buffer + scalar-prefetch kernel). r3 ratchet: 418 tok/s; target 2x.
 run bench_decode 900 python bench_decode.py
