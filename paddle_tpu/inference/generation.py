@@ -836,11 +836,36 @@ class FusedDecoder:
                 scs = jax.lax.dynamic_update_slice(
                     caches[1], sc_new[None], (l, 0, 0, 0, 0, t))
                 caches = (ci8, scs)
+                attn = attend(q, caches, l, t)
             else:
-                caches = jax.lax.dynamic_update_slice(
-                    caches, kv_new[None].astype(caches.dtype),
-                    (l, 0, 0, 0, t, 0))
-            attn = attend(q, caches, l, t)
+                attn = None
+                if (os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE",
+                                   "0") == "1"
+                        and os.environ.get("PADDLE_TPU_STACKED_KERNEL",
+                                           "1") != "0"
+                        and mesh is None):
+                    # fused write+attend: the kernel lands the new K/V
+                    # row in place (input_output_aliases) and attends in
+                    # one pass — no XLA-side dynamic_update_slice on the
+                    # scan carry, so copy-insertion can never
+                    # materialize a full-cache copy
+                    from ..ops.pallas.decode_attention import (
+                        decode_attention_stacked_write,
+                        stacked_write_is_supported)
+                    if stacked_write_is_supported(
+                            (q.shape[0], 1, nh, hd), caches.shape,
+                            q.dtype, cache_dtype=caches.dtype):
+                        lens_ = jnp.full((q.shape[0],), t, jnp.int32)
+                        caches, o = decode_attention_stacked_write(
+                            jnp.swapaxes(q, 1, 2),
+                            kv_new.astype(caches.dtype), caches, l,
+                            lens_)
+                        attn = jnp.swapaxes(o, 1, 2)
+                if attn is None:
+                    caches = jax.lax.dynamic_update_slice(
+                        caches, kv_new[None].astype(caches.dtype),
+                        (l, 0, 0, 0, t, 0))
+                    attn = attend(q, caches, l, t)
             attn = attn.reshape(b, 1, nh * hd)
             attn = mm(attn, p["lin_w"], p.get("lin_w_s")) + \
                 p["lin_b"].astype(attn.dtype)
@@ -1080,7 +1105,9 @@ class FusedDecoder:
         # the stacked-kernel escape hatch is trace-time state: it must be
         # part of every compiled-step cache key, or flipping it after a
         # compile failure would silently reuse the failing trace
-        sk_flag = os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
+        sk_flag = (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
+                   + "/kw" + os.environ.get(
+                       "PADDLE_TPU_KERNEL_CACHE_WRITE", "0"))
         pos, last_x = 0, None
         while pos < prompt:
             chunk = 64

@@ -26,8 +26,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["decode_attention", "decode_attention_stacked",
-           "decode_attention_stacked_i8", "is_supported",
-           "stacked_is_supported", "stacked_i8_is_supported"]
+           "decode_attention_stacked_i8", "decode_attention_stacked_write",
+           "is_supported", "stacked_is_supported",
+           "stacked_i8_is_supported", "stacked_write_is_supported"]
 
 NEG_INF = -1e30
 
@@ -48,7 +49,8 @@ def is_supported(q_shape, cache_shape, dtype) -> bool:
 
 def _online_softmax_block(q, k, v, n_valid, k_start, acc_sc, m_sc, l_sc,
                           *, scale, sq, bq, bk,
-                          k_col_scale=None, v_col_scale=None):
+                          k_col_scale=None, v_col_scale=None,
+                          exclusive=False):
     """One KV block's update of the running (acc, m, l) flash state —
     shared by the per-layer and stacked-cache kernels (the only thing
     that differs between them is how refs address their blocks).
@@ -67,8 +69,14 @@ def _online_softmax_block(q, k, v, n_valid, k_start, acc_sc, m_sc, l_sc,
     rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)  # q row
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     # row r is the token at global position n_valid + r: attends the
-    # prefix (cols < n_valid) and itself/earlier new tokens (causal)
-    mask = (rows < sq) & (cols <= n_valid + rows)
+    # prefix (cols < n_valid) and itself/earlier new tokens (causal).
+    # exclusive=True: prefix ONLY (cols < n_valid) — the write-kernel's
+    # cache blocks hold stale bytes at the new token's slot; its
+    # self-attention term enters via the seeded running stats instead.
+    if exclusive:
+        mask = (rows < sq) & (cols < n_valid)
+    else:
+        mask = (rows < sq) & (cols <= n_valid + rows)
     s = jnp.where(mask, s, NEG_INF)
 
     m_prev = m_sc[:]
@@ -460,3 +468,151 @@ def decode_attention_stacked_i8(qt, caches_i8, cache_scales, layer,
         interpret=_interpret(),
     )(lay, lens, qt, caches_i8, cache_scales)
     return out[:, :, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Fused write+attend: the kernel updates the cache IN PLACE via
+# input_output_aliases and attends in the same pass. This removes the
+# XLA-side dynamic_update_slice on the scan-carried buffer entirely —
+# the aliasing is declared at the custom-call level, so copy-insertion
+# cannot conservatively materialize full-cache copies (the failure mode
+# HLO-inspected on 2026-08-01: the carry update behind a kernel read
+# copied the whole [L,2,B,Hk,Smax,D] buffer). Only the ONE block
+# containing the write slot is ever written back; all other cache blocks
+# are untouched HBM. (Reference anchor: fused_multi_transformer_op.cu's
+# in-place cache write inside the attention kernel.)
+# ---------------------------------------------------------------------------
+
+def _stacked_write_kernel(lay_ref, len_ref, q_ref, kvn_ref, kv_ref,
+                          kvo_ref, o_ref, acc_sc, m_sc, l_sc,
+                          *, scale, bq, bk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+    jw = n_valid // bk                     # block holding the write slot
+
+    @pl.when(ki == 0)
+    def _():
+        # seed the running flash stats with the NEW token's own column
+        # (its k/v ride in via kvn_ref — the cache block's bytes at the
+        # write slot are stale until this kernel writes them)
+        q = q_ref[0, 0]                                  # [bq, d]
+        kn = kvn_ref[0, 0, 0, 0]                         # [1, d]
+        vn = kvn_ref[0, 1, 0, 0]                         # [1, d]
+        s = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        valid = rows < 1                                 # sq == 1
+        m_sc[:] = jnp.where(valid, s, NEG_INF)
+        l_sc[:] = jnp.where(valid, 1.0, 0.0)
+        acc_sc[:] = jnp.where(valid, 1.0, 0.0) * vn.astype(jnp.float32)
+
+    k_start = ki * bk
+
+    @pl.when(k_start < n_valid)
+    def _():
+        _online_softmax_block(q_ref[0, 0], kv_ref[0, 0, 0, 0],
+                              kv_ref[0, 1, 0, 0], n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=1, bq=bq, bk=bk,
+                              exclusive=True)
+
+    @pl.when(ki == jw)
+    def _():
+        # copy-through the write block, then land the new token's row.
+        # The output index map is CONSTANT at jw, so this is the only
+        # cache block pallas ever writes back; the copy is one
+        # VMEM-resident block, not HBM traffic beyond the block itself.
+        off = n_valid - jw * bk
+        kvo_ref[0, 0, 0, 0] = kv_ref[0, 0, 0, 0]
+        kvo_ref[0, 1, 0, 0] = kv_ref[0, 1, 0, 0]
+        kvo_ref[0, 0, 0, 0, pl.dslice(off, 1)] = kvn_ref[0, 0, 0, 0]
+        kvo_ref[0, 1, 0, 0, pl.dslice(off, 1)] = kvn_ref[0, 1, 0, 0]
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def stacked_write_is_supported(q_shape, caches_shape, dtype,
+                               cache_dtype=None) -> bool:
+    """Same layout/tiling rules as the read-only stacked kernel, plus the
+    write path's own restriction: exactly one new token per call (the
+    chunked decode scans step one token at a time; a multi-row write
+    could straddle two sequence blocks)."""
+    return q_shape[1] == 1 and stacked_is_supported(
+        q_shape, caches_shape, dtype, cache_dtype=cache_dtype)
+
+
+def decode_attention_stacked_write(qt, kv_new, caches, layer, cache_lens,
+                                   scale=None):
+    """qt: [B, H, 1, D] (kernel layout); kv_new: [2, B, Hk, 1, D] — the
+    new token's K/V for layer `layer`; caches: [L, 2, B, Hk, Smax, D],
+    DONATED (aliased to the first output). Returns (caches, attn) where
+    caches is the SAME buffer with the new rows landed at position
+    cache_lens[b] and attn is [B, H, 1, D].
+
+    The caller must NOT dynamic_update_slice the cache first — the write
+    happens inside the kernel, and the new token's self-attention term is
+    seeded from kv_new directly."""
+    b, h, sq, d = qt.shape
+    hk, smax = caches.shape[3], caches.shape[4]
+    group = h // hk
+    if sq != 1:
+        raise ValueError("decode_attention_stacked_write: one new token "
+                         f"per call (got Sq={sq}); gate with "
+                         "stacked_write_is_supported")
+    if scale is None:
+        scale = d ** -0.5
+    if caches.dtype != qt.dtype:
+        raise ValueError(
+            f"decode_attention_stacked_write: query dtype {qt.dtype} != "
+            f"cache dtype {caches.dtype}")
+    out_dtype = qt.dtype
+
+    qt, bq, bk, grid, kvidx, qidx, _clamp = _stacked_setup(
+        qt, hk, smax, group)
+    kvnidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        0, 0, b_, h_ // g, 0, 0)
+    # The OUTPUT map is the write-slot block UNCONDITIONALLY (constant in
+    # j) — it must NOT reuse the read clamp min(j, jw): for j < jw that
+    # addresses prefix blocks the kernel never stores to, and Pallas
+    # would write their stale VMEM windows back over live cache. With a
+    # constant map, exactly one block per (b, hk) is ever written back;
+    # every other cache block stays untouched HBM through the alias.
+    kvoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, len_r[b_] // bk_, 0)
+    kv_new = kv_new[None]                  # [1, 2, B, Hk, 1, D]
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    caches_out, out = pl.pallas_call(
+        functools.partial(_stacked_write_kernel, scale=float(scale),
+                          bq=bq, bk=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, d), kvnidx),
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvidx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvoidx),
+                pl.BlockSpec((1, 1, bq, d), qidx),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(caches.shape, caches.dtype),
+            jax.ShapeDtypeStruct((b, h, bq, d), out_dtype),
+        ],
+        input_output_aliases={4: 0},   # caches operand -> caches output
+        interpret=_interpret(),
+    )(lay, lens, qt, kv_new.astype(caches.dtype), caches)
+    return caches_out, out[:, :, :sq].astype(out_dtype)

@@ -559,3 +559,48 @@ class TestBeamPrefixSplit:
                        num_beams=3)
         np.testing.assert_array_equal(np.asarray(out._data),
                                       np.asarray(ref._data))
+
+
+class TestKernelCacheWrite:
+    """r5 s2: PADDLE_TPU_KERNEL_CACHE_WRITE=1 — the fused write+attend
+    kernel lands the new K/V row in place (input_output_aliases) instead
+    of an XLA-side dynamic_update_slice on the scan carry. Token parity
+    with the default path across greedy, sampling, and beam decode, and
+    the kernel path must actually be taken."""
+
+    def _run(self, monkeypatch, on, **gen_kw):
+        import paddle_tpu as paddle
+        if on:
+            monkeypatch.setenv("PADDLE_TPU_KERNEL_CACHE_WRITE", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_KERNEL_CACHE_WRITE",
+                               raising=False)
+        paddle.seed(61)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(seed=17)
+        return generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                              head=m.head, max_seq_len=128, **gen_kw)
+
+    def test_greedy_parity_and_path(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as da
+        ref = self._run(monkeypatch, on=False, max_new_tokens=8)
+        calls = []
+        real = da.decode_attention_stacked_write
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+        monkeypatch.setattr(da, "decode_attention_stacked_write", spy)
+        out = self._run(monkeypatch, on=True, max_new_tokens=8)
+        assert calls, "write-kernel mode fell back to the DUS path"
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_beam_parity(self, monkeypatch):
+        ref = self._run(monkeypatch, on=False, max_new_tokens=6,
+                        num_beams=3)
+        out = self._run(monkeypatch, on=True, max_new_tokens=6,
+                        num_beams=3)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))

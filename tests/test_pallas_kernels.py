@@ -407,6 +407,38 @@ def test_decode_attention_clamped_index_multiblock():
                                    atol=3e-5, rtol=3e-5)
 
 
+def test_decode_attention_stacked_write_parity():
+    """Fused write+attend (in-place cache via input_output_aliases) must
+    equal DUS-then-read exactly: attention output AND the full cache
+    buffer (landed rows, untouched prefix, untouched other layers) —
+    across lens that sit mid-block and exactly on a block boundary."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    L, b, h, d, smax = 2, 3, 4, 32, 512
+    rng = np.random.RandomState(7)
+    caches = jnp.asarray(rng.randn(L, 2, b, h, smax, d), jnp.float32)
+    q = jnp.asarray(rng.randn(b, h, 1, d), jnp.float32)
+    kv_new = jnp.asarray(rng.randn(2, b, h, 1, d), jnp.float32)
+    lens = jnp.asarray([30, 255, 256], jnp.int32)
+    assert da.stacked_write_is_supported((b, 1, h, d), caches.shape,
+                                         q.dtype)
+
+    for l in range(L):
+        ref_caches = caches
+        for bi in range(b):
+            for kv in range(2):
+                ref_caches = jax.lax.dynamic_update_slice(
+                    ref_caches,
+                    kv_new[kv, bi, :, 0][None, None, None, :, None, :],
+                    (l, kv, bi, 0, int(lens[bi]), 0))
+        ref_o = da.decode_attention_stacked(q, ref_caches, l, lens)
+        got_caches, got_o = da.decode_attention_stacked_write(
+            q, kv_new, caches, l, lens)
+        np.testing.assert_allclose(np.asarray(got_o), np.asarray(ref_o),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_array_equal(np.asarray(got_caches),
+                                      np.asarray(ref_caches))
+
+
 class TestFlashDropout:
     """Flash attention with seed-regenerated dropout (fwd/bwd mask parity)."""
 

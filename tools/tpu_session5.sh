@@ -159,6 +159,11 @@ commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
 run bench_decode_dense 900 env PADDLE_TPU_STACKED_KERNEL=0 python bench_decode.py
 commit_phase bench_decode_dense
 
+# 3c. Fused write+attend kernel (in-place cache via input_output_aliases,
+#     zero XLA-side DUS on the carry) — the copy-elimination A/B.
+run bench_decode_kw 900 env PADDLE_TPU_KERNEL_CACHE_WRITE=1 python bench_decode.py
+commit_phase bench_decode_kw
+
 # 4. int8 decode ladder: cache (halves KV stream), weights (halves the
 #    dominant ~250 MB/token weight stream), full stack incl. LM head.
 run bench_decode_i8 900 env PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
