@@ -79,6 +79,40 @@ def _build_parts():
             with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
                 seq_out, _pooled = inner.bert(x)
             return seq_out.sum()
+    elif target == "vit":
+        # bench-identical ViT-L/16 (bench.py bench_vit): b32x224 bf16,
+        # granular remat via BENCH_VIT_REMAT, AdamW fp32 masters
+        from paddle_tpu.models.vit import vit_l_16
+        batch = int(os.environ.get("BENCH_VIT_BATCH", "32"))
+        seq = 224
+        model = vit_l_16(
+            recompute=int(os.environ.get("BENCH_VIT_REMAT", "1")))
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=True)
+        x_np = rng.randn(batch, 3, seq, seq).astype(np.float32)
+        y_np = rng.randint(0, 1000, (batch,)).astype(np.int32)
+        args = (paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+
+        def loss_call(x, y):
+            import paddle_tpu.nn.functional as F
+            return F.cross_entropy(model(x), y)
+
+        def body_call(x, y):
+            # backbone without the classifier head/CE: reuse the model's
+            # own forward with the head detached is invasive; the head is
+            # one [D, 1000] matmul — time it via fwd minus fwd_no_head
+            head = model.head
+            model.head = None
+            try:
+                out = model(x)
+            finally:
+                model.head = head
+            return out.sum()
+
+        # tokens/step analogue: patches per image
+        return model, opt, args, loss_call, body_call, batch * 197
     else:
         if target == "llama":
             from paddle_tpu.models.llama import (LlamaConfig,

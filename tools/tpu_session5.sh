@@ -222,6 +222,10 @@ run prof_llama 1200 env PROF_MODEL=llama PROF_MODE=ablate python tools/tpu_profi
 commit_phase prof_llama
 run prof_vit 1500 python tools/vit_profile.py
 commit_phase prof_vit
+# hlo_category breakdown of the ViT step (device-track perfetto trace):
+# names the actual time sinks (conv layout? small-seq attention? remat?)
+run prof_vit_trace 1200 env PROF_MODEL=vit PROF_MODE=trace python tools/tpu_profile.py /tmp/vit_trace
+commit_phase prof_vit_trace
 
 # 11. Decode cost localization.
 run decode_profile 1500 python tools/decode_profile.py
