@@ -94,15 +94,12 @@ def main():
             "PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1" else "fp"),
         "head_mode": ("int8" if os.environ.get(
             "PADDLE_TPU_DECODE_INT8_HEAD") == "1" else "fp"),
-        # "stacked-write" only when the write kernel actually engages:
-        # the int8-cache (quant tuple) branch wins over the kw flag in
-        # generation.py layer_step, so that combination reports "stacked"
+        # both the fp and int8-cache branches have write-kernel flavors,
+        # so the kw flag alone decides the label
         "attention_path": ("dense-fallback" if os.environ.get(
             "PADDLE_TPU_STACKED_KERNEL") == "0" else
-            ("stacked-write" if (
-                os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE") == "1"
-                and os.environ.get("PADDLE_TPU_DECODE_INT8_CACHE") != "1")
-             else "stacked")),
+            ("stacked-write" if os.environ.get(
+                "PADDLE_TPU_KERNEL_CACHE_WRITE") == "1" else "stacked")),
         "num_beams": max(beams, 1),
     }
     if tpu_unavailable:

@@ -789,6 +789,12 @@ class FusedDecoder:
 
         def layer_step(x, p, caches, l, t):
             quant_w = "qkv_w_s" in p
+            # one gate for both cache flavors' fused write+attend branch
+            kw_on = (os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE",
+                                    "0") == "1"
+                     and os.environ.get("PADDLE_TPU_STACKED_KERNEL",
+                                        "1") != "0"
+                     and mesh is None)
 
             def mm(a, w, s=None):
                 # weight-only int8: dot on the exact int-valued weights
@@ -822,28 +828,44 @@ class FusedDecoder:
             kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
                                 jnp.swapaxes(v, 1, 2)])  # [2, B, H, 1, D]
             if isinstance(caches, tuple):
-                # cache-quant write: per-row absmax int8 + fp32 scale
-                kv32 = kv_new.astype(jnp.float32)
-                amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
-                sc_new = amax / 127.0
-                q_new = jnp.clip(
-                    jnp.round(kv32 / jnp.maximum(sc_new, 1e-8)),
-                    -127, 127).astype(jnp.int8)
-                ci8 = jax.lax.dynamic_update_slice(
-                    caches[0], q_new[None], (l, 0, 0, 0, t, 0))
-                # scale layout is [L, 2, B, H, 1, Smax]: position on the
-                # last axis, so this token's scales land at [..., 0, t]
-                scs = jax.lax.dynamic_update_slice(
-                    caches[1], sc_new[None], (l, 0, 0, 0, 0, t))
-                caches = (ci8, scs)
-                attn = attend(q, caches, l, t)
+                attn = None
+                if kw_on:
+                    # fused write+attend, int8 flavor: quantizes the new
+                    # row IN KERNEL (bit-identical recipe) and lands row
+                    # + scale in place — no XLA DUS on either carried
+                    # buffer (see the fp branch below for why)
+                    from ..ops.pallas.decode_attention import (
+                        decode_attention_stacked_i8_write,
+                        stacked_i8_write_is_supported)
+                    if stacked_i8_write_is_supported(
+                            (q.shape[0], 1, nh, hd), caches[0].shape,
+                            q.dtype):
+                        lens_ = jnp.full((q.shape[0],), t, jnp.int32)
+                        ci8, scs, o = decode_attention_stacked_i8_write(
+                            jnp.swapaxes(q, 1, 2), kv_new, caches[0],
+                            caches[1], l, lens_)
+                        caches = (ci8, scs)
+                        attn = jnp.swapaxes(o, 1, 2)
+                if attn is None:
+                    # cache-quant write: per-row absmax int8 + fp32 scale
+                    kv32 = kv_new.astype(jnp.float32)
+                    amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
+                    sc_new = amax / 127.0
+                    q_new = jnp.clip(
+                        jnp.round(kv32 / jnp.maximum(sc_new, 1e-8)),
+                        -127, 127).astype(jnp.int8)
+                    ci8 = jax.lax.dynamic_update_slice(
+                        caches[0], q_new[None], (l, 0, 0, 0, t, 0))
+                    # scale layout is [L, 2, B, H, 1, Smax]: position on
+                    # the last axis, so this token's scales land at
+                    # [..., 0, t]
+                    scs = jax.lax.dynamic_update_slice(
+                        caches[1], sc_new[None], (l, 0, 0, 0, 0, t))
+                    caches = (ci8, scs)
+                    attn = attend(q, caches, l, t)
             else:
                 attn = None
-                if (os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE",
-                                   "0") == "1"
-                        and os.environ.get("PADDLE_TPU_STACKED_KERNEL",
-                                           "1") != "0"
-                        and mesh is None):
+                if kw_on:
                     # fused write+attend: the kernel lands the new K/V
                     # row in place (input_output_aliases) and attends in
                     # one pass — no XLA-side dynamic_update_slice on the

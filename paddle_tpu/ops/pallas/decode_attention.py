@@ -27,8 +27,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["decode_attention", "decode_attention_stacked",
            "decode_attention_stacked_i8", "decode_attention_stacked_write",
+           "decode_attention_stacked_i8_write",
            "is_supported", "stacked_is_supported",
-           "stacked_i8_is_supported", "stacked_write_is_supported"]
+           "stacked_i8_is_supported", "stacked_write_is_supported",
+           "stacked_i8_write_is_supported"]
 
 NEG_INF = -1e30
 
@@ -519,15 +521,19 @@ def _stacked_write_kernel(lay_ref, len_ref, q_ref, kvn_ref, kv_ref,
 
     @pl.when(ki == jw)
     def _():
-        # copy-through the write block, then land the new token's row.
-        # The output index map is CONSTANT at jw, so this is the only
-        # cache block pallas ever writes back; the copy is one
-        # VMEM-resident block, not HBM traffic beyond the block itself.
+        # copy-through the write block with the new token's row selected
+        # in (row-mask select — one vector op per plane, no dynamic-
+        # offset store for Mosaic to choke on). The output index map is
+        # CONSTANT at jw, so this is the only cache block pallas ever
+        # writes back; the copy is one VMEM-resident block, not HBM
+        # traffic beyond the block itself.
         off = n_valid - jw * bk
-        kvo_ref[0, 0, 0, 0] = kv_ref[0, 0, 0, 0]
-        kvo_ref[0, 1, 0, 0] = kv_ref[0, 1, 0, 0]
-        kvo_ref[0, 0, 0, 0, pl.dslice(off, 1)] = kvn_ref[0, 0, 0, 0]
-        kvo_ref[0, 1, 0, 0, pl.dslice(off, 1)] = kvn_ref[0, 1, 0, 0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+        hit = rows == off
+        kvo_ref[0, 0, 0, 0] = jnp.where(hit, kvn_ref[0, 0, 0, 0],
+                                        kv_ref[0, 0, 0, 0])
+        kvo_ref[0, 1, 0, 0] = jnp.where(hit, kvn_ref[0, 1, 0, 0],
+                                        kv_ref[0, 1, 0, 0])
 
     @pl.when(ki == nk - 1)
     def _():
@@ -616,3 +622,169 @@ def decode_attention_stacked_write(qt, kv_new, caches, layer, cache_lens,
         interpret=_interpret(),
     )(lay, lens, qt, kv_new.astype(caches.dtype), caches)
     return caches_out, out[:, :, :sq].astype(out_dtype)
+
+
+def _stacked_i8_write_kernel(lay_ref, len_ref, q_ref, kvn_ref, kv_ref,
+                             kvs_ref, kvo_ref, kvso_ref, o_ref,
+                             acc_sc, m_sc, l_sc, *, scale, bq, bk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+    jw = n_valid // bk
+
+    # the new row's quantization (per-row absmax, same recipe as the
+    # host-side cache-quant write) — computed where needed; the seeded
+    # self-attention term uses the DEQUANTIZED values so the kernel is
+    # bit-consistent with the DUS-then-read int8 path
+    def _quant(row):                                     # [1, d] fp
+        r32 = row.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+        sc = amax / 127.0
+        qi = jnp.clip(jnp.round(r32 / jnp.maximum(sc, 1e-8)),
+                      -127, 127)
+        return qi, sc
+
+    @pl.when(ki == 0)
+    def _():
+        # seed arithmetic MIRRORS the read kernel exactly (bit-for-bit
+        # with the DUS-then-read path in every dtype): dot the RAW int
+        # values in the query dtype (all of [-127, 127] is exact in
+        # bf16), apply the k scale to the SCORE, fold the v scale into p
+        # and cast p to the operand dtype before the v dot
+        q = q_ref[0, 0]                                  # [bq, d]
+        kq, ksc = _quant(kvn_ref[0, 0, 0, 0])
+        vq, vsc = _quant(kvn_ref[0, 1, 0, 0])
+        s = jax.lax.dot_general(q, kq.astype(q.dtype),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) \
+            * scale * ksc
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        valid = rows < 1                                 # sq == 1
+        m_sc[:] = jnp.where(valid, s, NEG_INF)
+        l_sc[:] = jnp.where(valid, 1.0, 0.0)
+        pv = (jnp.where(valid, 1.0, 0.0) * vsc).astype(q.dtype)
+        acc_sc[:] = jax.lax.dot_general(
+            pv, vq.astype(q.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    k_start = ki * bk
+
+    @pl.when(k_start < n_valid)
+    def _():
+        q = q_ref[0, 0]
+        k = kv_ref[0, 0, 0, 0].astype(q.dtype)
+        v = kv_ref[0, 1, 0, 0].astype(q.dtype)
+        _online_softmax_block(q, k, v, n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=1, bq=bq, bk=bk,
+                              k_col_scale=kvs_ref[0, 0, 0, 0],
+                              v_col_scale=kvs_ref[0, 1, 0, 0],
+                              exclusive=True)
+
+    @pl.when(ki == jw)
+    def _():
+        off = n_valid - jw * bk
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+        hit = rows == off
+        kq, ksc = _quant(kvn_ref[0, 0, 0, 0])
+        vq, vsc = _quant(kvn_ref[0, 1, 0, 0])
+        kvo_ref[0, 0, 0, 0] = jnp.where(hit, kq.astype(jnp.int8),
+                                        kv_ref[0, 0, 0, 0])
+        kvo_ref[0, 1, 0, 0] = jnp.where(hit, vq.astype(jnp.int8),
+                                        kv_ref[0, 1, 0, 0])
+        # scales tile is [1, bk] lane-major: the write slot is a LANE
+        # select at `off` (no dynamic store)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        lhit = lanes == off
+        kvso_ref[0, 0, 0, 0] = jnp.where(lhit, ksc.reshape(1, 1),
+                                         kvs_ref[0, 0, 0, 0])
+        kvso_ref[0, 1, 0, 0] = jnp.where(lhit, vsc.reshape(1, 1),
+                                         kvs_ref[0, 1, 0, 0])
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def stacked_i8_write_is_supported(q_shape, caches_shape, dtype) -> bool:
+    """Gate for decode_attention_stacked_i8_write: the int8 read rules
+    plus the write path's one-new-token restriction (same rationale as
+    stacked_write_is_supported)."""
+    return q_shape[1] == 1 and stacked_i8_is_supported(
+        q_shape, caches_shape, dtype)
+
+
+def decode_attention_stacked_i8_write(qt, kv_new, caches_i8, cache_scales,
+                                      layer, cache_lens, scale=None):
+    """int8 variant of decode_attention_stacked_write: quantizes the new
+    token's K/V rows IN KERNEL (per-row absmax, bit-identical to the
+    host-side cache-quant write), lands row + scale in place (both
+    buffers aliased), and attends in the same pass. qt: [B, H, 1, D];
+    kv_new: [2, B, Hk, 1, D] (fp); caches_i8: [L, 2, B, Hk, Smax, D]
+    int8 DONATED; cache_scales: [L, 2, B, Hk, 1, Smax] fp32 DONATED.
+    Returns (caches_i8, cache_scales, attn)."""
+    b, h, sq, d = qt.shape
+    hk, smax = caches_i8.shape[3], caches_i8.shape[4]
+    group = h // hk
+    if sq != 1:
+        raise ValueError("decode_attention_stacked_i8_write: one new "
+                         f"token per call (got Sq={sq})")
+    if scale is None:
+        scale = d ** -0.5
+    if caches_i8.dtype != jnp.int8:
+        raise ValueError("decode_attention_stacked_i8_write: cache must "
+                         "be int8")
+    if cache_scales.shape != caches_i8.shape[:4] + (1, smax):
+        raise ValueError(
+            "decode_attention_stacked_i8_write: scales must be "
+            f"[L, 2, B, Hk, 1, Smax], got {cache_scales.shape}")
+    out_dtype = qt.dtype
+
+    qt, bq, bk, grid, kvidx, qidx, clamp = _stacked_setup(
+        qt, hk, smax, group)
+    kvnidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        0, 0, b_, h_ // g, 0, 0)
+    kvsidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, 0, clamp(j, len_r, b_))
+    # constant-at-jw output maps (see decode_attention_stacked_write)
+    kvoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, len_r[b_] // bk_, 0)
+    kvsoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
+        lay_r[0], 0, b_, h_ // g, 0, len_r[b_] // bk_)
+    kv_new = kv_new[None]                  # [1, 2, B, Hk, 1, D]
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    caches_out, scales_out, out = pl.pallas_call(
+        functools.partial(_stacked_i8_write_kernel, scale=float(scale),
+                          bq=bq, bk=bk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, d), kvnidx),
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, bk), kvsidx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 2, 1, 1, bk, d), kvoidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, bk), kvsoidx),
+                pl.BlockSpec((1, 1, bq, d), qidx),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(caches_i8.shape, jnp.int8),
+            jax.ShapeDtypeStruct(cache_scales.shape, jnp.float32),
+            jax.ShapeDtypeStruct((b, h, bq, d), out_dtype),
+        ],
+        input_output_aliases={4: 0, 5: 1},
+        interpret=_interpret(),
+    )(lay, lens, qt, kv_new.astype(jnp.float32), caches_i8, cache_scales)
+    return caches_out, scales_out, out[:, :, :sq].astype(out_dtype)

@@ -604,3 +604,19 @@ class TestKernelCacheWrite:
                         num_beams=3)
         np.testing.assert_array_equal(np.asarray(out._data),
                                       np.asarray(ref._data))
+
+    def test_int8_cache_parity(self, monkeypatch):
+        from paddle_tpu.ops.pallas import decode_attention as da
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        ref = self._run(monkeypatch, on=False, max_new_tokens=8)
+        calls = []
+        real = da.decode_attention_stacked_i8_write
+
+        def spy(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+        monkeypatch.setattr(da, "decode_attention_stacked_i8_write", spy)
+        out = self._run(monkeypatch, on=True, max_new_tokens=8)
+        assert calls, "int8 write-kernel mode fell back to the DUS path"
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))

@@ -714,3 +714,49 @@ class TestFusedFFNBwdKernels:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 atol=0.15, rtol=0.05)
+
+
+def test_decode_attention_stacked_i8_write_parity():
+    """int8 fused write+attend: in-kernel quantization must be
+    bit-identical to the host-side cache-quant write (int8 rows AND fp32
+    scales), and the attention output must match quant-then-read."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+    L, b, h, d, smax = 2, 3, 4, 32, 512
+    rng = np.random.RandomState(7)
+    cf = jnp.asarray(rng.randn(L, 2, b, h, smax, d), jnp.float32)
+    amax = jnp.max(jnp.abs(cf), axis=-1, keepdims=True)
+    sc = amax / 127.0
+    c_i8 = jnp.clip(jnp.round(cf / jnp.maximum(sc, 1e-8)),
+                    -127, 127).astype(jnp.int8)
+    scales = jnp.swapaxes(sc, -1, -2)          # [L,2,B,H,1,Smax]
+    q = jnp.asarray(rng.randn(b, h, 1, d), jnp.float32)
+    kv_new = jnp.asarray(rng.randn(2, b, h, 1, d), jnp.float32)
+    lens = jnp.asarray([30, 255, 256], jnp.int32)
+
+    def host_quant(row):
+        r32 = row.astype(jnp.float32)
+        am = jnp.max(jnp.abs(r32), axis=-1, keepdims=True)
+        s = am / 127.0
+        qv = jnp.clip(jnp.round(r32 / jnp.maximum(s, 1e-8)),
+                      -127, 127).astype(jnp.int8)
+        return qv, s
+
+    for l in range(L):
+        rc, rs = c_i8, scales
+        for bi in range(b):
+            for kv in range(2):
+                qv, s = host_quant(kv_new[kv, bi, :, 0])   # [h,d],[h,1]
+                rc = jax.lax.dynamic_update_slice(
+                    rc, qv[None, None, None, :, None, :],
+                    (l, kv, bi, 0, int(lens[bi]), 0))
+                rs = jax.lax.dynamic_update_slice(
+                    rs, s.reshape(1, 1, 1, h, 1, 1),
+                    (l, kv, bi, 0, 0, int(lens[bi])))
+        ref_o = da.decode_attention_stacked_i8(q, rc, rs, l, lens)
+        gc, gs, go = da.decode_attention_stacked_i8_write(
+            q, kv_new, c_i8, scales, l, lens)
+        np.testing.assert_allclose(np.asarray(go), np.asarray(ref_o),
+                                   atol=3e-5, rtol=3e-5)
+        np.testing.assert_array_equal(np.asarray(gc), np.asarray(rc))
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(rs),
+                                   atol=1e-7)

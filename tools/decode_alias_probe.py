@@ -37,8 +37,14 @@ def main() -> int:
 
     L, B, H, S, D = 3, 2, 4, 256, 32
     shape = (L, 2, B, H, S, D)
-    cache_re = re.compile(
-        r"f32\[" + ",".join(str(d) for d in shape) + r"\][^\n]*copy\(")
+    # every carry-buffer shape whose copies would defeat the design: the
+    # fp cache, the int8 cache, AND the i8 mode's fp32 scales buffer
+    # (the second aliased output — its aliasing is the riskier half)
+    def _shape_re(prefix, dims):
+        return re.compile(prefix + r"\[" + ",".join(str(d) for d in dims)
+                          + r"\][^\n]*copy\(")
+    carry_res = [_shape_re("f32", shape), _shape_re("s8", shape),
+                 _shape_re("f32", shape[:4] + (1, shape[4]))]
     interpret = jax.default_backend() != "tpu"
 
     def kern1(kv_ref, o_ref):
@@ -104,16 +110,32 @@ def main() -> int:
         buf, o = decode_attention_stacked_write(q, kvn, buf, i, lens)
         return buf, o.sum()
 
+    from paddle_tpu.ops.pallas.decode_attention import (
+        decode_attention_stacked_i8_write)
+    buf_i8 = jnp.zeros(shape, jnp.int8)
+    buf_sc = jnp.zeros(shape[:4] + (1, shape[4]), jnp.float32)
+
+    def body_kw_i8(carry, i):
+        ci, sc = carry
+        ci, sc, o = decode_attention_stacked_i8_write(q, kvn, ci, sc, i,
+                                                      lens)
+        return (ci, sc), o.sum()
+
     out = {"device": str(dev), "tpu_unavailable": bool(tpu_unavailable),
            "cache_bytes": int(np.prod(shape)) * 4}
-    for name, body in (("dus_only", body_only), ("dus_dense", body_dense),
-                       ("dus_kernel1", body_k1), ("dus_kernel2", body_k2),
-                       ("kernel_write", body_kw)):
+    for name, body, init in (
+            ("dus_only", body_only, None), ("dus_dense", body_dense, None),
+            ("dus_kernel1", body_k1, None), ("dus_kernel2", body_k2, None),
+            ("kernel_write", body_kw, None),
+            ("kernel_write_i8", body_kw_i8, (buf_i8, buf_sc))):
         try:
             fn = jax.jit(functools.partial(jax.lax.scan, body,
                                            xs=jnp.arange(L)))
-            txt = fn.lower(jnp.zeros(shape, jnp.float32)).compile().as_text()
-            out[name] = {"full_cache_copies": len(cache_re.findall(txt))}
+            txt = fn.lower(init if init is not None
+                           else jnp.zeros(shape, jnp.float32)
+                           ).compile().as_text()
+            out[name] = {"full_cache_copies":
+                         sum(len(r.findall(txt)) for r in carry_res)}
         except Exception as e:  # a compile failure is itself a finding
             out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
     print(json.dumps(out))

@@ -163,6 +163,10 @@ commit_phase bench_decode_dense
 #     zero XLA-side DUS on the carry) — the copy-elimination A/B.
 run bench_decode_kw 900 env PADDLE_TPU_KERNEL_CACHE_WRITE=1 python bench_decode.py
 commit_phase bench_decode_kw
+# 3d. int8 cache + write kernel: in-kernel quantization, both buffers
+#     aliased — the best-bandwidth decode mode without the DUS hazard.
+run bench_decode_i8kw 900 env PADDLE_TPU_KERNEL_CACHE_WRITE=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
+commit_phase bench_decode_i8kw
 
 # 4. int8 decode ladder: cache (halves KV stream), weights (halves the
 #    dominant ~250 MB/token weight stream), full stack incl. LM head.
