@@ -101,6 +101,8 @@ def main():
             ("stacked-write" if os.environ.get(
                 "PADDLE_TPU_KERNEL_CACHE_WRITE") == "1" else "stacked")),
         "num_beams": max(beams, 1),
+        "prefill_mode": ("bulk" if os.environ.get(
+            "PADDLE_TPU_BULK_PREFILL") == "1" else "scan"),
     }
     if tpu_unavailable:
         record["tpu_unavailable"] = True

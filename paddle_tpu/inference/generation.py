@@ -510,6 +510,39 @@ class FusedDecoder:
         tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
         return jax.jit(prefill, donate_argnums=() if tunneled else (2,))
 
+    def _build_bulk_prefill(self):
+        """Whole-prompt prefill (PADDLE_TPU_BULK_PREFILL=1): ONE jitted
+        call embeds the prompt, runs the stack with causal flash, and
+        builds the ring cache by PADDING the per-layer K/V scan output to
+        Smax — the cache is born in its final buffer (no DUS, no carry,
+        nothing for copy-insertion to get wrong). One executable per
+        exact prompt length (serving should bucket prompts; the chunked
+        per-token prefill remains the default). Composes with the int8
+        cache (vectorized absmax quant of the whole stack) and int8
+        weight stacks (mm handles them)."""
+        bulk_hidden = self._build_step_core(False, 0, 1.0, 1.0).bulk_hidden
+        smax = self.smax
+        cache_dtype = self.fmt.qkv_weights[0]._data.dtype
+        int8 = self._int8_cache()
+
+        def prefill(stk, e_arrays, toks):
+            last_x, kv_all = bulk_hidden(stk, e_arrays, toks)
+            S = toks.shape[1]
+            pad = [(0, 0)] * 4 + [(0, smax - S), (0, 0)]
+            if int8:
+                kv32 = kv_all.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
+                sc = amax / 127.0
+                q_i8 = jnp.clip(jnp.round(kv32 / jnp.maximum(sc, 1e-8)),
+                                -127, 127).astype(jnp.int8)
+                caches = (jnp.pad(q_i8, pad),
+                          jnp.pad(jnp.swapaxes(sc, -1, -2),
+                                  [(0, 0)] * 5 + [(0, smax - S)]))
+            else:
+                caches = jnp.pad(kv_all.astype(cache_dtype), pad)
+            return last_x, caches
+        return jax.jit(prefill)
+
     def _build_head_sample(self, do_sample, top_k, top_p, temperature,
                            eos=None, min_length=0,
                            repetition_penalty=1.0):
@@ -787,36 +820,58 @@ class FusedDecoder:
                            cache[1].astype(jnp.float32))
             return jnp.swapaxes(o, 1, 2).astype(q.dtype)
 
+        def mm_p(a, w, s=None):
+            # weight-only int8: dot on the exact int-valued weights
+            # (bf16-exact in [-127, 127], fp32 accumulation), then
+            # the per-out-channel dequant scale on the [B, O] result
+            out_ = a @ w.astype(a.dtype)
+            return out_ * s.astype(a.dtype) if s is not None else out_
+
+        def qkv_of(h, p):
+            # [B, T, E] -> q, k, v [B, T, nh, hd]; handles the weight-
+            # only-int8 stacks ([O, I] pre-reshaped at stack time)
+            if "qkv_w_s" in p:
+                qkv = mm_p(h, p["qkv_w"].T, p["qkv_w_s"]) + \
+                    p["qkv_b"].reshape(-1).astype(h.dtype)
+            else:
+                w = p["qkv_w"].reshape(3 * nh * hd, h.shape[-1]).T
+                qkv = h @ w.astype(h.dtype) + \
+                    p["qkv_b"].reshape(-1).astype(h.dtype)
+            qkv = qkv.reshape(h.shape[0], h.shape[1], 3, nh, hd)
+            return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def proj_ffn_tail(residual, attn_flat, p):
+            # shared post-attention half of a layer: out-proj + residual
+            # + (post-)LN + FFN — shape-agnostic over the token dim, so
+            # the per-token step and bulk prefill cannot diverge
+            attn = mm_p(attn_flat, p["lin_w"], p.get("lin_w_s")) + \
+                p["lin_b"].astype(attn_flat.dtype)
+            x = residual + attn
+            if not pre_ln:
+                x = ln(x, p["ln_s"], p["ln_b"])
+            residual = x
+            h = ln(x, p["fln_s"], p["fln_b"]) if pre_ln else x
+            h = mm_p(h, p["f1_w"], p.get("f1_w_s")) + \
+                p["f1_b"].astype(h.dtype)
+            h = getattr(jax.nn, act)(h)
+            h = mm_p(h, p["f2_w"], p.get("f2_w_s")) + \
+                p["f2_b"].astype(h.dtype)
+            x = residual + h
+            if not pre_ln:
+                x = ln(x, p["fln_s"], p["fln_b"])
+            return x
+
         def layer_step(x, p, caches, l, t):
-            quant_w = "qkv_w_s" in p
             # one gate for both cache flavors' fused write+attend branch
             kw_on = (os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE",
                                     "0") == "1"
                      and os.environ.get("PADDLE_TPU_STACKED_KERNEL",
                                         "1") != "0"
                      and mesh is None)
-
-            def mm(a, w, s=None):
-                # weight-only int8: dot on the exact int-valued weights
-                # (bf16-exact in [-127, 127], fp32 accumulation), then
-                # the per-out-channel dequant scale on the [B, O] result
-                out_ = a @ w.astype(a.dtype)
-                return out_ * s.astype(a.dtype) if s is not None else out_
-
             residual = x
             h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
-            emb = h.shape[-1]
-            if quant_w:
-                # pre-reshaped to [O, I] at stack time
-                qkv = mm(h, p["qkv_w"].T, p["qkv_w_s"]) + \
-                    p["qkv_b"].reshape(-1).astype(h.dtype)
-            else:
-                w = p["qkv_w"].reshape(3 * nh * hd, emb).T
-                qkv = h @ w.astype(h.dtype) + \
-                    p["qkv_b"].reshape(-1).astype(h.dtype)
             b = h.shape[0]
-            qkv = qkv.reshape(b, 1, 3, nh, hd)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            q, k, v = qkv_of(h, p)
             if use_rotary:
                 q = rope1(q, t)
                 k = rope1(k, t)
@@ -888,21 +943,8 @@ class FusedDecoder:
                         caches, kv_new[None].astype(caches.dtype),
                         (l, 0, 0, 0, t, 0))
                     attn = attend(q, caches, l, t)
-            attn = attn.reshape(b, 1, nh * hd)
-            attn = mm(attn, p["lin_w"], p.get("lin_w_s")) + \
-                p["lin_b"].astype(attn.dtype)
-            x = residual + attn
-            if not pre_ln:
-                x = ln(x, p["ln_s"], p["ln_b"])
-            residual = x
-            h = ln(x, p["fln_s"], p["fln_b"]) if pre_ln else x
-            h = mm(h, p["f1_w"], p.get("f1_w_s")) + p["f1_b"].astype(h.dtype)
-            h = getattr(jax.nn, act)(h)
-            h = mm(h, p["f2_w"], p.get("f2_w_s")) + p["f2_b"].astype(h.dtype)
-            x = residual + h
-            if not pre_ln:
-                x = ln(x, p["fln_s"], p["fln_b"])
-            return x, caches
+            return proj_ffn_tail(residual, attn.reshape(b, 1, nh * hd),
+                                 p), caches
 
         embed, head = self.embed, self.head
         e_params, h_params = self._embed_params, self._head_params
@@ -972,11 +1014,71 @@ class FusedDecoder:
                 nxt = jnp.argmax(logits, axis=-1)
             return nxt.astype(jnp.int32)
 
+        def rope_bulk(x, pos):
+            # x: [B, S, H, D] at absolute positions pos [S] — the
+            # vectorized twin of rope1 (identical math, so bulk prefill
+            # writes bit-identical K to the per-token path)
+            inv = 1.0 / (rope_base ** (jnp.arange(0, hd, 2,
+                                                  dtype=jnp.float32) / hd))
+            fr = pos.astype(jnp.float32)[:, None] * inv[None, :]  # [S,D/2]
+            s = jnp.concatenate([jnp.sin(fr), jnp.sin(fr)], axis=-1)
+            c = jnp.concatenate([jnp.cos(fr), jnp.cos(fr)], axis=-1)
+            ss = s[None, :, None, :]
+            cc = c[None, :, None, :]
+            x1 = x[..., : hd // 2]
+            x2 = x[..., hd // 2:]
+            rot = jnp.concatenate([-x2, x1], axis=-1)
+            return (x * cc.astype(x.dtype) + rot * ss.astype(x.dtype))
+
+        def bulk_hidden(stk, e_arrays, toks):
+            """Whole-prompt prefill: embed [B, S], run the layer stack
+            with CAUSAL FLASH attention over the full sequence (MXU-fed
+            [B,S,E] matmuls instead of the per-token scan's [B,1,E]
+            slivers), and return (last hidden [B,1,E],
+            kv_all [L,2,B,H,S,D]). The K/V stack comes out as scan ys —
+            never a carried buffer — so the caller builds the ring cache
+            with ONE pad, no DUS and no aliasing hazard at all."""
+            from ..ops.pallas import flash_attention as fa
+            x = call_layerlike(embed, e_params, e_arrays, toks)
+            S = toks.shape[1]
+            pos = jnp.arange(S, dtype=jnp.int32)
+
+            def body(x, p):
+                residual = x
+                h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
+                bsz = h.shape[0]
+                q, k, v = qkv_of(h, p)
+                if use_rotary:
+                    q = rope_bulk(q, pos)
+                    k = rope_bulk(k, pos)
+                # causal self-attention over the prompt ([B, S, H, D]
+                # layout is the flash kernel's own)
+                if fa.is_supported(q.shape, q.dtype):
+                    o = fa.flash_attention(q, k, v, causal=True)
+                else:
+                    s_ = jnp.einsum(
+                        "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * (hd ** -0.5)
+                    m_ = jnp.tril(jnp.ones((S, S), bool))
+                    s_ = jnp.where(m_[None, None], s_, -1e30)
+                    o = jnp.einsum("bhqk,bkhd->bqhd",
+                                   jax.nn.softmax(s_, axis=-1),
+                                   v.astype(jnp.float32)).astype(q.dtype)
+                x = proj_ffn_tail(residual, o.reshape(bsz, S, nh * hd),
+                                  p)
+                kv = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                jnp.swapaxes(v, 1, 2)])  # [2, B, H, S, D]
+                return x, kv
+
+            x, kv_all = jax.lax.scan(body, x, stk)
+            return x[:, -1:], kv_all
+
         def step(stk, e_arrays, h_arrays, caches, tok, t, key):
             x, caches = hidden(stk, e_arrays, caches, tok, t)
             return sample_head(h_arrays, x, key), caches
 
         step.hidden = hidden
+        step.bulk_hidden = bulk_hidden
         step.sample_head = sample_head
         step.call_layerlike = call_layerlike
         step.head_logits = head_logits
@@ -1121,7 +1223,6 @@ class FusedDecoder:
         e_arrays = [p._data for p in self._embed_params]
         h_arrays = self._maybe_quant_head(
             [p._data for p in self._head_params])
-        caches = self.init_cache(b)
         toks_tm = jnp.swapaxes(ids.astype(jnp.int32), 0, 1)  # [S, B]
         mesh_now = self._mesh_mp()
         # the stacked-kernel escape hatch is trace-time state: it must be
@@ -1130,7 +1231,24 @@ class FusedDecoder:
         sk_flag = (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
                    + "/kw" + os.environ.get(
                        "PADDLE_TPU_KERNEL_CACHE_WRITE", "0"))
-        pos, last_x = 0, None
+        if (os.environ.get("PADDLE_TPU_BULK_PREFILL", "0") == "1"
+                and mesh_now is None and prompt > 1):
+            # whole-prompt prefill: causal flash over [B, S], cache built
+            # by padding the K/V scan output (see _build_bulk_prefill).
+            # One executable per exact prompt length.
+            # param dtype is part of the key: a weight swap to a new
+            # dtype must rebuild (cache_dtype is baked at build time)
+            pkey = ("bulkprefill", prompt, self._int8_cache(),
+                    str(self.fmt.qkv_weights[0]._data.dtype))
+            pstep = self._scan_cache.get(pkey)
+            if pstep is None:
+                pstep = self._build_bulk_prefill()
+                self._scan_cache[pkey] = pstep
+            last_x, caches = pstep(stk, e_arrays, ids.astype(jnp.int32))
+            pos = prompt
+        else:
+            caches = self.init_cache(b)
+            pos, last_x = 0, None
         while pos < prompt:
             chunk = 64
             while chunk > prompt - pos:

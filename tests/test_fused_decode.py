@@ -620,3 +620,42 @@ class TestKernelCacheWrite:
         assert calls, "int8 write-kernel mode fell back to the DUS path"
         np.testing.assert_array_equal(np.asarray(out._data),
                                       np.asarray(ref._data))
+
+
+class TestBulkPrefill:
+    """r5 s2: PADDLE_TPU_BULK_PREFILL=1 — whole-prompt prefill (causal
+    flash over [B, S], cache built by padding the K/V scan output; no
+    per-token scan, no DUS). Token parity with the chunked per-token
+    prefill across greedy, rotary, int8-cache, and beam modes."""
+
+    def _run(self, monkeypatch, bulk, rotary=False, **gen_kw):
+        import paddle_tpu as paddle
+        if bulk:
+            monkeypatch.setenv("PADDLE_TPU_BULK_PREFILL", "1")
+        else:
+            monkeypatch.delenv("PADDLE_TPU_BULK_PREFILL", raising=False)
+        paddle.seed(71)
+        m = TinyFusedLM()
+        m.eval()
+        ids = _prompt(b=2, s=33, seed=21)
+        return generate_fused(m.fmt, paddle.to_tensor(ids), embed=m.embed,
+                              head=m.head, max_seq_len=128,
+                              use_rotary=rotary, **gen_kw)
+
+    @pytest.mark.parametrize("rotary", [False, True])
+    def test_greedy_parity(self, monkeypatch, rotary):
+        ref = self._run(monkeypatch, bulk=False, rotary=rotary,
+                        max_new_tokens=8)
+        out = self._run(monkeypatch, bulk=True, rotary=rotary,
+                        max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))
+
+    def test_int8_cache_and_beam_parity(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        ref = self._run(monkeypatch, bulk=False, max_new_tokens=6,
+                        num_beams=3)
+        out = self._run(monkeypatch, bulk=True, max_new_tokens=6,
+                        num_beams=3)
+        np.testing.assert_array_equal(np.asarray(out._data),
+                                      np.asarray(ref._data))

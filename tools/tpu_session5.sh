@@ -215,6 +215,12 @@ commit_phase vit_remat0 BENCH_RESULT.json
 #    (TP-sharded kernel decode cannot A/B here: mp>=2 needs >1 chip.)
 run bench_decode_beam 900 env BENCH_BEAMS=4 BENCH_PROMPT=256 python bench_decode.py
 commit_phase bench_decode_beam
+# 9b. Bulk-prefill A/B at prompt=256 (timed region includes prefill):
+#     per-token scan prefill vs whole-prompt causal-flash prefill.
+run bench_decode_p256 900 env BENCH_PROMPT=256 python bench_decode.py
+commit_phase bench_decode_p256
+run bench_decode_p256_bulk 900 env BENCH_PROMPT=256 PADDLE_TPU_BULK_PREFILL=1 python bench_decode.py
+commit_phase bench_decode_p256_bulk
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_w8c8
 
