@@ -566,7 +566,10 @@ def bench_bert(on_tpu, peak_tflops):
     # on TPU hardware terms), stage-2 = optimizer+grad sharding specs
     model, opt = paddle.amp.decorate(model, opt, level="O2",
                                      dtype="bfloat16")
-    model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+    if os.environ.get("BENCH_BERT_PLAIN") != "1":
+        # BENCH_BERT_PLAIN=1: drop the stage-2 wrapper (keep AMP-O2) —
+        # isolates what the sharding machinery costs at world=1
+        model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
     n_params = sum(p.size for p in model.parameters())
 
     rng = np.random.RandomState(0)
@@ -643,7 +646,11 @@ def bench_llama(on_tpu, peak_tflops):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  multi_precision=on_tpu)
-    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+    if os.environ.get("BENCH_LLAMA_PLAIN") != "1":
+        # BENCH_LLAMA_PLAIN=1: drop the stage-3 wrapper — isolates what
+        # param/grad resharding costs at world=1 (llama's MFU laggard
+        # hunt; the 8-dev composition is proven by dryrun_multichip)
+        model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
     n_params = sum(p.size for p in model.parameters())
 
     rng = np.random.RandomState(0)

@@ -224,6 +224,14 @@ commit_phase bench_decode_p256_bulk
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_w8c8
 
+# 9c. Wrapper-overhead A/B: the laggard configs run their sharding
+#     wrappers at world=1 — measure each config bare to see if the
+#     machinery itself costs step time on one chip.
+run llama_plain 1200 env BENCH_HEADLINE=0 BENCH_ONLY=llama BENCH_LLAMA_PLAIN=1 python bench.py
+commit_phase llama_plain BENCH_RESULT.json
+run bert_plain 1200 env BENCH_HEADLINE=0 BENCH_ONLY=bert BENCH_BERT_PLAIN=1 python bench.py
+commit_phase bert_plain BENCH_RESULT.json
+
 # 10. Laggard-config profiles: where do BERT's (24.6%) and llama's
 #     (42.1%) steps actually go? Ablation mode ranks fwd/bwd/opt parts.
 run prof_bert 1200 env PROF_MODEL=bert PROF_MODE=ablate python tools/tpu_profile.py
