@@ -16,7 +16,9 @@
 # Run order is value-per-minute. $OUT/done/ALL marks full completion.
 set -u
 OUT=${1:-/tmp/tpu_session5}
-LOCK=/tmp/tpu_window_active
+# TPU_WINDOW_LOCK override: CPU rehearsals take their own lock so a
+# live-window launch is never blocked by a rehearsal holding the mutex
+LOCK=${TPU_WINDOW_LOCK:-/tmp/tpu_window_active}
 mkdir -p "$OUT" "$OUT/done"
 cd /root/repo
 mkdir -p tpu_windows
