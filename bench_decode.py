@@ -43,8 +43,11 @@ def main():
             lay.bfloat16()
     fmt.eval()
 
-    dec = FusedDecoder(fmt, embed, head, max_seq_len=smax)
     plen = int(os.environ.get("BENCH_PROMPT", "16"))
+    # a BENCH_PROMPT longer than the ring (CPU-fallback smax is tiny)
+    # must grow the ring, not assert inside generate
+    smax = max(smax, plen + new_tokens)
+    dec = FusedDecoder(fmt, embed, head, max_seq_len=smax)
     prompt = np.random.RandomState(0).randint(
         1, V, (batch, plen)).astype(np.int32)
     # BENCH_BEAMS=K times cache-backed beam search instead of greedy
