@@ -225,6 +225,12 @@ run bench_decode_p256_bulk 900 env BENCH_PROMPT=256 PADDLE_TPU_BULK_PREFILL=1 py
 commit_phase bench_decode_p256_bulk
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
 commit_phase bench_decode_w8c8
+# 9d. Serving-batch row (b32 amortizes the ~250 MB/token weight stream
+#     4x over the b8 ratchet) and the all-levers-on best-mode row.
+run bench_decode_b32 900 env BENCH_BATCH=32 python bench_decode.py
+commit_phase bench_decode_b32
+run bench_decode_best 900 env BENCH_BATCH=32 PADDLE_TPU_KERNEL_CACHE_WRITE=1 PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 PADDLE_TPU_DECODE_INT8_HEAD=1 python bench_decode.py
+commit_phase bench_decode_best
 
 # 9c. Wrapper-overhead A/B: the laggard configs run their sharding
 #     wrappers at world=1 — measure each config bare to see if the
