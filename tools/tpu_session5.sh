@@ -146,7 +146,7 @@ commit_phase alias_probe
 # 2. Decode ratchet with the in-place KV cache (scan-carried stacked
 #    buffer + scalar-prefetch kernel). r3 ratchet: 418 tok/s; target 2x.
 run bench_decode 900 python bench_decode.py
-commit_phase bench_decode
+commit_phase bench_decode BENCH_tpu.json
 
 # 3. Full 5-config bench — the MFU-spread scoreboard; appends the window
 #    record to BENCH_tpu.json. Early: short windows must land this.
@@ -159,25 +159,25 @@ commit_phase bench_all BENCH_tpu.json BENCH_RESULT.json
 #     in the same build to localize whether the kernel or something else
 #     (e.g. the in-place scan cache) regressed.
 run bench_decode_dense 900 env PADDLE_TPU_STACKED_KERNEL=0 python bench_decode.py
-commit_phase bench_decode_dense
+commit_phase bench_decode_dense BENCH_tpu.json
 
 # 3c. Fused write+attend kernel (in-place cache via input_output_aliases,
 #     zero XLA-side DUS on the carry) — the copy-elimination A/B.
 run bench_decode_kw 900 env PADDLE_TPU_KERNEL_CACHE_WRITE=1 python bench_decode.py
-commit_phase bench_decode_kw
+commit_phase bench_decode_kw BENCH_tpu.json
 # 3d. int8 cache + write kernel: in-kernel quantization, both buffers
 #     aliased — the best-bandwidth decode mode without the DUS hazard.
 run bench_decode_i8kw 900 env PADDLE_TPU_KERNEL_CACHE_WRITE=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
-commit_phase bench_decode_i8kw
+commit_phase bench_decode_i8kw BENCH_tpu.json
 
 # 4. int8 decode ladder: cache (halves KV stream), weights (halves the
 #    dominant ~250 MB/token weight stream), full stack incl. LM head.
 run bench_decode_i8 900 env PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
-commit_phase bench_decode_i8
+commit_phase bench_decode_i8 BENCH_tpu.json
 run bench_decode_w8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 python bench_decode.py
-commit_phase bench_decode_w8
+commit_phase bench_decode_w8 BENCH_tpu.json
 run bench_decode_full8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 PADDLE_TPU_DECODE_INT8_HEAD=1 python bench_decode.py
-commit_phase bench_decode_full8
+commit_phase bench_decode_full8 BENCH_tpu.json
 
 # 5. 1B single-chip: Adafactor (analytic ~7 GB state — expected to FIT,
 #    the >=1B single-chip row), then AdamW (expected RESOURCE_EXHAUSTED,
@@ -216,21 +216,21 @@ commit_phase vit_remat0 BENCH_RESULT.json
 # 9. Remaining decode ratchets: cache-backed beam search + w8c8 combo.
 #    (TP-sharded kernel decode cannot A/B here: mp>=2 needs >1 chip.)
 run bench_decode_beam 900 env BENCH_BEAMS=4 BENCH_PROMPT=256 python bench_decode.py
-commit_phase bench_decode_beam
+commit_phase bench_decode_beam BENCH_tpu.json
 # 9b. Bulk-prefill A/B at prompt=256 (timed region includes prefill):
 #     per-token scan prefill vs whole-prompt causal-flash prefill.
 run bench_decode_p256 900 env BENCH_PROMPT=256 python bench_decode.py
-commit_phase bench_decode_p256
+commit_phase bench_decode_p256 BENCH_tpu.json
 run bench_decode_p256_bulk 900 env BENCH_PROMPT=256 PADDLE_TPU_BULK_PREFILL=1 python bench_decode.py
-commit_phase bench_decode_p256_bulk
+commit_phase bench_decode_p256_bulk BENCH_tpu.json
 run bench_decode_w8c8 900 env PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 python bench_decode.py
-commit_phase bench_decode_w8c8
+commit_phase bench_decode_w8c8 BENCH_tpu.json
 # 9d. Serving-batch row (b32 amortizes the ~250 MB/token weight stream
 #     4x over the b8 ratchet) and the all-levers-on best-mode row.
 run bench_decode_b32 900 env BENCH_BATCH=32 python bench_decode.py
-commit_phase bench_decode_b32
+commit_phase bench_decode_b32 BENCH_tpu.json
 run bench_decode_best 900 env BENCH_BATCH=32 PADDLE_TPU_KERNEL_CACHE_WRITE=1 PADDLE_TPU_DECODE_INT8_WEIGHTS=1 PADDLE_TPU_DECODE_INT8_CACHE=1 PADDLE_TPU_DECODE_INT8_HEAD=1 python bench_decode.py
-commit_phase bench_decode_best
+commit_phase bench_decode_best BENCH_tpu.json
 
 # 9c. Wrapper-overhead A/B: the laggard configs run their sharding
 #     wrappers at world=1 — measure each config bare to see if the
