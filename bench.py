@@ -927,10 +927,17 @@ def main():
     # them on the retry burns scarce window minutes (the gpt2 headline
     # alone is ~7 min). Reuse fresh (<6 h) TPU-run partials; rehearsals
     # can't resume (on_tpu is False) and errored/skipped rows re-run.
+    # BENCH_ONLY sweeps must not CONSUME a bench_all partial either (they
+    # also don't delete it, mirroring _checkpoint's guard below): the
+    # sweep would republish the banked headline inside its own window
+    # record, and tools/publish_partial.py would then promote the same
+    # partial a second time — the exact double-publish the deletion
+    # guard exists to prevent.
     partial_path = os.path.join(os.path.dirname(__file__),
                                 "BENCH_partial.json")
     prior = None
-    if os.environ.get("BENCH_RESUME", "0") == "1" and on_tpu:
+    if os.environ.get("BENCH_RESUME", "0") == "1" and on_tpu \
+            and not os.environ.get("BENCH_ONLY"):
         try:
             if time.time() - os.path.getmtime(partial_path) < 6 * 3600:
                 with open(partial_path) as f:
@@ -1095,11 +1102,15 @@ def main():
         _append_tpu_window(record)
         # this run's rows are now published as a window record — a later
         # BENCH_RESUME must re-measure, not republish them as a second
-        # "new" window (stale-partial trap)
-        try:
-            os.remove(partial_path)
-        except OSError:
-            pass
+        # "new" window (stale-partial trap). BENCH_ONLY sweeps mirror
+        # _checkpoint's guard: they are not bench_all, so they must not
+        # consume a flap-banked bench_all partial that
+        # tools/publish_partial.py still has to promote.
+        if not only:
+            try:
+                os.remove(partial_path)
+            except OSError:
+                pass
     _emit_record(record)
 
 

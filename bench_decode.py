@@ -15,6 +15,29 @@ import time
 import numpy as np
 
 
+def _attention_path(dec, fmt, batch):
+    """Label for the attention path the decode step ACTUALLY takes:
+    env flags narrow the choice, but the kernels' own support predicates
+    (shape/dtype/tiling rules on the real cache shape) decide whether the
+    stacked path runs or the dense fallback does."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        stacked_i8_is_supported, stacked_is_supported)
+    if os.environ.get("PADDLE_TPU_STACKED_KERNEL") == "0":
+        return "dense-fallback"
+    nh, hd = fmt.num_heads, fmt.head_dim
+    dtype = fmt.qkv_weights[0]._data.dtype
+    cshape = (fmt.num_layers, 2, batch, nh, dec.smax, hd)
+    qshape = (batch, 1, nh, hd)
+    int8 = os.environ.get("PADDLE_TPU_DECODE_INT8_CACHE") == "1"
+    ok = (stacked_i8_is_supported(qshape, cshape, dtype) if int8
+          else stacked_is_supported(qshape, cshape, dtype,
+                                    cache_dtype=dtype))
+    if not ok:
+        return "dense-fallback"
+    return ("stacked-write" if os.environ.get(
+        "PADDLE_TPU_KERNEL_CACHE_WRITE") == "1" else "stacked")
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # tunnel-outage-safe init (subprocess probe + CPU fallback): shared
@@ -45,8 +68,13 @@ def main():
 
     plen = int(os.environ.get("BENCH_PROMPT", "16"))
     # a BENCH_PROMPT longer than the ring (CPU-fallback smax is tiny)
-    # must grow the ring, not assert inside generate
+    # must grow the ring, not assert inside generate. FusedDecoder
+    # itself rounds max_seq_len up to a 128-multiple (stacked-kernel
+    # tiling rule); mirror that here so the record's max_seq and the
+    # _attention_path support probe see the ACTUAL ring size, not the
+    # requested one (ADVICE r5: mislabeled bench rows)
     smax = max(smax, plen + new_tokens)
+    smax = -(-smax // 128) * 128
     dec = FusedDecoder(fmt, embed, head, max_seq_len=smax)
     prompt = np.random.RandomState(0).randint(
         1, V, (batch, plen)).astype(np.int32)
@@ -98,11 +126,11 @@ def main():
         "head_mode": ("int8" if os.environ.get(
             "PADDLE_TPU_DECODE_INT8_HEAD") == "1" else "fp"),
         # both the fp and int8-cache branches have write-kernel flavors,
-        # so the kw flag alone decides the label
-        "attention_path": ("dense-fallback" if os.environ.get(
-            "PADDLE_TPU_STACKED_KERNEL") == "0" else
-            ("stacked-write" if os.environ.get(
-                "PADDLE_TPU_KERNEL_CACHE_WRITE") == "1" else "stacked")),
+        # so the kw flag picks between them — but only when the actual
+        # shapes pass the kernel's own support predicate; a failing
+        # predicate means the dense fallback ran no matter what the env
+        # says (ADVICE r5: env-derived labels mislabeled bench rows)
+        "attention_path": _attention_path(dec, fmt, batch),
         "num_beams": max(beams, 1),
         "prefill_mode": ("bulk" if os.environ.get(
             "PADDLE_TPU_BULK_PREFILL") == "1" else "scan"),

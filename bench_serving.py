@@ -1,0 +1,261 @@
+"""Serving micro-bench: continuous batching vs static batching on the
+SAME compiled decode step (ServingEngine over the stacked KV ring cache).
+
+Synthetic mixed-length workload with Poisson arrivals on a VIRTUAL clock
+(idle waits are skipped, compute time is real), fixed seed. Two drivers:
+
+  * continuous — requests are admitted the moment a slot frees (the
+    engine's native behavior);
+  * static     — gang scheduling: a batch of `num_slots` requests is
+    submitted only when the engine is fully idle and every member has
+    arrived, so finished rows idle until the slowest row ends (the
+    classic static-batch throughput killer).
+
+Both run the same engine class, same compiled-step shape, same workload.
+Run manually (CPU works: JAX_PLATFORMS=cpu python bench_serving.py).
+Prints ONE JSON line in the BENCH record format; the full record also
+lands in BENCH_serving.json, and on-TPU runs append to the BENCH_tpu.json
+window log like every other bench.
+
+Env knobs: BENCH_SLOTS, BENCH_SERVE_REQUESTS, BENCH_SERVE_WARMUP,
+BENCH_SERVE_CHUNK, BENCH_SERVE_SEED, BENCH_SERVE_LOAD (offered load vs
+measured capacity, default 1.5 — backlog forms, continuous batching's
+favorable regime and the honest serving scenario).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+class VirtualClock:
+    """perf_counter plus a skip offset: drivers jump over idle waits for
+    future arrivals instead of sleeping, so the bench measures compute,
+    not sleep — while TTFT/latency still account queueing delay."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._skip = 0.0
+
+    def now(self):
+        return time.perf_counter() - self._t0 + self._skip
+
+    def skip_to(self, t):
+        n = self.now()
+        if t > n:
+            self._skip += t - n
+
+
+def _make_workload(rng, n, v, smax):
+    """Mixed-length requests: short-to-medium prompts, a long-tailed
+    spread of generation lengths (high variance in decode length is what
+    separates continuous from static batching — a static batch pads
+    every row to its slowest member)."""
+    reqs = []
+    for _ in range(n):
+        plen = int(rng.randint(4, 33))
+        max_new = int(rng.choice([8, 16, 24, 32, 48, 64, 96],
+                                 p=[.15, .20, .15, .15, .15, .12, .08]))
+        max_new = min(max_new, smax - plen)
+        prompt = rng.randint(1, v, (plen,)).astype("int32")
+        reqs.append((prompt, max_new))
+    return reqs
+
+
+def _drive_continuous(eng, clock, reqs, arrivals):
+    sub = {}                 # rid -> (workload index, submit time)
+    i = 0
+    while i < len(reqs) or eng.has_work:
+        now = clock.now()
+        while i < len(reqs) and arrivals[i] <= now:
+            prompt, max_new = reqs[i]
+            sub[eng.submit(prompt, max_new_tokens=max_new)] = (
+                i, clock.now())
+            i += 1
+        if not eng.has_work:
+            clock.skip_to(arrivals[i])
+            continue
+        eng.step()
+    return sub
+
+
+def _drive_static(eng, clock, reqs, arrivals):
+    """Gang scheduling: batches of num_slots in arrival order; a batch
+    starts only when complete AND the engine is idle."""
+    b = eng.num_slots
+    sub = {}
+    for s in range(0, len(reqs), b):
+        batch = list(range(s, min(s + b, len(reqs))))
+        clock.skip_to(max(arrivals[j] for j in batch))
+        for j in batch:
+            prompt, max_new = reqs[j]
+            sub[eng.submit(prompt, max_new_tokens=max_new)] = (
+                j, clock.now())
+        eng.run()
+    return sub
+
+
+def _collect(eng, sub, arrivals):
+    """Per-request TTFT/latency measured from ARRIVAL (queueing delay
+    included): arrival -> submit is driver bookkeeping, submit -> first
+    token comes from the engine record."""
+    ttft, lat, toks = [], [], 0
+    for rid, (j, t_sub) in sub.items():
+        r = eng.results[rid]
+        wait = t_sub - arrivals[j]
+        ttft.append(wait + r["ttft_s"])
+        lat.append(wait + r["latency_s"])
+        toks += int(r["tokens"].size)
+    return ttft, lat, toks
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+
+    E, H, FF, L, V = ((768, 12, 3072, 12, 50304) if on_tpu
+                      else (64, 4, 128, 2, 256))
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "128"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_warm = int(os.environ.get("BENCH_SERVE_WARMUP", str(2 * slots)))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+
+    paddle.seed(0)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    if on_tpu:
+        for lay in (embed, fmt, head):
+            lay.bfloat16()
+    fmt.eval()
+
+    rng = np.random.RandomState(seed)
+    # bucket_reqs cover every prefill bucket a 4..32-token prompt can
+    # round up to (4, 8, 16, 32) — submitted ONE AT A TIME during warmup
+    # so each bucket's executable compiles (a gang admission would share
+    # the largest bucket); the measured phase asserts ZERO retraces
+    bucket_reqs = [(rng.randint(1, V, (plen,)).astype("int32"), 4)
+                   for plen in (4, 8, 16, 32)]
+    warm_reqs = _make_workload(rng, n_warm, V, smax)
+    meas_reqs = _make_workload(rng, n_meas, V, smax)
+
+    def run_mode(drive, label):
+        clock = VirtualClock()
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            clock=clock.now)
+        # ---- warmup pass 1: compiles (each prefill bucket admitted
+        # solo); pass 2 (all compiled): capacity estimate used to set
+        # the Poisson rate — including compile time would understate
+        # capacity and undersubmit the measured phase
+        for prompt, max_new in bucket_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+            eng.run()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        eng.reset_metrics(keep_results=False)
+        t0 = clock.now()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        warm = eng.metrics()
+        cap = warm["tokens_emitted"] / max(clock.now() - t0, 1e-9)
+        traces_warm = warm["traces"]
+        eng.reset_metrics(keep_results=False)
+
+        # ---- measured phase: Poisson arrivals at `load` x capacity
+        mean_new = float(np.mean([m for _, m in meas_reqs]))
+        rate = load * cap / mean_new              # requests / s
+        arr_rng = np.random.RandomState(seed + 1)
+        arrivals = np.cumsum(
+            arr_rng.exponential(1.0 / rate, size=len(meas_reqs)))
+        arrivals += clock.now()
+
+        t_start = clock.now()
+        sub = drive(eng, clock, meas_reqs, arrivals)
+        elapsed = clock.now() - t_start
+        ttft, lat, toks = _collect(eng, sub, arrivals)
+        m = eng.metrics()
+        return {
+            "label": label,
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "capacity_tokens_per_sec": round(cap, 2),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+            "requests": len(meas_reqs),
+            "ttft_p50_ms": round(1e3 * float(np.percentile(ttft, 50)), 1),
+            "ttft_p99_ms": round(1e3 * float(np.percentile(ttft, 99)), 1),
+            "latency_p50_ms": round(1e3 * float(np.percentile(lat, 50)),
+                                    1),
+            "latency_p99_ms": round(1e3 * float(np.percentile(lat, 99)),
+                                    1),
+        }
+
+    cont = run_mode(_drive_continuous, "continuous")
+    stat = run_mode(_drive_static, "static")
+
+    record = {
+        "metric": "serving_continuous_tokens_per_sec",
+        "value": cont["tokens_per_sec"],
+        "unit": "tokens/s",
+        "static_tokens_per_sec": stat["tokens_per_sec"],
+        "speedup_vs_static": round(
+            cont["tokens_per_sec"] / max(stat["tokens_per_sec"], 1e-9),
+            3),
+        "num_slots": slots, "max_seq": smax, "decode_chunk": chunk,
+        "layers": L, "hidden": E, "vocab": V,
+        "requests": n_meas, "warmup_requests": n_warm,
+        "offered_load": load,
+        "retraces_after_warmup": cont["retraces_after_warmup"],
+        "ttft_p50_ms": cont["ttft_p50_ms"],
+        "ttft_p99_ms": cont["ttft_p99_ms"],
+        "latency_p50_ms": cont["latency_p50_ms"],
+        "latency_p99_ms": cont["latency_p99_ms"],
+        "static_ttft_p50_ms": stat["ttft_p50_ms"],
+        "static_ttft_p99_ms": stat["ttft_p99_ms"],
+        "static_latency_p50_ms": stat["latency_p50_ms"],
+        "static_latency_p99_ms": stat["latency_p99_ms"],
+        "device": str(dev),
+        "cache_mode": ("int8" if os.environ.get(
+            "PADDLE_TPU_DECODE_INT8_CACHE") == "1" else "fp"),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"bench_serving: could not write {path}: {e}",
+              file=sys.stderr)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    if record["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP — the fixed-shape "
+              "contract is broken", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

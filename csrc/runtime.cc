@@ -316,21 +316,23 @@ void pd_store_master_stop(void* h) {
 }
 
 void* pd_store_client_connect(const char* host, int port, int timeout_ms) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return nullptr;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return nullptr;
-  }
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
   auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
-  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-    if (Clock::now() > deadline) {
-      ::close(fd);
-      return nullptr;
-    }
+  int fd = -1;
+  for (;;) {
+    // fresh socket per attempt: after a failed connect the fd is left in
+    // an error state and every further connect on it fails immediately,
+    // which used to turn the retry window into a single shot — a client
+    // racing the master's bind could then never get in at all
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+      break;
+    ::close(fd);
+    if (Clock::now() > deadline) return nullptr;
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
   int one = 1;

@@ -1,0 +1,74 @@
+"""Version shims for the jax API surface this codebase targets.
+
+The code is written against jax >= 0.6, where `shard_map` is a top-level
+export (`jax.shard_map` / `from jax import shard_map`) and its
+replication-check kwarg is spelled `check_vma`. Older 0.4.x installs ship
+the same functionality as `jax.experimental.shard_map.shard_map` with the
+kwarg spelled `check_rep`. Rather than fork every call site (and the
+tests, which also do `from jax import shard_map`), this module installs a
+uniform `jax.shard_map` into the jax namespace when it is missing.
+
+Imported for its side effect from paddle_tpu/__init__.py, before any
+submodule that does `from jax import shard_map` at module scope.
+"""
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None, **kw):
+        """jax>=0.6-style shard_map on a 0.4.x install. `check_vma` maps
+        onto the old `check_rep` switch (both gate the same replication/
+        varying-manual-axes validation; passing False skips it), and
+        `axis_names` (the MANUAL axes) onto the old `auto` kwarg (its
+        complement: the mesh axes left to GSPMD)."""
+        if check_rep is None and check_vma is not None:
+            check_rep = check_vma
+        if check_rep is not None:
+            kw["check_rep"] = check_rep
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            kw["auto"] = auto
+            if auto:
+                # jax>=0.6 resolves bare PartitionSpecs inside a
+                # partially-auto shard_map against the call-site mesh;
+                # 0.4.x needs the mesh context manager active while the
+                # body traces, or with_sharding_constraint(P(...)) raises
+                # "requires a non-empty mesh"
+                inner, phys = f, mesh
+
+                def f(*a, **k):
+                    with phys:
+                        return inner(*a, **k)
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "pvary"):
+    def _pvary(x, axis_name):  # noqa: ARG001 - name(s) unused on 0.4.x
+        """jax>=0.6's lax.pvary marks a replicated value as varying over
+        manual axes for the vma (varying-manual-axes) type system. 0.4.x
+        has no vma tracking — its check_rep model treats replicated and
+        varying uniformly — so the marker is the identity."""
+        return x
+
+    jax.lax.pvary = _pvary
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        """jax>=0.6's lax.axis_size on 0.4.x: psum of 1 over the axis —
+        constant-folded at trace time inside shard_map/pmap, so no
+        runtime collective is actually issued."""
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+if not hasattr(jax, "typeof"):
+    # jax>=0.6's jax.typeof is the abstract value; 0.4.x spells it
+    # core.get_aval. 0.4.x avals have no .vma attribute, which callers
+    # already probe with getattr(..., None) — the right degradation.
+    jax.typeof = jax.core.get_aval

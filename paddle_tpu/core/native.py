@@ -51,11 +51,21 @@ def load_native():
         try:
             if (not os.path.exists(lib)
                     or os.path.getmtime(lib) < os.path.getmtime(src)):
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", src, "-o", lib + ".tmp"],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(lib + ".tmp", lib)
+                # pid-unique scratch: concurrently-launched ranks all see
+                # the stale .so and rebuild; a shared ".tmp" makes them
+                # clobber each other's half-written output (os.replace of
+                # a file another rank is still writing), taking the
+                # native runtime down for the whole job
+                tmp = f"{lib}.{os.getpid()}.tmp"
+                try:
+                    subprocess.run(
+                        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                         "-pthread", src, "-o", tmp],
+                        check=True, capture_output=True, timeout=120)
+                    os.replace(tmp, lib)
+                finally:
+                    if os.path.exists(tmp):
+                        os.remove(tmp)
             L = ctypes.CDLL(lib)
         except Exception:
             _BUILD_FAILED = True

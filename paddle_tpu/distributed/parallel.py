@@ -46,6 +46,16 @@ def _maybe_jax_distributed_init():
                            os.environ.get("JAX_COORDINATOR_ADDRESS"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID",
                              os.environ.get("JAX_PROCESS_ID", "0")))
+    try:
+        # jax < 0.5 leaves CPU collectives on the XLA default, which
+        # raises "Multiprocess computations aren't implemented on the
+        # CPU backend" at the first cross-process op; newer jax defaults
+        # to gloo and drops the flag (hence best-effort). Must be set
+        # BEFORE the backend client is created — i.e. right here, ahead
+        # of jax.distributed.initialize.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     if coord:
         _store_barrier(coord, n, pid)
         try:
@@ -92,10 +102,28 @@ def _store_barrier(coord: str, world: int, rank: int):
                          "PADDLE_STORE_CONNECT_TIMEOUT", "15")))
         c.add("init/count", 1)
         if rank == 0:
+            # BOUNDED wait: a peer whose store connect failed skips the
+            # rendezvous entirely (best-effort contract), so an open
+            # wait here would deadlock the whole job — rank 0 stuck in
+            # this loop never reaches jax.distributed.initialize, and
+            # every other rank then blocks inside it forever. On
+            # timeout, release any ranks that DID register and fall
+            # through to jax.distributed.initialize, which is the real
+            # (coordinator-side) rendezvous anyway.
+            import time
+            deadline = time.time() + float(os.environ.get(
+                "PADDLE_STORE_CONNECT_TIMEOUT", "15"))
             while c.get("init/count") is None or \
                     int.from_bytes(c.get("init/count")[:8], "little",
                                    signed=True) < world:
-                import time
+                if time.time() > deadline:
+                    logging.warning(
+                        "paddle_tpu: TCPStore pre-init rendezvous timed "
+                        "out with %s/%d ranks registered; proceeding",
+                        c.get("init/count") and int.from_bytes(
+                            c.get("init/count")[:8], "little",
+                            signed=True), world)
+                    break
                 time.sleep(0.05)
             c.set("init/ready", b"1")
         c.wait("init/ready", timeout_s=float(os.environ.get(

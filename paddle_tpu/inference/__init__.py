@@ -24,7 +24,16 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
-           "PrecisionType"]
+           "PrecisionType", "ServingEngine", "ServedRequest"]
+
+
+def __getattr__(name):
+    # lazy: the serving engine drags the nn layer stack in via
+    # generation.py; importing paddle_tpu.inference must stay light
+    if name in ("ServingEngine", "ServedRequest"):
+        from . import serving
+        return getattr(serving, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class PrecisionType:

@@ -29,10 +29,13 @@ __all__ = ["generate", "generate_fused", "FusedDecoder"]
 
 
 def _absmax_int8(w, axis):
-    """Per-slice absmax int8 quantization (one recipe for ALL weight-only
-    quant sites: layer stacks + LM head): scales = absmax/127 over the
-    CONTRACTED axis with a zero-column guard; values clip/round to int8.
-    Returns (int8 array, fp32 scales with the reduced axis kept)."""
+    """Per-slice absmax int8 quantization (ONE recipe for every absmax
+    site: weight-only layer stacks + LM head, and the int8 KV-cache
+    writes in prefill / decode / serving bulk-admit — the i8 write
+    kernel documents its in-kernel quant as bit-identical to this):
+    scales = absmax/127 over the reduced axis with a zero-slice guard;
+    values clip/round to int8. Returns (int8 array, fp32 scales with
+    the reduced axis kept)."""
     a = w.astype(jnp.float32)
     s = jnp.max(jnp.abs(a), axis=axis, keepdims=True) / 127.0
     q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
@@ -526,15 +529,12 @@ class FusedDecoder:
         int8 = self._int8_cache()
 
         def prefill(stk, e_arrays, toks):
-            last_x, kv_all = bulk_hidden(stk, e_arrays, toks)
+            x_all, kv_all = bulk_hidden(stk, e_arrays, toks)
+            last_x = x_all[:, -1:]
             S = toks.shape[1]
             pad = [(0, 0)] * 4 + [(0, smax - S), (0, 0)]
             if int8:
-                kv32 = kv_all.astype(jnp.float32)
-                amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
-                sc = amax / 127.0
-                q_i8 = jnp.clip(jnp.round(kv32 / jnp.maximum(sc, 1e-8)),
-                                -127, 127).astype(jnp.int8)
+                q_i8, sc = _absmax_int8(kv_all, -1)
                 caches = (jnp.pad(q_i8, pad),
                           jnp.pad(jnp.swapaxes(sc, -1, -2),
                                   [(0, 0)] * 5 + [(0, smax - S)]))
@@ -718,13 +718,22 @@ class FusedDecoder:
             return (out * s + b).astype(x.dtype)
 
         def rope1(x, t):
-            # x: [B, 1, H, D] at absolute position t
+            # x: [B, 1, H, D] at absolute position t — scalar (every row
+            # at the same position, the classic decode step) or [B]
+            # (per-row positions, the serving engine's ragged slots)
             inv = 1.0 / (rope_base ** (jnp.arange(0, hd, 2,
                                                   dtype=jnp.float32) / hd))
-            fr = t.astype(jnp.float32) * inv            # [D/2]
+            tv = jnp.asarray(t).astype(jnp.float32)
+            fr = tv[..., None] * inv                    # [D/2] or [B, D/2]
             s, c = jnp.sin(fr), jnp.cos(fr)
-            ss = jnp.concatenate([s, s])[None, None, None, :]
-            cc = jnp.concatenate([c, c])[None, None, None, :]
+            ss = jnp.concatenate([s, s], axis=-1)
+            cc = jnp.concatenate([c, c], axis=-1)
+            if tv.ndim:
+                ss = ss[:, None, None, :]
+                cc = cc[:, None, None, :]
+            else:
+                ss = ss[None, None, None, :]
+                cc = cc[None, None, None, :]
             x1 = x[..., : hd // 2]
             x2 = x[..., hd // 2:]
             rot = jnp.concatenate([-x2, x1], axis=-1)
@@ -733,8 +742,12 @@ class FusedDecoder:
         def attend(q, caches, l, t):
             # q: [B, 1, H, D]; caches: [L, 2, B, H, Smax, D] (full stack —
             # the kernel addresses layer l via scalar prefetch, zero-copy)
-            # or (int8 stack, fp32 scales) in cache-quant mode
+            # or (int8 stack, fp32 scales) in cache-quant mode. t: scalar
+            # OR [B] per-row positions (the kernels take [B] lens anyway;
+            # the dense fallback broadcasts its mask per row).
             qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
+            tb = jnp.broadcast_to(jnp.asarray(t).astype(jnp.int32),
+                                  (q.shape[0],))
             quant = isinstance(caches, tuple)
             # escape hatch: PADDLE_TPU_STACKED_KERNEL=0 forces the dense
             # path — the stacked kernels' first on-chip Mosaic compile
@@ -746,7 +759,7 @@ class FusedDecoder:
                     stacked_i8_is_supported, stacked_is_supported)
                 mp = (1 if mesh is None
                       else dict(mesh.shape).get("mp", 1))
-                lens = jnp.full((q.shape[0],), t, jnp.int32)
+                lens = tb
                 cshape = (caches[0] if quant else caches).shape
                 if mesh is not None and mp >= 2 and nh % mp == 0 \
                         and cshape[3] % mp == 0:
@@ -813,7 +826,8 @@ class FusedDecoder:
                                                      keepdims=False)
             s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
                            cache[0].astype(jnp.float32)) * (hd ** -0.5)
-            mask = jnp.arange(smax)[None, None, None, :] <= t
+            mask = (jnp.arange(smax)[None, None, None, :]
+                    <= tb[:, None, None, None])
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhqs,bhsd->bhqd", p,
@@ -861,13 +875,27 @@ class FusedDecoder:
                 x = ln(x, p["fln_s"], p["fln_b"])
             return x
 
-        def layer_step(x, p, caches, l, t):
-            # one gate for both cache flavors' fused write+attend branch
+        def _write_targets(t, b, write_mask):
+            # per-row write positions ([B] int32). Masked-out rows are
+            # sent OUT OF BOUNDS (index Smax) so the scatter with
+            # mode="drop" skips them entirely — a neighbouring slot's
+            # live cache row cannot be touched by construction (the
+            # serving engine's in-slot prefill depends on this).
+            tv = jnp.broadcast_to(jnp.asarray(t).astype(jnp.int32), (b,))
+            if write_mask is not None:
+                tv = jnp.where(write_mask, tv, smax)
+            return tv
+
+        def layer_step(x, p, caches, l, t, write_mask=None):
+            # one gate for both cache flavors' fused write+attend branch.
+            # A masked write (serving's in-slot prefill: only admitted
+            # rows may land K/V) always takes the scatter path — the
+            # write kernels land every row unconditionally.
             kw_on = (os.environ.get("PADDLE_TPU_KERNEL_CACHE_WRITE",
                                     "0") == "1"
                      and os.environ.get("PADDLE_TPU_STACKED_KERNEL",
                                         "1") != "0"
-                     and mesh is None)
+                     and mesh is None and write_mask is None)
             residual = x
             h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
             b = h.shape[0]
@@ -895,7 +923,9 @@ class FusedDecoder:
                     if stacked_i8_write_is_supported(
                             (q.shape[0], 1, nh, hd), caches[0].shape,
                             q.dtype):
-                        lens_ = jnp.full((q.shape[0],), t, jnp.int32)
+                        lens_ = jnp.broadcast_to(
+                            jnp.asarray(t).astype(jnp.int32),
+                            (q.shape[0],))
                         ci8, scs, o = decode_attention_stacked_i8_write(
                             jnp.swapaxes(q, 1, 2), kv_new, caches[0],
                             caches[1], l, lens_)
@@ -903,19 +933,26 @@ class FusedDecoder:
                         attn = jnp.swapaxes(o, 1, 2)
                 if attn is None:
                     # cache-quant write: per-row absmax int8 + fp32 scale
-                    kv32 = kv_new.astype(jnp.float32)
-                    amax = jnp.max(jnp.abs(kv32), axis=-1, keepdims=True)
-                    sc_new = amax / 127.0
-                    q_new = jnp.clip(
-                        jnp.round(kv32 / jnp.maximum(sc_new, 1e-8)),
-                        -127, 127).astype(jnp.int8)
-                    ci8 = jax.lax.dynamic_update_slice(
-                        caches[0], q_new[None], (l, 0, 0, 0, t, 0))
-                    # scale layout is [L, 2, B, H, 1, Smax]: position on
-                    # the last axis, so this token's scales land at
-                    # [..., 0, t]
-                    scs = jax.lax.dynamic_update_slice(
-                        caches[1], sc_new[None], (l, 0, 0, 0, 0, t))
+                    q_new, sc_new = _absmax_int8(kv_new, -1)
+                    if jnp.ndim(t) == 0 and write_mask is None:
+                        ci8 = jax.lax.dynamic_update_slice(
+                            caches[0], q_new[None], (l, 0, 0, 0, t, 0))
+                        # scale layout is [L, 2, B, H, 1, Smax]: position
+                        # on the last axis, so this token's scales land
+                        # at [..., 0, t]
+                        scs = jax.lax.dynamic_update_slice(
+                            caches[1], sc_new[None], (l, 0, 0, 0, 0, t))
+                    else:
+                        # per-row positions (serving): one scatter of B
+                        # rows; masked/OOB rows are dropped
+                        tv = _write_targets(t, b, write_mask)
+                        bi = jnp.arange(b)
+                        ci8 = caches[0].at[l, :, bi, :, tv, :].set(
+                            jnp.swapaxes(q_new[:, :, :, 0], 0, 1),
+                            mode="drop")
+                        scs = caches[1].at[l, :, bi, :, 0, tv].set(
+                            jnp.swapaxes(sc_new[:, :, :, 0, 0], 0, 1),
+                            mode="drop")
                     caches = (ci8, scs)
                     attn = attend(q, caches, l, t)
             else:
@@ -932,16 +969,25 @@ class FusedDecoder:
                     if stacked_write_is_supported(
                             (q.shape[0], 1, nh, hd), caches.shape,
                             q.dtype, cache_dtype=caches.dtype):
-                        lens_ = jnp.full((q.shape[0],), t, jnp.int32)
+                        lens_ = jnp.broadcast_to(
+                            jnp.asarray(t).astype(jnp.int32),
+                            (q.shape[0],))
                         caches, o = decode_attention_stacked_write(
                             jnp.swapaxes(q, 1, 2),
                             kv_new.astype(caches.dtype), caches, l,
                             lens_)
                         attn = jnp.swapaxes(o, 1, 2)
                 if attn is None:
-                    caches = jax.lax.dynamic_update_slice(
-                        caches, kv_new[None].astype(caches.dtype),
-                        (l, 0, 0, 0, t, 0))
+                    if jnp.ndim(t) == 0 and write_mask is None:
+                        caches = jax.lax.dynamic_update_slice(
+                            caches, kv_new[None].astype(caches.dtype),
+                            (l, 0, 0, 0, t, 0))
+                    else:
+                        tv = _write_targets(t, b, write_mask)
+                        caches = caches.at[
+                            l, :, jnp.arange(b), :, tv, :].set(
+                            jnp.swapaxes(kv_new[:, :, :, 0], 0, 1).astype(
+                                caches.dtype), mode="drop")
                     attn = attend(q, caches, l, t)
             return proj_ffn_tail(residual, attn.reshape(b, 1, nh * hd),
                                  p), caches
@@ -956,9 +1002,12 @@ class FusedDecoder:
                 out = fn(Tensor(x_arr))
             return out._data if isinstance(out, Tensor) else out
 
-        def hidden(stk, e_arrays, caches, tok, t):
-            # tok: [B] int32; t: scalar int32; caches: [L, 2, B, H, Smax, D]
-            # -> (x [B, 1, E], caches) with caches updated at position t.
+        def hidden(stk, e_arrays, caches, tok, t, write_mask=None):
+            # tok: [B] int32; t: scalar int32 OR [B] per-row positions
+            # (serving: each slot decodes at its own depth); caches:
+            # [L, 2, B, H, Smax, D] -> (x [B, 1, E], caches) with caches
+            # updated at position t (rows where write_mask is False are
+            # skipped — attention still runs, the K/V write is dropped).
             # The cache rides the layer scan as CARRY (in-place dynamic
             # updates on one buffer), not as xs->ys (which rewrote the
             # whole stack per token — the r3 decode profile's ~10 ms/token
@@ -977,7 +1026,7 @@ class FusedDecoder:
             def body(carry, xs):
                 x, caches = carry
                 p, l = xs
-                x, caches = layer_step(x, p, caches, l, t)
+                x, caches = layer_step(x, p, caches, l, t, write_mask)
                 return (x, caches), None
             nl = (caches[0] if isinstance(caches, tuple)
                   else caches).shape[0]
@@ -1034,10 +1083,14 @@ class FusedDecoder:
             """Whole-prompt prefill: embed [B, S], run the layer stack
             with CAUSAL FLASH attention over the full sequence (MXU-fed
             [B,S,E] matmuls instead of the per-token scan's [B,1,E]
-            slivers), and return (last hidden [B,1,E],
+            slivers), and return (hidden states [B,S,E],
             kv_all [L,2,B,H,S,D]). The K/V stack comes out as scan ys —
             never a carried buffer — so the caller builds the ring cache
-            with ONE pad, no DUS and no aliasing hazard at all."""
+            with ONE pad, no DUS and no aliasing hazard at all. ALL
+            positions' hidden states come back (not just the last): the
+            serving engine's in-slot bulk admission pads ragged prompts
+            to a pow-2 bucket and gathers each row's hidden at its OWN
+            last real token."""
             from ..ops.pallas import flash_attention as fa
             x = call_layerlike(embed, e_params, e_arrays, toks)
             S = toks.shape[1]
@@ -1071,7 +1124,7 @@ class FusedDecoder:
                 return x, kv
 
             x, kv_all = jax.lax.scan(body, x, stk)
-            return x[:, -1:], kv_all
+            return x, kv_all
 
         def step(stk, e_arrays, h_arrays, caches, tok, t, key):
             x, caches = hidden(stk, e_arrays, caches, tok, t)

@@ -1,0 +1,648 @@
+"""Continuous-batching serving engine over the stacked KV ring cache.
+
+Capability parity: the serving loop the reference's AnalysisPredictor +
+fused_multi_transformer stack is deployed behind (and the Orca/vLLM-style
+slot scheduling production LLM serving converged on), realized TPU-style
+on top of FusedDecoder's machinery:
+
+  * ONE decode step is compiled for a fixed shape — B cache slots over
+    the stacked ring buffer [L, 2, B, H, Smax, D] — and stays hot while
+    requests churn through the slots. Admission, completion, and slot
+    reuse are pure DATA (per-slot `cache_lens`, active masks, per-slot
+    sampling params all ride in as arrays), so request churn causes ZERO
+    retraces and zero recompiles after warmup.
+  * Each slot decodes at its OWN depth: the per-row position path in
+    generation.py (vector `t`) drives the same Pallas flash-decode
+    kernels, which always took per-row `cache_lens`.
+  * In-slot prefill: a freed slot is overwritten by the next queued
+    request via the chunked prefill scan with a per-row WRITE MASK —
+    non-admitted rows' live cache rows are untouchable by construction
+    (masked rows scatter out of bounds and are dropped).
+  * Slot eviction = resetting `cache_lens[b]` host-side; nothing is
+    zeroed. The decode_attention write kernels' `cache_lens < Smax`
+    invariant (enforced at submit: prompt + max_new_tokens <= Smax)
+    guarantees a dead slot can never write out of its row.
+
+Host control happens only at chunk boundaries: every `decode_chunk`
+tokens the engine harvests per-slot streams, completes finished
+requests, admits from the queue, and emits a metrics record (tokens/s,
+TTFT, queue depth, slot occupancy, step latency, trace count).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_key
+from ..tensor.tensor import Tensor, no_grad
+from .generation import FusedDecoder, _absmax_int8, _sample_next
+
+__all__ = ["ServingEngine", "ServedRequest"]
+
+
+class ServedRequest:
+    """One request's lifecycle record. States: queued -> running ->
+    finished. Times come from the engine clock (injectable for virtual-
+    time benchmarking); `ttft_s`/`latency_s` are measured from submit."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "min_length", "repetition_penalty", "state", "slot",
+                 "tokens", "t_submit", "t_first", "t_done")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
+                 min_length, repetition_penalty, t_submit):
+        self.rid = rid
+        self.prompt = prompt                      # np.int32 [S]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.min_length = int(min_length)
+        self.repetition_penalty = float(repetition_penalty)
+        self.state = "queued"
+        self.slot = None
+        self.tokens = []                          # generated token ids
+        self.t_submit = t_submit
+        self.t_first = None                       # first token time
+        self.t_done = None
+
+    @property
+    def ttft_s(self):
+        return (None if self.t_first is None
+                else self.t_first - self.t_submit)
+
+    @property
+    def latency_s(self):
+        return (None if self.t_done is None
+                else self.t_done - self.t_submit)
+
+    def result(self):
+        return {"rid": self.rid, "tokens": np.asarray(self.tokens,
+                                                      np.int32),
+                "ttft_s": self.ttft_s, "latency_s": self.latency_s}
+
+
+class ServingEngine:
+    """Slot-based continuous batching over FusedDecoder's compiled step.
+
+    API sketch::
+
+        eng = ServingEngine(fmt, embed, head, num_slots=8,
+                            max_seq_len=1024)
+        rid = eng.submit(prompt_ids, max_new_tokens=64, eos_token_id=2)
+        eng.run()                       # drive until queue + slots drain
+        out = eng.results[rid]["tokens"]
+        eng.metrics()                   # aggregate engine counters
+
+    Sampling mode (greedy / top-k / top-p / temperature) is ENGINE
+    config — it is baked into the one compiled step. Per-REQUEST knobs
+    (eos_token_id, max_new_tokens, min_length, repetition_penalty) are
+    data: [B] arrays the compiled step reads, so they never retrace.
+    repetition_penalty needs the [B, V] presence-mask carry; enable it
+    at construction (`enable_repetition_penalty=True`) — the flag is
+    static trace structure.
+    """
+
+    def __init__(self, fmt, embed, head, num_slots, max_seq_len,
+                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+                 decode_chunk=None, use_rotary=False,
+                 enable_repetition_penalty=False, clock=None):
+        self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
+                                use_rotary=use_rotary)
+        self.num_slots = int(num_slots)
+        self.smax = self.dec.smax
+        self.do_sample = bool(do_sample)
+        self.top_k, self.top_p = top_k, top_p
+        self.temperature = temperature
+        self.decode_chunk = int(decode_chunk or
+                                os.environ.get("PADDLE_TPU_SERVE_CHUNK",
+                                               "4"))
+        self.prefill_cap = 64                   # pow-2 prefill ladder cap
+        self._rep_on = bool(enable_repetition_penalty)
+        self.clock = clock or time.perf_counter
+
+        b = self.num_slots
+        fmt.eval()
+        self._caches = self.dec.init_cache(b)
+        # host-side slot state (tiny [B] vectors; device arrays would buy
+        # nothing — they cross the boundary once per chunk anyway)
+        self._lens = np.zeros(b, np.int32)       # current decode position
+        self._active = np.zeros(b, bool)
+        self._nt = np.zeros(b, np.int32)         # tokens generated so far
+        self._max_nt = np.ones(b, np.int32)
+        self._eos = np.full(b, -1, np.int32)     # -1: no eos for the slot
+        self._min_len = np.zeros(b, np.int32)
+        self._rep_pen = np.ones(b, np.float32)
+        self._tok = np.zeros(b, np.int32)        # next step's input token
+        self._slot_req = [None] * b              # slot -> ServedRequest
+        self._presence = None                    # [B, V] bool when rep_on
+
+        self._queue = deque()
+        self.results = {}
+        self._rid = itertools.count()
+        self._jit_cache = {}
+        self._trace_count = 0                    # the retrace spy
+        # per-chunk metric records, bounded: a server driving step()
+        # forever must not leak one dict per chunk (metrics() reads the
+        # aggregate counters, never this log — it is observability only)
+        self.chunk_log = deque(maxlen=int(os.environ.get(
+            "PADDLE_TPU_SERVE_CHUNK_LOG", "4096")))
+        self._tokens_emitted = 0
+        self._busy_s = 0.0
+        self._admitted = 0
+
+    # ------------------------------------------------------------- public
+    def submit(self, prompt, max_new_tokens=20, eos_token_id=None,
+               min_length=0, repetition_penalty=1.0):
+        """Queue one request; returns its id. The slot-eviction invariant
+        is enforced HERE: a request may never be able to push its slot's
+        cache_lens to Smax (the write kernels' documented invariant).
+        prompt + max_new_tokens == Smax is allowed: cache_lens peaks at
+        Smax - 1, because a slot that deactivates (nt hit
+        max_new_tokens) stops INCREMENTING lens. The decode scan still
+        runs unmasked for inactive rows (a write mask would demote the
+        fused write+attend kernel), so the last sampled token's K/V IS
+        written — at the frozen lens == Smax - 1, rewritten with the
+        same value each subsequent chunk while the slot idles. In-bounds
+        by the check below, overwritten by the next admission's prefill;
+        do NOT snapshot a finished slot's cache row expecting it frozen
+        as of the final active step."""
+        ids = prompt._data if isinstance(prompt, Tensor) else prompt
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if ids.size + int(max_new_tokens) > self.smax:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens})"
+                f" exceeds the ring capacity Smax={self.smax} — the slot "
+                "could fill its cache row (cache_lens < Smax invariant)")
+        if repetition_penalty != 1.0 and not self._rep_on:
+            raise ValueError(
+                "repetition_penalty needs enable_repetition_penalty=True "
+                "at engine construction (the presence-mask carry is "
+                "static trace structure)")
+        req = ServedRequest(next(self._rid), ids, max_new_tokens,
+                            eos_token_id, min_length, repetition_penalty,
+                            self.clock())
+        self._queue.append(req)
+        return req.rid
+
+    @property
+    def has_work(self):
+        return bool(self._queue) or bool(self._active.any())
+
+    @property
+    def queue_depth(self):
+        return len(self._queue)
+
+    @property
+    def occupancy(self):
+        return float(self._active.mean()) if self.num_slots else 0.0
+
+    @no_grad()
+    def step(self):
+        """One scheduler iteration: admit waiting requests into free
+        slots (in-slot prefill + first-token sample), then run one
+        compiled decode chunk and harvest it. Emits one chunk_log record.
+        Returns the number of tokens emitted this step."""
+        t0 = self.clock()
+        admitted = self._admit()
+        emitted = len(admitted)
+        if self._active.any():
+            emitted += self._decode_one_chunk()
+        dt = self.clock() - t0
+        self._busy_s += dt
+        self._tokens_emitted += emitted
+        self.chunk_log.append({
+            "step_s": dt, "new_tokens": emitted,
+            "occupancy": self.occupancy, "queue_depth": self.queue_depth,
+            "traces": self._trace_count,
+        })
+        return emitted
+
+    def run(self):
+        """Drive until the queue and all slots drain."""
+        while self.has_work:
+            self.step()
+        return self.results
+
+    def reset_metrics(self, keep_results=True):
+        """Zero the aggregate counters (benchmarks call this after a
+        warmup phase so the measured window excludes compiles). The
+        trace counter is NOT reset — retraces-after-warmup is exactly
+        `metrics()['traces']` before vs after the measured phase."""
+        self.chunk_log.clear()
+        self._tokens_emitted = 0
+        self._busy_s = 0.0
+        self._admitted = 0
+        if not keep_results:
+            self.results = {}
+
+    def metrics(self):
+        done = [r for r in self.results.values()]
+        ttfts = [d["ttft_s"] for d in done if d["ttft_s"] is not None]
+        lats = [d["latency_s"] for d in done if d["latency_s"] is not None]
+
+        def pct(v, q):
+            return float(np.percentile(v, q)) if v else None
+        return {
+            "tokens_emitted": self._tokens_emitted,
+            "busy_s": round(self._busy_s, 4),
+            "tokens_per_sec": round(
+                self._tokens_emitted / self._busy_s, 2)
+            if self._busy_s else None,
+            "requests_finished": len(done),
+            "requests_admitted": self._admitted,
+            "queue_depth": self.queue_depth,
+            "occupancy": self.occupancy,
+            "traces": self._trace_count,
+            "ttft_p50_s": pct(ttfts, 50), "ttft_p99_s": pct(ttfts, 99),
+            "latency_p50_s": pct(lats, 50), "latency_p99_s": pct(lats, 99),
+        }
+
+    # ------------------------------------------------------- jitted steps
+    def _counted_jit(self, key, build, donate=()):
+        """jit with a retrace spy: the counter bumps at TRACE time (python
+        side effects run only while tracing), so `metrics()['traces']`
+        counts executable builds, not calls — the engine's zero-retrace-
+        after-warmup contract is asserted against exactly this number."""
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            inner = build()
+
+            def spied(*args):
+                self._trace_count += 1
+                return inner(*args)
+            tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+            fn = jax.jit(spied, donate_argnums=() if tunneled else donate)
+            self._jit_cache[key] = fn
+        return fn
+
+    def _core(self):
+        core = getattr(self, "_core_cache", None)
+        if core is None:
+            core = self.dec._build_step_core(
+                self.do_sample, self.top_k, self.top_p, self.temperature)
+            self._core_cache = core
+        return core
+
+    def _build_decode_chunk(self):
+        """The ONE compiled decode step: decode_chunk tokens per dispatch
+        over all B slots, each at its own depth (the scan length comes
+        from the `keys` argument the caller builds, one key per token).
+        Finish bookkeeping (per-slot eos / max_new_tokens) runs on-device
+        inside the scan; the host only sees the per-step (token,
+        emitted-mask) ys at the chunk boundary."""
+        core = self._core()
+        hidden, head_logits = core.hidden, core.head_logits
+        rep_on = self._rep_on
+        do_sample = self.do_sample
+        top_k, top_p, temp = self.top_k, self.top_p, self.temperature
+
+        def decode_chunk(stk, e_arrays, h_arrays, caches, tok, lens,
+                         active, nt, max_nt, eos_ids, min_len, rep_pen,
+                         presence, keys):
+            def body(carry, key):
+                tok, caches, lens, active, nt, presence = carry
+                x, caches = hidden(stk, e_arrays, caches, tok, lens)
+                logits = head_logits(h_arrays, x)
+                logits = logits.reshape(logits.shape[0], -1)
+                logits = _penalize_slots(
+                    logits, presence if rep_on else None, rep_pen, nt,
+                    min_len, eos_ids)
+                nxt = _sample_next(logits, do_sample, top_k, top_p,
+                                   temp, key)
+                emitted = active
+                hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+                step = active.astype(jnp.int32)
+                nt = nt + step
+                lens = lens + step
+                active = active & ~hit_eos & (nt < max_nt)
+                tok = jnp.where(emitted, nxt, tok)
+                if rep_on:
+                    presence = presence.at[
+                        jnp.arange(nxt.shape[0]), nxt].max(emitted)
+                carry = (tok, caches, lens, active, nt, presence)
+                return carry, (nxt, emitted)
+            carry, ys = jax.lax.scan(
+                body, (tok, caches, lens, active, nt, presence), keys)
+            tok, caches, lens, active, nt, presence = carry
+            return caches, tok, lens, active, nt, presence, ys
+        return decode_chunk
+
+    def _build_prefill_chunk(self, chunk):
+        """In-slot prefill: `chunk` teacher-forced tokens, per-row start
+        positions and per-row valid counts. Rows outside their valid
+        range (and slots not being admitted, n_valid == 0) are write-
+        masked — their cache rows cannot be touched. Each admitted row's
+        LAST valid hidden state is captured into last_x."""
+        hidden = self._core().hidden
+
+        def prefill(stk, e_arrays, caches, toks, t0, n_valid, last_x):
+            def body(carry, xs):
+                caches, last_x = carry
+                tok_i, i = xs
+                mask = i < n_valid
+                x, caches = hidden(stk, e_arrays, caches, tok_i, t0 + i,
+                                   mask)
+                last_x = jnp.where(mask[:, None, None], x, last_x)
+                return (caches, last_x), None
+            (caches, last_x), _ = jax.lax.scan(
+                body, (caches, last_x),
+                (toks, jnp.arange(chunk, dtype=jnp.int32)))
+            return last_x, caches
+        return prefill
+
+    def _build_admit_sample(self):
+        """First-token sample on the prefill hidden states (TTFT): the
+        per-slot logit controls apply at nt=0 for the admitted rows;
+        non-admitted rows' outputs are discarded by the host."""
+        head_logits = self._core().head_logits
+        rep_on = self._rep_on
+        do_sample = self.do_sample
+        top_k, top_p, temp = self.top_k, self.top_p, self.temperature
+
+        def admit_sample(h_arrays, last_x, key, eos_ids, min_len,
+                         rep_pen, presence):
+            logits = head_logits(h_arrays, last_x)
+            logits = logits.reshape(logits.shape[0], -1)
+            nt0 = jnp.zeros(logits.shape[0], jnp.int32)
+            logits = _penalize_slots(
+                logits, presence if rep_on else None, rep_pen, nt0,
+                min_len, eos_ids)
+            return _sample_next(logits, do_sample, top_k, top_p, temp,
+                                key)
+        return admit_sample
+
+    def _build_bulk_admit(self, sb):
+        """In-slot BULK prefill: one causal-flash pass over a single
+        padded prompt row [1, sb] (parallel over positions — no scan),
+        then one scatter of its K/V into the slot's cache row. Garbage
+        K/V at padded positions [plen, sb) is safe: decode writes the
+        real token's K/V at position `lens` BEFORE attending it
+        (write-then-attend), so a garbage position is always overwritten
+        the step it would first become attendable."""
+        core = self._core()
+        bulk_hidden = core.bulk_hidden
+        int8 = self.dec._int8_cache()
+        cache_dtype = self.dec.fmt.qkv_weights[0]._data.dtype
+
+        def bulk_admit(stk, e_arrays, caches, toks, slot, plen):
+            x, kv_all = bulk_hidden(stk, e_arrays, toks)
+            # the row's OWN last real token's hidden state (ragged pad)
+            last = jax.lax.dynamic_slice_in_dim(x, plen - 1, 1, 1)
+            kv = kv_all[:, :, 0]                      # [L, 2, H, sb, D]
+            if int8:
+                qi, sc = _absmax_int8(kv, -1)
+                ci8 = caches[0].at[:, :, slot, :, :sb, :].set(qi)
+                scs = caches[1].at[:, :, slot, :, 0, :sb].set(sc[..., 0])
+                caches = (ci8, scs)
+            else:
+                caches = caches.at[:, :, slot, :, :sb, :].set(
+                    kv.astype(cache_dtype))
+            return caches, last
+        return bulk_admit
+
+    def _bulk_admit_row(self, stk, e_arrays, req, last_x):
+        plen = req.prompt.size
+        sb = min(1 << (int(plen) - 1).bit_length(), self.smax)
+        fn = self._counted_jit(
+            ("bulk_admit", sb),
+            lambda s=sb: self._build_bulk_admit(s), donate=(2,))
+        toks = np.zeros((1, sb), np.int32)
+        toks[0, :plen] = req.prompt
+        self._caches, row_x = fn(
+            stk, e_arrays, self._caches, jnp.asarray(toks),
+            jnp.asarray(req.slot, jnp.int32),
+            jnp.asarray(plen, jnp.int32))
+        return last_x.at[req.slot].set(row_x[0])
+
+    # --------------------------------------------------------- scheduling
+    def _free_slots(self):
+        return [i for i in range(self.num_slots)
+                if not self._active[i] and self._slot_req[i] is None]
+
+    def _admit(self):
+        """Move queued requests into free slots: batched in-slot prefill
+        (chunked, write-masked) + one first-token sample. Returns the
+        list of admitted requests (each just emitted its first token)."""
+        free = self._free_slots()
+        batch = []
+        while free and self._queue:
+            req = self._queue.popleft()
+            slot = free.pop(0)
+            req.slot = slot
+            req.state = "running"
+            self._slot_req[slot] = req
+            batch.append(req)
+        if not batch:
+            return []
+        self._admitted += len(batch)
+        b = self.num_slots
+        stk = self.dec._stacked()
+        e_arrays = [p._data for p in self.dec._embed_params]
+        h_arrays = self.dec._maybe_quant_head(
+            [p._data for p in self.dec._head_params])
+
+        if self._rep_on:
+            # reset the admitted rows' presence to their prompt one-hots
+            vocab = self._presence_init().shape[1]
+            admit_mask = np.zeros(b, bool)
+            rows = np.zeros((b, vocab), bool)
+            for r in batch:
+                admit_mask[r.slot] = True
+                rows[r.slot, r.prompt] = True
+            self._presence = jnp.where(
+                jnp.asarray(admit_mask)[:, None], jnp.asarray(rows),
+                self._presence_init())
+
+        # E from the embedding table; dtype from the stack
+        e_dim = int(e_arrays[0].shape[-1]) if e_arrays else \
+            int(self.dec.fmt.qkv_weights[0]._data.shape[-1])
+        dt = self.dec.fmt.qkv_weights[0]._data.dtype
+        last_x = jnp.zeros((b, 1, e_dim), dt)
+
+        # Two in-slot prefill flavors:
+        #  * bulk (default, no mesh): ONE causal-flash pass over the
+        #    single admitted row, padded to a pow-2 bucket, then one
+        #    scatter into that slot's cache row. Prefill compute is per
+        #    ROW — the masked batch scan below runs every step over all
+        #    B rows to fill one, which made admission cost ~B x static
+        #    batching's shared prefill on the serving bench.
+        #  * masked scan (mesh / opt-out PADDLE_TPU_SERVE_BULK=0): the
+        #    chunked prefill scan with a per-row write mask.
+        use_bulk = (self.dec._mesh_mp() is None and
+                    os.environ.get("PADDLE_TPU_SERVE_BULK", "1") != "0")
+        if use_bulk:
+            for r in batch:
+                last_x = self._bulk_admit_row(stk, e_arrays, r, last_x)
+        else:
+            maxp = max(r.prompt.size for r in batch)
+            chunks = self._prefill_chunks(maxp)
+            prompts = np.zeros((b, sum(chunks)), np.int32)
+            n_left = np.zeros(b, np.int32)
+            for r in batch:
+                prompts[r.slot, :r.prompt.size] = r.prompt
+                n_left[r.slot] = r.prompt.size
+            pos = 0
+            for chunk in chunks:
+                fn = self._counted_jit(
+                    ("prefill", chunk),
+                    lambda c=chunk: self._build_prefill_chunk(c),
+                    donate=(2,))
+                toks = jnp.asarray(
+                    np.ascontiguousarray(prompts[:, pos:pos + chunk].T))
+                t0 = np.where(n_left > 0, pos, self._lens).astype(
+                    np.int32)
+                n_valid = np.clip(n_left - pos, 0, chunk).astype(
+                    np.int32)
+                last_x, self._caches = fn(
+                    stk, e_arrays, self._caches, toks,
+                    jnp.asarray(t0), jnp.asarray(n_valid), last_x)
+                pos += chunk
+
+        # per-slot params refresh for the admitted rows
+        for r in batch:
+            s = r.slot
+            self._lens[s] = r.prompt.size
+            self._nt[s] = 0
+            self._max_nt[s] = r.max_new_tokens
+            self._eos[s] = (-1 if r.eos_token_id is None
+                            else int(r.eos_token_id))
+            self._min_len[s] = r.min_length
+            self._rep_pen[s] = r.repetition_penalty
+
+        sample = self._counted_jit(("admit_sample",),
+                                   self._build_admit_sample)
+        key = next_key() if self.do_sample else jax.random.PRNGKey(0)
+        nxt = np.asarray(sample(
+            h_arrays, last_x, key, jnp.asarray(self._eos),
+            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+            self._presence_arg()))
+
+        now = self.clock()
+        for r in batch:
+            s = r.slot
+            tok0 = int(nxt[s])
+            r.t_first = now
+            r.tokens.append(tok0)
+            self._nt[s] = 1
+            self._tok[s] = tok0
+            hit_eos = (r.eos_token_id is not None
+                       and tok0 == int(r.eos_token_id))
+            self._active[s] = not hit_eos and r.max_new_tokens > 1
+            if self._rep_on:
+                self._presence = self._presence.at[s, tok0].set(True)
+            if not self._active[s]:
+                self._finish(r, now)
+        return batch
+
+    def _decode_one_chunk(self):
+        chunk = self.decode_chunk
+        stk = self.dec._stacked()
+        e_arrays = [p._data for p in self.dec._embed_params]
+        h_arrays = self.dec._maybe_quant_head(
+            [p._data for p in self.dec._head_params])
+        fn = self._counted_jit(
+            ("decode", chunk), self._build_decode_chunk, donate=(3,))
+        base = next_key() if self.do_sample else jax.random.PRNGKey(0)
+        keys = jax.random.split(base, chunk)
+        (self._caches, tok, lens, active, nt, presence,
+         (toks, emitted)) = fn(
+            stk, e_arrays, h_arrays, self._caches,
+            jnp.asarray(self._tok), jnp.asarray(self._lens),
+            jnp.asarray(self._active), jnp.asarray(self._nt),
+            jnp.asarray(self._max_nt), jnp.asarray(self._eos),
+            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+            self._presence_arg(), keys)
+        if self._rep_on:
+            self._presence = presence
+        toks = np.asarray(toks)                  # [chunk, B]
+        emitted = np.asarray(emitted)            # [chunk, B] bool
+        # np.array (not asarray): host slot state stays WRITABLE — jax
+        # outputs view as read-only numpy
+        self._tok = np.array(tok)
+        self._lens = np.array(lens)
+        self._nt = np.array(nt)
+        still_active = np.array(active)
+
+        n_emitted = 0
+        now = self.clock()
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is None or not self._active[s]:
+                continue
+            hits = emitted[:, s]
+            req.tokens.extend(int(t) for t in toks[hits, s])
+            n_emitted += int(hits.sum())
+            if not still_active[s]:
+                self._finish(req, now)
+        self._active = still_active
+        return n_emitted
+
+    def _finish(self, req, now):
+        req.state = "finished"
+        req.t_done = now
+        self.results[req.rid] = req.result()
+        s = req.slot
+        self._slot_req[s] = None
+        self._active[s] = False
+        # slot eviction IS this bookkeeping: the cache row is left as-is
+        # (positions >= cache_lens are never attendable; the next
+        # admission's masked prefill overwrites [0, plen) in place)
+
+    # ------------------------------------------------------------ helpers
+    def _prefill_chunks(self, maxp):
+        """Prefill dispatch sizes for a prompt of length maxp: full
+        `prefill_cap` chunks, then ONE chunk rounded UP to the next
+        power of two (bounded variant set, like the decode ladder — but
+        up, not down). One admission is one prefill dispatch for any
+        prompt <= cap; the tail steps are write-masked no-ops. Serving
+        is dispatch-bound at admission time: a 3-dispatch 4+2+1 ladder
+        walk per admitted request measurably beat the masked tail's
+        wasted compute on the serving bench."""
+        out, pos = [], 0
+        while pos < maxp:
+            rem = maxp - pos
+            c = (self.prefill_cap if rem >= self.prefill_cap
+                 else 1 << (rem - 1).bit_length())
+            out.append(c)
+            pos += c
+        return out
+
+    def _presence_init(self):
+        if self._presence is None:
+            vocab = int(self.dec._head_params[0].shape[1])
+            self._presence = jnp.zeros((self.num_slots, vocab), bool)
+        return self._presence
+
+    def _presence_arg(self):
+        if not self._rep_on:
+            # a [B, 1] placeholder keeps the compiled signature stable
+            return jnp.zeros((self.num_slots, 1), bool)
+        return self._presence_init()
+
+
+def _penalize_slots(logits, presence, rep_pen, nt, min_len, eos_ids):
+    """Vectorized-over-slots logit controls (reference: generation's
+    logit processors, here with PER-SLOT parameters as data):
+    repetition_penalty divides positive / multiplies negative logits of
+    context tokens, per row (rows at 1.0 are exact no-ops); min_length
+    suppresses each row's OWN eos column while that row has generated
+    fewer than its min_length tokens. eos_ids < 0 means no eos."""
+    if presence is not None:
+        pen = rep_pen[:, None]
+        logits = jnp.where(
+            presence,
+            jnp.where(logits > 0, logits / pen, logits * pen),
+            logits)
+    cols = jnp.arange(logits.shape[1])[None, :]
+    is_eos = cols == eos_ids[:, None]
+    suppress = is_eos & (nt < min_len)[:, None]
+    return jnp.where(suppress, -1e30, logits)

@@ -491,7 +491,14 @@ def _stacked_write_kernel(lay_ref, len_ref, q_ref, kvn_ref, kv_ref,
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     n_valid = len_ref[pl.program_id(0)]
-    jw = n_valid // bk                     # block holding the write slot
+    # block holding the write slot, clamped to the LAST real block: at a
+    # full cache (n_valid == Smax — an eviction-invariant violation) the
+    # unclamped jw would be nk, one past the grid, and the matching
+    # output index map would address undefined HBM. Clamped, the write
+    # row-select misses every row (off == bk) so the kernel copies the
+    # last block through unchanged — the new token is DROPPED, never a
+    # wild write.
+    jw = jnp.minimum(n_valid // bk, nk - 1)
 
     @pl.when(ki == 0)
     def _():
@@ -562,7 +569,17 @@ def decode_attention_stacked_write(qt, kv_new, caches, layer, cache_lens,
 
     The caller must NOT dynamic_update_slice the cache first — the write
     happens inside the kernel, and the new token's self-attention term is
-    seeded from kv_new directly."""
+    seeded from kv_new directly.
+
+    INVARIANT: cache_lens[b] < Smax for every row — the ring must have a
+    free slot (the serving engine's slot-eviction logic frees a row
+    BEFORE re-admitting into it, maintaining exactly this). A full row
+    (cache_lens[b] == Smax) cannot raise from traced code; instead both
+    the in-kernel write block and the output index map clamp to the last
+    sequence block, so the new token is dropped and the cache bytes are
+    left untouched (attn still includes the new token's seeded
+    self-attention term). Never rely on the drop: it exists to make an
+    invariant violation non-corrupting, not to implement eviction."""
     b, h, sq, d = qt.shape
     hk, smax = caches.shape[3], caches.shape[4]
     group = h // hk
@@ -588,8 +605,13 @@ def decode_attention_stacked_write(qt, kv_new, caches, layer, cache_lens,
     # would write their stale VMEM windows back over live cache. With a
     # constant map, exactly one block per (b, hk) is ever written back;
     # every other cache block stays untouched HBM through the alias.
+    # min(..., nblk-1) mirrors the kernel's jw clamp: a full row
+    # (cache_lens == Smax) must address the LAST block, not one past it
+    # (see the invariant note in the docstring).
+    nblk = smax // bk
     kvoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, len_r[b_] // bk_, 0)
+        lay_r[0], 0, b_, h_ // g,
+        jnp.minimum(len_r[b_] // bk_, nblk - 1), 0)
     kv_new = kv_new[None]                  # [1, 2, B, Hk, 1, D]
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
@@ -630,7 +652,10 @@ def _stacked_i8_write_kernel(lay_ref, len_ref, q_ref, kvn_ref, kv_ref,
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     n_valid = len_ref[pl.program_id(0)]
-    jw = n_valid // bk
+    # same full-cache clamp as _stacked_write_kernel: at n_valid == Smax
+    # the write row/lane selects miss (off == bk) and the last block +
+    # scales copy through unchanged — token dropped, never a wild write
+    jw = jnp.minimum(n_valid // bk, nk - 1)
 
     # the new row's quantization (per-row absmax, same recipe as the
     # host-side cache-quant write) — computed where needed; the seeded
@@ -724,7 +749,11 @@ def decode_attention_stacked_i8_write(qt, kv_new, caches_i8, cache_scales,
     buffers aliased), and attends in the same pass. qt: [B, H, 1, D];
     kv_new: [2, B, Hk, 1, D] (fp); caches_i8: [L, 2, B, Hk, Smax, D]
     int8 DONATED; cache_scales: [L, 2, B, Hk, 1, Smax] fp32 DONATED.
-    Returns (caches_i8, cache_scales, attn)."""
+    Returns (caches_i8, cache_scales, attn).
+
+    INVARIANT: cache_lens[b] < Smax (see decode_attention_stacked_write);
+    a full row clamps to the last block and drops the write — cache and
+    scales come back byte-identical for that row, never corrupted."""
     b, h, sq, d = qt.shape
     hk, smax = caches_i8.shape[3], caches_i8.shape[4]
     group = h // hk
@@ -748,11 +777,15 @@ def decode_attention_stacked_i8_write(qt, kv_new, caches_i8, cache_scales,
         0, 0, b_, h_ // g, 0, 0)
     kvsidx = lambda b_, h_, j, lay_r, len_r, g=group: (  # noqa: E731
         lay_r[0], 0, b_, h_ // g, 0, clamp(j, len_r, b_))
-    # constant-at-jw output maps (see decode_attention_stacked_write)
+    # constant-at-jw output maps, clamped to the last block exactly like
+    # decode_attention_stacked_write (cache_lens < Smax invariant)
+    nblk = smax // bk
     kvoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, len_r[b_] // bk_, 0)
+        lay_r[0], 0, b_, h_ // g,
+        jnp.minimum(len_r[b_] // bk_, nblk - 1), 0)
     kvsoidx = lambda b_, h_, j, lay_r, len_r, g=group, bk_=bk: (  # noqa: E731
-        lay_r[0], 0, b_, h_ // g, 0, len_r[b_] // bk_)
+        lay_r[0], 0, b_, h_ // g, 0,
+        jnp.minimum(len_r[b_] // bk_, nblk - 1))
     kv_new = kv_new[None]                  # [1, 2, B, Hk, 1, D]
     lens = cache_lens.astype(jnp.int32).reshape(b)
     lay = jnp.asarray(layer, jnp.int32).reshape(1)
