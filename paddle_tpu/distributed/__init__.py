@@ -21,7 +21,10 @@ from . import sharding
 from .auto_parallel.api import shard_tensor, ProcessMesh, shard_op
 from .spawn_mod import spawn
 from .checkpoint import (save_state_dict, load_state_dict,
-                         wait_all_async_saves)
+                         wait_all_async_saves, save_checkpoint,
+                         load_latest, latest_step)
+from .resilience import (PeerFailureError, monitored_barrier,
+                         check_peer_failure)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
@@ -29,6 +32,8 @@ __all__ = [
     "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
     "reduce_scatter", "new_group", "get_group", "ReduceOp", "fleet",
     "sharding", "shard_tensor", "ProcessMesh", "spawn", "is_initialized",
+    "save_checkpoint", "load_latest", "latest_step", "PeerFailureError",
+    "monitored_barrier", "check_peer_failure",
 ]
 from . import rpc  # noqa: E402  (reference: paddle.distributed.rpc)
 __all__.append("rpc")

@@ -22,10 +22,86 @@ import numpy as np
 
 from ..tensor.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "wait_all_async_saves"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_all_async_saves",
+           "save_checkpoint", "load_latest", "latest_step"]
 
 _pending: list = []
 _pending_lock = threading.Lock()
+
+_COMMIT_MARKER = ".paddle_committed"   # exists <=> the step dir is durable
+_LATEST = "LATEST"
+
+
+class _AsyncHandle:
+    """AsyncCheckpointer + a finalize callback that runs EXACTLY ONCE,
+    after (and only after) the commit lands — the auto-resume LATEST
+    pointer rides on this, so a crash before the join leaves the previous
+    pointer intact and the partial dir unmarked (skipped on load)."""
+
+    def __init__(self, ckptr, finalize=None):
+        self._ckptr = ckptr
+        self._finalize = finalize
+        self._done = False
+        self._lock = threading.Lock()
+
+    def wait_until_finished(self):
+        self._ckptr.wait_until_finished()
+        with self._lock:
+            if not self._done:
+                self._done = True
+                if self._finalize is not None:
+                    self._finalize()
+
+    def close(self):
+        self._ckptr.close()
+
+
+class _ThreadHandle:
+    """Thread-backed async commit for the LOCAL (.pdparams) checkpoint
+    format — same join/finalize-exactly-once contract as _AsyncHandle, so
+    save_checkpoint's LATEST pointer lands at the wait_all_async_saves
+    join on this path too. `commit` runs on a daemon thread against a
+    snapshot taken by the CALLER (the write must never race live
+    parameter updates)."""
+
+    def __init__(self, commit, finalize=None):
+        self._finalize = finalize
+        self._err: BaseException | None = None
+        self._done = False
+        self._lock = threading.Lock()
+
+        def run():
+            try:
+                commit()
+            except BaseException as e:   # re-raised at the join
+                self._err = e
+
+        self._t = threading.Thread(target=run, daemon=True,
+                                   name="paddle-ckpt-local-async")
+        self._t.start()
+
+    def wait_until_finished(self):
+        self._t.join()
+        if self._err is not None:
+            raise self._err
+        with self._lock:
+            if not self._done:
+                self._done = True
+                if self._finalize is not None:
+                    self._finalize()
+
+    def close(self):
+        pass
+
+
+def _fsync_path(path: str):
+    """fsync an existing file (or directory) by path — durability for the
+    auto-resume chain: LATEST must never outlive the bytes it points at."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _to_arrays(sd):
@@ -60,24 +136,59 @@ def wait_all_async_saves():
 
 
 def save_state_dict(state_dict: dict, path: str, process_group=None,
-                    coordinator_rank: int = 0, async_save: bool = False):
+                    coordinator_rank: int = 0, async_save: bool = False,
+                    local: bool = False, _finalize=None):
     """Write a (possibly sharded) state dict. async_save=True returns as
     soon as the on-device arrays are snapshot; the serialize/commit runs in
-    the background (wait_all_async_saves() to join)."""
-    try:
-        import orbax.checkpoint as ocp
-    except ImportError:
-        from ..framework.io import save
-        save(state_dict, os.path.join(path, "fallback.pdparams"))
+    the background (wait_all_async_saves() to join). `_finalize` (internal,
+    save_checkpoint) runs once, strictly after the commit lands.
+
+    local=True writes host-local, WITHOUT cross-process coordination —
+    Orbax's save runs a global sync barrier across jax processes, so a
+    rank-0-only save of replicated state in a multi-process job would
+    wedge the caller (and, worse, wedge it in C where even the watchdog's
+    async-raise can't land). The local format is the framework's own
+    .pdparams serializer; load_state_dict auto-detects it. async_save is
+    honored here too: the host snapshot is taken before returning, the
+    pickle write (and _finalize) land at the wait_all_async_saves join."""
+    if not local:
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError:
+            local = True             # no orbax: same host-local fallback
+    if local:
+        os.makedirs(path, exist_ok=True)
+        from ..framework import io as _io
+        # snapshot to host NOW — the async contract is "return once the
+        # arrays are captured", and the background pickle must not race
+        # the train loop mutating the live tensors
+        snap = _io._to_saveable(state_dict)
+        target = os.path.join(path, "fallback.pdparams")
+
+        def commit():
+            # fsync the payload BEFORE the caller's finalize repoints
+            # LATEST — a power loss must not leave a durable-looking
+            # pointer at a torn pickle
+            _io.save(snap, target)
+            _fsync_path(target)
+
+        if async_save:
+            _track(_ThreadHandle(commit, finalize=_finalize))
+            return
+        commit()
+        if _finalize is not None:
+            _finalize()
         return
     arrays = _to_arrays(state_dict)
     path = os.path.abspath(path)
     if async_save:
         ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
         ckptr.save(path, arrays, force=True)
-        _track(ckptr)
+        _track(_AsyncHandle(ckptr, finalize=_finalize))
         return
     ocp.PyTreeCheckpointer().save(path, arrays, force=True)
+    if _finalize is not None:
+        _finalize()
 
 
 def load_state_dict(state_dict: dict, path: str, process_group=None,
@@ -86,9 +197,12 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
     tensor is materialized directly with its CURRENT sharding (mesh +
     sharding_spec at restore time — not the one it was saved under), so a
     checkpoint from mesh A restores onto mesh B with each device reading
-    only its slice."""
+    only its slice. A checkpoint written in the LOCAL format (see
+    save_state_dict(local=True) / no-orbax fallback) is auto-detected."""
     try:
         import orbax.checkpoint as ocp
+        if os.path.exists(os.path.join(path, "fallback.pdparams")):
+            raise ImportError    # local-format dir: use the native reader
     except ImportError:
         from ..framework.io import load
         restored = load(os.path.join(path, "fallback.pdparams"),
@@ -128,3 +242,133 @@ def load_state_dict(state_dict: dict, path: str, process_group=None,
         else:
             state_dict[k] = Tensor(np.asarray(arr))
     return state_dict
+
+
+# --------------------------------------------------------------- auto-resume
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(os.path.abspath(root), f"step_{int(step)}")
+
+
+def _is_committed(root: str, name: str) -> bool:
+    return os.path.isfile(os.path.join(root, name, _COMMIT_MARKER))
+
+
+def save_checkpoint(state_dict: dict, root: str, step: int,
+                    async_save: bool = False, keep: int = 0,
+                    local: bool = False):
+    """Durable, resumable checkpoint: writes `root/step_<step>/`, then —
+    strictly AFTER the commit lands — drops a commit marker in the dir and
+    atomically repoints `root/LATEST` (tmp + os.replace). A process that
+    dies mid-write leaves LATEST on the previous step and the partial dir
+    unmarked, so a supervised restart resumes from the last DURABLE step.
+
+    async_save=True: the marker + pointer land when the commit is joined
+    (wait_all_async_saves), never before. `keep` > 0 prunes all but the
+    newest `keep` committed step dirs. In MULTI-PROCESS jobs either every
+    rank calls this (sharded Orbax commit), or ONE rank checkpoints
+    replicated state with local=True — a rank-0-only DEFAULT (Orbax) save
+    would wedge in Orbax's global sync barrier."""
+    root = os.path.abspath(root)
+    os.makedirs(root, exist_ok=True)
+    d = _step_dir(root, step)
+
+    def finalize():
+        with open(os.path.join(d, _COMMIT_MARKER), "w") as f:
+            f.write(str(int(step)))
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            _fsync_path(d)       # dirents of marker + payload themselves
+        except OSError:
+            pass
+        tmp = os.path.join(root, f".{_LATEST}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(d))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(root, _LATEST))
+        try:
+            _fsync_path(root)    # the rename's directory entry itself
+        except OSError:
+            pass
+        if keep > 0:
+            _prune(root, keep)
+
+    save_state_dict(state_dict, d, async_save=async_save, local=local,
+                    _finalize=finalize)
+    return d
+
+
+def _committed_steps(root: str):
+    import re
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m:
+            out.append((int(m.group(1)), name))
+    return sorted(out, reverse=True)
+
+
+def _prune(root: str, keep: int):
+    import shutil
+    committed = [(s, n) for s, n in _committed_steps(root)
+                 if _is_committed(root, n)]
+    for _, name in committed[keep:]:
+        shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def latest_step(root: str):
+    """The newest durable (committed) step under `root`, or None. Prefers
+    the LATEST pointer; falls back to a directory scan when the pointer is
+    missing or points at an uncommitted dir."""
+    import logging
+    root = os.path.abspath(root)
+    try:
+        with open(os.path.join(root, _LATEST)) as f:
+            name = f.read().strip()
+        if name and _is_committed(root, name):
+            return int(name.rsplit("_", 1)[1])
+        if name:
+            logging.warning(
+                "paddle_tpu.checkpoint: LATEST points at %s which is not "
+                "committed — scanning for the newest durable step", name)
+    except (OSError, ValueError, IndexError):
+        pass
+    for step, name in _committed_steps(root):
+        if _is_committed(root, name):
+            return step
+        logging.warning("paddle_tpu.checkpoint: skipping partial/"
+                        "uncommitted checkpoint dir %s",
+                        os.path.join(root, name))
+    return None
+
+
+def load_latest(state_dict: dict, root: str):
+    """Restore `state_dict` from the newest durable checkpoint under
+    `root`. Returns the restored step (int) or None when no durable
+    checkpoint exists (fresh start). Partial/uncommitted dirs — a crash
+    mid-commit — are skipped with a warning, never loaded. A committed
+    step whose payload is unreadable anyway (torn disk, lost pages after
+    power loss) falls back to the next-newest durable step instead of
+    failing every restart attempt."""
+    import logging
+    root = os.path.abspath(root)
+    first = latest_step(root)
+    if first is None:
+        return None
+    order = [first] + [s for s, n in _committed_steps(root)
+                       if _is_committed(root, n) and s != first]
+    for step in order:
+        try:
+            load_state_dict(state_dict, _step_dir(root, step))
+            return step
+        except Exception as e:
+            logging.warning(
+                "paddle_tpu.checkpoint: committed step_%d payload is "
+                "unreadable (%r) — falling back to the previous durable "
+                "step", step, e)
+    return None

@@ -7,6 +7,15 @@ contract, captures per-rank logs (workerlog.N), restarts on failure up to
 
 TPU-native: one process per HOST (not per chip) — inside each process JAX owns
 all local chips; rendezvous is the JAX coordination service, not TCPStore.
+
+Gang supervision (SURVEY §5.3 failure detection): children are POLLED, not
+serially wait()ed — the first non-zero exit (a crash, or a watchdog-initiated
+exit on a survivor) triggers SIGTERM -> grace -> SIGKILL of the whole gang, a
+per-rank failure report (exit code + the failing rank's workerlog tail), and
+an exponential-backoff restart with a FRESH master port and
+PADDLE_RESTART_COUNT bumped (the elastic generation number — training
+companions resume via distributed.checkpoint.load_latest). Each generation
+logs to workerlog.N.restartK so post-mortems never interleave generations.
 """
 from __future__ import annotations
 
@@ -25,31 +34,53 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def main():
-    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
-    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
-    parser.add_argument("--nnodes", type=int, default=1)
-    parser.add_argument("--node_rank", type=int, default=0)
-    parser.add_argument("--master", default=None)
-    parser.add_argument("--log_dir", default="log")
-    parser.add_argument("--max_restart", type=int, default=0)
-    parser.add_argument("--devices", "--gpus", default=None,
-                        help="accepted for reference-CLI parity; device "
-                             "placement is XLA-managed")
-    parser.add_argument("--job_id", default="default")
-    parser.add_argument("training_script")
-    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    args = parser.parse_args()
+def _log_path(log_dir: str, rank: int, attempt: int) -> str:
+    """Generation-rotated per-rank log: attempt 0 keeps the classic
+    workerlog.N name, restarts get workerlog.N.restartK."""
+    name = f"workerlog.{rank}" if attempt == 0 \
+        else f"workerlog.{rank}.restart{attempt}"
+    return os.path.join(log_dir, name)
 
+
+def _tail(path: str, n: int = 20) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 8192))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+        return "\n".join(lines[-n:])
+    except OSError:
+        return "<no log captured>"
+
+
+def _reap_gang(procs, grace_s: float):
+    """SIGTERM every still-running child, give them `grace_s` to unwind
+    (flush logs, close stores), then SIGKILL the stragglers. Returns the
+    final exit codes (None never: everyone is dead on return)."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.time() + grace_s
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.1)
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+    return [p.poll() for p in procs]
+
+
+def _spawn_gang(args, master, attempt):
     nprocs = args.nproc_per_node
     world = nprocs * args.nnodes
-    master = args.master or f"127.0.0.1:{_free_port()}"
-    os.makedirs(args.log_dir, exist_ok=True)
-
-    attempts = 0
-    while True:
-        procs = []
-        logs = []
+    procs, logs = [], []
+    try:
         for local_rank in range(nprocs):
             rank = args.node_rank * nprocs + local_rank
             env = dict(os.environ)
@@ -59,31 +90,119 @@ def main():
                 "PADDLE_LOCAL_RANK": str(local_rank),
                 "PADDLE_MASTER": master,
                 "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{_free_port()}",
+                "PADDLE_RESTART_COUNT": str(attempt),
                 "JAX_PROCESS_ID": str(rank),
                 "JAX_NUM_PROCESSES": str(world),
                 "JAX_COORDINATOR_ADDRESS": master,
             })
-            logf = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "a")
+            logf = open(_log_path(args.log_dir, rank, attempt), "a")
             logs.append(logf)
+            # every rank INCLUDING 0 logs to its workerlog: rank 0 hosts
+            # the store daemon and is the most failure-prone rank — the
+            # failure report must be able to tail its log too
             p = subprocess.Popen(
                 [sys.executable, args.training_script] +
                 args.training_script_args,
-                env=env, stdout=logf if rank != 0 else None,
-                stderr=subprocess.STDOUT if rank != 0 else None)
+                env=env, stdout=logf, stderr=subprocess.STDOUT)
+            p._pd_rank = rank
             procs.append(p)
-
-        codes = [p.wait() for p in procs]
+    except Exception:
+        # a mid-loop spawn failure (EMFILE, ENOMEM) must not strand the
+        # already-started ranks holding the rendezvous ports
+        _reap_gang(procs, getattr(args, "grace_period", 5.0))
         for f in logs:
             f.close()
-        if all(c == 0 for c in codes):
-            return 0
-        attempts += 1
-        if attempts > args.max_restart:
-            print(f"launch: ranks failed with codes {codes}", file=sys.stderr)
-            return max(codes)
-        print(f"launch: restarting (attempt {attempts}/{args.max_restart})",
-              file=sys.stderr)
-        time.sleep(1)
+        raise
+    return procs, logs
+
+
+def _failure_report(args, procs, attempt) -> str:
+    lines = [f"launch: gang failure report (attempt {attempt}):"]
+    for p in procs:
+        rc = p.poll()
+        rank = p._pd_rank
+        status = "ok" if rc == 0 else (
+            f"signal {-rc}" if rc is not None and rc < 0 else f"exit {rc}")
+        lines.append(f"launch:   rank {rank}: {status}")
+        if rc not in (0, None):
+            tail = _tail(_log_path(args.log_dir, rank, attempt))
+            lines.append(f"launch:   --- workerlog tail (rank {rank}) ---")
+            lines.extend(f"launch:   | {ln}" for ln in tail.splitlines())
+    return "\n".join(lines)
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master", default=None)
+    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--max_restart", type=int, default=0)
+    parser.add_argument(
+        "--restart_backoff", type=float,
+        default=float(os.environ.get("PADDLE_RESTART_BACKOFF_S", "1")),
+        help="base of the exponential restart backoff (seconds)")
+    parser.add_argument(
+        "--grace_period", type=float,
+        default=float(os.environ.get("PADDLE_LAUNCH_GRACE_S", "5")),
+        help="SIGTERM->SIGKILL grace when tearing down a failed gang")
+    parser.add_argument("--devices", "--gpus", default=None,
+                        help="accepted for reference-CLI parity; device "
+                             "placement is XLA-managed")
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    poll_s = float(os.environ.get("PADDLE_LAUNCH_POLL_S", "0.2"))
+    backoff_cap = float(os.environ.get("PADDLE_RESTART_BACKOFF_MAX_S", "30"))
+
+    attempt = 0
+    while True:
+        # fresh master port per generation (unless pinned by --master):
+        # the previous generation's coordinator/TCPStore sockets may
+        # linger in TIME_WAIT, and a stale store daemon must never serve
+        # the new generation's rendezvous
+        master = args.master or f"127.0.0.1:{_free_port()}"
+        procs, logs = _spawn_gang(args, master, attempt)
+        first_bad = None
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [p for p in procs
+                       if p.poll() not in (0, None)]
+                if bad:
+                    first_bad = bad[0]
+                    break
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(poll_s)
+            # gang failure: tear down the survivors, then report
+            _reap_gang(procs, args.grace_period)
+        except KeyboardInterrupt:
+            _reap_gang(procs, args.grace_period)
+            raise
+        finally:
+            for f in logs:
+                f.close()
+
+        print(_failure_report(args, procs, attempt), file=sys.stderr)
+        fail_rc = first_bad.poll()
+        fail_rc = fail_rc if fail_rc > 0 else 128 - fail_rc  # signal -> 128+N
+        attempt += 1
+        if attempt > args.max_restart:
+            print(f"launch: rank {first_bad._pd_rank} failed "
+                  f"(rc {fail_rc}); restart budget exhausted "
+                  f"({args.max_restart})", file=sys.stderr)
+            return fail_rc
+        delay = min(args.restart_backoff * (2 ** (attempt - 1)),
+                    backoff_cap)
+        print(f"launch: restarting (attempt {attempt}/{args.max_restart}) "
+              f"after {delay:.1f}s backoff, fresh master port, "
+              f"PADDLE_RESTART_COUNT={attempt}", file=sys.stderr)
+        time.sleep(delay)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,47 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
 _state = {"initialized": False, "rank": 0, "world_size": 1, "mesh": None}
 
 
+def _maybe_start_watchdog(rank: int, world: int):
+    """Start the heartbeat watchdog (resilience/watchdog.py) over the same
+    TCPStore daemon _store_barrier runs one port above the coordinator.
+    Multi-process only; PADDLE_WATCHDOG_TIMEOUT_S=0 disables; best-effort
+    when the native runtime is unavailable."""
+    if world <= 1:
+        return
+    if float(os.environ.get("PADDLE_WATCHDOG_TIMEOUT_S", "300")) <= 0:
+        return
+    coord = os.environ.get("PADDLE_MASTER",
+                           os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if not coord:
+        return
+    try:
+        from ..core.native import TCPStore, load_native
+        if load_native() is None:
+            return
+    except Exception:
+        return
+    host, port = coord.rsplit(":", 1)
+    store_port = int(port) + 1
+    connect_t = float(os.environ.get("PADDLE_STORE_CONNECT_TIMEOUT", "15"))
+
+    def factory(timeout_s=None):
+        return TCPStore(host, store_port,
+                        timeout_s=connect_t if timeout_s is None
+                        else timeout_s)
+
+    try:  # one SHORT probe connection: no store daemon -> no watchdog
+        # (full connect_t here would stall init when the rendezvous store
+        # was skipped, e.g. its port was taken)
+        TCPStore(host, store_port, timeout_s=min(connect_t, 2.0)).close()
+    except Exception:
+        import logging
+        logging.warning("paddle_tpu: heartbeat watchdog disabled (store "
+                        "%s:%d unreachable)", host, store_port)
+        return
+    from .resilience import start_watchdog
+    start_watchdog(factory, rank, world)
+
+
 def _maybe_jax_distributed_init():
     """Multi-host init from PADDLE_* or JAX_* env (TCPStore-equivalent)."""
     n = int(os.environ.get("PADDLE_TRAINERS_NUM",
@@ -141,6 +182,9 @@ def init_parallel_env():
     _state["rank"] = jax.process_index()
     _state["world_size"] = jax.process_count()
     _state["initialized"] = True
+    from ..testing import fault
+    fault.inject("init", rank=_state["rank"])
+    _maybe_start_watchdog(_state["rank"], _state["world_size"])
     from .communication.group import _ensure_default_group
     _ensure_default_group()
     return ParallelEnv()
@@ -215,6 +259,8 @@ def all_reduce_gradients(params, group=None):
     ws = get_world_size(group)
     if ws <= 1:
         return
+    from .resilience import check_peer_failure
+    check_peer_failure()   # fail fast instead of entering a doomed psum
     from .communication.all_reduce import all_reduce
     from ..tensor.tensor import no_grad
     with no_grad():

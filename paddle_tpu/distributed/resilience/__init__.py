@@ -1,0 +1,11 @@
+"""paddle.distributed resilience layer: heartbeat watchdog + monitored
+barrier (parity: ProcessGroupNCCL watchdog / FLAGS_pg_timeout semantics,
+realized over the native TCPStore)."""
+from .watchdog import (PeerFailureError, Watchdog, start_watchdog,
+                       stop_watchdog, check_peer_failure,
+                       monitored_barrier, notify_progress,
+                       current_watchdog, WATCHDOG_EXIT_CODE)
+
+__all__ = ["PeerFailureError", "Watchdog", "start_watchdog",
+           "stop_watchdog", "check_peer_failure", "monitored_barrier",
+           "notify_progress", "current_watchdog", "WATCHDOG_EXIT_CODE"]

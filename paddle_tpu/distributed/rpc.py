@@ -132,17 +132,29 @@ class _RpcAgent:
         sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
     @staticmethod
-    def _recv_msg(sock) -> bytes:
+    def _recv_msg(sock, deadline=None) -> bytes:
+        # the deadline bounds the WHOLE message, re-armed before every
+        # recv — a per-op timeout alone lets a peer dripping one byte per
+        # interval hold the caller far past the advertised call deadline
+        def _read(nbytes):
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("rpc recv: call deadline exceeded "
+                                       "mid-message")
+                sock.settimeout(left)
+            return sock.recv(nbytes)
+
         hdr = b""
         while len(hdr) < 8:
-            chunk = sock.recv(8 - len(hdr))
+            chunk = _read(8 - len(hdr))
             if not chunk:
                 raise ConnectionError("rpc peer closed")
             hdr += chunk
         (n,) = struct.unpack("<Q", hdr)
         buf = bytearray()
         while len(buf) < n:
-            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            chunk = _read(min(1 << 20, n - len(buf)))
             if not chunk:
                 raise ConnectionError("rpc peer closed mid-message")
             buf += chunk
@@ -186,13 +198,46 @@ class _RpcAgent:
             conn.close()
 
     def call(self, to: str, fn, args, kwargs, timeout):
+        """One bounded RPC round-trip. The connect is RETRIED with
+        exponential backoff inside the call deadline (a peer mid-restart
+        refuses for a moment — that's recoverable); once connected, every
+        socket op inherits the remaining deadline, so a half-open peer
+        turns into TimeoutError instead of an unbounded wait."""
         info = self.workers[to]
-        with socket.create_connection((info.ip, info.port),
-                                      timeout=timeout) as sock:
-            sock.settimeout(timeout)
+        deadline = time.monotonic() + timeout
+        # deadline-bounded by default: a refused connect is instantaneous,
+        # and a peer mid-restart stays refused for the supervisor's whole
+        # backoff window — counting attempts would burn <1s of a 30s
+        # budget. PADDLE_RPC_CONNECT_RETRIES>0 adds an attempt cap on top.
+        retries = int(os.environ.get("PADDLE_RPC_CONNECT_RETRIES", "0"))
+        backoff = float(os.environ.get("PADDLE_RPC_CONNECT_BACKOFF_S",
+                                       "0.1"))
+        sock, last, attempt = None, None, 0
+        while sock is None:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"rpc to {to!r} ({info.ip}:{info.port}): connect "
+                    f"deadline exceeded ({timeout}s, {attempt} attempts; "
+                    f"last error: {last!r})")
+            try:
+                sock = socket.create_connection((info.ip, info.port),
+                                                timeout=left)
+            except OSError as e:
+                last = e
+                attempt += 1
+                if retries > 0 and attempt >= retries:
+                    raise ConnectionError(
+                        f"rpc to {to!r} ({info.ip}:{info.port}): connect "
+                        f"failed after {attempt} attempts: {last!r}")
+                time.sleep(min(backoff * (2 ** (attempt - 1)), 5.0,
+                               max(0.0, deadline - time.monotonic())))
+        with sock:
+            sock.settimeout(max(0.001, deadline - time.monotonic()))
             self._send_msg(sock, self._token + pickle.dumps(
                 (fn, args or (), kwargs or {})))
-            ok, value = pickle.loads(self._recv_msg(sock))
+            sock.settimeout(max(0.001, deadline - time.monotonic()))
+            ok, value = pickle.loads(self._recv_msg(sock, deadline))
         if not ok:
             raise value
         return value
@@ -242,14 +287,25 @@ def _require_agent() -> _RpcAgent:
     return _agent[0]
 
 
-def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=30.0):
+def _resolve_timeout(timeout):
+    """None -> the env-configurable default (PADDLE_RPC_TIMEOUT_S, 30 s).
+    There is deliberately NO infinite mode: a half-open peer must become
+    a timely TimeoutError, never a forever-hung caller."""
+    if timeout is None:
+        return float(os.environ.get("PADDLE_RPC_TIMEOUT_S", "30"))
+    return float(timeout)
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
     """Run fn(*args, **kwargs) on worker `to`; block for the result."""
-    return _require_agent().call(to, fn, args, kwargs, timeout)
+    return _require_agent().call(to, fn, args, kwargs,
+                                 _resolve_timeout(timeout))
 
 
-def rpc_async(to: str, fn, args=None, kwargs=None, timeout=30.0):
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None):
     """Like rpc_sync but returns a future with .wait()."""
     agent = _require_agent()
+    timeout = _resolve_timeout(timeout)
     fut = _FutureResult()
 
     def run():

@@ -24,13 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
-           "PrecisionType", "ServingEngine", "ServedRequest"]
+           "PrecisionType", "ServingEngine", "ServedRequest",
+           "AdmissionFull"]
 
 
 def __getattr__(name):
     # lazy: the serving engine drags the nn layer stack in via
     # generation.py; importing paddle_tpu.inference must stay light
-    if name in ("ServingEngine", "ServedRequest"):
+    if name in ("ServingEngine", "ServedRequest", "AdmissionFull"):
         from . import serving
         return getattr(serving, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -43,20 +43,28 @@ from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 from .generation import FusedDecoder, _absmax_int8, _sample_next
 
-__all__ = ["ServingEngine", "ServedRequest"]
+__all__ = ["ServingEngine", "ServedRequest", "AdmissionFull"]
+
+
+class AdmissionFull(RuntimeError):
+    """submit() rejected: the pending queue is at max_pending (overload
+    shedding — the caller backs off or routes elsewhere; the engine never
+    buffers unboundedly)."""
 
 
 class ServedRequest:
     """One request's lifecycle record. States: queued -> running ->
-    finished. Times come from the engine clock (injectable for virtual-
-    time benchmarking); `ttft_s`/`latency_s` are measured from submit."""
+    finished | expired. Times come from the engine clock (injectable for
+    virtual-time benchmarking); `ttft_s`/`latency_s` are measured from
+    submit."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "min_length", "repetition_penalty", "state", "slot",
-                 "tokens", "t_submit", "t_first", "t_done")
+                 "tokens", "t_submit", "t_first", "t_done", "deadline_s")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
-                 min_length, repetition_penalty, t_submit):
+                 min_length, repetition_penalty, t_submit,
+                 deadline_s=None):
         self.rid = rid
         self.prompt = prompt                      # np.int32 [S]
         self.max_new_tokens = int(max_new_tokens)
@@ -69,6 +77,7 @@ class ServedRequest:
         self.t_submit = t_submit
         self.t_first = None                       # first token time
         self.t_done = None
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
 
     @property
     def ttft_s(self):
@@ -83,7 +92,8 @@ class ServedRequest:
     def result(self):
         return {"rid": self.rid, "tokens": np.asarray(self.tokens,
                                                       np.int32),
-                "ttft_s": self.ttft_s, "latency_s": self.latency_s}
+                "ttft_s": self.ttft_s, "latency_s": self.latency_s,
+                "expired": self.state == "expired"}
 
 
 class ServingEngine:
@@ -110,7 +120,8 @@ class ServingEngine:
     def __init__(self, fmt, embed, head, num_slots, max_seq_len,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                  decode_chunk=None, use_rotary=False,
-                 enable_repetition_penalty=False, clock=None):
+                 enable_repetition_penalty=False, clock=None,
+                 max_pending=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -154,10 +165,16 @@ class ServingEngine:
         self._tokens_emitted = 0
         self._busy_s = 0.0
         self._admitted = 0
+        # overload shedding: 0 = unbounded (legacy behavior)
+        self.max_pending = int(max_pending if max_pending is not None
+                               else os.environ.get(
+                                   "PADDLE_TPU_SERVE_MAX_PENDING", "0"))
+        self._rejected = 0
+        self._expired = 0
 
     # ------------------------------------------------------------- public
     def submit(self, prompt, max_new_tokens=20, eos_token_id=None,
-               min_length=0, repetition_penalty=1.0):
+               min_length=0, repetition_penalty=1.0, deadline_s=None):
         """Queue one request; returns its id. The slot-eviction invariant
         is enforced HERE: a request may never be able to push its slot's
         cache_lens to Smax (the write kernels' documented invariant).
@@ -187,9 +204,14 @@ class ServingEngine:
                 "repetition_penalty needs enable_repetition_penalty=True "
                 "at engine construction (the presence-mask carry is "
                 "static trace structure)")
+        if self.max_pending and len(self._queue) >= self.max_pending:
+            self._rejected += 1
+            raise AdmissionFull(
+                f"pending queue full ({len(self._queue)}/"
+                f"{self.max_pending}) — request shed at admission")
         req = ServedRequest(next(self._rid), ids, max_new_tokens,
                             eos_token_id, min_length, repetition_penalty,
-                            self.clock())
+                            self.clock(), deadline_s=deadline_s)
         self._queue.append(req)
         return req.rid
 
@@ -212,6 +234,7 @@ class ServingEngine:
         compiled decode chunk and harvest it. Emits one chunk_log record.
         Returns the number of tokens emitted this step."""
         t0 = self.clock()
+        self._expire_deadlines(t0)
         admitted = self._admit()
         emitted = len(admitted)
         if self._active.any():
@@ -241,11 +264,16 @@ class ServingEngine:
         self._tokens_emitted = 0
         self._busy_s = 0.0
         self._admitted = 0
+        self._rejected = 0
+        self._expired = 0
         if not keep_results:
             self.results = {}
 
     def metrics(self):
-        done = [r for r in self.results.values()]
+        # expired requests are SHED, not finished — keeping them out of
+        # the percentiles (their "latency" is an eviction time) and out
+        # of requests_finished (else finished + expired double-counts)
+        done = [r for r in self.results.values() if not r.get("expired")]
         ttfts = [d["ttft_s"] for d in done if d["ttft_s"] is not None]
         lats = [d["latency_s"] for d in done if d["latency_s"] is not None]
 
@@ -259,6 +287,8 @@ class ServingEngine:
             if self._busy_s else None,
             "requests_finished": len(done),
             "requests_admitted": self._admitted,
+            "requests_rejected": self._rejected,
+            "requests_expired": self._expired,
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
             "traces": self._trace_count,
@@ -586,11 +616,31 @@ class ServingEngine:
         self._active = still_active
         return n_emitted
 
-    def _finish(self, req, now):
-        req.state = "finished"
+    def _expire_deadlines(self, now):
+        """Evict every request past its deadline_s — queued requests are
+        shed before they ever cost a prefill; RUNNING ones release their
+        slot through the normal eviction machinery (_finish resets the
+        slot bookkeeping; the cache row needs no zeroing)."""
+        for req in [r for r in self._queue
+                    if r.deadline_s is not None
+                    and now - r.t_submit > r.deadline_s]:
+            self._queue.remove(req)
+            self._finish(req, now, expired=True)
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if (req is not None and req.deadline_s is not None
+                    and now - req.t_submit > req.deadline_s):
+                self._finish(req, now, expired=True)
+
+    def _finish(self, req, now, expired=False):
+        req.state = "expired" if expired else "finished"
         req.t_done = now
+        if expired:
+            self._expired += 1
         self.results[req.rid] = req.result()
         s = req.slot
+        if s is None:                # shed from the queue, never admitted
+            return
         self._slot_req[s] = None
         self._active[s] = False
         # slot eviction IS this bookkeeping: the cache row is left as-is

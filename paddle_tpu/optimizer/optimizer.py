@@ -114,6 +114,17 @@ class Optimizer:
 
     # ----------------------------------------------------------------- step
     def step(self):
+        # robustness hooks on the train-step path: surface a watchdog-
+        # detected peer failure as PeerFailureError at the step boundary
+        # (instead of entering a doomed collective), and give the fault-
+        # injection harness its per-step trigger point. Both are ~free
+        # when the watchdog is off / the harness is disarmed.
+        from ..distributed.resilience import (check_peer_failure,
+                                              notify_progress)
+        from ..testing import fault
+        check_peer_failure()
+        notify_progress()
+        fault.inject("step")
         with no_grad():
             # plain Tensors (stop_gradient=False) are optimizable too —
             # the reference accepts any trainable tensor, not just

@@ -1,0 +1,29 @@
+"""paddle_tpu.testing — test-only instrumentation shipped with the package.
+
+The single registry of fault-injection env vars lives HERE so the harness
+(`fault.py`), the conftest leak guard, and the docs all read one list —
+adding a knob in fault.py without registering it is a test failure, not a
+silent drift.
+"""
+from __future__ import annotations
+
+import os
+
+# Every env var the fault-injection harness reads. Keep sorted; the
+# conftest guard fails any non-FT test that runs with one of these set.
+FI_ENV_VARS = (
+    "PADDLE_FI_AT_STEP",        # step index gating KILL/HANG ("step" point)
+    "PADDLE_FI_DROP_HEARTBEAT",  # rank whose heartbeat publisher goes dark
+    "PADDLE_FI_HANG",           # rank that hangs (bounded sleep) at the point
+    "PADDLE_FI_KILL_RANK",      # rank that hard-exits (os._exit) at the point
+)
+
+
+def fi_env_active() -> list:
+    """The PADDLE_FI_* vars currently set (empty list = harness disarmed)."""
+    return [v for v in FI_ENV_VARS if os.environ.get(v) not in (None, "")]
+
+
+from . import fault  # noqa: E402  (re-export the harness)
+
+__all__ = ["FI_ENV_VARS", "fi_env_active", "fault"]

@@ -1,0 +1,93 @@
+"""Env-driven fault-injection harness (SURVEY §5.3 failure paths).
+
+Hook points are compiled into the real code paths (parallel init, the
+optimizer train step, the watchdog's heartbeat publisher) and stay ~free
+when disarmed: `inject()` is a no-op unless a PADDLE_FI_* var is set.
+
+Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
+
+  PADDLE_FI_KILL_RANK=<r>       rank r hard-exits (os._exit(FI_EXIT_CODE))
+  PADDLE_FI_HANG=<r>            rank r hangs (bounded sleep, supervisor's
+                                problem) instead of exiting
+  PADDLE_FI_AT_STEP=<n>         gate KILL/HANG to train-step n ("step"
+                                hook); unset -> they fire at "init"
+  PADDLE_FI_DROP_HEARTBEAT=<r>  rank r's heartbeat publisher goes dark
+                                (the process stays alive: the watchdog on
+                                the PEERS must convert this into a
+                                PeerFailureError)
+
+Injections fire at most once per process (a restarted generation whose
+env cleared the vars is unaffected; one that kept them re-injects —
+companions gate on PADDLE_RESTART_COUNT to fault only generation 0).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from . import FI_ENV_VARS
+
+__all__ = ["inject", "heartbeat_dropped", "step_count", "reset",
+           "FI_EXIT_CODE", "HANG_BOUND_S"]
+
+FI_EXIT_CODE = 43          # distinctive: never collides with signal codes
+HANG_BOUND_S = 3600.0      # a "hang" is a bounded sleep, not a true wedge
+
+_steps = 0                 # "step"-point calls observed in this process
+_fired = False
+
+
+def reset():
+    """Re-arm the harness (in-process tests; subprocesses never need it)."""
+    global _steps, _fired
+    _steps, _fired = 0, False
+
+
+def step_count() -> int:
+    return _steps
+
+
+def _rank() -> str:
+    return os.environ.get("PADDLE_TRAINER_ID", "0")
+
+
+def _armed() -> bool:
+    return any(os.environ.get(v) not in (None, "") for v in FI_ENV_VARS)
+
+
+def heartbeat_dropped(rank=None) -> bool:
+    """Consulted by the watchdog's publisher before every beat."""
+    r = str(rank) if rank is not None else _rank()
+    return os.environ.get("PADDLE_FI_DROP_HEARTBEAT") == r
+
+
+def inject(point: str, rank=None):
+    """Run the injections registered for `point` ("init" | "step").
+
+    The "step" point also advances the harness step counter, so
+    PADDLE_FI_AT_STEP indexes optimizer steps 0, 1, 2, ... regardless of
+    where the caller is in its own loop.
+    """
+    global _steps, _fired
+    if not _armed():
+        return
+    if point == "step":
+        at = os.environ.get("PADDLE_FI_AT_STEP")
+        hit = at is not None and _steps == int(at)
+        _steps += 1
+    else:
+        hit = os.environ.get("PADDLE_FI_AT_STEP") is None
+    if not hit or _fired:
+        return
+    r = str(rank) if rank is not None else _rank()
+    if os.environ.get("PADDLE_FI_HANG") == r:
+        _fired = True
+        print(f"paddle_tpu.testing.fault: rank {r} HANGING at {point} "
+              f"(step {_steps - 1 if point == 'step' else '-'})", flush=True)
+        time.sleep(HANG_BOUND_S)
+        os._exit(FI_EXIT_CODE)   # the bound expired without a supervisor
+    if os.environ.get("PADDLE_FI_KILL_RANK") == r:
+        _fired = True
+        print(f"paddle_tpu.testing.fault: rank {r} KILLED at {point} "
+              f"(step {_steps - 1 if point == 'step' else '-'})", flush=True)
+        os._exit(FI_EXIT_CODE)

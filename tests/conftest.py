@@ -26,6 +26,23 @@ import pytest
 
 
 @pytest.fixture(autouse=True)
+def _no_fault_injection_leak(request):
+    """Fail FAST if a fault-injection env var leaks into a non-FT test:
+    an armed harness silently changes behavior (or kills the worker) far
+    from the test that set it. FT tests pass the PADDLE_FI_* vars to
+    their SUBPROCESS env only; the pytest process itself must stay clean
+    everywhere except tests/test_fault_tolerance.py."""
+    from paddle_tpu.testing import fi_env_active
+    leaked = fi_env_active()
+    if leaked and "test_fault_tolerance" not in str(request.node.fspath):
+        pytest.fail(
+            f"fault-injection env leaked into a non-FT test: {leaked} "
+            "(unset PADDLE_FI_*, or pass it to the companion subprocess "
+            "env instead of the pytest process)", pytrace=False)
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as paddle
     paddle.seed(102)

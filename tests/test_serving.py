@@ -231,6 +231,97 @@ class TestFullCacheGuard:
         assert int(eng._lens[0]) == 127      # peaked at Smax - 1
 
 
+class TestOverloadShedding:
+    """Robustness satellites (ISSUE 3): bounded admission queue + per-
+    request deadlines over the existing eviction machinery."""
+
+    def test_max_pending_rejects_cleanly_then_drains(self):
+        from paddle_tpu.inference.serving import AdmissionFull
+        fmt, embed, head = _model(seed=21)
+        rng = np.random.RandomState(0)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2,
+                            max_pending=3)
+        for _ in range(3):
+            eng.submit(_prompt(rng, 4), max_new_tokens=3)
+        with pytest.raises(AdmissionFull):
+            eng.submit(_prompt(rng, 4), max_new_tokens=3)
+        assert eng.metrics()["requests_rejected"] == 1
+        eng.run()                        # shed != broken: queue drains
+        assert eng.metrics()["requests_finished"] == 3
+        # capacity freed -> admission works again
+        rid = eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        eng.run()
+        assert eng.results[rid]["tokens"].size == 2
+
+    def test_deadline_evicts_queued_and_running(self):
+        fmt, embed, head = _model(seed=22)
+        rng = np.random.RandomState(1)
+        clk = [0.0]
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128, decode_chunk=2,
+                            clock=lambda: clk[0])
+        rid_run = eng.submit(_prompt(rng, 4), max_new_tokens=60,
+                             deadline_s=5.0)
+        rid_q = eng.submit(_prompt(rng, 4), max_new_tokens=4,
+                           deadline_s=1.0)
+        eng.step()                       # admits rid_run; rid_q queued
+        assert eng.results == {}
+        clk[0] = 2.0
+        eng.step()                       # rid_q shed from the queue
+        assert eng.results[rid_q]["expired"] is True
+        assert eng.results[rid_q]["tokens"].size == 0
+        clk[0] = 6.0
+        eng.step()                       # rid_run evicted mid-decode
+        assert eng.results[rid_run]["expired"] is True
+        assert not eng._active.any()
+        assert eng.metrics()["requests_expired"] == 2
+        # the evicted slot is reusable: a fresh request completes
+        rid3 = eng.submit(_prompt(rng, 5), max_new_tokens=3)
+        eng.run()
+        assert eng.results[rid3]["expired"] is False
+        assert eng.results[rid3]["tokens"].size == 3
+        # expired requests are shed, not finished: they stay out of the
+        # finished count and the latency percentiles
+        m = eng.metrics()
+        assert m["requests_finished"] == 1
+        assert m["requests_expired"] == 2
+
+    def test_reset_metrics_zeroes_shed_counters(self):
+        """reset_metrics() must zero rejected/expired alongside admitted,
+        or a post-warmup shed-rate computed from one metrics() snapshot
+        mixes windows."""
+        from paddle_tpu.inference.serving import AdmissionFull
+        fmt, embed, head = _model(seed=24)
+        rng = np.random.RandomState(3)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2,
+                            max_pending=1)
+        eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        with pytest.raises(AdmissionFull):
+            eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        eng.run()
+        assert eng.metrics()["requests_rejected"] == 1
+        eng.reset_metrics()
+        m = eng.metrics()
+        assert m["requests_admitted"] == 0
+        assert m["requests_rejected"] == 0
+        assert m["requests_expired"] == 0
+
+    def test_no_deadline_is_unbounded(self):
+        fmt, embed, head = _model(seed=23)
+        rng = np.random.RandomState(2)
+        clk = [0.0]
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128, decode_chunk=2,
+                            clock=lambda: clk[0])
+        rid = eng.submit(_prompt(rng, 4), max_new_tokens=4)
+        clk[0] = 1e6                     # ancient request, no deadline
+        eng.run()
+        assert eng.results[rid]["expired"] is False
+        assert eng.results[rid]["tokens"].size == 4
+
+
 @pytest.mark.slow
 class TestServingBench:
     def test_bench_serving_poisson_sweep(self, monkeypatch, capsys):
