@@ -21,6 +21,17 @@ Env knobs: BENCH_SLOTS, BENCH_SERVE_REQUESTS, BENCH_SERVE_WARMUP,
 BENCH_SERVE_CHUNK, BENCH_SERVE_SEED, BENCH_SERVE_LOAD (offered load vs
 measured capacity, default 1.5 — backlog forms, continuous batching's
 favorable regime and the honest serving scenario).
+
+--shared-prompts runs the PREFIX-CACHE workload instead: N prompt
+templates (shared system prompts) x Poisson arrivals, the same engine
+with the prefix cache ON vs OFF at equal compiled shape — reporting
+prefix hit-rate, prefill tokens computed vs admitted, TTFT p50/p99,
+tokens/s speedup, and the zero-retrace contract. Its knobs:
+BENCH_PREFIX_TEMPLATES (4), BENCH_PREFIX_TLEN (template tokens),
+BENCH_PREFIX_CAP (prefill_cap == prefix block size),
+BENCH_PREFIX_BLOCKS (pool budget). Both modes merge into ONE
+BENCH_serving.json (the shared-prompt record lands under
+"shared_prompts"; each mode preserves the other's record).
 """
 from __future__ import annotations
 
@@ -111,28 +122,40 @@ def _collect(eng, sub, arrivals):
     return ttft, lat, toks
 
 
-def main():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from bench import _init_devices
-    jax, dev, tpu_unavailable = _init_devices()
-    on_tpu = dev.platform in ("tpu", "axon")
-    import numpy as np
+def _write_merged(path, record, shared_rec=None):
+    """ONE BENCH_serving.json for both modes: the classic record is the
+    top level, the shared-prompt record rides under "shared_prompts";
+    whichever mode runs preserves the other mode's half."""
+    old = {}
+    try:
+        with open(path) as f:
+            old = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if record is None:                   # shared mode: keep classic half
+        record = old if isinstance(old, dict) else {}
+    elif isinstance(old, dict) and "shared_prompts" in old and \
+            shared_rec is None:
+        shared_rec = old["shared_prompts"]
+    if shared_rec is not None:
+        record = dict(record, shared_prompts=shared_rec)
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"bench_serving: could not write {path}: {e}",
+              file=sys.stderr)
+    return record
 
+
+def _build_model(on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu.incubate.nn import FusedMultiTransformer
-    from paddle_tpu.inference.serving import ServingEngine
     from paddle_tpu.nn.layer.common import Embedding, Linear
 
     E, H, FF, L, V = ((768, 12, 3072, 12, 50304) if on_tpu
                       else (64, 4, 128, 2, 256))
-    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
-    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "128"))
-    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
-    n_warm = int(os.environ.get("BENCH_SERVE_WARMUP", str(2 * slots)))
-    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
-    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
-    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
-
     paddle.seed(0)
     embed = Embedding(V, E)
     fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
@@ -142,6 +165,30 @@ def main():
         for lay in (embed, fmt, head):
             lay.bfloat16()
     fmt.eval()
+    return fmt, embed, head, (E, H, FF, L, V)
+
+
+def main(argv=None):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--shared-prompts" in argv:
+        return main_shared_prompts()
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "128"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_warm = int(os.environ.get("BENCH_SERVE_WARMUP", str(2 * slots)))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
 
     rng = np.random.RandomState(seed)
     # bucket_reqs cover every prefill bucket a 4..32-token prompt can
@@ -239,13 +286,10 @@ def main():
         record["tpu_unavailable"] = True
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_serving.json")
-    try:
-        with open(path, "w") as f:
-            json.dump(record, f, indent=1)
-            f.write("\n")
-    except OSError as e:
-        print(f"bench_serving: could not write {path}: {e}",
-              file=sys.stderr)
+    # merge only for the FILE (preserving the shared_prompts half): the
+    # TPU window entry and stdout stay the pure classic record — a
+    # stale shared-prompt sub-record must not ride along
+    _write_merged(path, record)
     if on_tpu and not tpu_unavailable:
         from bench import _append_tpu_window
         _append_tpu_window(record)
@@ -253,6 +297,188 @@ def main():
     if record["retraces_after_warmup"]:
         print("bench_serving: RETRACES AFTER WARMUP — the fixed-shape "
               "contract is broken", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _make_shared_workload(rng, n, v, smax, templates, sfx_lo, sfx_hi,
+                          new_choices):
+    """Shared-system-prompt traffic: each request is one of N templates
+    (the shared prefix — system prompt / few-shot header) plus a short
+    unique user suffix; generation lengths are short-to-medium (the
+    TTFT-sensitive interactive regime where redundant prefill dominates)."""
+    import numpy as np
+    reqs = []
+    for _ in range(n):
+        t = templates[int(rng.randint(len(templates)))]
+        sfx = rng.randint(1, v, (int(rng.randint(sfx_lo, sfx_hi + 1)),)
+                          ).astype("int32")
+        prompt = np.concatenate([t, sfx])
+        max_new = int(rng.choice(new_choices))
+        reqs.append((prompt, min(max_new, smax - prompt.size)))
+    return reqs
+
+
+def main_shared_prompts():
+    """Prefix-cache A/B: the same engine class, same compiled shapes,
+    same fixed-seed Poisson shared-prompt workload and the same arrival
+    times — with the prefix cache ON vs OFF. The arrival rate comes from
+    the cache-OFF engine's measured capacity, so the ON side's win shows
+    up as BOTH higher delivered tokens/s and lower TTFT (it drains the
+    same backlog faster)."""
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    # a longer ring than the classic mode: the shared-prompt regime is
+    # exactly the long-system-prompt + short-answer traffic shape
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    n_templates = int(os.environ.get("BENCH_PREFIX_TEMPLATES", "4"))
+    tlen = int(os.environ.get("BENCH_PREFIX_TLEN",
+                              "512" if on_tpu else "192"))
+    cap_ = int(os.environ.get("BENCH_PREFIX_CAP",
+                              "64" if on_tpu else "16"))
+    pool_blocks = int(os.environ.get("BENCH_PREFIX_BLOCKS",
+                                     str(4 * n_templates * (tlen // cap_))))
+    new_choices = [8, 12, 16]
+    sfx_lo, sfx_hi = 3, min(8, smax - tlen - max(new_choices))
+    if sfx_hi < sfx_lo:
+        print(f"bench_serving: BENCH_PREFIX_TLEN={tlen} leaves no room "
+              f"in BENCH_SMAX={smax} for a suffix + {max(new_choices)} "
+              f"generated tokens (need tlen <= smax - "
+              f"{sfx_lo + max(new_choices)})", file=sys.stderr)
+        return 2
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
+
+    rng = np.random.RandomState(seed)
+    templates = [rng.randint(1, V, (tlen,)).astype("int32")
+                 for _ in range(n_templates)]
+    # warmup covers every executable either side will need: one request
+    # PER TEMPLATE admitted solo (miss path: bulk bucket + commits),
+    # then a re-run of the same prompts (hit path: adopt ladder + every
+    # suffix-scan chunk bucket), plus suffix-length extremes
+    warm_reqs = _make_shared_workload(
+        rng, max(2 * slots, 2 * n_templates), V, smax, templates,
+        sfx_lo, sfx_hi, new_choices)
+    meas_reqs = _make_shared_workload(rng, n_meas, V, smax, templates,
+                                      sfx_lo, sfx_hi, new_choices)
+
+    def run_mode(cache_on, arrivals=None):
+        clock = VirtualClock()
+        eng = ServingEngine(
+            fmt, embed, head, num_slots=slots, max_seq_len=smax,
+            decode_chunk=chunk, clock=clock.now, prefill_cap=cap_,
+            prefix_cache_blocks=pool_blocks if cache_on else 0)
+        # solo admissions compile every bucket BOTH paths need: the
+        # first request per template is a MISS (bulk bucket + commit),
+        # the repeats are HITS at the suffix-length extremes (adopt
+        # ladder + each suffix-scan chunk bucket — a miss never touches
+        # the scan, so the hit path must be warmed explicitly)
+        for t in templates:
+            for sfx in (sfx_lo, sfx_lo, sfx_hi):
+                p = np.concatenate([t, np.arange(1, sfx + 1,
+                                                 dtype=np.int32)])
+                eng.submit(p, max_new_tokens=4)
+                eng.run()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        eng.reset_metrics(keep_results=False)
+        t0 = clock.now()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        warm = eng.metrics()
+        cap_tps = warm["tokens_emitted"] / max(clock.now() - t0, 1e-9)
+        traces_warm = warm["traces"]
+        eng.reset_metrics(keep_results=False)
+
+        if arrivals is None:
+            mean_new = float(np.mean([m for _, m in meas_reqs]))
+            rate = load * cap_tps / mean_new
+            arr_rng = np.random.RandomState(seed + 1)
+            arrivals = np.cumsum(
+                arr_rng.exponential(1.0 / rate, size=len(meas_reqs)))
+        arr = arrivals + clock.now()
+        t_start = clock.now()
+        sub = _drive_continuous(eng, clock, meas_reqs, arr)
+        elapsed = clock.now() - t_start
+        ttft, lat, toks = _collect(eng, sub, arr)
+        m = eng.metrics()
+        return {
+            "cache": "on" if cache_on else "off",
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "capacity_tokens_per_sec": round(cap_tps, 2),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+            "prefix_hits": m["prefix_hits"],
+            "prefix_misses": m["prefix_misses"],
+            "prefix_hit_rate": m["prefix_hit_rate"],
+            "prefill_tokens_saved": m["prefill_tokens_saved"],
+            "prefill_tokens_computed": m["prefill_tokens_computed"],
+            "ttft_p50_ms": round(1e3 * float(np.percentile(ttft, 50)), 1),
+            "ttft_p99_ms": round(1e3 * float(np.percentile(ttft, 99)), 1),
+            "latency_p50_ms": round(1e3 * float(np.percentile(lat, 50)),
+                                    1),
+            "latency_p99_ms": round(1e3 * float(np.percentile(lat, 99)),
+                                    1),
+        }, arrivals
+
+    off, arrivals = run_mode(False)
+    on, _ = run_mode(True, arrivals)
+
+    record = {
+        "metric": "serving_prefix_cache_speedup",
+        "value": round(on["tokens_per_sec"]
+                       / max(off["tokens_per_sec"], 1e-9), 3),
+        "unit": "x tokens/s vs prefix-cache-off",
+        "tokens_per_sec_on": on["tokens_per_sec"],
+        "tokens_per_sec_off": off["tokens_per_sec"],
+        "ttft_p50_ms_on": on["ttft_p50_ms"],
+        "ttft_p50_ms_off": off["ttft_p50_ms"],
+        "ttft_p99_ms_on": on["ttft_p99_ms"],
+        "ttft_p99_ms_off": off["ttft_p99_ms"],
+        "latency_p50_ms_on": on["latency_p50_ms"],
+        "latency_p50_ms_off": off["latency_p50_ms"],
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefix_hits": on["prefix_hits"],
+        "prefix_misses": on["prefix_misses"],
+        "prefill_tokens_saved": on["prefill_tokens_saved"],
+        "prefill_tokens_computed": on["prefill_tokens_computed"],
+        "retraces_after_warmup": on["retraces_after_warmup"],
+        "retraces_after_warmup_off": off["retraces_after_warmup"],
+        "num_slots": slots, "max_seq": smax, "decode_chunk": chunk,
+        "prefill_cap": cap_, "prefix_cache_blocks": pool_blocks,
+        "templates": n_templates, "template_tokens": tlen,
+        "layers": L, "hidden": E, "vocab": V,
+        "requests": n_meas, "offered_load": load, "seed": seed,
+        "device": str(dev),
+        "cache_mode": ("int8" if os.environ.get(
+            "PADDLE_TPU_DECODE_INT8_CACHE") == "1" else "fp"),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, shared_rec=record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    if record["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP with the prefix "
+              "cache on — the fixed-shape contract is broken",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
            "PrecisionType", "ServingEngine", "ServedRequest",
-           "AdmissionFull"]
+           "AdmissionFull", "PrefixCache", "PrefixStore"]
 
 
 def __getattr__(name):
@@ -34,6 +34,9 @@ def __getattr__(name):
     if name in ("ServingEngine", "ServedRequest", "AdmissionFull"):
         from . import serving
         return getattr(serving, name)
+    if name in ("PrefixCache", "PrefixStore"):
+        from . import prefix_cache
+        return getattr(prefix_cache, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1234,7 +1234,7 @@ class FusedDecoder:
     def generate(self, input_ids, max_new_tokens=20, eos_token_id=None,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                  num_beams=1, length_penalty=1.0, min_length=0,
-                 repetition_penalty=1.0):
+                 repetition_penalty=1.0, prefix_cache=None):
         """Prefill the prompt via compiled chunked scans of the hidden
         core (LM head applied once at the end), then run the compiled
         chunked decode. Every device dispatch is a jitted scan — the
@@ -1242,7 +1242,18 @@ class FusedDecoder:
         eagerly here. num_beams > 1 runs beam search AGAINST the decode
         cache (see the beam builders above). min_length /
         repetition_penalty apply INSIDE the compiled steps via a [B, V]
-        context-presence carry."""
+        context-presence carry.
+
+        prefix_cache: a ``paddle_tpu.inference.PrefixCache`` (the SAME
+        object a ServingEngine may use). The longest published prefix of
+        each row is block-copied into the fresh cache instead of being
+        recomputed, prefill starts at the adopted offset, and the
+        prompt's full blocks are committed back after prefill — repeated
+        eval prompts skip their shared-prefix FLOPs across generate()
+        calls too. Prefill starts at the MIN adopted length across rows
+        (the chunked scan walks one scalar position for the whole
+        batch); ignored under an active mesh (the pool carries no
+        sharding annotations)."""
         if num_beams > 1 and do_sample:
             raise ValueError("beam search (num_beams>1) is deterministic; "
                              "do_sample=True is not supported with it")
@@ -1284,8 +1295,21 @@ class FusedDecoder:
         sk_flag = (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
                    + "/kw" + os.environ.get(
                        "PADDLE_TPU_KERNEL_CACHE_WRITE", "0"))
+        pc = prefix_cache if mesh_now is None else None
+        adopt_len, chains = 0, None
+        ids_pc = (np.asarray(ids).astype(np.int32)
+                  if pc is not None else None)
+        if pc is not None and prompt > 1:
+            ms = [pc.lookup(ids_pc[r]) for r in range(b)]
+            # one scalar prefill position serves the whole batch, so the
+            # adoptable length is the min across rows (b == 1 — the
+            # repeated-eval-prompt case — loses nothing)
+            n = min(len(mt) for mt in ms)
+            if n:
+                chains = [mt[:n] for mt in ms]
+                adopt_len = n * pc.block_tokens
         if (os.environ.get("PADDLE_TPU_BULK_PREFILL", "0") == "1"
-                and mesh_now is None and prompt > 1):
+                and mesh_now is None and prompt > 1 and not adopt_len):
             # whole-prompt prefill: causal flash over [B, S], cache built
             # by padding the K/V scan output (see _build_bulk_prefill).
             # One executable per exact prompt length.
@@ -1302,6 +1326,18 @@ class FusedDecoder:
         else:
             caches = self.init_cache(b)
             pos, last_x = 0, None
+            if chains is not None:
+                # splat the published prefix blocks into each row, then
+                # start the chunked prefill at the adopted offset —
+                # lookup() guarantees adopt_len <= prompt - 1, so the
+                # loop below always runs and last_x is always produced
+                for r, chain in enumerate(chains):
+                    pc.store.acquire(chain)
+                    try:
+                        caches = pc.adopt(caches, r, chain)
+                    finally:
+                        pc.store.release(chain)
+                pos = adopt_len
         while pos < prompt:
             chunk = 64
             while chunk > prompt - pos:
@@ -1315,6 +1351,11 @@ class FusedDecoder:
                                    toks_tm[pos:pos + chunk],
                                    jnp.asarray(pos, jnp.int32))
             pos += chunk
+        if pc is not None and prompt >= pc.block_tokens:
+            # commit-on-prefill, oneshot flavor: publish each row's full
+            # blocks before decode touches (and donates) the cache buffer
+            for r in range(b):
+                pc.publish(caches, r, ids_pc[r])
         if num_beams > 1:
             return self._generate_beam(
                 ids, last_x, caches, stk, e_arrays, h_arrays,
@@ -1421,7 +1462,7 @@ def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
                    max_seq_len=None, eos_token_id=None, do_sample=False,
                    top_k=0, top_p=1.0, temperature=1.0, use_rotary=False,
                    num_beams=1, length_penalty=1.0, min_length=0,
-                   repetition_penalty=1.0):
+                   repetition_penalty=1.0, prefix_cache=None):
     """One-shot driver over FusedDecoder (see class docstring)."""
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
@@ -1431,4 +1472,5 @@ def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
                         top_k, top_p, temperature, num_beams=num_beams,
                         length_penalty=length_penalty,
                         min_length=min_length,
-                        repetition_penalty=repetition_penalty)
+                        repetition_penalty=repetition_penalty,
+                        prefix_cache=prefix_cache)

@@ -1,0 +1,444 @@
+"""Automatic prefix caching over the stacked KV ring cache.
+
+Capability parity: vLLM's PagedAttention block reuse / SGLang's
+RadixAttention, realized against this repo's stacked fixed-shape cache
+[L, 2, B, H, Smax, D]: a cached prefix is pure DATA — its K/V blocks are
+splatted into a slot's cache row by one compiled gather-copy instead of
+being recomputed by the prefill stack. Two halves:
+
+  * ``PrefixStore`` — a host-side radix tree over CHUNK-ALIGNED token
+    spans (chunk = the serving engine's ``prefill_cap``, so the prefill
+    ladder and the prefix-block ladder are tuned from one knob). Each
+    node owns exactly one device pool block and is keyed by that block's
+    exact token tuple under its parent (dict hashing of the tuple IS the
+    token-hash key, with exact-match verification for free — no
+    collision hazard). Ref-counting pins a chain while a copy is in
+    flight; eviction is LRU over refcount-0 LEAVES only (an inner node's
+    children are reachable only through it, so evicting a parent first
+    would leak its subtree).
+  * ``PrefixCache`` — the store plus a DEVICE block pool
+    [L, 2, NB, H, Bt, D] (mirrored int8+scales when the engine runs the
+    int8 KV cache) and the two compiled copy paths:
+
+      - ``adopt``: the longest matched chain is gathered by block index
+        and scattered into the target slot's cache row in ONE compiled
+        dispatch. The executable is fixed-shape over a pow-2 ladder of
+        chain lengths (same bounded-variant discipline as the prefill
+        ladder) with the tail write-masked exactly like in-slot prefill:
+        invalid ladder positions are sent out of bounds and dropped
+        (``mode="drop"``), so a neighbouring slot's live row is
+        untouchable by construction and every landed write stays at a
+        position < plen <= Smax - max_new — inside the
+        ``cache_lens < Smax`` clamp the decode_attention write kernels
+        document.
+      - ``commit``: as a slot's prefill lands, each FULL block of its
+        prompt is copied out of the slot row into a free pool block and
+        published under its token key. Copy-on-write is structural: the
+        pool is separate storage, a slot only ever copies IN at
+        admission and OUT at commit — decode continues into slot-private
+        rows and can never mutate a published block.
+
+Both copy paths are plain XLA gathers/scatters (no new kernels): the
+blocks move HBM->HBM once per admission, which is orders of magnitude
+cheaper than re-running the L-layer prefill stack over the same tokens.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["PrefixStore", "PrefixCache", "PrefixNode"]
+
+
+class PrefixNode:
+    """One radix-tree node == one published KV block. ``tokens`` is the
+    block's exact token tuple (the edge label from ``parent``); ``block``
+    is its device pool index."""
+
+    __slots__ = ("tokens", "parent", "children", "block", "refcount",
+                 "last_use")
+
+    def __init__(self, tokens, parent, block):
+        self.tokens = tokens
+        self.parent = parent
+        self.children = {}               # token tuple -> PrefixNode
+        self.block = block
+        self.refcount = 0
+        self.last_use = 0
+
+    def __repr__(self):                  # debugging aid only
+        return (f"PrefixNode(block={self.block}, ref={self.refcount}, "
+                f"children={len(self.children)})")
+
+
+class PrefixStore:
+    """Host-side radix store over fixed-size token blocks with a capacity
+    budget in KV blocks, ref-counting, and LRU leaf eviction. Pure host
+    bookkeeping — no device arrays — so it unit-tests without jax."""
+
+    def __init__(self, num_blocks, block_tokens):
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        if self.num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        if self.block_tokens < 1:
+            raise ValueError("block_tokens must be >= 1")
+        self._root = PrefixNode((), None, -1)
+        self._free = list(range(self.num_blocks))
+        # refcount-0 LEAVES, maintained at every transition (create /
+        # link / acquire / release / evict): eviction picks min-last_use
+        # from this set instead of walking the whole tree — allocation
+        # under a full pool is O(evictable), not O(nodes x chain), on
+        # the TTFT-critical admission path
+        self._evictable = set()
+        self._tick = 0
+        # counters (raw store level; the serving engine keeps its own
+        # per-admission hit/miss window counters)
+        self.match_hits = 0
+        self.match_misses = 0
+        self.evictions = 0
+        self.committed_blocks = 0
+
+    # ------------------------------------------------------------- blocks
+    def _blocks_of(self, tokens):
+        """Full ``block_tokens``-sized tuples of ``tokens`` (the ragged
+        tail never forms a block — it stays slot-private)."""
+        t = np.asarray(tokens).reshape(-1)
+        bt = self.block_tokens
+        n = t.size // bt
+        return [tuple(int(x) for x in t[i * bt:(i + 1) * bt])
+                for i in range(n)]
+
+    def _touch(self, node):
+        self._tick += 1
+        node.last_use = self._tick
+
+    # -------------------------------------------------------------- match
+    def match(self, tokens):
+        """Longest chain of published blocks prefixing ``tokens``.
+        Returns the node list root-first and bumps each node's LRU stamp
+        (a matched chain is hot). Does NOT take refs — callers pin with
+        acquire()/release() around the device copy. Hit/miss counters
+        are bumped by PrefixCache.lookup() AFTER its final-block cap, so
+        store- and engine-level hit counts can never disagree."""
+        chain = []
+        node = self._root
+        for key in self._blocks_of(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            chain.append(child)
+            node = child
+        return chain
+
+    def _update_evictable(self, node):
+        if node is self._root:
+            return
+        if node.children or node.refcount:
+            self._evictable.discard(node)
+        else:
+            self._evictable.add(node)
+
+    def acquire(self, nodes):
+        for n in nodes:
+            n.refcount += 1
+            self._evictable.discard(n)
+
+    def release(self, nodes):
+        for n in nodes:
+            if n.refcount <= 0:
+                raise RuntimeError("prefix block refcount underflow")
+            n.refcount -= 1
+            self._update_evictable(n)
+
+    # ------------------------------------------------------------ insert
+    def insert(self, tokens):
+        """Publish ``tokens``' full blocks: walk/extend the radix chain,
+        allocating a pool block for every node that does not exist yet
+        (evicting cold blocks if the budget is exhausted). Returns
+        ``[(node, is_new), ...]`` root-first — the caller device-copies
+        the K/V of every ``is_new`` block; existing nodes are dedup hits
+        and need no copy. Publication stops early (prefix of the chain
+        only) when no block can be allocated; partial chains are valid —
+        matching is per-block."""
+        out = []
+        node = self._root
+        try:
+            for key in self._blocks_of(tokens):
+                child = node.children.get(key)
+                if child is None:
+                    blk = self._alloc()
+                    if blk is None:      # budget exhausted, nothing cold
+                        break
+                    child = PrefixNode(key, node, blk)
+                    node.children[key] = child
+                    self._update_evictable(node)   # parent: now inner
+                    self.committed_blocks += 1
+                    out.append((child, True))
+                else:
+                    out.append((child, False))
+                self._touch(child)
+                # pin the chain UNDER CONSTRUCTION: without this, a
+                # chain longer than the free budget would evict its own
+                # freshly-created tail (a refcount-0 leaf) to allocate
+                # the next block, orphaning the subtree. acquire() (not
+                # a raw refcount bump) so the pinned node also leaves
+                # the evictable set — a dedup'd leaf left there would be
+                # picked as the LRU victim and trip _evict's guard
+                self.acquire((child,))
+                node = child
+        finally:
+            self.release(n for n, _ in out)
+        return out
+
+    def _alloc(self):
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_evictable_leaf()
+        if victim is None:
+            return None
+        return self._evict(victim)
+
+    def _lru_evictable_leaf(self):
+        """Oldest refcount-0 LEAF (no children), from the maintained
+        evictable set."""
+        return min(self._evictable, key=lambda n: n.last_use,
+                   default=None)
+
+    def _evict(self, node):
+        """Unlink ``node`` and recycle its pool block id. Only refcount-0
+        leaves are evictable — enforced, not assumed."""
+        if node.children or node.refcount:
+            raise RuntimeError("evicting a pinned or inner prefix block")
+        del node.parent.children[node.tokens]
+        self._evictable.discard(node)
+        self._update_evictable(node.parent)    # may have become a leaf
+        self.evictions += 1
+        return node.block
+
+    # ------------------------------------------------------------- stats
+    def _count_nodes(self):
+        n, stack = 0, list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            n += 1
+            stack.extend(node.children.values())
+        return n
+
+    def stats(self):
+        used = self._count_nodes()
+        return {
+            "blocks_capacity": self.num_blocks,
+            "blocks_used": used,
+            # the REAL free list, not capacity - used: a leaked block id
+            # (allocated but never attached, or evicted but not
+            # recycled) shows up as used + free != capacity
+            "blocks_free": len(self._free),
+            "match_hits": self.match_hits,
+            "match_misses": self.match_misses,
+            "evictions": self.evictions,
+            "committed_blocks": self.committed_blocks,
+        }
+
+
+class PrefixCache:
+    """The device half: block pool + compiled adopt/commit copies. One
+    PrefixCache can be SHARED between a ServingEngine and oneshot
+    ``FusedDecoder.generate(prefix_cache=...)`` calls — the pool layout
+    depends only on (L, H, D, cache flavor), not on the cache batch, so
+    the same published blocks serve both (executables are cached per
+    cache signature; a second signature costs one extra trace, counted
+    by the same spy)."""
+
+    def __init__(self, num_blocks, block_tokens):
+        self.store = PrefixStore(num_blocks, block_tokens)
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self._pool = None                # device blocks (array or tuple)
+        self._pool_sig = None            # (L, H, D, dtype-ish, int8?)
+        self._jit_cache = {}
+        self.trace_count = 0             # retrace spy, engine-compatible
+
+    # ---------------------------------------------------------- plumbing
+    def _counted_jit(self, key, build, donate=()):
+        """Same spy discipline as ServingEngine._counted_jit: the counter
+        bumps at trace time only, so zero-retrace-after-warmup contracts
+        can assert over engine traces + this counter."""
+        import jax
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            inner = build()
+
+            def spied(*args):
+                self.trace_count += 1
+                return inner(*args)
+            tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+            fn = jax.jit(spied, donate_argnums=() if tunneled else donate)
+            self._jit_cache[key] = fn
+        return fn
+
+    @staticmethod
+    def _sig_of(caches):
+        quant = isinstance(caches, tuple)
+        stack = caches[0] if quant else caches
+        L, _, _, H, _, D = stack.shape
+        return (L, H, D, str(stack.dtype), quant)
+
+    def _ensure_pool(self, caches):
+        """Build (or validate) the pool against this cache's layout. The
+        pool is [L, 2, NB, H, Bt, D] (+ [L, 2, NB, H, 1, Bt] scales in
+        int8 mode) — block index rides the cache's batch axis so adopt
+        and commit are pure gathers/updates along one axis."""
+        import jax.numpy as jnp
+        sig = self._sig_of(caches)
+        if self._pool is not None:
+            if sig != self._pool_sig:
+                raise ValueError(
+                    f"prefix cache pool was built for {self._pool_sig}, "
+                    f"got caches with {sig} — one PrefixCache serves one "
+                    "model/cache flavor")
+            return
+        L, H, D, dt, quant = sig
+        shape = (L, 2, self.num_blocks, H, self.block_tokens, D)
+        if quant:
+            self._pool = (jnp.zeros(shape, jnp.int8),
+                          jnp.zeros(shape[:4] + (1, self.block_tokens),
+                                    jnp.float32))
+        else:
+            self._pool = jnp.zeros(shape, dt)
+        self._pool_sig = sig
+
+    # ------------------------------------------------------------ lookup
+    def lookup(self, tokens):
+        """Longest ADOPTABLE chain for a prompt: the raw radix match
+        capped so at least one prompt token always goes through real
+        prefill — the first-token sample needs the last prompt token's
+        hidden state, which only prefill produces (a fully-cached prompt
+        drops its final block; vLLM does the same)."""
+        t = np.asarray(tokens).reshape(-1)
+        nodes = self.store.match(t)
+        cap = (t.size - 1) // self.block_tokens
+        nodes = nodes[:cap]
+        if nodes:
+            self.store.match_hits += 1
+        else:
+            self.store.match_misses += 1
+        return nodes
+
+    # ------------------------------------------------------------- adopt
+    def _build_adopt(self, K, quant):
+        import jax.numpy as jnp
+        Bt = self.block_tokens
+
+        def adopt(caches, pool_s, pool_sc, idx, slot, nblk):
+            # idx: [K] pool block ids (tail-padded); nblk: valid count.
+            # Ladder tail positions are sent OUT OF BOUNDS (index Smax)
+            # so mode="drop" skips them — identical write-mask discipline
+            # to the in-slot prefill scatter; every landed position is
+            # < nblk*Bt <= plen - 1 < Smax, inside the write kernels'
+            # cache_lens < Smax clamp.
+            stack = caches[0] if quant else caches
+            smax = stack.shape[4]
+            pos = jnp.arange(K * Bt, dtype=jnp.int32)
+            pos = jnp.where(pos < nblk * Bt, pos, smax)
+            blocks = jnp.take(pool_s, idx, axis=2)     # [L,2,K,H,Bt,D]
+            vals = jnp.transpose(blocks, (2, 4, 0, 1, 3, 5))
+            vals = vals.reshape((K * Bt,) + vals.shape[2:])
+            if quant:
+                ci8 = caches[0].at[:, :, slot, :, pos, :].set(
+                    vals, mode="drop")
+                scs = jnp.take(pool_sc, idx, axis=2)   # [L,2,K,H,1,Bt]
+                sv = jnp.transpose(scs, (2, 5, 0, 1, 3, 4))
+                sv = sv.reshape((K * Bt,) + sv.shape[2:])[..., 0]
+                scl = caches[1].at[:, :, slot, :, 0, pos].set(
+                    sv, mode="drop")
+                return ci8, scl
+            return caches.at[:, :, slot, :, pos, :].set(vals, mode="drop")
+        return adopt
+
+    def adopt(self, caches, slot, nodes):
+        """Splat ``nodes``' pool blocks into ``caches``' row ``slot`` at
+        positions [0, len(nodes)*Bt) in one compiled dispatch. Returns
+        the updated caches. Caller holds refs on ``nodes`` across the
+        call (host-synchronous dispatch: once issued, the pool buffer is
+        captured by data dependency and the refs may drop)."""
+        import jax.numpy as jnp
+        self._ensure_pool(caches)
+        quant = isinstance(caches, tuple)
+        n = len(nodes)
+        if n == 0:
+            return caches
+        K = 1 << (n - 1).bit_length()                  # pow-2 ladder
+        idx = np.zeros(K, np.int32)
+        idx[:n] = [nd.block for nd in nodes]
+        sig = self._sig_of(caches)
+        # donate ONLY the caches (arg 0): the pool is read, not consumed —
+        # it must stay live for every later adopt/commit
+        fn = self._counted_jit(
+            ("adopt", K, sig),
+            lambda k=K, q=quant: self._build_adopt(k, q), donate=(0,))
+        pool = self._pool
+        ps, psc = (pool if quant else (pool, None))
+        if psc is None:
+            psc = jnp.zeros((1,), jnp.float32)         # signature filler
+        return fn(caches, ps, psc, jnp.asarray(idx),
+                  jnp.asarray(slot, jnp.int32), jnp.asarray(n, jnp.int32))
+
+    # ------------------------------------------------------------ commit
+    def _build_commit(self, quant):
+        import jax
+        import jax.numpy as jnp
+        Bt = self.block_tokens
+
+        def commit(pool_s, pool_sc, caches, slot, t0, dst):
+            stack = caches[0] if quant else caches
+            L, _, _, H, _, D = stack.shape
+            blk = jax.lax.dynamic_slice(
+                stack, (0, 0, slot, 0, t0, 0), (L, 2, 1, H, Bt, D))
+            pool_s = jax.lax.dynamic_update_slice(
+                pool_s, blk.astype(pool_s.dtype), (0, 0, dst, 0, 0, 0))
+            if quant:
+                sc = jax.lax.dynamic_slice(
+                    caches[1], (0, 0, slot, 0, 0, t0), (L, 2, 1, H, 1, Bt))
+                pool_sc = jax.lax.dynamic_update_slice(
+                    pool_sc, sc, (0, 0, dst, 0, 0, 0))
+            return pool_s, pool_sc
+        return commit
+
+    def commit_block(self, caches, slot, t0, dst_block):
+        """Copy the Bt-token block at ``caches[.., slot, .., t0:t0+Bt, ..]``
+        into pool block ``dst_block`` (one fixed-shape dispatch; slot, t0
+        and dst are data). Called strictly AFTER the block's prefill
+        chunks landed in the slot — ordering is by jax data dependency on
+        the caches buffer, no sync needed."""
+        import jax.numpy as jnp
+        self._ensure_pool(caches)
+        quant = isinstance(caches, tuple)
+        sig = self._sig_of(caches)
+        fn = self._counted_jit(
+            ("commit", sig),
+            lambda q=quant: self._build_commit(q), donate=(0, 1))
+        pool = self._pool
+        ps, psc = (pool if quant else (pool, None))
+        if psc is None:
+            psc = jnp.zeros((1,), jnp.float32)
+        ps, psc = fn(ps, psc, caches, jnp.asarray(slot, jnp.int32),
+                     jnp.asarray(t0, jnp.int32),
+                     jnp.asarray(dst_block, jnp.int32))
+        self._pool = (ps, psc) if quant else ps
+
+    def publish(self, caches, slot, tokens):
+        """Commit-on-prefill: publish every full block of ``tokens`` that
+        is not already in the store, copying its K/V out of the slot row.
+        Blocks the prompt ADOPTED at admission re-resolve to their
+        existing nodes (dedup — no copy). Returns #new blocks."""
+        plan = self.store.insert(tokens)
+        new = 0
+        for i, (node, is_new) in enumerate(plan):
+            if is_new:
+                self.commit_block(caches, slot, i * self.block_tokens,
+                                  node.block)
+                new += 1
+        return new

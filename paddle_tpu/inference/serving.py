@@ -27,6 +27,15 @@ Host control happens only at chunk boundaries: every `decode_chunk`
 tokens the engine harvests per-slot streams, completes finished
 requests, admits from the queue, and emits a metrics record (tokens/s,
 TTFT, queue depth, slot occupancy, step latency, trace count).
+
+Automatic prefix caching (`prefix_cache_blocks=` / a shared
+`PrefixCache`): admission first splats the longest PUBLISHED prefix of
+the prompt into the slot's cache row — one compiled block gather-copy
+over a pow-2 chain-length ladder, write-masked like in-slot prefill —
+and only the uncached suffix runs through the chunked prefill scan; as
+prefill lands, the prompt's full `prefill_cap`-sized blocks are
+committed back to the pool (copy-out, dedup'd) so later shared-prompt
+requests hit. See prefix_cache.py for the radix store / COW invariants.
 """
 from __future__ import annotations
 
@@ -121,7 +130,8 @@ class ServingEngine:
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                  decode_chunk=None, use_rotary=False,
                  enable_repetition_penalty=False, clock=None,
-                 max_pending=None):
+                 max_pending=None, prefill_cap=None,
+                 prefix_cache_blocks=0, prefix_cache=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -132,7 +142,38 @@ class ServingEngine:
         self.decode_chunk = int(decode_chunk or
                                 os.environ.get("PADDLE_TPU_SERVE_CHUNK",
                                                "4"))
-        self.prefill_cap = 64                   # pow-2 prefill ladder cap
+        # pow-2 prefill ladder cap — ONE knob tunes both the prefill
+        # chunk ladder and the prefix-cache block size (blocks are
+        # prefill-chunk-aligned by construction)
+        cap = int(prefill_cap if prefill_cap is not None
+                  else os.environ.get("PADDLE_SERVING_PREFILL_CAP", "64"))
+        if cap < 1 or cap & (cap - 1):
+            raise ValueError(
+                f"prefill_cap must be a power of two >= 1, got {cap} "
+                "(the prefill ladder and the prefix-block ladder both "
+                "key their bounded executable sets on it)")
+        self.prefill_cap = cap
+        # automatic prefix caching: pass a shared PrefixCache (e.g. the
+        # one oneshot generate() calls use) or a block budget to build a
+        # private one; 0/None = off (legacy behavior, no new dispatches)
+        if prefix_cache is not None:
+            if prefix_cache.block_tokens != self.prefill_cap:
+                raise ValueError(
+                    f"shared prefix cache has block_tokens="
+                    f"{prefix_cache.block_tokens} but prefill_cap="
+                    f"{self.prefill_cap} — the block and prefill ladders "
+                    "must align")
+            self.prefix_cache = prefix_cache
+        elif prefix_cache_blocks:
+            from .prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(int(prefix_cache_blocks),
+                                            self.prefill_cap)
+        else:
+            self.prefix_cache = None
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefill_tokens_saved = 0
+        self._prefill_tokens_computed = 0
         self._rep_on = bool(enable_repetition_penalty)
         self.clock = clock or time.perf_counter
 
@@ -245,7 +286,7 @@ class ServingEngine:
         self.chunk_log.append({
             "step_s": dt, "new_tokens": emitted,
             "occupancy": self.occupancy, "queue_depth": self.queue_depth,
-            "traces": self._trace_count,
+            "traces": self._traces_total(),
         })
         return emitted
 
@@ -266,6 +307,10 @@ class ServingEngine:
         self._admitted = 0
         self._rejected = 0
         self._expired = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefill_tokens_saved = 0
+        self._prefill_tokens_computed = 0
         if not keep_results:
             self.results = {}
 
@@ -279,7 +324,8 @@ class ServingEngine:
 
         def pct(v, q):
             return float(np.percentile(v, q)) if v else None
-        return {
+        looked = self._prefix_hits + self._prefix_misses
+        m = {
             "tokens_emitted": self._tokens_emitted,
             "busy_s": round(self._busy_s, 4),
             "tokens_per_sec": round(
@@ -291,10 +337,33 @@ class ServingEngine:
             "requests_expired": self._expired,
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
-            "traces": self._trace_count,
+            "traces": self._traces_total(),
             "ttft_p50_s": pct(ttfts, 50), "ttft_p99_s": pct(ttfts, 99),
             "latency_p50_s": pct(lats, 50), "latency_p99_s": pct(lats, 99),
+            # prefix-cache window counters (all zero with caching off):
+            # hits + misses == requests_admitted by construction; saved +
+            # computed == total prompt tokens admitted this window
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefix_hit_rate": (round(self._prefix_hits / looked, 4)
+                                if looked else None),
+            "prefill_tokens_saved": self._prefill_tokens_saved,
+            "prefill_tokens_computed": self._prefill_tokens_computed,
         }
+        if self.prefix_cache is not None:
+            m["prefix_store"] = self.prefix_cache.store.stats()
+        return m
+
+    def _traces_total(self):
+        """Engine traces + the prefix cache's copy-path traces: the
+        zero-retrace-after-warmup contract covers the adopt/commit
+        executables too (a shared PrefixCache may also accrue traces
+        from oneshot generate() calls — still honest: any trace hits
+        the same compile stall)."""
+        n = self._trace_count
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.trace_count
+        return n
 
     # ------------------------------------------------------- jitted steps
     def _counted_jit(self, key, build, donate=()):
@@ -507,19 +576,64 @@ class ServingEngine:
         #    batching's shared prefill on the serving bench.
         #  * masked scan (mesh / opt-out PADDLE_TPU_SERVE_BULK=0): the
         #    chunked prefill scan with a per-row write mask.
-        use_bulk = (self.dec._mesh_mp() is None and
+        mesh_on = self.dec._mesh_mp() is not None
+        use_bulk = (not mesh_on and
                     os.environ.get("PADDLE_TPU_SERVE_BULK", "1") != "0")
-        if use_bulk:
-            for r in batch:
+        # Prefix-cache admission: the longest published block chain is
+        # splatted into the slot's cache row by ONE compiled gather-copy
+        # dispatch (pow-2 ladder over chain length), and only the
+        # uncached suffix goes through prefill. Disabled under a mesh
+        # (the pool carries no sharding annotations) — every admission
+        # then counts as a miss so hits + misses == admitted still
+        # reconciles and a dead cache is visible as hit_rate == 0.
+        pc = self.prefix_cache if not mesh_on else None
+        if pc is None and self.prefix_cache is not None:
+            self._prefix_misses += len(batch)
+        base = np.zeros(b, np.int32)          # adopted tokens per slot
+        published = set()                     # slots published this admit
+        for r in batch:
+            if pc is not None:
+                # lookup + (miss-path bulk prefill + publish) run PER
+                # REQUEST, in order: a cold gang of same-template
+                # requests admitted in one batch would otherwise ALL
+                # miss — row 1's publish lets rows 2..B adopt the
+                # template inside the same admission
+                nodes = pc.lookup(r.prompt)
+                if nodes:
+                    pc.store.acquire(nodes)   # pin across the copy
+                    try:
+                        self._caches = pc.adopt(self._caches, r.slot,
+                                                nodes)
+                    finally:
+                        pc.store.release(nodes)
+                    base[r.slot] = len(nodes) * pc.block_tokens
+                    self._prefix_hits += 1
+                    self._prefill_tokens_saved += int(base[r.slot])
+                else:
+                    self._prefix_misses += 1
+            if self.prefix_cache is not None:
+                self._prefill_tokens_computed += (r.prompt.size
+                                                  - int(base[r.slot]))
+            if use_bulk and not base[r.slot]:
                 last_x = self._bulk_admit_row(stk, e_arrays, r, last_x)
-        else:
-            maxp = max(r.prompt.size for r in batch)
+                if pc is not None:
+                    pc.publish(self._caches, r.slot, r.prompt)
+                    published.add(r.slot)
+        # a prefix hit always takes the masked-scan path for its suffix:
+        # the bulk flash pass has no way to attend the adopted prefix
+        # K/V, while the per-token scan attends the whole cache row up
+        # to each position by construction
+        scan_batch = [r for r in batch if not use_bulk or base[r.slot]]
+        if scan_batch:
+            maxp = max(r.prompt.size - int(base[r.slot])
+                       for r in scan_batch)
             chunks = self._prefill_chunks(maxp)
             prompts = np.zeros((b, sum(chunks)), np.int32)
             n_left = np.zeros(b, np.int32)
-            for r in batch:
-                prompts[r.slot, :r.prompt.size] = r.prompt
-                n_left[r.slot] = r.prompt.size
+            for r in scan_batch:
+                sfx = r.prompt[int(base[r.slot]):]
+                prompts[r.slot, :sfx.size] = sfx
+                n_left[r.slot] = sfx.size
             pos = 0
             for chunk in chunks:
                 fn = self._counted_jit(
@@ -528,7 +642,7 @@ class ServingEngine:
                     donate=(2,))
                 toks = jnp.asarray(
                     np.ascontiguousarray(prompts[:, pos:pos + chunk].T))
-                t0 = np.where(n_left > 0, pos, self._lens).astype(
+                t0 = np.where(n_left > 0, base + pos, self._lens).astype(
                     np.int32)
                 n_valid = np.clip(n_left - pos, 0, chunk).astype(
                     np.int32)
@@ -536,6 +650,17 @@ class ServingEngine:
                     stk, e_arrays, self._caches, toks,
                     jnp.asarray(t0), jnp.asarray(n_valid), last_x)
                 pos += chunk
+        # commit-on-prefill for the rows whose prefill just landed via
+        # the scan (bulk-miss rows published inline above): publish each
+        # prompt's full blocks back to the pool under their token keys.
+        # Adopted blocks re-resolve to their existing nodes (dedup, no
+        # copy); only genuinely new blocks are copied out of the slot
+        # row. COW is structural: the pool is separate storage, decode
+        # only writes slot-private positions >= plen.
+        if pc is not None:
+            for r in batch:
+                if r.slot not in published:
+                    pc.publish(self._caches, r.slot, r.prompt)
 
         # per-slot params refresh for the admitted rows
         for r in batch:

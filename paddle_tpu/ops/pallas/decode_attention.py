@@ -14,6 +14,14 @@ q: [B, Sq, H, D] (Sq small — 1 for greedy decode), cache: [B, Smax, Hk, D]
 (GQA: Hk | H), cache_lens: [B] int32 valid prefix lengths. New tokens at
 positions cache_lens..cache_lens+Sq-1 attend causally among themselves and
 fully to the cache prefix. Forward-only (inference).
+
+The `cache_lens < Smax` invariant (write kernels clamp a full row's write
+to a drop) has THREE clients: the serving engine's eviction-as-data slot
+reuse, the submit-time `prompt + max_new_tokens <= Smax` bound, and the
+prefix cache's block-granular adopt copy (inference/prefix_cache.py) —
+adopted block writes land at positions < plen <= Smax - max_new_tokens
+with the pow-2 ladder tail masked out of bounds and dropped, so a
+block-granular splat can never push a row to (or past) Smax either.
 """
 from __future__ import annotations
 

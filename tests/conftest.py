@@ -42,6 +42,54 @@ def _no_fault_injection_leak(request):
     yield
 
 
+def check_serving_metrics(eng):
+    """Metrics-consistency guard for serving tests (same spirit as the
+    fault-injection leak guard: invariants that must hold for ANY engine
+    state are asserted in one place). Window counters must reconcile:
+    every admission is exactly one prefix-cache lookup (hit or miss),
+    token throughput implies busy time, and rates stay in [0, 1].
+    Returns the metrics dict so tests can chain their own assertions.
+
+    NOTE: call on windows without an intervening reset_metrics(
+    keep_results=True) — requests_finished is recomputed from retained
+    results while the window counters zero, which legitimately breaks
+    the reconciliation."""
+    m = eng.metrics()
+    assert m["requests_admitted"] >= 0
+    # every finished request was admitted (expired ones may have been
+    # shed straight from the queue, so they don't reconcile this way)
+    assert m["requests_finished"] <= m["requests_admitted"]
+    if getattr(eng, "prefix_cache", None) is not None:
+        assert m["prefix_hits"] + m["prefix_misses"] == \
+            m["requests_admitted"], (
+            f"every admission must count as exactly one prefix lookup: "
+            f"hits={m['prefix_hits']} + misses={m['prefix_misses']} != "
+            f"admitted={m['requests_admitted']}")
+        assert m["prefill_tokens_saved"] >= 0
+        assert m["prefill_tokens_computed"] >= 0
+        if m["prefix_hits"] == 0:
+            assert m["prefill_tokens_saved"] == 0
+        st = m["prefix_store"]
+        assert 0 <= st["blocks_used"] <= st["blocks_capacity"]
+        assert st["blocks_used"] + st["blocks_free"] == \
+            st["blocks_capacity"]
+    else:
+        assert m["prefix_hits"] == 0 and m["prefix_misses"] == 0
+        assert m["prefill_tokens_saved"] == 0
+        assert m["prefill_tokens_computed"] == 0
+    if m["prefix_hit_rate"] is not None:
+        assert 0.0 <= m["prefix_hit_rate"] <= 1.0
+    if m["tokens_emitted"]:
+        assert m["busy_s"] > 0 and m["tokens_per_sec"] > 0
+    return m
+
+
+@pytest.fixture
+def serving_metrics_ok():
+    """Fixture handle on check_serving_metrics for serving tests."""
+    return check_serving_metrics
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as paddle

@@ -1,0 +1,251 @@
+"""Prefix-cache KV reuse: the host radix store's bookkeeping contracts
+(refcounts, LRU leaf eviction, capacity budget, dedup — no device
+needed) and the device block pool's copy paths (commit-out / adopt-in
+round-trip, ladder write-masking, signature guard, cross-object reuse
+between oneshot generate() and the serving engine)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.prefix_cache import PrefixCache, PrefixStore
+
+
+class TestPrefixStoreRadix:
+    """Pure-host radix store semantics."""
+
+    def test_match_is_block_aligned_and_longest(self):
+        st = PrefixStore(8, 4)
+        toks = np.arange(1, 11, dtype=np.int32)          # 10 tokens
+        plan = st.insert(toks)
+        assert [new for _, new in plan] == [True, True]  # 2 full blocks
+        assert len(st.match(toks)) == 2                  # ragged tail free
+        assert len(st.match(toks[:8])) == 2
+        assert len(st.match(toks[:7])) == 1              # partial block
+        # same first block, diverging second: radix splits per block
+        fork = np.concatenate([toks[:4], [99, 98, 97, 96]])
+        assert len(st.match(fork)) == 1
+        assert len(st.match(np.asarray([7, 7, 7, 7]))) == 0
+
+    def test_insert_dedups_existing_chain(self):
+        st = PrefixStore(8, 4)
+        toks = np.arange(1, 9, dtype=np.int32)
+        st.insert(toks)
+        again = st.insert(toks)
+        assert [new for _, new in again] == [False, False]
+        assert st.stats()["committed_blocks"] == 2
+        # extending the chain reuses the prefix and allocates the tail
+        longer = np.concatenate([toks, [50, 51, 52, 53]])
+        plan = st.insert(longer)
+        assert [new for _, new in plan] == [False, False, True]
+
+    def test_lru_leaf_eviction_order(self):
+        st = PrefixStore(2, 2)
+        st.insert(np.asarray([1, 2, 3, 4]))              # blocks A1 -> A2
+        st.match(np.asarray([1, 2]))                     # touch A1 (leaf A2 stays cold)
+        plan = st.insert(np.asarray([5, 6]))             # needs one block
+        assert plan and plan[0][1] is True
+        assert st.stats()["evictions"] == 1
+        # the evicted victim was the cold LEAF (A2): A1 still matches
+        assert len(st.match(np.asarray([1, 2, 3, 4]))) == 1
+        assert len(st.match(np.asarray([5, 6]))) == 1
+
+    def test_inner_nodes_are_never_evicted(self):
+        st = PrefixStore(2, 2)
+        st.insert(np.asarray([1, 2, 3, 4]))
+        # allocation pressure may only take the leaf — never the parent
+        # out from under its child
+        st.insert(np.asarray([9, 9]))
+        assert len(st.match(np.asarray([1, 2]))) == 1
+        assert st.stats()["evictions"] == 1
+
+    def test_refcount_pins_against_eviction(self):
+        st = PrefixStore(1, 2)
+        (node, _), = st.insert(np.asarray([1, 2]))
+        st.acquire([node])
+        assert st.insert(np.asarray([3, 4])) == []       # nothing evictable
+        assert st.stats()["evictions"] == 0
+        st.release([node])
+        plan = st.insert(np.asarray([3, 4]))
+        assert plan and plan[0][1] is True
+        assert st.stats()["evictions"] == 1
+        assert len(st.match(np.asarray([1, 2]))) == 0    # gone
+
+    def test_refcount_underflow_raises(self):
+        st = PrefixStore(2, 2)
+        (node, _), = st.insert(np.asarray([1, 2]))
+        with pytest.raises(RuntimeError, match="underflow"):
+            st.release([node])
+
+    def test_insert_never_evicts_its_own_dedup_chain(self):
+        """Re-publishing an existing chain plus a new tail under a FULL
+        pool: the dedup'd nodes are pinned for the walk, so the LRU
+        victim search must skip them instead of selecting one and
+        tripping _evict's pinned-node guard (crashed with RuntimeError
+        before the insert-pin went through acquire())."""
+        st = PrefixStore(1, 2)
+        st.insert(np.asarray([1, 2]))
+        plan = st.insert(np.asarray([1, 2, 3, 4]))
+        # the one block is the (pinned) dedup'd prefix: the tail simply
+        # cannot be published — no crash, no self-eviction
+        assert [(n.tokens, new) for n, new in plan] == [((1, 2), False)]
+        assert st.stats()["evictions"] == 0
+        assert len(st.match(np.asarray([1, 2]))) == 1
+
+    def test_capacity_budget_publishes_prefix_only(self):
+        st = PrefixStore(3, 2)
+        plan = st.insert(np.arange(1, 13, dtype=np.int32))  # 6 blocks
+        assert len(plan) == 3                            # budget-bounded
+        assert st.stats()["blocks_used"] == 3
+        assert st.stats()["blocks_free"] == 0
+        # the published PREFIX still matches (partial chains are valid)
+        assert len(st.match(np.arange(1, 13, dtype=np.int32))) == 3
+
+
+class TestPrefixCacheDevice:
+    """Device pool copy paths (CPU-executed jax)."""
+
+    def _caches(self, rng, B=2, L=2, H=3, S=32, D=8):
+        import jax.numpy as jnp
+        return jnp.asarray(rng.randn(L, 2, B, H, S, D), jnp.float32)
+
+    def test_commit_adopt_roundtrip_fp(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        pc = PrefixCache(4, 4)
+        src = self._caches(rng)
+        toks = np.arange(1, 10, dtype=np.int32)          # 2 full blocks
+        for i, (node, new) in enumerate(pc.store.insert(toks)):
+            assert new
+            pc.commit_block(src, 0, i * 4, node.block)
+        dst = jnp.zeros_like(src)
+        nodes = pc.store.match(toks)
+        out = pc.adopt(dst, 1, nodes)
+        # slot 1 rows [0, 8) == slot 0's committed rows, bit-identical
+        np.testing.assert_array_equal(np.asarray(out[:, :, 1, :, :8, :]),
+                                      np.asarray(src[:, :, 0, :, :8, :]))
+        # positions past the chain and OTHER slots stay untouched
+        assert not np.asarray(out[:, :, 1, :, 8:, :]).any()
+        assert not np.asarray(out[:, :, 0]).any()
+
+    def test_adopt_ladder_tail_is_write_masked(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(1)
+        pc = PrefixCache(8, 4)
+        src = self._caches(rng)
+        toks = np.arange(1, 14, dtype=np.int32)          # 3 full blocks
+        for i, (node, _) in enumerate(pc.store.insert(toks)):
+            pc.commit_block(src, 0, i * 4, node.block)
+        sentinel = jnp.full_like(src, 7.0)
+        out = pc.adopt(sentinel, 0, pc.store.match(toks))
+        # 3 blocks ride a K=4 ladder: positions [12, 16) are the masked
+        # tail and must keep the sentinel (dropped, not zero-filled)
+        np.testing.assert_array_equal(np.asarray(out[:, :, 0, :, :12, :]),
+                                      np.asarray(src[:, :, 0, :, :12, :]))
+        np.testing.assert_array_equal(
+            np.asarray(out[:, :, 0, :, 12:16, :]),
+            np.full_like(np.asarray(src[:, :, 0, :, 12:16, :]), 7.0))
+
+    def test_commit_adopt_roundtrip_int8_flavor(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(2)
+        L, B, H, S, D, Bt = 2, 2, 3, 32, 8, 4
+        ci8 = jnp.asarray(rng.randint(-127, 127, (L, 2, B, H, S, D)),
+                          jnp.int8)
+        sc = jnp.asarray(rng.rand(L, 2, B, H, 1, S), jnp.float32)
+        pc = PrefixCache(4, Bt)
+        toks = np.arange(1, 9, dtype=np.int32)
+        for i, (node, _) in enumerate(pc.store.insert(toks)):
+            pc.commit_block((ci8, sc), 0, i * Bt, node.block)
+        dst = (jnp.zeros_like(ci8), jnp.zeros_like(sc))
+        o8, osc = pc.adopt(dst, 1, pc.store.match(toks))
+        np.testing.assert_array_equal(np.asarray(o8[:, :, 1, :, :8, :]),
+                                      np.asarray(ci8[:, :, 0, :, :8, :]))
+        np.testing.assert_array_equal(np.asarray(osc[:, :, 1, :, 0, :8]),
+                                      np.asarray(sc[:, :, 0, :, 0, :8]))
+
+    def test_pool_signature_guard(self):
+        rng = np.random.RandomState(3)
+        pc = PrefixCache(4, 4)
+        pc._ensure_pool(self._caches(rng))
+        with pytest.raises(ValueError, match="PrefixCache serves one"):
+            pc._ensure_pool(self._caches(rng, H=5))
+
+    def test_lookup_always_leaves_a_suffix_token(self):
+        rng = np.random.RandomState(4)
+        pc = PrefixCache(8, 4)
+        src = self._caches(rng)
+        toks = np.arange(1, 9, dtype=np.int32)           # exactly 2 blocks
+        for i, (node, _) in enumerate(pc.store.insert(toks)):
+            pc.commit_block(src, 0, i * 4, node.block)
+        # a fully-block-aligned prompt must drop its final block: the
+        # first-token sample needs the last prompt token's hidden state
+        assert len(pc.lookup(toks)) == 1
+        assert len(pc.lookup(np.concatenate([toks, [9]]))) == 2
+
+
+class TestOneshotGenerateReuse:
+    """Satellite: generate(prefix_cache=...) skips recomputation across
+    calls and shares published blocks with a ServingEngine."""
+
+    def _model(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        from paddle_tpu.nn.layer.common import Embedding, Linear
+        V, E, H, FF, L = 97, 32, 4, 64, 2
+        paddle.seed(3)
+        embed = Embedding(V, E)
+        fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                    normalize_before=True)
+        head = Linear(E, V, bias_attr=False)
+        fmt.eval()
+        return fmt, embed, head, V
+
+    def test_repeated_eval_prompts_hit_and_match(self):
+        from paddle_tpu.inference.generation import FusedDecoder
+        fmt, embed, head, V = self._model()
+        rng = np.random.RandomState(7)
+        prompt = rng.randint(1, V, (1, 21)).astype(np.int32)
+        pc = PrefixCache(16, 4)
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=64)
+        ref = np.asarray(dec.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=8)._data)
+        out1 = np.asarray(dec.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=8,
+                                       prefix_cache=pc)._data)
+        out2 = np.asarray(dec.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=8,
+                                       prefix_cache=pc)._data)
+        np.testing.assert_array_equal(out1, ref)
+        np.testing.assert_array_equal(out2, ref)
+        st = pc.store.stats()
+        assert st["committed_blocks"] == 5               # 21 // 4
+        assert st["match_hits"] >= 1                     # call 2 adopted
+
+    def test_engine_hits_blocks_published_by_generate(
+            self, serving_metrics_ok):
+        from paddle_tpu.inference.generation import FusedDecoder
+        from paddle_tpu.inference.serving import ServingEngine
+        fmt, embed, head, V = self._model()
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(1, V, (1, 21)).astype(np.int32)
+        pc = PrefixCache(16, 4)
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=64)
+        ref = np.asarray(dec.generate(paddle.to_tensor(prompt),
+                                      max_new_tokens=8,
+                                      prefix_cache=pc)._data)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=64, decode_chunk=2,
+                            prefill_cap=4, prefix_cache=pc)
+        rid = eng.submit(prompt[0], max_new_tokens=8)
+        eng.run()
+        np.testing.assert_array_equal(eng.results[rid]["tokens"],
+                                      ref[0, 21:])
+        m = serving_metrics_ok(eng)
+        assert m["prefix_hits"] == 1
+        assert m["prefill_tokens_saved"] == 20           # 5 blocks x 4
+
+    def test_block_ladder_mismatch_refused(self):
+        from paddle_tpu.inference.serving import ServingEngine
+        fmt, embed, head, _ = self._model()
+        with pytest.raises(ValueError, match="must align"):
+            ServingEngine(fmt, embed, head, num_slots=2, max_seq_len=64,
+                          prefill_cap=8, prefix_cache=PrefixCache(8, 4))
