@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
            "PrecisionType", "ServingEngine", "ServedRequest",
-           "AdmissionFull", "PrefixCache", "PrefixStore"]
+           "AdmissionFull", "PrefixCache", "PrefixStore", "NGramDrafter"]
 
 
 def __getattr__(name):
@@ -37,6 +37,9 @@ def __getattr__(name):
     if name in ("PrefixCache", "PrefixStore"):
         from . import prefix_cache
         return getattr(prefix_cache, name)
+    if name == "NGramDrafter":
+        from . import spec_decode
+        return spec_decode.NGramDrafter
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -78,6 +78,15 @@ def _penalize(logits, presence, repetition_penalty, nt, min_length, eos):
     return logits
 
 
+def _host_seed(key):
+    """Fold a jax PRNG key (typed or raw uint32) into a numpy
+    RandomState seed — the host-side acceptance sampler of speculative
+    decoding draws from numpy, seeded off the same stream the device
+    samplers advance."""
+    data = np.asarray(jax.random.key_data(key)).ravel()
+    return int(data[-1]) & 0x7FFFFFFF
+
+
 def _presence_from(ids, vocab):
     p = jnp.zeros((ids.shape[0], vocab), bool)
     rows = jnp.arange(ids.shape[0])[:, None]
@@ -717,35 +726,46 @@ class FusedDecoder:
             out = (x.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
             return (out * s + b).astype(x.dtype)
 
-        def rope1(x, t):
-            # x: [B, 1, H, D] at absolute position t — scalar (every row
-            # at the same position, the classic decode step) or [B]
-            # (per-row positions, the serving engine's ragged slots)
+        def rope_block(x, tv2):
+            # x: [B, Sq, H, D] at per-(row, position) absolute positions
+            # tv2 [B, Sq] — ONE rotary implementation for every decode
+            # flavor (rope1 below is a rank adapter over it), so the
+            # per-token, serving vector-t, and spec-verify block paths
+            # cannot drift numerically
             inv = 1.0 / (rope_base ** (jnp.arange(0, hd, 2,
                                                   dtype=jnp.float32) / hd))
-            tv = jnp.asarray(t).astype(jnp.float32)
-            fr = tv[..., None] * inv                    # [D/2] or [B, D/2]
-            s, c = jnp.sin(fr), jnp.cos(fr)
-            ss = jnp.concatenate([s, s], axis=-1)
-            cc = jnp.concatenate([c, c], axis=-1)
-            if tv.ndim:
-                ss = ss[:, None, None, :]
-                cc = cc[:, None, None, :]
-            else:
-                ss = ss[None, None, None, :]
-                cc = cc[None, None, None, :]
+            fr = tv2.astype(jnp.float32)[..., None] * inv   # [B, Sq, D/2]
+            s = jnp.concatenate([jnp.sin(fr), jnp.sin(fr)], axis=-1)
+            c = jnp.concatenate([jnp.cos(fr), jnp.cos(fr)], axis=-1)
+            ss = s[:, :, None, :]
+            cc = c[:, :, None, :]
             x1 = x[..., : hd // 2]
             x2 = x[..., hd // 2:]
             rot = jnp.concatenate([-x2, x1], axis=-1)
             return (x * cc.astype(x.dtype) + rot * ss.astype(x.dtype))
 
+        def rope1(x, t):
+            # x: [B, 1, H, D] at absolute position t — scalar (every row
+            # at the same position, the classic decode step) or [B]
+            # (per-row positions, the serving engine's ragged slots)
+            tv = jnp.asarray(t).astype(jnp.int32)
+            tv2 = jnp.broadcast_to(tv.reshape(-1, 1) if tv.ndim
+                                   else tv[None, None], (x.shape[0], 1))
+            return rope_block(x, tv2)
+
         def attend(q, caches, l, t):
-            # q: [B, 1, H, D]; caches: [L, 2, B, H, Smax, D] (full stack —
-            # the kernel addresses layer l via scalar prefetch, zero-copy)
-            # or (int8 stack, fp32 scales) in cache-quant mode. t: scalar
-            # OR [B] per-row positions (the kernels take [B] lens anyway;
-            # the dense fallback broadcasts its mask per row).
-            qt = jnp.swapaxes(q, 1, 2)                  # [B, H, 1, D]
+            # q: [B, Sq, H, D] (Sq == 1 for the classic decode step; the
+            # spec-decode verify step passes the whole K+1 block);
+            # caches: [L, 2, B, H, Smax, D] (full stack — the kernel
+            # addresses layer l via scalar prefetch, zero-copy) or (int8
+            # stack, fp32 scales) in cache-quant mode. t: scalar OR [B]
+            # per-row BASE positions — query row j attends cache
+            # positions <= t + j (the stacked kernels' native block-
+            # causal semantics: "new tokens attend causally among
+            # themselves and fully to the prefix"; the dense fallback
+            # builds the same mask per row).
+            sq = q.shape[1]
+            qt = jnp.swapaxes(q, 1, 2)                  # [B, H, Sq, D]
             tb = jnp.broadcast_to(jnp.asarray(t).astype(jnp.int32),
                                   (q.shape[0],))
             quant = isinstance(caches, tuple)
@@ -772,10 +792,10 @@ class FusedDecoder:
                     # auto-partitioning; shard_map is the manual escape.
                     lshape = cshape[:3] + (cshape[3] // mp,) + cshape[4:]
                     ok = (stacked_i8_is_supported(
-                              (q.shape[0], 1, nh // mp, hd), lshape,
+                              (q.shape[0], sq, nh // mp, hd), lshape,
                               q.dtype) if quant else
                           stacked_is_supported(
-                              (q.shape[0], 1, nh // mp, hd), lshape,
+                              (q.shape[0], sq, nh // mp, hd), lshape,
                               q.dtype, cache_dtype=caches.dtype))
                     if ok:
                         from jax import shard_map
@@ -801,12 +821,13 @@ class FusedDecoder:
                             o = fn(qt, caches, l, lens)
                         return jnp.swapaxes(o, 1, 2)
                 if mesh is None and quant and stacked_i8_is_supported(
-                        (q.shape[0], 1, nh, hd), caches[0].shape, q.dtype):
+                        (q.shape[0], sq, nh, hd), caches[0].shape,
+                        q.dtype):
                     o = decode_attention_stacked_i8(qt, caches[0],
                                                     caches[1], l, lens)
                     return jnp.swapaxes(o, 1, 2)
                 if mesh is None and not quant and stacked_is_supported(
-                        (q.shape[0], 1, nh, hd), caches.shape, q.dtype,
+                        (q.shape[0], sq, nh, hd), caches.shape, q.dtype,
                         cache_dtype=caches.dtype):
                     o = decode_attention_stacked(qt, caches, l, lens)
                     return jnp.swapaxes(o, 1, 2)
@@ -826,8 +847,11 @@ class FusedDecoder:
                                                      keepdims=False)
             s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
                            cache[0].astype(jnp.float32)) * (hd ** -0.5)
+            # block-causal: query row j (token at position t + j) sees
+            # cache cols <= t + j; for Sq == 1 this is the classic mask
             mask = (jnp.arange(smax)[None, None, None, :]
-                    <= tb[:, None, None, None])
+                    <= (tb[:, None, None, None]
+                        + jnp.arange(sq)[None, None, :, None]))
             s = jnp.where(mask, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bhqs,bhsd->bhqd", p,
@@ -992,6 +1016,48 @@ class FusedDecoder:
             return proj_ffn_tail(residual, attn.reshape(b, 1, nh * hd),
                                  p), caches
 
+        def spec_layer_step(x, p, caches, l, lens, wmask):
+            # one layer of the speculative-decoding VERIFY block: Sq =
+            # K+1 tokens land their K/V at per-(row, offset) positions
+            # lens[b] + j (write-then-attend, like the per-token step),
+            # then ONE block-causal attend covers prefix + draft — the
+            # whole block costs one weight stream instead of K+1 scan
+            # iterations. wmask [B, Sq]: masked positions scatter out of
+            # bounds and are dropped (same discipline as the masked-scan
+            # prefill), so a draft past the ring clamp or an inactive
+            # slot can never write; their garbage logits are discarded
+            # by the host and their cache positions are rewritten before
+            # ever becoming attendable (write-then-attend at the next
+            # step's advanced lens).
+            residual = x
+            h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
+            b, kp = h.shape[0], h.shape[1]
+            q, k, v = qkv_of(h, p)
+            offs = jnp.arange(kp, dtype=jnp.int32)[None, :]
+            t2 = lens[:, None] + offs                       # [B, Sq]
+            if use_rotary:
+                q = rope_block(q, t2)
+                k = rope_block(k, t2)
+            kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                jnp.swapaxes(v, 1, 2)])  # [2, B, H, Sq, D]
+            tv = jnp.where(wmask, t2, smax)              # OOB -> dropped
+            bi = jnp.arange(b)[:, None]
+            if isinstance(caches, tuple):
+                q_new, sc_new = _absmax_int8(kv_new, -1)
+                ci8 = caches[0].at[l, :, bi, :, tv, :].set(
+                    jnp.transpose(q_new, (1, 3, 0, 2, 4)), mode="drop")
+                scs = caches[1].at[l, :, bi, :, 0, tv].set(
+                    jnp.transpose(sc_new[..., 0], (1, 3, 0, 2)),
+                    mode="drop")
+                caches = (ci8, scs)
+            else:
+                caches = caches.at[l, :, bi, :, tv, :].set(
+                    jnp.transpose(kv_new, (1, 3, 0, 2, 4)).astype(
+                        caches.dtype), mode="drop")
+            attn = attend(q, caches, l, lens)
+            return proj_ffn_tail(residual, attn.reshape(b, kp, nh * hd),
+                                 p), caches
+
         embed, head = self.embed, self.head
         e_params, h_params = self._embed_params, self._head_params
 
@@ -1027,6 +1093,35 @@ class FusedDecoder:
                 x, caches = carry
                 p, l = xs
                 x, caches = layer_step(x, p, caches, l, t, write_mask)
+                return (x, caches), None
+            nl = (caches[0] if isinstance(caches, tuple)
+                  else caches).shape[0]
+            (x, caches), _ = jax.lax.scan(
+                body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
+            return x, caches
+
+        def spec_hidden(stk, e_arrays, caches, toks, lens, write_mask):
+            # toks: [B, Sq] int32 (position 0 the current input token,
+            # 1..K the draft); lens: [B] per-row base positions;
+            # write_mask: [B, Sq]. Returns (x [B, Sq, E], caches) — the
+            # verify-step hidden core: ONE pass of the layer stack over
+            # the whole K+1 block (see spec_layer_step).
+            x = call_layerlike(embed, e_params, e_arrays, toks)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                sh = NamedSharding(mesh,
+                                   P(None, None, None, "mp", None, None))
+                if isinstance(caches, tuple):
+                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
+                                   for c in caches)
+                else:
+                    caches = jax.lax.with_sharding_constraint(caches, sh)
+
+            def body(carry, xs):
+                x, caches = carry
+                p, l = xs
+                x, caches = spec_layer_step(x, p, caches, l, lens,
+                                            write_mask)
                 return (x, caches), None
             nl = (caches[0] if isinstance(caches, tuple)
                   else caches).shape[0]
@@ -1131,11 +1226,88 @@ class FusedDecoder:
             return sample_head(h_arrays, x, key), caches
 
         step.hidden = hidden
+        step.spec_hidden = spec_hidden
         step.bulk_hidden = bulk_hidden
         step.sample_head = sample_head
         step.call_layerlike = call_layerlike
         step.head_logits = head_logits
         return step
+
+    # ------------------------------------------------ speculative decoding
+    def _build_verify_core(self, k, rep_on=False, greedy_out=False):
+        """The speculative-decoding VERIFY step (Leviathan et al. 2023;
+        drafts come from the model-free n-gram lookup in spec_decode.py):
+        ONE compiled fixed-shape step runs K+1 positions per row through
+        the stack — position 0 is the row's current input token, 1..K the
+        draft — using the per-row-position vector-t + write-masked KV
+        path (same discipline as the masked-scan prefill; every landed
+        write stays under the `cache_lens < Smax` clamp documented in
+        decode_attention.py because masked positions scatter out of
+        bounds and drop). It returns the PENALIZED logits at all K+1
+        positions so acceptance/rollback is pure host data: rejected
+        positions' K/V are never attendable (the next step's writes land
+        at the advanced lens BEFORE those positions are read —
+        write-then-attend), and `cache_lens` advances by accepted+1
+        only, entirely host-side.
+
+        Per-slot eos / min_length / repetition_penalty vectorize across
+        the block: position j is penalized as the (nt+j)-th generated
+        token, with the presence mask speculatively extended by the
+        draft tokens consumed at positions <= j (the host discards the
+        speculative presence and re-applies only accepted tokens).
+
+        A row with no usable draft rides in as a padded all-masked draft
+        (dlen == 0) and the step degrades to the normal decode step for
+        that row — one executable for every draft pattern, zero retraces
+        across churn. Signature (all [B] unless noted): (stk, e_arrays,
+        h_arrays, caches, toks [B, K+1], lens, dlen, active, nt,
+        eos_ids, min_len, rep_pen, presence [B, V] or placeholder) ->
+        (caches, logits [B, K+1, V]).
+
+        greedy_out=True: greedy acceptance only consumes the argmax
+        chain, so the step returns [B, K+1] int32 argmax instead of the
+        logits — at production vocab sizes that drops the per-step
+        device-to-host transfer from ~MBs to bytes."""
+        core = self._build_step_core(False, 0, 1.0, 1.0)
+        spec_hidden, head_logits = core.spec_hidden, core.head_logits
+        smax = self.smax
+        kp = int(k) + 1
+
+        def verify(stk, e_arrays, h_arrays, caches, toks, lens, dlen,
+                   active, nt, eos_ids, min_len, rep_pen, presence):
+            offs = jnp.arange(kp, dtype=jnp.int32)[None, :]     # [1, Kp]
+            t2 = lens[:, None] + offs                           # [B, Kp]
+            valid = (active[:, None] & (offs <= dlen[:, None])
+                     & (t2 < smax))
+            x, caches = spec_hidden(stk, e_arrays, caches, toks, lens,
+                                    valid)
+            logits = head_logits(h_arrays, x)
+            logits = logits.reshape(logits.shape[0], kp, -1)
+            v = logits.shape[-1]
+            if rep_on:
+                # speculative presence: position j's context includes
+                # the draft tokens consumed at positions <= j (cumulative
+                # one-hot OR, masked to valid positions) on top of the
+                # carried presence — matches the sequential step's
+                # token-by-token presence updates exactly
+                oh = (jax.nn.one_hot(toks, v, dtype=jnp.int32)
+                      * valid[..., None].astype(jnp.int32))
+                seen = (jnp.cumsum(oh, axis=1) > 0) | presence[:, None, :]
+                pen = rep_pen[:, None, None]
+                logits = jnp.where(
+                    seen,
+                    jnp.where(logits > 0, logits / pen, logits * pen),
+                    logits)
+            cols = jnp.arange(v)[None, None, :]
+            is_eos = cols == eos_ids[:, None, None]
+            suppress = is_eos & ((nt[:, None] + offs)
+                                 < min_len[:, None])[..., None]
+            logits = jnp.where(suppress, -1e30, logits)
+            if greedy_out:
+                return caches, jnp.argmax(logits, axis=-1).astype(
+                    jnp.int32)
+            return caches, logits
+        return verify
 
     def _generate_beam(self, ids, last_x, caches, stk, e_arrays, h_arrays,
                        max_new_tokens, eos_token_id, k, length_penalty,
@@ -1229,12 +1401,122 @@ class FusedDecoder:
             out[row, prompt:] = seq
         return Tensor(jnp.asarray(out))
 
+    def _generate_spec(self, ids, caches, stk, e_arrays, h_arrays, first,
+                       max_new_tokens, eos, do_sample, top_k, top_p,
+                       temperature, min_length, repetition_penalty,
+                       presence, k, prompt, mesh_now, sk_flag):
+        """Host drive for speculative decoding over the compiled verify
+        core: per-row NGramDrafter proposals -> ONE fixed-shape K+1
+        verify step -> host acceptance (greedy exact-match / rejection
+        sampling with the bonus-token resample) -> rollback as pure
+        data. Rows accept independently, so per-row positions diverge —
+        all bookkeeping is host vectors over the vector-t step, and the
+        output is assembled with the chunked path's semantics (rows
+        that finish early are eos-padded to the last finisher)."""
+        from .spec_decode import (NGramDrafter, filtered_probs,
+                                  greedy_accept, rejection_sample,
+                                  truncate_emitted)
+        b = ids.shape[0]
+        rep_on = repetition_penalty != 1.0
+        prompt_np = np.asarray(ids)
+        first = np.asarray(first)
+        rows = [[int(first[r])] for r in range(b)]
+        drafters = []
+        for r in range(b):
+            d = NGramDrafter(k)
+            d.reset(prompt_np[r])
+            d.update(rows[r])
+            drafters.append(d)
+        lens = np.full(b, prompt, np.int32)
+        nt = np.ones(b, np.int32)
+        finished = ((first == eos) if eos is not None
+                    else np.zeros(b, bool))
+        eos_vec = jnp.full(b, -1 if eos is None else eos, jnp.int32)
+        min_vec = jnp.full(b, int(min_length), jnp.int32)
+        rp_vec = jnp.full(b, float(repetition_penalty), jnp.float32)
+        vkey = ("verify", k, rep_on, do_sample, mesh_now, sk_flag)
+        vstep = self._scan_cache.get(vkey)
+        if vstep is None:
+            tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+            vstep = jax.jit(
+                self._build_verify_core(k, rep_on,
+                                        greedy_out=not do_sample),
+                donate_argnums=() if tunneled else (3,))
+            self._scan_cache[vkey] = vstep
+        rng = None
+        if do_sample:
+            rng = np.random.RandomState(_host_seed(next_key()))
+        while True:
+            act = ~finished & (nt < max_new_tokens)
+            if not act.any():
+                break
+            drafts = np.zeros((b, k), np.int32)
+            dlen = np.zeros(b, np.int32)
+            toks = np.zeros((b, k + 1), np.int32)
+            for r in range(b):
+                toks[r, 0] = rows[r][-1]
+                if not act[r]:
+                    continue
+                d = drafters[r].propose()
+                # never speculate past the row's remaining budget: the
+                # bonus token always ships, so at most remaining-1
+                # drafts are useful — this also keeps every landed
+                # write < prompt + max_new_tokens <= Smax
+                m = min(int(d.size), int(max_new_tokens - nt[r]) - 1)
+                if m > 0:
+                    drafts[r, :m] = d[:m]
+                    dlen[r] = m
+            toks[:, 1:] = drafts
+            caches, out = vstep(
+                stk, e_arrays, h_arrays, caches, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(dlen), jnp.asarray(act),
+                jnp.asarray(nt), eos_vec, min_vec, rp_vec,
+                presence if rep_on else jnp.zeros((b, 1), bool))
+            # greedy steps return just the [B, K+1] argmax chain (the
+            # only thing exact-match acceptance reads); sampling needs
+            # the full logits for the rejection test
+            out = (np.asarray(out).astype(np.float32) if do_sample
+                   else np.asarray(out))
+            new_rows, new_cols = [], []
+            for r in range(b):
+                if not act[r]:
+                    continue
+                m = int(dlen[r])
+                if do_sample:
+                    probs = filtered_probs(out[r, :m + 1], top_k, top_p,
+                                           temperature)
+                    kept, _ = rejection_sample(drafts[r, :m], probs, rng)
+                else:
+                    kept, _ = greedy_accept(drafts[r, :m],
+                                            out[r, :m + 1])
+                emitted, hit_eos = truncate_emitted(
+                    kept, int(max_new_tokens - nt[r]), eos)
+                nt[r] += len(emitted)
+                rows[r].extend(emitted)
+                lens[r] += len(emitted)
+                if hit_eos:
+                    finished[r] = True
+                drafters[r].update(emitted)
+                if rep_on:
+                    new_rows.extend([r] * len(emitted))
+                    new_cols.extend(emitted)
+            if rep_on and new_rows:
+                presence = presence.at[jnp.asarray(new_rows),
+                                       jnp.asarray(new_cols)].set(True)
+        width = max(len(t) for t in rows)
+        pad = eos if eos is not None else 0
+        out = np.full((b, prompt + width), pad, prompt_np.dtype)
+        out[:, :prompt] = prompt_np
+        for r in range(b):
+            out[r, prompt:prompt + len(rows[r])] = rows[r]
+        return Tensor(jnp.asarray(out))
+
     # --------------------------------------------------------------- drive
     @no_grad()
     def generate(self, input_ids, max_new_tokens=20, eos_token_id=None,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
                  num_beams=1, length_penalty=1.0, min_length=0,
-                 repetition_penalty=1.0, prefix_cache=None):
+                 repetition_penalty=1.0, prefix_cache=None, spec_k=0):
         """Prefill the prompt via compiled chunked scans of the hidden
         core (LM head applied once at the end), then run the compiled
         chunked decode. Every device dispatch is a jitted scan — the
@@ -1253,7 +1535,20 @@ class FusedDecoder:
         calls too. Prefill starts at the MIN adopted length across rows
         (the chunked scan walks one scalar position for the whole
         batch); ignored under an active mesh (the pool carries no
-        sharding annotations)."""
+        sharding annotations).
+
+        spec_k: speculative decoding with the model-free n-gram drafter
+        (spec_decode.py) and the compiled K+1-position verify step —
+        pow-2 validated, 0 disables. Greedy outputs are token-identical
+        to spec_k=0; composes with prefix_cache= (prefill is untouched).
+        Batch eval loops with repetitive outputs (summarize/echo) emit
+        several tokens per verify step."""
+        from .spec_decode import validate_spec_k
+        spec_k = validate_spec_k(spec_k)
+        if spec_k and num_beams > 1:
+            raise ValueError(
+                "spec_k composes with greedy/sampling generation, not "
+                "beam search (a draft has no beam lineage to verify)")
         if num_beams > 1 and do_sample:
             raise ValueError("beam search (num_beams>1) is deterministic; "
                              "do_sample=True is not supported with it")
@@ -1386,6 +1681,13 @@ class FusedDecoder:
         else:
             nxt = hstep(h_arrays, last_x, hkey_rng)
 
+        if spec_k:
+            return self._generate_spec(
+                ids, caches, stk, e_arrays, h_arrays, nxt,
+                max_new_tokens, eos_i, do_sample, top_k, top_p,
+                temperature, min_length, repetition_penalty, presence,
+                spec_k, prompt, mesh_now, sk_flag)
+
         # ---- compiled decode: CHUNKED scan dispatch. Without eos, all
         # remaining tokens run in one device program; with eos, fixed-size
         # chunks with on-device finished-masking and a host early-exit
@@ -1462,7 +1764,7 @@ def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
                    max_seq_len=None, eos_token_id=None, do_sample=False,
                    top_k=0, top_p=1.0, temperature=1.0, use_rotary=False,
                    num_beams=1, length_penalty=1.0, min_length=0,
-                   repetition_penalty=1.0, prefix_cache=None):
+                   repetition_penalty=1.0, prefix_cache=None, spec_k=0):
     """One-shot driver over FusedDecoder (see class docstring)."""
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
@@ -1473,4 +1775,4 @@ def generate_fused(fmt, input_ids, embed, head, max_new_tokens=20,
                         length_penalty=length_penalty,
                         min_length=min_length,
                         repetition_penalty=repetition_penalty,
-                        prefix_cache=prefix_cache)
+                        prefix_cache=prefix_cache, spec_k=spec_k)

@@ -36,6 +36,18 @@ and only the uncached suffix runs through the chunked prefill scan; as
 prefill lands, the prompt's full `prefill_cap`-sized blocks are
 committed back to the pool (copy-out, dedup'd) so later shared-prompt
 requests hit. See prefix_cache.py for the radix store / COW invariants.
+
+Speculative decoding (`spec_k=` / `PADDLE_SERVING_SPEC_K`): a per-slot
+model-free n-gram drafter (spec_decode.py) proposes up to K tokens per
+step from the request's own context; ONE compiled K+1-position verify
+step (generation._build_verify_core) scores them all, and
+acceptance/rollback runs here as pure data over the returned logits —
+greedy outputs stay token-identical to spec off, sampled outputs keep
+the exact target distribution via rejection sampling. Slots with no
+usable draft ride along all-masked (the step degrades to a normal
+decode step for them), and a thin-draft scheduler heuristic falls back
+to the plain decode chunk — both executables are warm, so churn stays
+zero-retrace either way.
 """
 from __future__ import annotations
 
@@ -123,7 +135,9 @@ class ServingEngine:
     data: [B] arrays the compiled step reads, so they never retrace.
     repetition_penalty needs the [B, V] presence-mask carry; enable it
     at construction (`enable_repetition_penalty=True`) — the flag is
-    static trace structure.
+    static trace structure. `spec_k=K` turns on speculative decoding
+    (see the module docstring): K, like the sampling mode, is baked
+    into the ONE compiled verify step; drafts and acceptance are data.
     """
 
     def __init__(self, fmt, embed, head, num_slots, max_seq_len,
@@ -131,7 +145,7 @@ class ServingEngine:
                  decode_chunk=None, use_rotary=False,
                  enable_repetition_penalty=False, clock=None,
                  max_pending=None, prefill_cap=None,
-                 prefix_cache_blocks=0, prefix_cache=None):
+                 prefix_cache_blocks=0, prefix_cache=None, spec_k=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -176,6 +190,30 @@ class ServingEngine:
         self._prefill_tokens_computed = 0
         self._rep_on = bool(enable_repetition_penalty)
         self.clock = clock or time.perf_counter
+        # speculative decoding: K draft tokens per verify step (ONE
+        # compiled K+1-position executable replaces the decode chunk;
+        # slots with no usable draft ride in all-masked and degrade to
+        # a normal decode step). K is static trace structure — pow-2
+        # validated like prefill_cap; 0 disables (legacy decode path).
+        from .spec_decode import NGramDrafter, validate_spec_k
+        self.spec_k = validate_spec_k(
+            spec_k if spec_k is not None
+            else os.environ.get("PADDLE_SERVING_SPEC_K", "0"))
+        self._drafters = ([NGramDrafter(self.spec_k)
+                           for _ in range(int(num_slots))]
+                          if self.spec_k else None)
+        # dispatch heuristic: a verify step only beats `decode_chunk`
+        # plain steps when enough draft tokens ride along to amortize
+        # its K+1-position pass — below `spec_min_draft` average drafts
+        # per active slot the engine runs the (equally warm) decode
+        # chunk instead, so thin-draft phases never pay the verify
+        # premium. 0 = always verify when spec is on.
+        self._spec_min_draft = float(os.environ.get(
+            "PADDLE_SERVING_SPEC_MIN_DRAFT", "2"))
+        self._spec_rng = None            # lazy: sampled-mode acceptance
+        self._draft_proposed = 0
+        self._draft_accepted = 0
+        self._decode_steps = 0           # per-ROW sample events
 
         b = self.num_slots
         fmt.eval()
@@ -279,7 +317,8 @@ class ServingEngine:
         admitted = self._admit()
         emitted = len(admitted)
         if self._active.any():
-            emitted += self._decode_one_chunk()
+            emitted += (self._spec_decode_step() if self.spec_k
+                        else self._decode_one_chunk())
         dt = self.clock() - t0
         self._busy_s += dt
         self._tokens_emitted += emitted
@@ -311,6 +350,9 @@ class ServingEngine:
         self._prefix_misses = 0
         self._prefill_tokens_saved = 0
         self._prefill_tokens_computed = 0
+        self._draft_proposed = 0
+        self._draft_accepted = 0
+        self._decode_steps = 0
         if not keep_results:
             self.results = {}
 
@@ -328,9 +370,13 @@ class ServingEngine:
         m = {
             "tokens_emitted": self._tokens_emitted,
             "busy_s": round(self._busy_s, 4),
-            "tokens_per_sec": round(
-                self._tokens_emitted / self._busy_s, 2)
-            if self._busy_s else None,
+            # zero-elapsed guard: a frozen/coarse clock can leave
+            # busy_s == 0.0 with tokens already emitted (first-step
+            # metrics call) — report a throughput of 0.0, never divide
+            "tokens_per_sec": (
+                round(self._tokens_emitted / self._busy_s, 2)
+                if self._busy_s > 0
+                else (0.0 if self._tokens_emitted else None)),
             "requests_finished": len(done),
             "requests_admitted": self._admitted,
             "requests_rejected": self._rejected,
@@ -349,6 +395,21 @@ class ServingEngine:
                                 if looked else None),
             "prefill_tokens_saved": self._prefill_tokens_saved,
             "prefill_tokens_computed": self._prefill_tokens_computed,
+            # speculative-decoding window counters (spec_k=0 keeps
+            # proposed/accepted at 0 and tokens_per_step at exactly 1):
+            # decode_steps counts per-ROW sample events (the admit
+            # first-token sample + each decode/verify row-step), so
+            # tokens_emitted == decode_steps + draft_accepted always —
+            # the conftest reconciliation pins it
+            "decode_steps": self._decode_steps,
+            "draft_proposed": self._draft_proposed,
+            "draft_accepted": self._draft_accepted,
+            "acceptance_rate": (
+                round(self._draft_accepted / self._draft_proposed, 4)
+                if self._draft_proposed else None),
+            "tokens_per_step": (
+                round(self._tokens_emitted / self._decode_steps, 4)
+                if self._decode_steps else None),
         }
         if self.prefix_cache is not None:
             m["prefix_store"] = self.prefix_cache.store.stats()
@@ -672,6 +733,8 @@ class ServingEngine:
                             else int(r.eos_token_id))
             self._min_len[s] = r.min_length
             self._rep_pen[s] = r.repetition_penalty
+            if self._drafters is not None:
+                self._drafters[s].reset(r.prompt)
 
         sample = self._counted_jit(("admit_sample",),
                                    self._build_admit_sample)
@@ -682,6 +745,7 @@ class ServingEngine:
             self._presence_arg()))
 
         now = self.clock()
+        self._decode_steps += len(batch)     # one sample event per row
         for r in batch:
             s = r.slot
             tok0 = int(nxt[s])
@@ -689,6 +753,8 @@ class ServingEngine:
             r.tokens.append(tok0)
             self._nt[s] = 1
             self._tok[s] = tok0
+            if self._drafters is not None:
+                self._drafters[s].update([tok0])
             hit_eos = (r.eos_token_id is not None
                        and tok0 == int(r.eos_token_id))
             self._active[s] = not hit_eos and r.max_new_tokens > 1
@@ -735,10 +801,125 @@ class ServingEngine:
                 continue
             hits = emitted[:, s]
             req.tokens.extend(int(t) for t in toks[hits, s])
+            if self._drafters is not None:
+                # spec engines reach here through the thin-draft
+                # fallback: the drafter context must track every
+                # emitted token or later proposals go stale
+                self._drafters[s].update(toks[hits, s])
             n_emitted += int(hits.sum())
             if not still_active[s]:
                 self._finish(req, now)
         self._active = still_active
+        self._decode_steps += n_emitted      # 1 row-step per token here
+        return n_emitted
+
+    def _spec_decode_step(self):
+        """One speculative decode iteration over ALL slots: per-slot
+        n-gram draft proposals ride into ONE compiled K+1-position
+        verify step as pure data, and acceptance/rollback happen here
+        on the returned logits — greedy exact-match (token-identical to
+        the normal decode path) or rejection sampling with the
+        bonus-token resample. A slot's cache_lens advances by
+        accepted+1 only; rejected positions' K/V were write-masked or
+        are overwritten before ever becoming attendable
+        (write-then-attend at the advanced lens). Slots without a
+        usable draft ship dlen == 0 and degrade to a normal one-token
+        step inside the SAME executable — zero retraces across churn,
+        counted by the usual trace spy."""
+        from .spec_decode import (filtered_probs, greedy_accept,
+                                  rejection_sample, truncate_emitted)
+        k = self.spec_k
+        b = self.num_slots
+        stk = self.dec._stacked()
+        e_arrays = [p._data for p in self.dec._embed_params]
+        h_arrays = self.dec._maybe_quant_head(
+            [p._data for p in self.dec._head_params])
+        drafts = np.zeros((b, k), np.int32)
+        dlen = np.zeros(b, np.int32)
+        for s in range(b):
+            if not self._active[s]:
+                continue
+            d = self._drafters[s].propose()
+            # the bonus token always ships, so at most remaining-1
+            # drafts are useful — this cap also keeps every landed
+            # write inside the submit-time `prompt + max_new <= Smax`
+            # bound (lens + dlen <= prompt + max_nt - 1 < Smax)
+            m = min(int(d.size), int(self._max_nt[s] - self._nt[s]) - 1)
+            if m > 0:
+                drafts[s, :m] = d[:m]
+                dlen[s] = m
+        if int(dlen.sum()) < self._spec_min_draft * self._active.sum():
+            # thin-draft phase (cold contexts, non-repetitive spans):
+            # the plain decode chunk emits decode_chunk tokens/row per
+            # dispatch — cheaper than a near-empty verify step. Both
+            # executables are warm, so the switch is pure scheduling.
+            return self._decode_one_chunk()
+        toks = np.zeros((b, k + 1), np.int32)
+        toks[:, 0] = self._tok
+        toks[:, 1:] = drafts
+        fn = self._counted_jit(
+            ("verify", k),
+            lambda: self.dec._build_verify_core(
+                k, self._rep_on, greedy_out=not self.do_sample),
+            donate=(3,))
+        self._caches, out = fn(
+            stk, e_arrays, h_arrays, self._caches, jnp.asarray(toks),
+            jnp.asarray(self._lens), jnp.asarray(dlen),
+            jnp.asarray(self._active), jnp.asarray(self._nt),
+            jnp.asarray(self._eos), jnp.asarray(self._min_len),
+            jnp.asarray(self._rep_pen), self._presence_arg())
+        if self.do_sample:
+            logits = np.asarray(out).astype(np.float32)  # [B, K+1, V]
+            if self._spec_rng is None:
+                from .generation import _host_seed
+                self._spec_rng = np.random.RandomState(
+                    _host_seed(next_key()))
+        else:
+            # greedy: the step returns just the [B, K+1] argmax chain —
+            # the only thing exact-match acceptance reads
+            argmax = np.asarray(out)
+        n_emitted = 0
+        now = self.clock()
+        new_rows, new_cols = [], []
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if req is None or not self._active[s]:
+                continue
+            m = int(dlen[s])
+            if self.do_sample:
+                probs = filtered_probs(logits[s, :m + 1], self.top_k,
+                                       self.top_p, self.temperature)
+                kept, _ = rejection_sample(drafts[s, :m], probs,
+                                           self._spec_rng)
+            else:
+                kept, _ = greedy_accept(drafts[s, :m],
+                                        argmax[s, :m + 1])
+            eos = None if self._eos[s] < 0 else int(self._eos[s])
+            emitted, hit_eos = truncate_emitted(
+                kept, int(self._max_nt[s] - self._nt[s]), eos)
+            self._nt[s] += len(emitted)
+            req.tokens.extend(emitted)
+            n_emitted += len(emitted)
+            self._lens[s] += len(emitted)
+            self._tok[s] = emitted[-1]
+            # per-row accounting: 1 verify row-step emitted
+            # len(emitted) tokens, len(emitted)-1 of them drafts —
+            # tokens == steps + accepted reconciles by construction
+            self._decode_steps += 1
+            self._draft_proposed += m
+            self._draft_accepted += len(emitted) - 1
+            self._drafters[s].update(emitted)
+            if self._rep_on:
+                new_rows.extend([s] * len(emitted))
+                new_cols.extend(emitted)
+            if hit_eos or self._nt[s] >= self._max_nt[s]:
+                self._active[s] = False
+                self._finish(req, now)
+        if self._rep_on and new_rows:
+            # rollback is structural: the verify step's speculative
+            # presence carry was DISCARDED — only accepted tokens join
+            self._presence = self._presence.at[
+                jnp.asarray(new_rows), jnp.asarray(new_cols)].set(True)
         return n_emitted
 
     def _expire_deadlines(self, now):

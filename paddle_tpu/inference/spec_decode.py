@@ -1,0 +1,212 @@
+"""Speculative decoding: model-free n-gram drafting + acceptance math.
+
+The drafter is prompt-lookup / n-gram speculation (Saxena, "Prompt
+Lookup Decoding", 2023): the cheapest possible draft model is the
+request's OWN context — summarization, RAG, code-edit and chatty
+multi-turn workloads copy long spans of their input (or of their own
+earlier output), so the continuation of the most recent earlier
+occurrence of the current suffix n-gram is a strong K-token guess. It
+is pure host data (a suffix map over prompt + generated tokens,
+updated incrementally on accept), which makes it a perfect fit for the
+zero-retrace serving engine: proposals ride into the ONE compiled
+verify step as `[B, K]` arrays, and a slot with no usable draft simply
+ships an all-masked draft (the step degrades to a normal decode step).
+
+Acceptance is the standard speculative-sampling rule (Leviathan et
+al., "Fast Inference from Transformers via Speculative Decoding",
+2023), specialized for a DETERMINISTIC drafter (q is a point mass on
+the drafted token):
+
+  * greedy: exact-match — accept the longest draft prefix that equals
+    the verify step's argmax chain, then emit the first disagreeing
+    argmax as the bonus token (token-identical to spec-off greedy by
+    construction);
+  * sampled: accept draft d_j with probability min(1, p_j(d_j)); on
+    the first rejection resample from the residual max(p - q, 0)
+    renormalized (= p with d_j zeroed); if every draft survives, the
+    bonus token samples from the last position's p. With a point-mass
+    q the emitted marginal is EXACTLY p at every position — enabling
+    speculation never changes the output distribution.
+
+Host-side numpy throughout — acceptance/rollback is pure data over the
+verify step's returned logits; nothing here traces.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NGramDrafter", "greedy_accept", "rejection_sample",
+           "filtered_probs", "truncate_emitted", "validate_spec_k"]
+
+
+def truncate_emitted(kept, remaining, eos):
+    """Apply a row's emission limits to an accepted-token chain: stop
+    at the row's eos or after `remaining` tokens (its max_new budget).
+    Returns (emitted, hit_eos). ONE owner for the truncation contract —
+    the serving engine and the oneshot generate() drive both walk
+    accepted tokens through this, so greedy on/off parity and the
+    `tokens == decode_steps + draft_accepted` reconciliation cannot
+    drift between them."""
+    emitted = []
+    hit_eos = False
+    for t in kept:
+        emitted.append(int(t))
+        if eos is not None and int(t) == eos:
+            hit_eos = True
+            break
+        if len(emitted) >= remaining:
+            break
+    return emitted, hit_eos
+
+
+def validate_spec_k(k):
+    """K is static trace structure (the verify step runs K+1 positions),
+    so it is validated like `prefill_cap`: a power of two keeps the
+    compiled-executable set bounded and predictable. 0 disables."""
+    k = int(k)
+    if k < 0 or (k and k & (k - 1)):
+        raise ValueError(
+            f"spec_k must be 0 (disabled) or a power of two, got {k} "
+            "(K is baked into the ONE compiled verify step — the pow-2 "
+            "rule keeps the executable set bounded, like prefill_cap)")
+    return k
+
+
+class NGramDrafter:
+    """Suffix map over one request's context (prompt + generated).
+
+    `maps[n]` stores, for every n-gram in the context THAT HAS a
+    continuation, the start index of its most recent occurrence — an
+    n-gram ending at position i-1 is inserted when token i lands, so a
+    lookup can never match the context's own tail (which has nothing
+    after it to propose). propose() scans n from `max_ngram` down to
+    `min_ngram` (longest-match-first, the standard prompt-lookup order)
+    and returns up to K continuation tokens of the first hit; no match
+    returns an empty proposal. update() appends accepted tokens and
+    extends the maps incrementally — O(accepted * ngrams) per step,
+    never a rescan."""
+
+    def __init__(self, k, max_ngram=3, min_ngram=1):
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"NGramDrafter needs k >= 1, got {k}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        if not 1 <= self.min_ngram <= self.max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}..{max_ngram}")
+        self._toks = []
+        self._maps = {}
+        self.reset(())
+
+    def reset(self, prompt):
+        """Start a fresh context (slot re-admission): rebuild the suffix
+        map over the new prompt."""
+        self._toks = []
+        self._maps = {n: {} for n in
+                      range(self.min_ngram, self.max_ngram + 1)}
+        self.update(prompt)
+
+    def update(self, accepted):
+        """Append accepted tokens; every n-gram that just GAINED a
+        continuation (it ends right before a newly landed token) is
+        (re-)indexed at its start."""
+        toks = self._toks
+        for t in accepted:
+            i = len(toks)              # index the new token will take
+            toks.append(int(t))
+            for n, m in self._maps.items():
+                j = i - n              # n-gram ending at i-1
+                if j >= 0:
+                    m[tuple(toks[j:i])] = j
+
+    def propose(self):
+        """Up to K draft tokens continuing the most recent earlier
+        occurrence of the context's suffix; empty when no n-gram
+        matches (the caller ships an all-masked draft)."""
+        toks = self._toks
+        length = len(toks)
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if length < n:
+                continue
+            j = self._maps[n].get(tuple(toks[-n:]))
+            if j is None:
+                continue
+            # j + n < length by construction (only n-grams with a
+            # continuation are indexed), so there is >= 1 draft token
+            return np.asarray(toks[j + n: j + n + self.k], np.int32)
+        return np.zeros((0,), np.int32)
+
+    @property
+    def context_len(self):
+        return len(self._toks)
+
+
+def greedy_accept(draft, greedy_tokens):
+    """Greedy exact-match acceptance. draft: [m] proposed tokens;
+    greedy_tokens: [>= m+1] the verify step's argmax at positions
+    0..m (position j's argmax is the model's token AFTER consuming
+    draft tokens 1..j). Returns (tokens_out, n_accepted): the accepted
+    draft prefix plus the first disagreeing argmax as the bonus token —
+    exactly the chain sequential greedy decode would emit."""
+    draft = np.asarray(draft)
+    a = 0
+    while a < draft.size and int(draft[a]) == int(greedy_tokens[a]):
+        a += 1
+    return [int(t) for t in draft[:a]] + [int(greedy_tokens[a])], a
+
+
+def filtered_probs(logits, top_k=0, top_p=1.0, temperature=1.0):
+    """Numpy mirror of generation._filter_logits + softmax: temperature
+    scale, top-k floor, nucleus cutoff — the target distributions p_j
+    the rejection sampler accepts against. logits: [P, V] -> [P, V]
+    float64 probabilities (rows sum to 1)."""
+    lg = np.asarray(logits, np.float64) / max(float(temperature), 1e-6)
+    if top_k and top_k > 0:
+        kth = np.sort(lg, axis=-1)[:, -top_k][:, None]
+        lg = np.where(lg < kth, -1e30, lg)
+    if top_p and top_p < 1.0:
+        srt = np.sort(lg, axis=-1)[:, ::-1]
+        e = np.exp(srt - srt.max(-1, keepdims=True))
+        cum = np.cumsum(e / e.sum(-1, keepdims=True), axis=-1)
+        cutoff_idx = np.sum(cum < top_p, axis=-1, keepdims=True)
+        kth = np.take_along_axis(srt, cutoff_idx, axis=-1)
+        lg = np.where(lg < kth, -1e30, lg)
+    e = np.exp(lg - lg.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def rejection_sample(draft, probs, rng):
+    """Speculative rejection sampling for a point-mass drafter. draft:
+    [m] proposed tokens; probs: [m+1, V] target distributions (position
+    j's p is conditioned on the draft tokens before it); rng: a
+    np.random.RandomState. Returns (tokens_out, n_accepted).
+
+    Accept d_j w.p. p_j(d_j); first rejection resamples from the
+    residual (p_j with d_j zeroed, renormalized — max(p - q, 0) for a
+    point-mass q) and stops; all-accepted samples the bonus token from
+    p_m. Emitted marginal per position is exactly p_j: accept
+    contributes p(d) at d, reject contributes (1-p(d)) * p(x)/(1-p(d))
+    everywhere else."""
+    probs = np.asarray(probs, np.float64)
+    out = []
+    for j, d in enumerate(np.asarray(draft, np.int64)):
+        p = probs[j]
+        if rng.uniform() < p[d]:
+            out.append(int(d))
+            continue
+        r = p.copy()
+        r[d] = 0.0
+        s = float(r.sum())
+        if s <= 0.0:
+            # p IS the point mass on d (filtered to one token): the
+            # accept branch has probability 1 up to float round-off
+            out.append(int(d))
+        else:
+            out.append(int(rng.choice(r.size, p=r / s)))
+        return out, j
+    m = len(out)
+    p = probs[m]
+    out.append(int(rng.choice(p.size, p=p / p.sum())))
+    return out, m

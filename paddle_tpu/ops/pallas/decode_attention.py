@@ -16,12 +16,17 @@ positions cache_lens..cache_lens+Sq-1 attend causally among themselves and
 fully to the cache prefix. Forward-only (inference).
 
 The `cache_lens < Smax` invariant (write kernels clamp a full row's write
-to a drop) has THREE clients: the serving engine's eviction-as-data slot
-reuse, the submit-time `prompt + max_new_tokens <= Smax` bound, and the
+to a drop) has FOUR clients: the serving engine's eviction-as-data slot
+reuse, the submit-time `prompt + max_new_tokens <= Smax` bound, the
 prefix cache's block-granular adopt copy (inference/prefix_cache.py) —
 adopted block writes land at positions < plen <= Smax - max_new_tokens
 with the pow-2 ladder tail masked out of bounds and dropped, so a
-block-granular splat can never push a row to (or past) Smax either.
+block-granular splat can never push a row to (or past) Smax either —
+and the speculative-decoding verify step (inference/spec_decode.py +
+generation._build_verify_core): its K+1 block writes at positions
+lens..lens+K are per-position masked to `lens + j < Smax` (masked
+positions scatter out of bounds and drop), and drafting caps K at the
+row's remaining budget, so lens + dlen <= prompt + max_new - 1 < Smax.
 """
 from __future__ import annotations
 

@@ -79,6 +79,22 @@ def check_serving_metrics(eng):
         assert m["prefill_tokens_computed"] == 0
     if m["prefix_hit_rate"] is not None:
         assert 0.0 <= m["prefix_hit_rate"] <= 1.0
+    # speculative-decoding reconciliation: a draft token can only be
+    # accepted after being proposed, and every emitted token is either
+    # one per-row sample event (admit/decode/verify step) or an
+    # accepted draft riding a verify step — the engine counts them so
+    # this holds in greedy AND sampled mode, spec on or off
+    assert 0 <= m["draft_accepted"] <= m["draft_proposed"]
+    assert m["tokens_emitted"] == m["decode_steps"] + \
+        m["draft_accepted"], (
+        f"token accounting broke: tokens={m['tokens_emitted']} != "
+        f"steps={m['decode_steps']} + accepted={m['draft_accepted']}")
+    if m["acceptance_rate"] is not None:
+        assert 0.0 <= m["acceptance_rate"] <= 1.0
+    if m["tokens_per_step"] is not None:
+        assert m["tokens_per_step"] >= 1.0
+    if getattr(eng, "spec_k", 0) == 0:
+        assert m["draft_proposed"] == 0 and m["draft_accepted"] == 0
     if m["tokens_emitted"]:
         assert m["busy_s"] > 0 and m["tokens_per_sec"] > 0
     return m

@@ -152,6 +152,27 @@ class TestServingChurn:
         rid = eng.submit(np.ones(4, np.int32), max_new_tokens=124)
         assert rid == 0
 
+    def test_tokens_per_sec_zero_elapsed_guard(self):
+        """A frozen clock leaves busy_s == 0.0 with tokens already
+        emitted (e.g. a metrics() call after the first step under a
+        coarse virtual clock): tokens_per_sec must read 0.0 — never a
+        ZeroDivisionError, and never None once tokens exist."""
+        fmt, embed, head = _model(seed=15)
+        rng = np.random.RandomState(6)
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128, decode_chunk=2,
+                            clock=lambda: 0.0)
+        eng.submit(_prompt(rng, 4), max_new_tokens=3)
+        eng.step()
+        m = eng.metrics()
+        assert m["tokens_emitted"] > 0
+        assert m["busy_s"] == 0.0
+        assert m["tokens_per_sec"] == 0.0
+        # a truly idle engine still reports None (nothing to rate)
+        fresh = ServingEngine(fmt, embed, head, num_slots=1,
+                              max_seq_len=128)
+        assert fresh.metrics()["tokens_per_sec"] is None
+
     def test_metrics_surface(self):
         fmt, embed, head = _model(seed=13)
         rng = np.random.RandomState(4)
@@ -498,3 +519,30 @@ class TestServingBench:
         # ~1.4x tokens/s and ~2x better TTFT p50; 12 requests here)
         assert rec["value"] > 1.1
         assert rec["ttft_p50_ms_on"] < rec["ttft_p50_ms_off"]
+
+    def test_bench_spec_decode_sweep(self, monkeypatch, capsys,
+                                     tmp_path):
+        """The speculative-decoding A/B (n-gram drafter + verify step
+        on vs off at equal compiled shape, SAME arrivals). Slow-marked
+        like the other sweeps: tier-1 covers spec decoding through
+        tests/test_spec_decode.py; this drives the full bench and its
+        acceptance gates (speedup, acceptance rate, no retraces). The
+        output redirects to tmp so CI can't clobber the committed
+        record."""
+        import json
+        import bench_serving
+        monkeypatch.setattr(bench_serving, "__file__",
+                            str(tmp_path / "bench_serving.py"))
+        monkeypatch.setenv("BENCH_SERVE_REQUESTS", "12")
+        rc = bench_serving.main(["--spec"])
+        assert rc == 0
+        rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["retraces_after_warmup"] == 0
+        assert rec["retraces_after_warmup_off"] == 0
+        assert rec["draft_accepted"] > 0
+        assert rec["acceptance_rate"] > 0.5
+        assert rec["tokens_per_step"] > 1.2
+        # timing-dependent with margin below the >= 1.2x the full
+        # fixed-seed bench shows (12 requests here, CI jitter)
+        assert rec["value"] > 1.05

@@ -1,0 +1,290 @@
+"""Speculative decoding: n-gram drafter + compiled K+1 verify step.
+
+Contracts under test:
+  * NGramDrafter: suffix-map proposals continue the most recent earlier
+    occurrence of the context suffix, cap at K, update incrementally on
+    accept, and return empty on no match;
+  * acceptance math: greedy exact-match reproduces sequential greedy by
+    construction, and rejection sampling with a point-mass drafter
+    emits EXACTLY the target distribution (bonus-token resample
+    included);
+  * the engine: greedy outputs with spec on are token-identical to
+    spec off under admission/eviction churn, with prefix caching on
+    AND off; zero retraces after warmup; K is pow-2 validated;
+  * oneshot FusedDecoder.generate(spec_k=) reuses the same machinery
+    and composes with prefix_cache=.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference.generation import FusedDecoder
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.spec_decode import (NGramDrafter, filtered_probs,
+                                              greedy_accept,
+                                              rejection_sample,
+                                              validate_spec_k)
+from paddle_tpu.nn.layer.common import Embedding, Linear
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+
+
+def _model(seed=3):
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return fmt, embed, head
+
+
+def _prompt(rng, n):
+    return rng.randint(1, V, (n,)).astype(np.int32)
+
+
+class TestNGramDrafter:
+    def test_proposes_continuation_of_latest_match(self):
+        d = NGramDrafter(4)
+        d.reset([1, 2, 3, 9, 9, 1, 2, 3, 7, 8, 5, 1, 2, 3])
+        # suffix (1, 2, 3) last occurred (with a continuation) at index
+        # 5 -> propose what followed THERE: 7, 8, 5, 1
+        np.testing.assert_array_equal(d.propose(), [7, 8, 5, 1])
+
+    def test_cap_at_k_and_short_tail(self):
+        d = NGramDrafter(2)
+        d.reset([1, 2, 3, 9, 9, 1, 2, 3, 7, 8, 5, 1, 2, 3])
+        np.testing.assert_array_equal(d.propose(), [7, 8])      # capped
+        d2 = NGramDrafter(8)
+        d2.reset([4, 4, 5, 6, 4, 4])
+        # match at index 0, only 2 continuation tokens exist
+        np.testing.assert_array_equal(d2.propose(), [5, 6, 4, 4])
+
+    def test_no_match_returns_empty(self):
+        d = NGramDrafter(4)
+        d.reset([1, 2, 3, 4, 5, 6])                 # no repeats
+        assert d.propose().size == 0
+        d.reset([])
+        assert d.propose().size == 0
+
+    def test_update_on_accept_extends_the_map(self):
+        d = NGramDrafter(4, max_ngram=2)
+        d.reset([5, 6, 7])
+        assert d.propose().size == 0
+        d.update([5, 6])                            # context: 5 6 7 5 6
+        # suffix (5, 6) matched at 0, continuation 7 5 6
+        np.testing.assert_array_equal(d.propose(), [7, 5, 6])
+        assert d.context_len == 5
+        # no 2-gram match falls back to the 1-gram map: suffix (5,)
+        # last occurred with a continuation at index 3 -> 6, 8, 5
+        d.update([8, 5])
+        np.testing.assert_array_equal(d.propose(), [6, 8, 5])
+
+    def test_reset_drops_the_old_context(self):
+        d = NGramDrafter(4)
+        d.reset([1, 2, 1, 2])
+        assert d.propose().size > 0
+        d.reset([3, 4, 5])
+        assert d.propose().size == 0
+
+    def test_validate_spec_k(self):
+        assert validate_spec_k(0) == 0
+        assert validate_spec_k(4) == 4
+        assert validate_spec_k("8") == 8
+        for bad in (3, 5, 6, -1):
+            with pytest.raises(ValueError, match="power of two"):
+                validate_spec_k(bad)
+
+
+class TestAcceptanceMath:
+    def test_greedy_accept(self):
+        toks, a = greedy_accept([7, 8, 9], [7, 8, 5, 1])
+        assert (toks, a) == ([7, 8, 5], 2)          # 2 accepted + bonus
+        toks, a = greedy_accept([], [4])
+        assert (toks, a) == ([4], 0)                # no draft: pure step
+        toks, a = greedy_accept([7, 8], [7, 8, 3])
+        assert (toks, a) == ([7, 8, 3], 2)          # full accept + bonus
+
+    def test_rejection_sampling_matches_target_distribution(self):
+        """Point-mass drafter rejection sampling is distribution-exact:
+        P(emit d) = p(d) on the accept branch, and the residual
+        resample spreads the rest as p(x) / (1 - p(d)) * (1 - p(d)) —
+        the first emitted token's marginal is exactly p regardless of
+        what the drafter proposed."""
+        rng = np.random.RandomState(0)
+        p = np.array([[0.5, 0.3, 0.15, 0.05],
+                      [0.25, 0.25, 0.25, 0.25]])
+        n = 20000
+        counts = np.zeros(4)
+        for _ in range(n):
+            out, _ = rejection_sample([1], p, rng)   # draft token 1
+            counts[out[0]] += 1
+        np.testing.assert_allclose(counts / n, p[0], atol=0.02)
+
+    def test_rejection_sampling_bonus_token(self):
+        """All-accepted drafts get a bonus token drawn from the LAST
+        position's distribution."""
+        rng = np.random.RandomState(1)
+        p = np.array([[0.0, 1.0, 0.0, 0.0],         # always accepts d=1
+                      [0.0, 0.0, 1.0, 0.0]])        # bonus must be 2
+        out, acc = rejection_sample([1], p, rng)
+        assert out == [1, 2] and acc == 1
+
+    def test_filtered_probs_top_k(self):
+        logits = np.array([[1.0, 2.0, 3.0, 4.0]])
+        probs = filtered_probs(logits, top_k=2)
+        assert probs[0, 0] == 0.0 and probs[0, 1] == 0.0
+        assert abs(probs.sum() - 1.0) < 1e-12
+        assert probs[0, 3] > probs[0, 2] > 0
+
+
+class TestServingSpec:
+    def _repetitive_reqs(self, rng, n=8):
+        """Echo-shaped prompts + generations long enough for the tiny
+        model's greedy output to settle into its repeating attractor —
+        the regime the drafter feeds on (mirrors the --spec bench)."""
+        cores = [_prompt(rng, 4 + j) for j in range(3)]
+        reqs = []
+        for i in range(n):
+            # shared cores: repeats also exercise the prefix cache
+            reqs.append((np.tile(cores[i % 3], 2), 16 + 4 * (i % 3)))
+        return reqs
+
+    @pytest.mark.parametrize("cache_blocks", [0, 8])
+    def test_greedy_on_off_parity_across_churn(self, cache_blocks,
+                                               serving_metrics_ok):
+        """Enabling speculation must never change greedy outputs — slots
+        churn through 2 slots, with prefix caching both off and on."""
+        fmt, embed, head = _model(seed=41)
+        rng = np.random.RandomState(7)
+        reqs = self._repetitive_reqs(rng)
+
+        def run(spec):
+            eng = ServingEngine(fmt, embed, head, num_slots=2,
+                                max_seq_len=128, decode_chunk=2,
+                                prefill_cap=4,
+                                prefix_cache_blocks=cache_blocks,
+                                spec_k=spec)
+            rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+            eng.run()
+            return eng, [eng.results[r]["tokens"] for r in rids]
+
+        eng_on, toks_on = run(4)
+        eng_off, toks_off = run(0)
+        for a, b in zip(toks_on, toks_off):
+            np.testing.assert_array_equal(a, b)
+        m = serving_metrics_ok(eng_on)
+        serving_metrics_ok(eng_off)
+        assert m["draft_proposed"] > 0
+        assert m["draft_accepted"] > 0          # speculation really fired
+        assert m["tokens_per_step"] > 1.0
+        if cache_blocks:
+            assert m["prefix_hits"] > 0         # ... and composed
+
+    def test_sampled_mode_runs_and_reconciles(self, serving_metrics_ok):
+        """Sampled spec decoding (rejection sampling) keeps the token
+        accounting exact; outputs are not required to match spec-off
+        (different RNG consumption), just to be well-formed."""
+        fmt, embed, head = _model(seed=42)
+        rng = np.random.RandomState(8)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2, spec_k=4,
+                            do_sample=True, top_k=5)
+        rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in self._repetitive_reqs(rng, n=4)]
+        eng.run()
+        m = serving_metrics_ok(eng)
+        for (p, mx), rid in zip(self._repetitive_reqs(
+                np.random.RandomState(8), n=4), rids):
+            assert eng.results[rid]["tokens"].size == mx
+        assert m["draft_proposed"] > 0
+
+    def test_zero_retraces_after_warmup(self, serving_metrics_ok):
+        """Draft length / acceptance patterns are pure data: once the
+        warmup stream has compiled the executable set (verify step AND
+        the thin-draft chunk fallback), an identical churn stream must
+        not trace anything new."""
+        fmt, embed, head = _model(seed=43)
+        rng = np.random.RandomState(9)
+        reqs = self._repetitive_reqs(rng)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2, spec_k=4)
+        for p, m in reqs:
+            eng.submit(p, max_new_tokens=m)
+        eng.run()
+        warm = eng.metrics()["traces"]
+        assert warm > 0
+        for p, m in reqs:                       # same stream again
+            eng.submit(p, max_new_tokens=m)
+        eng.run()
+        m = serving_metrics_ok(eng)
+        assert m["traces"] == warm, (
+            f"spec churn retraced: {warm} -> {m['traces']}")
+
+    def test_spec_k_validation(self, monkeypatch):
+        fmt, embed, head = _model(seed=44)
+        with pytest.raises(ValueError, match="power of two"):
+            ServingEngine(fmt, embed, head, num_slots=1,
+                          max_seq_len=128, spec_k=3)
+        monkeypatch.setenv("PADDLE_SERVING_SPEC_K", "5")
+        with pytest.raises(ValueError, match="power of two"):
+            ServingEngine(fmt, embed, head, num_slots=1, max_seq_len=128)
+        monkeypatch.setenv("PADDLE_SERVING_SPEC_K", "2")
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128)
+        assert eng.spec_k == 2 and eng._drafters is not None
+        # explicit arg wins over env; 0 disables
+        eng2 = ServingEngine(fmt, embed, head, num_slots=1,
+                             max_seq_len=128, spec_k=0)
+        assert eng2.spec_k == 0 and eng2._drafters is None
+
+
+class TestOneshotSpec:
+    def test_generate_spec_parity_and_prefix_cache(self):
+        """FusedDecoder.generate(spec_k=) is token-identical to the
+        chunked-scan decode for greedy, with and without a shared
+        PrefixCache (the same drafter + verify core as the engine)."""
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+        fmt, embed, head = _model(seed=45)
+        rng = np.random.RandomState(10)
+        core = _prompt(rng, 6)
+        ids = np.stack([np.tile(core, 2),
+                        np.tile(_prompt(rng, 6), 2)])
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=128)
+        base = np.asarray(dec.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=24)._data)
+        spec = np.asarray(dec.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=24,
+                                       spec_k=4)._data)
+        np.testing.assert_array_equal(base, spec)
+        pc = PrefixCache(16, 4)
+        both = np.asarray(dec.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=24, spec_k=4,
+                                       prefix_cache=pc)._data)
+        np.testing.assert_array_equal(base, both)
+
+    def test_generate_spec_eos_parity(self):
+        fmt, embed, head = _model(seed=46)
+        rng = np.random.RandomState(11)
+        ids = np.stack([np.tile(_prompt(rng, 5), 2) for _ in range(2)])
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=128)
+        base = np.asarray(dec.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=20,
+                                       eos_token_id=7)._data)
+        spec = np.asarray(dec.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=20,
+                                       eos_token_id=7, spec_k=2)._data)
+        np.testing.assert_array_equal(base, spec)
+
+    def test_generate_spec_k_validation(self):
+        fmt, embed, head = _model(seed=47)
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=128)
+        ids = np.ones((1, 4), np.int32)
+        with pytest.raises(ValueError, match="power of two"):
+            dec.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                         spec_k=3)
+        with pytest.raises(ValueError, match="beam"):
+            dec.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                         spec_k=2, num_beams=2)
