@@ -25,7 +25,8 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
            "PrecisionType", "ServingEngine", "ServedRequest",
-           "AdmissionFull", "PrefixCache", "PrefixStore", "NGramDrafter"]
+           "AdmissionFull", "PrefixCache", "PrefixStore", "NGramDrafter",
+           "BlockPool", "PagedPrefixCache", "PagedPrefixStore"]
 
 
 def __getattr__(name):
@@ -37,6 +38,9 @@ def __getattr__(name):
     if name in ("PrefixCache", "PrefixStore"):
         from . import prefix_cache
         return getattr(prefix_cache, name)
+    if name in ("BlockPool", "PagedPrefixCache", "PagedPrefixStore"):
+        from . import paged_kv
+        return getattr(paged_kv, name)
     if name == "NGramDrafter":
         from . import spec_decode
         return spec_decode.NGramDrafter

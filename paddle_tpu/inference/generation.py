@@ -428,6 +428,29 @@ class FusedDecoder:
                               jnp.float32))
         return jnp.zeros(shape, dtype)
 
+    def init_paged_cache(self, pool, dtype=None):
+        """Device arrays for a paged_kv.BlockPool: the ONE kv pool
+        {"kv": [L, 2, NB, H, Bt, D]} (+ {"sc": [L, 2, NB, H, 1, Bt]}
+        mirrored int8 scales in cache-quant mode). The caller (the
+        serving engine) adds the per-slot block tables as "tbl" per
+        dispatch — tables are host state, rebuilt from numpy each call,
+        while the pool arrays ride donation like the dense cache."""
+        f = self.fmt
+        dtype = dtype or self.fmt.qkv_weights[0]._data.dtype
+        if getattr(pool, "smax", self.smax) != self.smax:
+            raise ValueError(
+                f"BlockPool was sized for max_seq_len={pool.smax} but "
+                f"this decoder's ring capacity is Smax={self.smax} — "
+                "the block table has Smax/Bt entries, the two must "
+                "agree")
+        shape = (f.num_layers, 2, pool.num_blocks, f.num_heads,
+                 pool.block_tokens, f.head_dim)
+        if self._int8_cache():
+            return {"kv": jnp.zeros(shape, jnp.int8),
+                    "sc": jnp.zeros(shape[:4] + (1, pool.block_tokens),
+                                    jnp.float32)}
+        return {"kv": jnp.zeros(shape, dtype)}
+
     # ------------------------------------------------------------ the step
     def _mesh_mp(self):
         from ..parallel import current_mesh
@@ -757,18 +780,76 @@ class FusedDecoder:
             # q: [B, Sq, H, D] (Sq == 1 for the classic decode step; the
             # spec-decode verify step passes the whole K+1 block);
             # caches: [L, 2, B, H, Smax, D] (full stack — the kernel
-            # addresses layer l via scalar prefetch, zero-copy) or (int8
-            # stack, fp32 scales) in cache-quant mode. t: scalar OR [B]
-            # per-row BASE positions — query row j attends cache
-            # positions <= t + j (the stacked kernels' native block-
-            # causal semantics: "new tokens attend causally among
-            # themselves and fully to the prefix"; the dense fallback
-            # builds the same mask per row).
+            # addresses layer l via scalar prefetch, zero-copy), (int8
+            # stack, fp32 scales) in cache-quant mode, or the PAGED dict
+            # {"kv": [L, 2, NB, H, Bt, D](, "sc"), "tbl": [B, Smax/Bt]}
+            # — one block pool, per-slot block tables (paged_kv.py).
+            # t: scalar OR [B] per-row BASE positions — query row j
+            # attends cache positions <= t + j (the stacked kernels'
+            # native block-causal semantics: "new tokens attend causally
+            # among themselves and fully to the prefix"; the dense
+            # fallback builds the same mask per row).
             sq = q.shape[1]
             qt = jnp.swapaxes(q, 1, 2)                  # [B, H, Sq, D]
             tb = jnp.broadcast_to(jnp.asarray(t).astype(jnp.int32),
                                   (q.shape[0],))
-            quant = isinstance(caches, tuple)
+            paged = isinstance(caches, dict)
+            quant = isinstance(caches, tuple) or (paged and
+                                                  "sc" in caches)
+            if paged:
+                pool_kv, tbl = caches["kv"], caches["tbl"]
+                nb = pool_kv.shape[2]
+                # the paged kernel gathers K/V through the block table
+                # (table rides as scalar prefetch — block ids are data);
+                # the pool never shards, so the mesh path stays dense
+                if (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
+                        != "0" and mesh is None):
+                    from ..ops.pallas.decode_attention import (
+                        decode_attention_paged, decode_attention_paged_i8,
+                        paged_i8_is_supported, paged_is_supported)
+                    if quant and paged_i8_is_supported(
+                            (q.shape[0], sq, nh, hd), pool_kv.shape,
+                            q.dtype):
+                        o = decode_attention_paged_i8(
+                            qt, pool_kv, caches["sc"], tbl, l, tb)
+                        return jnp.swapaxes(o, 1, 2)
+                    if not quant and paged_is_supported(
+                            (q.shape[0], sq, nh, hd), pool_kv.shape,
+                            q.dtype, cache_dtype=pool_kv.dtype):
+                        o = decode_attention_paged(qt, pool_kv, tbl, l,
+                                                   tb)
+                        return jnp.swapaxes(o, 1, 2)
+                # gather-through-table dense fallback: materialize the
+                # row view [2, B, H, Smax, D] from the pool (sentinel
+                # entries clamp to an arbitrary block — their positions
+                # are >= the row's lens and masked below, exactly like
+                # the dense path's stale ring positions)
+                pool_l = jax.lax.dynamic_index_in_dim(pool_kv, l, 0,
+                                                      keepdims=False)
+                tc = jnp.minimum(tbl, nb - 1)
+                kvg = jnp.take(pool_l, tc, axis=1)  # [2, B, Nblk, H, Bt, D]
+                kvg = jnp.transpose(kvg, (0, 1, 3, 2, 4, 5)).reshape(
+                    2, tbl.shape[0], nh, smax, hd)
+                if quant:
+                    sc_l = jax.lax.dynamic_index_in_dim(
+                        caches["sc"], l, 0, keepdims=False)
+                    scg = jnp.take(sc_l, tc, axis=1)  # [2,B,Nblk,H,1,Bt]
+                    scg = jnp.transpose(scg, (0, 1, 3, 4, 2, 5)).reshape(
+                        2, tbl.shape[0], nh, 1, smax)
+                    cache = kvg.astype(jnp.float32) * jnp.swapaxes(
+                        scg, -1, -2)
+                else:
+                    cache = kvg
+                s = jnp.einsum("bhqd,bhsd->bhqs", qt.astype(jnp.float32),
+                               cache[0].astype(jnp.float32)) * (hd ** -0.5)
+                mask = (jnp.arange(smax)[None, None, None, :]
+                        <= (tb[:, None, None, None]
+                            + jnp.arange(sq)[None, None, :, None]))
+                s = jnp.where(mask, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhqs,bhsd->bhqd", p,
+                               cache[1].astype(jnp.float32))
+                return jnp.swapaxes(o, 1, 2).astype(q.dtype)
             # escape hatch: PADDLE_TPU_STACKED_KERNEL=0 forces the dense
             # path — the stacked kernels' first on-chip Mosaic compile
             # happens inside a driver bench window; a compile failure
@@ -910,6 +991,56 @@ class FusedDecoder:
                 tv = jnp.where(write_mask, tv, smax)
             return tv
 
+        def _paged_blk_off(tbl, tv, nb):
+            # resolve positions tv ([B] or [B, Sq]) through the block
+            # table: OOB positions (== smax, the write-mask discipline)
+            # and unmapped sentinel entries both land on block `nb` —
+            # out of bounds for the pool's block axis, so the scatter
+            # with mode="drop" skips them. This is the FIFTH client of
+            # the decode_attention `cache_lens < Smax` clamp inventory.
+            bt = smax // tbl.shape[1]
+            nblk = tbl.shape[1]
+            ji = tv // bt
+            safe = ji < nblk
+            jc = jnp.minimum(ji, nblk - 1)
+            if tv.ndim == 1:
+                blk = jnp.take_along_axis(tbl, jc[:, None], axis=1)[:, 0]
+            else:
+                blk = jnp.take_along_axis(tbl, jc, axis=1)
+            return jnp.where(safe, blk, nb), tv % bt
+
+        def paged_write(caches, l, tv, kv_new):
+            # scatter the new K/V rows through the block table. kv_new:
+            # [2, B, H, Sq, D]; tv: [B] (Sq == 1, per-token step) or
+            # [B, Sq] (spec-verify block). Same value layouts as the
+            # dense per-row scatters, with (block, offset) replacing
+            # the ring position.
+            pool_kv, tbl = caches["kv"], caches["tbl"]
+            nb = pool_kv.shape[2]
+            blk, off = _paged_blk_off(tbl, tv, nb)
+            if "sc" in caches:
+                q_new, sc_new = _absmax_int8(kv_new, -1)
+                if tv.ndim == 1:
+                    kvq = pool_kv.at[l, :, blk, :, off, :].set(
+                        jnp.swapaxes(q_new[:, :, :, 0], 0, 1),
+                        mode="drop")
+                    scq = caches["sc"].at[l, :, blk, :, 0, off].set(
+                        jnp.swapaxes(sc_new[:, :, :, 0, 0], 0, 1),
+                        mode="drop")
+                else:
+                    kvq = pool_kv.at[l, :, blk, :, off, :].set(
+                        jnp.transpose(q_new, (1, 3, 0, 2, 4)),
+                        mode="drop")
+                    scq = caches["sc"].at[l, :, blk, :, 0, off].set(
+                        jnp.transpose(sc_new[..., 0], (1, 3, 0, 2)),
+                        mode="drop")
+                return dict(caches, kv=kvq, sc=scq)
+            vals = (jnp.swapaxes(kv_new[:, :, :, 0], 0, 1)
+                    if tv.ndim == 1
+                    else jnp.transpose(kv_new, (1, 3, 0, 2, 4)))
+            return dict(caches, kv=pool_kv.at[l, :, blk, :, off, :].set(
+                vals.astype(pool_kv.dtype), mode="drop"))
+
         def layer_step(x, p, caches, l, t, write_mask=None):
             # one gate for both cache flavors' fused write+attend branch.
             # A masked write (serving's in-slot prefill: only admitted
@@ -934,7 +1065,16 @@ class FusedDecoder:
             # entire [L, 2, B, H, Smax, D] buffer every token)
             kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
                                 jnp.swapaxes(v, 1, 2)])  # [2, B, H, 1, D]
-            if isinstance(caches, tuple):
+            if isinstance(caches, dict):
+                # paged: the K/V row scatters through the slot's block
+                # table (write-then-attend, like every other flavor);
+                # the fused write+attend kernels stay dense-only — the
+                # paged read kernel gathers through the table instead
+                caches = paged_write(caches, l,
+                                     _write_targets(t, b, write_mask),
+                                     kv_new)
+                attn = attend(q, caches, l, t)
+            elif isinstance(caches, tuple):
                 attn = None
                 if kw_on:
                     # fused write+attend, int8 flavor: quantizes the new
@@ -1042,6 +1182,14 @@ class FusedDecoder:
                                 jnp.swapaxes(v, 1, 2)])  # [2, B, H, Sq, D]
             tv = jnp.where(wmask, t2, smax)              # OOB -> dropped
             bi = jnp.arange(b)[:, None]
+            if isinstance(caches, dict):
+                # paged verify writes: the whole K+1 block scatters
+                # through the block table (masked positions -> the
+                # sentinel block, dropped — same discipline as dense)
+                caches = paged_write(caches, l, tv, kv_new)
+                attn = attend(q, caches, l, lens)
+                return proj_ffn_tail(
+                    residual, attn.reshape(b, kp, nh * hd), p), caches
             if isinstance(caches, tuple):
                 q_new, sc_new = _absmax_int8(kv_new, -1)
                 ci8 = caches[0].at[l, :, bi, :, tv, :].set(
@@ -1079,7 +1227,9 @@ class FusedDecoder:
             # whole stack per token — the r3 decode profile's ~10 ms/token
             # vs ~1 ms bandwidth-floor gap).
             x = call_layerlike(embed, e_params, e_arrays, tok[:, None])
-            if mesh is not None:
+            if mesh is not None and not isinstance(caches, dict):
+                # (the paged pool carries no sharding annotations — the
+                # serving engine disables paged mode under a mesh)
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 sh = NamedSharding(mesh,
                                    P(None, None, None, "mp", None, None))
@@ -1094,7 +1244,8 @@ class FusedDecoder:
                 p, l = xs
                 x, caches = layer_step(x, p, caches, l, t, write_mask)
                 return (x, caches), None
-            nl = (caches[0] if isinstance(caches, tuple)
+            nl = (caches["kv"] if isinstance(caches, dict)
+                  else caches[0] if isinstance(caches, tuple)
                   else caches).shape[0]
             (x, caches), _ = jax.lax.scan(
                 body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
@@ -1107,7 +1258,9 @@ class FusedDecoder:
             # verify-step hidden core: ONE pass of the layer stack over
             # the whole K+1 block (see spec_layer_step).
             x = call_layerlike(embed, e_params, e_arrays, toks)
-            if mesh is not None:
+            if mesh is not None and not isinstance(caches, dict):
+                # (the paged pool carries no sharding annotations — the
+                # serving engine disables paged mode under a mesh)
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 sh = NamedSharding(mesh,
                                    P(None, None, None, "mp", None, None))
@@ -1123,7 +1276,8 @@ class FusedDecoder:
                 x, caches = spec_layer_step(x, p, caches, l, lens,
                                             write_mask)
                 return (x, caches), None
-            nl = (caches[0] if isinstance(caches, tuple)
+            nl = (caches["kv"] if isinstance(caches, dict)
+                  else caches[0] if isinstance(caches, tuple)
                   else caches).shape[0]
             (x, caches), _ = jax.lax.scan(
                 body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))

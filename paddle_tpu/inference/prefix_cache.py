@@ -41,14 +41,43 @@ being recomputed by the prefill stack. Two halves:
 Both copy paths are plain XLA gathers/scatters (no new kernels): the
 blocks move HBM->HBM once per admission, which is orders of magnitude
 cheaper than re-running the L-layer prefill stack over the same tokens.
+
+PAGED twin: under the serving engine's default paged KV cache
+(paged_kv.py), this module's radix machinery is reused by
+``PagedPrefixStore``/``PagedPrefixCache`` against the ONE shared
+``BlockPool`` — adopt becomes writing the chain's pool indices into
+the slot's block table and commit becomes referencing the slot's own
+blocks, so a hit costs zero device copies. The dense ``PrefixCache``
+here remains the cross-engine-shareable flavor (oneshot
+``generate(prefix_cache=...)`` uses it) and the layout the engine
+falls back to with ``PADDLE_SERVING_PAGED=0``.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
-__all__ = ["PrefixStore", "PrefixCache", "PrefixNode"]
+__all__ = ["PrefixStore", "PrefixCache", "PrefixNode",
+           "lookup_adoptable"]
+
+
+def lookup_adoptable(store, block_tokens, tokens):
+    """Longest ADOPTABLE chain for a prompt — ONE owner for the cap +
+    counter rules shared by the dense PrefixCache and the paged twin
+    (paged_kv.PagedPrefixCache): the raw radix match is capped so at
+    least one prompt token always goes through real prefill (the
+    first-token sample needs the last prompt token's hidden state,
+    which only prefill produces — a fully-cached prompt drops its
+    final block; vLLM does the same), and the hit/miss counters bump
+    HERE, post-cap, so store- and engine-level hit rates can never
+    disagree."""
+    t = np.asarray(tokens).reshape(-1)
+    nodes = store.match(t)
+    nodes = nodes[:(t.size - 1) // block_tokens]
+    if nodes:
+        store.match_hits += 1
+    else:
+        store.match_misses += 1
+    return nodes
 
 
 class PrefixNode:
@@ -263,21 +292,17 @@ class PrefixCache:
 
     # ---------------------------------------------------------- plumbing
     def _counted_jit(self, key, build, donate=()):
-        """Same spy discipline as ServingEngine._counted_jit: the counter
-        bumps at trace time only, so zero-retrace-after-warmup contracts
-        can assert over engine traces + this counter."""
-        import jax
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            inner = build()
+        """Trace-spy jit (paged_kv.counted_jit is the one owner of the
+        spy/donation rules): the counter bumps at trace time only, so
+        zero-retrace-after-warmup contracts can assert over engine
+        traces + this counter. Imported lazily — this module stays
+        importable without jax for the host-only store tests."""
+        from .paged_kv import counted_jit
+        return counted_jit(self._jit_cache, key, build,
+                           self._bump_traces, donate)
 
-            def spied(*args):
-                self.trace_count += 1
-                return inner(*args)
-            tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-            fn = jax.jit(spied, donate_argnums=() if tunneled else donate)
-            self._jit_cache[key] = fn
-        return fn
+    def _bump_traces(self):
+        self.trace_count += 1
 
     @staticmethod
     def _sig_of(caches):
@@ -312,20 +337,9 @@ class PrefixCache:
 
     # ------------------------------------------------------------ lookup
     def lookup(self, tokens):
-        """Longest ADOPTABLE chain for a prompt: the raw radix match
-        capped so at least one prompt token always goes through real
-        prefill — the first-token sample needs the last prompt token's
-        hidden state, which only prefill produces (a fully-cached prompt
-        drops its final block; vLLM does the same)."""
-        t = np.asarray(tokens).reshape(-1)
-        nodes = self.store.match(t)
-        cap = (t.size - 1) // self.block_tokens
-        nodes = nodes[:cap]
-        if nodes:
-            self.store.match_hits += 1
-        else:
-            self.store.match_misses += 1
-        return nodes
+        """Longest ADOPTABLE chain for a prompt (see
+        lookup_adoptable — the shared owner of the cap/counter rules)."""
+        return lookup_adoptable(self.store, self.block_tokens, tokens)
 
     # ------------------------------------------------------------- adopt
     def _build_adopt(self, K, quant):

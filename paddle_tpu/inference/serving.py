@@ -37,6 +37,23 @@ prefill lands, the prompt's full `prefill_cap`-sized blocks are
 committed back to the pool (copy-out, dedup'd) so later shared-prompt
 requests hit. See prefix_cache.py for the radix store / COW invariants.
 
+Paged KV cache (default; `PADDLE_SERVING_PAGED=0` keeps the dense
+per-slot ring for parity testing): ONE BlockPool
+`[L, 2, NBtotal, H, Bt, D]` holds every KV block — slots, prefix-cache
+entries, and spec-verify writes — and each slot's sequence is a block
+TABLE `[Smax/Bt]` of pool indices living here as pure data
+(paged_kv.py). Decode/verify attention gathers through the table
+(paged Pallas kernels / gather-dense fallback), K/V writes scatter
+through it under the same `cache_lens < Smax` clamp discipline, prefix
+hits become index writes (zero-copy adopt, zero-copy publish), blocks
+map lazily as `lens` grows and free on eviction, and copy-on-write
+makes `fork_slot` (parallel sampling) nearly free. Slot capacity is
+bounded by the POOL, not `B x Smax`: `kv_pool_blocks=` /
+`PADDLE_SERVING_KV_BLOCKS` states a memory budget (explicitly sized
+pools shed honestly with `AdmissionFull` when commitments exceed it);
+the default sizing `B x Smax/Bt` equals the dense HBM footprint and
+never sheds. `metrics()` exposes `kv_blocks_used/free/total`.
+
 Speculative decoding (`spec_k=` / `PADDLE_SERVING_SPEC_K`): a per-slot
 model-free n-gram drafter (spec_decode.py) proposes up to K tokens per
 step from the request's own context; ONE compiled K+1-position verify
@@ -145,7 +162,8 @@ class ServingEngine:
                  decode_chunk=None, use_rotary=False,
                  enable_repetition_penalty=False, clock=None,
                  max_pending=None, prefill_cap=None,
-                 prefix_cache_blocks=0, prefix_cache=None, spec_k=None):
+                 prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
+                 paged=None, kv_pool=None, kv_pool_blocks=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -167,10 +185,104 @@ class ServingEngine:
                 "(the prefill ladder and the prefix-block ladder both "
                 "key their bounded executable sets on it)")
         self.prefill_cap = cap
+        # PAGED KV cache (default; PADDLE_SERVING_PAGED=0 keeps the
+        # dense per-slot ring for parity testing): ONE BlockPool
+        # [L, 2, NBtotal, H, Bt, D] shared by slots, prefixes, and
+        # spec-verify writes, addressed through per-slot block tables
+        # [B, Smax/Bt] that live here as pure data. Block size Bt IS
+        # prefill_cap — the one knob. Slot capacity is bounded by the
+        # POOL (actual token residency), not B x Smax; blocks map
+        # lazily as lens grows and free on eviction. A shared dense
+        # PrefixCache object forces dense mode (its pool is separate
+        # storage); an active mp mesh does too (the pool carries no
+        # sharding annotations).
+        env_paged = os.environ.get("PADDLE_SERVING_PAGED", "1") != "0"
+        want_paged = env_paged if paged is None else bool(paged)
+        if want_paged and prefix_cache is not None:
+            if paged:
+                raise ValueError(
+                    "a shared dense PrefixCache cannot back a paged "
+                    "engine (its blocks live in separate storage; a "
+                    "paged engine's prefix blocks ARE kv pool blocks) "
+                    "— pass prefix_cache_blocks= instead, or "
+                    "paged=False")
+            want_paged = False
+        if want_paged and self.dec._mesh_mp() is not None:
+            if paged:
+                # only the env/auto default may downgrade silently — an
+                # EXPLICIT paged=True must not quietly hand back a
+                # dense engine (fork_slot would then fail, the kv gate
+                # would never exist)
+                raise ValueError(
+                    "paged=True under an active mp mesh is not "
+                    "supported (the block pool carries no sharding "
+                    "annotations) — drop paged= to accept the dense "
+                    "fallback")
+            want_paged = False
+        self.paged = want_paged
+        if not self.paged and (kv_pool is not None
+                               or kv_pool_blocks is not None):
+            raise ValueError(
+                "kv_pool/kv_pool_blocks state a paged-pool memory "
+                "budget, but this engine resolved to the DENSE layout "
+                "(PADDLE_SERVING_PAGED=0, paged=False, a shared dense "
+                "prefix cache, or the automatic fallback under an "
+                "active mp mesh) — refusing to drop the budget "
+                "silently")
+        self.pool = None
+        self._kv_gate = False
+        self._kv_reserved = 0            # running worst-case blocks
+        self._kv_committed = 0           # queued + running worst case
+        self._cow_copies = 0
+        if self.paged:
+            from .paged_kv import BlockPool
+            nb_env = os.environ.get("PADDLE_SERVING_KV_BLOCKS")
+            if kv_pool is not None:
+                if kv_pool.block_tokens != cap:
+                    raise ValueError(
+                        f"BlockPool has block_tokens="
+                        f"{kv_pool.block_tokens} but prefill_cap={cap} "
+                        "— the pool block, the prefix block, and the "
+                        "prefill chunk ladder are ONE knob and must "
+                        "agree")
+                if kv_pool.used:
+                    # the engine owns the pool's DEVICE arrays; an
+                    # allocator with live blocks belongs to another
+                    # engine's storage (cross-engine pool sharing needs
+                    # shared device buffers — not built yet)
+                    raise ValueError(
+                        "kv_pool already has allocated blocks — one "
+                        "BlockPool serves one engine")
+                self.pool = kv_pool
+            else:
+                nb = int(kv_pool_blocks if kv_pool_blocks is not None
+                         else nb_env if nb_env
+                         else self.num_slots * (self.smax // cap))
+                self.pool = BlockPool(nb, cap, self.smax)
+            # an EXPLICITLY sized pool is an operator-stated memory
+            # budget: submit() sheds honestly (AdmissionFull) when
+            # commitments exceed it. The default sizing (B x Smax/Bt ==
+            # dense HBM) can always hold every admissible request, so
+            # no gate — exact behavioral parity with the dense engine.
+            self._kv_gate = (kv_pool is not None
+                             or kv_pool_blocks is not None
+                             or bool(nb_env))
         # automatic prefix caching: pass a shared PrefixCache (e.g. the
         # one oneshot generate() calls use) or a block budget to build a
-        # private one; 0/None = off (legacy behavior, no new dispatches)
+        # private one; 0/None = off (legacy behavior, no new dispatches).
+        # In paged mode the budget builds a PagedPrefixCache over the
+        # SAME pool: adopt/commit become block-table index writes
+        # (zero-copy hits) instead of compiled gather/splat copies.
         if prefix_cache is not None:
+            from .prefix_cache import PrefixCache
+            if not isinstance(prefix_cache, PrefixCache):
+                # a PagedPrefixCache is engine-PRIVATE (its blocks live
+                # in one engine's pool and tables) — accepting it here
+                # would die later with an AttributeError in _admit
+                raise ValueError(
+                    f"prefix_cache= takes a shareable dense PrefixCache"
+                    f", got {type(prefix_cache).__name__} — paged "
+                    "engines build their own via prefix_cache_blocks=")
             if prefix_cache.block_tokens != self.prefill_cap:
                 raise ValueError(
                     f"shared prefix cache has block_tokens="
@@ -179,9 +291,15 @@ class ServingEngine:
                     "must align")
             self.prefix_cache = prefix_cache
         elif prefix_cache_blocks:
-            from .prefix_cache import PrefixCache
-            self.prefix_cache = PrefixCache(int(prefix_cache_blocks),
-                                            self.prefill_cap)
+            if self.paged:
+                from .paged_kv import PagedPrefixCache
+                self.prefix_cache = PagedPrefixCache(
+                    int(prefix_cache_blocks), self.prefill_cap,
+                    self.pool)
+            else:
+                from .prefix_cache import PrefixCache
+                self.prefix_cache = PrefixCache(int(prefix_cache_blocks),
+                                                self.prefill_cap)
         else:
             self.prefix_cache = None
         self._prefix_hits = 0
@@ -217,7 +335,16 @@ class ServingEngine:
 
         b = self.num_slots
         fmt.eval()
-        self._caches = self.dec.init_cache(b)
+        if self.paged:
+            self._caches = self.dec.init_paged_cache(self.pool)
+            # per-slot block tables: position s of slot b lives at
+            # pool[.., tables[b, s // Bt], .., s % Bt, ..]; the sentinel
+            # num_blocks marks unmapped entries (writes through it drop)
+            self._tables = np.full((b, self.smax // self.prefill_cap),
+                                   self.pool.num_blocks, np.int32)
+        else:
+            self._caches = self.dec.init_cache(b)
+            self._tables = None
         # host-side slot state (tiny [B] vectors; device arrays would buy
         # nothing — they cross the boundary once per chunk anyway)
         self._lens = np.zeros(b, np.int32)       # current decode position
@@ -244,6 +371,7 @@ class ServingEngine:
         self._tokens_emitted = 0
         self._busy_s = 0.0
         self._admitted = 0
+        self._forked = 0
         # overload shedding: 0 = unbounded (legacy behavior)
         self.max_pending = int(max_pending if max_pending is not None
                                else os.environ.get(
@@ -288,6 +416,27 @@ class ServingEngine:
             raise AdmissionFull(
                 f"pending queue full ({len(self._queue)}/"
                 f"{self.max_pending}) — request shed at admission")
+        if self.paged:
+            need = self._blocks_needed(ids.size, max_new_tokens)
+            if need > self.pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} kv blocks but the pool holds "
+                    f"{self.pool.num_blocks} total — it can never be "
+                    "admitted (grow kv_pool_blocks or shrink the "
+                    "request)")
+            if self._kv_gate and \
+                    self._kv_committed + need > self.pool.num_blocks:
+                # the POOL (not the slot count) is exhausted: honest
+                # shedding against the operator's stated memory budget
+                # — finished/expired requests release their commitment,
+                # so the caller's backoff-and-retry recovers
+                self._rejected += 1
+                raise AdmissionFull(
+                    f"kv pool exhausted ({self._kv_committed}/"
+                    f"{self.pool.num_blocks} blocks committed to "
+                    f"queued+running requests; this one needs {need}) "
+                    "— request shed at admission")
+            self._kv_committed += need
         req = ServedRequest(next(self._rid), ids, max_new_tokens,
                             eos_token_id, min_length, repetition_penalty,
                             self.clock(), deadline_s=deadline_s)
@@ -344,6 +493,7 @@ class ServingEngine:
         self._tokens_emitted = 0
         self._busy_s = 0.0
         self._admitted = 0
+        self._forked = 0
         self._rejected = 0
         self._expired = 0
         self._prefix_hits = 0
@@ -353,6 +503,7 @@ class ServingEngine:
         self._draft_proposed = 0
         self._draft_accepted = 0
         self._decode_steps = 0
+        self._cow_copies = 0
         if not keep_results:
             self.results = {}
 
@@ -379,6 +530,7 @@ class ServingEngine:
                 else (0.0 if self._tokens_emitted else None)),
             "requests_finished": len(done),
             "requests_admitted": self._admitted,
+            "requests_forked": self._forked,
             "requests_rejected": self._rejected,
             "requests_expired": self._expired,
             "queue_depth": self.queue_depth,
@@ -410,6 +562,17 @@ class ServingEngine:
             "tokens_per_step": (
                 round(self._tokens_emitted / self._decode_steps, 4)
                 if self._decode_steps else None),
+            # paged-pool accounting (dense mode: total/used/free None):
+            # used + free == total always — a refcounted block shared
+            # by N slots and the prefix store is ONE physical block,
+            # counted once. kv_cow_copies is a window counter (0 in
+            # the steady flow; forks pay one per diverged block).
+            "kv_blocks_total": (self.pool.num_blocks if self.paged
+                                else None),
+            "kv_blocks_used": self.pool.used if self.paged else None,
+            "kv_blocks_free": (self.pool.free_count if self.paged
+                               else None),
+            "kv_cow_copies": self._cow_copies,
         }
         if self.prefix_cache is not None:
             m["prefix_store"] = self.prefix_cache.store.stats()
@@ -424,25 +587,23 @@ class ServingEngine:
         n = self._trace_count
         if self.prefix_cache is not None:
             n += self.prefix_cache.trace_count
+        if self.pool is not None:
+            n += self.pool.trace_count       # the COW copy executable
         return n
 
     # ------------------------------------------------------- jitted steps
     def _counted_jit(self, key, build, donate=()):
-        """jit with a retrace spy: the counter bumps at TRACE time (python
-        side effects run only while tracing), so `metrics()['traces']`
-        counts executable builds, not calls — the engine's zero-retrace-
-        after-warmup contract is asserted against exactly this number."""
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            inner = build()
+        """jit with a retrace spy (paged_kv.counted_jit is the one
+        owner): the counter bumps at TRACE time, so
+        `metrics()['traces']` counts executable builds, not calls — the
+        engine's zero-retrace-after-warmup contract is asserted against
+        exactly this number."""
+        from .paged_kv import counted_jit
+        return counted_jit(self._jit_cache, key, build,
+                           self._bump_traces, donate)
 
-            def spied(*args):
-                self._trace_count += 1
-                return inner(*args)
-            tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
-            fn = jax.jit(spied, donate_argnums=() if tunneled else donate)
-            self._jit_cache[key] = fn
-        return fn
+    def _bump_traces(self):
+        self._trace_count += 1
 
     def _core(self):
         core = getattr(self, "_core_cache", None)
@@ -451,6 +612,172 @@ class ServingEngine:
                 self.do_sample, self.top_k, self.top_p, self.temperature)
             self._core_cache = core
         return core
+
+    # ------------------------------------------------------ paged plumbing
+    def _cache_arg(self):
+        """The compiled-step cache operand: dense -> the ring buffer
+        as-is; paged -> the pool dict plus this dispatch's block tables
+        (tiny [B, Smax/Bt] int32, re-uploaded from host state per call
+        — block ids are DATA, so table churn never retraces)."""
+        if not self.paged:
+            return self._caches
+        return dict(self._caches, tbl=jnp.asarray(self._tables))
+
+    def _keep_caches(self, out):
+        if not self.paged:
+            self._caches = out
+        else:
+            self._caches = {k: v for k, v in out.items() if k != "tbl"}
+
+    def _blocks_needed(self, plen, max_new):
+        """Worst-case pool blocks for one request: every position in
+        [0, plen + max_new) mapped. The submit-time Smax bound keeps
+        this <= Smax/Bt."""
+        return -(-(int(plen) + int(max_new)) // self.prefill_cap)
+
+    def _alloc_kv_blocks(self, n):
+        got = self.pool.alloc(n)
+        if got is None:
+            store = getattr(self.prefix_cache, "store", None)
+            if store is not None and hasattr(store, "reclaim"):
+                # prefix blocks are CACHE: evict cold ones under memory
+                # pressure before touching the reservation guarantees
+                store.reclaim(n - self.pool.free_count)
+            got = self.pool.alloc(n)
+        if got is None:
+            raise RuntimeError(
+                f"kv block pool over-committed: need {n} blocks, "
+                f"{self.pool.free_count} free after reclaim — the "
+                "admission-time reservation accounting should make "
+                "this unreachable")
+        return got
+
+    def _map_blocks(self, slot, hi):
+        """Lazily map pool blocks so the slot's table covers positions
+        [0, hi) — called as lens grows (admission covers the prompt;
+        each decode/verify dispatch covers its write window)."""
+        row = self._tables[slot]
+        nb = self.pool.num_blocks
+        need = [j for j in range(-(-int(hi) // self.prefill_cap))
+                if row[j] == nb]
+        if need:
+            row[need] = self._alloc_kv_blocks(len(need))
+
+    def _budget_pos(self, slot):
+        """One-past the slot's LAST possible write position: lens peaks
+        at plen + max_new - 1 (the submit-time bound), and every
+        masked/dropped write targets a position below it too — so the
+        write-window mapping must never touch a block past this, or a
+        tightly sized pool would be asked for blocks beyond the
+        admission-time worst-case reservation."""
+        return (int(self._lens[slot]) - int(self._nt[slot])
+                + int(self._max_nt[slot]))
+
+    def _ensure_writable(self, slot, lo, hi):
+        """COW guard + lazy mapping for the write window [lo, hi): an
+        unmapped block allocates; a SHARED block (refcount > 1 — prefix
+        blocks another slot/the store also references, or a fork twin)
+        is copied-on-write first, so a write can never leak into
+        someone else's view. In the steady serving flow writes land
+        strictly past every shared block (adoption/publication are
+        block-aligned below plen), so the copy only ever fires for
+        forked slots."""
+        hi = min(int(hi), self.smax)
+        if hi <= lo:
+            return
+        row = self._tables[slot]
+        nb = self.pool.num_blocks
+        bt = self.prefill_cap
+        for j in range(int(lo) // bt, (hi - 1) // bt + 1):
+            blk = int(row[j])
+            if blk == nb:
+                row[j] = self._alloc_kv_blocks(1)[0]
+            elif int(self.pool.refcounts[blk]) > 1:
+                new = self._alloc_kv_blocks(1)[0]
+                self._caches = self.pool.copy_block(self._caches, blk,
+                                                    new)
+                row[j] = new
+                self.pool.deref([blk])
+                self._cow_copies += 1
+
+    def _free_slot_blocks(self, slot):
+        row = self._tables[slot]
+        nb = self.pool.num_blocks
+        mapped = [int(x) for x in row[row < nb]]
+        if mapped:
+            self.pool.deref(mapped)
+        row[:] = nb
+
+    def fork_slot(self, rid, max_new_tokens=None):
+        """Copy-on-write FORK of a running request (paged mode): clone
+        its decode state into a free slot, sharing every KV block
+        through the block table (pool refcounts; ZERO data movement).
+        The twins then decode independently — the first write into a
+        still-shared block triggers the copy-on-write of just that
+        block. This is the parallel-sampling / N-best primitive the
+        paged layout gives for free; returns the child's request id.
+
+        The child inherits the parent's generated-so-far tokens and
+        budget (``max_new_tokens`` overrides the remaining total)."""
+        if not self.paged:
+            raise ValueError("fork_slot needs the paged KV cache "
+                             "(PADDLE_SERVING_PAGED=0 disables it)")
+        src = None
+        for r in self._slot_req:
+            if r is not None and r.rid == rid:
+                src = r
+        if src is None or src.state != "running":
+            raise ValueError(f"request {rid} is not running in a slot")
+        free = self._free_slots()
+        if not free:
+            # shed like submit() sheds: the rejection must show up in
+            # the overload metric, not vanish
+            self._rejected += 1
+            raise AdmissionFull("no free slot to fork into")
+        s0, s1 = src.slot, free[0]
+        mnt = int(max_new_tokens if max_new_tokens is not None
+                  else src.max_new_tokens)
+        if src.prompt.size + mnt > self.smax:
+            raise ValueError("fork budget exceeds the ring capacity")
+        need = self._blocks_needed(src.prompt.size, mnt)
+        if self._kv_reserved + need > self.pool.num_blocks:
+            self._rejected += 1
+            raise AdmissionFull(
+                f"kv pool exhausted: fork needs {need} blocks, "
+                f"{self.pool.num_blocks - self._kv_reserved} unreserved")
+        child = ServedRequest(next(self._rid), src.prompt, mnt,
+                              src.eos_token_id, src.min_length,
+                              src.repetition_penalty, self.clock())
+        child.state = "running"
+        child.slot = s1
+        child.tokens = list(src.tokens)
+        child.t_first = src.t_first
+        self._slot_req[s1] = child
+        self._kv_reserved += need
+        self._kv_committed += need
+        # a fork is a CLONE, not an admission: it performs no prefix
+        # lookup, so counting it as admitted would break the
+        # hits + misses == admitted reconciliation conftest pins
+        self._forked += 1
+        # share the parent's blocks: table row copy + one ref each
+        row = self._tables[s0]
+        mapped = [int(x) for x in row[row < self.pool.num_blocks]]
+        self.pool.ref(mapped)
+        self._tables[s1] = row
+        for vec in (self._lens, self._nt, self._eos, self._min_len,
+                    self._rep_pen, self._tok):
+            vec[s1] = vec[s0]
+        self._max_nt[s1] = mnt
+        self._active[s1] = self._active[s0] and self._nt[s1] < mnt
+        if self._drafters is not None:
+            self._drafters[s1].reset(src.prompt)
+            self._drafters[s1].update(child.tokens)
+        if self._rep_on:
+            p = self._presence_init()
+            self._presence = p.at[s1].set(p[s0])
+        if not self._active[s1]:
+            self._finish(child, self.clock())
+        return child.rid
 
     def _build_decode_chunk(self):
         """The ONE compiled decode step: decode_chunk tokens per dispatch
@@ -558,7 +885,35 @@ class ServingEngine:
             # the row's OWN last real token's hidden state (ragged pad)
             last = jax.lax.dynamic_slice_in_dim(x, plen - 1, 1, 1)
             kv = kv_all[:, :, 0]                      # [L, 2, H, sb, D]
-            if int8:
+            if isinstance(caches, dict):
+                # paged: scatter the prompt's K/V through the slot's
+                # block table. Positions >= plen (the pow-2 pad) go OUT
+                # OF BOUNDS and drop — unlike the dense path they never
+                # land as garbage, so the pad needs no pool blocks and
+                # the write-then-attend overwrite argument isn't even
+                # needed.
+                pool_kv, tbl = caches["kv"], caches["tbl"]
+                nb = pool_kv.shape[2]
+                bt = pool_kv.shape[4]
+                row = jax.lax.dynamic_index_in_dim(tbl, slot, 0,
+                                                   keepdims=False)
+                pos = jnp.arange(sb, dtype=jnp.int32)
+                blk = jnp.where(pos < plen, jnp.take(row, pos // bt), nb)
+                off = pos % bt
+                if int8:
+                    qi, sc = _absmax_int8(kv, -1)
+                    kvq = pool_kv.at[:, :, blk, :, off, :].set(
+                        jnp.transpose(qi, (3, 0, 1, 2, 4)), mode="drop")
+                    scq = caches["sc"].at[:, :, blk, :, 0, off].set(
+                        jnp.transpose(sc[..., 0], (3, 0, 1, 2)),
+                        mode="drop")
+                    caches = dict(caches, kv=kvq, sc=scq)
+                else:
+                    caches = dict(caches, kv=pool_kv.at[
+                        :, :, blk, :, off, :].set(
+                        jnp.transpose(kv, (3, 0, 1, 2, 4)).astype(
+                            pool_kv.dtype), mode="drop"))
+            elif int8:
                 qi, sc = _absmax_int8(kv, -1)
                 ci8 = caches[0].at[:, :, slot, :, :sb, :].set(qi)
                 scs = caches[1].at[:, :, slot, :, 0, :sb].set(sc[..., 0])
@@ -577,10 +932,11 @@ class ServingEngine:
             lambda s=sb: self._build_bulk_admit(s), donate=(2,))
         toks = np.zeros((1, sb), np.int32)
         toks[0, :plen] = req.prompt
-        self._caches, row_x = fn(
-            stk, e_arrays, self._caches, jnp.asarray(toks),
+        out, row_x = fn(
+            stk, e_arrays, self._cache_arg(), jnp.asarray(toks),
             jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(plen, jnp.int32))
+        self._keep_caches(out)
         return last_x.at[req.slot].set(row_x[0])
 
     # --------------------------------------------------------- scheduling
@@ -595,6 +951,18 @@ class ServingEngine:
         free = self._free_slots()
         batch = []
         while free and self._queue:
+            if self.paged:
+                # pool-bounded admission: a request enters a slot only
+                # with its WORST-CASE block reservation covered (sum of
+                # running reservations <= NBtotal keeps every lazy
+                # allocation satisfiable — shared blocks only add
+                # slack). Otherwise it waits; eviction frees blocks.
+                head = self._queue[0]
+                need = self._blocks_needed(head.prompt.size,
+                                           head.max_new_tokens)
+                if self._kv_reserved + need > self.pool.num_blocks:
+                    break
+                self._kv_reserved += need
             req = self._queue.popleft()
             slot = free.pop(0)
             req.slot = slot
@@ -661,13 +1029,20 @@ class ServingEngine:
                 # template inside the same admission
                 nodes = pc.lookup(r.prompt)
                 if nodes:
-                    pc.store.acquire(nodes)   # pin across the copy
-                    try:
-                        self._caches = pc.adopt(self._caches, r.slot,
-                                                nodes)
-                    finally:
-                        pc.store.release(nodes)
-                    base[r.slot] = len(nodes) * pc.block_tokens
+                    if self.paged:
+                        # THE zero-copy hit: the matched chain's pool
+                        # indices are written into the slot's block
+                        # table (+refcount) — no gather, no dispatch
+                        base[r.slot] = pc.adopt_into(self._tables,
+                                                     r.slot, nodes)
+                    else:
+                        pc.store.acquire(nodes)   # pin across the copy
+                        try:
+                            self._caches = pc.adopt(self._caches,
+                                                    r.slot, nodes)
+                        finally:
+                            pc.store.release(nodes)
+                        base[r.slot] = len(nodes) * pc.block_tokens
                     self._prefix_hits += 1
                     self._prefill_tokens_saved += int(base[r.slot])
                 else:
@@ -675,10 +1050,18 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 self._prefill_tokens_computed += (r.prompt.size
                                                   - int(base[r.slot]))
+            if self.paged:
+                # map the prompt's remaining blocks (adopted entries
+                # already point into the pool); the decode window maps
+                # lazily chunk by chunk
+                self._map_blocks(r.slot, r.prompt.size)
             if use_bulk and not base[r.slot]:
                 last_x = self._bulk_admit_row(stk, e_arrays, r, last_x)
                 if pc is not None:
-                    pc.publish(self._caches, r.slot, r.prompt)
+                    if self.paged:
+                        pc.publish_from(self._tables, r.slot, r.prompt)
+                    else:
+                        pc.publish(self._caches, r.slot, r.prompt)
                     published.add(r.slot)
         # a prefix hit always takes the masked-scan path for its suffix:
         # the bulk flash pass has no way to attend the adopted prefix
@@ -707,21 +1090,27 @@ class ServingEngine:
                     np.int32)
                 n_valid = np.clip(n_left - pos, 0, chunk).astype(
                     np.int32)
-                last_x, self._caches = fn(
-                    stk, e_arrays, self._caches, toks,
+                last_x, out = fn(
+                    stk, e_arrays, self._cache_arg(), toks,
                     jnp.asarray(t0), jnp.asarray(n_valid), last_x)
+                self._keep_caches(out)
                 pos += chunk
         # commit-on-prefill for the rows whose prefill just landed via
         # the scan (bulk-miss rows published inline above): publish each
         # prompt's full blocks back to the pool under their token keys.
         # Adopted blocks re-resolve to their existing nodes (dedup, no
         # copy); only genuinely new blocks are copied out of the slot
-        # row. COW is structural: the pool is separate storage, decode
-        # only writes slot-private positions >= plen.
+        # row (dense) or referenced in place (paged: publication takes
+        # a store ref on the slot's OWN blocks — zero-copy commit).
+        # COW is structural either way: decode only writes slot-private
+        # positions >= plen, strictly past every published full block.
         if pc is not None:
             for r in batch:
                 if r.slot not in published:
-                    pc.publish(self._caches, r.slot, r.prompt)
+                    if self.paged:
+                        pc.publish_from(self._tables, r.slot, r.prompt)
+                    else:
+                        pc.publish(self._caches, r.slot, r.prompt)
 
         # per-slot params refresh for the admitted rows
         for r in batch:
@@ -774,14 +1163,24 @@ class ServingEngine:
             ("decode", chunk), self._build_decode_chunk, donate=(3,))
         base = next_key() if self.do_sample else jax.random.PRNGKey(0)
         keys = jax.random.split(base, chunk)
-        (self._caches, tok, lens, active, nt, presence,
+        if self.paged:
+            # cover this chunk's write window before dispatch (lazy
+            # mapping as lens grows + the COW guard for forked slots)
+            for s in range(self.num_slots):
+                if self._active[s]:
+                    self._ensure_writable(
+                        s, int(self._lens[s]),
+                        min(int(self._lens[s]) + chunk,
+                            self._budget_pos(s)))
+        (out, tok, lens, active, nt, presence,
          (toks, emitted)) = fn(
-            stk, e_arrays, h_arrays, self._caches,
+            stk, e_arrays, h_arrays, self._cache_arg(),
             jnp.asarray(self._tok), jnp.asarray(self._lens),
             jnp.asarray(self._active), jnp.asarray(self._nt),
             jnp.asarray(self._max_nt), jnp.asarray(self._eos),
             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
             self._presence_arg(), keys)
+        self._keep_caches(out)
         if self._rep_on:
             self._presence = presence
         toks = np.asarray(toks)                  # [chunk, B]
@@ -862,12 +1261,24 @@ class ServingEngine:
             lambda: self.dec._build_verify_core(
                 k, self._rep_on, greedy_out=not self.do_sample),
             donate=(3,))
-        self._caches, out = fn(
-            stk, e_arrays, h_arrays, self._caches, jnp.asarray(toks),
+        if self.paged:
+            # cover the verify block's write window [lens, lens+K]
+            # before dispatch — accepted positions become attendable
+            # next step, so every VALID draft write must land (an
+            # unmapped entry would silently drop it)
+            for s in range(self.num_slots):
+                if self._active[s]:
+                    self._ensure_writable(
+                        s, int(self._lens[s]),
+                        min(int(self._lens[s]) + k + 1,
+                            self._budget_pos(s)))
+        caches_out, out = fn(
+            stk, e_arrays, h_arrays, self._cache_arg(), jnp.asarray(toks),
             jnp.asarray(self._lens), jnp.asarray(dlen),
             jnp.asarray(self._active), jnp.asarray(self._nt),
             jnp.asarray(self._eos), jnp.asarray(self._min_len),
             jnp.asarray(self._rep_pen), self._presence_arg())
+        self._keep_caches(caches_out)
         if self.do_sample:
             logits = np.asarray(out).astype(np.float32)  # [B, K+1, V]
             if self._spec_rng is None:
@@ -944,11 +1355,23 @@ class ServingEngine:
         if expired:
             self._expired += 1
         self.results[req.rid] = req.result()
+        if self.paged:
+            self._kv_committed -= self._blocks_needed(req.prompt.size,
+                                                      req.max_new_tokens)
         s = req.slot
         if s is None:                # shed from the queue, never admitted
             return
         self._slot_req[s] = None
         self._active[s] = False
+        if self.paged:
+            # eviction frees the slot's block REFERENCES: blocks the
+            # prefix store (or a fork twin) still holds stay resident,
+            # everything else returns to the pool free list. The table
+            # row resets to the sentinel, so the unmasked idle-row
+            # rewrite at the frozen lens drops instead of landing.
+            self._kv_reserved -= self._blocks_needed(req.prompt.size,
+                                                     req.max_new_tokens)
+            self._free_slot_blocks(s)
         # slot eviction IS this bookkeeping: the cache row is left as-is
         # (positions >= cache_lens are never attendable; the next
         # admission's masked prefill overwrites [0, plen) in place)

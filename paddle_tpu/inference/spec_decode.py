@@ -30,6 +30,12 @@ the drafted token):
 
 Host-side numpy throughout — acceptance/rollback is pure data over the
 verify step's returned logits; nothing here traces.
+
+Cache layouts: the verify step's K+1 write-masked K/V scatters ride
+whatever cache the engine runs — the dense per-slot ring, or (default)
+the paged block pool where each position resolves through the slot's
+block table (paged_kv.py; masked positions target the sentinel block
+and drop, the same `cache_lens < Smax` clamp discipline either way).
 """
 from __future__ import annotations
 

@@ -16,17 +16,26 @@ positions cache_lens..cache_lens+Sq-1 attend causally among themselves and
 fully to the cache prefix. Forward-only (inference).
 
 The `cache_lens < Smax` invariant (write kernels clamp a full row's write
-to a drop) has FOUR clients: the serving engine's eviction-as-data slot
+to a drop) has FIVE clients: the serving engine's eviction-as-data slot
 reuse, the submit-time `prompt + max_new_tokens <= Smax` bound, the
 prefix cache's block-granular adopt copy (inference/prefix_cache.py) —
 adopted block writes land at positions < plen <= Smax - max_new_tokens
 with the pow-2 ladder tail masked out of bounds and dropped, so a
 block-granular splat can never push a row to (or past) Smax either —
-and the speculative-decoding verify step (inference/spec_decode.py +
+the speculative-decoding verify step (inference/spec_decode.py +
 generation._build_verify_core): its K+1 block writes at positions
 lens..lens+K are per-position masked to `lens + j < Smax` (masked
 positions scatter out of bounds and drop), and drafting caps K at the
-row's remaining budget, so lens + dlen <= prompt + max_new - 1 < Smax.
+row's remaining budget, so lens + dlen <= prompt + max_new - 1 < Smax —
+and the PAGED write path (inference/paged_kv.py + the paged branches in
+generation._build_step_core): every K/V write resolves position t to
+(block_tables[b, t // Bt], t % Bt), a masked row's position Smax maps
+to table index Smax/Bt which is re-pointed at the OUT-OF-BOUNDS
+sentinel block `num_blocks` and dropped, and an unmapped table entry
+holds the same sentinel — so a write past a slot's mapped blocks (or
+any masked write) lands nowhere, exactly the dense clamp's discipline.
+Smax % Bt == 0 is asserted at BlockPool construction with a clear
+error, so the table arithmetic can never itself gather out of bounds.
 """
 from __future__ import annotations
 
@@ -41,9 +50,11 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["decode_attention", "decode_attention_stacked",
            "decode_attention_stacked_i8", "decode_attention_stacked_write",
            "decode_attention_stacked_i8_write",
+           "decode_attention_paged", "decode_attention_paged_i8",
            "is_supported", "stacked_is_supported",
            "stacked_i8_is_supported", "stacked_write_is_supported",
-           "stacked_i8_write_is_supported"]
+           "stacked_i8_write_is_supported", "paged_is_supported",
+           "paged_i8_is_supported"]
 
 NEG_INF = -1e30
 
@@ -834,3 +845,265 @@ def decode_attention_stacked_i8_write(qt, kv_new, caches_i8, cache_scales,
         interpret=_interpret(),
     )(lay, lens, qt, kv_new.astype(jnp.float32), caches_i8, cache_scales)
     return caches_out, scales_out, out[:, :, :sq].astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache variant: the KV cache is ONE shared block pool
+# [L, 2, NBtotal, Hk, Bt, D] and each batch row's positions resolve
+# through a per-slot block table [B, Smax/Bt] int32 (vLLM PagedAttention
+# layout; see inference/paged_kv.py for the allocator). The table rides
+# in as a SCALAR-PREFETCH operand so the kv BlockSpec index map can
+# translate grid step j into the row's j-th pool block — the kernel
+# streams exactly the blocks the row owns, in table order, and the
+# last-valid-block clamp re-addresses past-the-end steps at the last
+# valid block so the pipeline elides their HBM copies (same trick as
+# the stacked kernels). The sequence-block size IS the pool's Bt, so
+# one compiled kernel serves every slot/table content — block ids are
+# data, never structure.
+# ---------------------------------------------------------------------------
+
+def _paged_sublane(dtype) -> int:
+    """Minimum Mosaic sublane multiple for the pool's Bt axis: the kv
+    block (1, 2, 1, 1, Bt, D) puts Bt on the second-to-minor dim."""
+    d = jnp.dtype(dtype)
+    if d == jnp.int8:
+        return 32
+    if d in (jnp.bfloat16, jnp.float16):
+        return 16
+    return 8
+
+
+def paged_is_supported(q_shape, pool_shape, dtype,
+                       cache_dtype=None) -> bool:
+    """pool: [L, 2, NB, Hk, Bt, D]; q: [B, Sq, H, D]. Bt must satisfy
+    the dtype's sublane tiling (fp32: 8, bf16/fp16: 16, int8: 32) —
+    smaller block_tokens values fall back to the gather-dense path in
+    generation.py. Like the stacked kernels, q and cache dtypes must
+    MATCH (upcasting the pool would copy every block)."""
+    if len(q_shape) != 4 or len(pool_shape) != 6:
+        return False
+    if q_shape[-1] > 256 or q_shape[1] > 128:
+        return False
+    if pool_shape[3] == 0 or q_shape[2] % pool_shape[3] != 0:
+        return False
+    bt = pool_shape[4]
+    sub = _paged_sublane(cache_dtype if cache_dtype is not None else dtype)
+    if bt < sub or bt % sub:
+        return False
+    if cache_dtype is not None and jnp.dtype(cache_dtype) != jnp.dtype(dtype):
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def paged_i8_is_supported(q_shape, pool_shape, dtype) -> bool:
+    """int8 pool flavor: same layout rules with the int8 sublane
+    minimum (Bt % 32 == 0); compute dtype is the query's."""
+    if len(q_shape) != 4 or len(pool_shape) != 6:
+        return False
+    if q_shape[-1] > 256 or q_shape[1] > 128:
+        return False
+    if pool_shape[3] == 0 or q_shape[2] % pool_shape[3] != 0:
+        return False
+    bt = pool_shape[4]
+    if bt < 32 or bt % 32:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _paged_setup(qt, bt, nblk, nb, group):
+    """Shared host-side setup for the paged kernels: q padding, grid,
+    and the table-translated index maps. Index-map signature:
+    (b, h, j, lay_ref, len_ref, tbl_ref) — tables are the THIRD
+    scalar-prefetch operand. Unmapped/sentinel table entries are
+    clamped to block nb - 1 (their contents are never attendable: the
+    kernel masks cols >= n_valid + sq, and the clamp below only
+    re-addresses steps past the last valid block anyway)."""
+    b, h, sq, d = qt.shape
+    bq = max(8, 1 << (sq - 1).bit_length()) if sq < 128 else 128
+    if bq != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, bq - sq), (0, 0)))
+    grid = (b, h, nblk)
+
+    def _clamp(j, len_r, b_):
+        # same pipeline-copy-elision clamp as the stacked kernels:
+        # steps past this row's last valid block re-address that block
+        return jnp.minimum(j, (len_r[b_] + sq - 1) // bt)
+
+    def _blk(j, len_r, tbl_r, b_):
+        return jnp.minimum(tbl_r[b_, _clamp(j, len_r, b_)], nb - 1)
+
+    kvidx = lambda b_, h_, j, lay_r, len_r, tbl_r, g=group: (  # noqa: E731
+        lay_r[0], 0, _blk(j, len_r, tbl_r, b_), h_ // g, 0, 0)
+    qidx = lambda b_, h_, j, lay_r, len_r, tbl_r: (  # noqa: E731
+        b_, h_, 0, 0)
+    return qt, bq, grid, kvidx, qidx, _blk
+
+
+def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, kv_ref, o_ref,
+                  acc_sc, m_sc, l_sc, *, scale, sq, bq, bk):
+    # flash math identical to _stacked_kernel (shared
+    # _online_softmax_block); only the addressing differs — the
+    # (1, 2, 1, 1, bk, d) kv block was fetched through the block table
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = k_start < n_valid + sq
+
+    @pl.when(run)
+    def _():
+        _online_softmax_block(q_ref[0, 0], kv_ref[0, 0, 0, 0],
+                              kv_ref[0, 1, 0, 0], n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq, bq=bq, bk=bk)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_paged(qt, pool, tables, layer, cache_lens,
+                           scale=None):
+    """qt: [B, H, Sq, D] (kernel layout); pool: [L, 2, NB, Hk, Bt, D]
+    — the ONE shared block pool; tables: [B, Smax/Bt] int32 per-slot
+    block tables (sentinel NB for unmapped entries); layer: scalar
+    int32 (scalar-prefetch); cache_lens: [B] int32. Returns
+    [B, H, Sq, D] — attention of the new queries over the row's
+    table-resolved prefix + the just-written new positions."""
+    b, h, sq, d = qt.shape
+    hk, bt = pool.shape[3], pool.shape[4]
+    nb = pool.shape[2]
+    nblk = tables.shape[1]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    if pool.dtype != qt.dtype:
+        raise ValueError(
+            f"decode_attention_paged: query dtype {qt.dtype} != pool "
+            f"dtype {pool.dtype}; gate with paged_is_supported(..., "
+            "cache_dtype=...) and use the gather-dense path instead")
+    out_dtype = qt.dtype
+
+    qt, bq, grid, kvidx, qidx, _ = _paged_setup(qt, bt, nblk, nb, group)
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    tbl = tables.astype(jnp.int32)
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=float(scale), sq=sq,
+                          bq=bq, bk=bt),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, bt, d), kvidx),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), pool.dtype),
+        interpret=_interpret(),
+    )(lay, lens, tbl, qt, pool)
+    return out[:, :, :sq].astype(out_dtype)
+
+
+def _paged_i8_kernel(lay_ref, len_ref, tbl_ref, q_ref, kv_ref, kvs_ref,
+                     o_ref, acc_sc, m_sc, l_sc, *, scale, sq, bq, bk):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = len_ref[pl.program_id(0)]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = k_start < n_valid + sq
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0]
+        # int8 -> compute dtype conversion; per-row dequant scales
+        # applied column-wise to the score matrix as [1, bk] lane-major
+        # tiles — identical discipline to _stacked_i8_kernel
+        k = kv_ref[0, 0, 0, 0].astype(q.dtype)
+        v = kv_ref[0, 1, 0, 0].astype(q.dtype)
+        _online_softmax_block(q, k, v, n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq, bq=bq, bk=bk,
+                              k_col_scale=kvs_ref[0, 0, 0, 0],
+                              v_col_scale=kvs_ref[0, 1, 0, 0])
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0, 0] = (acc_sc[:] /
+                       jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_paged_i8(qt, pool_i8, pool_scales, tables, layer,
+                              cache_lens, scale=None):
+    """int8 paged flavor: pool_i8 [L, 2, NB, Hk, Bt, D] int8 with
+    per-row absmax scales pool_scales [L, 2, NB, Hk, 1, Bt] fp32 (the
+    scales pool mirrors the kv pool block-for-block, so both resolve
+    through the SAME table entry). Returns [B, H, Sq, D] in the query
+    dtype."""
+    b, h, sq, d = qt.shape
+    hk, bt = pool_i8.shape[3], pool_i8.shape[4]
+    nb = pool_i8.shape[2]
+    nblk = tables.shape[1]
+    group = h // hk
+    if scale is None:
+        scale = d ** -0.5
+    if pool_i8.dtype != jnp.int8:
+        raise ValueError("decode_attention_paged_i8: pool must be int8")
+    if pool_scales.shape != pool_i8.shape[:4] + (1, bt):
+        raise ValueError(
+            "decode_attention_paged_i8: scales must be "
+            f"[L, 2, NB, Hk, 1, Bt], got {pool_scales.shape}")
+    out_dtype = qt.dtype
+
+    qt, bq, grid, kvidx, qidx, blkf = _paged_setup(qt, bt, nblk, nb,
+                                                   group)
+    kvsidx = lambda b_, h_, j, lay_r, len_r, tbl_r, g=group: (  # noqa: E731
+        lay_r[0], 0, blkf(j, len_r, tbl_r, b_), h_ // g, 0, 0)
+    lens = cache_lens.astype(jnp.int32).reshape(b)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    tbl = tables.astype(jnp.int32)
+    out = pl.pallas_call(
+        functools.partial(_paged_i8_kernel, scale=float(scale), sq=sq,
+                          bq=bq, bk=bt),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, bt, d), kvidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, bt), kvsidx),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d), qidx),
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), out_dtype),
+        interpret=_interpret(),
+    )(lay, lens, tbl, qt, pool_i8, pool_scales)
+    return out[:, :, :sq]

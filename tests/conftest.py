@@ -56,9 +56,12 @@ def check_serving_metrics(eng):
     the reconciliation."""
     m = eng.metrics()
     assert m["requests_admitted"] >= 0
-    # every finished request was admitted (expired ones may have been
-    # shed straight from the queue, so they don't reconcile this way)
-    assert m["requests_finished"] <= m["requests_admitted"]
+    # every finished request was admitted or forked (expired ones may
+    # have been shed straight from the queue, so they don't reconcile
+    # this way; a fork is a clone — it performs no prefix lookup and
+    # counts separately so hits + misses == admitted stays exact)
+    assert m["requests_finished"] <= \
+        m["requests_admitted"] + m["requests_forked"]
     if getattr(eng, "prefix_cache", None) is not None:
         assert m["prefix_hits"] + m["prefix_misses"] == \
             m["requests_admitted"], (
@@ -97,6 +100,25 @@ def check_serving_metrics(eng):
         assert m["draft_proposed"] == 0 and m["draft_accepted"] == 0
     if m["tokens_emitted"]:
         assert m["busy_s"] > 0 and m["tokens_per_sec"] > 0
+    # paged-pool block accounting: the allocator must reconcile on
+    # EVERY serving test — used + free == NBtotal (a refcounted block
+    # shared by N slot tables and the prefix store is ONE physical
+    # block, counted once), used matches the refcount vector, and a
+    # positive refcount never rides the free list
+    if getattr(eng, "pool", None) is not None:
+        pool = eng.pool
+        assert m["kv_blocks_total"] == pool.num_blocks
+        assert m["kv_blocks_used"] + m["kv_blocks_free"] == \
+            m["kv_blocks_total"], (
+            f"kv block leak: used={m['kv_blocks_used']} + "
+            f"free={m['kv_blocks_free']} != total={m['kv_blocks_total']}")
+        assert m["kv_blocks_used"] == int((pool.refcounts > 0).sum())
+        assert not any(pool.refcounts[b] for b in pool._free)
+        assert 0 <= eng._kv_reserved <= pool.num_blocks
+        assert m["kv_cow_copies"] >= 0
+    else:
+        assert m["kv_blocks_total"] is None
+        assert m["kv_cow_copies"] == 0
     return m
 
 

@@ -1,0 +1,476 @@
+"""Paged KV cache (ISSUE 6): ONE block pool + per-slot block tables.
+
+Contracts under test:
+  * allocator soundness: free-list/refcount reconciliation, the
+    Smax % Bt construction-time assert, and the ONE-knob validation
+    (pool block == prefix block == prefill_cap);
+  * zero-copy prefix machinery: publish pins pool blocks by reference,
+    eviction drops only the store's reference, reclaim frees under
+    memory pressure;
+  * EXACT paged-vs-dense token parity (greedy + sampled, fp + int8
+    cache, prefix cache on/off, spec on/off) under admission/eviction
+    churn — the paged layout must be invisible in the tokens;
+  * zero retraces after warmup with the paged path (block ids are
+    data, never structure);
+  * copy-on-write: fork_slot shares every block, divergence copies
+    exactly the touched block, the twin's view is untouched;
+  * pool-bounded admission: AdmissionFull on an explicitly sized
+    exhausted pool, recovery once eviction releases the commitment.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged_kv import (BlockPool, PagedPrefixCache,
+                                           PagedPrefixStore)
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+
+
+def _model(seed=3):
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return fmt, embed, head
+
+
+def _prompt(rng, n):
+    return rng.randint(1, V, (n,)).astype(np.int32)
+
+
+def _engine(fmt, embed, head, paged, **kw):
+    from paddle_tpu.inference.serving import ServingEngine
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("decode_chunk", 2)
+    return ServingEngine(fmt, embed, head, paged=paged, **kw)
+
+
+def _run(eng, reqs):
+    rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    eng.run()
+    return [eng.results[r]["tokens"] for r in rids]
+
+
+class TestBlockPool:
+    def test_alloc_free_reconciles(self):
+        pool = BlockPool(4, 8, 64)
+        a = pool.alloc(2)
+        assert sorted(a) == [0, 1] and pool.used == 2
+        pool.ref([a[0]])
+        pool.deref([a[0]])                       # still held once
+        assert pool.used == 2
+        pool.deref(a)                            # both free now
+        assert pool.used == 0 and pool.free_count == 4
+        assert pool.alloc(5) is None             # all-or-nothing
+        assert pool.free_count == 4
+
+    def test_refcount_underflow_and_free_ref_raise(self):
+        pool = BlockPool(2, 8, 64)
+        (b,) = pool.alloc(1)
+        pool.deref([b])
+        with pytest.raises(RuntimeError, match="underflow"):
+            pool.deref([b])
+        with pytest.raises(RuntimeError, match="free block"):
+            pool.ref([b])
+
+    def test_smax_must_align_to_block_tokens(self):
+        """The satellite assert: a ragged last block would gather out
+        of bounds downstream — refuse at construction with a clear
+        message instead."""
+        with pytest.raises(ValueError, match="multiple of block_tokens"):
+            BlockPool(4, 8, 60)
+        with pytest.raises(ValueError, match="power of two"):
+            BlockPool(4, 6, 60)
+
+    def test_one_knob_pool_vs_prefill_cap(self):
+        """prefill_cap, prefix block_tokens and the pool Bt are ONE
+        value — a mismatched explicit pool is refused naming both."""
+        fmt, embed, head = _model()
+        with pytest.raises(ValueError, match="block_tokens=8.*"
+                           "prefill_cap=16"):
+            _engine(fmt, embed, head, True, prefill_cap=16,
+                    kv_pool=BlockPool(8, 8, 128))
+        with pytest.raises(ValueError, match="ONE value"):
+            PagedPrefixStore(4, 16, BlockPool(8, 8, 128))
+
+    def test_copy_block_copies_exactly_one_block(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        pool = BlockPool(4, 8, 64)
+        caches = {"kv": jnp.asarray(rng.randn(L, 2, 4, H, 8, 8),
+                                    jnp.float32)}
+        before = np.asarray(caches["kv"])
+        out = pool.copy_block(caches, 1, 3)
+        after = np.asarray(out["kv"])
+        np.testing.assert_array_equal(after[:, :, 3], before[:, :, 1])
+        np.testing.assert_array_equal(after[:, :, :3], before[:, :, :3])
+        assert pool.trace_count == 1
+        pool.copy_block(out, 0, 2)
+        assert pool.trace_count == 1             # executable reused
+
+
+class TestPagedPrefixStore:
+    def _pool_store(self, budget=4, bt=2, nb=8):
+        pool = BlockPool(nb, bt, 16)
+        return pool, PagedPrefixStore(budget, bt, pool)
+
+    def test_publish_pins_by_reference_no_copy(self):
+        pool, st = self._pool_store()
+        ids = pool.alloc(2)                      # the "slot's" blocks
+        plan = st.publish(np.asarray([1, 2, 3, 4]), ids)
+        assert [new for _, new in plan] == [True, True]
+        assert [n.block for n, _ in plan] == ids
+        assert list(pool.refcounts[ids]) == [2, 2]   # slot + store
+        # slot releases -> blocks stay resident through the store ref
+        pool.deref(ids)
+        assert pool.used == 2
+        again = st.publish(np.asarray([1, 2, 3, 4]), [7, 7])
+        assert [new for _, new in again] == [False, False]   # dedup
+
+    def test_eviction_drops_only_store_reference(self):
+        pool, st = self._pool_store(budget=1)
+        ids = pool.alloc(2)
+        st.publish(np.asarray([1, 2]), [ids[0]])
+        # budget 1: the next publish evicts the LRU leaf, which merely
+        # derefs — the "slot" still holds ids[0], so it stays resident
+        st.publish(np.asarray([5, 6]), [ids[1]])
+        assert st.stats()["evictions"] == 1
+        assert pool.refcounts[ids[0]] == 1       # slot ref survives
+        assert len(st.match(np.asarray([1, 2]))) == 0
+
+    def test_reclaim_frees_cold_chains(self):
+        pool, st = self._pool_store(budget=4, nb=4)
+        ids = pool.alloc(4)
+        st.publish(np.arange(1, 9), ids)         # 4-block chain
+        pool.deref(ids)                          # owner finished
+        assert pool.free_count == 0
+        freed = st.reclaim(2)
+        assert freed == 2 and pool.free_count == 2
+        s = st.stats()
+        assert s["blocks_used"] + s["blocks_free"] == s["blocks_capacity"]
+
+    def test_insert_is_refused(self):
+        pool, st = self._pool_store()
+        with pytest.raises(NotImplementedError, match="publish"):
+            st.insert(np.asarray([1, 2]))
+
+
+class TestPagedParity:
+    """The tentpole contract: the paged layout is INVISIBLE in the
+    tokens — exact parity with the dense ring across every serving
+    flavor, under slot churn (5+ requests through 2 slots)."""
+
+    # prefill_cap=64 drives the paged Pallas kernel (Bt meets the
+    # sublane tiling); prefill_cap=4 drives the gather-dense fallback
+    @pytest.mark.parametrize("cap", [64, 4])
+    def test_greedy_parity_under_churn(self, cap, serving_metrics_ok):
+        fmt, embed, head = _model()
+        rng = np.random.RandomState(0)
+        reqs = [(_prompt(rng, s), m)
+                for s, m in [(5, 6), (3, 4), (7, 8), (4, 5), (6, 3)]]
+        toks_p = _run(_engine(fmt, embed, head, True, prefill_cap=cap),
+                      reqs)
+        eng_d = _engine(fmt, embed, head, False, prefill_cap=cap)
+        toks_d = _run(eng_d, reqs)
+        assert not eng_d.paged and eng_d.pool is None
+        for a, b in zip(toks_p, toks_d):
+            np.testing.assert_array_equal(a, b)
+
+    def test_sampled_parity(self):
+        fmt, embed, head = _model(seed=8)
+        rng = np.random.RandomState(1)
+        reqs = [(_prompt(rng, s), m)
+                for s, m in [(5, 8), (3, 6), (6, 8), (4, 6)]]
+
+        def run(paged):
+            paddle.seed(0)               # identical sampling key stream
+            return _run(_engine(fmt, embed, head, paged,
+                                do_sample=True, top_k=5), reqs)
+        for a, b in zip(run(True), run(False)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_int8_cache_parity(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        fmt, embed, head = _model(seed=5)
+        rng = np.random.RandomState(2)
+        reqs = [(_prompt(rng, s), m)
+                for s, m in [(5, 6), (3, 5), (6, 4)]]
+        for a, b in zip(_run(_engine(fmt, embed, head, True), reqs),
+                        _run(_engine(fmt, embed, head, False), reqs)):
+            np.testing.assert_array_equal(a, b)
+
+    def _shared_reqs(self, rng, n=10):
+        prefixes = [_prompt(rng, 8) for _ in range(3)]
+        reqs = [(prefixes[0].copy(), 3), (prefixes[0].copy(), 3)]
+        for i in range(n):
+            reqs.append((np.concatenate(
+                [prefixes[i % 3], _prompt(rng, 2 + i % 5)]), 4))
+        return reqs
+
+    @pytest.mark.parametrize("sample", [False, True])
+    def test_prefix_cache_parity_under_eviction_churn(
+            self, sample, serving_metrics_ok):
+        """Paged prefix caching (zero-copy adopt/publish) must match
+        BOTH the paged cache-off run and the dense cache-on run, token
+        for token — with a 3-block store budget forcing constant
+        eviction/republication churn."""
+        fmt, embed, head = _model(seed=31)
+        rng = np.random.RandomState(5)
+        reqs = self._shared_reqs(rng)
+
+        def run(paged, blocks):
+            paddle.seed(0)
+            eng = _engine(fmt, embed, head, paged, prefill_cap=4,
+                          prefix_cache_blocks=blocks,
+                          do_sample=sample, top_k=5)
+            return eng, _run(eng, reqs)
+
+        eng_on, t_on = run(True, 3)
+        _, t_off = run(True, 0)
+        _, t_dense = run(False, 3)
+        for a, b in zip(t_on, t_off):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(t_on, t_dense):
+            np.testing.assert_array_equal(a, b)
+        m = serving_metrics_ok(eng_on)
+        assert isinstance(eng_on.prefix_cache, PagedPrefixCache)
+        assert m["prefix_hits"] > 0
+        assert m["prefill_tokens_saved"] > 0
+        assert m["prefix_store"]["evictions"] > 0
+
+    def test_spec_decode_parity(self, serving_metrics_ok):
+        """spec_k on the paged path: greedy outputs token-identical to
+        paged spec-off AND to the dense spec-on engine."""
+        fmt, embed, head = _model(seed=13)
+        rng = np.random.RandomState(0)
+        reqs = [(np.tile(_prompt(rng, 6), 3), 24) for _ in range(5)]
+
+        def run(paged, k):
+            paddle.seed(0)
+            eng = _engine(fmt, embed, head, paged, spec_k=k)
+            return eng, _run(eng, reqs)
+
+        eng_pk, t_pk = run(True, 4)
+        _, t_p0 = run(True, 0)
+        _, t_dk = run(False, 4)
+        for a, b in zip(t_pk, t_p0):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(t_pk, t_dk):
+            np.testing.assert_array_equal(a, b)
+        m = serving_metrics_ok(eng_pk)
+        assert m["draft_accepted"] > 0           # speculation really ran
+
+
+class TestPagedChurn:
+    def test_zero_retraces_after_warmup(self, serving_metrics_ok):
+        """Block ids are DATA: slot churn, lazy block mapping, prefix
+        adoption and eviction must not trace anything new once warmup
+        exercised the bucket ladder."""
+        fmt, embed, head = _model(seed=32)
+        rng = np.random.RandomState(6)
+        prefixes = [_prompt(rng, 8) for _ in range(2)]
+        reqs = [(np.concatenate([prefixes[i % 2],
+                                 _prompt(rng, 2 + i % 4)]), 4)
+                for i in range(12)]
+        eng = _engine(fmt, embed, head, True, prefill_cap=4,
+                      prefix_cache_blocks=16)
+        for p, m in reqs[:6]:
+            eng.submit(p, max_new_tokens=m)
+        eng.run()
+        warm = eng.metrics()["traces"]
+        assert warm > 0
+        for p, m in reqs[6:]:
+            eng.submit(p, max_new_tokens=m)
+        eng.run()
+        m = serving_metrics_ok(eng)
+        assert m["traces"] == warm, (
+            f"paged churn retraced: {warm} -> {m['traces']}")
+        assert m["prefix_hits"] > 0
+        # everything returned to the pool except the store's pins
+        assert m["kv_blocks_used"] == \
+            int((eng.pool.refcounts > 0).sum())
+
+    def test_request_at_exact_ring_capacity(self):
+        """The boundary request (final write at Smax - 1) completes on
+        the paged path and maps exactly Smax/Bt blocks."""
+        fmt, embed, head = _model(seed=14)
+        rng = np.random.RandomState(5)
+        eng = _engine(fmt, embed, head, True, num_slots=1)
+        rid = eng.submit(_prompt(rng, 120), max_new_tokens=8)
+        eng.run()
+        assert eng.results[rid]["tokens"].size == 8
+        assert int(eng._lens[0]) == 127
+        assert eng.metrics()["kv_blocks_used"] == 0   # freed on finish
+
+
+class TestCopyOnWrite:
+    def test_fork_shares_then_cow_diverges(self, serving_metrics_ok):
+        """Two slots share a prefix block and diverge: fork_slot clones
+        a running request by table copy (+refcounts, zero data
+        movement); the first divergent write triggers the COW of just
+        that block, and the twin's tokens/prefix stay intact."""
+        fmt, embed, head = _model(seed=7)
+        rng = np.random.RandomState(9)
+        eng = _engine(fmt, embed, head, True, do_sample=True, top_k=20,
+                      temperature=5.0)
+        rid = eng.submit(_prompt(rng, 9), max_new_tokens=24)
+        eng.step()
+        eng.step()
+        n_fork = len(eng._slot_req[0].tokens)    # generated so far
+        used_before = eng.metrics()["kv_blocks_used"]
+        child = eng.fork_slot(rid)
+        # the fork added ZERO blocks: pure table copy + refcounts
+        assert eng.metrics()["kv_blocks_used"] == used_before
+        shared = int((eng.pool.refcounts > 1).sum())
+        assert shared > 0
+        eng.run()
+        m = serving_metrics_ok(eng)
+        a = eng.results[rid]["tokens"]
+        b = eng.results[child]["tokens"]
+        assert len(a) == len(b) == 24
+        # the pre-fork generated prefix is common; the suffixes diverge
+        np.testing.assert_array_equal(a[:n_fork], b[:n_fork])
+        assert list(a) != list(b)
+        # divergence copied at least the shared write block — and ONLY
+        # blocks, never rows (the counter counts block copies)
+        assert m["kv_cow_copies"] >= 1
+        assert m["kv_blocks_used"] == 0          # both freed cleanly
+
+    def test_fork_reconciles_with_prefix_metrics(self,
+                                                 serving_metrics_ok):
+        """A fork is a CLONE, not an admission: it performs no prefix
+        lookup, so it must ride `requests_forked` — counting it as
+        admitted broke hits + misses == admitted on prefix-cache
+        engines."""
+        fmt, embed, head = _model(seed=17)
+        rng = np.random.RandomState(3)
+        eng = _engine(fmt, embed, head, True, prefill_cap=4,
+                      prefix_cache_blocks=8, do_sample=True, top_k=10)
+        rid = eng.submit(_prompt(rng, 9), max_new_tokens=8)
+        eng.step()
+        eng.fork_slot(rid)
+        eng.run()
+        m = serving_metrics_ok(eng)        # reconciliation holds
+        assert m["requests_forked"] == 1
+        assert m["requests_admitted"] == 1
+        assert m["requests_finished"] == 2
+
+    def test_fork_requires_paged(self):
+        fmt, embed, head = _model(seed=7)
+        eng = _engine(fmt, embed, head, False)
+        with pytest.raises(ValueError, match="paged"):
+            eng.fork_slot(0)
+
+
+class TestPoolExhaustion:
+    def test_admission_full_then_recovery(self, serving_metrics_ok):
+        """An EXPLICITLY sized pool is a stated memory budget: submit
+        sheds with AdmissionFull when queued+running commitments would
+        exceed it, and recovers once eviction releases blocks. The
+        pool — not the slot count — is the bound (4 free slots here)."""
+        from paddle_tpu.inference.serving import AdmissionFull
+        fmt, embed, head = _model(seed=21)
+        rng = np.random.RandomState(0)
+        eng = _engine(fmt, embed, head, True, num_slots=4,
+                      prefill_cap=4, kv_pool_blocks=6)
+        assert eng._kv_gate
+        # each request: 5 prompt + 6 new = 11 tokens -> 3 blocks
+        eng.submit(_prompt(rng, 5), max_new_tokens=6)
+        eng.submit(_prompt(rng, 5), max_new_tokens=6)
+        with pytest.raises(AdmissionFull, match="kv pool exhausted"):
+            eng.submit(_prompt(rng, 5), max_new_tokens=6)
+        assert eng.metrics()["requests_rejected"] == 1
+        eng.run()                                # eviction frees blocks
+        rid = eng.submit(_prompt(rng, 5), max_new_tokens=6)
+        eng.run()
+        assert eng.results[rid]["tokens"].size == 6
+        m = serving_metrics_ok(eng)
+        assert m["requests_finished"] == 3
+        assert m["kv_blocks_used"] == 0
+
+    def test_exact_reservation_fill_completes(self, serving_metrics_ok):
+        """Requests whose worst-case reservations EXACTLY fill the pool
+        must run to completion: the per-chunk write-window mapping is
+        clamped to each slot's token budget, so the final chunk (whose
+        raw window [lens, lens+chunk) crosses past the last budgeted
+        position) never asks for a block beyond the reservation
+        (crashed with 'pool over-committed' before the clamp)."""
+        fmt, embed, head = _model(seed=27)
+        rng = np.random.RandomState(2)
+        eng = _engine(fmt, embed, head, True, num_slots=2,
+                      prefill_cap=4, kv_pool_blocks=6, decode_chunk=4)
+        # 6 prompt + 6 new = 12 tokens = exactly 3 blocks each; the
+        # last decode chunk's unclamped window would touch block 3
+        rids = [eng.submit(_prompt(rng, 6), max_new_tokens=6)
+                for _ in range(2)]
+        eng.run()
+        assert all(eng.results[r]["tokens"].size == 6 for r in rids)
+        m = serving_metrics_ok(eng)
+        assert m["kv_blocks_used"] == 0
+
+    def test_never_fitting_request_is_a_value_error(self):
+        fmt, embed, head = _model(seed=22)
+        eng = _engine(fmt, embed, head, True, num_slots=1,
+                      prefill_cap=4, kv_pool_blocks=4)
+        with pytest.raises(ValueError, match="never"):
+            eng.submit(np.ones(30, np.int32), max_new_tokens=40)
+
+    def test_default_pool_never_sheds(self):
+        """Default sizing (B x Smax/Bt == the dense HBM footprint) must
+        behave exactly like the dense engine: queue absorbs any burst,
+        no kv gate."""
+        fmt, embed, head = _model(seed=23)
+        rng = np.random.RandomState(1)
+        eng = _engine(fmt, embed, head, True)
+        assert not eng._kv_gate
+        rids = [eng.submit(_prompt(rng, 4), max_new_tokens=3)
+                for _ in range(12)]              # 6x the slot count
+        eng.run()
+        assert all(eng.results[r]["tokens"].size == 3 for r in rids)
+
+
+class TestPagedEnvKnob:
+    def test_env_flag_selects_the_layout(self, monkeypatch):
+        fmt, embed, head = _model(seed=24)
+        monkeypatch.setenv("PADDLE_SERVING_PAGED", "0")
+        eng = _engine(fmt, embed, head, None)
+        assert not eng.paged and eng.pool is None
+        monkeypatch.setenv("PADDLE_SERVING_PAGED", "1")
+        eng = _engine(fmt, embed, head, None)
+        assert eng.paged and eng.pool is not None
+
+    def test_kv_budget_on_a_dense_engine_is_refused(self, monkeypatch):
+        """A stated pool budget must never be silently dropped: a
+        dense-resolved engine (env off / paged=False) with
+        kv_pool_blocks= fails fast instead of serving without the
+        AdmissionFull gate the operator asked for."""
+        fmt, embed, head = _model(seed=26)
+        with pytest.raises(ValueError, match="DENSE"):
+            _engine(fmt, embed, head, False, kv_pool_blocks=8)
+        monkeypatch.setenv("PADDLE_SERVING_PAGED", "0")
+        with pytest.raises(ValueError, match="DENSE"):
+            _engine(fmt, embed, head, None,
+                    kv_pool=BlockPool(8, 64, 128))
+
+    def test_shared_dense_prefix_cache_forces_dense(self):
+        """A cross-engine dense PrefixCache keeps working (its pool is
+        separate storage): default-paged engines silently fall back,
+        an EXPLICIT paged=True is refused loudly — and an engine-
+        private PagedPrefixCache is refused as prefix_cache= instead
+        of dying later with an AttributeError in _admit."""
+        from paddle_tpu.inference.prefix_cache import PrefixCache
+        fmt, embed, head = _model(seed=25)
+        pc = PrefixCache(8, 64)
+        eng = _engine(fmt, embed, head, None, prefix_cache=pc)
+        assert not eng.paged
+        with pytest.raises(ValueError, match="paged"):
+            _engine(fmt, embed, head, True, prefix_cache=pc)
+        paged_pc = PagedPrefixCache(8, 64, BlockPool(8, 64, 128))
+        with pytest.raises(ValueError, match="PagedPrefixCache"):
+            _engine(fmt, embed, head, None, prefix_cache=paged_pc)

@@ -462,7 +462,11 @@ class TestPrefixCacheServing:
         eng.reset_metrics(keep_results=False)
         after = eng.metrics()
         for k in fresh:
-            if k in ("traces", "prefix_store"):
+            if k in ("traces", "prefix_store", "kv_blocks_total",
+                     "kv_blocks_used", "kv_blocks_free"):
+                # allocator STATE, not window counters: published
+                # prefix blocks legitimately stay resident across a
+                # metrics reset (like the trace spy and store stats)
                 continue
             assert after[k] == fresh[k], (
                 f"reset_metrics missed {k}: {after[k]!r} != fresh "
@@ -519,6 +523,32 @@ class TestServingBench:
         # ~1.4x tokens/s and ~2x better TTFT p50; 12 requests here)
         assert rec["value"] > 1.1
         assert rec["ttft_p50_ms_on"] < rec["ttft_p50_ms_off"]
+
+    def test_bench_paged_kv_sweep(self, monkeypatch, capsys, tmp_path):
+        """The paged-KV capacity A/B (equal KV memory, 4x slots; plus
+        the equal-slot per-step-cost check and the exact token-parity
+        gate). Slow-marked like the other sweeps: tier-1 covers the
+        paged layout through tests/test_paged_kv.py; this drives the
+        full bench. Output redirects to tmp so CI can't clobber the
+        committed record."""
+        import json
+        import bench_serving
+        monkeypatch.setattr(bench_serving, "__file__",
+                            str(tmp_path / "bench_serving.py"))
+        monkeypatch.setenv("BENCH_SERVE_REQUESTS", "12")
+        rc = bench_serving.main(["--paged"])
+        assert rc == 0
+        rec = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["parity_ok"] is True
+        assert rec["retraces_after_warmup"] == 0
+        assert rec["retraces_after_warmup_dense"] == 0
+        # the capacity win: strictly more concurrent slots than the
+        # dense engine can physically hold at the same KV bytes
+        assert rec["value"] >= 1.5
+        # per-step cost at equal shape: margin below the ~0.97 the
+        # full fixed-seed bench shows (12 requests here, CI jitter)
+        assert rec["tokens_per_sec_ratio_equal_slots"] > 0.8
 
     def test_bench_spec_decode_sweep(self, monkeypatch, capsys,
                                      tmp_path):
