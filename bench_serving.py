@@ -48,10 +48,22 @@ parity check at equal shape. Its knobs: BENCH_PAGED_CAP (block tokens
 == prefill_cap), BENCH_PAGED_SLOTS (paged-side slot count, default
 4 x BENCH_SLOTS).
 
+--chunked runs the TOKEN-BUDGET (chunked prefill) overload A/B: a
+long-prompt Poisson mix (the regime where one prompt's prefill holds
+the decode gang hostage) at 2x offered load, the chunked engine
+(default token_budget) vs the legacy PHASE-prefill engine
+(token_budget=0) at equal compiled shape and the SAME arrivals —
+reporting TTFT p50/p90/p99 straight from engine metrics() (no
+out-of-band percentile math), the p99/p50 flatness ratio, tokens/s,
+budget utilization, an exact greedy chunked-vs-phase token-parity
+check, and the zero-retrace contract. Its knobs: BENCH_TOKEN_BUDGET
+(default: the engine default B x decode_chunk), BENCH_CHUNKED_LONG
+(long-prompt fraction, default 0.6).
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
-the paged record under "paged_kv"; each mode preserves the others'
-records).
+the paged record under "paged_kv", the chunked-prefill record under
+"chunked_prefill"; each mode preserves the others' records).
 """
 from __future__ import annotations
 
@@ -149,7 +161,8 @@ def _collect(eng, sub, arrivals):
     return ttft, lat, toks
 
 
-_SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv")
+_SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
+                "chunked_prefill")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -212,6 +225,8 @@ def main(argv=None):
         return main_spec()
     if "--paged" in argv:
         return main_paged()
+    if "--chunked" in argv:
+        return main_chunked()
     from bench import _init_devices
     jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
@@ -888,6 +903,242 @@ def main_paged():
         rc = 1
     if not parity_ok:
         print("bench_serving: PAGED/DENSE TOKEN PARITY BROKE",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def _make_longprompt_workload(rng, n, v, smax, long_frac):
+    """The TTFT-hostage regime: a Poisson mix where most requests carry
+    LONG prompts (document/context-stuffing traffic) next to short
+    interactive ones, all with short-to-medium generations — under
+    phase prefill one long admission stalls the whole decode gang and
+    the short requests' TTFT p99 blows out."""
+    import numpy as np
+    reqs = []
+    for _ in range(n):
+        if rng.uniform() < long_frac:
+            plen = int(rng.randint(96, 161))
+        else:
+            plen = int(rng.randint(8, 25))
+        max_new = int(rng.choice([8, 16, 24]))
+        prompt = rng.randint(1, v, (plen,)).astype("int32")
+        reqs.append((prompt, min(max_new, smax - plen)))
+    return reqs
+
+
+def main_chunked():
+    """Token-budget (chunked prefill) overload A/B: the chunked engine
+    (default token_budget) vs the legacy phase-prefill engine
+    (token_budget=0), same compiled shapes, same fixed-seed long-prompt
+    Poisson workload at 2x offered load and the SAME arrivals (rate
+    from the PHASE engine's measured capacity). TTFT percentiles come
+    straight from engine metrics() — the engine owns them now — and
+    the headline value is the chunked side's p99/p50 flatness ratio.
+    Also runs an exact greedy chunked-vs-phase token-parity check and
+    asserts the zero-retrace contract on both sides. Lands under
+    "chunked_prefill" in BENCH_serving.json."""
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "2.0"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    long_frac = float(os.environ.get("BENCH_CHUNKED_LONG", "0.6"))
+    # a serving-scale budget (Sarathi budgets are hundreds of tokens):
+    # C = budget/B columns per row, so 64/slot lets a whole classic
+    # prompt (and a 64-token chunk of a long one) land per dispatch.
+    # The ENGINE default stays B x decode_chunk — right for
+    # latency-lean deployments; a bench at overload wants throughput.
+    tb_env = os.environ.get("BENCH_TOKEN_BUDGET")
+    token_budget = int(tb_env) if tb_env else 64 * slots
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
+
+    rng = np.random.RandomState(seed)
+    # solo admissions covering every pow-2 prefill bucket the phase
+    # engine's bulk admission can hit (8..256); the chunked engine's
+    # budget core is shape-invariant but warms on the same stream
+    bucket_reqs = [(rng.randint(1, V, (p,)).astype("int32"), 4)
+                   for p in (4, 8, 16, 32, 64, 128, 160)]
+    warm_reqs = _make_longprompt_workload(rng, 2 * slots, V, smax,
+                                          long_frac)
+    meas_reqs = _make_longprompt_workload(rng, n_meas, V, smax,
+                                          long_frac)
+    # the THROUGHPUT and FLATNESS gates run on the CLASSIC workload
+    # shape (the tentpole's "tokens/s within 5% of the phase baseline
+    # on the classic workload"; the 2-3x p99/p50 complaint in the
+    # motivation IS the classic record's) at 2x the classic record's
+    # offered load (2 x 1.5 = 3.0) — chunking must not tax the steady
+    # mixed-length flow AND must keep its TTFT tail flat where phase
+    # admission stalls spike it
+    classic_load = float(os.environ.get("BENCH_CHUNKED_CLASSIC_LOAD",
+                                        "3.0"))
+    classic_reqs = _make_workload(rng, n_meas, V, min(smax, 128))
+
+    def run_mode(tb, reqs, ld, arrivals=None):
+        clock = VirtualClock()
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            clock=clock.now, token_budget=tb)
+        for prompt, max_new in bucket_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+            eng.run()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        eng.reset_metrics(keep_results=False)
+        t0 = clock.now()
+        for prompt, max_new in warm_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        warm = eng.metrics()
+        cap = warm["tokens_emitted"] / max(clock.now() - t0, 1e-9)
+        traces_warm = warm["traces"]
+        eng.reset_metrics(keep_results=False)
+
+        if arrivals is None:
+            mean_new = float(np.mean([m for _, m in reqs]))
+            rate = ld * cap / mean_new
+            arr_rng = np.random.RandomState(seed + 1)
+            arrivals = np.cumsum(
+                arr_rng.exponential(1.0 / rate, size=len(reqs)))
+        arr = arrivals + clock.now()
+        t_start = clock.now()
+        _drive_continuous(eng, clock, reqs, arr)
+        elapsed = clock.now() - t_start
+        m = eng.metrics()
+        # TTFT/latency straight from the engine (satellite: the bench
+        # no longer computes percentiles out-of-band) — the driver
+        # submits each request the moment it is due, so submit-based
+        # engine TTFT matches the arrival-based view
+        return {
+            "scheduler": "chunked" if tb != 0 else "phase",
+            "token_budget": eng.token_budget,
+            "tokens": m["tokens_emitted"],
+            "tokens_per_sec": round(m["tokens_emitted"]
+                                    / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "capacity_tokens_per_sec": round(cap, 2),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+            "ttft_p50_ms": round(1e3 * m["ttft_p50_s"], 1),
+            "ttft_p90_ms": round(1e3 * m["ttft_p90_s"], 1),
+            "ttft_p99_ms": round(1e3 * m["ttft_p99_s"], 1),
+            "ttft_p99_over_p50": round(m["ttft_p99_s"]
+                                       / max(m["ttft_p50_s"], 1e-9), 3),
+            "latency_p50_ms": round(1e3 * m["latency_p50_s"], 1),
+            "latency_p99_ms": round(1e3 * m["latency_p99_s"], 1),
+            "budget_steps": m["budget_steps"],
+            "budget_utilization": m["budget_utilization"],
+            "budget_prefill_tokens": m["budget_prefill_tokens"],
+        }, arrivals
+
+    # long-prompt overload half (the TTFT-flatness story), then the
+    # classic-workload half (the throughput-parity gate), each with
+    # SAME arrivals across the two schedulers
+    phase, arrivals = run_mode(0, meas_reqs, load)
+    chunked, _ = run_mode(token_budget, meas_reqs, load, arrivals)
+    phase_cl, arr_cl = run_mode(0, classic_reqs, classic_load)
+    chunk_cl, _ = run_mode(token_budget, classic_reqs, classic_load,
+                           arr_cl)
+
+    # exact greedy parity at equal shape (the scheduler-invisibility
+    # token contract)
+    par_reqs = _make_longprompt_workload(rng, 2 * slots, V, smax,
+                                         long_frac)
+
+    def parity_run(tb):
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            token_budget=tb)
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in par_reqs]
+        eng.run()
+        return [eng.results[r]["tokens"].tolist() for r in rids]
+
+    parity_ok = parity_run(token_budget) == parity_run(0)
+
+    record = {
+        "metric": "serving_chunked_prefill_ttft_p99_over_p50",
+        # headline: TTFT-tail flatness on the long-prompt mix at 2x
+        # offered load, chunked vs the phase scheduler at the SAME
+        # arrivals. HONESTY NOTE (pinned by the runs behind this
+        # record): at a SUSTAINED 2-3x overload the p99/p50 ratio is
+        # backlog-shaped for ANY scheduler (~1.4-1.7 here), and on
+        # this dispatch-bound CPU toy model a whole-prompt bulk
+        # prefill costs only ~3 decode chunks, so the phase scheduler
+        # barely exhibits the hostage stall the <= 1.3 target assumes
+        # — chunked's win shows as flatness within a few % of phase's WHILE
+        # streaming prefill, plus throughput parity on the classic
+        # workload; the <= 1.3 absolute regime needs compute-bound
+        # prefill (real accelerator), where one bulk costs tens of
+        # decode steps and victims dominate the tail.
+        "value": chunked["ttft_p99_over_p50"],
+        "unit": "x (chunked TTFT p99/p50, long-prompt mix at 2x load)",
+        "phase_ttft_p99_over_p50": phase["ttft_p99_over_p50"],
+        "ttft_p50_ms_chunked": chunked["ttft_p50_ms"],
+        "ttft_p90_ms_chunked": chunked["ttft_p90_ms"],
+        "ttft_p99_ms_chunked": chunked["ttft_p99_ms"],
+        "ttft_p50_ms_phase": phase["ttft_p50_ms"],
+        "ttft_p99_ms_phase": phase["ttft_p99_ms"],
+        "longprompt_tokens_per_sec_ratio": round(
+            chunked["tokens_per_sec"]
+            / max(phase["tokens_per_sec"], 1e-9), 3),
+        # the throughput-parity gate: the CLASSIC workload at 2x the
+        # classic record's offered load (tokens/s within 5% of phase)
+        "classic_load": classic_load,
+        "tokens_per_sec_chunked": chunk_cl["tokens_per_sec"],
+        "tokens_per_sec_phase": phase_cl["tokens_per_sec"],
+        "tokens_per_sec_ratio": round(
+            chunk_cl["tokens_per_sec"]
+            / max(phase_cl["tokens_per_sec"], 1e-9), 3),
+        "classic_ttft_p99_over_p50": chunk_cl["ttft_p99_over_p50"],
+        "classic_ttft_p99_over_p50_phase":
+            phase_cl["ttft_p99_over_p50"],
+        "retraces_after_warmup_classic": (
+            chunk_cl["retraces_after_warmup"]
+            + phase_cl["retraces_after_warmup"]),
+        "latency_p50_ms_chunked": chunked["latency_p50_ms"],
+        "latency_p50_ms_phase": phase["latency_p50_ms"],
+        "token_budget": chunked["token_budget"],
+        "budget_steps": chunked["budget_steps"],
+        "budget_utilization": chunked["budget_utilization"],
+        "budget_prefill_tokens": chunked["budget_prefill_tokens"],
+        "parity_ok": parity_ok,
+        "retraces_after_warmup": chunked["retraces_after_warmup"],
+        "retraces_after_warmup_phase": phase["retraces_after_warmup"],
+        "long_prompt_fraction": long_frac,
+        "num_slots": slots, "max_seq": smax, "decode_chunk": chunk,
+        "layers": L, "hidden": E, "vocab": V,
+        "requests": n_meas, "offered_load": load, "seed": seed,
+        "device": str(dev),
+        "cache_mode": ("int8" if os.environ.get(
+            "PADDLE_TPU_DECODE_INT8_CACHE") == "1" else "fp"),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "chunked_prefill", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    rc = 0
+    if record["retraces_after_warmup"] or \
+            record["retraces_after_warmup_phase"]:
+        print("bench_serving: RETRACES AFTER WARMUP under the token-"
+              "budget scheduler — the fixed-shape contract is broken",
+              file=sys.stderr)
+        rc = 1
+    if not parity_ok:
+        print("bench_serving: CHUNKED/PHASE TOKEN PARITY BROKE",
               file=sys.stderr)
         rc = 1
     return rc

@@ -102,6 +102,27 @@ def _sample_next(logits, do_sample, top_k, top_p, temperature, key=None):
                                   logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_rows(logits, do_sample, top_k, top_p, temperature, seeds, nt):
+    """Scheduling-invariant per-row sampling for the serving engine:
+    row b draws from fold_in(PRNGKey(seeds[b]), nt[b]) — the randomness
+    behind a request's nt-th generated token depends ONLY on (request
+    seed, position), never on which dispatch produced it. That makes
+    sampled outputs identical across schedulers (phase-prefill vs the
+    token-budget step, any chunk boundary, any slot assignment), which
+    is what lets the chunked-vs-phase parity tests assert EXACT sampled
+    token equality. Stateless by construction: a discarded sample (a
+    masked row, a teacher-forced prefill position) consumes nothing.
+    logits: [B, V]; seeds, nt: [B] int32 -> [B] int32 token ids."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits, do_sample, top_k, top_p, temperature)
+
+    def one(seed, n, lg):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), n)
+        return jax.random.categorical(key, lg)
+    return jax.vmap(one)(seeds, nt, logits).astype(jnp.int32)
+
+
 @no_grad()
 def generate(model, input_ids, max_new_tokens: int = 20,
              eos_token_id: Optional[int] = None, do_sample: bool = False,
@@ -1462,6 +1483,164 @@ class FusedDecoder:
                     jnp.int32)
             return caches, logits
         return verify
+
+    # ------------------------------------------------ token-budget step
+    def _build_budget_core(self, c, rep_on=False, do_sample=False,
+                           top_k=0, top_p=1.0, temperature=1.0,
+                           full_logits=False, chain=False, scan_tail=0):
+        """The unified TOKEN-BUDGET step (Sarathi-style chunked prefill:
+        every dispatch spends a fixed token budget mixing decode tokens
+        and prefill chunks, so a long prompt streams through spare
+        capacity instead of holding the decode gang hostage): ONE
+        compiled [B, C]-column pass generalizing the spec-verify core to
+        per-row SEGMENT lengths. Row b processes `seg[b]` real tokens
+        starting at its own base position `lens[b]` — a decode row's
+        segment is its current input token plus any draft tokens (spec
+        decoding is just another claim on the budget), a prefilling
+        row's segment is its next prompt chunk (teacher-forced), an idle
+        row ships seg == 0 and rides all-masked. Everything per-row is
+        DATA, so one executable covers every packing the scheduler can
+        emit — zero retraces across admission/prefill/decode/draft
+        churn.
+
+        `gen0[b]` is the column index at which row b's GENERATION
+        starts: 0 for decode rows, seg-1 for a prefill row finishing its
+        prompt this dispatch (the last prompt token's logits sample the
+        first generated token), C (never) for a mid-prompt chunk.
+        Position j is penalized as the (nt + max(0, j - gen0))-th
+        generated token; columns before gen0 are teacher-forced and
+        their outputs are discarded by the host.
+
+        Write discipline is the verify core's: K/V for the whole block
+        scatters through `valid = (col < seg) & (pos < Smax)` — masked
+        positions go out of bounds and drop (the `cache_lens < Smax`
+        clamp inventory in decode_attention.py; this step rides the
+        same spec_hidden path as the verify core), then one block-causal
+        attend covers prefix + segment.
+
+        Output (by static engine config): without spec (chain=False)
+        the ONLY block logits any consumer reads are each row's LAST
+        valid column's, so the core gathers that one hidden state per
+        row BEFORE the LM head ([B, E] through the head instead of
+        [B, C, V] — the head is the largest stream of the step, and at
+        C columns the full-chain head would cost C x the decode
+        step's), samples [B] tokens (argmax, or _sample_rows in
+        sampled mode — scheduling-invariant), and then runs
+        `scan_tail` TRAILING DECODE iterations in the SAME dispatch
+        (the decode-chunk scan body verbatim): rows that are decoding
+        — including a row whose prompt just finished in this very
+        block — keep emitting `decode_chunk` tokens per dispatch while
+        prefill streams, so mixed steps never slow decode below the
+        plain chunk. Returns (caches, tok0 [B], emit0 [B] bool,
+        ys (toks, emitted) [scan_tail, B], lens, active, nt, presence)
+        with ALL row state advanced on device, like the decode chunk.
+        With spec (chain=True) draft acceptance needs all segment
+        positions: greedy -> the [B, C] argmax chain, sampled
+        (full_logits=True) -> penalized logits [B, C, V] for host-side
+        rejection sampling (no trailing scan — accepted drafts already
+        make the block multi-token)."""
+        from .serving import _penalize_slots
+        core = self._build_step_core(False, 0, 1.0, 1.0)
+        spec_hidden, head_logits = core.spec_hidden, core.head_logits
+        hidden = core.hidden
+        smax = self.smax
+        c = int(c)
+        nscan = int(scan_tail)
+
+        def budget(stk, e_arrays, h_arrays, caches, toks, lens, seg,
+                   gen0, nt, max_nt, eos_ids, min_len, rep_pen,
+                   presence, seeds):
+            offs = jnp.arange(c, dtype=jnp.int32)[None, :]      # [1, C]
+            t2 = lens[:, None] + offs                           # [B, C]
+            valid = (offs < seg[:, None]) & (t2 < smax)
+            x, caches = spec_hidden(stk, e_arrays, caches, toks, lens,
+                                    valid)
+            if not chain:
+                # per-row gather at the last valid column, THEN the
+                # head: position seg-1 is a row's only consumed block
+                # output (its generated-token count there is exactly
+                # nt, so the per-slot penalty helper applies verbatim —
+                # the head being per-position linear, gather-then-head
+                # is bit-identical to head-then-gather)
+                last = jnp.maximum(seg - 1, 0)
+                xl = jnp.take_along_axis(x, last[:, None, None],
+                                         axis=1)
+                logits = head_logits(h_arrays, xl)
+                logits = logits.reshape(logits.shape[0], -1)
+                logits = _penalize_slots(
+                    logits, presence if rep_on else None, rep_pen, nt,
+                    min_len, eos_ids)
+                tok0 = _sample_rows(logits, do_sample, top_k, top_p,
+                                    temperature, seeds, nt)
+                # block bookkeeping, all vectorized: a row emitted iff
+                # its segment reached generation (decode rows always;
+                # a prefill row only when the prompt finished here)
+                emit0 = (seg > 0) & (gen0 < seg)
+                hit_eos = (eos_ids >= 0) & (tok0 == eos_ids)
+                lens = lens + seg                # consumed positions
+                nt = nt + emit0.astype(jnp.int32)
+                active = emit0 & ~hit_eos & (nt < max_nt)
+                tok = jnp.where(emit0, tok0, toks[:, 0])
+                if rep_on:
+                    presence = presence.at[
+                        jnp.arange(tok0.shape[0]), tok0].max(emit0)
+
+                def body(carry, _):
+                    tok, caches, lens, active, nt, presence = carry
+                    xs, caches = hidden(stk, e_arrays, caches, tok,
+                                        lens)
+                    lg = head_logits(h_arrays, xs)
+                    lg = lg.reshape(lg.shape[0], -1)
+                    lg = _penalize_slots(
+                        lg, presence if rep_on else None, rep_pen, nt,
+                        min_len, eos_ids)
+                    nxt = _sample_rows(lg, do_sample, top_k, top_p,
+                                       temperature, seeds, nt)
+                    emitted = active
+                    h_eos = (eos_ids >= 0) & (nxt == eos_ids)
+                    step_ = active.astype(jnp.int32)
+                    nt2 = nt + step_
+                    lens2 = lens + step_
+                    act2 = active & ~h_eos & (nt2 < max_nt)
+                    tok2 = jnp.where(emitted, nxt, tok)
+                    if rep_on:
+                        presence = presence.at[
+                            jnp.arange(nxt.shape[0]), nxt].max(emitted)
+                    return (tok2, caches, lens2, act2, nt2,
+                            presence), (nxt, emitted)
+                (tok, caches, lens, active, nt, presence), ys = \
+                    jax.lax.scan(
+                        body,
+                        (tok, caches, lens, active, nt, presence),
+                        None, length=nscan)
+                return (caches, tok0, emit0, ys, tok, lens, active, nt,
+                        presence)
+            logits = head_logits(h_arrays, x)
+            logits = logits.reshape(logits.shape[0], c, -1)
+            v = logits.shape[-1]
+            if rep_on:
+                # speculative presence, as in the verify core: position
+                # j's context adds the segment tokens consumed at
+                # columns <= j (prompt tokens are already in the carried
+                # presence — admission seeds it with the full prompt —
+                # so the cumulative OR only really adds draft tokens)
+                oh = (jax.nn.one_hot(toks, v, dtype=jnp.int32)
+                      * valid[..., None].astype(jnp.int32))
+                seen = (jnp.cumsum(oh, axis=1) > 0) | presence[:, None, :]
+                pen = rep_pen[:, None, None]
+                logits = jnp.where(
+                    seen,
+                    jnp.where(logits > 0, logits / pen, logits * pen),
+                    logits)
+            nt_eff = nt[:, None] + jnp.maximum(offs - gen0[:, None], 0)
+            cols = jnp.arange(v)[None, None, :]
+            is_eos = cols == eos_ids[:, None, None]
+            suppress = is_eos & (nt_eff < min_len[:, None])[..., None]
+            logits = jnp.where(suppress, -1e30, logits)
+            if full_logits:
+                return caches, logits
+            return caches, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return budget
 
     def _generate_beam(self, ids, last_x, caches, stk, e_arrays, h_arrays,
                        max_new_tokens, eos_token_id, k, length_penalty,

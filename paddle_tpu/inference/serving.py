@@ -54,6 +54,23 @@ pools shed honestly with `AdmissionFull` when commitments exceed it);
 the default sizing `B x Smax/Bt` equals the dense HBM footprint and
 never sheds. `metrics()` exposes `kv_blocks_used/free/total`.
 
+Token-budget scheduling (default; `token_budget=` /
+`PADDLE_SERVING_TOKEN_BUDGET`, 0 restores the legacy phase-prefill
+scheduler): every compiled step spends a fixed token budget mixing
+decode rows (one input token + any draft claim each) with prefill
+chunks from admitted-but-unprefilled slots — Sarathi-style chunked
+prefill. Admission is pure bookkeeping (slots enter a `prefilling`
+state; the budget packer advances them through spare step capacity),
+so one long prompt can no longer hold the whole decode gang hostage
+and TTFT p99 stays flat under load. The ONE [B, C]-column budget core
+(generation._build_budget_core) generalizes the spec-verify block to
+per-row segment lengths: segments, drafts, prefill cursors are all
+data, so every packing the scheduler can emit reuses one executable.
+Sampled mode draws each token from fold_in(request_seed, position)
+(generation._sample_rows), making sampled outputs EXACTLY invariant
+to the scheduler — the chunked-vs-phase parity tests pin token
+equality in both greedy and sampled mode.
+
 Speculative decoding (`spec_k=` / `PADDLE_SERVING_SPEC_K`): a per-slot
 model-free n-gram drafter (spec_decode.py) proposes up to K tokens per
 step from the request's own context; ONE compiled K+1-position verify
@@ -79,7 +96,8 @@ import numpy as np
 
 from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
-from .generation import FusedDecoder, _absmax_int8, _sample_next
+from .generation import (FusedDecoder, _absmax_int8, _host_seed,
+                         _sample_rows)
 
 __all__ = ["ServingEngine", "ServedRequest", "AdmissionFull"]
 
@@ -98,11 +116,12 @@ class ServedRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "min_length", "repetition_penalty", "state", "slot",
-                 "tokens", "t_submit", "t_first", "t_done", "deadline_s")
+                 "tokens", "t_submit", "t_first", "t_done", "deadline_s",
+                 "seed")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  min_length, repetition_penalty, t_submit,
-                 deadline_s=None):
+                 deadline_s=None, seed=0):
         self.rid = rid
         self.prompt = prompt                      # np.int32 [S]
         self.max_new_tokens = int(max_new_tokens)
@@ -116,6 +135,10 @@ class ServedRequest:
         self.t_first = None                       # first token time
         self.t_done = None
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        # per-request sampling seed: the engine's sampled mode draws
+        # each generated token from fold_in(PRNGKey(seed), position),
+        # so outputs are invariant to scheduling (see _sample_rows)
+        self.seed = int(seed)
 
     @property
     def ttft_s(self):
@@ -163,7 +186,8 @@ class ServingEngine:
                  enable_repetition_penalty=False, clock=None,
                  max_pending=None, prefill_cap=None,
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
-                 paged=None, kv_pool=None, kv_pool_blocks=None):
+                 paged=None, kv_pool=None, kv_pool_blocks=None,
+                 token_budget=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -320,18 +344,76 @@ class ServingEngine:
         self._drafters = ([NGramDrafter(self.spec_k)
                            for _ in range(int(num_slots))]
                           if self.spec_k else None)
-        # dispatch heuristic: a verify step only beats `decode_chunk`
-        # plain steps when enough draft tokens ride along to amortize
-        # its K+1-position pass — below `spec_min_draft` average drafts
-        # per active slot the engine runs the (equally warm) decode
-        # chunk instead, so thin-draft phases never pay the verify
-        # premium. 0 = always verify when spec is on.
+        # dispatch heuristic (PHASE mode only — DEPRECATED): a verify
+        # step only beats `decode_chunk` plain steps when enough draft
+        # tokens ride along to amortize its K+1-position pass — below
+        # `spec_min_draft` average drafts per active slot the phase
+        # engine runs the (equally warm) decode chunk instead. The
+        # token-budget scheduler subsumes this with budget arithmetic
+        # (drafts are just another claim on the step budget; the
+        # dispatch that processes more real tokens wins), so in chunked
+        # mode the env is ignored.
         self._spec_min_draft = float(os.environ.get(
             "PADDLE_SERVING_SPEC_MIN_DRAFT", "2"))
         self._spec_rng = None            # lazy: sampled-mode acceptance
         self._draft_proposed = 0
         self._draft_accepted = 0
         self._decode_steps = 0           # per-ROW sample events
+
+        # TOKEN-BUDGET scheduler (default ON): every compiled step
+        # spends `token_budget` tokens mixing decode rows (1 input
+        # token + any draft claim each) with prefill chunks from
+        # admitted-but-unprefilled slots — admission no longer runs a
+        # blocking prefill phase, so one long prompt can't hold the
+        # decode gang hostage (Sarathi-style chunked prefill).
+        # token_budget=0 restores the legacy PHASE-prefill scheduler
+        # (blocking bulk/scan prefill at admission) — kept as the A/B
+        # baseline and for `bench_serving.py --chunked`.
+        # default: C = max(4 x decode_chunk, spec_k + 1) columns per
+        # row — wide enough that a classic-length prompt (and a full
+        # draft) lands in ONE dispatch; measured on the classic CPU
+        # bench this beats the phase scheduler's bulk admission by
+        # ~15% tokens/s where the ISSUE's leaner B x decode_chunk
+        # (C = chunk) cost 15% (8 block steps per 32-token prompt)
+        tb_env = os.environ.get("PADDLE_SERVING_TOKEN_BUDGET")
+        tb = int(token_budget if token_budget is not None
+                 else tb_env if tb_env
+                 else self.num_slots * max(4 * self.decode_chunk,
+                                           self.spec_k + 1))
+        if tb < 0:
+            raise ValueError(f"token_budget must be >= 0, got {tb}")
+        if tb and tb < self.num_slots:
+            raise ValueError(
+                f"token_budget={tb} < num_slots={num_slots}: every "
+                "active decode row claims one mandatory token per step, "
+                "so the budget must cover at least the slot count "
+                "(token_budget=0 disables chunked scheduling entirely)")
+        self.token_budget = tb
+        # the compiled budget step's column count C: per-row segment
+        # cap, static shape. ceil(budget/B) rounds the shape to the
+        # budget; a full draft (spec_k + the input token) must also fit
+        # one row. pow-2 like every other ladder knob.
+        cw = max(-(-tb // self.num_slots) if tb else 1, self.spec_k + 1)
+        self._budget_cols = 1 << (cw - 1).bit_length()
+        if tb and self.spec_k and \
+                os.environ.get("PADDLE_SERVING_SPEC_MIN_DRAFT") is not None:
+            import warnings
+            warnings.warn(
+                "PADDLE_SERVING_SPEC_MIN_DRAFT is deprecated and "
+                "ignored under the token-budget scheduler (drafts are "
+                "budget claims; the dispatch choice is budget "
+                "arithmetic). Set token_budget=0 for the legacy phase "
+                "scheduler if you need the old heuristic.",
+                DeprecationWarning, stacklevel=2)
+        # prefill progress: prompt tokens still to feed per slot (> 0
+        # marks an admitted-but-unprefilled "prefilling" slot the
+        # budget packer advances, oldest request first)
+        self._pf_left = np.zeros(int(num_slots), np.int64)
+        self._budget_steps = 0
+        self._budget_tokens_used = 0
+        self._budget_prefill_tokens = 0
+        self._budget_decode_tokens = 0
+        self._budget_draft_tokens = 0
 
         b = self.num_slots
         fmt.eval()
@@ -355,6 +437,7 @@ class ServingEngine:
         self._min_len = np.zeros(b, np.int32)
         self._rep_pen = np.ones(b, np.float32)
         self._tok = np.zeros(b, np.int32)        # next step's input token
+        self._rseed = np.zeros(b, np.int64)      # per-request sample seed
         self._slot_req = [None] * b              # slot -> ServedRequest
         self._presence = None                    # [B, V] bool when rep_on
 
@@ -439,13 +522,21 @@ class ServingEngine:
             self._kv_committed += need
         req = ServedRequest(next(self._rid), ids, max_new_tokens,
                             eos_token_id, min_length, repetition_penalty,
-                            self.clock(), deadline_s=deadline_s)
+                            self.clock(), deadline_s=deadline_s,
+                            seed=self._fresh_seed())
         self._queue.append(req)
         return req.rid
 
+    def _fresh_seed(self):
+        """One per-request sampling seed off the global key stream
+        (greedy engines skip the draw: submit order then can't perturb
+        unrelated consumers of the global key)."""
+        return _host_seed(next_key()) if self.do_sample else 0
+
     @property
     def has_work(self):
-        return bool(self._queue) or bool(self._active.any())
+        return (bool(self._queue) or bool(self._active.any())
+                or bool((self._pf_left > 0).any()))
 
     @property
     def queue_depth(self):
@@ -453,21 +544,37 @@ class ServingEngine:
 
     @property
     def occupancy(self):
-        return float(self._active.mean()) if self.num_slots else 0.0
+        if not self.num_slots:
+            return 0.0
+        # a slot mid-prefill is occupied even though it isn't decoding
+        return float((self._active | (self._pf_left > 0)).mean())
 
     @no_grad()
     def step(self):
-        """One scheduler iteration: admit waiting requests into free
-        slots (in-slot prefill + first-token sample), then run one
-        compiled decode chunk and harvest it. Emits one chunk_log record.
-        Returns the number of tokens emitted this step."""
+        """One scheduler iteration. Token-budget mode (default): admit
+        waiting requests into free slots as PURE BOOKKEEPING (they
+        enter `prefilling` — no blocking prefill phase), then run one
+        budget-packed dispatch mixing decode rows and prefill chunks.
+        Phase mode (token_budget=0): the legacy blocking-prefill
+        admission + decode chunk. Emits one chunk_log record; returns
+        the number of tokens emitted this step."""
         t0 = self.clock()
         self._expire_deadlines(t0)
-        admitted = self._admit()
-        emitted = len(admitted)
-        if self._active.any():
-            emitted += (self._spec_decode_step() if self.spec_k
-                        else self._decode_one_chunk())
+        if self.token_budget:
+            self._admit_chunked()
+            emitted = self._budget_step()
+        else:
+            admitted = self._admit()
+            emitted = len(admitted)
+            if self._active.any():
+                emitted += (self._spec_decode_step() if self.spec_k
+                            else self._decode_one_chunk())
+        # re-check AFTER the dispatch: a deadline that lapsed while the
+        # step ran (or while admission waits on a head-of-line block
+        # reservation) must expire now, not one full step later — a
+        # queued request behind a pool-exhausted admission otherwise
+        # sits past its deadline for a whole extra dispatch
+        self._expire_deadlines(self.clock())
         dt = self.clock() - t0
         self._busy_s += dt
         self._tokens_emitted += emitted
@@ -504,6 +611,11 @@ class ServingEngine:
         self._draft_accepted = 0
         self._decode_steps = 0
         self._cow_copies = 0
+        self._budget_steps = 0
+        self._budget_tokens_used = 0
+        self._budget_prefill_tokens = 0
+        self._budget_decode_tokens = 0
+        self._budget_draft_tokens = 0
         if not keep_results:
             self.results = {}
 
@@ -536,7 +648,8 @@ class ServingEngine:
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
             "traces": self._traces_total(),
-            "ttft_p50_s": pct(ttfts, 50), "ttft_p99_s": pct(ttfts, 99),
+            "ttft_p50_s": pct(ttfts, 50), "ttft_p90_s": pct(ttfts, 90),
+            "ttft_p99_s": pct(ttfts, 99),
             "latency_p50_s": pct(lats, 50), "latency_p99_s": pct(lats, 99),
             # prefix-cache window counters (all zero with caching off):
             # hits + misses == requests_admitted by construction; saved +
@@ -573,6 +686,22 @@ class ServingEngine:
             "kv_blocks_free": (self.pool.free_count if self.paged
                                else None),
             "kv_cow_copies": self._cow_copies,
+            # token-budget window counters (all zero in phase mode):
+            # used = the REAL tokens packed into budget dispatches
+            # (prefill + decode + draft parts sum to it exactly — the
+            # conftest reconciliation pins the split), utilization =
+            # used / (steps x token_budget). Plain decode-chunk
+            # dispatches the budget arithmetic falls back to are NOT
+            # budget steps and don't count here.
+            "budget_steps": self._budget_steps,
+            "budget_tokens_used": self._budget_tokens_used,
+            "budget_prefill_tokens": self._budget_prefill_tokens,
+            "budget_decode_tokens": self._budget_decode_tokens,
+            "budget_draft_tokens": self._budget_draft_tokens,
+            "budget_utilization": (
+                round(self._budget_tokens_used
+                      / (self._budget_steps * self.token_budget), 4)
+                if self._budget_steps and self.token_budget else None),
         }
         if self.prefix_cache is not None:
             m["prefix_store"] = self.prefix_cache.store.stats()
@@ -747,7 +876,8 @@ class ServingEngine:
                 f"{self.pool.num_blocks - self._kv_reserved} unreserved")
         child = ServedRequest(next(self._rid), src.prompt, mnt,
                               src.eos_token_id, src.min_length,
-                              src.repetition_penalty, self.clock())
+                              src.repetition_penalty, self.clock(),
+                              seed=self._fresh_seed())
         child.state = "running"
         child.slot = s1
         child.tokens = list(src.tokens)
@@ -768,6 +898,16 @@ class ServingEngine:
                     self._rep_pen, self._tok):
             vec[s1] = vec[s0]
         self._max_nt[s1] = mnt
+        # the child samples from its OWN seed stream: under the
+        # scheduling-invariant per-request sampling discipline, twins
+        # sharing the parent's seed would decode IDENTICAL suffixes —
+        # the whole point of a fork is divergent continuations
+        self._rseed[s1] = child.seed
+        # a mid-prefill parent forks cleanly: the child inherits the
+        # prefill cursor and streams the remaining prompt through the
+        # budget packer like any prefilling slot (its writes trigger
+        # COW on the still-shared prompt blocks)
+        self._pf_left[s1] = self._pf_left[s0]
         self._active[s1] = self._active[s0] and self._nt[s1] < mnt
         if self._drafters is not None:
             self._drafters[s1].reset(src.prompt)
@@ -775,7 +915,7 @@ class ServingEngine:
         if self._rep_on:
             p = self._presence_init()
             self._presence = p.at[s1].set(p[s0])
-        if not self._active[s1]:
+        if not self._active[s1] and not self._pf_left[s1]:
             self._finish(child, self.clock())
         return child.rid
 
@@ -791,11 +931,12 @@ class ServingEngine:
         rep_on = self._rep_on
         do_sample = self.do_sample
         top_k, top_p, temp = self.top_k, self.top_p, self.temperature
+        chunk = self.decode_chunk
 
         def decode_chunk(stk, e_arrays, h_arrays, caches, tok, lens,
                          active, nt, max_nt, eos_ids, min_len, rep_pen,
-                         presence, keys):
-            def body(carry, key):
+                         presence, seeds):
+            def body(carry, _):
                 tok, caches, lens, active, nt, presence = carry
                 x, caches = hidden(stk, e_arrays, caches, tok, lens)
                 logits = head_logits(h_arrays, x)
@@ -803,8 +944,10 @@ class ServingEngine:
                 logits = _penalize_slots(
                     logits, presence if rep_on else None, rep_pen, nt,
                     min_len, eos_ids)
-                nxt = _sample_next(logits, do_sample, top_k, top_p,
-                                   temp, key)
+                # per-row keys fold (request seed, nt): sampling is
+                # invariant to chunk boundaries and scheduling
+                nxt = _sample_rows(logits, do_sample, top_k, top_p,
+                                   temp, seeds, nt)
                 emitted = active
                 hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
                 step = active.astype(jnp.int32)
@@ -818,7 +961,8 @@ class ServingEngine:
                 carry = (tok, caches, lens, active, nt, presence)
                 return carry, (nxt, emitted)
             carry, ys = jax.lax.scan(
-                body, (tok, caches, lens, active, nt, presence), keys)
+                body, (tok, caches, lens, active, nt, presence), None,
+                length=chunk)
             tok, caches, lens, active, nt, presence = carry
             return caches, tok, lens, active, nt, presence, ys
         return decode_chunk
@@ -855,7 +999,7 @@ class ServingEngine:
         do_sample = self.do_sample
         top_k, top_p, temp = self.top_k, self.top_p, self.temperature
 
-        def admit_sample(h_arrays, last_x, key, eos_ids, min_len,
+        def admit_sample(h_arrays, last_x, seeds, eos_ids, min_len,
                          rep_pen, presence):
             logits = head_logits(h_arrays, last_x)
             logits = logits.reshape(logits.shape[0], -1)
@@ -863,8 +1007,8 @@ class ServingEngine:
             logits = _penalize_slots(
                 logits, presence if rep_on else None, rep_pen, nt0,
                 min_len, eos_ids)
-            return _sample_next(logits, do_sample, top_k, top_p, temp,
-                                key)
+            return _sample_rows(logits, do_sample, top_k, top_p, temp,
+                                seeds, nt0)
         return admit_sample
 
     def _build_bulk_admit(self, sb):
@@ -1122,16 +1266,16 @@ class ServingEngine:
                             else int(r.eos_token_id))
             self._min_len[s] = r.min_length
             self._rep_pen[s] = r.repetition_penalty
+            self._rseed[s] = r.seed
             if self._drafters is not None:
                 self._drafters[s].reset(r.prompt)
 
         sample = self._counted_jit(("admit_sample",),
                                    self._build_admit_sample)
-        key = next_key() if self.do_sample else jax.random.PRNGKey(0)
         nxt = np.asarray(sample(
-            h_arrays, last_x, key, jnp.asarray(self._eos),
-            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
-            self._presence_arg()))
+            h_arrays, last_x, jnp.asarray(self._rseed, jnp.int32),
+            jnp.asarray(self._eos), jnp.asarray(self._min_len),
+            jnp.asarray(self._rep_pen), self._presence_arg()))
 
         now = self.clock()
         self._decode_steps += len(batch)     # one sample event per row
@@ -1153,6 +1297,359 @@ class ServingEngine:
                 self._finish(r, now)
         return batch
 
+    def _admit_chunked(self):
+        """Token-budget admission: move queued requests into free slots
+        as pure BOOKKEEPING — prefix-cache lookup/adopt plus slot-state
+        reset. No prefill dispatch happens here: the slot enters
+        `prefilling` (pf_left > 0) and the budget packer streams its
+        prompt through spare step capacity, so a long prompt can never
+        stall the decode gang. Publication back to the prefix store
+        happens when the prompt completes (commit-on-prefill, the same
+        dedup as the phase path — cold same-template gangs admitted
+        together all miss, unlike phase admission's serialized
+        publish-then-lookup; the store converges one prompt later)."""
+        free = self._free_slots()
+        batch = []
+        while free and self._queue:
+            if self.paged:
+                # pool-bounded admission, same reservation rule as the
+                # phase path: worst-case blocks covered or the head
+                # waits (deadline expiry still runs every step)
+                head = self._queue[0]
+                need = self._blocks_needed(head.prompt.size,
+                                           head.max_new_tokens)
+                if self._kv_reserved + need > self.pool.num_blocks:
+                    break
+                self._kv_reserved += need
+            req = self._queue.popleft()
+            slot = free.pop(0)
+            req.slot = slot
+            req.state = "running"
+            self._slot_req[slot] = req
+            batch.append(req)
+        if not batch:
+            return []
+        self._admitted += len(batch)
+        if self._rep_on:
+            # presence seeds with the FULL prompt at admission (the
+            # budget core's penalty at the first-token sample needs it;
+            # teacher-forced prefill columns never consume it)
+            vocab = self._presence_init().shape[1]
+            admit_mask = np.zeros(self.num_slots, bool)
+            rows = np.zeros((self.num_slots, vocab), bool)
+            for r in batch:
+                admit_mask[r.slot] = True
+                rows[r.slot, r.prompt] = True
+            self._presence = jnp.where(
+                jnp.asarray(admit_mask)[:, None], jnp.asarray(rows),
+                self._presence_init())
+        mesh_on = self.dec._mesh_mp() is not None
+        pc = self.prefix_cache if not mesh_on else None
+        if pc is None and self.prefix_cache is not None:
+            self._prefix_misses += len(batch)
+        for r in batch:
+            s = r.slot
+            base = 0
+            if pc is not None:
+                nodes = pc.lookup(r.prompt)
+                if nodes:
+                    if self.paged:
+                        base = pc.adopt_into(self._tables, s, nodes)
+                    else:
+                        pc.store.acquire(nodes)   # pin across the copy
+                        try:
+                            self._caches = pc.adopt(self._caches, s,
+                                                    nodes)
+                        finally:
+                            pc.store.release(nodes)
+                        base = len(nodes) * pc.block_tokens
+                    self._prefix_hits += 1
+                    self._prefill_tokens_saved += int(base)
+                else:
+                    self._prefix_misses += 1
+            if self.prefix_cache is not None:
+                self._prefill_tokens_computed += (r.prompt.size
+                                                  - int(base))
+            # lens IS the prefill cursor: KV entries written so far
+            # (adopted prefix now, streamed chunks as they land)
+            self._lens[s] = base
+            self._pf_left[s] = r.prompt.size - int(base)
+            self._nt[s] = 0
+            self._max_nt[s] = r.max_new_tokens
+            self._eos[s] = (-1 if r.eos_token_id is None
+                            else int(r.eos_token_id))
+            self._min_len[s] = r.min_length
+            self._rep_pen[s] = r.repetition_penalty
+            self._rseed[s] = r.seed
+            self._active[s] = False          # decoding starts at finish
+            if self._drafters is not None:
+                self._drafters[s].reset(r.prompt)
+        return batch
+
+    def _get_spec_rng(self):
+        if self._spec_rng is None:
+            self._spec_rng = np.random.RandomState(
+                _host_seed(next_key()))
+        return self._spec_rng
+
+    def _budget_step(self):
+        """ONE token-budget dispatch: pack decode rows (1 mandatory
+        input token + any draft claim each) and prefill chunks into the
+        compiled [B, C] budget core, then harvest per-row. Pure-decode
+        steps fall back to the (equally warm) decode-chunk scan when
+        IT moves more tokens per dispatch — the budget arithmetic that
+        subsumes the deprecated thin-draft heuristic. Returns tokens
+        emitted."""
+        from .spec_decode import (filtered_probs, greedy_accept,
+                                  rejection_sample, truncate_emitted)
+        b = self.num_slots
+        c = self._budget_cols
+        dec_rows = [s for s in range(b) if self._active[s]]
+        pf_rows = [s for s in range(b) if self._pf_left[s] > 0]
+        if not dec_rows and not pf_rows:
+            return 0
+        k = self.spec_k
+        drafts = np.zeros((b, max(k, 1)), np.int32)
+        dlen = np.zeros(b, np.int32)
+        if k:
+            for s in dec_rows:
+                d = self._drafters[s].propose()
+                # the bonus token always ships: at most remaining-1
+                # drafts are useful, and a row's whole segment must fit
+                # the C columns
+                m = min(int(d.size),
+                        int(self._max_nt[s] - self._nt[s]) - 1, c - 1)
+                if m > 0:
+                    drafts[s, :m] = d[:m]
+                    dlen[s] = m
+        if not pf_rows and len(dec_rows) + int(dlen.sum()) < \
+                len(dec_rows) * self.decode_chunk:
+            # budget arithmetic: the block step processes
+            # len(dec) + sum(dlen) real tokens, the chunk scan
+            # len(dec) * decode_chunk — dispatch whichever moves more
+            return self._decode_one_chunk()
+        # ---- pack: decode inputs are mandatory, prefill chunks fill
+        # spare capacity (rotating start so concurrent prefills share
+        # the budget), drafts claim what is left
+        budget = self.token_budget - len(dec_rows)
+        toks = np.zeros((b, c), np.int32)
+        seg = np.zeros(b, np.int32)
+        gen0 = np.full(b, c, np.int32)
+        pf_n = np.zeros(b, np.int32)
+        for s in dec_rows:
+            toks[s, 0] = self._tok[s]
+            seg[s] = 1
+            gen0[s] = 0
+        if pf_rows:
+            # FCFS (Sarathi's order): the OLDEST prefilling request
+            # takes the whole spare budget first — round-robin sharing
+            # would stretch EVERY concurrent prompt's prefill (and so
+            # the TTFT tail) by the number of prefilling slots
+            pf_rows.sort(key=lambda s: self._slot_req[s].rid)
+            for s in pf_rows:
+                n = min(int(self._pf_left[s]), c, budget)
+                if n <= 0:
+                    continue
+                req = self._slot_req[s]
+                p0 = req.prompt.size - int(self._pf_left[s])
+                toks[s, :n] = req.prompt[p0:p0 + n]
+                seg[s] = n
+                pf_n[s] = n
+                if n == int(self._pf_left[s]):
+                    # finishing this dispatch: the last prompt token's
+                    # logits sample the request's FIRST generated token
+                    gen0[s] = n - 1
+                budget -= n
+        if k:
+            for s in dec_rows:
+                m = min(int(dlen[s]), budget)
+                dlen[s] = m
+                if m > 0:
+                    toks[s, 1:1 + m] = drafts[s, :m]
+                    seg[s] = 1 + m
+                    budget -= m
+        tail = 0 if k else max(self.decode_chunk - 1, 0)
+        if self.paged:
+            # cover every packed row's write window before dispatch
+            # (lazy mapping + the COW guard): the block's segment,
+            # plus the trailing decode scan's window for rows that
+            # will be decoding after the block (active rows and
+            # prefill rows finishing here), clamped to the
+            # admission-time reservation `plen + max_new`
+            for s in range(b):
+                if not seg[s]:
+                    continue
+                decodes = bool(self._active[s]) or \
+                    (pf_n[s] and pf_n[s] == self._pf_left[s])
+                hi = (int(self._lens[s]) + int(seg[s])
+                      + (tail if decodes else 0))
+                req = self._slot_req[s]
+                cap_pos = req.prompt.size + int(self._max_nt[s])
+                self._ensure_writable(s, int(self._lens[s]),
+                                      min(hi, cap_pos))
+        stk = self.dec._stacked()
+        e_arrays = [p._data for p in self.dec._embed_params]
+        h_arrays = self.dec._maybe_quant_head(
+            [p._data for p in self.dec._head_params])
+        full_logits = bool(self.do_sample and k)
+        fn = self._counted_jit(
+            ("budget", c),
+            lambda: self.dec._build_budget_core(
+                c, self._rep_on, self.do_sample, self.top_k, self.top_p,
+                self.temperature, full_logits=full_logits,
+                chain=bool(k), scan_tail=tail),
+            donate=(3,))
+        res = fn(
+            stk, e_arrays, h_arrays, self._cache_arg(),
+            jnp.asarray(toks), jnp.asarray(self._lens),
+            jnp.asarray(seg), jnp.asarray(gen0), jnp.asarray(self._nt),
+            jnp.asarray(self._max_nt), jnp.asarray(self._eos),
+            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+            self._presence_arg(), jnp.asarray(self._rseed, jnp.int32))
+        self._keep_caches(res[0])
+        self._budget_steps += 1
+        self._budget_tokens_used += int(seg.sum())
+        self._budget_prefill_tokens += int(pf_n.sum())
+        self._budget_decode_tokens += len(dec_rows)
+        self._budget_draft_tokens += int(dlen.sum())
+        now = self.clock()
+        mesh_on = self.dec._mesh_mp() is not None
+        pc = self.prefix_cache if not mesh_on else None
+        if not k:
+            # ---- non-spec harvest: the core advanced ALL row state on
+            # device (block sample + trailing decode scan); the host
+            # walks tokens and finish events
+            (_, tok0, emit0, (ys_t, ys_e), tokc, lensc, activec, ntc,
+             presc) = res
+            tok0 = np.asarray(tok0)
+            emit0 = np.asarray(emit0)
+            ys_t = np.asarray(ys_t)          # [tail, B]
+            ys_e = np.asarray(ys_e)
+            prev_active = self._active.copy()
+            self._tok = np.array(tokc)
+            self._lens = np.array(lensc)
+            self._nt = np.array(ntc)
+            still_active = np.array(activec)
+            if self._rep_on:
+                self._presence = presc
+            n_emitted = 0
+            for s in range(b):
+                req = self._slot_req[s]
+                if req is None:
+                    continue
+                if pf_n[s]:
+                    self._pf_left[s] -= int(pf_n[s])
+                    if self._pf_left[s] == 0 and pc is not None:
+                        # commit-on-prefill publication: decode writes
+                        # (including this dispatch's trailing scan)
+                        # land strictly past every published full
+                        # block, so publishing at harvest is safe
+                        if self.paged:
+                            pc.publish_from(self._tables, s, req.prompt)
+                        else:
+                            pc.publish(self._caches, s, req.prompt)
+                if not emit0[s] and not prev_active[s]:
+                    continue                 # idle or still prefilling
+                row_toks = []
+                if emit0[s]:
+                    row_toks.append(int(tok0[s]))
+                    if pf_n[s]:              # the prompt finished HERE
+                        req.t_first = now
+                if tail:
+                    hits = ys_e[:, s]
+                    row_toks.extend(int(t) for t in ys_t[hits, s])
+                req.tokens.extend(row_toks)
+                n_emitted += len(row_toks)
+                self._decode_steps += len(row_toks)
+                if not still_active[s]:
+                    self._finish(req, now)
+            self._active = still_active
+            return n_emitted
+        # ---- spec harvest: block-only (accepted drafts already make
+        # the step multi-token); acceptance/rollback on host, as in the
+        # legacy verify step
+        out = np.asarray(res[1])
+        n_emitted = 0
+        new_rows, new_cols = [], []
+        logits = out.astype(np.float32) if full_logits else None
+        for s in pf_rows:
+            n = int(pf_n[s])
+            if n == 0:
+                continue
+            req = self._slot_req[s]
+            self._pf_left[s] -= n
+            self._lens[s] += n
+            if self._pf_left[s] > 0:
+                continue
+            # prompt complete: commit-on-prefill publication, then the
+            # first token (TTFT is measured to exactly this event)
+            if pc is not None:
+                if self.paged:
+                    pc.publish_from(self._tables, s, req.prompt)
+                else:
+                    pc.publish(self._caches, s, req.prompt)
+            if full_logits:
+                p = filtered_probs(logits[s, int(seg[s]) - 1][None],
+                                   self.top_k, self.top_p,
+                                   self.temperature)
+                tok0 = int(self._get_spec_rng().choice(p.shape[-1],
+                                                       p=p[0]))
+            else:
+                tok0 = int(out[s, int(seg[s]) - 1])   # greedy chain
+            req.t_first = now
+            req.tokens.append(tok0)
+            self._nt[s] = 1
+            self._tok[s] = tok0
+            self._decode_steps += 1      # one sample event for the row
+            n_emitted += 1
+            if self._drafters is not None:
+                self._drafters[s].update([tok0])
+            if self._rep_on:
+                new_rows.append(s)
+                new_cols.append(tok0)
+            hit_eos = (req.eos_token_id is not None
+                       and tok0 == int(req.eos_token_id))
+            self._active[s] = not hit_eos and req.max_new_tokens > 1
+            if not self._active[s]:
+                self._finish(req, now)
+        for s in dec_rows:
+            req = self._slot_req[s]
+            if req is None or not self._active[s]:
+                continue
+            m = int(dlen[s])
+            if full_logits:
+                probs = filtered_probs(logits[s, :m + 1], self.top_k,
+                                       self.top_p, self.temperature)
+                kept, _ = rejection_sample(drafts[s, :m], probs,
+                                           self._get_spec_rng())
+            else:
+                kept, _ = greedy_accept(drafts[s, :m], out[s, :m + 1])
+            eos = None if self._eos[s] < 0 else int(self._eos[s])
+            emitted, hit_eos = truncate_emitted(
+                kept, int(self._max_nt[s] - self._nt[s]), eos)
+            self._nt[s] += len(emitted)
+            req.tokens.extend(emitted)
+            n_emitted += len(emitted)
+            self._lens[s] += len(emitted)
+            self._tok[s] = emitted[-1]
+            self._decode_steps += 1
+            self._draft_proposed += m
+            self._draft_accepted += len(emitted) - 1
+            if self._drafters is not None:
+                self._drafters[s].update(emitted)
+            if self._rep_on:
+                new_rows.extend([s] * len(emitted))
+                new_cols.extend(emitted)
+            if hit_eos or self._nt[s] >= self._max_nt[s]:
+                self._active[s] = False
+                self._finish(req, now)
+        if self._rep_on and new_rows:
+            # the budget core's speculative presence was discarded —
+            # only tokens that actually landed join the carry
+            self._presence = self._presence.at[
+                jnp.asarray(new_rows), jnp.asarray(new_cols)].set(True)
+        return n_emitted
+
     def _decode_one_chunk(self):
         chunk = self.decode_chunk
         stk = self.dec._stacked()
@@ -1161,8 +1658,6 @@ class ServingEngine:
             [p._data for p in self.dec._head_params])
         fn = self._counted_jit(
             ("decode", chunk), self._build_decode_chunk, donate=(3,))
-        base = next_key() if self.do_sample else jax.random.PRNGKey(0)
-        keys = jax.random.split(base, chunk)
         if self.paged:
             # cover this chunk's write window before dispatch (lazy
             # mapping as lens grows + the COW guard for forked slots)
@@ -1179,7 +1674,7 @@ class ServingEngine:
             jnp.asarray(self._active), jnp.asarray(self._nt),
             jnp.asarray(self._max_nt), jnp.asarray(self._eos),
             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
-            self._presence_arg(), keys)
+            self._presence_arg(), jnp.asarray(self._rseed, jnp.int32))
         self._keep_caches(out)
         if self._rep_on:
             self._presence = presence
@@ -1281,10 +1776,7 @@ class ServingEngine:
         self._keep_caches(caches_out)
         if self.do_sample:
             logits = np.asarray(out).astype(np.float32)  # [B, K+1, V]
-            if self._spec_rng is None:
-                from .generation import _host_seed
-                self._spec_rng = np.random.RandomState(
-                    _host_seed(next_key()))
+            self._get_spec_rng()
         else:
             # greedy: the step returns just the [B, K+1] argmax chain —
             # the only thing exact-match acceptance reads
@@ -1363,6 +1855,7 @@ class ServingEngine:
             return
         self._slot_req[s] = None
         self._active[s] = False
+        self._pf_left[s] = 0             # a mid-prefill eviction stops
         if self.paged:
             # eviction frees the slot's block REFERENCES: blocks the
             # prefix store (or a fork twin) still holds stay resident,

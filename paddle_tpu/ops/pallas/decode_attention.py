@@ -36,6 +36,14 @@ holds the same sentinel — so a write past a slot's mapped blocks (or
 any masked write) lands nowhere, exactly the dense clamp's discipline.
 Smax % Bt == 0 is asserted at BlockPool construction with a clear
 error, so the table arithmetic can never itself gather out of bounds.
+
+A SIXTH client rides the verify step's discipline: the token-budget
+scheduler's budget core (generation._build_budget_core, serving's
+chunked prefill + decode packing) writes per-row SEGMENTS at positions
+lens..lens+seg-1 through the same spec_hidden write-masked path —
+validity is (col < seg) & (pos < Smax), decode segments stay under the
+submit-time budget exactly like drafts, and prefill segments stay
+under plen <= Smax - max_new by construction.
 """
 from __future__ import annotations
 

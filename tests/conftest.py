@@ -100,6 +100,26 @@ def check_serving_metrics(eng):
         assert m["draft_proposed"] == 0 and m["draft_accepted"] == 0
     if m["tokens_emitted"]:
         assert m["busy_s"] > 0 and m["tokens_per_sec"] > 0
+    # token-budget reconciliation: a budget dispatch can never pack
+    # more real tokens than steps x token_budget, and every packed
+    # token is exactly one of {prefill chunk token, decode input,
+    # draft} — the three parts must sum to the total
+    tb = getattr(eng, "token_budget", 0)
+    assert m["budget_tokens_used"] == (
+        m["budget_prefill_tokens"] + m["budget_decode_tokens"]
+        + m["budget_draft_tokens"]), (
+        f"budget token split broke: {m['budget_tokens_used']} != "
+        f"{m['budget_prefill_tokens']} + {m['budget_decode_tokens']} + "
+        f"{m['budget_draft_tokens']}")
+    if tb:
+        assert m["budget_tokens_used"] <= m["budget_steps"] * tb, (
+            f"budget overspent: {m['budget_tokens_used']} tokens in "
+            f"{m['budget_steps']} steps at budget {tb}")
+        if m["budget_utilization"] is not None:
+            assert 0.0 < m["budget_utilization"] <= 1.0
+    else:
+        assert m["budget_steps"] == 0 and m["budget_tokens_used"] == 0
+        assert m["budget_utilization"] is None
     # paged-pool block accounting: the allocator must reconcile on
     # EVERY serving test — used + free == NBtotal (a refcounted block
     # shared by N slot tables and the prefix store is ONE physical
