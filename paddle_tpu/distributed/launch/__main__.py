@@ -27,6 +27,8 @@ import subprocess
 import sys
 import time
 
+from ..logjson import log_event
+
 
 def _free_port():
     with socket.socket() as s:
@@ -167,6 +169,12 @@ def main():
         # the new generation's rendezvous
         master = args.master or f"127.0.0.1:{_free_port()}"
         procs, logs = _spawn_gang(args, master, attempt)
+        # JSON-only event (no plain-mode print existed here): the
+        # cluster front-end sees each generation start with its master
+        log_event("launch", "gang_start", stream=sys.stderr,
+                  generation=attempt, master=master,
+                  world=args.nproc_per_node * args.nnodes,
+                  pids=[p.pid for p in procs])
         first_bad = None
         try:
             while True:
@@ -188,20 +196,35 @@ def main():
             for f in logs:
                 f.close()
 
-        print(_failure_report(args, procs, attempt), file=sys.stderr)
         fail_rc = first_bad.poll()
         fail_rc = fail_rc if fail_rc > 0 else 128 - fail_rc  # signal -> 128+N
+        log_event("launch", "gang_failure", stream=sys.stderr,
+                  message=_failure_report(args, procs, attempt),
+                  generation=attempt, failed_rank=first_bad._pd_rank,
+                  failed_rc=fail_rc,
+                  exit_codes={p._pd_rank: p.poll() for p in procs},
+                  log_tail=_tail(_log_path(args.log_dir,
+                                           first_bad._pd_rank, attempt)))
         attempt += 1
         if attempt > args.max_restart:
-            print(f"launch: rank {first_bad._pd_rank} failed "
-                  f"(rc {fail_rc}); restart budget exhausted "
-                  f"({args.max_restart})", file=sys.stderr)
+            log_event("launch", "restart_budget_exhausted",
+                      stream=sys.stderr,
+                      message=f"launch: rank {first_bad._pd_rank} failed "
+                              f"(rc {fail_rc}); restart budget exhausted "
+                              f"({args.max_restart})",
+                      generation=attempt - 1,
+                      failed_rank=first_bad._pd_rank, failed_rc=fail_rc,
+                      max_restart=args.max_restart)
             return fail_rc
         delay = min(args.restart_backoff * (2 ** (attempt - 1)),
                     backoff_cap)
-        print(f"launch: restarting (attempt {attempt}/{args.max_restart}) "
-              f"after {delay:.1f}s backoff, fresh master port, "
-              f"PADDLE_RESTART_COUNT={attempt}", file=sys.stderr)
+        log_event("launch", "restart", stream=sys.stderr,
+                  message=f"launch: restarting (attempt {attempt}/"
+                          f"{args.max_restart}) after {delay:.1f}s "
+                          f"backoff, fresh master port, "
+                          f"PADDLE_RESTART_COUNT={attempt}",
+                  generation=attempt, backoff_s=round(delay, 3),
+                  max_restart=args.max_restart)
         time.sleep(delay)
 
 

@@ -1,0 +1,62 @@
+"""Structured JSON-lines event logging for the distributed runtime.
+
+``PADDLE_LOG_JSON=1`` switches the gang supervisor's and the
+watchdog's human-oriented prints into ONE JSON object per line —
+machine-ingestible worker logs for a cluster front-end (restart /
+failure / heartbeat events with rank, supervisor generation, and both
+monotonic and wall-clock timestamps). With the flag off, ``log_event``
+prints the caller's plain ``message`` unchanged (or stays silent when
+there is none), so the default log format is exactly what it always
+was.
+
+Import-light by design (stdlib only): the launcher and the watchdog's
+failure path must never grow a heavy dependency.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["json_logging_enabled", "log_event"]
+
+
+def json_logging_enabled() -> bool:
+    return os.environ.get("PADDLE_LOG_JSON") == "1"
+
+
+def log_event(component: str, event: str, message: str | None = None,
+              stream=None, **fields):
+    """Emit one runtime event.
+
+    JSON mode: one object per line —
+    ``{"component", "event", "rank", "generation", "t_wall", "t_mono",
+    **fields}`` (rank from PADDLE_TRAINER_ID, None for the supervisor
+    itself; generation from PADDLE_RESTART_COUNT). Plain mode: prints
+    ``message`` verbatim when given, else silent (events that never had
+    a print — e.g. clean exits — only surface in JSON mode).
+    """
+    out = stream if stream is not None else sys.stdout
+    if not json_logging_enabled():
+        if message is not None:
+            print(message, file=out, flush=True)
+        return
+    rank_env = os.environ.get("PADDLE_TRAINER_ID")
+    rec = {
+        "component": component,
+        "event": event,
+        "rank": int(rank_env) if rank_env not in (None, "") else None,
+        "generation": int(os.environ.get("PADDLE_RESTART_COUNT", "0")
+                          or 0),
+        "t_wall": round(time.time(), 6),
+        "t_mono": round(time.monotonic(), 6),
+    }
+    if message is not None:
+        rec["message"] = message
+    rec.update(fields)
+    try:
+        line = json.dumps(rec, default=str)
+    except (TypeError, ValueError):
+        line = json.dumps({k: str(v) for k, v in rec.items()})
+    print(line, file=out, flush=True)

@@ -41,6 +41,8 @@ import os
 import threading
 import time
 
+from ..logjson import log_event
+
 __all__ = ["PeerFailureError", "Watchdog", "start_watchdog",
            "stop_watchdog", "check_peer_failure", "monitored_barrier",
            "notify_progress", "current_watchdog", "WATCHDOG_EXIT_CODE"]
@@ -96,6 +98,13 @@ class Watchdog:
         self.require_progress_s = float(
             os.environ.get("PADDLE_WATCHDOG_REQUIRE_PROGRESS_S", "0"))
         self._progress_at = time.monotonic()
+        # telemetry surface (inference/telemetry.py folds these into the
+        # Prometheus exposition): per-peer heartbeat freshness + how many
+        # peer failures this watchdog has recorded
+        self._seen = {}                 # peer -> (counter, t_progress)
+        self._done_peers = set()        # peers that departed cleanly
+        self._watch_started = time.monotonic()
+        self.peer_failures = 0
         self.failure: PeerFailureError | None = None
         self._crashed = False     # set by the excepthook start_watchdog installs
         self._stop = threading.Event()
@@ -133,6 +142,7 @@ class Watchdog:
             # flaggable — posting done here would exempt a dead rank from
             # staleness and wedge the survivors in their next collective
             return
+        log_event("watchdog", "clean_exit", rank=self.rank)
         try:
             s = self._store_factory(self._connect_timeout)
             s.set(f"wd/done/{self.rank}", b"1")
@@ -190,9 +200,9 @@ class Watchdog:
 
     # -------------------------------------------------------------- watcher
     def _watch_loop(self):
-        seen = {}                       # peer -> (counter, t_progress)
-        done = set()                    # peers that posted wd/done/<rank>
-        t0 = time.monotonic()
+        seen = self._seen               # peer -> (counter, t_progress)
+        done = self._done_peers         # peers that posted wd/done/<rank>
+        t0 = self._watch_started = time.monotonic()
         store_ok_at = t0
         while not self._stop.is_set():
             now = time.monotonic()
@@ -249,6 +259,9 @@ class Watchdog:
                             "paddle_tpu watchdog: [rank %d] store retired "
                             "with a clean coordinator exit — watchdog "
                             "stopping", self.rank)
+                        log_event("watchdog", "store_retired",
+                                  rank=self.rank,
+                                  peers_departed=sorted(done))
                         return
                     self._fail(PeerFailureError(
                         f"[rank {self.rank}] watchdog: rendezvous store "
@@ -268,8 +281,12 @@ class Watchdog:
     # -------------------------------------------------------------- failure
     def _fail(self, err: PeerFailureError):
         self.failure = err
+        self.peer_failures += 1
         logging.error("paddle_tpu watchdog: %s", err)
-        print(f"paddle_tpu watchdog: {err}", flush=True)
+        log_event("watchdog", "peer_failure",
+                  message=f"paddle_tpu watchdog: {err}",
+                  rank=self.rank, ranks=list(err.ranks),
+                  timeout_s=self.timeout_s, action=self.action)
         if self.action != "raise":
             return
         # async-raise into the main thread: a Python-level train loop dies
@@ -285,14 +302,43 @@ class Watchdog:
         while time.monotonic() < deadline:
             if self._stop.wait(0.2):
                 return               # main thread handled it and stopped us
-        print(f"paddle_tpu watchdog: [rank {self.rank}] main thread did "
-              f"not unwind within {self.kill_grace_s:.1f}s grace — "
-              f"hard-exiting {WATCHDOG_EXIT_CODE}", flush=True)
+        log_event("watchdog", "hard_exit",
+                  message=f"paddle_tpu watchdog: [rank {self.rank}] main "
+                          f"thread did not unwind within "
+                          f"{self.kill_grace_s:.1f}s grace — hard-exiting "
+                          f"{WATCHDOG_EXIT_CODE}",
+                  rank=self.rank, exit_code=WATCHDOG_EXIT_CODE)
         os._exit(WATCHDOG_EXIT_CODE)
 
     def check(self):
         if self.failure is not None:
             raise self.failure
+
+    # ------------------------------------------------------------- gauges
+    def heartbeat_ages(self):
+        """Seconds since each peer's heartbeat counter last PROGRESSED
+        (a peer that never beat ages from watchdog start — the same
+        staleness clock the watcher judges by, so a gauge crossing
+        ``timeout_s`` is exactly a pending PeerFailureError). Peers
+        that posted clean-exit markers are omitted: departure, not
+        death."""
+        now = time.monotonic()
+        ages = {}
+        for peer in range(self.world):
+            if peer == self.rank or peer in self._done_peers:
+                continue
+            rec = self._seen.get(peer)
+            ages[peer] = now - (rec[1] if rec is not None
+                                else self._watch_started)
+        return ages
+
+    def gauges(self):
+        """Telemetry surface (folded into the Prometheus exposition by
+        inference/telemetry.runtime_prometheus)."""
+        return {"rank": self.rank, "world": self.world,
+                "timeout_s": self.timeout_s,
+                "peer_failures_total": self.peer_failures,
+                "heartbeat_age_s": self.heartbeat_ages()}
 
     # ------------------------------------------------------------- barrier
     def monitored_barrier(self, timeout_s: float = None, tag: str = None):

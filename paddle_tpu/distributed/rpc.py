@@ -27,6 +27,23 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
 
+def _telemetry():
+    """The runtime metrics registry (inference/telemetry.py — import-
+    light, lazy: rpc must not pay for it until the first call). Returns
+    None when unavailable so the transport never fails on metrics."""
+    global _TELE
+    if _TELE is None:
+        try:
+            from ..inference import telemetry as _t
+            _TELE = _t
+        except Exception:
+            _TELE = False
+    return _TELE or None
+
+
+_TELE = None
+
+
 @dataclass
 class WorkerInfo:
     name: str
@@ -204,6 +221,30 @@ class _RpcAgent:
         socket op inherits the remaining deadline, so a half-open peer
         turns into TimeoutError instead of an unbounded wait."""
         info = self.workers[to]
+        t_call = time.monotonic()
+        try:
+            ok, value = self._call_inner(info, to, fn, args, kwargs,
+                                         timeout)
+        except Exception:
+            # transport failure: counted, NOT recorded in the latency
+            # histogram (a timed-out call's "latency" is the deadline)
+            tele = _telemetry()
+            if tele is not None:
+                tele.runtime_counter("paddle_rpc_calls_total", 1)
+                tele.runtime_counter("paddle_rpc_call_errors_total", 1)
+            raise
+        tele = _telemetry()
+        if tele is not None:
+            # a remote exception shipped back IS a completed round-trip
+            tele.runtime_counter("paddle_rpc_calls_total", 1)
+            tele.runtime_histogram(
+                "paddle_rpc_call_latency_seconds").observe(
+                time.monotonic() - t_call)
+        if not ok:
+            raise value
+        return value
+
+    def _call_inner(self, info, to, fn, args, kwargs, timeout):
         deadline = time.monotonic() + timeout
         # deadline-bounded by default: a refused connect is instantaneous,
         # and a peer mid-restart stays refused for the supervisor's whole
@@ -238,9 +279,7 @@ class _RpcAgent:
                 (fn, args or (), kwargs or {})))
             sock.settimeout(max(0.001, deadline - time.monotonic()))
             ok, value = pickle.loads(self._recv_msg(sock, deadline))
-        if not ok:
-            raise value
-        return value
+        return ok, value
 
     def stop(self):
         self._stop = True

@@ -26,7 +26,9 @@ import numpy as np
 __all__ = ["Config", "Predictor", "create_predictor", "PlaceType",
            "PrecisionType", "ServingEngine", "ServedRequest",
            "AdmissionFull", "PrefixCache", "PrefixStore", "NGramDrafter",
-           "BlockPool", "PagedPrefixCache", "PagedPrefixStore"]
+           "BlockPool", "PagedPrefixCache", "PagedPrefixStore",
+           "Telemetry", "LogHistogram", "export_chrome_tracing",
+           "parse_prometheus"]
 
 
 def __getattr__(name):
@@ -35,6 +37,10 @@ def __getattr__(name):
     if name in ("ServingEngine", "ServedRequest", "AdmissionFull"):
         from . import serving
         return getattr(serving, name)
+    if name in ("Telemetry", "LogHistogram", "export_chrome_tracing",
+                "parse_prometheus"):
+        from . import telemetry
+        return getattr(telemetry, name)
     if name in ("PrefixCache", "PrefixStore"):
         from . import prefix_cache
         return getattr(prefix_cache, name)

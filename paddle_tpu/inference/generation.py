@@ -25,7 +25,31 @@ import numpy as np
 from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 
-__all__ = ["generate", "generate_fused", "FusedDecoder"]
+__all__ = ["generate", "generate_fused", "FusedDecoder",
+           "dispatch_kind", "DISPATCH_KINDS"]
+
+# ---- dispatch-kind vocabulary (serving telemetry) ---------------------
+# Every compiled executable the serving stack can dispatch is built
+# here (or keyed to a core built here), and the telemetry step timeline
+# labels each dispatch with ONE canonical kind. Keeping the vocabulary
+# next to the core builders means a new executable kind cannot reach
+# the engine without naming itself for the timeline.
+DISPATCH_KINDS = {
+    "bulk_admit": "prefill",      # one-row causal-flash prompt pass
+    "prefill": "prefill",         # masked chunked prefill scan
+    "admit_sample": "admit",      # first-token sample on prefill hiddens
+    "decode": "decode",           # the decode-chunk scan
+    "verify": "verify",           # the K+1-position spec-verify block
+    "budget": "budget",           # the [B, C] token-budget core
+}
+
+
+def dispatch_kind(jit_key):
+    """Canonical telemetry kind for a serving jit-cache key (keys are
+    tuples whose head names the executable family; shape parameters
+    follow). Unknown families pass through as their own name so a new
+    dispatch is visible — just unclassified — rather than dropped."""
+    return DISPATCH_KINDS.get(jit_key[0], str(jit_key[0]))
 
 
 def _absmax_int8(w, axis):

@@ -114,6 +114,7 @@ class BlockPool:
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._jit_cache = {}
         self.trace_count = 0             # COW copy-path retrace spy
+        self.used_peak = 0               # residency high-water mark
 
     # ---------------------------------------------------------- allocator
     @property
@@ -131,6 +132,8 @@ class BlockPool:
             return None
         ids = [self._free.pop() for _ in range(int(n))]
         self.refcounts[ids] = 1
+        if self.used > self.used_peak:
+            self.used_peak = self.used
         return ids
 
     def ref(self, blocks):
@@ -153,6 +156,16 @@ class BlockPool:
     def stats(self):
         return {"blocks_total": self.num_blocks, "blocks_used": self.used,
                 "blocks_free": self.free_count}
+
+    def gauges(self):
+        """Prometheus-ready pool gauges (telemetry.render_prometheus and
+        telemetry.snapshot consume these): residency now + the lifetime
+        high-water mark — the number an operator sizes
+        ``PADDLE_SERVING_KV_BLOCKS`` against."""
+        return {"kv_blocks_total": self.num_blocks,
+                "kv_blocks_used": self.used,
+                "kv_blocks_free": self.free_count,
+                "kv_blocks_used_peak": self.used_peak}
 
     # -------------------------------------------------------- the COW copy
     def _bump_traces(self):

@@ -71,6 +71,17 @@ Sampled mode draws each token from fold_in(request_seed, position)
 to the scheduler — the chunked-vs-phase parity tests pin token
 equality in both greedy and sampled mode.
 
+Telemetry (telemetry.py; `telemetry_ring=` / `PADDLE_TELEMETRY_RING`,
+0 disables collection): per-request lifecycle spans and a per-dispatch
+step timeline in bounded rings, TTFT/latency/tokens-per-step as
+fixed-size log-bucketed histograms (the `metrics()` percentile source —
+no unbounded scans), `metrics_prometheus()` text exposition with
+counters monotonic across `reset_metrics`, `telemetry_snapshot()` as
+the cluster-router payload, and
+`telemetry.export_chrome_tracing(engine, path)` for Perfetto. All of
+it is host bookkeeping: telemetry on adds ZERO device dispatches and
+leaves the zero-retrace contract untouched.
+
 Speculative decoding (`spec_k=` / `PADDLE_SERVING_SPEC_K`): a per-slot
 model-free n-gram drafter (spec_decode.py) proposes up to K tokens per
 step from the request's own context; ONE compiled K+1-position verify
@@ -97,7 +108,8 @@ import numpy as np
 from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 from .generation import (FusedDecoder, _absmax_int8, _host_seed,
-                         _sample_rows)
+                         _sample_rows, dispatch_kind)
+from .telemetry import COUNTER_FOLD_KEYS, DEFAULT_RING, Telemetry
 
 __all__ = ["ServingEngine", "ServedRequest", "AdmissionFull"]
 
@@ -169,6 +181,12 @@ class ServingEngine:
         out = eng.results[rid]["tokens"]
         eng.metrics()                   # aggregate engine counters
 
+    `results` retains the most recent `telemetry_ring` finished
+    requests (default 2048, `PADDLE_TELEMETRY_RING`) — a long-lived
+    service must harvest each result promptly rather than index
+    arbitrarily old rids; aggregate totals survive in `metrics()` and
+    the Prometheus lifetime counters.
+
     Sampling mode (greedy / top-k / top-p / temperature) is ENGINE
     config — it is baked into the one compiled step. Per-REQUEST knobs
     (eos_token_id, max_new_tokens, min_length, repetition_penalty) are
@@ -187,7 +205,7 @@ class ServingEngine:
                  max_pending=None, prefill_cap=None,
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
                  paged=None, kv_pool=None, kv_pool_blocks=None,
-                 token_budget=None):
+                 token_budget=None, telemetry_ring=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -332,6 +350,21 @@ class ServingEngine:
         self._prefill_tokens_computed = 0
         self._rep_on = bool(enable_repetition_penalty)
         self.clock = clock or time.perf_counter
+        # telemetry subsystem (telemetry.py): per-request lifecycle
+        # spans + the step timeline live in a bounded ring
+        # (`telemetry_ring=` / PADDLE_TELEMETRY_RING, default 2048;
+        # 0 disables collection — one branch per event, no timestamp
+        # calls); the TTFT/latency/tokens-per-step histograms stay on
+        # regardless (they are metrics()' percentile source and are
+        # fixed-size). All timestamps ride the ENGINE clock, so spans
+        # line up exactly with ttft_s/latency_s under a virtual clock.
+        self.telemetry = Telemetry(telemetry_ring, clock=self.clock)
+        # results is BOUNDED at the telemetry ring size (the old
+        # unbounded dict leaked one entry per finished request for the
+        # engine's lifetime); total counts survive in the window
+        # counters + the Prometheus lifetime base
+        self._results_cap = self.telemetry.ring or DEFAULT_RING
+        self._prom_base = {}          # lifetime counter base (reset folds)
         # speculative decoding: K draft tokens per verify step (ONE
         # compiled K+1-position executable replaces the decode chunk;
         # slots with no usable draft ride in all-masked and degrade to
@@ -455,6 +488,10 @@ class ServingEngine:
         self._busy_s = 0.0
         self._admitted = 0
         self._forked = 0
+        # window counter (was recomputed from the results dict, which is
+        # bounded now — an unbounded scan AND an unbounded dict at
+        # service lifetimes); expired requests never count here
+        self._finished = 0
         # overload shedding: 0 = unbounded (legacy behavior)
         self.max_pending = int(max_pending if max_pending is not None
                                else os.environ.get(
@@ -496,6 +533,8 @@ class ServingEngine:
                 "static trace structure)")
         if self.max_pending and len(self._queue) >= self.max_pending:
             self._rejected += 1
+            if self.telemetry.enabled:
+                self.telemetry.req_rejected(self.clock())
             raise AdmissionFull(
                 f"pending queue full ({len(self._queue)}/"
                 f"{self.max_pending}) — request shed at admission")
@@ -514,6 +553,8 @@ class ServingEngine:
                 # — finished/expired requests release their commitment,
                 # so the caller's backoff-and-retry recovers
                 self._rejected += 1
+                if self.telemetry.enabled:
+                    self.telemetry.req_rejected(self.clock())
                 raise AdmissionFull(
                     f"kv pool exhausted ({self._kv_committed}/"
                     f"{self.pool.num_blocks} blocks committed to "
@@ -525,6 +566,7 @@ class ServingEngine:
                             self.clock(), deadline_s=deadline_s,
                             seed=self._fresh_seed())
         self._queue.append(req)
+        self.telemetry.req_queued(req.rid, req.t_submit)
         return req.rid
 
     def _fresh_seed(self):
@@ -559,6 +601,7 @@ class ServingEngine:
         admission + decode chunk. Emits one chunk_log record; returns
         the number of tokens emitted this step."""
         t0 = self.clock()
+        had_work = self.has_work
         self._expire_deadlines(t0)
         if self.token_budget:
             self._admit_chunked()
@@ -578,6 +621,10 @@ class ServingEngine:
         dt = self.clock() - t0
         self._busy_s += dt
         self._tokens_emitted += emitted
+        if had_work:
+            # tokens-per-step distribution (0 is a real value: a pure-
+            # prefill budget step emits nothing and that IS the story)
+            self.telemetry.observe_step_tokens(emitted)
         self.chunk_log.append({
             "step_s": dt, "new_tokens": emitted,
             "occupancy": self.occupancy, "queue_depth": self.queue_depth,
@@ -591,16 +638,58 @@ class ServingEngine:
             self.step()
         return self.results
 
+    def _window_counters(self):
+        """The raw window-counter surface, keyed like metrics(). Kept in
+        ONE place so reset_metrics' lifetime-base folding (Prometheus
+        counters must be monotonic across resets) can assert it covers
+        exactly telemetry.COUNTER_FOLD_KEYS — a new counter that skips
+        either side fails loudly here, not silently in a dashboard."""
+        return {
+            "tokens_emitted": self._tokens_emitted,
+            "busy_s": self._busy_s,
+            "requests_finished": self._finished,
+            "requests_admitted": self._admitted,
+            "requests_forked": self._forked,
+            "requests_rejected": self._rejected,
+            "requests_expired": self._expired,
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
+            "prefill_tokens_saved": self._prefill_tokens_saved,
+            "prefill_tokens_computed": self._prefill_tokens_computed,
+            "decode_steps": self._decode_steps,
+            "draft_proposed": self._draft_proposed,
+            "draft_accepted": self._draft_accepted,
+            "kv_cow_copies": self._cow_copies,
+            "budget_steps": self._budget_steps,
+            "budget_tokens_used": self._budget_tokens_used,
+            "budget_prefill_tokens": self._budget_prefill_tokens,
+            "budget_decode_tokens": self._budget_decode_tokens,
+            "budget_draft_tokens": self._budget_draft_tokens,
+        }
+
     def reset_metrics(self, keep_results=True):
         """Zero the aggregate counters (benchmarks call this after a
         warmup phase so the measured window excludes compiles). The
         trace counter is NOT reset — retraces-after-warmup is exactly
-        `metrics()['traces']` before vs after the measured phase."""
+        `metrics()['traces']` before vs after the measured phase.
+        Every window counter folds into the Prometheus lifetime base
+        first (metrics_prometheus() counters never move backwards), and
+        the telemetry rings/histograms start a fresh window (the next
+        export_chrome_tracing covers exactly the measured window)."""
+        window = self._window_counters()
+        assert set(window) == set(COUNTER_FOLD_KEYS), (
+            "window-counter surface drifted from telemetry."
+            "COUNTER_FOLD_KEYS: "
+            f"{set(window) ^ set(COUNTER_FOLD_KEYS)}")
+        for k, v in window.items():
+            self._prom_base[k] = self._prom_base.get(k, 0) + v
+        self.telemetry.reset()
         self.chunk_log.clear()
         self._tokens_emitted = 0
         self._busy_s = 0.0
         self._admitted = 0
         self._forked = 0
+        self._finished = 0
         self._rejected = 0
         self._expired = 0
         self._prefix_hits = 0
@@ -620,15 +709,15 @@ class ServingEngine:
             self.results = {}
 
     def metrics(self):
-        # expired requests are SHED, not finished — keeping them out of
-        # the percentiles (their "latency" is an eviction time) and out
-        # of requests_finished (else finished + expired double-counts)
-        done = [r for r in self.results.values() if not r.get("expired")]
-        ttfts = [d["ttft_s"] for d in done if d["ttft_s"] is not None]
-        lats = [d["latency_s"] for d in done if d["latency_s"] is not None]
-
-        def pct(v, q):
-            return float(np.percentile(v, q)) if v else None
+        # percentiles come from the telemetry subsystem's BOUNDED
+        # log-bucketed histograms (estimates within one bucket width of
+        # exact), not a scan over per-request records: the old
+        # done-list walk grew without bound at service lifetimes, and
+        # the results dict it walked is capped now. Expired requests
+        # are SHED, not finished — they never reach the histograms
+        # (their "latency" is an eviction time) and never count in
+        # requests_finished (else finished + expired double-counts).
+        tele = self.telemetry
         looked = self._prefix_hits + self._prefix_misses
         m = {
             "tokens_emitted": self._tokens_emitted,
@@ -640,7 +729,7 @@ class ServingEngine:
                 round(self._tokens_emitted / self._busy_s, 2)
                 if self._busy_s > 0
                 else (0.0 if self._tokens_emitted else None)),
-            "requests_finished": len(done),
+            "requests_finished": self._finished,
             "requests_admitted": self._admitted,
             "requests_forked": self._forked,
             "requests_rejected": self._rejected,
@@ -648,9 +737,11 @@ class ServingEngine:
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
             "traces": self._traces_total(),
-            "ttft_p50_s": pct(ttfts, 50), "ttft_p90_s": pct(ttfts, 90),
-            "ttft_p99_s": pct(ttfts, 99),
-            "latency_p50_s": pct(lats, 50), "latency_p99_s": pct(lats, 99),
+            "ttft_p50_s": tele.hist_ttft.percentile(50),
+            "ttft_p90_s": tele.hist_ttft.percentile(90),
+            "ttft_p99_s": tele.hist_ttft.percentile(99),
+            "latency_p50_s": tele.hist_latency.percentile(50),
+            "latency_p99_s": tele.hist_latency.percentile(99),
             # prefix-cache window counters (all zero with caching off):
             # hits + misses == requests_admitted by construction; saved +
             # computed == total prompt tokens admitted this window
@@ -707,6 +798,23 @@ class ServingEngine:
             m["prefix_store"] = self.prefix_cache.store.stats()
         return m
 
+    def metrics_prometheus(self):
+        """Prometheus text-format exposition: every metrics() key under
+        a stable name (telemetry.PROMETHEUS_NAMES), counters monotonic
+        across reset_metrics (lifetime base + window), the bounded
+        TTFT/latency/tokens-per-step histograms, pool/prefix gauges,
+        and the distributed-runtime section (watchdog heartbeat ages,
+        supervisor generation, rpc latency)."""
+        from .telemetry import render_prometheus
+        return render_prometheus(self)
+
+    def telemetry_snapshot(self):
+        """JSON-serializable state snapshot — the routing payload a
+        cluster front-end polls per replica (queue depth + occupancy +
+        pool headroom + histogram percentiles in one cheap read)."""
+        from .telemetry import snapshot
+        return snapshot(self)
+
     def _traces_total(self):
         """Engine traces + the prefix cache's copy-path traces: the
         zero-retrace-after-warmup contract covers the adopt/commit
@@ -733,6 +841,31 @@ class ServingEngine:
 
     def _bump_traces(self):
         self._trace_count += 1
+
+    def _run_dispatch(self, key, build, donate, args, rows=0, **fields):
+        """Every compiled dispatch goes through here: resolves the
+        jitted executable (trace-spied as before) and, when the
+        telemetry ring is on, logs ONE step-timeline event — kind from
+        generation.dispatch_kind(key), dispatch-side elapsed, trace-spy
+        delta (a compile mid-flight shows as traces_delta >= 1), and
+        gauge snapshots for the counter tracks. Returns (out, event);
+        the caller attaches harvest results via Telemetry.finish_step.
+        Telemetry off = exactly the old call (no clock reads)."""
+        fn = self._counted_jit(key, build, donate=donate)
+        tele = self.telemetry
+        if not tele.enabled:
+            return fn(*args), None
+        t0 = self.clock()
+        tr0 = self._traces_total()
+        out = fn(*args)
+        t1 = self.clock()
+        ev = tele.step_event(
+            dispatch_kind(key), t0, t1 - t0, rows=rows,
+            traces_delta=self._traces_total() - tr0,
+            queue_depth=self.queue_depth,
+            kv_blocks_used=(self.pool.used if self.paged else None),
+            **fields)
+        return out, ev
 
     def _core(self):
         core = getattr(self, "_core_cache", None)
@@ -862,6 +995,8 @@ class ServingEngine:
             # shed like submit() sheds: the rejection must show up in
             # the overload metric, not vanish
             self._rejected += 1
+            if self.telemetry.enabled:
+                self.telemetry.req_rejected(self.clock())
             raise AdmissionFull("no free slot to fork into")
         s0, s1 = src.slot, free[0]
         mnt = int(max_new_tokens if max_new_tokens is not None
@@ -871,6 +1006,8 @@ class ServingEngine:
         need = self._blocks_needed(src.prompt.size, mnt)
         if self._kv_reserved + need > self.pool.num_blocks:
             self._rejected += 1
+            if self.telemetry.enabled:
+                self.telemetry.req_rejected(self.clock())
             raise AdmissionFull(
                 f"kv pool exhausted: fork needs {need} blocks, "
                 f"{self.pool.num_blocks - self._kv_reserved} unreserved")
@@ -889,6 +1026,10 @@ class ServingEngine:
         # lookup, so counting it as admitted would break the
         # hits + misses == admitted reconciliation conftest pins
         self._forked += 1
+        if self.telemetry.enabled:
+            self.telemetry.req_queued(child.rid, child.t_submit)
+            self.telemetry.req_admitted(child.rid, s1, child.t_submit)
+            self.telemetry.req_event(child.rid, "forked", child.t_submit)
         # share the parent's blocks: table row copy + one ref each
         row = self._tables[s0]
         mapped = [int(x) for x in row[row < self.pool.num_blocks]]
@@ -1071,15 +1212,15 @@ class ServingEngine:
     def _bulk_admit_row(self, stk, e_arrays, req, last_x):
         plen = req.prompt.size
         sb = min(1 << (int(plen) - 1).bit_length(), self.smax)
-        fn = self._counted_jit(
-            ("bulk_admit", sb),
-            lambda s=sb: self._build_bulk_admit(s), donate=(2,))
         toks = np.zeros((1, sb), np.int32)
         toks[0, :plen] = req.prompt
-        out, row_x = fn(
-            stk, e_arrays, self._cache_arg(), jnp.asarray(toks),
-            jnp.asarray(req.slot, jnp.int32),
-            jnp.asarray(plen, jnp.int32))
+        (out, row_x), _ = self._run_dispatch(
+            ("bulk_admit", sb),
+            lambda s=sb: self._build_bulk_admit(s), (2,),
+            (stk, e_arrays, self._cache_arg(), jnp.asarray(toks),
+             jnp.asarray(req.slot, jnp.int32),
+             jnp.asarray(plen, jnp.int32)),
+            rows=1, tokens=int(plen))
         self._keep_caches(out)
         return last_x.at[req.slot].set(row_x[0])
 
@@ -1116,6 +1257,10 @@ class ServingEngine:
         if not batch:
             return []
         self._admitted += len(batch)
+        tele = self.telemetry
+        t_adm = self.clock() if tele.enabled else None
+        for r in batch:
+            tele.req_admitted(r.rid, r.slot, t_adm)
         b = self.num_slots
         stk = self.dec._stacked()
         e_arrays = [p._data for p in self.dec._embed_params]
@@ -1189,6 +1334,7 @@ class ServingEngine:
                         base[r.slot] = len(nodes) * pc.block_tokens
                     self._prefix_hits += 1
                     self._prefill_tokens_saved += int(base[r.slot])
+                    tele.req_event(r.rid, "prefix_adopt", t_adm)
                 else:
                     self._prefix_misses += 1
             if self.prefix_cache is not None:
@@ -1201,6 +1347,7 @@ class ServingEngine:
                 self._map_blocks(r.slot, r.prompt.size)
             if use_bulk and not base[r.slot]:
                 last_x = self._bulk_admit_row(stk, e_arrays, r, last_x)
+                tele.req_event(r.rid, "prefill_chunk", t_adm)
                 if pc is not None:
                     if self.paged:
                         pc.publish_from(self._tables, r.slot, r.prompt)
@@ -1224,21 +1371,23 @@ class ServingEngine:
                 n_left[r.slot] = sfx.size
             pos = 0
             for chunk in chunks:
-                fn = self._counted_jit(
-                    ("prefill", chunk),
-                    lambda c=chunk: self._build_prefill_chunk(c),
-                    donate=(2,))
                 toks = jnp.asarray(
                     np.ascontiguousarray(prompts[:, pos:pos + chunk].T))
                 t0 = np.where(n_left > 0, base + pos, self._lens).astype(
                     np.int32)
                 n_valid = np.clip(n_left - pos, 0, chunk).astype(
                     np.int32)
-                last_x, out = fn(
-                    stk, e_arrays, self._cache_arg(), toks,
-                    jnp.asarray(t0), jnp.asarray(n_valid), last_x)
+                (last_x, out), _ = self._run_dispatch(
+                    ("prefill", chunk),
+                    lambda c=chunk: self._build_prefill_chunk(c), (2,),
+                    (stk, e_arrays, self._cache_arg(), toks,
+                     jnp.asarray(t0), jnp.asarray(n_valid), last_x),
+                    rows=int((n_valid > 0).sum()),
+                    tokens=int(n_valid.sum()))
                 self._keep_caches(out)
                 pos += chunk
+            for r in scan_batch:
+                self.telemetry.req_event(r.rid, "prefill_chunk", t_adm)
         # commit-on-prefill for the rows whose prefill just landed via
         # the scan (bulk-miss rows published inline above): publish each
         # prompt's full blocks back to the pool under their token keys.
@@ -1270,12 +1419,13 @@ class ServingEngine:
             if self._drafters is not None:
                 self._drafters[s].reset(r.prompt)
 
-        sample = self._counted_jit(("admit_sample",),
-                                   self._build_admit_sample)
-        nxt = np.asarray(sample(
-            h_arrays, last_x, jnp.asarray(self._rseed, jnp.int32),
-            jnp.asarray(self._eos), jnp.asarray(self._min_len),
-            jnp.asarray(self._rep_pen), self._presence_arg()))
+        out, _ = self._run_dispatch(
+            ("admit_sample",), self._build_admit_sample, (),
+            (h_arrays, last_x, jnp.asarray(self._rseed, jnp.int32),
+             jnp.asarray(self._eos), jnp.asarray(self._min_len),
+             jnp.asarray(self._rep_pen), self._presence_arg()),
+            rows=len(batch), tokens=len(batch))
+        nxt = np.asarray(out)
 
         now = self.clock()
         self._decode_steps += len(batch)     # one sample event per row
@@ -1283,6 +1433,7 @@ class ServingEngine:
             s = r.slot
             tok0 = int(nxt[s])
             r.t_first = now
+            tele.req_event(r.rid, "first_token", now)
             r.tokens.append(tok0)
             self._nt[s] = 1
             self._tok[s] = tok0
@@ -1330,6 +1481,10 @@ class ServingEngine:
         if not batch:
             return []
         self._admitted += len(batch)
+        tele = self.telemetry
+        t_adm = self.clock() if tele.enabled else None
+        for r in batch:
+            tele.req_admitted(r.rid, r.slot, t_adm)
         if self._rep_on:
             # presence seeds with the FULL prompt at admission (the
             # budget core's penalty at the first-token sample needs it;
@@ -1365,6 +1520,7 @@ class ServingEngine:
                         base = len(nodes) * pc.block_tokens
                     self._prefix_hits += 1
                     self._prefill_tokens_saved += int(base)
+                    tele.req_event(r.rid, "prefix_adopt", t_adm)
                 else:
                     self._prefix_misses += 1
             if self.prefix_cache is not None:
@@ -1492,20 +1648,24 @@ class ServingEngine:
         h_arrays = self.dec._maybe_quant_head(
             [p._data for p in self.dec._head_params])
         full_logits = bool(self.do_sample and k)
-        fn = self._counted_jit(
+        tele = self.telemetry
+        res, ev = self._run_dispatch(
             ("budget", c),
             lambda: self.dec._build_budget_core(
                 c, self._rep_on, self.do_sample, self.top_k, self.top_p,
                 self.temperature, full_logits=full_logits,
                 chain=bool(k), scan_tail=tail),
-            donate=(3,))
-        res = fn(
-            stk, e_arrays, h_arrays, self._cache_arg(),
-            jnp.asarray(toks), jnp.asarray(self._lens),
-            jnp.asarray(seg), jnp.asarray(gen0), jnp.asarray(self._nt),
-            jnp.asarray(self._max_nt), jnp.asarray(self._eos),
-            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
-            self._presence_arg(), jnp.asarray(self._rseed, jnp.int32))
+            (3,),
+            (stk, e_arrays, h_arrays, self._cache_arg(),
+             jnp.asarray(toks), jnp.asarray(self._lens),
+             jnp.asarray(seg), jnp.asarray(gen0), jnp.asarray(self._nt),
+             jnp.asarray(self._max_nt), jnp.asarray(self._eos),
+             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+             self._presence_arg(), jnp.asarray(self._rseed, jnp.int32)),
+            rows=int((seg > 0).sum()),
+            budget_used=int(seg.sum()),
+            budget_wasted=b * c - int(seg.sum()),
+            drafts=int(dlen.sum()))
         self._keep_caches(res[0])
         self._budget_steps += 1
         self._budget_tokens_used += int(seg.sum())
@@ -1539,6 +1699,7 @@ class ServingEngine:
                     continue
                 if pf_n[s]:
                     self._pf_left[s] -= int(pf_n[s])
+                    tele.req_event(req.rid, "prefill_chunk", now)
                     if self._pf_left[s] == 0 and pc is not None:
                         # commit-on-prefill publication: decode writes
                         # (including this dispatch's trailing scan)
@@ -1555,15 +1716,20 @@ class ServingEngine:
                     row_toks.append(int(tok0[s]))
                     if pf_n[s]:              # the prompt finished HERE
                         req.t_first = now
+                        tele.req_event(req.rid, "first_token", now)
                 if tail:
                     hits = ys_e[:, s]
                     row_toks.extend(int(t) for t in ys_t[hits, s])
+                if row_toks and prev_active[s]:
+                    tele.req_event(req.rid, "decode", now)
                 req.tokens.extend(row_toks)
                 n_emitted += len(row_toks)
                 self._decode_steps += len(row_toks)
                 if not still_active[s]:
                     self._finish(req, now)
             self._active = still_active
+            tele.finish_step(ev, self.clock() if ev is not None else 0.0,
+                             tokens=n_emitted)
             return n_emitted
         # ---- spec harvest: block-only (accepted drafts already make
         # the step multi-token); acceptance/rollback on host, as in the
@@ -1579,6 +1745,7 @@ class ServingEngine:
             req = self._slot_req[s]
             self._pf_left[s] -= n
             self._lens[s] += n
+            tele.req_event(req.rid, "prefill_chunk", now)
             if self._pf_left[s] > 0:
                 continue
             # prompt complete: commit-on-prefill publication, then the
@@ -1597,6 +1764,7 @@ class ServingEngine:
             else:
                 tok0 = int(out[s, int(seg[s]) - 1])   # greedy chain
             req.t_first = now
+            tele.req_event(req.rid, "first_token", now)
             req.tokens.append(tok0)
             self._nt[s] = 1
             self._tok[s] = tok0
@@ -1635,6 +1803,7 @@ class ServingEngine:
             self._decode_steps += 1
             self._draft_proposed += m
             self._draft_accepted += len(emitted) - 1
+            tele.req_event(req.rid, "verify", now)
             if self._drafters is not None:
                 self._drafters[s].update(emitted)
             if self._rep_on:
@@ -1648,6 +1817,8 @@ class ServingEngine:
             # only tokens that actually landed join the carry
             self._presence = self._presence.at[
                 jnp.asarray(new_rows), jnp.asarray(new_cols)].set(True)
+        tele.finish_step(ev, self.clock() if ev is not None else 0.0,
+                         tokens=n_emitted)
         return n_emitted
 
     def _decode_one_chunk(self):
@@ -1656,8 +1827,6 @@ class ServingEngine:
         e_arrays = [p._data for p in self.dec._embed_params]
         h_arrays = self.dec._maybe_quant_head(
             [p._data for p in self.dec._head_params])
-        fn = self._counted_jit(
-            ("decode", chunk), self._build_decode_chunk, donate=(3,))
         if self.paged:
             # cover this chunk's write window before dispatch (lazy
             # mapping as lens grows + the COW guard for forked slots)
@@ -1667,14 +1836,16 @@ class ServingEngine:
                         s, int(self._lens[s]),
                         min(int(self._lens[s]) + chunk,
                             self._budget_pos(s)))
-        (out, tok, lens, active, nt, presence,
-         (toks, emitted)) = fn(
-            stk, e_arrays, h_arrays, self._cache_arg(),
-            jnp.asarray(self._tok), jnp.asarray(self._lens),
-            jnp.asarray(self._active), jnp.asarray(self._nt),
-            jnp.asarray(self._max_nt), jnp.asarray(self._eos),
-            jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
-            self._presence_arg(), jnp.asarray(self._rseed, jnp.int32))
+        res, ev = self._run_dispatch(
+            ("decode", chunk), self._build_decode_chunk, (3,),
+            (stk, e_arrays, h_arrays, self._cache_arg(),
+             jnp.asarray(self._tok), jnp.asarray(self._lens),
+             jnp.asarray(self._active), jnp.asarray(self._nt),
+             jnp.asarray(self._max_nt), jnp.asarray(self._eos),
+             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+             self._presence_arg(), jnp.asarray(self._rseed, jnp.int32)),
+            rows=int(self._active.sum()))
+        (out, tok, lens, active, nt, presence, (toks, emitted)) = res
         self._keep_caches(out)
         if self._rep_on:
             self._presence = presence
@@ -1695,6 +1866,8 @@ class ServingEngine:
                 continue
             hits = emitted[:, s]
             req.tokens.extend(int(t) for t in toks[hits, s])
+            if hits.any():
+                self.telemetry.req_event(req.rid, "decode", now)
             if self._drafters is not None:
                 # spec engines reach here through the thin-draft
                 # fallback: the drafter context must track every
@@ -1705,6 +1878,9 @@ class ServingEngine:
                 self._finish(req, now)
         self._active = still_active
         self._decode_steps += n_emitted      # 1 row-step per token here
+        self.telemetry.finish_step(
+            ev, self.clock() if ev is not None else 0.0,
+            tokens=n_emitted)
         return n_emitted
 
     def _spec_decode_step(self):
@@ -1751,11 +1927,6 @@ class ServingEngine:
         toks = np.zeros((b, k + 1), np.int32)
         toks[:, 0] = self._tok
         toks[:, 1:] = drafts
-        fn = self._counted_jit(
-            ("verify", k),
-            lambda: self.dec._build_verify_core(
-                k, self._rep_on, greedy_out=not self.do_sample),
-            donate=(3,))
         if self.paged:
             # cover the verify block's write window [lens, lens+K]
             # before dispatch — accepted positions become attendable
@@ -1767,12 +1938,18 @@ class ServingEngine:
                         s, int(self._lens[s]),
                         min(int(self._lens[s]) + k + 1,
                             self._budget_pos(s)))
-        caches_out, out = fn(
-            stk, e_arrays, h_arrays, self._cache_arg(), jnp.asarray(toks),
-            jnp.asarray(self._lens), jnp.asarray(dlen),
-            jnp.asarray(self._active), jnp.asarray(self._nt),
-            jnp.asarray(self._eos), jnp.asarray(self._min_len),
-            jnp.asarray(self._rep_pen), self._presence_arg())
+        (caches_out, out), ev = self._run_dispatch(
+            ("verify", k),
+            lambda: self.dec._build_verify_core(
+                k, self._rep_on, greedy_out=not self.do_sample),
+            (3,),
+            (stk, e_arrays, h_arrays, self._cache_arg(),
+             jnp.asarray(toks), jnp.asarray(self._lens),
+             jnp.asarray(dlen), jnp.asarray(self._active),
+             jnp.asarray(self._nt), jnp.asarray(self._eos),
+             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+             self._presence_arg()),
+            rows=int(self._active.sum()), drafts=int(dlen.sum()))
         self._keep_caches(caches_out)
         if self.do_sample:
             logits = np.asarray(out).astype(np.float32)  # [B, K+1, V]
@@ -1811,6 +1988,7 @@ class ServingEngine:
             self._decode_steps += 1
             self._draft_proposed += m
             self._draft_accepted += len(emitted) - 1
+            self.telemetry.req_event(req.rid, "verify", now)
             self._drafters[s].update(emitted)
             if self._rep_on:
                 new_rows.extend([s] * len(emitted))
@@ -1823,6 +2001,9 @@ class ServingEngine:
             # presence carry was DISCARDED — only accepted tokens join
             self._presence = self._presence.at[
                 jnp.asarray(new_rows), jnp.asarray(new_cols)].set(True)
+        self.telemetry.finish_step(
+            ev, self.clock() if ev is not None else 0.0,
+            tokens=n_emitted)
         return n_emitted
 
     def _expire_deadlines(self, now):
@@ -1846,7 +2027,21 @@ class ServingEngine:
         req.t_done = now
         if expired:
             self._expired += 1
+        else:
+            self._finished += 1
+            # histogram observation happens HERE, not at the first
+            # token: expired requests must stay out of the percentiles
+            # (their "latency" is an eviction time), same contract the
+            # old done-list scan enforced
+            self.telemetry.observe_request(req.ttft_s, req.latency_s)
+        self.telemetry.req_done(req.rid, req.state, now)
         self.results[req.rid] = req.result()
+        # bounded results (the telemetry ring size): a long-lived engine
+        # must not leak one dict per finished request — totals live in
+        # the window counters + the Prometheus lifetime base, recent
+        # results stay retrievable
+        while len(self.results) > self._results_cap:
+            self.results.pop(next(iter(self.results)))
         if self.paged:
             self._kv_committed -= self._blocks_needed(req.prompt.size,
                                                       req.max_new_tokens)

@@ -104,6 +104,13 @@ class NGramDrafter:
                 f"{min_ngram}..{max_ngram}")
         self._toks = []
         self._maps = {}
+        # telemetry: drafter-level lookup effectiveness (how often
+        # propose() had ANYTHING to offer — upstream of the engine's
+        # acceptance_rate, which only sees proposals that shipped).
+        # Per-drafter lifetime counters: reset() starts a new CONTEXT,
+        # not a new measurement window, so they survive re-admission.
+        self.propose_calls = 0
+        self.propose_hits = 0
         self.reset(())
 
     def reset(self, prompt):
@@ -133,6 +140,7 @@ class NGramDrafter:
         matches (the caller ships an all-masked draft)."""
         toks = self._toks
         length = len(toks)
+        self.propose_calls += 1
         for n in range(self.max_ngram, self.min_ngram - 1, -1):
             if length < n:
                 continue
@@ -141,6 +149,7 @@ class NGramDrafter:
                 continue
             # j + n < length by construction (only n-grams with a
             # continuation are indexed), so there is >= 1 draft token
+            self.propose_hits += 1
             return np.asarray(toks[j + n: j + n + self.k], np.int32)
         return np.zeros((0,), np.int32)
 

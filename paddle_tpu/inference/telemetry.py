@@ -1,0 +1,675 @@
+"""Serving telemetry: request spans, step timeline, bounded histograms,
+Prometheus/Perfetto export.
+
+Observability as a SUBSYSTEM instead of a dict (parity target: the
+reference stack's first-class profiler — python/paddle/profiler ::
+Profiler/RecordEvent/export_chrome_tracing — and the per-step/per-request
+timelines vLLM/Sarathi-style serving systems lean on to diagnose TTFT
+tails and budget waste):
+
+  * ``Telemetry`` — per-engine event collector. Per-request LIFECYCLE
+    SPANS (queued -> admitted -> prefix-adopt -> prefill chunks ->
+    first token -> decode/verify dispatches -> finished|expired|
+    rejected, monotonic engine-clock timestamps) and a STEP TIMELINE
+    (one event per compiled dispatch: kind admit/prefill/decode/verify/
+    budget, rows packed, budget used/wasted, draft tokens, dispatch vs
+    host-side elapsed, trace-spy deltas, gauge snapshots) both live in
+    bounded rings sized by ``PADDLE_TELEMETRY_RING`` (default 2048
+    entries; ``0`` disables span/step collection with near-zero
+    overhead — ONE branch per event, no timestamp calls when off).
+  * ``LogHistogram`` — fixed-size log2-bucketed streaming histograms
+    for TTFT / per-request latency / tokens-per-step. These replace the
+    old ``metrics()`` percentile scans over the grow-forever results
+    list (a real leak at service lifetimes): O(1) memory, O(1) observe,
+    p50/p90/p99 within one bucket width of exact, exact counts. The
+    histograms stay on even when the ring is disabled (they are the
+    ``metrics()`` percentile source and cost nothing).
+  * ``export_chrome_tracing(engine, path)`` — renders the rings as
+    Chrome-trace JSON via the ``paddle_tpu.profiler.ChromeTrace`` event
+    model (one pid per engine, one tid per slot plus a dispatch-
+    timeline tid, counter tracks for kv_blocks_used / queue depth /
+    budget_utilization), so Perfetto shows the serving run next to
+    jax.profiler's XLA timeline.
+  * ``render_prometheus(engine)`` / ``parse_prometheus(text)`` —
+    Prometheus text exposition with STABLE names (``PROMETHEUS_NAMES``
+    maps every ``metrics()`` key; counters are monotonic across
+    ``reset_metrics`` because the engine folds each window into a
+    lifetime base), folding in distributed-runtime gauges: watchdog
+    per-rank heartbeat age + peer-failure counts, supervisor restart
+    generation, and the rpc call-latency histogram registered here via
+    ``runtime_histogram``/``runtime_counter``.
+  * ``snapshot(engine)`` — the JSON routing payload a cluster
+    front-end consumes (queue depth, occupancy, pool headroom, prefix
+    hit rate, histogram percentiles).
+
+This module must stay import-light (stdlib + numpy only): the
+distributed runtime (rpc.py) records into the runtime registry and must
+not drag jax in at module import.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+
+import numpy as np
+
+__all__ = ["LogHistogram", "Telemetry", "RequestTrace",
+           "export_chrome_tracing", "render_prometheus",
+           "parse_prometheus", "snapshot", "runtime_histogram",
+           "runtime_counter", "runtime_prometheus", "PROMETHEUS_NAMES",
+           "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING"]
+
+DEFAULT_RING = 2048
+
+
+# ---------------------------------------------------------------- histogram
+class LogHistogram:
+    """Fixed-size log2-bucketed streaming histogram.
+
+    Buckets: one underflow bucket [0, lo), then ``buckets_per_octave``
+    geometric buckets per factor-of-two up to ``hi``, then one overflow
+    bucket. Percentile estimates interpolate linearly inside the target
+    bucket, so they sit within ONE bucket width of the exact value —
+    the accuracy/footprint trade the serving metrics need (memory is a
+    few hundred int64s forever, vs one dict per finished request).
+
+    Two layers of counts: the WINDOW (what ``percentile``/``count``
+    read; ``reset()`` zeroes it) and a lifetime BASE ``reset()`` folds
+    the window into — ``cumulative_counts()`` reads window + base, so
+    Prometheus counters stay monotonic across ``reset_metrics``.
+    """
+
+    __slots__ = ("edges", "counts", "total", "sum",
+                 "_base", "_base_total", "_base_sum", "bpo")
+
+    def __init__(self, lo=1e-6, hi=1e4, buckets_per_octave=4):
+        if not (0 < lo < hi):
+            raise ValueError(f"need 0 < lo < hi, got {lo}, {hi}")
+        self.bpo = int(buckets_per_octave)
+        n = int(math.ceil(math.log2(hi / lo) * self.bpo))
+        self.edges = lo * np.power(2.0, np.arange(n + 1) / self.bpo)
+        self.counts = np.zeros(n + 2, np.int64)   # under + n + over
+        self._base = np.zeros(n + 2, np.int64)
+        self.total = 0
+        self.sum = 0.0
+        self._base_total = 0
+        self._base_sum = 0.0
+
+    @property
+    def count(self):
+        return self.total
+
+    def observe(self, value):
+        v = max(float(value), 0.0)
+        # side="left": a value EXACTLY on a bucket edge belongs to the
+        # bucket that edge closes (buckets are (lo, hi]) — Prometheus'
+        # `le` boundaries are inclusive, so the text exposition's
+        # cumulative count at le=edge must include edge-valued samples
+        # (integer-valued series like tokens-per-step land exactly on
+        # the pow-2 edges every time)
+        i = int(np.searchsorted(self.edges, v, side="left"))
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+
+    def _bucket_bounds(self, i):
+        """(lo, hi] of bucket index ``i`` (0 = underflow; the overflow
+        bucket is clamped to its lower edge — an estimate can never
+        exceed the histogram's stated range)."""
+        n = self.edges.size
+        lo = 0.0 if i == 0 else float(self.edges[i - 1])
+        hi = float(self.edges[min(i, n - 1)])
+        return lo, hi
+
+    def percentile(self, q):
+        """Estimated q-th percentile (linear interpolation inside the
+        target bucket); None when the window is empty."""
+        if self.total == 0:
+            return None
+        target = (q / 100.0) * self.total
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo, hi = self._bucket_bounds(i)
+                frac = min(max((target - cum) / c, 0.0), 1.0)
+                return float(lo + frac * (hi - lo))
+            cum += c
+        lo, hi = self._bucket_bounds(len(self.counts) - 1)
+        return float(hi)
+
+    def bucket_width_at(self, value):
+        """Width of the bucket containing ``value`` — the documented
+        bound on the percentile estimation error. Same edge rule as
+        observe: a value on an edge belongs to the bucket it closes."""
+        v = max(float(value), 0.0)
+        i = int(np.searchsorted(self.edges, v, side="left"))
+        lo, hi = self._bucket_bounds(i)
+        return hi - lo
+
+    def reset(self):
+        """Zero the window, folding it into the lifetime base (the
+        Prometheus exposition never moves backwards)."""
+        self._base += self.counts
+        self._base_total += self.total
+        self._base_sum += self.sum
+        self.counts[:] = 0
+        self.total = 0
+        self.sum = 0.0
+
+    def cumulative_counts(self):
+        """(bucket counts, total, sum) over the histogram's LIFETIME
+        (window + every reset-folded window)."""
+        return (self._base + self.counts, self._base_total + self.total,
+                self._base_sum + self.sum)
+
+    def snapshot(self):
+        return {"count": int(self.total), "sum": round(float(self.sum), 6),
+                "p50": self.percentile(50), "p90": self.percentile(90),
+                "p99": self.percentile(99)}
+
+    def prometheus_lines(self, name, help_text=""):
+        """Prometheus histogram exposition over the LIFETIME counts.
+        Bucket boundaries are decimated to one per octave (the full
+        sub-octave resolution stays available to ``percentile``; the
+        text format does not need 130 lines per histogram)."""
+        counts, total, total_sum = self.cumulative_counts()
+        lines = [f"# HELP {name} {help_text or name}",
+                 f"# TYPE {name} histogram"]
+        for i in range(0, self.edges.size, self.bpo):
+            # le=edges[i] covers buckets 0..i (underflow + everything
+            # strictly below that edge)
+            cum = int(counts[: i + 1].sum())
+            lines.append(f'{name}_bucket{{le="{self.edges[i]:.6g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {int(total)}')
+        lines.append(f"{name}_sum {float(total_sum):.9g}")
+        lines.append(f"{name}_count {int(total)}")
+        return lines
+
+
+# ------------------------------------------------------------ request spans
+class RequestTrace:
+    """One request's lifecycle span: ordered (event, t) pairs on the
+    engine clock. Lives in ``Telemetry._live`` while in flight, moves
+    to the bounded ``spans`` ring at finish/expiry/rejection."""
+
+    __slots__ = ("rid", "slot", "state", "events")
+
+    def __init__(self, rid, slot=None):
+        self.rid = rid
+        self.slot = slot
+        self.state = "queued"
+        self.events = []                  # [(name, t_monotonic), ...]
+
+    def t0(self):
+        return self.events[0][1] if self.events else 0.0
+
+    def t1(self):
+        return self.events[-1][1] if self.events else 0.0
+
+
+class Telemetry:
+    """Per-engine telemetry collector (see the module docstring).
+
+    Every ``req_*``/``step_event`` entry point starts with ONE enabled
+    branch; call sites are expected to guard their own timestamp
+    computation on ``self.enabled`` so a disabled ring costs no clock
+    reads. The three histograms are independent of the ring and stay on
+    (they are the ``metrics()`` percentile source)."""
+
+    def __init__(self, ring=None, clock=None):
+        if ring is None:
+            ring = int(os.environ.get("PADDLE_TELEMETRY_RING",
+                                      str(DEFAULT_RING)))
+        if ring < 0:
+            raise ValueError(f"telemetry ring must be >= 0, got {ring}")
+        self.ring = int(ring)
+        self.enabled = self.ring > 0
+        self.clock = clock or time.perf_counter
+        self.spans = deque(maxlen=max(self.ring, 1))
+        self.steps = deque(maxlen=max(self.ring, 1))
+        self._live = {}                   # rid -> RequestTrace
+        self.hist_ttft = LogHistogram(1e-6, 1e4)
+        self.hist_latency = LogHistogram(1e-6, 1e4)
+        self.hist_step_tokens = LogHistogram(1.0, 1 << 16)
+
+    # ------------------------------------------------------- request spans
+    def req_queued(self, rid, t):
+        if not self.enabled:
+            return
+        tr = RequestTrace(rid)
+        tr.events.append(("queued", t))
+        self._live[rid] = tr
+
+    def req_admitted(self, rid, slot, t):
+        if not self.enabled:
+            return
+        tr = self._live.get(rid)
+        if tr is not None:
+            tr.slot = slot
+            tr.events.append(("admitted", t))
+
+    def req_event(self, rid, name, t):
+        if not self.enabled:
+            return
+        tr = self._live.get(rid)
+        if tr is not None:
+            tr.events.append((name, t))
+
+    def req_done(self, rid, state, t):
+        if not self.enabled:
+            return
+        tr = self._live.pop(rid, None)
+        if tr is None:                    # never tracked (ring was off
+            tr = RequestTrace(rid)        # at submit); synthesize
+        tr.state = state
+        tr.events.append((state, t))
+        self.spans.append(tr)
+
+    def req_rejected(self, t, rid=None):
+        """Sheds never get a rid — record a one-event span directly."""
+        if not self.enabled:
+            return
+        tr = RequestTrace(rid)
+        tr.state = "rejected"
+        tr.events.append(("rejected", t))
+        self.spans.append(tr)
+
+    # ------------------------------------------------------- step timeline
+    def step_event(self, kind, t, dur_s, rows=0, tokens=0,
+                   traces_delta=0, **gauges):
+        """One compiled dispatch on the timeline; returns the record so
+        the caller can attach harvest results (tokens, host_s) once the
+        host side finishes. None when disabled."""
+        if not self.enabled:
+            return None
+        ev = {"kind": kind, "t": t, "dur_s": dur_s, "rows": int(rows),
+              "tokens": int(tokens), "traces_delta": int(traces_delta)}
+        ev.update(gauges)
+        self.steps.append(ev)
+        return ev
+
+    @staticmethod
+    def finish_step(ev, now, tokens=None):
+        """Close a step record: host-side elapsed = everything between
+        the dispatch returning and the harvest completing."""
+        if ev is None:
+            return
+        if tokens is not None:
+            ev["tokens"] = int(tokens)
+        ev["host_s"] = round(max(0.0, now - ev["t"] - ev["dur_s"]), 9)
+
+    # --------------------------------------------------------- histograms
+    def observe_request(self, ttft_s, latency_s):
+        if ttft_s is not None:
+            self.hist_ttft.observe(ttft_s)
+        if latency_s is not None:
+            self.hist_latency.observe(latency_s)
+
+    def observe_step_tokens(self, n):
+        self.hist_step_tokens.observe(n)
+
+    def reset(self):
+        """Window reset (rides ``engine.reset_metrics``): clears the
+        rings so the next export covers exactly the measured window,
+        folds the histograms' windows into their lifetime bases.
+        In-flight spans survive — their requests are still live."""
+        self.spans.clear()
+        self.steps.clear()
+        self.hist_ttft.reset()
+        self.hist_latency.reset()
+        self.hist_step_tokens.reset()
+
+
+# -------------------------------------------------------- runtime registry
+# Process-global metrics the distributed runtime feeds (rpc call
+# latency, error counts); folded into every engine's exposition and
+# into runtime_prometheus() for engine-less processes.
+_runtime_hists: dict = {}
+_runtime_counters: dict = {}
+
+
+def runtime_histogram(name, lo=1e-6, hi=1e3):
+    h = _runtime_hists.get(name)
+    if h is None:
+        h = _runtime_hists[name] = LogHistogram(lo, hi)
+    return h
+
+
+def runtime_counter(name, inc=0):
+    _runtime_counters[name] = _runtime_counters.get(name, 0) + inc
+    return _runtime_counters[name]
+
+
+def runtime_prometheus():
+    """Distributed-runtime gauges: supervisor restart generation,
+    watchdog per-rank heartbeat age + peer-failure count, and whatever
+    the runtime registry accumulated (rpc latency/errors)."""
+    lines = []
+
+    def gauge(name, value, help_text="", labels=""):
+        lines.append(f"# HELP {name} {help_text or name}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value:g}")
+
+    gen = int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+    gauge("paddle_runtime_restart_generation", gen,
+          "gang supervisor restart generation (PADDLE_RESTART_COUNT)")
+    try:
+        from ..distributed.resilience.watchdog import current_watchdog
+        wd = current_watchdog()
+    except Exception:                     # import cycle / stripped build
+        wd = None
+    if wd is not None:
+        g = wd.gauges()
+        ages = g["heartbeat_age_s"]
+        if ages:
+            name = "paddle_runtime_watchdog_heartbeat_age_seconds"
+            lines.append(f"# HELP {name} seconds since each peer's "
+                         "heartbeat counter last progressed")
+            lines.append(f"# TYPE {name} gauge")
+            for peer in sorted(ages):
+                lines.append(f'{name}{{peer="{peer}"}} {ages[peer]:.3f}')
+        lines.append("# HELP paddle_runtime_watchdog_peer_failures_total "
+                     "peer failures recorded by this rank's watchdog")
+        lines.append("# TYPE paddle_runtime_watchdog_peer_failures_total "
+                     "counter")
+        lines.append("paddle_runtime_watchdog_peer_failures_total "
+                     f"{g['peer_failures_total']}")
+    for name in sorted(_runtime_counters):
+        lines.append(f"# HELP {name} {name}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_runtime_counters[name]}")
+    for name in sorted(_runtime_hists):
+        lines.extend(_runtime_hists[name].prometheus_lines(name))
+    return lines
+
+
+# --------------------------------------------------- prometheus exposition
+# STABLE name (and type) for every key ServingEngine.metrics() can emit.
+# tools/check_metrics_surface.py asserts the mapping is total: a future
+# counter that skips this table fails tier-1 instead of silently missing
+# from the exposition. Percentile keys map to their backing histogram.
+PROMETHEUS_NAMES = {
+    "tokens_emitted": ("paddle_serving_tokens_emitted_total", "counter"),
+    "busy_s": ("paddle_serving_busy_seconds_total", "counter"),
+    "tokens_per_sec": ("paddle_serving_tokens_per_sec", "gauge"),
+    "requests_finished": ("paddle_serving_requests_finished_total",
+                          "counter"),
+    "requests_admitted": ("paddle_serving_requests_admitted_total",
+                          "counter"),
+    "requests_forked": ("paddle_serving_requests_forked_total", "counter"),
+    "requests_rejected": ("paddle_serving_requests_rejected_total",
+                          "counter"),
+    "requests_expired": ("paddle_serving_requests_expired_total",
+                         "counter"),
+    "queue_depth": ("paddle_serving_queue_depth", "gauge"),
+    "occupancy": ("paddle_serving_slot_occupancy", "gauge"),
+    "traces": ("paddle_serving_compiled_traces_total", "counter"),
+    "ttft_p50_s": ("paddle_serving_ttft_seconds", "histogram"),
+    "ttft_p90_s": ("paddle_serving_ttft_seconds", "histogram"),
+    "ttft_p99_s": ("paddle_serving_ttft_seconds", "histogram"),
+    "latency_p50_s": ("paddle_serving_request_latency_seconds",
+                      "histogram"),
+    "latency_p99_s": ("paddle_serving_request_latency_seconds",
+                      "histogram"),
+    "prefix_hits": ("paddle_serving_prefix_hits_total", "counter"),
+    "prefix_misses": ("paddle_serving_prefix_misses_total", "counter"),
+    "prefix_hit_rate": ("paddle_serving_prefix_hit_rate", "gauge"),
+    "prefill_tokens_saved": ("paddle_serving_prefill_tokens_saved_total",
+                             "counter"),
+    "prefill_tokens_computed": (
+        "paddle_serving_prefill_tokens_computed_total", "counter"),
+    "decode_steps": ("paddle_serving_decode_row_steps_total", "counter"),
+    "draft_proposed": ("paddle_serving_draft_proposed_total", "counter"),
+    "draft_accepted": ("paddle_serving_draft_accepted_total", "counter"),
+    "acceptance_rate": ("paddle_serving_draft_acceptance_rate", "gauge"),
+    "tokens_per_step": ("paddle_serving_tokens_per_step", "gauge"),
+    "kv_blocks_total": ("paddle_serving_kv_blocks_total", "gauge"),
+    "kv_blocks_used": ("paddle_serving_kv_blocks_used", "gauge"),
+    "kv_blocks_free": ("paddle_serving_kv_blocks_free", "gauge"),
+    "kv_cow_copies": ("paddle_serving_kv_cow_copies_total", "counter"),
+    "budget_steps": ("paddle_serving_budget_steps_total", "counter"),
+    "budget_tokens_used": ("paddle_serving_budget_tokens_used_total",
+                           "counter"),
+    "budget_prefill_tokens": (
+        "paddle_serving_budget_prefill_tokens_total", "counter"),
+    "budget_decode_tokens": (
+        "paddle_serving_budget_decode_tokens_total", "counter"),
+    "budget_draft_tokens": ("paddle_serving_budget_draft_tokens_total",
+                            "counter"),
+    "budget_utilization": ("paddle_serving_budget_utilization", "gauge"),
+}
+
+# metrics() keys with no scalar Prometheus twin (nested dicts whose
+# fields are exported under their own names below)
+PROMETHEUS_EXEMPT_KEYS = {"prefix_store"}
+
+# metrics() keys reset_metrics legitimately does NOT restore to a fresh
+# engine's values: the trace spy (documented: never reset, it IS the
+# retrace contract) and allocator STATE (published prefix blocks stay
+# resident across a window reset)
+RESET_EXEMPT_KEYS = {"traces", "prefix_store", "kv_blocks_total",
+                     "kv_blocks_used", "kv_blocks_free"}
+
+# window counters the engine folds into its lifetime base at
+# reset_metrics — exactly the counter-typed keys minus the never-reset
+# trace spy
+COUNTER_FOLD_KEYS = tuple(
+    k for k, (_, t) in PROMETHEUS_NAMES.items()
+    if t == "counter" and k != "traces")
+
+
+def _fmt(v):
+    return f"{float(v):.9g}"
+
+
+def render_prometheus(engine):
+    """Prometheus text exposition for one ServingEngine: every scalar
+    metrics() key under its stable name (counters = lifetime base +
+    current window, monotonic across reset_metrics), the three
+    telemetry histograms, pool/prefix-store gauges, and the
+    distributed-runtime section."""
+    m = engine.metrics()
+    base = getattr(engine, "_prom_base", {})
+    lines = []
+    seen = set()
+    for key, (name, typ) in PROMETHEUS_NAMES.items():
+        if typ == "histogram" or name in seen:
+            continue
+        v = m.get(key)
+        if typ == "counter":
+            v = base.get(key, 0) + (v or 0)
+        elif v is None:
+            continue                      # gauge with nothing to report
+        seen.add(name)
+        lines.append(f"# HELP {name} serving metric {key!r}")
+        lines.append(f"# TYPE {name} {typ}")
+        lines.append(f"{name} {_fmt(v)}")
+    tele = engine.telemetry
+    lines.extend(tele.hist_ttft.prometheus_lines(
+        "paddle_serving_ttft_seconds",
+        "time to first token (submit -> first token), seconds"))
+    lines.extend(tele.hist_latency.prometheus_lines(
+        "paddle_serving_request_latency_seconds",
+        "per-request latency (submit -> finished), seconds"))
+    lines.extend(tele.hist_step_tokens.prometheus_lines(
+        "paddle_serving_step_tokens",
+        "tokens emitted per scheduler step"))
+    if engine.pool is not None:
+        g = engine.pool.gauges()
+        name = "paddle_serving_kv_blocks_used_peak"
+        lines.append(f"# HELP {name} kv pool residency high-water mark")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {g['kv_blocks_used_peak']}")
+    if engine.prefix_cache is not None:
+        st = engine.prefix_cache.store.stats()
+        for k in ("blocks_used", "blocks_capacity"):
+            if k not in st:
+                continue
+            name = f"paddle_serving_prefix_store_{k}"
+            lines.append(f"# HELP {name} prefix store {k}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {st[k]}")
+    lines.extend(runtime_prometheus())
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text):
+    """Text-format parse back into ``{name{labels} or name: value}``.
+    Strict enough for round-trip tests: every non-comment line must be
+    ``<name>[{labels}] <float>``, and every sample must sit under a
+    preceding # TYPE for its metric family."""
+    samples = {}
+    typed = set()
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) != 4 or parts[3] not in ("counter", "gauge",
+                                                   "histogram"):
+                raise ValueError(f"malformed TYPE line: {ln!r}")
+            typed.add(parts[2])
+            continue
+        if ln.startswith("#"):
+            continue
+        name_part, _, value = ln.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"malformed sample line: {ln!r}")
+        fam = name_part.split("{", 1)[0]
+        for sfx in ("_bucket", "_sum", "_count", ""):
+            if sfx and fam.endswith(sfx) and fam[: -len(sfx)] in typed:
+                break
+        else:
+            if fam not in typed:
+                raise ValueError(f"sample {fam!r} has no # TYPE line")
+        samples[name_part] = float(value)
+    return samples
+
+
+# ------------------------------------------------------------------ export
+def snapshot(engine):
+    """JSON-serializable telemetry snapshot — the routing payload a
+    cluster front-end polls per replica (load + affinity + headroom in
+    one cheap read)."""
+    m = engine.metrics()
+    tele = engine.telemetry
+    out = {
+        "queue_depth": m["queue_depth"],
+        "occupancy": m["occupancy"],
+        "num_slots": engine.num_slots,
+        "has_work": bool(engine.has_work),
+        "tokens_per_sec": m["tokens_per_sec"],
+        "requests": {k: m[f"requests_{k}"] for k in
+                     ("admitted", "finished", "forked", "rejected",
+                      "expired")},
+        "histograms": {
+            "ttft_s": tele.hist_ttft.snapshot(),
+            "latency_s": tele.hist_latency.snapshot(),
+            "tokens_per_step": tele.hist_step_tokens.snapshot(),
+        },
+        "budget": {k: m[f"budget_{k}"] for k in
+                   ("steps", "tokens_used", "prefill_tokens",
+                    "decode_tokens", "draft_tokens", "utilization")},
+        "prefix": {"hits": m["prefix_hits"], "misses": m["prefix_misses"],
+                   "hit_rate": m["prefix_hit_rate"]},
+        "spans_logged": len(tele.spans),
+        "steps_logged": len(tele.steps),
+        "telemetry_ring": tele.ring,
+    }
+    if engine.pool is not None:
+        out["kv_blocks"] = engine.pool.gauges()
+    if engine._drafters is not None:
+        out["drafter"] = {
+            "propose_calls": sum(d.propose_calls
+                                 for d in engine._drafters),
+            "propose_hits": sum(d.propose_hits
+                                for d in engine._drafters),
+        }
+    return out
+
+
+def export_chrome_tracing(engine, path, pid=0):
+    """Write the engine's telemetry rings as Chrome-trace JSON
+    (chrome://tracing / Perfetto: File > Open). Layout: one pid per
+    engine (``pid``), tid 0 = the dispatch timeline (one complete event
+    per compiled step), tid 1..B = slots (complete span per request,
+    instants for each lifecycle event), tid B+1 = requests shed from
+    the queue; counter tracks for kv_blocks_used / queue_depth /
+    budget_utilization ride the step events. Timestamps are the engine
+    clock rebased to the earliest recorded event. Returns ``path``."""
+    from ..profiler import ChromeTrace
+    tele = engine.telemetry
+    tr = ChromeTrace()
+    tr.process(pid, "paddle_tpu ServingEngine")
+    tr.thread(pid, 0, "dispatch timeline")
+    for s in range(engine.num_slots):
+        tr.thread(pid, s + 1, f"slot {s}")
+    tr.thread(pid, engine.num_slots + 1, "queue (never admitted)")
+    ts = [ev["t"] for ev in tele.steps]
+    ts += [sp.t0() for sp in tele.spans if sp.events]
+    base = min(ts) if ts else 0.0
+
+    def us(t):
+        return max((t - base) * 1e6, 0.0)
+
+    for ev in tele.steps:
+        args = {k: v for k, v in ev.items()
+                if k not in ("kind", "t") and v is not None}
+        tr.complete(ev["kind"], pid, 0, us(ev["t"]),
+                    max(ev["dur_s"], 0.0) * 1e6, args=args)
+        t_us = us(ev["t"])
+        if ev.get("kv_blocks_used") is not None:
+            tr.counter("kv_blocks_used", pid, t_us,
+                       {"blocks": ev["kv_blocks_used"]})
+        if ev.get("queue_depth") is not None:
+            tr.counter("queue_depth", pid, t_us,
+                       {"requests": ev["queue_depth"]})
+        if ev["kind"] == "budget":
+            used = ev.get("budget_used", 0)
+            cap = used + ev.get("budget_wasted", 0)
+            if cap:
+                tr.counter("budget_utilization", pid, t_us,
+                           {"frac": round(used / cap, 4)})
+    for sp in tele.spans:
+        if not sp.events:
+            continue
+        tid = (sp.slot + 1 if sp.slot is not None
+               else engine.num_slots + 1)
+        t0, t1 = sp.t0(), sp.t1()
+        tr.complete(f"req {sp.rid} [{sp.state}]", pid, tid, us(t0),
+                    max(t1 - t0, 0.0) * 1e6,
+                    args={"state": sp.state,
+                          "events": [[n, round(t - t0, 6)]
+                                     for n, t in sp.events]})
+        for name, t in sp.events:
+            tr.instant(name, pid, tid, us(t))
+    tr.write(path)
+    return path
+
+
+def validate_chrome_trace(path_or_dict):
+    """Cheap structural validation of a Chrome-trace export (benches
+    and tests assert on it): must json-parse, carry a traceEvents list,
+    and every event must have the required ph/pid/ts fields."""
+    if isinstance(path_or_dict, dict):
+        doc = path_or_dict
+    else:
+        with open(path_or_dict) as f:
+            doc = json.load(f)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        raise ValueError("chrome trace: no traceEvents list")
+    for e in evs:
+        if e.get("ph") not in ("X", "i", "C", "M"):
+            raise ValueError(f"chrome trace: unknown phase in {e!r}")
+        if e["ph"] != "M" and ("ts" not in e or e["ts"] < 0):
+            raise ValueError(f"chrome trace: bad ts in {e!r}")
+        if "pid" not in e:
+            raise ValueError(f"chrome trace: missing pid in {e!r}")
+    return doc

@@ -21,7 +21,58 @@ from ..core.native import NativeTracer
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SummaryView"]
+           "SummaryView", "ChromeTrace"]
+
+
+class ChromeTrace:
+    """Chrome-trace (chrome://tracing / Perfetto) event builder — the
+    ONE event model shared by the profiler's host-span export and the
+    serving telemetry export (inference/telemetry.py), so both render
+    side by side with jax.profiler's XLA timeline in Perfetto.
+
+    Phases used: "M" metadata (process/thread names), "X" complete
+    events (ts + dur), "i" instants, "C" counters. Timestamps and
+    durations are MICROSECONDS (the trace-event spec's unit)."""
+
+    def __init__(self):
+        self.events = []
+
+    def process(self, pid, name):
+        self.events.append({"ph": "M", "name": "process_name",
+                            "pid": pid, "tid": 0,
+                            "args": {"name": name}})
+
+    def thread(self, pid, tid, name):
+        self.events.append({"ph": "M", "name": "thread_name",
+                            "pid": pid, "tid": tid,
+                            "args": {"name": name}})
+
+    def complete(self, name, pid, tid, ts_us, dur_us, args=None):
+        ev = {"ph": "X", "name": name, "pid": pid, "tid": tid,
+              "ts": round(float(ts_us), 3),
+              "dur": round(max(float(dur_us), 0.0), 3)}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def instant(self, name, pid, tid, ts_us):
+        self.events.append({"ph": "i", "name": name, "pid": pid,
+                            "tid": tid, "ts": round(float(ts_us), 3),
+                            "s": "t"})
+
+    def counter(self, name, pid, ts_us, values):
+        self.events.append({"ph": "C", "name": name, "pid": pid,
+                            "tid": 0, "ts": round(float(ts_us), 3),
+                            "args": dict(values)})
+
+    def to_dict(self):
+        return {"traceEvents": self.events, "displayTimeUnit": "ms"}
+
+    def write(self, path):
+        import json
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f)
+        return path
 
 # Host span collector (C++, csrc/runtime.cc — parity with the reference's
 # native host tracer); None-safe when the toolchain is absent.
@@ -181,7 +232,19 @@ class Profiler:
         print(self.step_info())
 
     def export(self, path, format="json"):
-        pass
+        """Chrome-trace export of the timer-level step timeline (the
+        XPlane/host dumps land in the log dir at stop(); this is the
+        lightweight per-step view, same event model as the serving
+        telemetry export)."""
+        tr = ChromeTrace()
+        tr.process(0, "paddle_tpu Profiler")
+        tr.thread(0, 0, "train steps")
+        t = 0.0
+        for i, dt in enumerate(self._step_times):
+            tr.complete(f"step {i}", 0, 0, t * 1e6, dt * 1e6)
+            t += dt
+        tr.write(path)
+        return path
 
     def __enter__(self):
         self.start()

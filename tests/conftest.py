@@ -50,10 +50,10 @@ def check_serving_metrics(eng):
     token throughput implies busy time, and rates stay in [0, 1].
     Returns the metrics dict so tests can chain their own assertions.
 
-    NOTE: call on windows without an intervening reset_metrics(
-    keep_results=True) — requests_finished is recomputed from retained
-    results while the window counters zero, which legitimately breaks
-    the reconciliation."""
+    NOTE: call on windows without an intervening reset_metrics() while
+    requests were still in flight — a request admitted before the reset
+    that finishes after it counts in the finished window but not the
+    admitted one, which legitimately breaks the reconciliation."""
     m = eng.metrics()
     assert m["requests_admitted"] >= 0
     # every finished request was admitted or forked (expired ones may
@@ -136,9 +136,48 @@ def check_serving_metrics(eng):
         assert not any(pool.refcounts[b] for b in pool._free)
         assert 0 <= eng._kv_reserved <= pool.num_blocks
         assert m["kv_cow_copies"] >= 0
+        assert pool.used <= pool.used_peak <= pool.num_blocks
     else:
         assert m["kv_blocks_total"] is None
         assert m["kv_cow_copies"] == 0
+    # telemetry reconciliation (the PR 8 surface): the histograms ARE
+    # the percentile source — latency observes exactly the non-expired
+    # finished requests, TTFT at most that (a request always has a
+    # first token by finish; <= covers exotic fork edge cases), and a
+    # percentile is None exactly when its histogram window is empty
+    tele = getattr(eng, "telemetry", None)
+    if tele is not None:
+        assert tele.hist_latency.count == m["requests_finished"], (
+            f"latency histogram saw {tele.hist_latency.count} requests "
+            f"but requests_finished={m['requests_finished']}")
+        assert tele.hist_ttft.count <= m["requests_finished"]
+        assert (m["ttft_p50_s"] is None) == (tele.hist_ttft.count == 0)
+        assert (m["latency_p50_s"] is None) == (tele.hist_latency.count
+                                                == 0)
+        for a, b in (("ttft_p50_s", "ttft_p90_s"),
+                     ("ttft_p90_s", "ttft_p99_s"),
+                     ("latency_p50_s", "latency_p99_s")):
+            if m[a] is not None:
+                assert 0.0 <= m[a] <= m[b], (a, b, m[a], m[b])
+        assert m["queue_depth"] >= 0 and 0.0 <= m["occupancy"] <= 1.0
+        assert m["requests_rejected"] >= 0 and m["requests_expired"] >= 0
+        assert m["traces"] >= 0
+        # the ring is BOUNDED (so is the results dict — the old
+        # done-list leak), and the exposition round-trips a text-format
+        # parse with lifetime counters never lagging the window
+        assert len(tele.spans) <= max(tele.ring, 1)
+        assert len(tele.steps) <= max(tele.ring, 1)
+        assert len(eng.results) <= eng._results_cap
+        from paddle_tpu.inference.telemetry import parse_prometheus
+        prom = parse_prometheus(eng.metrics_prometheus())
+        assert prom["paddle_serving_tokens_emitted_total"] >= \
+            m["tokens_emitted"]
+        assert prom["paddle_serving_requests_admitted_total"] >= \
+            m["requests_admitted"]
+        assert prom["paddle_serving_ttft_seconds_count"] >= \
+            tele.hist_ttft.count
+        assert prom["paddle_serving_request_latency_seconds_count"] >= \
+            m["requests_finished"]
     return m
 
 

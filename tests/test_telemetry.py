@@ -1,0 +1,540 @@
+"""Serving telemetry subsystem (ISSUE 8): request spans, step timeline,
+bounded histograms, Prometheus/Perfetto export, runtime gauges.
+
+Contracts under test:
+  * span ordering + the TTFT event: the span's first_token - queued IS
+    the request's measured ttft_s (same engine clock, same floats);
+  * ring bounding: spans, steps, AND the results dict stay bounded
+    under churn while total counts survive in the window counters;
+  * telemetry-off fast path: ring 0 records nothing, metrics percentile
+    surface still works (histograms are independent of the ring);
+  * Prometheus exposition parses and counters are monotonic across
+    reset_metrics (the lifetime-base fold);
+  * histogram percentiles sit within one bucket width of exact numpy
+    percentiles;
+  * Chrome-trace export of a mixed prefill/decode/spec run is valid
+    trace JSON with >= 1 complete request span and the kv_blocks_used
+    counter track (the acceptance criterion);
+  * watchdog heartbeat-age gauge goes stale on a dropped heartbeat
+    (riding the fault-injection harness) and folds into the runtime
+    exposition;
+  * tools/check_metrics_surface.py passes (every metrics key covered by
+    reset_metrics + conftest reconciliation + Prometheus — tier-1).
+"""
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.telemetry import (LogHistogram, Telemetry,
+                                            export_chrome_tracing,
+                                            parse_prometheus,
+                                            validate_chrome_trace)
+from paddle_tpu.nn.layer.common import Embedding, Linear
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model(seed=3):
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return fmt, embed, head
+
+
+def _prompt(rng, n):
+    return rng.randint(1, V, (n,)).astype(np.int32)
+
+
+# =====================================================================
+# LogHistogram
+# =====================================================================
+class TestLogHistogram:
+    def test_percentiles_within_one_bucket_width(self):
+        """The documented accuracy contract: p50/p90/p99 estimates land
+        within one bucket width of exact numpy percentiles."""
+        rng = np.random.RandomState(7)
+        values = rng.lognormal(mean=-3.0, sigma=1.5, size=2000)
+        h = LogHistogram(1e-6, 1e4)
+        for v in values:
+            h.observe(v)
+        assert h.count == values.size
+        assert abs(h.sum - values.sum()) < 1e-6 * values.sum() + 1e-9
+        for q in (50, 90, 99):
+            exact = float(np.percentile(values, q))
+            est = h.percentile(q)
+            w = max(h.bucket_width_at(exact), h.bucket_width_at(est))
+            assert abs(est - exact) <= w + 1e-12, (q, est, exact, w)
+
+    def test_monotone_in_q_and_empty(self):
+        h = LogHistogram(1e-6, 1e3)
+        assert h.percentile(50) is None
+        rng = np.random.RandomState(1)
+        for v in rng.uniform(0.001, 10.0, 500):
+            h.observe(v)
+        ps = [h.percentile(q) for q in (1, 25, 50, 75, 90, 99)]
+        assert ps == sorted(ps)
+
+    def test_underflow_and_overflow_bounded(self):
+        h = LogHistogram(1e-3, 1.0)
+        h.observe(0.0)                       # underflow: frozen clocks
+        h.observe(1e9)                       # overflow: clamps to the
+        assert 0.0 <= h.percentile(1) < 1e-3  # last (pow-2-rounded) edge
+        assert h.percentile(99) <= float(h.edges[-1]) + 1e-12
+
+    def test_le_edges_are_inclusive(self):
+        """Prometheus `le` boundaries are INCLUSIVE: a sample exactly on
+        a bucket edge (tokens-per-step lands on the pow-2 edges every
+        run) must count under le=edge, or histogram_quantile skews a
+        whole bucket high."""
+        h = LogHistogram(1.0, 1 << 16)
+        for _ in range(10):
+            h.observe(4.0)               # exactly a per-octave edge
+        by_le = {}
+        for ln in h.prometheus_lines("t"):
+            if ln.startswith('t_bucket{le="'):
+                le = ln.split('"')[1]
+                by_le[le] = int(ln.rsplit(" ", 1)[1])
+        assert by_le["4"] == 10, by_le
+        assert by_le["2"] == 0
+        # the internal percentile view agrees: p50 sits in the bucket
+        # 4 closes, not the one above it
+        assert h.percentile(50) <= 4.0 + 1e-12
+
+    def test_reset_folds_into_cumulative(self):
+        h = LogHistogram(1e-3, 10.0)
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        h.reset()
+        assert h.count == 0 and h.percentile(50) is None
+        h.observe(0.5)
+        counts, total, s = h.cumulative_counts()
+        assert total == 4 and int(counts.sum()) == 4
+        assert abs(s - 1.1) < 1e-9
+        lines = h.prometheus_lines("x_seconds")
+        assert "x_seconds_count 4" in lines
+        # cumulative bucket counts are non-decreasing in le
+        vals = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                if ln.startswith("x_seconds_bucket")]
+        assert vals == sorted(vals) and vals[-1] == 4
+
+
+# =====================================================================
+# Request spans + ring bounding
+# =====================================================================
+class TestRequestSpans:
+    def test_span_ordering_and_ttft_event(self, serving_metrics_ok):
+        """Span events are time-ordered with the canonical lifecycle
+        sequence, and the TTFT implied by the span (first_token -
+        queued) EQUALS the request's measured ttft_s exactly — one
+        clock, one set of floats."""
+        fmt, embed, head = _model(seed=5)
+        rng = np.random.RandomState(2)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2,
+                            prefill_cap=4, prefix_cache_blocks=8)
+        rids = [eng.submit(_prompt(rng, 9), max_new_tokens=4)
+                for _ in range(3)]
+        eng.run()
+        serving_metrics_ok(eng)
+        spans = {sp.rid: sp for sp in eng.telemetry.spans}
+        assert set(rids) <= set(spans)
+        for rid in rids:
+            sp = spans[rid]
+            names = [n for n, _ in sp.events]
+            ts = [t for _, t in sp.events]
+            assert ts == sorted(ts)
+            assert names[0] == "queued" and names[-1] == "finished"
+            assert sp.state == "finished"
+            order = [names.index(n) for n in
+                     ("queued", "admitted", "first_token", "finished")]
+            assert order == sorted(order)
+            assert "prefill_chunk" in names
+            ev = dict(sp.events)             # first_token is unique
+            assert ev["first_token"] - ev["queued"] == \
+                eng.results[rid]["ttft_s"]
+            assert sp.slot is not None
+        # shared prompts: requests 2..3 hit the prefix cache published
+        # by request 1 — the adopt event shows in their spans
+        rid2 = eng.submit(_prompt(np.random.RandomState(2), 9),
+                          max_new_tokens=2)
+        eng.run()
+        sp2 = {sp.rid: sp for sp in eng.telemetry.spans}[rid2]
+        assert "prefix_adopt" in [n for n, _ in sp2.events]
+
+    def test_ring_bounds_spans_steps_and_results(self, serving_metrics_ok):
+        """PADDLE_TELEMETRY_RING bounds all three retention surfaces
+        under churn; total counts survive in the window counters (the
+        unbounded-results leak fix)."""
+        fmt, embed, head = _model(seed=6)
+        rng = np.random.RandomState(3)
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128, decode_chunk=2,
+                            telemetry_ring=4)
+        assert eng.telemetry.ring == 4 and eng._results_cap == 4
+        rids = []
+        for _ in range(10):
+            rids.append(eng.submit(_prompt(rng, 5), max_new_tokens=2))
+            eng.run()
+        m = serving_metrics_ok(eng)
+        assert m["requests_finished"] == 10      # totals preserved
+        assert m["requests_admitted"] == 10
+        assert eng.telemetry.hist_latency.count == 10
+        assert len(eng.telemetry.spans) == 4     # rings bounded
+        assert len(eng.results) == 4
+        assert set(eng.results) == set(rids[-4:])  # newest retained
+        assert len(eng.telemetry.steps) <= 4
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TELEMETRY_RING", "16")
+        fmt, embed, head = _model(seed=7)
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=64)
+        assert eng.telemetry.ring == 16 and eng._results_cap == 16
+        with pytest.raises(ValueError, match=">= 0"):
+            Telemetry(-1)
+
+    def test_telemetry_off_fast_path(self, serving_metrics_ok):
+        """Ring 0: no spans, no step events, no per-event clock reads —
+        but the histogram-backed metrics surface still works (it rides
+        timestamps the engine takes anyway) and results stay bounded at
+        the default cap."""
+        fmt, embed, head = _model(seed=8)
+        rng = np.random.RandomState(4)
+        calls = [0]
+        base = time.perf_counter
+
+        def counting_clock():
+            calls[0] += 1
+            return base()
+
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2,
+                            telemetry_ring=0, clock=counting_clock)
+        on = ServingEngine(fmt, embed, head, num_slots=2,
+                           max_seq_len=128, decode_chunk=2,
+                           clock=lambda: base())
+        assert not eng.telemetry.enabled
+        for e in (eng, on):
+            for _ in range(2):
+                e.submit(_prompt(np.random.RandomState(4), 6),
+                         max_new_tokens=3)
+        off_calls0 = calls[0]
+        eng.run()
+        on.run()
+        off_calls = calls[0] - off_calls0
+        m = serving_metrics_ok(eng)
+        assert len(eng.telemetry.spans) == 0
+        assert len(eng.telemetry.steps) == 0
+        assert m["ttft_p50_s"] is not None       # histograms still on
+        assert eng._results_cap == 2048
+        # the off engine reads the clock strictly less often than the
+        # on engine would for the same flow (no dispatch timing, no
+        # admission stamps): sanity-bound it to the step-level reads
+        steps = sum(1 for _ in eng.chunk_log)
+        assert off_calls <= 6 * steps + 4 * m["requests_admitted"] + 8
+        text = eng.metrics_prometheus()          # exposition still works
+        assert "paddle_serving_ttft_seconds_count" in text
+
+    def test_rejected_span_and_expiry_state(self, serving_metrics_ok):
+        from paddle_tpu.inference.serving import AdmissionFull
+        fmt, embed, head = _model(seed=9)
+        rng = np.random.RandomState(5)
+        clk = [0.0]
+
+        def ticking():                           # strictly advancing
+            clk[0] += 1e-4
+            return clk[0]
+
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=128, decode_chunk=2,
+                            max_pending=2, clock=ticking)
+        eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        rid_exp = eng.submit(_prompt(rng, 4), max_new_tokens=2,
+                             deadline_s=0.5)
+        with pytest.raises(AdmissionFull):
+            eng.submit(_prompt(rng, 4), max_new_tokens=2)
+        states = [sp.state for sp in eng.telemetry.spans]
+        assert states == ["rejected"]
+        clk[0] = 10.0                            # expire the queued one
+        eng.run()
+        m = serving_metrics_ok(eng)
+        assert m["requests_expired"] == 1 and m["requests_rejected"] == 1
+        by_rid = {sp.rid: sp for sp in eng.telemetry.spans}
+        assert by_rid[rid_exp].state == "expired"
+        # expired requests never reach the latency histograms
+        assert eng.telemetry.hist_latency.count == m["requests_finished"]
+
+
+# =====================================================================
+# Prometheus exposition
+# =====================================================================
+class TestPrometheus:
+    def test_parse_and_counter_monotonic_across_reset(self):
+        """The exposition round-trips a text parse, and every counter is
+        monotonic across reset_metrics (the lifetime-base fold): the
+        scrape a Prometheus server sees never moves backwards."""
+        fmt, embed, head = _model(seed=10)
+        rng = np.random.RandomState(6)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2)
+        for _ in range(2):
+            eng.submit(_prompt(rng, 6), max_new_tokens=3)
+        eng.run()
+        s1 = parse_prometheus(eng.metrics_prometheus())
+        counters = [k for k in s1 if k.endswith("_total")
+                    or k.endswith("_count")]
+        assert "paddle_serving_tokens_emitted_total" in counters
+        eng.reset_metrics(keep_results=False)
+        eng.submit(_prompt(rng, 6), max_new_tokens=3)
+        eng.run()
+        s2 = parse_prometheus(eng.metrics_prometheus())
+        for k in counters:
+            assert s2[k] >= s1[k], (k, s1[k], s2[k])
+        # and the window genuinely moved (not a trivially-frozen scrape)
+        assert s2["paddle_serving_tokens_emitted_total"] > \
+            s1["paddle_serving_tokens_emitted_total"]
+        # histogram sum/count reconcile
+        assert s2["paddle_serving_request_latency_seconds_count"] == \
+            s2["paddle_serving_requests_finished_total"]
+
+    def test_malformed_lines_rejected(self):
+        with pytest.raises(ValueError):
+            parse_prometheus("no_type_line 1\n")
+        with pytest.raises(ValueError):
+            parse_prometheus("# TYPE x widget\nx 1\n")
+
+    def test_runtime_registry(self):
+        from paddle_tpu.inference import telemetry as T
+        T.runtime_counter("paddle_test_counter_total", 3)
+        T.runtime_histogram("paddle_test_latency_seconds").observe(0.01)
+        text = "\n".join(T.runtime_prometheus())
+        s = parse_prometheus(text + "\n")
+        assert s["paddle_test_counter_total"] >= 3
+        assert s["paddle_test_latency_seconds_count"] >= 1
+        assert "paddle_runtime_restart_generation" in s
+
+
+# =====================================================================
+# Chrome-trace export (the Perfetto acceptance criterion)
+# =====================================================================
+class TestChromeTrace:
+    def test_mixed_prefill_decode_spec_run_exports(self):
+        """A mixed prefill/decode/spec run exports valid Chrome-trace
+        JSON: >= 1 COMPLETE request span (queued -> finished) and the
+        kv_blocks_used counter track, thread metadata for slots, and
+        every event structurally sound (validate_chrome_trace)."""
+        fmt, embed, head = _model(seed=12)
+        rng = np.random.RandomState(8)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2,
+                            prefill_cap=4, spec_k=2)
+        for _ in range(3):
+            core = _prompt(rng, 6)
+            eng.submit(np.tile(core, 3), max_new_tokens=12)
+        eng.run()
+        path = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"telemetry_trace_{os.getpid()}.json")
+        try:
+            export_chrome_tracing(eng, path)
+            doc = validate_chrome_trace(path)
+            evs = doc["traceEvents"]
+            spans = [e for e in evs if e["ph"] == "X"
+                     and str(e.get("name", "")).startswith("req ")
+                     and "[finished]" in e["name"]]
+            assert spans, "no complete queued->finished request span"
+            for e in spans:
+                assert e["dur"] >= 0 and e["tid"] >= 1
+                names = [n for n, _ in e["args"]["events"]]
+                assert names[0] == "queued" and names[-1] == "finished"
+            kinds = {e["name"] for e in evs if e["ph"] == "X"
+                     and e["tid"] == 0}
+            # budget scheduling is the default: every dispatch kind on
+            # the timeline is a canonical one
+            assert kinds <= {"admit", "prefill", "decode", "verify",
+                             "budget"}
+            assert kinds & {"budget", "decode"}
+            counters = {e["name"] for e in evs if e["ph"] == "C"}
+            assert "kv_blocks_used" in counters    # paged default
+            assert "queue_depth" in counters
+            threads = [e for e in evs if e["ph"] == "M"
+                       and e["name"] == "thread_name"]
+            assert len(threads) == eng.num_slots + 2
+        finally:
+            if os.path.exists(path):
+                os.remove(path)
+
+    def test_export_covers_measured_window_after_reset(self):
+        fmt, embed, head = _model(seed=14)
+        rng = np.random.RandomState(9)
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=64, decode_chunk=2)
+        eng.submit(_prompt(rng, 5), max_new_tokens=2)
+        eng.run()
+        eng.reset_metrics(keep_results=False)    # warmup discarded
+        rid = eng.submit(_prompt(rng, 5), max_new_tokens=2)
+        eng.run()
+        assert [sp.rid for sp in eng.telemetry.spans] == [rid]
+
+
+# =====================================================================
+# Runtime gauges: watchdog heartbeat age (fault-injection harness)
+# =====================================================================
+class TestWatchdogGauges:
+    def test_heartbeat_age_goes_stale_on_dropped_heartbeat(
+            self, monkeypatch):
+        from paddle_tpu.core.native import (TCPStore, TCPStoreServer,
+                                            load_native)
+        if load_native() is None:
+            pytest.skip("native runtime unavailable")
+        from paddle_tpu.distributed.resilience import watchdog as wdm
+        from paddle_tpu.distributed.resilience.watchdog import Watchdog
+        # ride the existing fault-injection harness: rank 1's publisher
+        # goes dark while its process stays alive — rank 0's gauge must
+        # age out and cross the failure threshold
+        monkeypatch.setenv("PADDLE_FI_DROP_HEARTBEAT", "1")
+        srv = TCPStoreServer(0)
+        wd0 = wd1 = None
+        try:
+            def mk(rank):
+                return Watchdog(
+                    lambda t: TCPStore("127.0.0.1", srv.port,
+                                       timeout_s=t),
+                    rank, 2, timeout_s=1.0, interval_s=0.1,
+                    action="flag")
+            wd0 = mk(0).start()
+            wd1 = mk(1).start()
+            deadline = time.monotonic() + 8.0
+            while wd0.failure is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert wd0.failure is not None, "dropped heartbeat undetected"
+            ages = wd0.heartbeat_ages()
+            assert set(ages) == {1}
+            assert ages[1] > wd0.timeout_s       # stale past threshold
+            g = wd0.gauges()
+            assert g["peer_failures_total"] == 1
+            # rank 1 SEES rank 0 beating: its gauge stays fresh
+            assert wd1.heartbeat_ages()[0] < wd0.timeout_s
+            # the runtime exposition folds the gauges in
+            monkeypatch.setattr(wdm, "_watchdog", [wd0])
+            from paddle_tpu.inference.telemetry import (
+                parse_prometheus, runtime_prometheus)
+            s = parse_prometheus("\n".join(runtime_prometheus()) + "\n")
+            key = 'paddle_runtime_watchdog_heartbeat_age_seconds{peer="1"}'
+            assert s[key] > wd0.timeout_s
+            assert s["paddle_runtime_watchdog_peer_failures_total"] == 1
+        finally:
+            for wd in (wd0, wd1):
+                if wd is not None:
+                    wd.stop()
+            srv.stop()
+
+
+# =====================================================================
+# Structured JSON-lines runtime log
+# =====================================================================
+class TestLogJson:
+    def test_plain_mode_prints_message_verbatim(self, capsys,
+                                                monkeypatch):
+        monkeypatch.delenv("PADDLE_LOG_JSON", raising=False)
+        from paddle_tpu.distributed.logjson import log_event
+        log_event("launch", "restart", message="launch: restarting",
+                  backoff_s=1.0)
+        log_event("watchdog", "clean_exit")      # message-less: silent
+        out = capsys.readouterr().out
+        assert out == "launch: restarting\n"
+
+    def test_json_mode_one_object_per_line(self, capsys, monkeypatch):
+        monkeypatch.setenv("PADDLE_LOG_JSON", "1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "2")
+        from paddle_tpu.distributed.logjson import log_event
+        t0 = time.monotonic()
+        log_event("watchdog", "peer_failure",
+                  message="paddle_tpu watchdog: rank 1 stale",
+                  ranks=[1], timeout_s=1.0)
+        log_event("launch", "gang_start", world=2)
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        recs = [json.loads(ln) for ln in lines]
+        assert recs[0]["component"] == "watchdog"
+        assert recs[0]["event"] == "peer_failure"
+        assert recs[0]["rank"] == 3 and recs[0]["generation"] == 2
+        assert recs[0]["ranks"] == [1]
+        assert recs[0]["t_mono"] >= t0 - 1.0
+        assert abs(recs[0]["t_wall"] - time.time()) < 60.0
+        assert recs[1]["event"] == "gang_start" and recs[1]["world"] == 2
+
+    def test_watchdog_failure_emits_json(self, capsys, monkeypatch):
+        monkeypatch.setenv("PADDLE_LOG_JSON", "1")
+        from paddle_tpu.distributed.resilience.watchdog import (
+            PeerFailureError, Watchdog)
+        wd = Watchdog(lambda t: None, 0, 2, timeout_s=1.0,
+                      interval_s=0.1, action="flag")
+        wd._fail(PeerFailureError("rank 1 gone", ranks=(1,)))
+        recs = [json.loads(ln) for ln in
+                capsys.readouterr().out.strip().splitlines()]
+        ev = [r for r in recs if r.get("event") == "peer_failure"]
+        assert ev and ev[0]["ranks"] == [1]
+        assert wd.peer_failures == 1
+
+
+# =====================================================================
+# rpc call-latency histogram
+# =====================================================================
+def _rpc_probe(x):
+    return x * 2
+
+
+class TestRpcLatency:
+    def test_rpc_call_records_latency(self):
+        from paddle_tpu.core.native import load_native
+        if load_native() is None:
+            pytest.skip("native runtime unavailable")
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.inference import telemetry as T
+        h = T.runtime_histogram("paddle_rpc_call_latency_seconds")
+        c0 = T.runtime_counter("paddle_rpc_calls_total", 0)
+        n0 = h.count
+        rpc.init_rpc("tele_worker0", rank=0, world_size=1,
+                     master_endpoint="127.0.0.1:0")
+        try:
+            assert rpc.rpc_sync("tele_worker0", _rpc_probe,
+                                args=(21,)) == 42
+            assert h.count == n0 + 1
+            assert T.runtime_counter("paddle_rpc_calls_total", 0) == \
+                c0 + 1
+            text = "\n".join(T.runtime_prometheus()) + "\n"
+            s = parse_prometheus(text)
+            assert s["paddle_rpc_call_latency_seconds_count"] >= 1
+        finally:
+            rpc.shutdown()
+
+
+# =====================================================================
+# tools/check_metrics_surface.py as a tier-1 test
+# =====================================================================
+def test_metrics_surface_fully_covered(capsys):
+    """Every metrics() key is covered by reset_metrics, the conftest
+    reconciliation, AND the Prometheus exposition — the PR 4 reset-
+    metrics bug class, made structural."""
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_surface",
+        os.path.join(REPO_ROOT, "tools", "check_metrics_surface.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main()
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "ok" in out

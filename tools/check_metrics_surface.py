@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Metrics-surface coverage check (runnable standalone AND as a tier-1
+test via tests/test_telemetry.py).
+
+Every key ``ServingEngine.metrics()`` can emit must be covered by all
+three of:
+
+  1. ``reset_metrics`` — after a reset the key must read like a fresh
+     engine's (or be on ``telemetry.RESET_EXEMPT_KEYS``: the trace spy
+     and allocator state, which legitimately survive a window reset);
+  2. the conftest reconciliation — ``check_serving_metrics`` in
+     tests/conftest.py must mention the key (every serving test then
+     exercises its invariant);
+  3. the Prometheus exposition — ``telemetry.PROMETHEUS_NAMES`` must
+     map the key to a stable name (or list it in
+     ``telemetry.PROMETHEUS_EXEMPT_KEYS``), and the mapped name must
+     actually appear in ``metrics_prometheus()`` output whenever the
+     key has a value.
+
+This makes the PR 4 bug class (a new counter silently skipping
+reset_metrics) STRUCTURAL: adding a metrics key without wiring all
+three surfaces fails tier-1.
+
+Usage: python tools/check_metrics_surface.py   (exit 0 = covered)
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_engine():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+
+    V, E, H, FF, L = 67, 32, 4, 64, 1
+    paddle.seed(11)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    # prefix cache ON (paged default): the widest metrics surface —
+    # every key the engine can emit is present in this configuration
+    rng = np.random.RandomState(5)
+    eng = ServingEngine(fmt, embed, head, num_slots=2, max_seq_len=64,
+                        decode_chunk=2, prefill_cap=4,
+                        prefix_cache_blocks=8)
+    return eng, rng, V
+
+
+def main(argv=None):
+    from paddle_tpu.inference.telemetry import (PROMETHEUS_EXEMPT_KEYS,
+                                                PROMETHEUS_NAMES,
+                                                RESET_EXEMPT_KEYS)
+    import numpy as np
+
+    failures = []
+    eng, rng, V = _build_engine()
+    fresh = eng.metrics()
+    keys = set(fresh)
+
+    # ---- drive real traffic so every counter that CAN move has moved
+    for n in (5, 9):
+        eng.submit(rng.randint(1, V, (n,)).astype(np.int32),
+                   max_new_tokens=3)
+    eng.run()
+    moved = eng.metrics()
+    # exposition captured on the ACTIVE window (post-reset, derived
+    # gauges like tokens_per_sec legitimately report None and vanish)
+    text = eng.metrics_prometheus()
+
+    # ---- 1. reset coverage
+    eng.reset_metrics(keep_results=False)
+    after = eng.metrics()
+    for k in sorted(keys):
+        if k in RESET_EXEMPT_KEYS:
+            continue
+        if after[k] != fresh[k]:
+            failures.append(
+                f"reset_metrics does not restore {k!r}: fresh "
+                f"{fresh[k]!r} vs post-reset {after[k]!r} (cover it in "
+                "reset_metrics or document it in "
+                "telemetry.RESET_EXEMPT_KEYS)")
+
+    # ---- 2. conftest reconciliation coverage (textual: the key must
+    # be asserted on in check_serving_metrics)
+    conftest_path = os.path.join(REPO_ROOT, "tests", "conftest.py")
+    with open(conftest_path) as f:
+        src = f.read()
+    body = src.split("def check_serving_metrics", 1)
+    if len(body) != 2:
+        failures.append("tests/conftest.py lost check_serving_metrics")
+        body = ["", src]
+    for k in sorted(keys):
+        if f'"{k}"' not in body[1]:
+            failures.append(
+                f"check_serving_metrics (tests/conftest.py) never "
+                f"touches metrics key {k!r} — add a reconciliation or "
+                "sanity assert for it")
+
+    # ---- 3. Prometheus exposition coverage
+    for k in sorted(keys):
+        if k in PROMETHEUS_EXEMPT_KEYS:
+            continue
+        if k not in PROMETHEUS_NAMES:
+            failures.append(
+                f"metrics key {k!r} has no telemetry.PROMETHEUS_NAMES "
+                "entry (map it to a stable name, or add it to "
+                "PROMETHEUS_EXEMPT_KEYS with a reason)")
+            continue
+        name, typ = PROMETHEUS_NAMES[k]
+        probe = f"{name}_bucket" if typ == "histogram" else name
+        # a gauge currently reporting None may legitimately be absent;
+        # anything the engine HAS a value for must be in the text.
+        # `moved` (pre-reset) is the window where values existed.
+        if moved.get(k) is not None and probe not in text:
+            failures.append(
+                f"metrics key {k!r} maps to {name!r} ({typ}) but the "
+                "exposition does not contain it")
+
+    if failures:
+        print("check_metrics_surface: FAILED")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print(f"check_metrics_surface: ok ({len(keys)} metrics keys covered "
+          "by reset_metrics + conftest reconciliation + Prometheus "
+          "exposition)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    # standalone runs must not touch the container's TPU tunnel (same
+    # lever as tests/conftest.py: the config override wins over env)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())
