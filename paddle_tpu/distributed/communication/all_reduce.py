@@ -2,11 +2,13 @@
 from __future__ import annotations
 
 from ...tensor.tensor import Tensor
+from ..resilience.flight_recorder import instrumented as _instrumented
 from .group import ReduceOp, _default_group
 
 __all__ = ["all_reduce"]
 
 
+@_instrumented("all_reduce")
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     from .group import Task
     g = group or _default_group()

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..resilience.flight_recorder import instrumented as _instrumented
+
 __all__ = ["ReduceOp", "Group", "ProcessGroupXLA", "new_group", "get_group",
            "destroy_process_group", "is_initialized", "_ensure_default_group",
            "_default_group", "wait"]
@@ -119,6 +121,7 @@ class ProcessGroupXLA:
         return np.asarray(local[0])
 
     # ----------------------------------------------------------- collectives
+    @_instrumented("pg_allreduce")
     def allreduce(self, arr, op=ReduceOp.SUM):
         import jax.lax as lax
         red = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
@@ -132,6 +135,7 @@ class ProcessGroupXLA:
             ("allreduce", op), arr,
             lambda x: red(x, self._axis()))[0])
 
+    @_instrumented("pg_allgather")
     def allgather(self, arr):
         import jax.lax as lax
         if self._in_trace(arr):
@@ -143,6 +147,7 @@ class ProcessGroupXLA:
             ("allgather",), arr,
             lambda x: lax.all_gather(x[0], self._axis()), out_spec=P()))
 
+    @_instrumented("pg_reducescatter")
     def reducescatter(self, arr, op=ReduceOp.SUM):
         import jax.lax as lax
         if self._in_trace(arr):
@@ -155,6 +160,7 @@ class ProcessGroupXLA:
             ("reducescatter", op), arr,
             lambda x: lax.psum_scatter(x[0], self._axis(), tiled=True)))
 
+    @_instrumented("pg_broadcast")
     def broadcast(self, arr, src_group_rank=0):
         import jax.lax as lax
         if self._in_trace(arr):
@@ -167,6 +173,7 @@ class ProcessGroupXLA:
             lambda x: lax.all_gather(x[0], self._axis())[src_group_rank],
             out_spec=P()))
 
+    @_instrumented("pg_alltoall")
     def alltoall(self, arr):
         import jax.lax as lax
         if self._in_trace(arr):
@@ -178,6 +185,7 @@ class ProcessGroupXLA:
             ("alltoall",), arr,
             lambda x: lax.all_to_all(x[0], self._axis(), 0, 0, tiled=True)))
 
+    @_instrumented("pg_permute")
     def permute(self, arr, perm):
         """ppermute: perm is a list of (src, dst) group-rank pairs."""
         import jax.lax as lax
@@ -189,6 +197,7 @@ class ProcessGroupXLA:
             ("ppermute", tuple(map(tuple, perm))), arr,
             lambda x: lax.ppermute(x, self._axis(), perm))[0])
 
+    @_instrumented("pg_barrier")
     def barrier(self):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ...tensor.tensor import Tensor
+from ..resilience.flight_recorder import instrumented as _instrumented
 from .group import ReduceOp, Task, _default_group
 
 __all__ = ["all_gather", "all_gather_object", "broadcast",
@@ -23,6 +24,7 @@ __all__ = ["all_gather", "all_gather_object", "broadcast",
            "barrier", "reduce_scatter", "get_backend", "stream"]
 
 
+@_instrumented("all_gather")
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     g = group or _default_group()
     gathered = g.pg.allgather(tensor._data)  # [nranks, ...]
@@ -34,6 +36,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return Task(gathered)
 
 
+@_instrumented("all_gather_object")
 def all_gather_object(object_list, obj, group=None):
     g = group or _default_group()
     if g.nranks <= 1:
@@ -71,6 +74,7 @@ def _capture_collective(tensor, fn):
     return Task(out._data)
 
 
+@_instrumented("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     g = group or _default_group()
     src_in_group = g.get_group_rank(src) if g.ranks else src
@@ -83,6 +87,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return Task(out)
 
 
+@_instrumented("reduce")
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reference semantics: only dst receives the reduction; other ranks'
     buffers are left as-is (XLA computes the allreduce — the cheapest ICI
@@ -111,6 +116,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return Task(out)
 
 
+@_instrumented("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = group or _default_group()
     if g.nranks <= 1:
@@ -146,6 +152,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return Task()
 
 
+@_instrumented("alltoall")
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     g = group or _default_group()
     if isinstance(in_tensor_list, Tensor):
@@ -167,6 +174,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return Task(out)
 
 
+@_instrumented("alltoall_single")
 def alltoall_single(in_tensor, out_tensor=None,
                     in_split_sizes=None, out_split_sizes=None, group=None,
                     sync_op=True):
@@ -179,6 +187,7 @@ def alltoall_single(in_tensor, out_tensor=None,
 
 
 # Point-to-point: realized as ppermute pairs (ICI neighbor exchange).
+@_instrumented("send")
 def send(tensor, dst=0, group=None, sync_op=True):
     g = group or _default_group()
     me = max(g.rank, 0)
@@ -186,6 +195,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return Task()
 
 
+@_instrumented("recv")
 def recv(tensor, src=0, group=None, sync_op=True):
     g = group or _default_group()
     me = max(g.rank, 0)
@@ -195,14 +205,17 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return Task(out)
 
 
+@_instrumented("isend")
 def isend(tensor, dst=0, group=None):
     return send(tensor, dst, group, sync_op=False)
 
 
+@_instrumented("irecv")
 def irecv(tensor, src=0, group=None):
     return recv(tensor, src, group, sync_op=False)
 
 
+@_instrumented("broadcast_object_list")
 def broadcast_object_list(object_list, src=0, group=None):
     """Broadcast a list of picklable objects from src (reference:
     communication/broadcast.py :: broadcast_object_list). Realized over
@@ -226,6 +239,7 @@ def broadcast_object_list(object_list, src=0, group=None):
     object_list[:] = gathered[src_gr]
 
 
+@_instrumented("scatter_object_list")
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
     """Scatter one picklable object per rank from src (reference:
@@ -250,6 +264,7 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     out_object_list[:] = [payload[me]]
 
 
+@_instrumented("gather")
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """Gather tensors onto dst (reference: communication/gather.py).
     All-ranks allgather + keep-on-dst: XLA collectives are SPMD — every
@@ -289,6 +304,7 @@ class P2POp:
         self.group = group
 
 
+@_instrumented("batch_isend_irecv")
 def batch_isend_irecv(p2p_op_list):
     """Execute a batch of P2POps; returns their Tasks. On TPU each pair
     lowers to a ppermute — XLA fuses/pipelines the batch over ICI, so
@@ -305,11 +321,13 @@ def get_backend(group=None):
     return "XLA"
 
 
+@_instrumented("barrier")
 def barrier(group=None):
     g = group or _default_group()
     return g.pg.barrier()
 
 
+@_instrumented("reduce_scatter")
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     g = group or _default_group()

@@ -97,6 +97,10 @@ def _spawn_gang(args, master, attempt):
                 "JAX_NUM_PROCESSES": str(world),
                 "JAX_COORDINATOR_ADDRESS": master,
             })
+            # flight dumps land next to the workerlogs unless the user
+            # pinned a dir — the supervisor's failure report aggregates
+            # flightdump.<rank>.<generation>.json from here
+            env.setdefault("PADDLE_FLIGHT_DUMP_DIR", args.log_dir)
             logf = open(_log_path(args.log_dir, rank, attempt), "a")
             logs.append(logf)
             # every rank INCLUDING 0 logs to its workerlog: rank 0 hosts
@@ -116,6 +120,42 @@ def _spawn_gang(args, master, attempt):
             f.close()
         raise
     return procs, logs
+
+
+def _emit_flight_diagnosis(args, attempt, world, stream=None):
+    """Aggregate the generation's flight dumps into the cross-rank
+    desync verdict and emit it as a ``gang_diagnosis`` event (plain
+    mode prints the diagnosis text verbatim — the SAME text
+    ``tools/flight_report.py`` prints offline, byte-for-byte; JSON mode
+    carries the structured fields for machine ingestion). Ranks whose
+    dump is missing or unparsable (crashed before dumping) are NAMED in
+    the diagnosis instead of silently omitted. Returns the struct, or
+    None when no dumps exist (recorder disabled)."""
+    from ..resilience import flight_recorder
+    dump_dir = os.environ.get("PADDLE_FLIGHT_DUMP_DIR") or args.log_dir
+    # only the ranks THIS supervisor spawned can be expected to dump
+    # into this node's dir — remote nodes' ranks dump on their hosts
+    local = [args.node_rank * args.nproc_per_node + i
+             for i in range(args.nproc_per_node)]
+    try:
+        text, diag = flight_recorder.diagnose_dir(
+            dump_dir, world=world, generation=attempt,
+            expected_ranks=local)
+    except Exception as e:          # a broken dump must not mask the
+        log_event("launch", "gang_diagnosis_error", stream=stream,
+                  message=f"launch: flight diagnosis failed: {e!r}",
+                  generation=attempt, error=repr(e))
+        return None                 # underlying failure report
+    if not diag["ranks_with_dump"] and not diag["missing_dump_errors"]:
+        return None                 # no recorder output for this gang
+    log_event("launch", "gang_diagnosis", stream=stream, message=text,
+              generation=attempt, world=world, desync=diag["desync"],
+              stragglers=diag["stragglers"], stuck=diag["stuck"],
+              ranks_with_dump=diag["ranks_with_dump"],
+              ranks_missing_dump=diag["ranks_missing_dump"],
+              missing_dump_errors=diag["missing_dump_errors"],
+              groups=diag["groups"])
+    return diag
 
 
 def _failure_report(args, procs, attempt) -> str:
@@ -205,6 +245,11 @@ def main():
                   exit_codes={p._pd_rank: p.poll() for p in procs},
                   log_tail=_tail(_log_path(args.log_dir,
                                            first_bad._pd_rank, attempt)))
+        # cross-rank flight diagnosis: name the desynced collective and
+        # the straggler rank instead of leaving only the log tail
+        _emit_flight_diagnosis(args, attempt,
+                               args.nproc_per_node * args.nnodes,
+                               stream=sys.stderr)
         attempt += 1
         if attempt > args.max_restart:
             log_event("launch", "restart_budget_exhausted",

@@ -31,11 +31,15 @@ def log_event(component: str, event: str, message: str | None = None,
     """Emit one runtime event.
 
     JSON mode: one object per line —
-    ``{"component", "event", "rank", "generation", "t_wall", "t_mono",
-    **fields}`` (rank from PADDLE_TRAINER_ID, None for the supervisor
-    itself; generation from PADDLE_RESTART_COUNT). Plain mode: prints
-    ``message`` verbatim when given, else silent (events that never had
-    a print — e.g. clean exits — only surface in JSON mode).
+    ``{"component", "event", "rank", "generation", "pid", "t_wall",
+    "t_mono", **fields}`` (rank from PADDLE_TRAINER_ID, None for the
+    supervisor itself; generation from PADDLE_RESTART_COUNT; pid so
+    events correlate with the flight recorder's pid-per-rank traces and
+    dump headers). Plain mode: prints ``message`` verbatim when given,
+    else silent (events that never had a print — e.g. clean exits —
+    only surface in JSON mode). The supervisor's ``gang_diagnosis``
+    event carries the cross-rank flight diagnosis this way: plain mode
+    prints the human text, JSON mode the structured verdict.
     """
     out = stream if stream is not None else sys.stdout
     if not json_logging_enabled():
@@ -49,6 +53,7 @@ def log_event(component: str, event: str, message: str | None = None,
         "rank": int(rank_env) if rank_env not in (None, "") else None,
         "generation": int(os.environ.get("PADDLE_RESTART_COUNT", "0")
                           or 0),
+        "pid": os.getpid(),
         "t_wall": round(time.time(), 6),
         "t_mono": round(time.monotonic(), 6),
     }

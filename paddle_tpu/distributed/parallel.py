@@ -21,6 +21,8 @@ from typing import Optional
 import jax
 import numpy as np
 
+from .resilience.flight_recorder import instrumented as _fr_instrumented
+
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
            "all_reduce_gradients", "is_initialized_env"]
 
@@ -184,6 +186,14 @@ def init_parallel_env():
     _state["initialized"] = True
     from ..testing import fault
     fault.inject("init", rank=_state["rank"])
+    # flight recorder: authoritative rank/world (default on at world>1),
+    # and a SIGTERM dump hook so a rank the supervisor reaps leaves its
+    # collective timeline behind for the cross-rank diagnosis
+    from .resilience import flight_recorder
+    flight_recorder.configure(rank=_state["rank"],
+                              world=_state["world_size"])
+    if _state["world_size"] > 1:
+        flight_recorder.install_signal_dump()
     _maybe_start_watchdog(_state["rank"], _state["world_size"])
     from .communication.group import _ensure_default_group
     _ensure_default_group()
@@ -248,13 +258,15 @@ class ParallelEnv:
         return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
 
 
+@_fr_instrumented("all_reduce_gradients")
 def all_reduce_gradients(params, group=None):
     """DataParallel grad sync: mean-allreduce every .grad across dp ranks.
 
     Parity: EagerReducer's bucketed allreduce
     (paddle/fluid/distributed/collective/reducer.cc). Under XLA one fused
     program per step IS the bucket fusion; eagerly this is a no-op at
-    world_size 1 and a psum at >1.
+    world_size 1 and a psum at >1. Recorded as ONE logical collective in
+    the flight recorder (the per-param all_reduce calls nest under it).
     """
     ws = get_world_size(group)
     if ws <= 1:

@@ -1,6 +1,11 @@
 """paddle.distributed resilience layer: heartbeat watchdog + monitored
 barrier (parity: ProcessGroupNCCL watchdog / FLAGS_pg_timeout semantics,
-realized over the native TCPStore)."""
+realized over the native TCPStore) + the distributed flight recorder
+(parity: torch's NCCL flight recorder — per-rank collective event rings,
+hang dumps, cross-rank desync diagnosis)."""
+from . import flight_recorder
+from .flight_recorder import (FlightRecorder, cluster_snapshot,
+                              diagnose_dir)
 from .watchdog import (PeerFailureError, Watchdog, start_watchdog,
                        stop_watchdog, check_peer_failure,
                        monitored_barrier, notify_progress,
@@ -8,4 +13,6 @@ from .watchdog import (PeerFailureError, Watchdog, start_watchdog,
 
 __all__ = ["PeerFailureError", "Watchdog", "start_watchdog",
            "stop_watchdog", "check_peer_failure", "monitored_barrier",
-           "notify_progress", "current_watchdog", "WATCHDOG_EXIT_CODE"]
+           "notify_progress", "current_watchdog", "WATCHDOG_EXIT_CODE",
+           "flight_recorder", "FlightRecorder", "cluster_snapshot",
+           "diagnose_dir"]

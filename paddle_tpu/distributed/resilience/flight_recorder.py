@@ -1,0 +1,947 @@
+"""Distributed flight recorder: per-rank collective event rings, hang
+dumps, and cross-rank desync diagnosis.
+
+Parity target: torch's NCCL flight recorder (``TORCH_NCCL_TRACE_BUFFER_
+SIZE`` / ``TORCH_NCCL_DUMP_ON_TIMEOUT``) — when a gang wedges, the
+supervisor must be able to say *which collective* diverged and *which
+rank* is the straggler, not just tail a workerlog. Rebuilt here on the
+repo's own TCPStore + telemetry plumbing:
+
+  * ``FlightRecorder`` — a bounded per-rank ring (``PADDLE_FLIGHT_
+    RECORDER`` sets the size; default on in multi-process jobs, ``0``
+    disables with ONE branch per event and zero clock reads) recording
+    every collective/rpc entry and exit: monotonic seq number, per-
+    process-group seq (the cross-rank alignment key — SPMD ranks issue
+    the same collectives in the same order per group), op kind, payload
+    shape/dtype/bytes, start/end timestamps, status
+    ``in_flight | done | error``.
+  * ONE instrumentation choke point — ``instrumented()`` (decorator)
+    and ``record_span()`` (context manager) — that ``communication/
+    ops.py``, ``communication/group.py``, ``parallel.py::
+    all_reduce_gradients``, ``Watchdog.monitored_barrier`` and
+    ``rpc.py`` all route through. Nested entries record only the
+    OUTERMOST op (``all_gather_object`` is one logical collective, not
+    three), and tracer-backed payloads are skipped entirely (a traced
+    collective is compiled into an XLA program; recording at trace time
+    would desynchronize seq numbers across ranks whose jit caches
+    differ). ``tools/check_collective_surface.py`` asserts structurally
+    that no public collective bypasses the choke point.
+  * Hang dumps — ``dump()`` writes ``flightdump.<rank>.<generation>.
+    json`` (dir: ``PADDLE_FLIGHT_DUMP_DIR``, the gang supervisor points
+    it at its log dir): the recorder tail, all-thread Python stacks
+    (``sys._current_frames`` + a raw ``faulthandler`` section),
+    watchdog gauges (heartbeat ages, restart generation — the dump is
+    self-describing without supervisor context), and the runtime
+    histogram registry. Triggered on watchdog ``PeerFailureError``,
+    wedged-rank escalation (exit 117), and supervisor SIGTERM.
+  * Cross-rank diagnosis — ``diagnose_dir()`` aggregates the dumps
+    into the desync verdict ("rank 0 in_flight in all_reduce seq=4;
+    rank 1 completed seq=3, never entered") naming the desynced
+    collective, the straggler ranks, ranks whose dump is missing, and
+    the straggler's in-collective stack. The gang supervisor and
+    ``tools/flight_report.py`` share this ONE implementation, so the
+    offline report reproduces the supervisor's diagnosis byte-for-byte.
+  * Cluster aggregation — each rank's watchdog publisher piggybacks a
+    small recorder snapshot onto TCPStore (``fr/<rank>`` keys, same
+    pattern as heartbeats); ``cluster_snapshot()`` on any rank reads
+    them all. Per-op wait-time histograms feed ``inference/telemetry``'s
+    ``runtime_histogram`` registry, so rank-level Prometheus exposition
+    comes for free; ``export_chrome_tracing()`` renders the dumps as a
+    pid-per-rank Perfetto timeline over ``profiler.ChromeTrace``.
+
+Import-light by design (stdlib only at module import): the launcher and
+the watchdog failure path load this; telemetry/profiler are pulled in
+lazily at the first recorded exit / export.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from contextlib import contextmanager
+
+__all__ = ["FlightRecorder", "DEFAULT_RING", "DUMP_SCHEMA",
+           "configure", "recorder", "reset", "instrumented",
+           "record_span", "instrumented_ops", "runtime_hist_name",
+           "dump_on_failure", "install_signal_dump", "dump_path",
+           "load_dumps", "diagnose", "diagnose_dir", "publish_snapshot",
+           "maybe_publish", "cluster_snapshot", "export_chrome_tracing"]
+
+DEFAULT_RING = 256
+DUMP_SCHEMA = "paddle_tpu.flightdump.v1"
+ENV_RING = "PADDLE_FLIGHT_RECORDER"
+ENV_DUMP_DIR = "PADDLE_FLIGHT_DUMP_DIR"
+SNAPSHOT_KEY_PREFIX = "fr/"
+STACK_TAIL_FRAMES = 12          # frames of the straggler stack in the report
+_RUNTIME_HIST_PREFIX = "paddle_runtime_collective_seconds"
+
+_SKIP = object()                # sentinel: tracer-backed payload, don't record
+
+
+def runtime_hist_name(op: str) -> str:
+    """Stable runtime-registry histogram name for one op kind (appears
+    in ``telemetry.runtime_prometheus()`` once the op has recorded an
+    exit; ``tools/check_metrics_surface.py`` pins the mapping)."""
+    return f"{_RUNTIME_HIST_PREFIX}_{op}"
+
+
+def _telemetry():
+    """Lazy runtime-metrics registry (same pattern as rpc.py): the
+    recorder must not drag numpy in at import, and must never fail on
+    metrics."""
+    global _TELE
+    if _TELE is None:
+        try:
+            from ...inference import telemetry as _t
+            _TELE = _t
+        except Exception:
+            _TELE = False
+    return _TELE or None
+
+
+_TELE = None
+
+
+def _fault():
+    """Lazy fault-injection harness (PADDLE_FI_HANG inside a collective
+    rides the choke point — the desync e2e's hook)."""
+    global _FAULT
+    if _FAULT is None:
+        try:
+            from ...testing import fault as _f
+            _FAULT = _f
+        except Exception:
+            _FAULT = False
+    return _FAULT or None
+
+
+_FAULT = None
+
+
+# ------------------------------------------------------------------ recorder
+class FlightRecorder:
+    """Bounded per-rank ring of collective/rpc events.
+
+    ``ring == 0`` disables collection: ``start``/``end`` return after
+    ONE branch with no clock reads (pinned by a counting-clock test,
+    same discipline as telemetry-off). In-flight events are tracked in
+    a side dict so a hung collective stays visible in ``tail()`` even
+    after later events evicted it from the ring.
+    """
+
+    def __init__(self, ring=None, rank=None, world=None, clock=None):
+        if ring is None:
+            ring = int(os.environ.get(ENV_RING, str(DEFAULT_RING)))
+        if ring < 0:
+            raise ValueError(f"flight recorder ring must be >= 0, "
+                             f"got {ring}")
+        self.ring = int(ring)
+        self.enabled = self.ring > 0
+        self.rank = int(rank if rank is not None
+                        else os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        self.world = int(world if world is not None
+                         else os.environ.get("PADDLE_TRAINERS_NUM", "1")
+                         or 1)
+        # time.monotonic, NOT perf_counter: dump headers stamp t_mono
+        # with the same clock, so "how long has this op been in flight"
+        # is dump.t_mono - ev.t_start with no cross-clock skew
+        self.clock = clock or time.monotonic
+        self.events = deque(maxlen=max(self.ring, 1))
+        self._in_flight = {}            # seq -> event dict
+        self._gseq = {}                 # group -> per-group seq counter
+        self._seq = 0
+        # RLock, not Lock: the SIGTERM dump handler runs on the MAIN
+        # thread at a bytecode boundary, which can land while that same
+        # thread's interrupted start()/end() frame holds the lock — a
+        # plain Lock would deadlock the dump (and the exit) against it
+        self._lock = threading.RLock()
+        self._dump_path = None          # set by the first dump (dump-once)
+
+    # ------------------------------------------------------------- recording
+    def start(self, op, group="default", kind="collective", shape=None,
+              dtype=None, nbytes=None, note=None):
+        """Record a collective/rpc ENTRY; returns the event (hand it to
+        ``end``), or None when disabled."""
+        if not self.enabled:
+            return None
+        t = self.clock()
+        with self._lock:
+            self._seq += 1
+            gseq = self._gseq.get(group, 0) + 1
+            self._gseq[group] = gseq
+            ev = {"seq": self._seq, "gseq": gseq, "op": op,
+                  "group": group, "kind": kind, "status": "in_flight",
+                  "t_start": t, "t_end": None}
+            if shape is not None:
+                ev["shape"] = list(shape)
+            if dtype is not None:
+                ev["dtype"] = str(dtype)
+            if nbytes is not None:
+                ev["nbytes"] = int(nbytes)
+            if note is not None:
+                ev["note"] = note
+            self.events.append(ev)
+            self._in_flight[ev["seq"]] = ev
+        return ev
+
+    def end(self, ev, error=None):
+        """Record the matching EXIT; feeds the per-op wait-time
+        histogram in the runtime registry."""
+        if ev is None:
+            return
+        t = self.clock()
+        with self._lock:
+            ev["t_end"] = t
+            ev["status"] = "done" if error is None else "error"
+            if error is not None:
+                ev["error"] = repr(error)
+            self._in_flight.pop(ev["seq"], None)
+        if ev["kind"] == "collective":
+            tele = _telemetry()
+            if tele is not None:
+                tele.runtime_histogram(
+                    runtime_hist_name(ev["op"])).observe(t - ev["t_start"])
+
+    def tail(self):
+        """Ring contents (seq order), merged with any in-flight events
+        the ring already evicted — a hung op is never dropped."""
+        with self._lock:
+            evs = {ev["seq"]: ev for ev in self.events}
+            evs.update(self._in_flight)
+        return [dict(evs[s]) for s in sorted(evs)]
+
+    def snapshot(self):
+        """Small JSON-able state summary — published to TCPStore by the
+        watchdog's heartbeat publisher and aggregated by
+        ``cluster_snapshot()`` (keep it heartbeat-sized: no stacks, no
+        event bodies)."""
+        with self._lock:
+            groups = {}
+            for ev in self._in_flight.values():
+                g = groups.setdefault(ev["group"], {})
+                g["in_flight_op"] = ev["op"]
+                g["in_flight_seq"] = ev["gseq"]
+            for grp, gseq in self._gseq.items():
+                groups.setdefault(grp, {})["seq"] = gseq
+            return {"rank": self.rank, "world": self.world,
+                    "generation": _generation(),
+                    "events_recorded": self._seq,
+                    "in_flight": len(self._in_flight),
+                    "groups": groups}
+
+    # ----------------------------------------------------------------- dumps
+    def dump_payload(self, reason="manual"):
+        """The full dump dict (separable from file IO for tests): ring
+        tail, all-thread stacks, watchdog gauges, runtime registry."""
+        t_mono = self.clock()
+        payload = {
+            "schema": DUMP_SCHEMA,
+            "rank": self.rank,
+            "world": self.world,
+            "generation": _generation(),
+            "pid": os.getpid(),
+            "reason": reason,
+            "t_wall": time.time(),
+            "t_mono": t_mono,
+            "ring": self.ring,
+            "events_recorded": self._seq,
+            "events": self.tail(),
+            "watchdog": _watchdog_state(),
+            "stacks": _thread_stacks(),
+            "faulthandler": _faulthandler_text(),
+        }
+        tele = _telemetry()
+        if tele is not None:
+            try:
+                payload["runtime_metrics"] = tele.runtime_registry_snapshot()
+            except Exception:
+                payload["runtime_metrics"] = None
+        return payload
+
+    def dump(self, path=None, reason="manual", force=False):
+        """Write the flight dump (atomic: tmp + rename). Dump-once by
+        default: the FIRST failure's view is the interesting one, and
+        cascading triggers (watchdog failure, then SIGTERM from the
+        supervisor reaping the gang) must not overwrite it."""
+        if self._dump_path is not None and not force:
+            return self._dump_path
+        if path is None:
+            path = dump_path(self.rank, _generation())
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.dump_payload(reason), f, default=str)
+        os.replace(tmp, path)
+        self._dump_path = path
+        return path
+
+
+def _generation() -> int:
+    return int(os.environ.get("PADDLE_RESTART_COUNT", "0") or 0)
+
+
+def _watchdog_state():
+    """The local watchdog's gauges + recorded failure — the dump header
+    must be self-describing without the supervisor's context (ISSUE:
+    heartbeat ages and restart generation in every dump)."""
+    try:
+        from .watchdog import current_watchdog
+        wd = current_watchdog()
+    except Exception:
+        return None
+    if wd is None:
+        return None
+    try:
+        return {"gauges": wd.gauges(),
+                "failure": str(wd.failure) if wd.failure else None,
+                "failure_ranks": list(wd.failure.ranks)
+                if wd.failure is not None else []}
+    except Exception:
+        return None
+
+
+def _thread_stacks():
+    """All-thread Python stacks as structured frames; the MAIN thread
+    (the one wedged inside a collective) is tagged so the diagnosis can
+    print its in-collective stack."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    main_id = threading.main_thread().ident
+    stacks = {}
+    for tid, frame in sys._current_frames().items():
+        label = names.get(tid, "unknown")
+        key = f"{label} (tid {tid})" + (" [main]" if tid == main_id else "")
+        stacks[key] = [
+            {"file": fs.filename, "line": fs.lineno, "func": fs.name,
+             "code": fs.line or ""}
+            for fs in traceback.extract_stack(frame)]
+    return stacks
+
+
+def _faulthandler_text():
+    """Raw faulthandler dump (C-level view of every thread) — catches
+    what the pure-Python walk can't when the interpreter state is
+    damaged. faulthandler writes through a real fd, so round-trip via a
+    temp file."""
+    try:
+        import faulthandler
+        import tempfile
+        with tempfile.TemporaryFile(mode="w+") as tf:
+            faulthandler.dump_traceback(file=tf, all_threads=True)
+            tf.seek(0)
+            return tf.read()
+    except Exception:
+        return ""
+
+
+# ----------------------------------------------------------- module recorder
+_UNSET = object()
+_REC: list = [_UNSET]
+
+
+def _init_from_env(world_hint=None):
+    """Default policy: explicitly set PADDLE_FLIGHT_RECORDER wins
+    (``0`` = off, N = ring size); unset = on with the default ring in
+    multi-process jobs, off single-process. The world comes from the
+    caller when known (``init_parallel_env`` passes the authoritative
+    count, covering jax-native launches where PADDLE_TRAINERS_NUM is
+    never set), else from the env contract."""
+    ring_env = os.environ.get(ENV_RING)
+    ring = None
+    if ring_env is not None and ring_env != "":
+        # defensive parse: recorder() is called lazily from inside the
+        # first collective, so a malformed env var must degrade to the
+        # default policy with a clear warning — not kill the job with a
+        # traceback pointing into an all_reduce
+        try:
+            ring = int(ring_env)
+            if ring < 0:
+                raise ValueError(ring_env)
+        except ValueError:
+            import logging
+            logging.warning(
+                "paddle_tpu flight recorder: ignoring malformed %s=%r "
+                "(expected a non-negative integer ring size); using the "
+                "default policy", ENV_RING, ring_env)
+            ring = None
+    if ring is None:
+        world = world_hint
+        if world is None:
+            try:
+                world = int(os.environ.get(
+                    "PADDLE_TRAINERS_NUM",
+                    os.environ.get("JAX_NUM_PROCESSES", "1")) or 1)
+            except ValueError:
+                world = 1
+        ring = DEFAULT_RING if world > 1 else 0
+    rec = FlightRecorder(ring=ring) if ring > 0 else None
+    _REC[0] = rec
+    return rec
+
+
+def recorder() -> FlightRecorder | None:
+    """The process-global recorder; None when disabled (the hot path's
+    single branch)."""
+    rec = _REC[0]
+    if rec is _UNSET:
+        rec = _init_from_env()
+    return rec
+
+
+def configure(ring=None, rank=None, world=None, clock=None):
+    """(Re)build the process-global recorder with authoritative values
+    (``init_parallel_env`` calls this once rank/world are known; tests
+    call it directly). Returns the recorder, or None when disabled."""
+    if ring is None:
+        _REC[0] = _UNSET
+        rec = _init_from_env(world_hint=world)
+        if rec is not None and (rank is not None or world is not None):
+            rec.rank = int(rank if rank is not None else rec.rank)
+            rec.world = int(world if world is not None else rec.world)
+        return rec
+    rec = FlightRecorder(ring=ring, rank=rank, world=world, clock=clock) \
+        if ring > 0 else None
+    _REC[0] = rec
+    return rec
+
+
+def reset():
+    """Drop the cached recorder (tests): the next ``recorder()`` call
+    re-reads the env."""
+    _REC[0] = _UNSET
+
+
+# --------------------------------------------------------------- choke point
+_tls = threading.local()
+
+
+def _is_tracer(x) -> bool:
+    # duck-typed (no jax import): every jax Tracer carries _trace;
+    # eager ArrayImpl / numpy arrays do not
+    return hasattr(x, "_trace")
+
+
+def _payload_of(args, kwargs):
+    """Best-effort payload introspection: the first Tensor-like
+    (``._data``) or array-like (``.shape``/``.dtype``) positional, or a
+    list of them (bytes summed). Returns ``_SKIP`` for tracer-backed
+    payloads — traced collectives are compiled, not eager events."""
+    def _arr(x):
+        data = getattr(x, "_data", x)
+        if _is_tracer(data):
+            return _SKIP
+        if hasattr(data, "shape") and hasattr(data, "dtype"):
+            return data
+        return None
+
+    # kwargs too: `all_reduce(tensor=x)` must hit the same tracer
+    # guard as the positional form, or traced calls record per-compile
+    # instead of per-execution and desynchronize the seq numbers
+    for a in tuple(args[:4]) + tuple(kwargs.values())[:4]:
+        if isinstance(a, (list, tuple)) and a:
+            first = _arr(a[0])
+            if first is _SKIP:
+                return _SKIP
+            if first is not None:
+                per = _nbytes(first)
+                return {"shape": first.shape, "dtype": first.dtype,
+                        "nbytes": per * len(a) if per is not None
+                        else None}
+        else:
+            arr = _arr(a)
+            if arr is _SKIP:
+                return _SKIP
+            if arr is not None:
+                return {"shape": arr.shape, "dtype": arr.dtype,
+                        "nbytes": _nbytes(arr)}
+    return {}
+
+
+def _nbytes(arr):
+    try:
+        return int(arr.size) * int(arr.dtype.itemsize)
+    except Exception:
+        return None
+
+
+def _group_of(args, kwargs):
+    """Group NAME for the event — the cross-rank alignment key, so it
+    must be derived from call-site data every rank shares (group names
+    are assigned in program order, identical across SPMD ranks)."""
+    g = kwargs.get("group")
+    cands = (g,) + tuple(args[:4]) if g is not None else tuple(args[:4])
+    for c in cands:
+        if c is None:
+            continue
+        if hasattr(c, "pg") and hasattr(c, "name"):        # Group
+            return c.name
+        if hasattr(c, "group_id") and hasattr(c, "ranks"):  # ProcessGroupXLA
+            return f"pg{c.group_id}"
+    return "default"
+
+
+@contextmanager
+def record_span(op, kind="collective", group="default", payload=None,
+                note=None):
+    """THE instrumentation choke point (context-manager form): every
+    public collective/rpc entry in the runtime routes through here (or
+    through the ``instrumented`` decorator built on it). Nested spans
+    record only the outermost op; disabled mode is one branch."""
+    rec = recorder()
+    if rec is None:
+        yield None
+        return
+    if getattr(_tls, "depth", 0):
+        yield None                      # nested: outer op owns the event
+        return
+    if kind == "collective":
+        f = _fault()
+        if f is not None:
+            # the desync-e2e hook: PADDLE_FI_AT_POINT=collective hangs
+            # a rank HERE, before the entry is recorded — "never
+            # entered seq N" is exactly what the diagnosis must name
+            f.inject("collective")
+    ev = rec.start(op, group=group, kind=kind, note=note,
+                   **(payload or {}))
+    _tls.depth = 1
+    try:
+        yield ev
+    except BaseException as e:
+        rec.end(ev, error=e)
+        raise
+    else:
+        rec.end(ev)
+    finally:
+        _tls.depth = 0
+
+
+_known_ops: set = set()
+
+
+def instrumented(op, kind="collective"):
+    """Decorator form of the choke point for module-level collectives
+    (``communication/ops.py`` etc.): payload and group are introspected
+    from the call args; tracer-backed calls skip recording entirely.
+    ``tools/check_collective_surface.py`` asserts every public
+    collective carries this decorator."""
+    _known_ops.add(op)
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            rec = recorder()
+            if rec is None or getattr(_tls, "depth", 0):
+                return fn(*args, **kwargs)
+            payload = _payload_of(args, kwargs)
+            if payload is _SKIP:
+                return fn(*args, **kwargs)
+            with record_span(op, kind=kind,
+                             group=_group_of(args, kwargs),
+                             payload=payload):
+                return fn(*args, **kwargs)
+        wrapper.__flight_recorder_op__ = op
+        return wrapper
+    return deco
+
+
+def instrumented_ops():
+    """Every op kind registered through ``instrumented`` in this
+    process (the structural checks iterate it)."""
+    return sorted(_known_ops)
+
+
+# ----------------------------------------------------------------- triggers
+def dump_on_failure(reason):
+    """Best-effort module-level dump (the watchdog failure path calls
+    this — it must never be able to break failure handling)."""
+    rec = recorder()
+    if rec is None:
+        return None
+    try:
+        return rec.dump(reason=reason)
+    except Exception:
+        return None
+
+
+def install_signal_dump():
+    """SIGTERM handler: dump, then chain to the previous handler (or
+    exit 128+15 when the default would have terminated us). Installed
+    by ``init_parallel_env`` in multi-process jobs — the gang
+    supervisor SIGTERMs survivors when reaping a failed gang, and each
+    must leave its flight dump behind. Main-thread only (signal API
+    contract)."""
+    rec = recorder()
+    if rec is None:
+        return False
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        try:
+            rec.dump(reason="sigterm")
+        except Exception:
+            pass
+        if prev is signal.SIG_IGN:
+            return                  # the host app chose to ignore SIGTERM
+        if callable(prev):
+            prev(signum, frame)
+        else:                       # SIG_DFL (or non-Python handler):
+            os._exit(128 + signum)  # preserve die-on-SIGTERM semantics
+
+    signal.signal(signal.SIGTERM, _handler)
+    return True
+
+
+# ---------------------------------------------------------------- dump files
+def dump_path(rank, generation, dump_dir=None) -> str:
+    d = dump_dir or os.environ.get(ENV_DUMP_DIR) or "."
+    return os.path.join(d, f"flightdump.{rank}.{generation}.json")
+
+
+def load_dumps(dump_dir, generation=None):
+    """Parse every ``flightdump.<rank>.<generation>.json`` in the dir.
+    Returns ``(generation, {rank: dump}, {rank: error-string})`` —
+    unparsable files land in the error map so the diagnosis can NAME
+    ranks that crashed mid-dump instead of silently omitting them.
+    ``generation=None`` picks the newest generation present."""
+    found = {}                          # generation -> {rank: path}
+    try:
+        names = os.listdir(dump_dir)
+    except OSError:
+        names = []
+    for name in names:
+        parts = name.split(".")
+        if len(parts) != 4 or parts[0] != "flightdump" or parts[3] != "json":
+            continue
+        try:
+            rank, gen = int(parts[1]), int(parts[2])
+        except ValueError:
+            continue
+        found.setdefault(gen, {})[rank] = os.path.join(dump_dir, name)
+    if not found:
+        return generation or 0, {}, {}
+    gen = max(found) if generation is None else int(generation)
+    dumps, errors = {}, {}
+    for rank, path in sorted(found.get(gen, {}).items()):
+        try:
+            with open(path) as f:
+                dumps[rank] = json.load(f)
+        except (OSError, ValueError) as e:
+            errors[rank] = f"unparsable: {e}"
+    return gen, dumps, errors
+
+
+# ----------------------------------------------------------------- diagnosis
+def _rank_group_state(dump, group):
+    """(last_entered_gseq, in_flight event or None, last op) for one
+    rank in one group, from its dump's event list."""
+    last, in_flight, last_op = 0, None, None
+    for ev in dump.get("events", ()):
+        if ev.get("kind") != "collective" or ev.get("group") != group:
+            continue
+        if ev["gseq"] >= last:
+            last = ev["gseq"]
+            last_op = ev["op"]
+        if ev["status"] == "in_flight":
+            if in_flight is None or ev["gseq"] > in_flight["gseq"]:
+                in_flight = ev
+    return last, in_flight, last_op
+
+
+def diagnose(dumps, errors=None, world=None, generation=0,
+             expected_ranks=None):
+    """Aggregate per-rank dumps into the cross-rank verdict.
+
+    Returns ``(text, struct)``. The text is DETERMINISTIC given the
+    dump contents (elapsed times come from each dump's own clock pair,
+    never from report time), so the supervisor's report and
+    ``tools/flight_report.py`` are byte-for-byte identical.
+
+    ``expected_ranks`` bounds which ranks may be declared
+    missing-dump stragglers: a multi-node supervisor only sees its own
+    node's dump dir, so it must pass the ranks it spawned — remote
+    ranks dumping to other hosts are not "crashed before dumping".
+    Default: every rank in ``world``.
+    """
+    errors = dict(errors or {})
+    if world is None:
+        world = max([d.get("world", 0) for d in dumps.values()]
+                    + [max(dumps, default=-1) + 1,
+                       max(errors, default=-1) + 1])
+    if expected_ranks is None:
+        expected_ranks = range(world)
+    ranks_with = sorted(dumps)
+    missing = [r for r in expected_ranks
+               if r not in dumps and r not in errors]
+    lines = [f"flight recorder: cross-rank diagnosis "
+             f"(generation {generation}, world {world})",
+             f"  dumps: ranks {ranks_with}"]
+    if missing or errors:
+        parts = [f"rank {r} (no dump file — crashed before dumping, or "
+                 "recorder disabled)" for r in missing]
+        parts += [f"rank {r} ({errors[r]})" for r in sorted(errors)]
+        lines.append("  missing dumps: " + ", ".join(parts))
+
+    groups = sorted({ev.get("group") for d in dumps.values()
+                     for ev in d.get("events", ())
+                     if ev.get("kind") == "collective"})
+    stragglers: set = set()
+    stuck = None
+    desync = False
+    group_struct = {}
+    for grp in groups:
+        states = {r: _rank_group_state(d, grp) for r, d in dumps.items()}
+        frontier = max((s[0] for s in states.values()), default=0)
+        in_flight_any = any(s[1] is not None for s in states.values())
+        aligned = (not in_flight_any
+                   and len({s[0] for s in states.values()}) <= 1)
+        grp_stragglers = set(
+            r for r, (last, fl, _) in states.items()
+            if last < frontier or (fl is not None
+                                   and fl["gseq"] < frontier))
+        # async-completion case: a rank still INSIDE a collective that
+        # some peer has completed and LEFT (nothing of its own in
+        # flight) is a straggler too — the peers finished seq N and
+        # moved on or exited; this rank never did. Kept distinct from
+        # "every rank in_flight at the same seq", which has no single
+        # culprit.
+        if any(last >= frontier and fl is None
+               for last, fl, _ in states.values()):
+            grp_stragglers |= {r for r, (last, fl, _) in states.items()
+                               if fl is not None
+                               and fl["gseq"] >= frontier}
+        grp_stragglers = sorted(grp_stragglers)
+        per_rank = {}
+        if aligned:
+            lines.append(f"  group '{grp}': aligned at seq {frontier}")
+            group_struct[grp] = {"aligned": True, "seq": frontier}
+            continue
+        desync = True
+        # the stuck collective: the earliest op still in flight, else
+        # the frontier op the stragglers never entered
+        flights = sorted(((s[1]["gseq"], r, s[1])
+                          for r, s in states.items() if s[1] is not None))
+        if flights:
+            stuck_seq, _, stuck_ev = flights[0]
+            stuck_op = stuck_ev["op"]
+        else:
+            stuck_seq = frontier
+            stuck_op = next((s[2] for s in states.values()
+                             if s[0] == frontier and s[2]), "?")
+        lines.append(f"  group '{grp}': desync in {stuck_op} "
+                     f"at seq {stuck_seq}")
+        for r in sorted(states):
+            last, fl, last_op = states[r]
+            dump_t = dumps[r].get("t_mono", 0.0)
+            if fl is not None:
+                waited = max(dump_t - fl["t_start"], 0.0)
+                extra = " (waiting on stragglers)" \
+                    if (fl["gseq"] >= frontier and grp_stragglers
+                        and r not in grp_stragglers) else ""
+                lines.append(f"    rank {r}: in_flight in {fl['op']} "
+                             f"seq={fl['gseq']} for {waited:.2f}s{extra}")
+                per_rank[r] = {"status": "in_flight", "op": fl["op"],
+                               "seq": fl["gseq"],
+                               "waited_s": round(waited, 2)}
+            elif last < frontier:
+                lines.append(f"    rank {r}: completed seq={last}, "
+                             f"never entered {stuck_op} seq={stuck_seq}")
+                per_rank[r] = {"status": "never_entered", "seq": last}
+            else:
+                lines.append(f"    rank {r}: completed seq={last} "
+                             f"({last_op}) and left the collective")
+                per_rank[r] = {"status": "done", "seq": last}
+        # collective-order mismatch (rank A in send while B in
+        # all_reduce): a desynced program order, worth its own line
+        ops_in_flight = {s[1]["op"] for s in states.values()
+                         if s[1] is not None and s[1]["gseq"] == stuck_seq}
+        if len(ops_in_flight) > 1:
+            lines.append("    op mismatch at seq="
+                         f"{stuck_seq}: {sorted(ops_in_flight)} — ranks "
+                         "issued different collectives (desynced "
+                         "program order)")
+        stragglers.update(grp_stragglers)
+        if stuck is None:
+            stuck = {"group": grp, "op": stuck_op, "seq": stuck_seq}
+        group_struct[grp] = {"aligned": False, "op": stuck_op,
+                             "seq": stuck_seq,
+                             "stragglers": grp_stragglers,
+                             "per_rank": per_rank}
+
+    # ranks wedged with an rpc (or other non-collective span) open
+    for r in sorted(dumps):
+        for ev in dumps[r].get("events", ()):
+            if ev.get("kind") != "collective" \
+                    and ev.get("status") == "in_flight":
+                waited = max(dumps[r].get("t_mono", 0.0) - ev["t_start"],
+                             0.0)
+                note = f" ({ev['note']})" if ev.get("note") else ""
+                lines.append(f"  rank {r}: {ev['kind']} in_flight in "
+                             f"{ev['op']}{note} group={ev['group']} "
+                             f"for {waited:.2f}s")
+
+    if not groups and dumps:
+        lines.append("  no collective events recorded")
+    elif not desync and dumps:
+        lines.append("  no cross-rank desync detected (all groups "
+                     "aligned)")
+    # missing-dump ranks are prime straggler suspects too: a rank that
+    # died or wedged before dumping never entered the stuck collective
+    all_missing = sorted(set(missing) | set(errors))
+    if desync:
+        stragglers.update(all_missing)
+    if desync and not stragglers:
+        lines.append("  stragglers: none identified — every rank is "
+                     "in_flight at the same seq (the collective itself "
+                     "is wedged: transport, or a peer outside these "
+                     "dumps)")
+    elif stragglers:
+        lines.append("  stragglers: " + ", ".join(
+            f"rank {r}" for r in sorted(stragglers)))
+
+    # watchdog verdicts from the dump headers (who flagged whom)
+    flags = []
+    for r in sorted(dumps):
+        wd = dumps[r].get("watchdog") or {}
+        if wd.get("failure_ranks"):
+            flags.append(f"rank {r} -> {wd['failure_ranks']}")
+    if flags:
+        lines.append("  watchdog flags: " + "; ".join(flags))
+
+    # the straggler's in-collective stack, straight from its dump
+    for r in sorted(stragglers):
+        stack = _main_stack(dumps.get(r))
+        if not stack:
+            continue
+        lines.append(f"  straggler rank {r} main-thread stack "
+                     "(most recent call last):")
+        for fs in stack[-STACK_TAIL_FRAMES:]:
+            base = os.path.basename(fs.get("file", "?"))
+            lines.append(f"    {base}:{fs.get('line')} "
+                         f"{fs.get('func')}: {fs.get('code', '')}")
+
+    struct = {"generation": generation, "world": world,
+              "desync": desync, "ranks_with_dump": ranks_with,
+              "ranks_missing_dump": all_missing,
+              "missing_dump_errors": {str(r): errors[r]
+                                      for r in sorted(errors)},
+              "stragglers": sorted(stragglers), "stuck": stuck,
+              "groups": group_struct}
+    return "\n".join(lines), struct
+
+
+def _main_stack(dump):
+    if not dump:
+        return None
+    for key, frames in (dump.get("stacks") or {}).items():
+        if key.endswith("[main]"):
+            return frames
+    return None
+
+
+def diagnose_dir(dump_dir, world=None, generation=None,
+                 expected_ranks=None):
+    """Diagnose straight from a dump directory — the ONE code path the
+    gang supervisor's failure report and ``tools/flight_report.py``
+    both call (byte-for-byte identical output is the contract)."""
+    gen, dumps, errors = load_dumps(dump_dir, generation=generation)
+    return diagnose(dumps, errors=errors, world=world, generation=gen,
+                    expected_ranks=expected_ranks)
+
+
+# --------------------------------------------------------- cluster snapshot
+def publish_snapshot(store, rec=None):
+    """Publish this rank's recorder snapshot to ``fr/<rank>`` (the
+    watchdog's heartbeat publisher piggybacks this every beat)."""
+    rec = rec if rec is not None else recorder()
+    if rec is None or not rec.enabled:
+        return False
+    store.set(f"{SNAPSHOT_KEY_PREFIX}{rec.rank}",
+              json.dumps(rec.snapshot()).encode())
+    return True
+
+
+def maybe_publish(store):
+    """Best-effort ``publish_snapshot`` (heartbeat-loop safe: never
+    raises, never publishes when disabled)."""
+    try:
+        return publish_snapshot(store)
+    except Exception:
+        return False
+
+
+def cluster_snapshot(store_factory=None, world=None):
+    """Rank-0 (or any rank's) cluster-wide view: every rank's published
+    recorder snapshot, aggregated like heartbeats. Defaults ride the
+    running watchdog's store; ranks that never published map to None."""
+    if store_factory is None or world is None:
+        from .watchdog import current_watchdog
+        wd = current_watchdog()
+        if wd is None:
+            raise RuntimeError(
+                "cluster_snapshot needs a store_factory + world when no "
+                "watchdog is running")
+        store_factory = store_factory or wd._store_factory
+        world = world if world is not None else wd.world
+    store = store_factory(5.0)
+    try:
+        out = {}
+        for r in range(int(world)):
+            raw = store.get(f"{SNAPSHOT_KEY_PREFIX}{r}")
+            out[r] = json.loads(raw.decode()) if raw else None
+        return out
+    finally:
+        try:
+            store.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------ perfetto
+def export_chrome_tracing(dump_dir_or_dumps, path, generation=None):
+    """Render flight dumps as a pid-per-rank Chrome/Perfetto trace over
+    ``profiler.ChromeTrace`` (PR 8's shared event model): pid = rank,
+    one 'collectives' track and one 'rpc' track per rank, in-flight
+    events drawn to each rank's dump time with status args. Per-event
+    monotonic timestamps are rebased to wall time through each dump's
+    own (t_wall, t_mono) anchor pair, so ranks line up cross-process."""
+    if isinstance(dump_dir_or_dumps, dict):
+        dumps = dump_dir_or_dumps
+    else:
+        _, dumps, _ = load_dumps(dump_dir_or_dumps, generation=generation)
+    if not dumps:
+        raise ValueError("export_chrome_tracing: no flight dumps found")
+    from ...profiler import ChromeTrace        # lazy: pulls jax
+    tr = ChromeTrace()
+    anchors = {}
+    for r, d in sorted(dumps.items()):
+        anchors[r] = d.get("t_wall", 0.0) - d.get("t_mono", 0.0)
+        tr.process(r, f"rank {r} flight recorder")
+        tr.thread(r, 0, "collectives")
+        tr.thread(r, 1, "rpc")
+    walls = [a + ev["t_start"]
+             for r, d in dumps.items() for ev in d.get("events", ())
+             for a in (anchors[r],)]
+    base = min(walls) if walls else 0.0
+    for r, d in sorted(dumps.items()):
+        a = anchors[r]
+        for ev in d.get("events", ()):
+            t0 = a + ev["t_start"] - base
+            t1 = a + (ev["t_end"] if ev["t_end"] is not None
+                      else d.get("t_mono", ev["t_start"])) - base
+            args = {k: ev[k] for k in ("seq", "gseq", "group", "status",
+                                       "shape", "dtype", "nbytes",
+                                       "note", "error") if k in ev}
+            tid = 0 if ev.get("kind") == "collective" else 1
+            tr.complete(f"{ev['op']} seq={ev['gseq']}", r, tid,
+                        t0 * 1e6, max(t1 - t0, 0.0) * 1e6, args=args)
+        tr.instant(f"dump [{d.get('reason', '?')}]", r, 0,
+                   (a + d.get("t_mono", 0.0) - base) * 1e6)
+    tr.write(path)
+    return path

@@ -42,6 +42,7 @@ import threading
 import time
 
 from ..logjson import log_event
+from . import flight_recorder
 
 __all__ = ["PeerFailureError", "Watchdog", "start_watchdog",
            "stop_watchdog", "check_peer_failure", "monitored_barrier",
@@ -191,6 +192,10 @@ class Watchdog:
                         self._pub_store = self._store_factory(
                             self._connect_timeout)
                     self._pub_store.add(f"hb/{self.rank}", 1)
+                    # piggyback the flight-recorder snapshot (fr/<rank>)
+                    # on the same cadence: cluster_snapshot() aggregates
+                    # these exactly like heartbeats
+                    flight_recorder.maybe_publish(self._pub_store)
             except Exception:
                 # publisher never escalates: liveness judgements belong to
                 # the PEERS' watchers; a broken local store just means our
@@ -282,6 +287,11 @@ class Watchdog:
     def _fail(self, err: PeerFailureError):
         self.failure = err
         self.peer_failures += 1
+        # flight dump FIRST (best-effort, never blocks failure handling):
+        # the recorder tail + all-thread stacks at the moment of
+        # detection are what the supervisor's cross-rank diagnosis needs,
+        # and the hard-exit path below never returns
+        flight_recorder.dump_on_failure("peer_failure")
         logging.error("paddle_tpu watchdog: %s", err)
         log_event("watchdog", "peer_failure",
                   message=f"paddle_tpu watchdog: {err}",
@@ -349,6 +359,11 @@ class Watchdog:
         instead of wedging."""
         timeout_s = float(timeout_s if timeout_s is not None
                           else self.timeout_s)
+        with flight_recorder.record_span("monitored_barrier",
+                                         group="world", note=tag):
+            self._monitored_barrier_inner(timeout_s, tag)
+
+    def _monitored_barrier_inner(self, timeout_s, tag):
         store = self._store_factory(min(timeout_s, 5.0))
         try:
             if tag is not None:

@@ -219,12 +219,19 @@ class _RpcAgent:
         exponential backoff inside the call deadline (a peer mid-restart
         refuses for a moment — that's recoverable); once connected, every
         socket op inherits the remaining deadline, so a half-open peer
-        turns into TimeoutError instead of an unbounded wait."""
+        turns into TimeoutError instead of an unbounded wait. The whole
+        round-trip rides the flight-recorder choke point (kind "rpc"),
+        so a dump taken while a call is outstanding shows which peer it
+        was waiting on."""
+        from .resilience import flight_recorder
         info = self.workers[to]
         t_call = time.monotonic()
         try:
-            ok, value = self._call_inner(info, to, fn, args, kwargs,
-                                         timeout)
+            with flight_recorder.record_span(
+                    "rpc", kind="rpc", group=f"rpc:{to}",
+                    note=getattr(fn, "__name__", str(fn))):
+                ok, value = self._call_inner(info, to, fn, args, kwargs,
+                                             timeout)
         except Exception:
             # transport failure: counted, NOT recorded in the latency
             # histogram (a timed-out call's "latency" is the deadline)

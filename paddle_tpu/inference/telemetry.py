@@ -59,7 +59,8 @@ import numpy as np
 __all__ = ["LogHistogram", "Telemetry", "RequestTrace",
            "export_chrome_tracing", "render_prometheus",
            "parse_prometheus", "snapshot", "runtime_histogram",
-           "runtime_counter", "runtime_prometheus", "PROMETHEUS_NAMES",
+           "runtime_counter", "runtime_prometheus",
+           "runtime_registry_snapshot", "PROMETHEUS_NAMES",
            "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING"]
 
 DEFAULT_RING = 2048
@@ -343,6 +344,17 @@ def runtime_histogram(name, lo=1e-6, hi=1e3):
 def runtime_counter(name, inc=0):
     _runtime_counters[name] = _runtime_counters.get(name, 0) + inc
     return _runtime_counters[name]
+
+
+def runtime_registry_snapshot():
+    """JSON-able snapshot of the process-global runtime registry
+    (counter values + histogram percentile summaries) — embedded in
+    flight-recorder dumps and cluster snapshots so a post-mortem sees
+    the rank's rpc/collective latency state without scraping
+    Prometheus."""
+    return {"counters": dict(sorted(_runtime_counters.items())),
+            "histograms": {name: _runtime_hists[name].snapshot()
+                           for name in sorted(_runtime_hists)}}
 
 
 def runtime_prometheus():

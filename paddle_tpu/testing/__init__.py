@@ -12,10 +12,20 @@ import os
 # Every env var the fault-injection harness reads. Keep sorted; the
 # conftest guard fails any non-FT test that runs with one of these set.
 FI_ENV_VARS = (
+    "PADDLE_FI_AT_POINT",       # named hook point targeting KILL/HANG
     "PADDLE_FI_AT_STEP",        # step index gating KILL/HANG ("step" point)
     "PADDLE_FI_DROP_HEARTBEAT",  # rank whose heartbeat publisher goes dark
     "PADDLE_FI_HANG",           # rank that hangs (bounded sleep) at the point
     "PADDLE_FI_KILL_RANK",      # rank that hard-exits (os._exit) at the point
+)
+
+# Flight-recorder configuration (distributed/resilience/flight_recorder.py)
+# — same registry discipline as the FI knobs: a test leaking recorder
+# config silently changes what every later collective records (and where
+# dumps land), so the conftest guard fails non-flight tests loudly.
+FR_ENV_VARS = (
+    "PADDLE_FLIGHT_DUMP_DIR",   # where flightdump.<rank>.<gen>.json land
+    "PADDLE_FLIGHT_RECORDER",   # ring size; 0 = disabled; unset = auto
 )
 
 
@@ -24,6 +34,12 @@ def fi_env_active() -> list:
     return [v for v in FI_ENV_VARS if os.environ.get(v) not in (None, "")]
 
 
+def fr_env_active() -> list:
+    """The flight-recorder env vars currently set (empty = default)."""
+    return [v for v in FR_ENV_VARS if os.environ.get(v) not in (None, "")]
+
+
 from . import fault  # noqa: E402  (re-export the harness)
 
-__all__ = ["FI_ENV_VARS", "fi_env_active", "fault"]
+__all__ = ["FI_ENV_VARS", "FR_ENV_VARS", "fi_env_active",
+           "fr_env_active", "fault"]

@@ -11,6 +11,19 @@ Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
                                 problem) instead of exiting
   PADDLE_FI_AT_STEP=<n>         gate KILL/HANG to train-step n ("step"
                                 hook); unset -> they fire at "init"
+  PADDLE_FI_AT_POINT=<name>     target a NAMED hook point instead
+                                ("init" | "step" | "collective" — the
+                                flight-recorder choke point); KILL/HANG
+                                fire at the AT_STEP-th occurrence of
+                                that point (unset AT_STEP = the first).
+                                "collective" requires the flight
+                                recorder to be enabled (the hook rides
+                                its choke point) — the desync e2e's
+                                lever: wedge one rank at its Nth
+                                collective entry, BEFORE the entry is
+                                recorded, so peers' dumps show the
+                                collective in flight and the wedged
+                                rank's shows it never entered
   PADDLE_FI_DROP_HEARTBEAT=<r>  rank r's heartbeat publisher goes dark
                                 (the process stays alive: the watchdog on
                                 the PEERS must convert this into a
@@ -34,6 +47,7 @@ FI_EXIT_CODE = 43          # distinctive: never collides with signal codes
 HANG_BOUND_S = 3600.0      # a "hang" is a bounded sleep, not a true wedge
 
 _steps = 0                 # "step"-point calls observed in this process
+_point_counts: dict = {}   # point -> calls observed (AT_POINT mode)
 _fired = False
 
 
@@ -41,6 +55,7 @@ def reset():
     """Re-arm the harness (in-process tests; subprocesses never need it)."""
     global _steps, _fired
     _steps, _fired = 0, False
+    _point_counts.clear()
 
 
 def step_count() -> int:
@@ -61,6 +76,30 @@ def heartbeat_dropped(rank=None) -> bool:
     return os.environ.get("PADDLE_FI_DROP_HEARTBEAT") == r
 
 
+def _should_fire(point: str) -> bool:
+    """Gating + counter bookkeeping for one inject() call.
+
+    PADDLE_FI_AT_POINT set: KILL/HANG target that named point, at its
+    AT_STEP-th occurrence (unset AT_STEP = the first occurrence).
+    Unset: legacy semantics — "step" fires at step AT_STEP, any other
+    point fires iff AT_STEP is unset.
+    """
+    global _steps
+    at_point = os.environ.get("PADDLE_FI_AT_POINT")
+    at = os.environ.get("PADDLE_FI_AT_STEP")
+    if at_point not in (None, ""):
+        idx = _point_counts.get(point, 0)
+        _point_counts[point] = idx + 1
+        if point == "step":
+            _steps += 1        # step_count() keeps counting in this mode
+        return point == at_point and (at is None or idx == int(at))
+    if point == "step":
+        hit = at is not None and _steps == int(at)
+        _steps += 1
+        return hit
+    return at is None
+
+
 def inject(point: str, rank=None):
     """Run the injections registered for `point` ("init" | "step").
 
@@ -71,12 +110,7 @@ def inject(point: str, rank=None):
     global _steps, _fired
     if not _armed():
         return
-    if point == "step":
-        at = os.environ.get("PADDLE_FI_AT_STEP")
-        hit = at is not None and _steps == int(at)
-        _steps += 1
-    else:
-        hit = os.environ.get("PADDLE_FI_AT_STEP") is None
+    hit = _should_fire(point)
     if not hit or _fired:
         return
     r = str(rank) if rank is not None else _rank()

@@ -32,13 +32,27 @@ def _no_fault_injection_leak(request):
     from the test that set it. FT tests pass the PADDLE_FI_* vars to
     their SUBPROCESS env only; the pytest process itself must stay clean
     everywhere except tests/test_fault_tolerance.py."""
-    from paddle_tpu.testing import fi_env_active
+    from paddle_tpu.testing import fi_env_active, fr_env_active
+    fspath = str(request.node.fspath)
+    exempt = ("test_fault_tolerance" in fspath
+              or "test_flight_recorder" in fspath)
     leaked = fi_env_active()
-    if leaked and "test_fault_tolerance" not in str(request.node.fspath):
+    if leaked and not exempt:
         pytest.fail(
             f"fault-injection env leaked into a non-FT test: {leaked} "
             "(unset PADDLE_FI_*, or pass it to the companion subprocess "
             "env instead of the pytest process)", pytrace=False)
+    # flight-recorder config leaks are the same bug class: an armed
+    # recorder silently changes what every later collective records and
+    # where dumps land — only the flight/FT suites may set these (and
+    # they do it via monkeypatch or subprocess envs)
+    leaked_fr = fr_env_active()
+    if leaked_fr and not exempt:
+        pytest.fail(
+            f"flight-recorder env leaked into an unrelated test: "
+            f"{leaked_fr} (unset PADDLE_FLIGHT_*, or pass it to the "
+            "companion subprocess env instead of the pytest process)",
+            pytrace=False)
     yield
 
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Collective-instrumentation coverage check (runnable standalone AND
+as a tier-1 test via tests/test_flight_recorder.py).
+
+The flight recorder only earns its cross-rank diagnosis if EVERY public
+collective routes through its one choke point
+(``flight_recorder.instrumented`` / ``record_span``) — a collective that
+bypasses it desynchronizes the per-group seq numbers the diagnosis
+aligns on, silently. Same discipline as tools/check_metrics_surface.py:
+make the bug class structural instead of trusting review.
+
+Checks (AST over the source, no heavy imports):
+
+  1. every module-level function in ``communication/ops.py``'s and
+     ``communication/all_reduce.py``'s ``__all__`` is decorated with
+     ``@_instrumented(...)`` (non-collective entries are allowlisted
+     with a reason);
+  2. every ProcessGroupXLA collective method in
+     ``communication/group.py`` is decorated;
+  3. ``parallel.py::all_reduce_gradients`` is decorated;
+  4. ``rpc.py``'s call path and ``watchdog.monitored_barrier`` route
+     through ``record_span``.
+
+Usage: python tools/check_collective_surface.py   (exit 0 = covered)
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMM = os.path.join(REPO_ROOT, "paddle_tpu", "distributed",
+                    "communication")
+
+# __all__ entries that are NOT collective entry points (each with the
+# reason it is exempt — anything new added to __all__ without either a
+# decorator or a line here fails tier-1)
+OPS_ALLOWLIST = {
+    "P2POp": "descriptor class; executed by batch_isend_irecv",
+    "get_backend": "pure metadata query, no communication",
+    "stream": "namespace re-exporting already-instrumented functions",
+}
+
+PG_METHODS = ("allreduce", "allgather", "reducescatter", "broadcast",
+              "alltoall", "permute", "barrier")
+
+
+def _decorator_names(node):
+    names = []
+    for dec in node.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(d, ast.Attribute):
+            names.append(d.attr)
+        elif isinstance(d, ast.Name):
+            names.append(d.id)
+    return names
+
+
+def _module_all(tree):
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    return []
+
+
+def _check_ops_module(path, failures):
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    exported = set(_module_all(tree))
+    fns = {n.name: n for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for name in sorted(exported):
+        if name in OPS_ALLOWLIST:
+            continue
+        node = fns.get(name)
+        if node is None:
+            # exported but not a module-level function (class/namespace):
+            # must be allowlisted explicitly
+            failures.append(
+                f"{os.path.basename(path)}: __all__ entry {name!r} is "
+                "not a module-level function and not in OPS_ALLOWLIST — "
+                "add it with a reason, or instrument it")
+            continue
+        if "_instrumented" not in _decorator_names(node) and \
+                "instrumented" not in _decorator_names(node):
+            failures.append(
+                f"{os.path.basename(path)}: public collective {name!r} "
+                "bypasses the flight-recorder choke point — decorate it "
+                "with @_instrumented(...) (or allowlist it with a "
+                "reason in tools/check_collective_surface.py)")
+
+
+def _check_pg_methods(failures):
+    path = os.path.join(COMM, "group.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ProcessGroupXLA":
+            meths = {n.name: n for n in node.body
+                     if isinstance(n, ast.FunctionDef)}
+            for m in PG_METHODS:
+                if m not in meths:
+                    failures.append(f"group.py: ProcessGroupXLA.{m} "
+                                    "disappeared")
+                elif "_instrumented" not in _decorator_names(meths[m]):
+                    failures.append(
+                        f"group.py: ProcessGroupXLA.{m} bypasses the "
+                        "flight-recorder choke point — decorate it")
+            return
+    failures.append("group.py: ProcessGroupXLA class not found")
+
+
+def _check_source_mentions(failures):
+    """The non-ops call sites named by the ISSUE: grad sync, the rpc
+    transport, the monitored barrier."""
+    spots = [
+        (os.path.join(REPO_ROOT, "paddle_tpu", "distributed",
+                      "parallel.py"),
+         "def all_reduce_gradients", ("_fr_instrumented",
+                                      "instrumented")),
+        (os.path.join(REPO_ROOT, "paddle_tpu", "distributed", "rpc.py"),
+         "def call", ("record_span",)),
+        (os.path.join(REPO_ROOT, "paddle_tpu", "distributed",
+                      "resilience", "watchdog.py"),
+         "def monitored_barrier", ("record_span",)),
+    ]
+    for path, anchor, needles in spots:
+        with open(path) as f:
+            src = f.read()
+        if anchor not in src:
+            failures.append(f"{os.path.basename(path)}: {anchor!r} not "
+                            "found (refactor moved it? update the check)")
+            continue
+        if not any(n in src for n in needles):
+            failures.append(
+                f"{os.path.basename(path)}: {anchor.split()[-1]} no "
+                f"longer routes through the flight-recorder choke point "
+                f"(expected one of {needles})")
+
+
+def main(argv=None):
+    failures: list = []
+    _check_ops_module(os.path.join(COMM, "ops.py"), failures)
+    _check_ops_module(os.path.join(COMM, "all_reduce.py"), failures)
+    _check_pg_methods(failures)
+    _check_source_mentions(failures)
+    if failures:
+        print("check_collective_surface: FAILED")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print("check_collective_surface: ok (every public collective routes "
+          "through the flight-recorder choke point)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,13 @@ def main(argv=None):
                 f"metrics key {k!r} maps to {name!r} ({typ}) but the "
                 "exposition does not contain it")
 
+    # ---- 4. distributed-runtime registry coverage: every op kind the
+    # flight recorder instruments must surface its wait-time histogram
+    # under a stable name in runtime_prometheus() (and in the registry
+    # snapshot flight dumps embed) once an event completes — a renamed
+    # histogram would silently vanish from the rank-level exposition
+    n_ops = _check_runtime_registry(failures)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -132,8 +139,58 @@ def main(argv=None):
         return 1
     print(f"check_metrics_surface: ok ({len(keys)} metrics keys covered "
           "by reset_metrics + conftest reconciliation + Prometheus "
-          "exposition)")
+          f"exposition; {n_ops} flight-recorder op histograms in the "
+          "runtime registry)")
     return 0
+
+
+def _check_runtime_registry(failures):
+    """Flight-recorder runtime-registry names: record one event per
+    instrumented op kind, then assert each op's histogram appears in
+    the Prometheus runtime section AND the registry snapshot."""
+    from paddle_tpu.distributed.resilience import flight_recorder
+    # importing the call sites registers their op kinds with the choke
+    # point (the structural check in tools/check_collective_surface.py
+    # asserts the decorators are actually present)
+    import paddle_tpu.distributed.communication.ops        # noqa: F401
+    import paddle_tpu.distributed.communication.all_reduce  # noqa: F401
+    import paddle_tpu.distributed.parallel                  # noqa: F401
+    from paddle_tpu.inference.telemetry import (runtime_prometheus,
+                                                runtime_registry_snapshot)
+
+    ops = flight_recorder.instrumented_ops()
+    if not ops:
+        failures.append("flight_recorder.instrumented_ops() is empty — "
+                        "the choke-point decorators disappeared")
+        return 0
+    # the probe must not pollute the PROCESS-GLOBAL registry: this runs
+    # in-process as a tier-1 test, and phantom ~0s observations would
+    # leak into every later runtime_prometheus() reading. Only probe
+    # ops whose histogram doesn't exist yet, and drop those afterwards.
+    from paddle_tpu.inference.telemetry import _runtime_hists
+    pre = set(_runtime_hists)
+    rec = flight_recorder.FlightRecorder(ring=8, rank=0, world=1)
+    try:
+        for op in ops:
+            if flight_recorder.runtime_hist_name(op) not in pre:
+                rec.end(rec.start(op, group="default", shape=(1,),
+                                  dtype="float32", nbytes=4))
+        text = "\n".join(runtime_prometheus())
+        snap = runtime_registry_snapshot()
+        for op in ops:
+            name = flight_recorder.runtime_hist_name(op)
+            if f"{name}_bucket" not in text:
+                failures.append(
+                    f"instrumented op {op!r} has no {name!r} histogram "
+                    "in runtime_prometheus() after recording an event")
+            if name not in snap["histograms"]:
+                failures.append(
+                    f"instrumented op {op!r} missing from "
+                    "runtime_registry_snapshot()['histograms']")
+    finally:
+        for name in set(_runtime_hists) - pre:
+            del _runtime_hists[name]
+    return len(ops)
 
 
 if __name__ == "__main__":
