@@ -23,7 +23,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "ping",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
 
@@ -361,6 +361,25 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None):
             fut._set(exc=e)
     threading.Thread(target=run, daemon=True).start()
     return fut
+
+
+def _pong():
+    return "pong"
+
+
+def ping(to: str, timeout=None) -> float:
+    """Bounded liveness probe: one trivial round-trip to worker ``to``;
+    returns the measured latency in seconds. Raises the usual transport
+    errors (TimeoutError / ConnectionError) when the peer is gone — the
+    cluster router's replica heartbeat rides exactly this, with a SHORT
+    timeout so a dead replica is detected in heartbeats, not in a
+    30s-default user-facing call."""
+    t0 = time.monotonic()
+    out = _require_agent().call(to, _pong, (), {},
+                                _resolve_timeout(timeout))
+    if out != "pong":
+        raise ConnectionError(f"rpc ping to {to!r}: bad reply {out!r}")
+    return time.monotonic() - t0
 
 
 def get_worker_info(name: str = None) -> WorkerInfo:

@@ -187,6 +187,16 @@ class ServingEngine:
     arbitrarily old rids; aggregate totals survive in `metrics()` and
     the Prometheus lifetime counters.
 
+    Streaming readers (the cluster gateway's SSE path) must NOT race
+    that cap: `track(rid)` registers an incremental cursor, and a
+    tracked request's record is RETAINED past the results cap until
+    `harvest_new_tokens(rid)` has returned `done=True` (or
+    `release(rid)` drops the cursor). Call `track` before the request
+    can finish — registering only after finish falls back to the
+    bounded `results` dict, which may already have evicted the entry
+    (KeyError, the documented race). `poll(rid)` is the non-destructive
+    status read; neither API moves any counter.
+
     Sampling mode (greedy / top-k / top-p / temperature) is ENGINE
     config — it is baked into the one compiled step. Per-REQUEST knobs
     (eos_token_id, max_new_tokens, min_length, repetition_penalty) are
@@ -476,6 +486,12 @@ class ServingEngine:
 
         self._queue = deque()
         self.results = {}
+        # streaming-harvest bookkeeping: every queued/running request is
+        # reachable by rid (bounded by queue + slots); a FINISHED request
+        # stays indexed only while a track() cursor holds it — the
+        # incremental SSE reader's guarantee against the results cap
+        self._req_index = {}              # rid -> ServedRequest
+        self._harvest = {}                # rid -> tokens already read
         self._rid = itertools.count()
         self._jit_cache = {}
         self._trace_count = 0                    # the retrace spy
@@ -566,6 +582,7 @@ class ServingEngine:
                             self.clock(), deadline_s=deadline_s,
                             seed=self._fresh_seed())
         self._queue.append(req)
+        self._req_index[req.rid] = req
         self.telemetry.req_queued(req.rid, req.t_submit)
         return req.rid
 
@@ -637,6 +654,87 @@ class ServingEngine:
         while self.has_work:
             self.step()
         return self.results
+
+    # ------------------------------------------------- streaming harvest
+    def _lookup_req(self, rid):
+        """(tokens, done, state) for a rid, or None if unknown: live
+        requests read their ServedRequest, finished untracked ones fall
+        back to the bounded results record."""
+        req = self._req_index.get(rid)
+        if req is not None:
+            return (req.tokens, req.state in ("finished", "expired"),
+                    req.state)
+        r = self.results.get(rid)
+        if r is not None:
+            return (r["tokens"], True,
+                    "expired" if r["expired"] else "finished")
+        return None
+
+    def track(self, rid):
+        """Register an incremental-harvest cursor for ``rid``. A tracked
+        request's record is retained past the bounded ``results`` cap
+        until the reader drains it — call BEFORE the request can finish
+        (the replica wrappers do it under the same lock as submit) or
+        the registration races the cap like any late ``results`` read."""
+        if rid in self._harvest:
+            return
+        if self._lookup_req(rid) is None:
+            raise KeyError(
+                f"request {rid} is unknown (never submitted, or it "
+                "finished and was evicted from the bounded results cap "
+                "before track() — register the cursor at submit time)")
+        self._harvest[rid] = 0
+
+    def poll(self, rid):
+        """Non-destructive status read: ``{"rid", "state", "n_tokens",
+        "ttft_s", "latency_s"}``, or None for an unknown rid. Moves no
+        cursor and no counter — safe to call at any rate."""
+        req = self._req_index.get(rid)
+        if req is not None:
+            return {"rid": rid, "state": req.state,
+                    "n_tokens": len(req.tokens), "ttft_s": req.ttft_s,
+                    "latency_s": req.latency_s}
+        r = self.results.get(rid)
+        if r is None:
+            return None
+        return {"rid": rid,
+                "state": "expired" if r["expired"] else "finished",
+                "n_tokens": int(np.asarray(r["tokens"]).size),
+                "ttft_s": r["ttft_s"], "latency_s": r["latency_s"]}
+
+    def harvest_new_tokens(self, rid):
+        """Incremental token harvest: ``(new_tokens, done, state)`` —
+        the tokens generated since the previous call (first call
+        auto-registers a cursor at 0 and returns everything so far).
+        When ``done`` the cursor is dropped and the retained record
+        released; a later call raises KeyError like any unknown rid.
+        This is the SSE streaming primitive: a tracked reader can be
+        arbitrarily slow without losing a finished request to the
+        results cap (the untracked `results` dict can — documented)."""
+        if rid not in self._harvest:
+            self.track(rid)
+        got = self._lookup_req(rid)
+        if got is None:                  # evicted between track and now:
+            self._harvest.pop(rid, None)  # only possible for a cursor
+            raise KeyError(              # registered post-finish
+                f"request {rid} was evicted from the results cap before "
+                "its first harvest — track() at submit time to pin it")
+        tokens, done, state = got
+        cur = self._harvest[rid]
+        new = [int(t) for t in tokens[cur:]]
+        if done:
+            self.release(rid)
+        else:
+            self._harvest[rid] = cur + len(new)
+        return new, done, state
+
+    def release(self, rid):
+        """Drop a streaming cursor (and the retained record, if the
+        request already finished). Idempotent."""
+        self._harvest.pop(rid, None)
+        req = self._req_index.get(rid)
+        if req is not None and req.state in ("finished", "expired"):
+            self._req_index.pop(rid, None)
 
     def _window_counters(self):
         """The raw window-counter surface, keyed like metrics(). Kept in
@@ -1020,6 +1118,7 @@ class ServingEngine:
         child.tokens = list(src.tokens)
         child.t_first = src.t_first
         self._slot_req[s1] = child
+        self._req_index[child.rid] = child
         self._kv_reserved += need
         self._kv_committed += need
         # a fork is a CLONE, not an admission: it performs no prefix
@@ -2042,6 +2141,12 @@ class ServingEngine:
         # results stay retrievable
         while len(self.results) > self._results_cap:
             self.results.pop(next(iter(self.results)))
+        # a tracked request's record outlives the cap until its reader
+        # drains it (harvest_new_tokens done=True / release); untracked
+        # requests drop from the index now — results keeps the bounded
+        # record, exactly the old lifecycle
+        if req.rid not in self._harvest:
+            self._req_index.pop(req.rid, None)
         if self.paged:
             self._kv_committed -= self._blocks_needed(req.prompt.size,
                                                       req.max_new_tokens)

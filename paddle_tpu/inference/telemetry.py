@@ -61,9 +61,32 @@ __all__ = ["LogHistogram", "Telemetry", "RequestTrace",
            "parse_prometheus", "snapshot", "runtime_histogram",
            "runtime_counter", "runtime_prometheus",
            "runtime_registry_snapshot", "PROMETHEUS_NAMES",
-           "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING"]
+           "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING",
+           "SNAPSHOT_SCHEMA_VERSION", "SNAPSHOT_REQUIRED_KEYS",
+           "SNAPSHOT_OPTIONAL_KEYS"]
 
 DEFAULT_RING = 2048
+
+# ---- telemetry_snapshot() wire contract -----------------------------
+# The snapshot IS a wire payload now: the cluster router
+# (serving_cluster/router.py) reads it over rpc to place requests, so
+# its key set is pinned structurally (tools/check_metrics_surface.py
+# fails tier-1 on drift, the same discipline as PROMETHEUS_NAMES).
+# Bump SNAPSHOT_SCHEMA_VERSION on any key addition/removal/semantic
+# change — a router seeing an unknown version refuses to score the
+# replica instead of silently misreading it.
+SNAPSHOT_SCHEMA_VERSION = 1
+
+# keys every snapshot carries, on every engine configuration
+SNAPSHOT_REQUIRED_KEYS = frozenset({
+    "schema_version", "queue_depth", "occupancy", "num_slots",
+    "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
+    "requests", "histograms", "budget", "prefix", "spans_logged",
+    "steps_logged", "telemetry_ring",
+})
+
+# keys present only on some configurations (paged pool / spec decode)
+SNAPSHOT_OPTIONAL_KEYS = frozenset({"kv_blocks", "drafter"})
 
 
 # ---------------------------------------------------------------- histogram
@@ -568,13 +591,22 @@ def parse_prometheus(text):
 def snapshot(engine):
     """JSON-serializable telemetry snapshot — the routing payload a
     cluster front-end polls per replica (load + affinity + headroom in
-    one cheap read)."""
+    one cheap read). Key set pinned by SNAPSHOT_REQUIRED_KEYS/
+    SNAPSHOT_OPTIONAL_KEYS; bump SNAPSHOT_SCHEMA_VERSION on change."""
     m = engine.metrics()
     tele = engine.telemetry
     out = {
+        "schema_version": SNAPSHOT_SCHEMA_VERSION,
         "queue_depth": m["queue_depth"],
         "occupancy": m["occupancy"],
         "num_slots": engine.num_slots,
+        # free = admittable right now (neither decoding nor prefilling
+        # nor parked finished): the router's slot-headroom signal
+        "slots_free": len(engine._free_slots()),
+        # the prefix-block alignment: the router's consistent-hash key
+        # is the first prefill_cap-aligned prompt block, so every
+        # replica's cap must agree and the router reads it from here
+        "prefill_cap": engine.prefill_cap,
         "has_work": bool(engine.has_work),
         "tokens_per_sec": m["tokens_per_sec"],
         "requests": {k: m[f"requests_{k}"] for k in

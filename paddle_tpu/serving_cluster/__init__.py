@@ -1,0 +1,24 @@
+"""Cluster serving front-end: OpenAI-compatible gateway, prefix-affinity
+router, replica failover.
+
+The missing assembly over the serving stack: PR 8's
+``telemetry_snapshot()`` is the routing payload, PR 4/6's radix prefix
+store is what makes placement matter, PR 3's heartbeat discipline is
+the death detector — this package turns N ``ServingEngine`` replicas
+(in-process threads or processes behind ``distributed/rpc.py``) into
+ONE ``curl``-able endpoint. See gateway.py / router.py / replica.py /
+protocol.py docstrings for the layer contracts, and
+``python -m paddle_tpu.serving_cluster`` for a self-contained demo
+cluster.
+
+The router is pure host code: nothing here dispatches to the device,
+so the per-replica zero-retrace contract is untouched by construction.
+"""
+from .gateway import Gateway
+from .protocol import ProtocolError
+from .replica import LocalReplica, ReplicaError, RpcReplica, serve_engine
+from .router import HashRing, NoReplicaError, Router
+
+__all__ = ["Gateway", "Router", "HashRing", "LocalReplica",
+           "RpcReplica", "serve_engine", "ReplicaError",
+           "NoReplicaError", "ProtocolError"]

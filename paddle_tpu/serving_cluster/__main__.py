@@ -1,0 +1,90 @@
+"""python -m paddle_tpu.serving_cluster — a self-contained demo
+cluster: N in-process replicas (each its own ServingEngine + prefix
+cache over a shared toy model) behind the gateway, ready for curl.
+
+    JAX_PLATFORMS=cpu python -m paddle_tpu.serving_cluster \
+        --replicas 2 --port 8100
+    curl -s localhost:8100/v1/models
+    curl -s localhost:8100/v1/completions -d \
+        '{"prompt": [5, 9, 2, 41], "max_tokens": 8}'
+    curl -sN localhost:8100/v1/completions -d \
+        '{"prompt": [5, 9, 2, 41], "max_tokens": 8, "stream": true}'
+    curl -s localhost:8100/metrics | head
+
+Flags default from the env contract (``PADDLE_GATEWAY_PORT``,
+``PADDLE_GATEWAY_REPLICAS``, ``PADDLE_ROUTER_POLICY``). This is the
+demo/e2e harness; a real deployment builds its own engines (one per
+accelerator) and passes them to ``LocalReplica``/``serve_engine``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def _build_engine(seed, slots, smax, prefix_blocks, cap):
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+
+    E, H, FF, L, V = 64, 4, 128, 2, 256
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return ServingEngine(fmt, embed, head, num_slots=slots,
+                         max_seq_len=smax, prefill_cap=cap,
+                         prefix_cache_blocks=prefix_blocks)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving_cluster",
+        description="demo cluster: N local replicas behind the gateway")
+    ap.add_argument("--replicas", type=int, default=int(os.environ.get(
+        "PADDLE_GATEWAY_REPLICAS", "2")))
+    ap.add_argument("--port", type=int, default=int(os.environ.get(
+        "PADDLE_GATEWAY_PORT", "8100")))
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-seq-len", type=int, default=256)
+    ap.add_argument("--prefill-cap", type=int, default=64)
+    ap.add_argument("--prefix-blocks", type=int, default=64)
+    ap.add_argument("--policy", default=None,
+                    help="router policy (default: PADDLE_ROUTER_POLICY "
+                         "or prefix_affinity)")
+    args = ap.parse_args(argv)
+
+    from .gateway import Gateway
+    from .replica import LocalReplica
+    from .router import Router
+
+    # every replica serves the SAME weights (seed-shared toy model) so
+    # routing is invisible to outputs — exactly the production contract
+    replicas = [
+        LocalReplica(f"replica{i}",
+                     _build_engine(0, args.slots, args.max_seq_len,
+                                   args.prefix_blocks, args.prefill_cap))
+        for i in range(args.replicas)]
+    router = Router(replicas, policy=args.policy)
+    gw = Gateway(router, port=args.port).start_background()
+    print(f"serving_cluster: {args.replicas} replicas on "
+          f"http://127.0.0.1:{gw.port} (policy {router.policy}) — "
+          "Ctrl-C to stop", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        gw.stop()
+        for r in replicas:
+            r.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,368 @@
+"""Asyncio HTTP gateway: one OpenAI-compatible endpoint over N replicas.
+
+Stdlib-only (asyncio streams + a minimal HTTP/1.1 parser — the
+container pins its dependency set, so no aiohttp): the gateway parses
+requests, delegates placement/failover to the Router, and streams
+tokens back as JSON or SSE. Engine-touching calls (submit / harvest /
+refresh — they take a replica lock, or an rpc round-trip) run in the
+default thread-pool executor so one slow replica never stalls the
+accept loop.
+
+Endpoints (wire shapes pinned in protocol.py, end-to-end by
+tools/check_http_surface.py):
+
+  * ``POST /v1/completions`` — JSON, or SSE when ``"stream": true``
+    (one chunk per harvest batch, ``data: [DONE]`` terminator).
+  * ``GET /v1/models`` — the single served model id.
+  * ``GET /healthz``   — ok/degraded + replica counts (degraded = some
+    but not all replicas dead; a fully dead cluster still answers,
+    status ``down`` — the load balancer's probe must not hang).
+  * ``GET /metrics``   — the router's aggregated Prometheus exposition
+    (every replica's engine metrics with a ``replica`` label + router
+    gauges).
+
+Backpressure is honest end-to-end: AdmissionFull from every replica →
+HTTP 429 with ``Retry-After``; ``deadline_s`` expiry → 504; all
+replicas dead → 503. A replica dying mid-stream never errors the
+stream — the router fails over and the replayed greedy prefix is
+skipped (router.py), so the client just sees one slow poll interval.
+
+Env knobs: ``PADDLE_GATEWAY_PORT`` (8100; 0 = ephemeral),
+``PADDLE_GATEWAY_POLL_S`` (harvest poll interval, 0.004),
+``PADDLE_GATEWAY_HB_S`` (health sweep interval, 0.25) — plus the
+router's ``PADDLE_ROUTER_POLICY`` / ``PADDLE_ROUTER_SPILL_DEPTH`` /
+``PADDLE_GATEWAY_HB_DEAD_S`` and the rpc replica's
+``PADDLE_GATEWAY_HB_TIMEOUT_S``. All registered in
+``paddle_tpu.testing.GW_ENV_VARS`` (conftest leak guard).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+from ..inference.serving import AdmissionFull
+from . import protocol
+from .router import NoReplicaError
+
+__all__ = ["Gateway"]
+
+_MAX_BODY = 8 << 20                       # 8 MiB: token-id prompts only
+
+
+class _HttpError(Exception):
+    def __init__(self, code, message):
+        self.code, self.message = code, message
+
+
+class Gateway:
+    def __init__(self, router, model_id="paddle_tpu", host="127.0.0.1",
+                 port=None, poll_s=None, hb_s=None):
+        self.router = router
+        self.model_id = model_id
+        self.host = host
+        self.port = int(port if port is not None
+                        else os.environ.get("PADDLE_GATEWAY_PORT",
+                                            "8100"))
+        self.poll_s = float(poll_s if poll_s is not None
+                            else os.environ.get("PADDLE_GATEWAY_POLL_S",
+                                                "0.004"))
+        self.hb_s = float(hb_s if hb_s is not None
+                          else os.environ.get("PADDLE_GATEWAY_HB_S",
+                                              "0.25"))
+        self._thread = None
+        self._loop = None
+        self._stop_evt = None
+
+    # ------------------------------------------------------------ server
+    async def serve(self, ready=None):
+        """Run until ``stop()``; sets ``self.port`` to the bound port
+        (port 0 = ephemeral, the tests' no-collision mode)."""
+        self._loop = asyncio.get_running_loop()
+        self._stop_evt = asyncio.Event()
+        server = await asyncio.start_server(self._handle, self.host,
+                                            self.port)
+        self.port = server.sockets[0].getsockname()[1]
+        health = asyncio.ensure_future(self._health_loop())
+        if ready is not None:
+            ready.set()
+        try:
+            async with server:
+                await self._stop_evt.wait()
+        finally:
+            health.cancel()
+
+    def start_background(self):
+        """Run the event loop in a daemon thread; returns once the
+        socket is listening (tests, tools, the CLI's curl demo)."""
+        ready = threading.Event()
+        self._thread = threading.Thread(
+            target=lambda: asyncio.run(self.serve(ready)), daemon=True,
+            name="gateway")
+        self._thread.start()
+        if not ready.wait(timeout=30):
+            raise RuntimeError("gateway failed to start within 30s")
+        return self
+
+    def stop(self):
+        if self._loop is not None and self._stop_evt is not None:
+            self._loop.call_soon_threadsafe(self._stop_evt.set)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    async def _health_loop(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                await loop.run_in_executor(None, self.router.refresh)
+                await loop.run_in_executor(None,
+                                           self.router.check_health)
+            except Exception:
+                pass                      # the sweep must never die
+            await asyncio.sleep(self.hb_s)
+
+    # ------------------------------------------------------------- http
+    async def _handle(self, reader, writer):
+        try:
+            try:
+                # bound the request read: a client that connects and
+                # sends nothing must not pin a handler task forever
+                method, path, body = await asyncio.wait_for(
+                    self._read_request(reader, writer), timeout=30)
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    asyncio.TimeoutError):
+                return
+            except _HttpError as e:
+                await self._send_error(writer, e.code, e.message)
+                return
+            try:
+                await self._route(method, path, body, writer)
+            except protocol.ProtocolError as e:
+                await self._send_error(writer, e.code, e.message)
+            except AdmissionFull as e:
+                await self._send_error(
+                    writer, "admission_full", str(e),
+                    extra={"Retry-After": str(protocol.RETRY_AFTER_S)})
+            except NoReplicaError as e:
+                await self._send_error(writer, "no_replica", str(e))
+            except KeyError as e:
+                # an unknown/already-released gid (e.g. a concurrent
+                # duplicate whose twin released first) is the client's
+                # 404, not a server bug
+                await self._send_error(writer, "not_found",
+                                       f"unknown request: {e}")
+            except (ConnectionError, asyncio.CancelledError):
+                raise
+            except Exception as e:
+                await self._send_error(writer, "internal",
+                                       f"unhandled: {e!r}")
+        except (ConnectionError, asyncio.CancelledError):
+            pass                          # client went away mid-write
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader, writer):
+        line = await reader.readline()
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) != 3:
+            raise _HttpError("bad_request", "malformed request line")
+        method, path = parts[0], parts[1]
+        clen = 0
+        expect_continue = False
+        while True:
+            h = (await reader.readline()).decode("latin-1").strip()
+            if not h:
+                break
+            k, _, v = h.partition(":")
+            key = k.strip().lower()
+            if key == "content-length":
+                try:
+                    clen = int(v)
+                except ValueError:
+                    raise _HttpError("bad_request",
+                                     "bad Content-Length")
+            elif key == "expect" \
+                    and v.strip().lower() == "100-continue":
+                expect_continue = True
+        if not 0 <= clen <= _MAX_BODY:
+            # the lower bound matters too: readexactly(-1) raises an
+            # unhandled ValueError instead of a clean 400
+            raise _HttpError("bad_request",
+                             f"Content-Length must be in [0, "
+                             f"{_MAX_BODY}], got {clen}")
+        if expect_continue and clen:
+            # curl sends Expect: 100-continue for bodies > 1 KiB and
+            # waits ~1s for this interim reply before transmitting —
+            # without it every large-prompt POST eats a fixed stall
+            writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            await writer.drain()
+        body = await reader.readexactly(clen) if clen else b""
+        return method, path, body
+
+    async def _route(self, method, path, body, writer):
+        if method == "GET" and path == "/healthz":
+            alive = len(self.router.alive_names())
+            total = len(self.router.replicas)
+            status = ("ok" if alive == total
+                      else "degraded" if alive else "down")
+            await self._send_json(writer, 200 if alive else 503, {
+                "status": status, "replicas_alive": alive,
+                "replicas_total": total})
+        elif method == "GET" and path == "/v1/models":
+            await self._send_json(writer, 200, {
+                "object": "list",
+                "data": [{"id": self.model_id, "object": "model",
+                          "owned_by": "paddle_tpu"}]})
+        elif method == "GET" and path == "/metrics":
+            loop = asyncio.get_running_loop()
+            text = await loop.run_in_executor(
+                None, self.router.metrics_prometheus)
+            await self._send_raw(
+                writer, 200, text.encode(),
+                ctype="text/plain; version=0.0.4; charset=utf-8")
+        elif method == "POST" and path == "/v1/completions":
+            await self._completions(body, writer)
+        else:
+            await self._send_error(writer, "not_found",
+                                   f"no route {method} {path}")
+
+    # ------------------------------------------------------ completions
+    async def _completions(self, body, writer):
+        try:
+            obj = json.loads(body.decode() or "null")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise protocol.ProtocolError("bad_request",
+                                         f"body is not JSON: {e}")
+        req = protocol.parse_completion_request(obj, self.model_id)
+        loop = asyncio.get_running_loop()
+        try:
+            gid = await loop.run_in_executor(
+                None, lambda: self.router.submit(
+                    req.prompt, request_id=req.request_id,
+                    **req.submit_kwargs()))
+        except ValueError as e:
+            # engine-side validation (prompt + max_tokens exceeding the
+            # ring capacity, disabled repetition penalty, ...) is a
+            # MALFORMED REQUEST, not a server bug
+            raise protocol.ProtocolError("bad_request", str(e))
+        if req.stream:
+            await self._stream(req, gid, writer)
+        else:
+            tokens, state = [], "running"
+            try:
+                while True:
+                    # explicit cursor: a concurrent idempotent retry
+                    # sharing this gid still sees the full stream
+                    new, done, state = await loop.run_in_executor(
+                        None, self.router.harvest, gid, len(tokens))
+                    tokens.extend(new)
+                    if done:
+                        break
+                    await asyncio.sleep(self.poll_s)
+            finally:
+                # error paths (orphaned request, client gone) must not
+                # leak the assignment / the engine-side tracked record
+                self.router.release(gid)
+            if state == "expired":
+                raise protocol.ProtocolError(
+                    "deadline_exceeded",
+                    f"request exceeded deadline_s="
+                    f"{req.deadline_s} before completing")
+            await self._send_json(writer, 200, protocol.completion_response(
+                gid, self.model_id, time.time(), tokens,
+                protocol.finish_reason(tokens, req.stop_token_id,
+                                       False),
+                len(req.prompt)))
+
+    async def _stream(self, req, gid, writer):
+        """SSE: headers go out with the FIRST harvest batch, so a
+        request that expires before any token still gets a clean 504
+        (after the first byte the stream can only finish via
+        finish_reason, OpenAI-style)."""
+        loop = asyncio.get_running_loop()
+        started = False
+        last_tok = None
+        sent = 0
+        try:
+            while True:
+                new, done, state = await loop.run_in_executor(
+                    None, self.router.harvest, gid, sent)
+                sent += len(new)
+                if new:
+                    if not started:
+                        await self._send_sse_headers(writer)
+                        started = True
+                    writer.write(protocol.sse_event(
+                        protocol.stream_chunk(gid, self.model_id,
+                                              time.time(), new)))
+                    last_tok = new[-1]
+                    await writer.drain()
+                if done:
+                    break
+                await asyncio.sleep(self.poll_s)
+        except Exception as e:
+            if not started or isinstance(e, ConnectionError):
+                raise                     # clean JSON error still possible
+            # headers are out: a second HTTP response would be protocol
+            # garbage — terminate the STREAM honestly instead
+            # (finish_reason "error" + [DONE], see protocol.py)
+            writer.write(protocol.sse_event(protocol.stream_chunk(
+                gid, self.model_id, time.time(), [], reason="error")))
+            writer.write(protocol.SSE_DONE)
+            await writer.drain()
+            return
+        finally:
+            # a client that disconnects mid-stream must not leak the
+            # router assignment (and its engine-side tracked record)
+            self.router.release(gid)
+        expired = state == "expired"
+        if not started:
+            if expired:
+                raise protocol.ProtocolError(
+                    "deadline_exceeded",
+                    f"request exceeded deadline_s={req.deadline_s} "
+                    "before its first token")
+            await self._send_sse_headers(writer)
+        reason = protocol.finish_reason(
+            [] if last_tok is None else [last_tok], req.stop_token_id,
+            expired)
+        writer.write(protocol.sse_event(protocol.stream_chunk(
+            gid, self.model_id, time.time(), [], reason=reason)))
+        writer.write(protocol.SSE_DONE)
+        await writer.drain()
+
+    # ---------------------------------------------------------- writers
+    async def _send_json(self, writer, status, obj, extra=None):
+        await self._send_raw(writer, status,
+                             json.dumps(obj).encode(),
+                             ctype="application/json", extra=extra)
+
+    async def _send_error(self, writer, code, message, extra=None):
+        status, body = protocol.error_body(code, message)
+        await self._send_json(writer, status, body, extra=extra)
+
+    async def _send_sse_headers(self, writer):
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+
+    async def _send_raw(self, writer, status, payload, ctype, extra=None):
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  429: "Too Many Requests", 500: "Internal Server Error",
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(status, "OK")
+        head = [f"HTTP/1.1 {status} {reason}",
+                f"Content-Type: {ctype}",
+                f"Content-Length: {len(payload)}",
+                "Connection: close"]
+        for k, v in (extra or {}).items():
+            head.append(f"{k}: {v}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)
+        await writer.drain()

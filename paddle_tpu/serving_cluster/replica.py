@@ -1,0 +1,301 @@
+"""Replica handles: ONE interface over an in-process engine thread and
+a remote engine behind ``paddle_tpu.distributed.rpc``.
+
+The router never sees a ServingEngine — it sees a *replica*: submit /
+poll / harvest / release / snapshot / heartbeat. Two implementations:
+
+  * ``LocalReplica`` — thread-per-engine. The driver thread loops
+    ``engine.step()`` while there is work and publishes a heartbeat
+    each iteration; every engine touch (submit, harvest, snapshot)
+    serializes on one lock, so the single-threaded engine stays
+    single-threaded. ``threaded=False`` hands the drive loop to the
+    caller (``pump()``) — the bench and the router unit tests use it to
+    run the whole cluster on a virtual clock, deterministically.
+  * ``RpcReplica`` — the same interface over ``rpc_sync`` to a worker
+    process that runs ``serve_engine()`` (which wraps ITS engine in a
+    LocalReplica — the locking story is identical in and out of
+    process). Heartbeats are ``rpc.ping`` with a SHORT timeout, so a
+    dead worker is detected at heartbeat cadence, not at the 30s rpc
+    default inside a user-facing call.
+
+Death is a first-class state: ``kill()`` (tests/bench) freezes the
+driver without draining — heartbeats stop, ``alive`` flips false, and
+every engine touch raises ``ReplicaError`` so the router's failover
+path (drain + re-submit elsewhere) is the ONLY way forward, exactly
+like a crashed process.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..inference.serving import AdmissionFull
+
+__all__ = ["ReplicaError", "LocalReplica", "RpcReplica", "serve_engine"]
+
+
+class ReplicaError(RuntimeError):
+    """The replica is dead or unreachable — the router must fail over.
+    Deliberately DISTINCT from AdmissionFull: backpressure means retry
+    or spill, death means drain and re-route."""
+
+
+class LocalReplica:
+    """Thread-per-engine in-process replica (see module docstring)."""
+
+    def __init__(self, name, engine, threaded=True, clock=None,
+                 idle_wait_s=0.002, step_hook=None):
+        self.name = name
+        self.engine = engine
+        self._lock = threading.RLock()
+        self._clock = clock or time.monotonic
+        self._hb = self._clock()
+        self._failed = False
+        self._stop = False
+        self._wake = threading.Event()
+        self._idle_wait_s = float(idle_wait_s)
+        # called as hook(self) after every WORKING engine step — the
+        # deterministic fault-drill lever (kill at exactly step K,
+        # mid-request, regardless of scheduler/socket timing)
+        self._step_hook = step_hook
+        self._thread = None
+        if threaded:
+            self._thread = threading.Thread(
+                target=self._drive, daemon=True,
+                name=f"replica-{name}")
+            self._thread.start()
+
+    # ------------------------------------------------------------ drive
+    def _drive(self):
+        while not self._stop:
+            if self._failed:
+                return                    # crash: heartbeat freezes
+            with self._lock:
+                work = self.engine.has_work and not self._failed
+                if work:
+                    self.engine.step()
+            if work and self._step_hook is not None:
+                self._step_hook(self)
+            self._hb = self._clock()
+            if not work:
+                self._wake.wait(self._idle_wait_s)
+                self._wake.clear()
+
+    def pump(self):
+        """Unthreaded drive: one engine step if there is work; returns
+        tokens emitted. The caller owns the cadence (virtual-clock
+        benches, deterministic tests)."""
+        self._check_alive()
+        with self._lock:
+            work = self.engine.has_work
+            out = self.engine.step() if work else 0
+        if work and self._step_hook is not None:
+            self._step_hook(self)
+        self._hb = self._clock()
+        return out
+
+    # ---------------------------------------------------------- health
+    def heartbeat_age(self):
+        return self._clock() - self._hb
+
+    @property
+    def alive(self):
+        if self._failed or self._stop:
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    def _check_alive(self):
+        if not self.alive:
+            raise ReplicaError(f"replica {self.name!r} is dead")
+
+    def kill(self):
+        """Simulated crash (tests/bench/fault drills): the driver stops
+        mid-flight WITHOUT draining — in-flight requests are stranded
+        exactly as a SIGKILLed process would strand them."""
+        self._failed = True
+        self._wake.set()
+
+    def close(self):
+        """Graceful stop (not a crash): the drive thread exits; the
+        engine keeps its state."""
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # ----------------------------------------------------------- engine
+    def submit(self, prompt, **kw):
+        """submit + track under ONE lock hold: the streaming cursor is
+        registered before the driver thread can possibly finish the
+        request, closing the results-cap race by construction."""
+        self._check_alive()
+        with self._lock:
+            rid = self.engine.submit(prompt, **kw)
+            self.engine.track(rid)
+        self._wake.set()
+        return rid
+
+    def harvest(self, rid):
+        self._check_alive()
+        with self._lock:
+            return self.engine.harvest_new_tokens(rid)
+
+    def poll(self, rid):
+        self._check_alive()
+        with self._lock:
+            return self.engine.poll(rid)
+
+    def release(self, rid):
+        if not self.alive:
+            return                        # nothing to free on a corpse
+        with self._lock:
+            self.engine.release(rid)
+
+    def snapshot(self):
+        self._check_alive()
+        with self._lock:
+            snap = self.engine.telemetry_snapshot()
+        snap["replica"] = self.name
+        return snap
+
+    def metrics_prometheus(self):
+        self._check_alive()
+        with self._lock:
+            return self.engine.metrics_prometheus()
+
+
+# ----------------------------------------------------------- rpc worker
+# Module-level state + functions so they pickle by reference through
+# rpc (a bound method would drag the whole replica object along).
+_WORKER: list = [None]
+
+
+def serve_engine(engine, name="replica", threaded=True):
+    """Install ``engine`` as THIS process's served replica (wrapped in a
+    LocalReplica — one lock story everywhere) and return the wrapper.
+    Call after ``rpc.init_rpc``; the gateway process then drives it via
+    ``RpcReplica(worker_name)``. ``threaded=False`` leaves the drive
+    loop to the caller's ``pump()`` (deterministic tests)."""
+    _WORKER[0] = LocalReplica(name, engine, threaded=threaded)
+    return _WORKER[0]
+
+
+def _served():
+    rep = _WORKER[0]
+    if rep is None:
+        raise RuntimeError("this worker serves no engine — call "
+                           "serving_cluster.replica.serve_engine first")
+    return rep
+
+
+def _rw_submit(prompt, kw):
+    return _served().submit(prompt, **kw)
+
+
+def _rw_harvest(rid):
+    return _served().harvest(rid)
+
+
+def _rw_poll(rid):
+    return _served().poll(rid)
+
+
+def _rw_release(rid):
+    return _served().release(rid)
+
+
+def _rw_snapshot():
+    return _served().snapshot()
+
+
+def _rw_prometheus():
+    return _served().metrics_prometheus()
+
+
+class RpcReplica:
+    """The replica interface over ``distributed/rpc.py``: every engine
+    touch is one ``rpc_sync`` to ``worker_name``; transport failures
+    (dead worker, timeout) surface as ``ReplicaError`` so the router
+    treats an unreachable process exactly like a dead thread.
+    ``AdmissionFull`` pickles through the rpc exception channel intact
+    — backpressure stays backpressure across the process boundary."""
+
+    def __init__(self, worker_name, timeout=None, ping_timeout=None):
+        from ..distributed import rpc
+        self._rpc = rpc
+        self.name = worker_name
+        self.engine = None                # remote — no local handle
+        self._timeout = float(
+            timeout if timeout is not None
+            else os.environ.get("PADDLE_RPC_TIMEOUT_S", "30"))
+        self._ping_timeout = float(
+            ping_timeout if ping_timeout is not None
+            else os.environ.get("PADDLE_GATEWAY_HB_TIMEOUT_S", "2"))
+        self._dead = False
+        self._hb = time.monotonic()
+
+    def _call(self, fn, *args, timeout=None):
+        if self._dead:
+            raise ReplicaError(f"replica {self.name!r} is dead")
+        try:
+            out = self._rpc.rpc_sync(
+                self.name, fn, args=args,
+                timeout=self._timeout if timeout is None else timeout)
+        except AdmissionFull:
+            self._hb = time.monotonic()   # a shed IS a live round-trip
+            raise
+        except (TimeoutError, ConnectionError, OSError) as e:
+            raise ReplicaError(
+                f"replica {self.name!r} unreachable: {e!r}") from e
+        self._hb = time.monotonic()
+        return out
+
+    # ---------------------------------------------------------- health
+    def heartbeat_age(self):
+        return time.monotonic() - self._hb
+
+    @property
+    def alive(self):
+        if self._dead:
+            return False
+        try:
+            self._rpc.ping(self.name, timeout=self._ping_timeout)
+        except Exception:
+            return False
+        self._hb = time.monotonic()
+        return True
+
+    def kill(self):
+        """Client-side tombstone (the worker process is killed out of
+        band); every later touch raises ReplicaError immediately."""
+        self._dead = True
+
+    def close(self):
+        self._dead = True
+
+    # ----------------------------------------------------------- engine
+    def submit(self, prompt, **kw):
+        return self._call(_rw_submit, list(prompt), kw)
+
+    def harvest(self, rid):
+        return self._call(_rw_harvest, rid)
+
+    def poll(self, rid):
+        return self._call(_rw_poll, rid)
+
+    def release(self, rid):
+        try:
+            return self._call(_rw_release, rid)
+        except ReplicaError:
+            return None                   # nothing to free on a corpse
+
+    def snapshot(self):
+        # the routing payload is tiny and polled at heartbeat cadence:
+        # a frozen worker must stall a snapshot for the SHORT probe
+        # timeout, never the 30s user-facing call default (the router
+        # may hold its lock across a submit-path refresh)
+        return self._call(_rw_snapshot, timeout=self._ping_timeout)
+
+    def metrics_prometheus(self):
+        return self._call(_rw_prometheus)

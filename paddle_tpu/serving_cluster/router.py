@@ -1,0 +1,610 @@
+"""Placement-sensitive request router over N replicas.
+
+The router turns replica handles (replica.py) into one submit/harvest
+surface with three policies (``PADDLE_ROUTER_POLICY``):
+
+  * ``round_robin`` — arrival order over alive replicas (the A/B
+    baseline: placement-blind).
+  * ``least_loaded`` — minimize a load score read from each replica's
+    ``telemetry_snapshot()``: ``queue_depth + busy_slots +
+    num_slots * kv_used_frac`` (queue pressure, slot pressure, pool
+    headroom — the three admission bottlenecks the engine exposes).
+  * ``prefix_affinity`` (default) — consistent-hash the FIRST
+    ``prefill_cap``-aligned prompt block onto a replica ring, so every
+    request sharing a template lands where that template's radix chain
+    is already hot (prefix_cache.py); prompts shorter than one block
+    carry no shareable block and fall back to least-loaded, and a
+    SATURATED owner (queue_depth >= ``PADDLE_ROUTER_SPILL_DEPTH``)
+    spills to least-loaded — affinity must never become head-of-line
+    blocking. Honesty note: affinity only pays at hit-rate > 0; on
+    no-template traffic it IS least-loaded with extra hashing.
+
+Replica death is a first-class path, not an exception trail:
+``check_health()`` (the gateway's heartbeat loop) marks a replica dead
+when its heartbeat age passes ``PADDLE_GATEWAY_HB_DEAD_S`` and its
+liveness probe fails, removes it from the hash ring (consistent
+hashing: only ITS keys move), and re-submits every one of its
+unfinished assignments elsewhere. Re-submission is idempotent by
+gateway request id and replays from the prompt; the assignment
+remembers how many tokens were already DELIVERED downstream and skips
+that many from the replacement stream — greedy decoding makes the
+replayed prefix token-identical, so the client's stream is seamless
+(sampled mode re-draws its per-request seed on the new engine and is
+documented as NOT replay-identical).
+
+Snapshots are trusted only at the pinned ``SNAPSHOT_SCHEMA_VERSION``:
+a replica reporting an unknown version is excluded from load scoring
+(counted in ``version_mismatches``) instead of being silently misread.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import os
+import threading
+import time
+
+from ..inference.serving import AdmissionFull
+from ..inference.telemetry import SNAPSHOT_SCHEMA_VERSION
+from .replica import ReplicaError
+
+__all__ = ["HashRing", "Router", "NoReplicaError", "POLICIES"]
+
+POLICIES = ("prefix_affinity", "least_loaded", "round_robin")
+
+
+class NoReplicaError(ReplicaError):
+    """Every replica is dead/unreachable — the gateway maps this to 503
+    (service unavailable), distinct from 429 backpressure."""
+
+
+def _hash64(data: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: add/remove a replica
+    moves only the keys it owns (~K/N of them), which is exactly the
+    prefix-affinity requirement — a replica death must not reshuffle
+    every template's home and cold-start every other radix store."""
+
+    def __init__(self, vnodes=64):
+        self.vnodes = int(vnodes)
+        self._points = []                 # sorted [(hash, name)]
+        self.names = set()
+
+    def add(self, name):
+        if name in self.names:
+            return
+        self.names.add(name)
+        for i in range(self.vnodes):
+            h = _hash64(f"{name}#{i}".encode())
+            bisect.insort(self._points, (h, name))
+
+    def remove(self, name):
+        if name not in self.names:
+            return
+        self.names.discard(name)
+        self._points = [(h, n) for h, n in self._points if n != name]
+
+    def owner(self, key: bytes):
+        """The replica owning ``key`` (first point clockwise), or None
+        on an empty ring."""
+        if not self._points:
+            return None
+        i = bisect.bisect_left(self._points, (_hash64(key), b""))
+        if i == len(self._points):
+            i = 0
+        return self._points[i][1]
+
+
+def _locked(fn):
+    """Serialize a Router method on the instance lock (see the class
+    docstring's thread-safety contract). RLock: harvest -> mark_dead ->
+    _place nest on the same thread."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
+class _Assignment:
+    __slots__ = ("gid", "request_id", "prompt", "kw", "replica", "rid",
+                 "tokens", "skip", "done", "state", "resubmits",
+                 "t_submit", "orphaned", "failed", "dup_returns")
+
+    def __init__(self, gid, request_id, prompt, kw, replica, rid,
+                 t_submit):
+        self.gid = gid
+        self.request_id = request_id
+        self.prompt = prompt
+        self.kw = kw
+        self.replica = replica            # None = placement in flight
+        self.rid = rid
+        self.tokens = []                  # full de-duplicated history:
+        self.skip = 0                     # replayed prefix to drop
+        self.done = False                 # every harvested token lands
+        self.state = "running"            # here exactly once, so N
+        self.resubmits = 0                # concurrent readers can each
+        self.t_submit = t_submit          # stream from their own cursor
+        self.orphaned = False
+        self.failed = None                # placement exception, if any
+        self.dup_returns = 0              # idempotent-retry handouts
+
+
+class Router:
+    """See the module docstring. All waits are the caller's: submit and
+    harvest are single bounded calls; health checking is explicit
+    (``check_health``), so a virtual-clock bench or a deterministic test
+    can drive the whole failure path without sleeping.
+
+    Thread-safety: the gateway drives this from multiple thread-pool
+    executor threads (one per in-flight HTTP request) plus the health
+    loop. ONE reentrant lock guards all router state (gid allocation,
+    the assignment table, the ring, the dead set, snapshots) — but
+    replica I/O (submit/harvest/snapshot/probe over a lock or rpc) is
+    ALWAYS performed outside it, so a frozen replica stalls only the
+    calls touching it, never the whole front-end. Races with failover
+    are resolved by re-checking the assignment's (replica, rid) epoch
+    after the I/O: a harvest that lost the race discards its batch
+    (the replacement replays those tokens), and each harvested token
+    lands in the assignment's history exactly once."""
+
+    def __init__(self, replicas, policy=None, spill_depth=None,
+                 hb_dead_s=None, snap_max_age_s=None, clock=None):
+        self.replicas = {r.name: r for r in replicas}
+        if len(self.replicas) != len(replicas):
+            raise ValueError("replica names must be unique")
+        self.policy = policy or os.environ.get("PADDLE_ROUTER_POLICY",
+                                               "prefix_affinity")
+        if self.policy not in POLICIES:
+            raise ValueError(f"unknown router policy {self.policy!r} "
+                             f"(choose from {POLICIES})")
+        self.spill_depth = int(
+            spill_depth if spill_depth is not None
+            else os.environ.get("PADDLE_ROUTER_SPILL_DEPTH", "4"))
+        self.hb_dead_s = float(
+            hb_dead_s if hb_dead_s is not None
+            else os.environ.get("PADDLE_GATEWAY_HB_DEAD_S", "2.0"))
+        self.snap_max_age_s = float(
+            snap_max_age_s if snap_max_age_s is not None
+            else os.environ.get("PADDLE_ROUTER_SNAP_AGE_S", "0.25"))
+        self.clock = clock or time.monotonic
+        self._lock = threading.RLock()
+        self.ring = HashRing()
+        for name in sorted(self.replicas):
+            self.ring.add(name)
+        self.dead = set()
+        self._snaps = {}                  # name -> (snapshot, t)
+        self._rr = 0                      # round-robin cursor
+        self._gid = 0
+        self._table = {}                  # gid -> _Assignment
+        self._by_request_id = {}          # idempotency key -> gid
+        self.submits_total = 0
+        self.failovers_total = 0
+        self.version_mismatches = 0
+        self._prefill_cap = None
+
+    # -------------------------------------------------------- snapshots
+    def alive_names(self):
+        return [n for n in sorted(self.replicas) if n not in self.dead]
+
+    def refresh(self, force=False):
+        """Pull each alive replica's telemetry snapshot (the routing
+        payload), at most once per ``snap_max_age_s`` unless forced. A
+        replica that errors here is NOT declared dead — one flaky
+        snapshot must not drain a healthy replica; its stale snapshot
+        is dropped (it scores worst until it answers again) and the
+        death verdict stays with check_health's heartbeat + liveness
+        probe (and with actual failed submits/harvests).
+
+        Deliberately NOT @_locked around the replica I/O: when the
+        health loop refreshes, a frozen rpc worker must stall only ITS
+        snapshot call, never every submit/harvest waiting on the
+        router lock. (A submit-path refresh still runs under the
+        caller's RLock frame — the short rpc snapshot timeout bounds
+        that case.)"""
+        now = self.clock()
+        with self._lock:
+            todo = []
+            for name in self.alive_names():
+                got = self._snaps.get(name)
+                if force or got is None \
+                        or now - got[1] > self.snap_max_age_s:
+                    todo.append(name)
+        fetched = {}
+        for name in todo:
+            try:
+                fetched[name] = self.replicas[name].snapshot()
+            except ReplicaError:
+                fetched[name] = None
+        with self._lock:
+            for name, snap in fetched.items():
+                if name in self.dead:
+                    continue
+                if snap is None:
+                    self._snaps.pop(name, None)
+                elif snap.get("schema_version") != \
+                        SNAPSHOT_SCHEMA_VERSION:
+                    # unknown payload: refuse to score it (drop any
+                    # stale cached one too) rather than misread it
+                    self.version_mismatches += 1
+                    self._snaps.pop(name, None)
+                else:
+                    self._snaps[name] = (snap, now)
+                    self._prefill_cap = snap["prefill_cap"]
+
+    def _snap(self, name):
+        got = self._snaps.get(name)
+        return got[0] if got else None
+
+    @staticmethod
+    def load_score(snap):
+        """queue pressure + slot pressure + pool pressure, one number.
+        Missing snapshot scores worst — never prefer a replica you know
+        nothing about over one you do."""
+        if snap is None:
+            return float("inf")
+        busy = snap["num_slots"] - snap["slots_free"]
+        score = snap["queue_depth"] + busy
+        kv = snap.get("kv_blocks")
+        if kv and kv["kv_blocks_total"]:
+            score += snap["num_slots"] * (kv["kv_blocks_used"]
+                                          / kv["kv_blocks_total"])
+        return score
+
+    # -------------------------------------------------------- placement
+    def _least_loaded(self, names):
+        return min(names, key=lambda n: (self.load_score(self._snap(n)),
+                                         n))
+
+    def prefix_key(self, prompt):
+        """The affinity key: the first ``prefill_cap``-aligned prompt
+        block (bytes), or None when the prompt is shorter than one
+        block (nothing shareable to be affine about)."""
+        cap = self._prefill_cap
+        if cap is None or len(prompt) < cap:
+            return None
+        return ",".join(str(int(t)) for t in prompt[:cap]).encode()
+
+    def _choose(self, prompt, names):
+        if self.policy == "round_robin":
+            self._rr += 1
+            return names[self._rr % len(names)]
+        if self.policy == "least_loaded":
+            return self._least_loaded(names)
+        key = self.prefix_key(prompt)
+        if key is None:
+            return self._least_loaded(names)
+        owner = self.ring.owner(key)
+        if owner not in names:
+            return self._least_loaded(names)
+        snap = self._snap(owner)
+        if snap is not None and snap["queue_depth"] >= self.spill_depth:
+            # saturation spill: the hot replica keeps its cache, the
+            # overflow goes wherever there is headroom
+            return self._least_loaded(names)
+        return owner
+
+    # ------------------------------------------------------- submit path
+    def submit(self, prompt, request_id=None, **kw):
+        """Route one request; returns the gateway-global id (gid).
+        Idempotent on ``request_id``: a repeat — concurrent or later,
+        while the original assignment is live — returns the existing
+        gid without re-running anything (the gid is RESERVED before
+        the placement I/O, so two simultaneous retries cannot race
+        into two engine submissions). AdmissionFull propagates only
+        when EVERY alive replica sheds (honest cluster-wide
+        backpressure); a replica that dies mid-submit is failed over
+        transparently."""
+        prompt = [int(t) for t in prompt]
+        with self._lock:
+            if request_id is not None \
+                    and request_id in self._by_request_id:
+                gid = self._by_request_id[request_id]
+                got = self._table.get(gid)
+                if got is not None:
+                    got.dup_returns += 1
+                return gid
+            self._gid += 1
+            gid = f"req-{self._gid}"
+            asg = _Assignment(gid, request_id, prompt, kw, None, None,
+                              self.clock())
+            self._table[gid] = asg
+            if request_id is not None:
+                self._by_request_id[request_id] = gid
+            self.submits_total += 1
+        self.refresh()
+        try:
+            name, rid = self._place(prompt, kw)
+        except Exception as e:
+            with self._lock:
+                # unwind the reservation — unless a concurrent
+                # idempotent retry already took this gid home, in
+                # which case the entry stays and carries the failure
+                # (its harvest re-raises e, so 429 stays 429 instead
+                # of decaying into a 404 for the duplicate; the
+                # duplicate's release drops the entry)
+                if request_id is not None:
+                    self._by_request_id.pop(request_id, None)
+                if asg.dup_returns:
+                    asg.failed = e
+                else:
+                    self._table.pop(gid, None)
+            raise
+        with self._lock:
+            asg.replica, asg.rid = name, rid
+            # the chosen replica may have been declared dead between
+            # our successful engine submit and this bookkeeping write
+            # — mark_dead's drain skipped the still-placement-pending
+            # assignment, so the failover is OURS to run
+            raced_death = name in self.dead and not asg.done
+            if raced_death:
+                asg.replica, asg.rid = None, None
+        if raced_death:
+            self._failover_one(asg)
+        return gid
+
+    def _place(self, prompt, kw, exclude=()):
+        """One placement attempt over the alive set: policy choice
+        first, then the remaining candidates by load on AdmissionFull
+        (spill), marking dead anything that errors. The replica submit
+        itself runs OUTSIDE the router lock (a frozen replica must not
+        stall unrelated requests). Raises the LAST AdmissionFull when
+        everyone sheds."""
+        last_full = None
+        tried = set(exclude)
+        while True:
+            with self._lock:
+                names = [n for n in self.alive_names()
+                         if n not in tried]
+                name = self._choose(prompt, names) if names else None
+            if name is None:
+                if last_full is not None:
+                    raise last_full
+                raise NoReplicaError("no alive replica to place on")
+            tried.add(name)
+            try:
+                return name, self.replicas[name].submit(prompt, **kw)
+            except AdmissionFull as e:
+                last_full = e
+            except ReplicaError:
+                self.mark_dead(name)
+
+    # ------------------------------------------------------ harvest path
+    def harvest(self, gid, cursor=None):
+        """Incremental harvest for one gateway request: ``(new_tokens,
+        done, state)``. Every harvested token lands in the
+        assignment's history exactly once; ``cursor=None`` returns the
+        tokens appended since the last cursorless call (single-reader
+        delta semantics), an explicit integer cursor returns
+        ``history[cursor:]`` so concurrent readers of one gid (an
+        idempotent client retry) each see the complete stream. A
+        replica death here triggers the failover re-submit and returns
+        an empty batch (the stream stalls one poll interval, never
+        errors); the replayed prefix is skipped so the history gets
+        each token once. KeyError for an unknown/released gid."""
+        with self._lock:
+            asg = self._table[gid]
+            base = len(asg.tokens) if cursor is None else int(cursor)
+            if asg.failed is not None:
+                raise asg.failed          # duplicate of a shed submit:
+            if asg.done:                  # 429 stays 429, never a 404
+                return list(asg.tokens[base:]), True, asg.state
+            if asg.orphaned:
+                raise NoReplicaError(
+                    f"{gid}: no alive replica to fail over to")
+            epoch = (asg.replica, asg.rid)
+            rep = (None if asg.replica is None
+                   else self.replicas[asg.replica])
+            if rep is None:               # failover placement in flight
+                return list(asg.tokens[base:]), False, "running"
+        try:
+            new, done, state = rep.harvest(epoch[1])
+        except ReplicaError:
+            self.mark_dead(epoch[0])
+            with self._lock:
+                # mark_dead no-ops when the replica was ALREADY dead
+                # (e.g. it died between a submit placing here and the
+                # bookkeeping write) — if the assignment still points
+                # at the corpse, the failover is ours to run
+                stuck = (not asg.done and not asg.orphaned
+                         and (asg.replica, asg.rid) == epoch)
+                if stuck:
+                    asg.replica, asg.rid = None, None
+            if stuck:
+                self._failover_one(asg)
+            with self._lock:
+                return list(asg.tokens[base:]), False, "running"
+        with self._lock:
+            if (asg.replica, asg.rid) != epoch:
+                # failover raced this harvest: DISCARD the batch — the
+                # replacement replays it (skip was set against the
+                # history length, which this batch never joined)
+                return list(asg.tokens[base:]), False, "running"
+            if asg.skip:
+                drop = min(asg.skip, len(new))
+                asg.skip -= drop
+                new = new[drop:]
+            asg.tokens.extend(new)
+            if done:
+                asg.done, asg.state = True, state
+            return list(asg.tokens[base:]), done, state
+
+    @_locked
+    def poll(self, gid):
+        asg = self._table.get(gid)
+        if asg is None:
+            return None
+        return {"gid": gid, "replica": asg.replica, "done": asg.done,
+                "state": asg.state, "delivered": len(asg.tokens),
+                "resubmits": asg.resubmits}
+
+    def release(self, gid):
+        """Forget a finished/abandoned request (client disconnect).
+        NOTE: with concurrent readers of one gid (idempotent retry),
+        the first release drops the assignment for all of them — the
+        gateway maps the survivors' KeyError to 404."""
+        with self._lock:
+            asg = self._table.pop(gid, None)
+            if asg is None:
+                return
+            if asg.request_id is not None:
+                self._by_request_id.pop(asg.request_id, None)
+            rep = None
+            if not asg.done and not asg.orphaned \
+                    and asg.replica is not None:
+                rep = self.replicas.get(asg.replica)
+        if rep is not None:
+            rep.release(asg.rid)
+
+    # ----------------------------------------------------------- health
+    def check_health(self):
+        """Heartbeat sweep: a replica whose heartbeat age passed
+        ``hb_dead_s`` gets ONE bounded liveness probe (outside the
+        router lock); failure = dead = drain + re-route. Returns the
+        names newly marked dead."""
+        with self._lock:
+            suspects = [n for n in self.alive_names()
+                        if self.replicas[n].heartbeat_age()
+                        > self.hb_dead_s]
+        died = []
+        for name in suspects:
+            if self.replicas[name].alive:  # probe refreshes the beat
+                continue
+            self.mark_dead(name)
+            died.append(name)
+        return died
+
+    def mark_dead(self, name):
+        """Death IS drain: remove from the ring (only its keys move),
+        then re-submit every unfinished assignment it held — idempotent
+        per assignment (each is re-placed exactly once per death), with
+        the delivered-history length remembered so the replayed greedy
+        prefix is skipped, not double-streamed. Re-placement I/O runs
+        outside the lock; until it lands the assignment's replica is
+        None and harvests return empty batches. A deadline_s request
+        fails over with its REMAINING budget (measured from the
+        original submit) — an already-expired one goes straight to the
+        expired state instead of restarting its clock."""
+        with self._lock:
+            if name in self.dead:
+                return
+            self.dead.add(name)
+            self.ring.remove(name)
+            self._snaps.pop(name, None)
+            victims = [asg for asg in self._table.values()
+                       if asg.replica == name and not asg.done
+                       and not asg.orphaned]
+            for asg in victims:
+                asg.replica, asg.rid = None, None
+        for asg in victims:
+            self._failover_one(asg)
+
+    def _failover_one(self, asg):
+        """Re-place ONE assignment whose replica is gone (the caller
+        already nulled its replica/rid under the lock). Deadline
+        requests fail over with their REMAINING budget; a released-
+        while-draining assignment (client disconnect racing the drain)
+        gets its stray replacement submission released instead of
+        leaking a tracked engine record forever."""
+        kw = dict(asg.kw)
+        if kw.get("deadline_s") is not None:
+            remaining = kw["deadline_s"] - (self.clock()
+                                            - asg.t_submit)
+            if remaining <= 0:
+                with self._lock:
+                    asg.done, asg.state = True, "expired"
+                return
+            kw["deadline_s"] = remaining
+        try:
+            new_name, rid = self._place(asg.prompt, kw)
+        except (AdmissionFull, NoReplicaError):
+            # nowhere to go RIGHT NOW: orphan it honestly; the
+            # gateway surfaces 503/429 instead of hanging
+            with self._lock:
+                asg.orphaned = True
+                asg.state = "orphaned"
+            return
+        with self._lock:
+            if asg.gid in self._table and not asg.done:
+                asg.skip = len(asg.tokens)
+                asg.replica, asg.rid = new_name, rid
+                asg.resubmits += 1
+                self.failovers_total += 1
+                stray = None
+            else:                         # released/finished meanwhile
+                stray = self.replicas.get(new_name)
+        if stray is not None:
+            stray.release(rid)
+
+    # ------------------------------------------------------- aggregation
+    def metrics_prometheus(self):
+        """Cluster exposition: each alive replica's engine exposition
+        with a ``replica`` label injected on every sample, plus the
+        router's own gauges (replica I/O outside the lock). One scrape
+        shows the whole cluster."""
+        with self._lock:
+            names = self.alive_names()
+        lines = []
+        seen_meta = set()
+        for name in names:
+            try:
+                text = self.replicas[name].metrics_prometheus()
+            except ReplicaError:
+                self.mark_dead(name)
+                continue
+            for ln in _relabel(text, name):
+                if ln.startswith("#"):
+                    # ONE HELP/TYPE line per family across the whole
+                    # cluster: Prometheus rejects a second HELP line
+                    # for the same metric name, so duplicates from
+                    # replica 2..N are dropped here
+                    parts = ln.split(None, 3)
+                    key = tuple(parts[:3])
+                    if key in seen_meta:
+                        continue
+                    seen_meta.add(key)
+                lines.append(ln)
+        with self._lock:
+            gauges = (
+                ("paddle_gateway_replicas_alive", "gauge",
+                 len(self.alive_names()), "replicas currently routable"),
+                ("paddle_gateway_replicas_total", "gauge",
+                 len(self.replicas), "replicas configured"),
+                ("paddle_gateway_requests_routed_total", "counter",
+                 self.submits_total, "requests placed by the router"),
+                ("paddle_gateway_failovers_total", "counter",
+                 self.failovers_total,
+                 "in-flight re-submissions after a replica death"),
+                ("paddle_gateway_snapshot_version_mismatches_total",
+                 "counter", self.version_mismatches,
+                 "snapshots refused for schema_version drift"))
+        for gname, typ, val, help_ in gauges:
+            lines.append(f"# HELP {gname} {help_}")
+            lines.append(f"# TYPE {gname} {typ}")
+            lines.append(f"{gname} {val}")
+        return "\n".join(lines) + "\n"
+
+
+def _relabel(text, replica):
+    """Inject ``replica="name"`` into every sample line of one
+    replica's Prometheus exposition; HELP/TYPE comments pass through
+    (the caller de-duplicates them across replicas — Prometheus rejects
+    a repeated HELP line for one family)."""
+    out = []
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            out.append(ln)
+            continue
+        name_part, _, value = ln.rpartition(" ")
+        if "{" in name_part:
+            fam, rest = name_part.split("{", 1)
+            out.append(f'{fam}{{replica="{replica}",{rest} {value}')
+        else:
+            out.append(f'{name_part}{{replica="{replica}"}} {value}')
+    return out

@@ -28,6 +28,23 @@ FR_ENV_VARS = (
     "PADDLE_FLIGHT_RECORDER",   # ring size; 0 = disabled; unset = auto
 )
 
+# Cluster-gateway configuration (serving_cluster/) — same registry
+# discipline: a leaked router policy / heartbeat threshold silently
+# changes placement and failover behavior in every later cluster test,
+# so only tests/test_serving_cluster.py may run with these set (and it
+# uses monkeypatch / constructor args, not the process env).
+GW_ENV_VARS = (
+    "PADDLE_GATEWAY_HB_DEAD_S",    # heartbeat age -> replica dead
+    "PADDLE_GATEWAY_HB_S",         # gateway health-sweep interval
+    "PADDLE_GATEWAY_HB_TIMEOUT_S",  # rpc replica liveness-probe timeout
+    "PADDLE_GATEWAY_POLL_S",       # SSE harvest poll interval
+    "PADDLE_GATEWAY_PORT",         # gateway listen port (0 = ephemeral)
+    "PADDLE_GATEWAY_REPLICAS",     # demo-cluster replica count
+    "PADDLE_ROUTER_POLICY",        # prefix_affinity|least_loaded|round_robin
+    "PADDLE_ROUTER_SNAP_AGE_S",    # snapshot staleness bound
+    "PADDLE_ROUTER_SPILL_DEPTH",   # owner queue depth -> affinity spill
+)
+
 
 def fi_env_active() -> list:
     """The PADDLE_FI_* vars currently set (empty list = harness disarmed)."""
@@ -39,7 +56,12 @@ def fr_env_active() -> list:
     return [v for v in FR_ENV_VARS if os.environ.get(v) not in (None, "")]
 
 
+def gw_env_active() -> list:
+    """The gateway/router env vars currently set (empty = default)."""
+    return [v for v in GW_ENV_VARS if os.environ.get(v) not in (None, "")]
+
+
 from . import fault  # noqa: E402  (re-export the harness)
 
-__all__ = ["FI_ENV_VARS", "FR_ENV_VARS", "fi_env_active",
-           "fr_env_active", "fault"]
+__all__ = ["FI_ENV_VARS", "FR_ENV_VARS", "GW_ENV_VARS", "fi_env_active",
+           "fr_env_active", "gw_env_active", "fault"]

@@ -32,7 +32,8 @@ def _no_fault_injection_leak(request):
     from the test that set it. FT tests pass the PADDLE_FI_* vars to
     their SUBPROCESS env only; the pytest process itself must stay clean
     everywhere except tests/test_fault_tolerance.py."""
-    from paddle_tpu.testing import fi_env_active, fr_env_active
+    from paddle_tpu.testing import (fi_env_active, fr_env_active,
+                                    gw_env_active)
     fspath = str(request.node.fspath)
     exempt = ("test_fault_tolerance" in fspath
               or "test_flight_recorder" in fspath)
@@ -52,6 +53,17 @@ def _no_fault_injection_leak(request):
             f"flight-recorder env leaked into an unrelated test: "
             f"{leaked_fr} (unset PADDLE_FLIGHT_*, or pass it to the "
             "companion subprocess env instead of the pytest process)",
+            pytrace=False)
+    # gateway/router config leaks (serving_cluster): a leaked policy or
+    # heartbeat threshold silently changes placement and failover in
+    # every later cluster test — only the cluster suite may set these,
+    # and it does so via monkeypatch or constructor args
+    leaked_gw = gw_env_active()
+    if leaked_gw and "test_serving_cluster" not in fspath:
+        pytest.fail(
+            f"gateway env leaked into an unrelated test: {leaked_gw} "
+            "(unset PADDLE_GATEWAY_*/PADDLE_ROUTER_*, or pass them via "
+            "monkeypatch / constructor args inside the cluster suite)",
             pytrace=False)
     yield
 

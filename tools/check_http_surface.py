@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""HTTP wire-surface coverage check (runnable standalone AND as a
+tier-1 test via tests/test_serving_cluster.py).
+
+Boots a REAL gateway (asyncio HTTP server, in-process LocalReplicas)
+and asserts every endpoint's response field set and every error-code
+mapping against ``serving_cluster/protocol.py`` — over actual sockets,
+not by inspecting handler code. The OpenAI-compat surface then cannot
+drift silently: renaming a response field, dropping the SSE
+terminator, or remapping an error status fails tier-1, the same
+discipline ``check_metrics_surface.py`` applies to the Prometheus
+surface.
+
+Pinned end-to-end:
+  * POST /v1/completions — COMPLETION_FIELDS / CHOICE_FIELDS /
+    USAGE_FIELDS exactly; SSE chunks carry STREAM_CHUNK_FIELDS and the
+    stream ends with ``data: [DONE]``.
+  * GET /v1/models, /healthz — field sets; /metrics — text exposition
+    with per-replica labels + gateway gauges.
+  * Error mapping (ERROR_STATUS rows, each triggered for real):
+    bad_request→400, unknown_model→404, not_found→404,
+    deadline_exceeded→504, admission_full→429 (+ Retry-After),
+    no_replica→503. ``internal``(500) is the only untriggered row —
+    reaching it requires a bug by definition.
+
+Usage: python tools/check_http_surface.py   (exit 0 = surface pinned)
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_engine(num_slots=2, **kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+
+    V, E, H, FF, L = 67, 32, 4, 64, 1
+    paddle.seed(11)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return ServingEngine(fmt, embed, head, num_slots=num_slots,
+                         max_seq_len=64, prefill_cap=4, **kw)
+
+
+def _req(port, method, path, body=None, timeout=60):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    c.request(method, path,
+              body=None if body is None else json.dumps(body),
+              headers={"Content-Type": "application/json"})
+    r = c.getresponse()
+    data = r.read()
+    c.close()
+    return r.status, {k.lower(): v for k, v in r.getheaders()}, data
+
+
+def _sse(port, body, timeout=120):
+    """Raw-socket SSE read: returns (status_line+headers, data lines)."""
+    payload = json.dumps(body).encode()
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    s.sendall(b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: %d\r\n\r\n%s" % (len(payload), payload))
+    buf = b""
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = [ln.strip()[6:] for ln in rest.split(b"\n")
+             if ln.strip().startswith(b"data: ")]
+    return head.decode("latin-1"), lines
+
+
+def main(argv=None):
+    import numpy as np
+
+    from paddle_tpu.inference.serving import AdmissionFull
+    from paddle_tpu.serving_cluster import (Gateway, LocalReplica,
+                                            Router)
+    from paddle_tpu.serving_cluster import protocol as P
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    rng = np.random.RandomState(5)
+    prompt = [int(t) for t in rng.randint(1, 67, (6,))]
+
+    # ---------------- cluster A: happy path + 400/404/504 ------------
+    reps = [LocalReplica(f"replica{i}", _build_engine())
+            for i in range(2)]
+    router = Router(reps, policy="prefix_affinity")
+    gw = Gateway(router, model_id="paddle_tpu", port=0,
+                 hb_s=0.1).start_background()
+    try:
+        st, hd, data = _req(gw.port, "POST", "/v1/completions",
+                            {"prompt": prompt, "max_tokens": 4,
+                             "stop_token_id": 2})
+        obj = json.loads(data)
+        check(st == 200, f"completions status {st}")
+        check(set(obj) == set(P.COMPLETION_FIELDS),
+              f"completion fields {sorted(obj)} != "
+              f"{sorted(P.COMPLETION_FIELDS)}")
+        ch = obj.get("choices", [{}])[0]
+        check(set(ch) == set(P.CHOICE_FIELDS),
+              f"choice fields {sorted(ch)} != {sorted(P.CHOICE_FIELDS)}")
+        check(set(obj.get("usage", {})) == set(P.USAGE_FIELDS),
+              f"usage fields {sorted(obj.get('usage', {}))}")
+        check(ch.get("finish_reason") in ("stop", "length"),
+              f"finish_reason {ch.get('finish_reason')!r}")
+        check(ch.get("text") == " ".join(str(t) for t in ch["tokens"]),
+              "text is not the space-joined token ids")
+
+        head, lines = _sse(gw.port, {"prompt": prompt, "max_tokens": 4,
+                                     "stream": True})
+        check("200 OK" in head and "text/event-stream" in head,
+              f"SSE head {head!r}")
+        check(lines and lines[-1] == b"[DONE]",
+              "SSE stream does not end with data: [DONE]")
+        for ln in lines[:-1]:
+            chunk = json.loads(ln)
+            check(set(chunk) == set(P.STREAM_CHUNK_FIELDS),
+                  f"stream chunk fields {sorted(chunk)}")
+            cch = chunk["choices"][0]
+            check(set(cch) == set(P.CHOICE_FIELDS),
+                  f"stream choice fields {sorted(cch)}")
+        reasons = [json.loads(ln)["choices"][0]["finish_reason"]
+                   for ln in lines[:-1]]
+        check(reasons[-1] in ("stop", "length") and
+              all(r is None for r in reasons[:-1]),
+              f"finish_reason placement {reasons}")
+
+        st, _, data = _req(gw.port, "GET", "/v1/models")
+        obj = json.loads(data)
+        check(st == 200 and set(obj) == set(P.MODELS_FIELDS),
+              f"/v1/models {st} fields {sorted(obj)}")
+        entry = obj.get("data", [{}])[0]
+        check(set(entry) == set(P.MODEL_ENTRY_FIELDS),
+              f"model entry fields {sorted(entry)}")
+
+        st, _, data = _req(gw.port, "GET", "/healthz")
+        obj = json.loads(data)
+        check(st == 200 and set(obj) == set(P.HEALTHZ_FIELDS),
+              f"/healthz {st} fields {sorted(obj)}")
+        check(obj.get("status") == "ok", f"healthz status {obj}")
+
+        st, hd, data = _req(gw.port, "GET", "/metrics")
+        check(st == 200 and hd.get("content-type", "").startswith(
+            "text/plain"), f"/metrics {st} {hd.get('content-type')}")
+        text = data.decode()
+        check('replica="replica0"' in text
+              and 'replica="replica1"' in text,
+              "/metrics lacks per-replica labels")
+        check("paddle_gateway_replicas_alive" in text
+              and "paddle_gateway_failovers_total" in text,
+              "/metrics lacks gateway gauges")
+
+        # ---- error rows, each triggered for real ----
+        seen = {}
+
+        def err(st, data, hd=None):
+            obj = json.loads(data)
+            check(set(obj) == {"error"} and
+                  set(obj["error"]) == set(P.ERROR_BODY_FIELDS),
+                  f"error envelope {obj}")
+            code = obj["error"]["code"]
+            check(P.ERROR_STATUS.get(code) == st,
+                  f"code {code!r} arrived with status {st} != "
+                  f"{P.ERROR_STATUS.get(code)}")
+            seen[code] = st
+            return obj
+
+        err(*_req(gw.port, "POST", "/v1/completions",
+                  {"prompt": "not token ids"})[::2])
+        # engine-side validation is ALSO bad_request, not 500: prompt +
+        # max_tokens exceeds the replicas' ring capacity (max_seq_len
+        # rounds up to Smax=128, so 120 + 20 violates it)
+        err(*_req(gw.port, "POST", "/v1/completions",
+                  {"prompt": list(range(1, 121)), "max_tokens": 20})[::2])
+        # an explicit JSON null takes the default, never a None that
+        # reaches the engine's integer comparisons
+        st, _, data = _req(gw.port, "POST", "/v1/completions",
+                           {"prompt": prompt, "max_tokens": None})
+        check(st == 200 and len(json.loads(data)["choices"][0]["tokens"])
+              == 16, f"max_tokens:null did not default to 16 ({st})")
+        err(*_req(gw.port, "POST", "/v1/completions",
+                  {"model": "gpt-4", "prompt": prompt})[::2])
+        err(*_req(gw.port, "GET", "/v1/nope")[::2])
+        err(*_req(gw.port, "POST", "/v1/completions",
+                  {"prompt": prompt, "max_tokens": 4,
+                   "deadline_s": 0})[::2])
+    finally:
+        gw.stop()
+        for r in reps:
+            r.close()
+
+    # ---------------- cluster B: 429 backpressure + 503 death --------
+    # threaded=False: nothing drains the engine, so the saturation below
+    # cannot race the HTTP round-trip — the 429 is deterministic
+    tiny = LocalReplica("tiny", _build_engine(num_slots=1,
+                                              max_pending=1),
+                        threaded=False)
+    router_b = Router([tiny], policy="least_loaded")
+    gw_b = Gateway(router_b, port=0, hb_s=0.05).start_background()
+    try:
+        # saturate the only replica: slot + the 1-deep pending queue
+        long_prompt = np.asarray(prompt * 4, np.int32)
+        for _ in range(4):
+            try:
+                tiny.submit(long_prompt, max_new_tokens=40)
+            except AdmissionFull:
+                break
+        st, hd, data = _req(gw_b.port, "POST", "/v1/completions",
+                            {"prompt": prompt, "max_tokens": 2})
+        obj = json.loads(data)
+        check(st == 429 and obj["error"]["code"] == "admission_full",
+              f"backpressure {st} {data[:120]!r}")
+        check(hd.get("retry-after") == str(P.RETRY_AFTER_S),
+              f"429 lacks Retry-After: {hd}")
+        seen["admission_full"] = st
+
+        tiny.kill()
+        deadline = time.monotonic() + 10
+        while router_b.alive_names() and time.monotonic() < deadline:
+            time.sleep(0.05)              # the gateway health loop
+        check(not router_b.alive_names(),
+              "health loop never marked the killed replica dead")
+        st, _, data = _req(gw_b.port, "POST", "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 2})
+        obj = json.loads(data)
+        check(st == 503 and obj["error"]["code"] == "no_replica",
+              f"dead cluster {st} {data[:120]!r}")
+        seen["no_replica"] = st
+        st, _, data = _req(gw_b.port, "GET", "/healthz")
+        check(st == 503 and json.loads(data)["status"] == "down",
+              f"dead healthz {st} {data!r}")
+    finally:
+        gw_b.stop()
+        tiny.close()
+
+    # every mapped error code except `internal` must have been
+    # triggered over the wire (internal == a bug path by definition)
+    want = set(P.ERROR_STATUS) - {"internal"}
+    check(set(seen) == want,
+          f"error rows exercised {sorted(seen)} != {sorted(want)}")
+
+    if failures:
+        print("check_http_surface: FAILED")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print(f"check_http_surface: ok ({len(P.ENDPOINTS)} endpoints, "
+          f"{len(seen)} error rows pinned over live HTTP)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO_ROOT)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(main())

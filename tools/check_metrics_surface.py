@@ -21,6 +21,12 @@ This makes the PR 4 bug class (a new counter silently skipping
 reset_metrics) STRUCTURAL: adding a metrics key without wiring all
 three surfaces fails tier-1.
 
+Additionally pinned here: the ``telemetry_snapshot()`` SCHEMA — the
+cluster router's wire payload (``SNAPSHOT_REQUIRED_KEYS`` /
+``SNAPSHOT_OPTIONAL_KEYS`` / ``SNAPSHOT_SCHEMA_VERSION`` in
+telemetry.py). Key drift without a version bump fails tier-1, because
+the router scores replicas off this payload over rpc.
+
 Usage: python tools/check_metrics_surface.py   (exit 0 = covered)
 """
 from __future__ import annotations
@@ -125,7 +131,15 @@ def main(argv=None):
                 f"metrics key {k!r} maps to {name!r} ({typ}) but the "
                 "exposition does not contain it")
 
-    # ---- 4. distributed-runtime registry coverage: every op kind the
+    # ---- 4. telemetry_snapshot() schema coverage: the snapshot is the
+    # cluster router's WIRE payload (serving_cluster/router.py scores
+    # replicas off it over rpc), so its key set is pinned structurally:
+    # required keys all present, nothing outside required+optional, a
+    # version stamp the router refuses to misread, and the whole thing
+    # JSON-serializable (it crosses process boundaries)
+    _check_snapshot_schema(failures, eng)
+
+    # ---- 5. distributed-runtime registry coverage: every op kind the
     # flight recorder instruments must surface its wait-time histogram
     # under a stable name in runtime_prometheus() (and in the registry
     # snapshot flight dumps embed) once an event completes — a renamed
@@ -139,9 +153,46 @@ def main(argv=None):
         return 1
     print(f"check_metrics_surface: ok ({len(keys)} metrics keys covered "
           "by reset_metrics + conftest reconciliation + Prometheus "
-          f"exposition; {n_ops} flight-recorder op histograms in the "
+          "exposition; snapshot schema pinned; "
+          f"{n_ops} flight-recorder op histograms in the "
           "runtime registry)")
     return 0
+
+
+def _check_snapshot_schema(failures, eng):
+    import json
+
+    from paddle_tpu.inference.telemetry import (SNAPSHOT_OPTIONAL_KEYS,
+                                                SNAPSHOT_REQUIRED_KEYS,
+                                                SNAPSHOT_SCHEMA_VERSION)
+    snap = eng.telemetry_snapshot()
+    if snap.get("schema_version") != SNAPSHOT_SCHEMA_VERSION:
+        failures.append(
+            f"telemetry_snapshot()['schema_version'] = "
+            f"{snap.get('schema_version')!r} != pinned "
+            f"{SNAPSHOT_SCHEMA_VERSION} — the router keys its trust on "
+            "this stamp")
+    missing = SNAPSHOT_REQUIRED_KEYS - set(snap)
+    if missing:
+        failures.append(
+            f"telemetry_snapshot() lost required keys {sorted(missing)} "
+            "(update telemetry.SNAPSHOT_REQUIRED_KEYS AND bump "
+            "SNAPSHOT_SCHEMA_VERSION if this is intentional)")
+    extra = set(snap) - SNAPSHOT_REQUIRED_KEYS - SNAPSHOT_OPTIONAL_KEYS
+    if extra:
+        failures.append(
+            f"telemetry_snapshot() grew unpinned keys {sorted(extra)} "
+            "— add them to SNAPSHOT_REQUIRED_KEYS or "
+            "SNAPSHOT_OPTIONAL_KEYS and bump SNAPSHOT_SCHEMA_VERSION")
+    if "kv_blocks" not in snap:
+        failures.append(
+            "the paged default engine's snapshot lost 'kv_blocks' — "
+            "the router's pool-headroom signal")
+    try:
+        json.dumps(snap)
+    except (TypeError, ValueError) as e:
+        failures.append(f"telemetry_snapshot() is not JSON-serializable:"
+                        f" {e} — it is a wire payload")
 
 
 def _check_runtime_registry(failures):
