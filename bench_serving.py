@@ -75,7 +75,14 @@ against the no-kill run. Its knobs: BENCH_CLUSTER_REPLICAS (3),
 BENCH_CLUSTER_KILL_AT (submission index triggering the kill, default
 half the workload), BENCH_CLUSTER_SPILL_DEPTH (default 4 x slots — the
 interactive default of 4 turns affinity into least-loaded under a
-sustained backlog).
+sustained backlog). The kill run additionally exports the MERGED
+cluster Perfetto trace (serving_cluster.export_cluster_trace) and
+FAILS unless it validates with the failed-over request joined across
+two replicas at attempts 1 and 2 (BENCH_CLUSTER_TRACE_PATH keeps the
+artifact), and records an "slo" goodput block — per-replica
+ok/violated_queue/violated_service verdicts + queue/service
+percentiles against the BENCH_SLO_TTFT_S/ITL_S/E2E_S objectives
+(unset = no objectives; the accounting still reconciles).
 
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
@@ -1333,6 +1340,63 @@ def _drive_cluster(router, reps, clock, reqs, arrivals, kill_at=None):
     return recs, kill
 
 
+def _cluster_trace_block(router):
+    """Export + validate the MERGED cluster Perfetto trace for the kill
+    run (the observability acceptance gate, same discipline as the
+    classic mode's chrome-trace validity check): the trace must parse,
+    and the killed request's failover must appear as the SAME trace id
+    with spans on two replicas at attempts 1 and 2.
+    ``BENCH_CLUSTER_TRACE_PATH`` persists the artifact (default: temp
+    file, deleted after validation)."""
+    import tempfile
+
+    from paddle_tpu.inference.telemetry import validate_chrome_trace
+    from paddle_tpu.serving_cluster import export_cluster_trace
+
+    keep = os.environ.get("BENCH_CLUSTER_TRACE_PATH")
+    if keep:
+        path = keep
+    else:
+        fd, path = tempfile.mkstemp(suffix=".json",
+                                    prefix="bench_cluster_trace_")
+        os.close(fd)
+    out = {"valid": False, "events": 0, "failover_trace_ids": 0,
+           "path": keep or None}
+    try:
+        export_cluster_trace(router, path)
+        doc = validate_chrome_trace(path)   # raises on bad structure
+        evs = doc["traceEvents"]
+        out["events"] = len(evs)
+        by_trace = {}
+        for e in evs:
+            args = e.get("args") or {}
+            tid = args.get("trace_id")
+            if tid is None or e.get("ph") != "X" \
+                    or "attempt" not in args or e.get("pid") == 0:
+                continue
+            by_trace.setdefault(tid, {"attempts": set(), "pids": set()})
+            by_trace[tid]["attempts"].add(args["attempt"])
+            by_trace[tid]["pids"].add(e["pid"])
+        joined = [t for t, j in by_trace.items()
+                  if max(j["attempts"]) >= 2 and len(j["pids"]) >= 2]
+        decisions = sum(1 for e in evs
+                        if e.get("pid") == 0
+                        and str(e.get("name", "")).startswith("route["))
+        out["failover_trace_ids"] = len(joined)
+        out["router_decisions"] = decisions
+        out["valid"] = bool(joined) and decisions > 0
+    except Exception as e:
+        print(f"bench_serving: cluster trace export failed: {e!r}",
+              file=sys.stderr)
+    finally:
+        if not keep:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+    return out
+
+
 def main_cluster():
     """Router-policy A/B + kill drill over N full in-process replicas
     (see the module docstring). Everything runs unthreaded on ONE
@@ -1397,11 +1461,23 @@ def main_cluster():
     # replica and hide it)
     warm_template = rng.randint(1, V, (tlen,)).astype("int32")
 
+    # declared SLO objectives for the goodput block (BENCH_SLO_*;
+    # unset = no objectives, every finished request counts ok — the
+    # block still records the split machinery end to end)
+    from paddle_tpu.inference.telemetry import SloPolicy
+
+    def _env_f(name):
+        v = os.environ.get(name)
+        return float(v) if v not in (None, "") else None
+    slo_policy = SloPolicy(ttft_s=_env_f("BENCH_SLO_TTFT_S"),
+                           itl_s=_env_f("BENCH_SLO_ITL_S"),
+                           e2e_s=_env_f("BENCH_SLO_E2E_S"))
+
     def build_engine(clock):
         eng = ServingEngine(
             fmt, embed, head, num_slots=slots, max_seq_len=smax,
             prefill_cap=cap_, prefix_cache_blocks=pool_blocks,
-            clock=clock.now)
+            clock=clock.now, slo=slo_policy)
         for sfx in (sfx_lo, sfx_lo, sfx_hi):
             p = np.concatenate([warm_template,
                                 np.arange(1, sfx + 1, dtype=np.int32)])
@@ -1414,9 +1490,12 @@ def main_cluster():
         reps = [LocalReplica(f"replica{r}", build_engine(clock),
                              threaded=False, clock=clock.now)
                 for r in range(n_rep)]
+        # audit_ring pinned explicitly: the bench's merged-trace gate
+        # requires router decision events, so an exported
+        # PADDLE_ROUTER_AUDIT_RING=0 must not fail a healthy kill drill
         return reps, Router(reps, policy=policy, hb_dead_s=0.05,
                             spill_depth=spill, snap_max_age_s=0.0,
-                            clock=clock.now)
+                            clock=clock.now, audit_ring=4096)
 
     # template id per request (by prefix identity): the concentration
     # metric below needs to know each request's template home
@@ -1473,6 +1552,40 @@ def main_cluster():
                 for r, t in zip(reps, traces0)],
             "failovers": router.failovers_total,
         }
+        # SLO/goodput block: per-replica verdicts + the queue/service
+        # decomposition percentiles (the autoscaler's signals, recorded
+        # per bench run so regressions are diffable)
+        def ms(v):
+            return None if v is None else round(1e3 * v, 2)
+        per_rep = {}
+        for r in reps:
+            em = r.engine.metrics()
+            per_rep[r.name] = {
+                "ok": em["slo_ok"],
+                "violated_queue": em["slo_violated_queue"],
+                "violated_service": em["slo_violated_service"],
+                "finished": em["requests_finished"],
+                "queue_p50_ms": ms(em["queue_p50_s"]),
+                "queue_p99_ms": ms(em["queue_p99_s"]),
+                "service_p50_ms": ms(em["service_p50_s"]),
+                "service_p99_ms": ms(em["service_p99_s"]),
+            }
+        done = sum(p["ok"] + p["violated_queue"] + p["violated_service"]
+                   for p in per_rep.values())
+        out["slo"] = {
+            "objectives": slo_policy.objectives(),
+            "ok": sum(p["ok"] for p in per_rep.values()),
+            "violated_queue": sum(p["violated_queue"]
+                                  for p in per_rep.values()),
+            "violated_service": sum(p["violated_service"]
+                                    for p in per_rep.values()),
+            "requests_classified": done,
+            # the independent side of the reconciliation gate: every
+            # engine-finished request must have received a verdict
+            "requests_finished": sum(p["finished"]
+                                     for p in per_rep.values()),
+            "per_replica": per_rep,
+        }
         by_idx = {r["idx"]: r["toks"] for r in recs.values()}
         if kill:
             out["kill"] = {
@@ -1485,6 +1598,7 @@ def main_cluster():
                     else round(kill_rep["t_recovered"]
                                - kill_rep["t_kill"], 3)),
             }
+            out["cluster_trace"] = _cluster_trace_block(router)
         return out, by_idx
 
     arr_rng = np.random.RandomState(seed + 1)
@@ -1527,6 +1641,9 @@ def main_cluster():
         "prefix_affinity": aff,
         "kill_drill": killed,
         "kill_token_parity": parity_ok,
+        # the goodput block the autoscaling item consumes (the kill
+        # run's: it includes the failover's queue/service impact)
+        "slo": killed["slo"],
         "affinity_hit_rate_gain": round(
             aff["prefix_hit_rate_overall"]
             - rr["prefix_hit_rate_overall"], 4),
@@ -1562,6 +1679,21 @@ def main_cluster():
               f"{killed['kill']['orphaned_requests']} requests — "
               "failover found no live replica to re-place them on",
               file=sys.stderr)
+        rc = 1
+    if not killed["cluster_trace"]["valid"]:
+        print("bench_serving: MERGED CLUSTER TRACE INVALID — the kill "
+              "drill must yield one validated Perfetto trace joining "
+              "the failed-over request across two replicas "
+              f"({killed['cluster_trace']})", file=sys.stderr)
+        rc = 1
+    slo_rec = killed["slo"]
+    if (slo_rec["ok"] + slo_rec["violated_queue"]
+            + slo_rec["violated_service"]) != slo_rec[
+                "requests_finished"]:
+        print("bench_serving: SLO RECONCILIATION BROKE in the cluster "
+              f"record: {slo_rec['requests_classified']} classified "
+              f"!= {slo_rec['requests_finished']} engine-finished: "
+              f"{slo_rec}", file=sys.stderr)
         rc = 1
     return rc
 

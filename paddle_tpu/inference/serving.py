@@ -109,7 +109,8 @@ from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 from .generation import (FusedDecoder, _absmax_int8, _host_seed,
                          _sample_rows, dispatch_kind)
-from .telemetry import COUNTER_FOLD_KEYS, DEFAULT_RING, Telemetry
+from .telemetry import (COUNTER_FOLD_KEYS, DEFAULT_RING, SloPolicy,
+                        Telemetry)
 
 __all__ = ["ServingEngine", "ServedRequest", "AdmissionFull"]
 
@@ -128,12 +129,12 @@ class ServedRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "min_length", "repetition_penalty", "state", "slot",
-                 "tokens", "t_submit", "t_first", "t_done", "deadline_s",
-                 "seed")
+                 "tokens", "t_submit", "t_admit", "t_first", "t_done",
+                 "deadline_s", "seed", "trace_id", "attempt")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  min_length, repetition_penalty, t_submit,
-                 deadline_s=None, seed=0):
+                 deadline_s=None, seed=0, trace_id=None, attempt=1):
         self.rid = rid
         self.prompt = prompt                      # np.int32 [S]
         self.max_new_tokens = int(max_new_tokens)
@@ -144,6 +145,7 @@ class ServedRequest:
         self.slot = None
         self.tokens = []                          # generated token ids
         self.t_submit = t_submit
+        self.t_admit = None                       # slot entry time
         self.t_first = None                       # first token time
         self.t_done = None
         self.deadline_s = None if deadline_s is None else float(deadline_s)
@@ -151,6 +153,12 @@ class ServedRequest:
         # each generated token from fold_in(PRNGKey(seed), position),
         # so outputs are invariant to scheduling (see _sample_rows)
         self.seed = int(seed)
+        # cluster trace context: the gateway/router thread one trace id
+        # through every placement of one client request; attempt
+        # increments across failover re-submits (telemetry.RequestTrace
+        # carries both, so cross-replica spans join on the trace id)
+        self.trace_id = None if trace_id is None else str(trace_id)
+        self.attempt = int(attempt)
 
     @property
     def ttft_s(self):
@@ -215,7 +223,7 @@ class ServingEngine:
                  max_pending=None, prefill_cap=None,
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
                  paged=None, kv_pool=None, kv_pool_blocks=None,
-                 token_budget=None, telemetry_ring=None):
+                 token_budget=None, telemetry_ring=None, slo=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -369,6 +377,17 @@ class ServingEngine:
         # fixed-size). All timestamps ride the ENGINE clock, so spans
         # line up exactly with ttft_s/latency_s under a virtual clock.
         self.telemetry = Telemetry(telemetry_ring, clock=self.clock)
+        # SLO/goodput layer (telemetry.SloPolicy; `slo=` or the
+        # PADDLE_SLO_* knobs): every FINISHED request is classified at
+        # _finish against the declared objectives — ok, violated by
+        # queueing, or violated by slow service. With no objectives set
+        # everything is ok, so slo_ok + slo_violated_queue +
+        # slo_violated_service == requests_finished holds always (the
+        # conftest reconciliation pins it).
+        self._slo = slo if slo is not None else SloPolicy.from_env()
+        self._slo_ok = 0
+        self._slo_violated_queue = 0
+        self._slo_violated_service = 0
         # results is BOUNDED at the telemetry ring size (the old
         # unbounded dict leaked one entry per finished request for the
         # engine's lifetime); total counts survive in the window
@@ -517,7 +536,8 @@ class ServingEngine:
 
     # ------------------------------------------------------------- public
     def submit(self, prompt, max_new_tokens=20, eos_token_id=None,
-               min_length=0, repetition_penalty=1.0, deadline_s=None):
+               min_length=0, repetition_penalty=1.0, deadline_s=None,
+               trace_id=None, attempt=1):
         """Queue one request; returns its id. The slot-eviction invariant
         is enforced HERE: a request may never be able to push its slot's
         cache_lens to Smax (the write kernels' documented invariant).
@@ -547,10 +567,14 @@ class ServingEngine:
                 "repetition_penalty needs enable_repetition_penalty=True "
                 "at engine construction (the presence-mask carry is "
                 "static trace structure)")
+        if attempt < 1:
+            raise ValueError(f"attempt must be >= 1, got {attempt}")
         if self.max_pending and len(self._queue) >= self.max_pending:
             self._rejected += 1
             if self.telemetry.enabled:
-                self.telemetry.req_rejected(self.clock())
+                self.telemetry.req_rejected(self.clock(),
+                                            trace_id=trace_id,
+                                            attempt=attempt)
             raise AdmissionFull(
                 f"pending queue full ({len(self._queue)}/"
                 f"{self.max_pending}) — request shed at admission")
@@ -570,7 +594,9 @@ class ServingEngine:
                 # so the caller's backoff-and-retry recovers
                 self._rejected += 1
                 if self.telemetry.enabled:
-                    self.telemetry.req_rejected(self.clock())
+                    self.telemetry.req_rejected(self.clock(),
+                                                trace_id=trace_id,
+                                                attempt=attempt)
                 raise AdmissionFull(
                     f"kv pool exhausted ({self._kv_committed}/"
                     f"{self.pool.num_blocks} blocks committed to "
@@ -580,10 +606,13 @@ class ServingEngine:
         req = ServedRequest(next(self._rid), ids, max_new_tokens,
                             eos_token_id, min_length, repetition_penalty,
                             self.clock(), deadline_s=deadline_s,
-                            seed=self._fresh_seed())
+                            seed=self._fresh_seed(), trace_id=trace_id,
+                            attempt=attempt)
         self._queue.append(req)
         self._req_index[req.rid] = req
-        self.telemetry.req_queued(req.rid, req.t_submit)
+        self.telemetry.req_queued(req.rid, req.t_submit,
+                                  trace_id=req.trace_id,
+                                  attempt=req.attempt)
         return req.rid
 
     def _fresh_seed(self):
@@ -763,6 +792,9 @@ class ServingEngine:
             "budget_prefill_tokens": self._budget_prefill_tokens,
             "budget_decode_tokens": self._budget_decode_tokens,
             "budget_draft_tokens": self._budget_draft_tokens,
+            "slo_ok": self._slo_ok,
+            "slo_violated_queue": self._slo_violated_queue,
+            "slo_violated_service": self._slo_violated_service,
         }
 
     def reset_metrics(self, keep_results=True):
@@ -803,6 +835,9 @@ class ServingEngine:
         self._budget_prefill_tokens = 0
         self._budget_decode_tokens = 0
         self._budget_draft_tokens = 0
+        self._slo_ok = 0
+        self._slo_violated_queue = 0
+        self._slo_violated_service = 0
         if not keep_results:
             self.results = {}
 
@@ -891,6 +926,20 @@ class ServingEngine:
                 round(self._budget_tokens_used
                       / (self._budget_steps * self.token_budget), 4)
                 if self._budget_steps and self.token_budget else None),
+            # SLO/goodput window counters (SloPolicy; objectives unset
+            # = everything ok): ok + violated_queue + violated_service
+            # == requests_finished by construction — every finished
+            # request gets exactly one verdict at _finish
+            "slo_ok": self._slo_ok,
+            "slo_violated_queue": self._slo_violated_queue,
+            "slo_violated_service": self._slo_violated_service,
+            # queue-wait vs service-time decomposition percentiles
+            # (the cause-attribution signal, same bounded histograms
+            # discipline as ttft/latency)
+            "queue_p50_s": tele.hist_queue.percentile(50),
+            "queue_p99_s": tele.hist_queue.percentile(99),
+            "service_p50_s": tele.hist_service.percentile(50),
+            "service_p99_s": tele.hist_service.percentile(99),
         }
         if self.prefix_cache is not None:
             m["prefix_store"] = self.prefix_cache.store.stats()
@@ -1112,9 +1161,11 @@ class ServingEngine:
         child = ServedRequest(next(self._rid), src.prompt, mnt,
                               src.eos_token_id, src.min_length,
                               src.repetition_penalty, self.clock(),
-                              seed=self._fresh_seed())
+                              seed=self._fresh_seed(),
+                              trace_id=src.trace_id, attempt=src.attempt)
         child.state = "running"
         child.slot = s1
+        child.t_admit = child.t_submit    # a clone never queues
         child.tokens = list(src.tokens)
         child.t_first = src.t_first
         self._slot_req[s1] = child
@@ -1126,7 +1177,9 @@ class ServingEngine:
         # hits + misses == admitted reconciliation conftest pins
         self._forked += 1
         if self.telemetry.enabled:
-            self.telemetry.req_queued(child.rid, child.t_submit)
+            self.telemetry.req_queued(child.rid, child.t_submit,
+                                      trace_id=child.trace_id,
+                                      attempt=child.attempt)
             self.telemetry.req_admitted(child.rid, s1, child.t_submit)
             self.telemetry.req_event(child.rid, "forked", child.t_submit)
         # share the parent's blocks: table row copy + one ref each
@@ -1357,8 +1410,11 @@ class ServingEngine:
             return []
         self._admitted += len(batch)
         tele = self.telemetry
-        t_adm = self.clock() if tele.enabled else None
+        # t_admit is ALWAYS stamped (ring on or off): the SLO layer's
+        # queue/service decomposition reads it at _finish
+        t_adm = self.clock()
         for r in batch:
+            r.t_admit = t_adm
             tele.req_admitted(r.rid, r.slot, t_adm)
         b = self.num_slots
         stk = self.dec._stacked()
@@ -1581,8 +1637,10 @@ class ServingEngine:
             return []
         self._admitted += len(batch)
         tele = self.telemetry
-        t_adm = self.clock() if tele.enabled else None
+        # always stamped (SLO queue/service decomposition reads it)
+        t_adm = self.clock()
         for r in batch:
+            r.t_admit = t_adm
             tele.req_admitted(r.rid, r.slot, t_adm)
         if self._rep_on:
             # presence seeds with the FULL prompt at admission (the
@@ -2128,11 +2186,31 @@ class ServingEngine:
             self._expired += 1
         else:
             self._finished += 1
+            # queue-time vs service-time decomposition + SLO verdict:
+            # queue = submit -> admitted (0 for forked clones), service
+            # = admitted -> finished; the mean inter-token gap stands
+            # in for the per-request ITL objective (tokens harvest in
+            # batches — there are no per-token timestamps to p99 over)
+            t_adm = req.t_admit if req.t_admit is not None else now
+            queue_s = max(t_adm - req.t_submit, 0.0)
+            service_s = max(now - t_adm, 0.0)
+            n = len(req.tokens)
+            itl_s = (max(req.t_done - req.t_first, 0.0) / (n - 1)
+                     if n > 1 and req.t_first is not None else 0.0)
+            verdict = self._slo.classify(queue_s, service_s, req.ttft_s,
+                                         itl_s, req.latency_s)
+            if verdict == "ok":
+                self._slo_ok += 1
+            elif verdict == "queue":
+                self._slo_violated_queue += 1
+            else:
+                self._slo_violated_service += 1
             # histogram observation happens HERE, not at the first
             # token: expired requests must stay out of the percentiles
             # (their "latency" is an eviction time), same contract the
             # old done-list scan enforced
-            self.telemetry.observe_request(req.ttft_s, req.latency_s)
+            self.telemetry.observe_request(req.ttft_s, req.latency_s,
+                                           queue_s, service_s)
         self.telemetry.req_done(req.rid, req.state, now)
         self.results[req.rid] = req.result()
         # bounded results (the telemetry ring size): a long-lived engine

@@ -40,7 +40,17 @@ tails and budget waste):
     ``runtime_histogram``/``runtime_counter``.
   * ``snapshot(engine)`` — the JSON routing payload a cluster
     front-end consumes (queue depth, occupancy, pool headroom, prefix
-    hit rate, histogram percentiles).
+    hit rate, histogram percentiles; v2 adds the SLO/goodput block +
+    queue/service decomposition).
+  * ``SloPolicy`` — declared latency objectives (``PADDLE_SLO_*``);
+    the engine classifies every finished request at completion (ok /
+    violated-by-queueing / violated-by-service) and the verdicts ride
+    ``metrics()``, the exposition, and the snapshot.
+  * ``trace_dump(engine)`` — the per-replica payload
+    ``serving_cluster.trace.export_cluster_trace`` merges into ONE
+    cluster-wide Perfetto trace (spans carry the gateway-minted
+    ``trace_id``/``attempt`` context; wall/mono anchor pair included
+    for cross-process rebasing).
 
 This module must stay import-light (stdlib + numpy only): the
 distributed runtime (rpc.py) records into the runtime registry and must
@@ -56,14 +66,15 @@ from collections import deque
 
 import numpy as np
 
-__all__ = ["LogHistogram", "Telemetry", "RequestTrace",
+__all__ = ["LogHistogram", "Telemetry", "RequestTrace", "SloPolicy",
            "export_chrome_tracing", "render_prometheus",
-           "parse_prometheus", "snapshot", "runtime_histogram",
+           "parse_prometheus", "snapshot", "trace_dump",
+           "runtime_histogram",
            "runtime_counter", "runtime_prometheus",
            "runtime_registry_snapshot", "PROMETHEUS_NAMES",
            "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING",
            "SNAPSHOT_SCHEMA_VERSION", "SNAPSHOT_REQUIRED_KEYS",
-           "SNAPSHOT_OPTIONAL_KEYS"]
+           "SNAPSHOT_OPTIONAL_KEYS", "SLO_ENV_VARS"]
 
 DEFAULT_RING = 2048
 
@@ -75,18 +86,94 @@ DEFAULT_RING = 2048
 # Bump SNAPSHOT_SCHEMA_VERSION on any key addition/removal/semantic
 # change — a router seeing an unknown version refuses to score the
 # replica instead of silently misreading it.
-SNAPSHOT_SCHEMA_VERSION = 1
+# v2: added the "slo" block (declared objectives + goodput counters)
+# and the queue_s/service_s decomposition histograms — the signals the
+# autoscaling item consumes.
+SNAPSHOT_SCHEMA_VERSION = 2
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
     "schema_version", "queue_depth", "occupancy", "num_slots",
     "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
     "requests", "histograms", "budget", "prefix", "spans_logged",
-    "steps_logged", "telemetry_ring",
+    "steps_logged", "telemetry_ring", "slo",
 })
 
 # keys present only on some configurations (paged pool / spec decode)
 SNAPSHOT_OPTIONAL_KEYS = frozenset({"kv_blocks", "drafter"})
+
+
+# ------------------------------------------------------------------ SLO
+# Declared latency objectives (the goodput contract). Registered in
+# paddle_tpu.testing.GW_ENV_VARS so the conftest leak guard covers them
+# — a leaked objective silently flips every later engine's goodput
+# counters.
+SLO_ENV_VARS = ("PADDLE_SLO_TTFT_S", "PADDLE_SLO_ITL_S",
+                "PADDLE_SLO_E2E_S")
+
+
+class SloPolicy:
+    """Declared per-request latency objectives (``PADDLE_SLO_*``):
+
+      * ``ttft_s``  — time to first token (submit -> first token);
+      * ``itl_s``   — MEAN inter-token latency over the request
+        ((t_done - t_first) / (n - 1)); the fleet-level p99 the issue
+        cares about is read off the latency histograms — per-token
+        timestamps are not recorded (tokens harvest in batches), so a
+        within-request p99 would be an invention, not a measurement;
+      * ``e2e_s``   — end-to-end latency (submit -> finished).
+
+    Unset objectives are never violated, so a no-knob engine counts
+    every finished request as ``slo_ok`` and the reconciliation
+    ``slo_ok + slo_violated_* == requests_finished`` holds universally.
+
+    ``classify`` attributes a violation to where the request spent its
+    time: ``queue`` when the queue wait (submit -> admitted) was at
+    least the service time, else ``service`` — the split the
+    autoscaler needs (queued-too-long = add replicas; slow-service =
+    the engine itself is the bottleneck)."""
+
+    __slots__ = ("ttft_s", "itl_s", "e2e_s")
+
+    def __init__(self, ttft_s=None, itl_s=None, e2e_s=None):
+        for name, v in (("ttft_s", ttft_s), ("itl_s", itl_s),
+                        ("e2e_s", e2e_s)):
+            if v is not None and float(v) <= 0:
+                raise ValueError(f"SLO objective {name} must be > 0, "
+                                 f"got {v}")
+        self.ttft_s = None if ttft_s is None else float(ttft_s)
+        self.itl_s = None if itl_s is None else float(itl_s)
+        self.e2e_s = None if e2e_s is None else float(e2e_s)
+
+    @classmethod
+    def from_env(cls):
+        def _f(name):
+            v = os.environ.get(name)
+            return None if v in (None, "") else float(v)
+        return cls(_f("PADDLE_SLO_TTFT_S"), _f("PADDLE_SLO_ITL_S"),
+                   _f("PADDLE_SLO_E2E_S"))
+
+    @property
+    def enabled(self):
+        return (self.ttft_s is not None or self.itl_s is not None
+                or self.e2e_s is not None)
+
+    def objectives(self):
+        return {"ttft_s": self.ttft_s, "itl_s": self.itl_s,
+                "e2e_s": self.e2e_s}
+
+    def classify(self, queue_s, service_s, ttft_s, itl_s, e2e_s):
+        """``"ok" | "queue" | "service"`` for one finished request."""
+        violated = (
+            (self.ttft_s is not None and ttft_s is not None
+             and ttft_s > self.ttft_s)
+            or (self.itl_s is not None and itl_s is not None
+                and itl_s > self.itl_s)
+            or (self.e2e_s is not None and e2e_s is not None
+                and e2e_s > self.e2e_s))
+        if not violated:
+            return "ok"
+        return "queue" if queue_s >= service_s else "service"
 
 
 # ---------------------------------------------------------------- histogram
@@ -219,15 +306,23 @@ class LogHistogram:
 class RequestTrace:
     """One request's lifecycle span: ordered (event, t) pairs on the
     engine clock. Lives in ``Telemetry._live`` while in flight, moves
-    to the bounded ``spans`` ring at finish/expiry/rejection."""
+    to the bounded ``spans`` ring at finish/expiry/rejection.
 
-    __slots__ = ("rid", "slot", "state", "events")
+    ``trace_id``/``attempt`` are the CLUSTER trace context: the gateway
+    mints one trace id per HTTP request and the router threads it
+    through every placement (attempt increments across failover
+    re-submits), so a kill-drill stream yields ONE joined trace across
+    gateway, router, and both replicas."""
 
-    def __init__(self, rid, slot=None):
+    __slots__ = ("rid", "slot", "state", "events", "trace_id", "attempt")
+
+    def __init__(self, rid, slot=None, trace_id=None, attempt=1):
         self.rid = rid
         self.slot = slot
         self.state = "queued"
         self.events = []                  # [(name, t_monotonic), ...]
+        self.trace_id = trace_id
+        self.attempt = int(attempt)
 
     def t0(self):
         return self.events[0][1] if self.events else 0.0
@@ -260,12 +355,17 @@ class Telemetry:
         self.hist_ttft = LogHistogram(1e-6, 1e4)
         self.hist_latency = LogHistogram(1e-6, 1e4)
         self.hist_step_tokens = LogHistogram(1.0, 1 << 16)
+        # queue-time vs service-time decomposition (the SLO layer's
+        # cause attribution + the autoscaler's queue-pressure signal);
+        # like the other histograms these stay on with the ring off
+        self.hist_queue = LogHistogram(1e-6, 1e4)
+        self.hist_service = LogHistogram(1e-6, 1e4)
 
     # ------------------------------------------------------- request spans
-    def req_queued(self, rid, t):
+    def req_queued(self, rid, t, trace_id=None, attempt=1):
         if not self.enabled:
             return
-        tr = RequestTrace(rid)
+        tr = RequestTrace(rid, trace_id=trace_id, attempt=attempt)
         tr.events.append(("queued", t))
         self._live[rid] = tr
 
@@ -294,11 +394,14 @@ class Telemetry:
         tr.events.append((state, t))
         self.spans.append(tr)
 
-    def req_rejected(self, t, rid=None):
-        """Sheds never get a rid — record a one-event span directly."""
+    def req_rejected(self, t, rid=None, trace_id=None, attempt=1):
+        """Sheds never get a rid — record a one-event span directly.
+        ``attempt`` matters for failover re-submits that shed: the
+        merged cluster trace must attribute the rejection to the
+        placement attempt that actually hit this replica."""
         if not self.enabled:
             return
-        tr = RequestTrace(rid)
+        tr = RequestTrace(rid, trace_id=trace_id, attempt=attempt)
         tr.state = "rejected"
         tr.events.append(("rejected", t))
         self.spans.append(tr)
@@ -328,11 +431,16 @@ class Telemetry:
         ev["host_s"] = round(max(0.0, now - ev["t"] - ev["dur_s"]), 9)
 
     # --------------------------------------------------------- histograms
-    def observe_request(self, ttft_s, latency_s):
+    def observe_request(self, ttft_s, latency_s, queue_s=None,
+                        service_s=None):
         if ttft_s is not None:
             self.hist_ttft.observe(ttft_s)
         if latency_s is not None:
             self.hist_latency.observe(latency_s)
+        if queue_s is not None:
+            self.hist_queue.observe(queue_s)
+        if service_s is not None:
+            self.hist_service.observe(service_s)
 
     def observe_step_tokens(self, n):
         self.hist_step_tokens.observe(n)
@@ -347,6 +455,8 @@ class Telemetry:
         self.hist_ttft.reset()
         self.hist_latency.reset()
         self.hist_step_tokens.reset()
+        self.hist_queue.reset()
+        self.hist_service.reset()
 
 
 # -------------------------------------------------------- runtime registry
@@ -478,6 +588,21 @@ PROMETHEUS_NAMES = {
     "budget_draft_tokens": ("paddle_serving_budget_draft_tokens_total",
                             "counter"),
     "budget_utilization": ("paddle_serving_budget_utilization", "gauge"),
+    # SLO/goodput layer: every finished request is classified against
+    # the declared objectives (SloPolicy) — ok, violated-by-queueing,
+    # or violated-by-slow-service; the three always sum to
+    # requests_finished (conftest reconciliation)
+    "slo_ok": ("paddle_serving_slo_ok_total", "counter"),
+    "slo_violated_queue": ("paddle_serving_slo_violated_queue_total",
+                           "counter"),
+    "slo_violated_service": (
+        "paddle_serving_slo_violated_service_total", "counter"),
+    "queue_p50_s": ("paddle_serving_queue_time_seconds", "histogram"),
+    "queue_p99_s": ("paddle_serving_queue_time_seconds", "histogram"),
+    "service_p50_s": ("paddle_serving_service_time_seconds",
+                      "histogram"),
+    "service_p99_s": ("paddle_serving_service_time_seconds",
+                      "histogram"),
 }
 
 # metrics() keys with no scalar Prometheus twin (nested dicts whose
@@ -535,6 +660,12 @@ def render_prometheus(engine):
     lines.extend(tele.hist_step_tokens.prometheus_lines(
         "paddle_serving_step_tokens",
         "tokens emitted per scheduler step"))
+    lines.extend(tele.hist_queue.prometheus_lines(
+        "paddle_serving_queue_time_seconds",
+        "per-request queue wait (submit -> admitted), seconds"))
+    lines.extend(tele.hist_service.prometheus_lines(
+        "paddle_serving_service_time_seconds",
+        "per-request service time (admitted -> finished), seconds"))
     if engine.pool is not None:
         g = engine.pool.gauges()
         name = "paddle_serving_kv_blocks_used_peak"
@@ -616,6 +747,18 @@ def snapshot(engine):
             "ttft_s": tele.hist_ttft.snapshot(),
             "latency_s": tele.hist_latency.snapshot(),
             "tokens_per_step": tele.hist_step_tokens.snapshot(),
+            # queue-time vs service-time decomposition — the
+            # autoscaler's "is the backlog queueing or slow service"
+            # signal, per replica
+            "queue_s": tele.hist_queue.snapshot(),
+            "service_s": tele.hist_service.snapshot(),
+        },
+        # goodput accounting against the declared objectives (v2)
+        "slo": {
+            "objectives": engine._slo.objectives(),
+            "ok": m["slo_ok"],
+            "violated_queue": m["slo_violated_queue"],
+            "violated_service": m["slo_violated_service"],
         },
         "budget": {k: m[f"budget_{k}"] for k in
                    ("steps", "tokens_used", "prefill_tokens",
@@ -638,35 +781,56 @@ def snapshot(engine):
     return out
 
 
-def export_chrome_tracing(engine, path, pid=0):
-    """Write the engine's telemetry rings as Chrome-trace JSON
-    (chrome://tracing / Perfetto: File > Open). Layout: one pid per
-    engine (``pid``), tid 0 = the dispatch timeline (one complete event
+def trace_dump(engine):
+    """JSON-serializable dump of one engine's telemetry rings — the
+    per-replica payload the CLUSTER trace export merges
+    (serving_cluster/trace.py): finished spans + still-live spans (a
+    killed replica's stranded requests are exactly the interesting
+    ones), the step timeline, and a (t_wall, t_mono) anchor pair so a
+    cross-process merge can rebase every engine-clock timestamp to wall
+    time — the same discipline as the flight recorder's dumps."""
+    tele = engine.telemetry
+    spans = []
+    for sp in list(tele.spans) + list(tele._live.values()):
+        spans.append({
+            "rid": sp.rid, "slot": sp.slot, "state": sp.state,
+            "trace_id": sp.trace_id, "attempt": sp.attempt,
+            "events": [[n, float(t)] for n, t in sp.events],
+        })
+    return {
+        "t_wall": time.time(),
+        "t_mono": engine.clock(),
+        "num_slots": engine.num_slots,
+        "spans": spans,
+        "steps": [dict(ev) for ev in tele.steps],
+    }
+
+
+def render_trace_dump(tr, pid, dump, us, process_name,
+                      counters=False):
+    """Render one engine ``trace_dump`` into ``tr`` (ChromeTrace) as
+    process ``pid``: tid 0 = the dispatch timeline (one complete event
     per compiled step), tid 1..B = slots (complete span per request,
     instants for each lifecycle event), tid B+1 = requests shed from
-    the queue; counter tracks for kv_blocks_used / queue_depth /
-    budget_utilization ride the step events. Timestamps are the engine
-    clock rebased to the earliest recorded event. Returns ``path``."""
-    from ..profiler import ChromeTrace
-    tele = engine.telemetry
-    tr = ChromeTrace()
-    tr.process(pid, "paddle_tpu ServingEngine")
+    the queue. ONE implementation shared by ``export_chrome_tracing``
+    and the cluster merge (serving_cluster/trace.py) so the
+    single-engine and cluster exports cannot drift apart. ``us`` maps
+    an engine-clock timestamp to trace microseconds (the caller owns
+    rebasing/anchoring); ``counters=True`` adds the kv_blocks_used /
+    queue_depth / budget_utilization counter tracks."""
+    nslots = dump["num_slots"]
+    tr.process(pid, process_name)
     tr.thread(pid, 0, "dispatch timeline")
-    for s in range(engine.num_slots):
+    for s in range(nslots):
         tr.thread(pid, s + 1, f"slot {s}")
-    tr.thread(pid, engine.num_slots + 1, "queue (never admitted)")
-    ts = [ev["t"] for ev in tele.steps]
-    ts += [sp.t0() for sp in tele.spans if sp.events]
-    base = min(ts) if ts else 0.0
-
-    def us(t):
-        return max((t - base) * 1e6, 0.0)
-
-    for ev in tele.steps:
+    tr.thread(pid, nslots + 1, "queue (never admitted)")
+    for ev in dump["steps"]:
         args = {k: v for k, v in ev.items()
                 if k not in ("kind", "t") and v is not None}
         tr.complete(ev["kind"], pid, 0, us(ev["t"]),
                     max(ev["dur_s"], 0.0) * 1e6, args=args)
+        if not counters:
+            continue
         t_us = us(ev["t"])
         if ev.get("kv_blocks_used") is not None:
             tr.counter("kv_blocks_used", pid, t_us,
@@ -680,19 +844,44 @@ def export_chrome_tracing(engine, path, pid=0):
             if cap:
                 tr.counter("budget_utilization", pid, t_us,
                            {"frac": round(used / cap, 4)})
-    for sp in tele.spans:
-        if not sp.events:
+    for sp in dump["spans"]:
+        if not sp["events"]:
             continue
-        tid = (sp.slot + 1 if sp.slot is not None
-               else engine.num_slots + 1)
-        t0, t1 = sp.t0(), sp.t1()
-        tr.complete(f"req {sp.rid} [{sp.state}]", pid, tid, us(t0),
-                    max(t1 - t0, 0.0) * 1e6,
-                    args={"state": sp.state,
-                          "events": [[n, round(t - t0, 6)]
-                                     for n, t in sp.events]})
-        for name, t in sp.events:
+        tid = (sp["slot"] + 1 if sp["slot"] is not None
+               else nslots + 1)
+        t0, t1 = sp["events"][0][1], sp["events"][-1][1]
+        args = {"state": sp["state"],
+                "events": [[n, round(t - t0, 6)]
+                           for n, t in sp["events"]]}
+        if sp["trace_id"] is not None:
+            args["trace_id"] = sp["trace_id"]
+            args["attempt"] = sp["attempt"]
+        tr.complete(f"req {sp['rid']} [{sp['state']}]", pid, tid,
+                    us(t0), max(t1 - t0, 0.0) * 1e6, args=args)
+        for name, t in sp["events"]:
             tr.instant(name, pid, tid, us(t))
+
+
+def export_chrome_tracing(engine, path, pid=0):
+    """Write the engine's telemetry rings as Chrome-trace JSON
+    (chrome://tracing / Perfetto: File > Open), one pid per engine
+    (``pid``) in the ``render_trace_dump`` layout with counter tracks.
+    Still-live spans are included (via ``trace_dump`` — a wedged
+    request is exactly the interesting one). Timestamps are the engine
+    clock rebased to the earliest recorded event. Returns ``path``."""
+    from ..profiler import ChromeTrace
+    dump = trace_dump(engine)
+    ts = [ev["t"] for ev in dump["steps"]]
+    ts += [sp["events"][0][1] for sp in dump["spans"] if sp["events"]]
+    base = min(ts) if ts else 0.0
+
+    def us(t):
+        return max((t - base) * 1e6, 0.0)
+
+    tr = ChromeTrace()
+    render_trace_dump(tr, pid, dump, us,
+                      process_name="paddle_tpu ServingEngine",
+                      counters=True)
     tr.write(path)
     return path
 

@@ -13,12 +13,21 @@ cluster.
 
 The router is pure host code: nothing here dispatches to the device,
 so the per-replica zero-retrace contract is untouched by construction.
+
+The trace plane rides on top (PR 11): one ``X-Request-Id`` trace id
+per HTTP request threaded gateway -> router -> replica -> engine and
+ACROSS failover (same id, incremented attempt), a router decision
+audit ring with per-reason counters, gateway HTTP latency histograms,
+and ``export_cluster_trace`` — one merged Perfetto trace for the whole
+cluster (trace.py).
 """
 from .gateway import Gateway
 from .protocol import ProtocolError
 from .replica import LocalReplica, ReplicaError, RpcReplica, serve_engine
-from .router import HashRing, NoReplicaError, Router
+from .router import AUDIT_REASONS, HashRing, NoReplicaError, Router
+from .trace import export_cluster_trace
 
 __all__ = ["Gateway", "Router", "HashRing", "LocalReplica",
            "RpcReplica", "serve_engine", "ReplicaError",
-           "NoReplicaError", "ProtocolError"]
+           "NoReplicaError", "ProtocolError", "AUDIT_REASONS",
+           "export_cluster_trace"]

@@ -27,12 +27,30 @@ replicas dead → 503. A replica dying mid-stream never errors the
 stream — the router fails over and the replayed greedy prefix is
 skipped (router.py), so the client just sees one slow poll interval.
 
+Trace plane (the cluster observability spine):
+
+  * every HTTP request gets a TRACE ID — inbound ``X-Request-Id``
+    honored, else minted — echoed on the response (header + the
+    ``trace_id`` body field + every SSE chunk) and threaded through
+    the router into each replica engine's request spans, surviving
+    failover re-submits (same id, incremented attempt);
+  * every handled request lands one HTTP SPAN (method, path, status,
+    duration, trace id, gid) in a bounded ring
+    (``PADDLE_GATEWAY_TRACE_RING``, default 2048; 0 disables) — the
+    gateway pid's track in ``export_cluster_trace`` (trace.py);
+  * per-endpoint+status latency histograms ride the process-global
+    ``telemetry.runtime_histogram`` registry
+    (``paddle_gateway_http_request_seconds_<endpoint>_<status>``),
+    exposed under ``replica="gateway"`` in ``/metrics`` — the
+    gateway's own accept/parse/stream time was previously invisible.
+
 Env knobs: ``PADDLE_GATEWAY_PORT`` (8100; 0 = ephemeral),
 ``PADDLE_GATEWAY_POLL_S`` (harvest poll interval, 0.004),
-``PADDLE_GATEWAY_HB_S`` (health sweep interval, 0.25) — plus the
-router's ``PADDLE_ROUTER_POLICY`` / ``PADDLE_ROUTER_SPILL_DEPTH`` /
-``PADDLE_GATEWAY_HB_DEAD_S`` and the rpc replica's
-``PADDLE_GATEWAY_HB_TIMEOUT_S``. All registered in
+``PADDLE_GATEWAY_HB_S`` (health sweep interval, 0.25),
+``PADDLE_GATEWAY_TRACE_RING`` (HTTP span ring) — plus the router's
+``PADDLE_ROUTER_POLICY`` / ``PADDLE_ROUTER_SPILL_DEPTH`` /
+``PADDLE_ROUTER_AUDIT_RING`` / ``PADDLE_GATEWAY_HB_DEAD_S`` and the
+rpc replica's ``PADDLE_GATEWAY_HB_TIMEOUT_S``. All registered in
 ``paddle_tpu.testing.GW_ENV_VARS`` (conftest leak guard).
 """
 from __future__ import annotations
@@ -42,14 +60,24 @@ import json
 import os
 import threading
 import time
+import uuid
+from collections import deque
 
 from ..inference.serving import AdmissionFull
+from ..inference.telemetry import runtime_histogram
 from . import protocol
 from .router import NoReplicaError
 
 __all__ = ["Gateway"]
 
 _MAX_BODY = 8 << 20                       # 8 MiB: token-id prompts only
+
+# endpoint key for the per-endpoint+status latency histogram names
+# (flat names: the runtime registry has no label dimension)
+_ENDPOINT_KEYS = {"/v1/completions": "completions",
+                  "/v1/models": "models",
+                  "/healthz": "healthz",
+                  "/metrics": "metrics"}
 
 
 class _HttpError(Exception):
@@ -72,6 +100,13 @@ class Gateway:
         self.hb_s = float(hb_s if hb_s is not None
                           else os.environ.get("PADDLE_GATEWAY_HB_S",
                                               "0.25"))
+        # HTTP span ring: one record per handled request, merged into
+        # the cluster Perfetto export as the gateway pid's http track
+        ring = int(os.environ.get("PADDLE_GATEWAY_TRACE_RING", "2048"))
+        if ring < 0:
+            raise ValueError(f"trace ring must be >= 0, got {ring}")
+        self.trace_ring = ring
+        self.http_log = deque(maxlen=max(ring, 1))
         self._thread = None
         self._loop = None
         self._stop_evt = None
@@ -124,56 +159,97 @@ class Gateway:
             await asyncio.sleep(self.hb_s)
 
     # ------------------------------------------------------------- http
+    def _finish_span(self, span):
+        """Close one HTTP span: ring entry (the cluster trace's gateway
+        track) + the endpoint+status latency histogram. A span with no
+        status never got a response (client vanished pre-reply) and is
+        recorded with status 0."""
+        span["dur_s"] = round(time.monotonic() - span["t"], 9)
+        status = span["status"] if span["status"] is not None else 0
+        key = _ENDPOINT_KEYS.get(span["path"], "other")
+        runtime_histogram(
+            f"paddle_gateway_http_request_seconds_{key}_{status}",
+            1e-6, 1e3).observe(span["dur_s"])
+        if self.trace_ring:
+            self.http_log.append(dict(span, status=status))
+
     async def _handle(self, reader, writer):
+        # the span exists BEFORE the request is parsed and is filled
+        # in as _read_request progresses, so a request that dies in
+        # parsing (malformed request line, bad Content-Length) still
+        # lands an HTTP span + histogram sample and echoes a trace id
+        # on its 400; connections that never complete a request
+        # (client vanished, read timeout) are not recorded
+        span = {"trace_id": None, "method": "?", "path": "",
+                "status": None, "gid": None, "t": time.monotonic(),
+                "dur_s": None}
+        record = False
         try:
             try:
                 # bound the request read: a client that connects and
                 # sends nothing must not pin a handler task forever
-                method, path, body = await asyncio.wait_for(
-                    self._read_request(reader, writer), timeout=30)
+                method, path, body, tid = await asyncio.wait_for(
+                    self._read_request(reader, writer, span),
+                    timeout=30)
             except (asyncio.IncompleteReadError, ConnectionError,
                     asyncio.TimeoutError):
                 return
             except _HttpError as e:
-                await self._send_error(writer, e.code, e.message)
+                record = True
+                span["trace_id"] = span["trace_id"] or uuid.uuid4().hex
+                await self._send_error(writer, e.code, e.message,
+                                       span=span)
                 return
+            # the trace context: honor the client/proxy-minted id, else
+            # mint one — every response echoes it (header + body), the
+            # router threads it through every replica placement
+            record = True
+            span["trace_id"] = tid or uuid.uuid4().hex
             try:
-                await self._route(method, path, body, writer)
+                await self._route(method, path, body, writer, span)
             except protocol.ProtocolError as e:
-                await self._send_error(writer, e.code, e.message)
+                await self._send_error(writer, e.code, e.message,
+                                       span=span)
             except AdmissionFull as e:
                 await self._send_error(
                     writer, "admission_full", str(e),
-                    extra={"Retry-After": str(protocol.RETRY_AFTER_S)})
+                    extra={"Retry-After": str(protocol.RETRY_AFTER_S)},
+                    span=span)
             except NoReplicaError as e:
-                await self._send_error(writer, "no_replica", str(e))
+                await self._send_error(writer, "no_replica", str(e),
+                                       span=span)
             except KeyError as e:
                 # an unknown/already-released gid (e.g. a concurrent
                 # duplicate whose twin released first) is the client's
                 # 404, not a server bug
                 await self._send_error(writer, "not_found",
-                                       f"unknown request: {e}")
+                                       f"unknown request: {e}",
+                                       span=span)
             except (ConnectionError, asyncio.CancelledError):
                 raise
             except Exception as e:
                 await self._send_error(writer, "internal",
-                                       f"unhandled: {e!r}")
+                                       f"unhandled: {e!r}", span=span)
         except (ConnectionError, asyncio.CancelledError):
             pass                          # client went away mid-write
         finally:
+            if record:
+                self._finish_span(span)
             try:
                 writer.close()
             except Exception:
                 pass
 
-    async def _read_request(self, reader, writer):
+    async def _read_request(self, reader, writer, span):
         line = await reader.readline()
         parts = line.decode("latin-1").strip().split()
         if len(parts) != 3:
             raise _HttpError("bad_request", "malformed request line")
         method, path = parts[0], parts[1]
+        span["method"], span["path"] = method, path
         clen = 0
         expect_continue = False
+        trace_id = None
         while True:
             h = (await reader.readline()).decode("latin-1").strip()
             if not h:
@@ -189,6 +265,9 @@ class Gateway:
             elif key == "expect" \
                     and v.strip().lower() == "100-continue":
                 expect_continue = True
+            elif key == protocol.TRACE_HEADER.lower():
+                trace_id = v.strip() or None
+                span["trace_id"] = trace_id
         if not 0 <= clen <= _MAX_BODY:
             # the lower bound matters too: readexactly(-1) raises an
             # unhandled ValueError instead of a clean 400
@@ -202,9 +281,9 @@ class Gateway:
             writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
             await writer.drain()
         body = await reader.readexactly(clen) if clen else b""
-        return method, path, body
+        return method, path, body, trace_id
 
-    async def _route(self, method, path, body, writer):
+    async def _route(self, method, path, body, writer, span):
         if method == "GET" and path == "/healthz":
             alive = len(self.router.alive_names())
             total = len(self.router.replicas)
@@ -212,27 +291,29 @@ class Gateway:
                       else "degraded" if alive else "down")
             await self._send_json(writer, 200 if alive else 503, {
                 "status": status, "replicas_alive": alive,
-                "replicas_total": total})
+                "replicas_total": total}, span=span)
         elif method == "GET" and path == "/v1/models":
             await self._send_json(writer, 200, {
                 "object": "list",
                 "data": [{"id": self.model_id, "object": "model",
-                          "owned_by": "paddle_tpu"}]})
+                          "owned_by": "paddle_tpu"}]}, span=span)
         elif method == "GET" and path == "/metrics":
             loop = asyncio.get_running_loop()
             text = await loop.run_in_executor(
                 None, self.router.metrics_prometheus)
             await self._send_raw(
                 writer, 200, text.encode(),
-                ctype="text/plain; version=0.0.4; charset=utf-8")
+                ctype="text/plain; version=0.0.4; charset=utf-8",
+                span=span)
         elif method == "POST" and path == "/v1/completions":
-            await self._completions(body, writer)
+            await self._completions(body, writer, span)
         else:
             await self._send_error(writer, "not_found",
-                                   f"no route {method} {path}")
+                                   f"no route {method} {path}",
+                                   span=span)
 
     # ------------------------------------------------------ completions
-    async def _completions(self, body, writer):
+    async def _completions(self, body, writer, span):
         try:
             obj = json.loads(body.decode() or "null")
         except (ValueError, UnicodeDecodeError) as e:
@@ -240,18 +321,31 @@ class Gateway:
                                          f"body is not JSON: {e}")
         req = protocol.parse_completion_request(obj, self.model_id)
         loop = asyncio.get_running_loop()
+        trace_id = span["trace_id"]
         try:
             gid = await loop.run_in_executor(
                 None, lambda: self.router.submit(
                     req.prompt, request_id=req.request_id,
-                    **req.submit_kwargs()))
+                    trace_id=trace_id, **req.submit_kwargs()))
         except ValueError as e:
             # engine-side validation (prompt + max_tokens exceeding the
             # ring capacity, disabled repetition penalty, ...) is a
             # MALFORMED REQUEST, not a server bug
             raise protocol.ProtocolError("bad_request", str(e))
+        span["gid"] = gid
+        # an idempotent repeat (request_id already live) returns the
+        # ORIGINAL submission's gid — adopt its trace id so the echoed
+        # header/body/SSE ids match the engine spans and router audit
+        # instead of the retry's fresh id, which traces nothing. Only
+        # requests carrying a request_id can be repeats, so the common
+        # path skips the extra executor hop + router lock round-trip.
+        if req.request_id is not None:
+            canon = await loop.run_in_executor(
+                None, self.router.trace_id_of, gid)
+            if canon is not None and canon != trace_id:
+                span["trace_id"] = trace_id = canon
         if req.stream:
-            await self._stream(req, gid, writer)
+            await self._stream(req, gid, writer, span)
         else:
             tokens, state = [], "running"
             try:
@@ -277,14 +371,15 @@ class Gateway:
                 gid, self.model_id, time.time(), tokens,
                 protocol.finish_reason(tokens, req.stop_token_id,
                                        False),
-                len(req.prompt)))
+                len(req.prompt), trace_id=trace_id), span=span)
 
-    async def _stream(self, req, gid, writer):
+    async def _stream(self, req, gid, writer, span):
         """SSE: headers go out with the FIRST harvest batch, so a
         request that expires before any token still gets a clean 504
         (after the first byte the stream can only finish via
         finish_reason, OpenAI-style)."""
         loop = asyncio.get_running_loop()
+        trace_id = span["trace_id"]
         started = False
         last_tok = None
         sent = 0
@@ -295,11 +390,12 @@ class Gateway:
                 sent += len(new)
                 if new:
                     if not started:
-                        await self._send_sse_headers(writer)
+                        await self._send_sse_headers(writer, span)
                         started = True
                     writer.write(protocol.sse_event(
                         protocol.stream_chunk(gid, self.model_id,
-                                              time.time(), new)))
+                                              time.time(), new,
+                                              trace_id=trace_id)))
                     last_tok = new[-1]
                     await writer.drain()
                 if done:
@@ -312,7 +408,8 @@ class Gateway:
             # garbage — terminate the STREAM honestly instead
             # (finish_reason "error" + [DONE], see protocol.py)
             writer.write(protocol.sse_event(protocol.stream_chunk(
-                gid, self.model_id, time.time(), [], reason="error")))
+                gid, self.model_id, time.time(), [], reason="error",
+                trace_id=trace_id)))
             writer.write(protocol.SSE_DONE)
             await writer.drain()
             return
@@ -327,33 +424,43 @@ class Gateway:
                     "deadline_exceeded",
                     f"request exceeded deadline_s={req.deadline_s} "
                     "before its first token")
-            await self._send_sse_headers(writer)
+            await self._send_sse_headers(writer, span)
         reason = protocol.finish_reason(
             [] if last_tok is None else [last_tok], req.stop_token_id,
             expired)
         writer.write(protocol.sse_event(protocol.stream_chunk(
-            gid, self.model_id, time.time(), [], reason=reason)))
+            gid, self.model_id, time.time(), [], reason=reason,
+            trace_id=trace_id)))
         writer.write(protocol.SSE_DONE)
         await writer.drain()
 
     # ---------------------------------------------------------- writers
-    async def _send_json(self, writer, status, obj, extra=None):
+    async def _send_json(self, writer, status, obj, extra=None,
+                         span=None):
         await self._send_raw(writer, status,
                              json.dumps(obj).encode(),
-                             ctype="application/json", extra=extra)
+                             ctype="application/json", extra=extra,
+                             span=span)
 
-    async def _send_error(self, writer, code, message, extra=None):
+    async def _send_error(self, writer, code, message, extra=None,
+                          span=None):
         status, body = protocol.error_body(code, message)
-        await self._send_json(writer, status, body, extra=extra)
+        await self._send_json(writer, status, body, extra=extra,
+                              span=span)
 
-    async def _send_sse_headers(self, writer):
-        writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: text/event-stream\r\n"
-                     b"Cache-Control: no-cache\r\n"
-                     b"Connection: close\r\n\r\n")
+    async def _send_sse_headers(self, writer, span=None):
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n")
+        if span is not None:
+            span["status"] = 200
+            head += (f"{protocol.TRACE_HEADER}: "
+                     f"{span['trace_id']}\r\n").encode()
+        writer.write(head + b"Connection: close\r\n\r\n")
         await writer.drain()
 
-    async def _send_raw(self, writer, status, payload, ctype, extra=None):
+    async def _send_raw(self, writer, status, payload, ctype,
+                        extra=None, span=None):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   429: "Too Many Requests", 500: "Internal Server Error",
                   503: "Service Unavailable",
@@ -362,6 +469,12 @@ class Gateway:
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(payload)}",
                 "Connection: close"]
+        if span is not None:
+            # every traced response echoes the trace context header —
+            # the client (or a proxy) can correlate any reply, error
+            # rows included, with the merged cluster trace
+            span["status"] = status
+            head.append(f"{protocol.TRACE_HEADER}: {span['trace_id']}")
         for k, v in (extra or {}).items():
             head.append(f"{k}: {v}")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + payload)

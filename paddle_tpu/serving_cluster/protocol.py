@@ -31,7 +31,7 @@ __all__ = ["ProtocolError", "CompletionRequest", "ERROR_STATUS",
            "RETRY_AFTER_S", "COMPLETION_FIELDS", "CHOICE_FIELDS",
            "USAGE_FIELDS", "STREAM_CHUNK_FIELDS", "MODELS_FIELDS",
            "MODEL_ENTRY_FIELDS", "HEALTHZ_FIELDS", "ERROR_BODY_FIELDS",
-           "ENDPOINTS", "parse_completion_request",
+           "ENDPOINTS", "TRACE_HEADER", "parse_completion_request",
            "completion_response", "stream_chunk", "sse_event",
            "SSE_DONE", "error_body", "finish_reason"]
 
@@ -54,12 +54,20 @@ ERROR_STATUS = {
 # the client WHEN, not just no
 RETRY_AFTER_S = 1
 
+# the end-to-end trace context header: the gateway honors an inbound
+# id (so an upstream proxy can pre-mint) or mints one, echoes it on
+# EVERY response, and threads it through router -> replica -> engine —
+# one curl -H "X-Request-Id: ..." is findable in the merged cluster
+# trace, the router audit, and each replica's request spans
+TRACE_HEADER = "X-Request-Id"
+
 # ---------------------------------------------------- response shapes
 COMPLETION_FIELDS = ("id", "object", "created", "model", "choices",
-                     "usage")
+                     "usage", "trace_id")
 CHOICE_FIELDS = ("index", "text", "tokens", "finish_reason")
 USAGE_FIELDS = ("prompt_tokens", "completion_tokens", "total_tokens")
-STREAM_CHUNK_FIELDS = ("id", "object", "created", "model", "choices")
+STREAM_CHUNK_FIELDS = ("id", "object", "created", "model", "choices",
+                       "trace_id")
 MODELS_FIELDS = ("object", "data")
 MODEL_ENTRY_FIELDS = ("id", "object", "owned_by")
 HEALTHZ_FIELDS = ("status", "replicas_alive", "replicas_total")
@@ -185,7 +193,7 @@ def _choice(tokens, reason):
 
 
 def completion_response(req_id, model, created, tokens, reason,
-                        prompt_tokens):
+                        prompt_tokens, trace_id=None):
     return {
         "id": req_id, "object": "text_completion",
         "created": int(created), "model": model,
@@ -193,15 +201,19 @@ def completion_response(req_id, model, created, tokens, reason,
         "usage": {"prompt_tokens": int(prompt_tokens),
                   "completion_tokens": len(tokens),
                   "total_tokens": int(prompt_tokens) + len(tokens)},
+        "trace_id": trace_id,
     }
 
 
-def stream_chunk(req_id, model, created, tokens, reason=None):
+def stream_chunk(req_id, model, created, tokens, reason=None,
+                 trace_id=None):
     """One SSE data payload: the DELTA tokens since the last chunk
-    (``finish_reason`` only on the final chunk, OpenAI-style)."""
+    (``finish_reason`` only on the final chunk, OpenAI-style; every
+    chunk carries the request's trace id so a mid-stream failover is
+    correlatable from the client side)."""
     return {"id": req_id, "object": "text_completion.chunk",
             "created": int(created), "model": model,
-            "choices": [_choice(tokens, reason)]}
+            "choices": [_choice(tokens, reason)], "trace_id": trace_id}
 
 
 def sse_event(payload) -> bytes:

@@ -164,6 +164,18 @@ class LocalReplica:
         with self._lock:
             return self.engine.metrics_prometheus()
 
+    def trace_dump(self):
+        """JSON-able dump of the engine's telemetry rings for the
+        cluster trace export (telemetry.trace_dump + replica name).
+        Deliberately NO alive check: a killed replica's rings are the
+        post-mortem — its stranded in-flight spans are exactly what the
+        merged kill-drill trace must show."""
+        from ..inference.telemetry import trace_dump
+        with self._lock:
+            d = trace_dump(self.engine)
+        d["replica"] = self.name
+        return d
+
 
 # ----------------------------------------------------------- rpc worker
 # Module-level state + functions so they pickle by reference through
@@ -211,6 +223,10 @@ def _rw_snapshot():
 
 def _rw_prometheus():
     return _served().metrics_prometheus()
+
+
+def _rw_trace_dump():
+    return _served().trace_dump()
 
 
 class RpcReplica:
@@ -299,3 +315,9 @@ class RpcReplica:
 
     def metrics_prometheus(self):
         return self._call(_rw_prometheus)
+
+    def trace_dump(self):
+        """The worker's telemetry rings over rpc (ReplicaError when the
+        process is gone — unlike a LocalReplica there is no in-process
+        corpse to read; the cluster export skips it with a note)."""
+        return self._call(_rw_trace_dump)

@@ -35,6 +35,18 @@ documented as NOT replay-identical).
 Snapshots are trusted only at the pinned ``SNAPSHOT_SCHEMA_VERSION``:
 a replica reporting an unknown version is excluded from load scoring
 (counted in ``version_mismatches``) instead of being silently misread.
+
+Every placement is AUDITED: the router records WHY each request landed
+where it did — policy, per-candidate load scores, chosen replica, and
+a reason from ``AUDIT_REASONS`` — in a bounded ring
+(``PADDLE_ROUTER_AUDIT_RING``, default 2048), with per-reason counters
+in the ``/metrics`` exposition
+(``paddle_gateway_route_decisions_total{reason=...}``) and the full
+entries merged into the cluster Perfetto export (trace.py). Trace
+context rides along: ``submit`` mints (or accepts) a ``trace_id`` and
+threads it through every replica submit — failover re-submits carry
+the SAME trace id with an incremented attempt, so a kill-drill stream
+yields one joined trace.
 """
 from __future__ import annotations
 
@@ -43,14 +55,26 @@ import hashlib
 import os
 import threading
 import time
+import uuid
+from collections import deque
 
 from ..inference.serving import AdmissionFull
 from ..inference.telemetry import SNAPSHOT_SCHEMA_VERSION
 from .replica import ReplicaError
 
-__all__ = ["HashRing", "Router", "NoReplicaError", "POLICIES"]
+__all__ = ["HashRing", "Router", "NoReplicaError", "POLICIES",
+           "AUDIT_REASONS"]
 
 POLICIES = ("prefix_affinity", "least_loaded", "round_robin")
+
+# every reason a placement decision can record (pinned by
+# tools/check_metrics_surface.py — the audit counters' label set must
+# not drift): affinity_hit = consistent-hash owner took it, spill =
+# saturated/shedding owner overflowed to least-loaded, least_loaded /
+# round_robin = the policy's own choice, failover = re-submit after a
+# replica death, orphaned = failover found nowhere to go
+AUDIT_REASONS = ("affinity_hit", "least_loaded", "round_robin", "spill",
+                 "failover", "orphaned")
 
 
 class NoReplicaError(ReplicaError):
@@ -115,12 +139,14 @@ def _locked(fn):
 class _Assignment:
     __slots__ = ("gid", "request_id", "prompt", "kw", "replica", "rid",
                  "tokens", "skip", "done", "state", "resubmits",
-                 "t_submit", "orphaned", "failed", "dup_returns")
+                 "t_submit", "orphaned", "failed", "dup_returns",
+                 "trace_id")
 
     def __init__(self, gid, request_id, prompt, kw, replica, rid,
-                 t_submit):
+                 t_submit, trace_id=None):
         self.gid = gid
         self.request_id = request_id
+        self.trace_id = trace_id          # cluster trace context
         self.prompt = prompt
         self.kw = kw
         self.replica = replica            # None = placement in flight
@@ -155,7 +181,8 @@ class Router:
     lands in the assignment's history exactly once."""
 
     def __init__(self, replicas, policy=None, spill_depth=None,
-                 hb_dead_s=None, snap_max_age_s=None, clock=None):
+                 hb_dead_s=None, snap_max_age_s=None, clock=None,
+                 audit_ring=None):
         self.replicas = {r.name: r for r in replicas}
         if len(self.replicas) != len(replicas):
             raise ValueError("replica names must be unique")
@@ -188,6 +215,20 @@ class Router:
         self.failovers_total = 0
         self.version_mismatches = 0
         self._prefill_cap = None
+        # placement decision audit: bounded ring of WHY each request
+        # landed where it did, plus per-reason counters (exposed in
+        # /metrics and merged into the cluster Perfetto export)
+        ar = int(audit_ring if audit_ring is not None
+                 else os.environ.get("PADDLE_ROUTER_AUDIT_RING", "2048"))
+        if ar < 0:
+            raise ValueError(f"audit ring must be >= 0, got {ar}")
+        # 0 disables the ring (no per-decision entry is built or
+        # stored) but the per-reason counters stay — they're pinned in
+        # /metrics by tools/check_metrics_surface.py and cost one dict
+        # increment per placement
+        self.audit_enabled = ar > 0
+        self.audit = deque(maxlen=max(ar, 1))
+        self.audit_counts = {r: 0 for r in AUDIT_REASONS}
 
     # -------------------------------------------------------- snapshots
     def alive_names(self):
@@ -272,26 +313,54 @@ class Router:
         return ",".join(str(int(t)) for t in prompt[:cap]).encode()
 
     def _choose(self, prompt, names):
+        """One policy choice over ``names``: returns ``(name, reason)``
+        with reason from AUDIT_REASONS — the decision audit records WHY
+        alongside WHERE."""
         if self.policy == "round_robin":
             self._rr += 1
-            return names[self._rr % len(names)]
+            return names[self._rr % len(names)], "round_robin"
         if self.policy == "least_loaded":
-            return self._least_loaded(names)
+            return self._least_loaded(names), "least_loaded"
         key = self.prefix_key(prompt)
         if key is None:
-            return self._least_loaded(names)
+            return self._least_loaded(names), "least_loaded"
         owner = self.ring.owner(key)
         if owner not in names:
-            return self._least_loaded(names)
+            return self._least_loaded(names), "least_loaded"
         snap = self._snap(owner)
         if snap is not None and snap["queue_depth"] >= self.spill_depth:
             # saturation spill: the hot replica keeps its cache, the
             # overflow goes wherever there is headroom
-            return self._least_loaded(names)
-        return owner
+            return self._least_loaded(names), "spill"
+        return owner, "affinity_hit"
+
+    def _record_decision(self, asg, chosen, reason, scores, attempt):
+        """Append one audit entry (bounded ring) + bump its reason
+        counter. JSON-able by construction (the cluster trace export
+        and tools/slo_report.py both consume entries verbatim):
+        unknown-snapshot scores (inf) are recorded as None. Ring size
+        0 skips the entry entirely; the reason counter always bumps."""
+        entry = None
+        if self.audit_enabled:
+            entry = {
+                "t": self.clock(),
+                "gid": asg.gid,
+                "trace_id": asg.trace_id,
+                "attempt": int(attempt),
+                "policy": self.policy,
+                "chosen": chosen,
+                "reason": reason,
+                "scores": {n: (None if s == float("inf")
+                               else round(s, 4))
+                           for n, s in scores.items()},
+            }
+        with self._lock:
+            if entry is not None:
+                self.audit.append(entry)
+            self.audit_counts[reason] += 1
 
     # ------------------------------------------------------- submit path
-    def submit(self, prompt, request_id=None, **kw):
+    def submit(self, prompt, request_id=None, trace_id=None, **kw):
         """Route one request; returns the gateway-global id (gid).
         Idempotent on ``request_id``: a repeat — concurrent or later,
         while the original assignment is live — returns the existing
@@ -300,8 +369,17 @@ class Router:
         into two engine submissions). AdmissionFull propagates only
         when EVERY alive replica sheds (honest cluster-wide
         backpressure); a replica that dies mid-submit is failed over
-        transparently."""
+        transparently.
+
+        ``trace_id`` is the cluster trace context (the gateway mints
+        one per HTTP request, honoring an inbound ``X-Request-Id``);
+        direct callers that pass none get a minted id, so every
+        placement is traceable. The id survives failover re-submits
+        (attempt increments), joining the request's spans across
+        replicas."""
         prompt = [int(t) for t in prompt]
+        if trace_id is None:
+            trace_id = uuid.uuid4().hex
         with self._lock:
             if request_id is not None \
                     and request_id in self._by_request_id:
@@ -313,14 +391,14 @@ class Router:
             self._gid += 1
             gid = f"req-{self._gid}"
             asg = _Assignment(gid, request_id, prompt, kw, None, None,
-                              self.clock())
+                              self.clock(), trace_id=str(trace_id))
             self._table[gid] = asg
             if request_id is not None:
                 self._by_request_id[request_id] = gid
             self.submits_total += 1
         self.refresh()
         try:
-            name, rid = self._place(prompt, kw)
+            name, rid = self._place(prompt, kw, asg=asg, attempt=1)
         except Exception as e:
             with self._lock:
                 # unwind the reservation — unless a concurrent
@@ -349,31 +427,55 @@ class Router:
             self._failover_one(asg)
         return gid
 
-    def _place(self, prompt, kw, exclude=()):
+    def _place(self, prompt, kw, exclude=(), asg=None, attempt=1,
+               reason_override=None):
         """One placement attempt over the alive set: policy choice
         first, then the remaining candidates by load on AdmissionFull
         (spill), marking dead anything that errors. The replica submit
         itself runs OUTSIDE the router lock (a frozen replica must not
         stall unrelated requests). Raises the LAST AdmissionFull when
-        everyone sheds."""
+        everyone sheds. A successful placement is recorded in the
+        decision audit (reason from the policy choice; ``spill`` once a
+        shed forced a retry elsewhere; ``reason_override`` stamps the
+        failover path)."""
         last_full = None
         tried = set(exclude)
+        shed = False
         while True:
             with self._lock:
                 names = [n for n in self.alive_names()
                          if n not in tried]
-                name = self._choose(prompt, names) if names else None
+                if names:
+                    name, reason = self._choose(prompt, names)
+                    # the per-candidate score dict exists only for the
+                    # audit entry — skip it when the ring is off
+                    scores = ({n: self.load_score(self._snap(n))
+                               for n in names}
+                              if self.audit_enabled else {})
+                else:
+                    name = None
             if name is None:
                 if last_full is not None:
                     raise last_full
                 raise NoReplicaError("no alive replica to place on")
             tried.add(name)
             try:
-                return name, self.replicas[name].submit(prompt, **kw)
+                rid = self.replicas[name].submit(
+                    prompt,
+                    trace_id=None if asg is None else asg.trace_id,
+                    attempt=attempt, **kw)
             except AdmissionFull as e:
                 last_full = e
+                shed = True               # the next landing is a spill
             except ReplicaError:
                 self.mark_dead(name)
+            else:
+                if asg is not None:
+                    self._record_decision(
+                        asg, name,
+                        reason_override or ("spill" if shed else reason),
+                        scores, attempt)
+                return name, rid
 
     # ------------------------------------------------------ harvest path
     def harvest(self, gid, cursor=None):
@@ -442,7 +544,19 @@ class Router:
             return None
         return {"gid": gid, "replica": asg.replica, "done": asg.done,
                 "state": asg.state, "delivered": len(asg.tokens),
-                "resubmits": asg.resubmits}
+                "resubmits": asg.resubmits, "trace_id": asg.trace_id,
+                "attempt": asg.resubmits + 1}
+
+    def trace_id_of(self, gid):
+        """The trace id riding assignment ``gid`` (None once
+        released). The gateway re-reads this after submit: an
+        idempotent repeat returns the ORIGINAL submission's gid, and
+        the response must echo the trace id the engine spans and the
+        decision audit actually carry — not whatever fresh id the
+        retry arrived with."""
+        with self._lock:
+            got = self._table.get(gid)
+            return None if got is None else got.trace_id
 
     def release(self, gid):
         """Forget a finished/abandoned request (client disconnect).
@@ -521,14 +635,21 @@ class Router:
                     asg.done, asg.state = True, "expired"
                 return
             kw["deadline_s"] = remaining
+        # same trace id, NEXT attempt: the re-submitted stream joins
+        # the original's trace (resubmits bumps only after placement
+        # lands, so attempt = prior resubmits + this one + 1)
+        attempt = asg.resubmits + 2
         try:
-            new_name, rid = self._place(asg.prompt, kw)
+            new_name, rid = self._place(asg.prompt, kw, asg=asg,
+                                        attempt=attempt,
+                                        reason_override="failover")
         except (AdmissionFull, NoReplicaError):
             # nowhere to go RIGHT NOW: orphan it honestly; the
             # gateway surfaces 503/429 instead of hanging
             with self._lock:
                 asg.orphaned = True
                 asg.state = "orphaned"
+            self._record_decision(asg, None, "orphaned", {}, attempt)
             return
         with self._lock:
             if asg.gid in self._table and not asg.done:
@@ -545,20 +666,23 @@ class Router:
     # ------------------------------------------------------- aggregation
     def metrics_prometheus(self):
         """Cluster exposition: each alive replica's engine exposition
-        with a ``replica`` label injected on every sample, plus the
-        router's own gauges (replica I/O outside the lock). One scrape
-        shows the whole cluster."""
+        with a ``replica`` label injected on every sample, the GATEWAY
+        PROCESS's own runtime registry (HTTP latency histograms, rpc
+        client latency) under ``replica="gateway"``, the router's
+        placement-decision counters, and the router gauges (replica
+        I/O outside the lock). One scrape shows the whole cluster.
+
+        Note for in-process (LocalReplica) clusters: the gateway and
+        its replicas share one process, so process-global runtime
+        families legitimately appear under both a replica label and
+        the gateway label — distinct series, one HELP/TYPE."""
         with self._lock:
             names = self.alive_names()
         lines = []
         seen_meta = set()
-        for name in names:
-            try:
-                text = self.replicas[name].metrics_prometheus()
-            except ReplicaError:
-                self.mark_dead(name)
-                continue
-            for ln in _relabel(text, name):
+
+        def _append(text, label):
+            for ln in _relabel(text, label):
                 if ln.startswith("#"):
                     # ONE HELP/TYPE line per family across the whole
                     # cluster: Prometheus rejects a second HELP line
@@ -570,6 +694,29 @@ class Router:
                         continue
                     seen_meta.add(key)
                 lines.append(ln)
+
+        for name in names:
+            try:
+                text = self.replicas[name].metrics_prometheus()
+            except ReplicaError:
+                self.mark_dead(name)
+                continue
+            _append(text, name)
+        # the gateway process's own runtime registry: HTTP endpoint
+        # latency histograms (gateway.py records them per
+        # endpoint+status) and the rpc client's call latency — the
+        # front-end's accept/parse/stream time was invisible when
+        # /metrics only relabeled engine expositions
+        from ..inference.telemetry import runtime_prometheus
+        _append("\n".join(runtime_prometheus()) + "\n", "gateway")
+        with self._lock:
+            name = "paddle_gateway_route_decisions_total"
+            lines.append(f"# HELP {name} placements by audit reason "
+                         "(router decision audit ring)")
+            lines.append(f"# TYPE {name} counter")
+            for reason in AUDIT_REASONS:
+                lines.append(f'{name}{{reason="{reason}"}} '
+                             f"{self.audit_counts[reason]}")
         with self._lock:
             gauges = (
                 ("paddle_gateway_replicas_alive", "gauge",

@@ -40,9 +40,18 @@ GW_ENV_VARS = (
     "PADDLE_GATEWAY_POLL_S",       # SSE harvest poll interval
     "PADDLE_GATEWAY_PORT",         # gateway listen port (0 = ephemeral)
     "PADDLE_GATEWAY_REPLICAS",     # demo-cluster replica count
+    "PADDLE_GATEWAY_TRACE_RING",   # HTTP span ring size (0 = off)
+    "PADDLE_ROUTER_AUDIT_RING",    # decision ring (0 = ring off;
+                                   # reason counters stay)
     "PADDLE_ROUTER_POLICY",        # prefix_affinity|least_loaded|round_robin
     "PADDLE_ROUTER_SNAP_AGE_S",    # snapshot staleness bound
     "PADDLE_ROUTER_SPILL_DEPTH",   # owner queue depth -> affinity spill
+    # SLO objectives (inference/telemetry.py SloPolicy): a leaked
+    # objective silently flips every later engine's goodput counters —
+    # same guard discipline as the router knobs
+    "PADDLE_SLO_E2E_S",            # end-to-end latency objective (s)
+    "PADDLE_SLO_ITL_S",            # mean inter-token latency objective
+    "PADDLE_SLO_TTFT_S",           # time-to-first-token objective (s)
 )
 
 

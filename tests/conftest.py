@@ -146,6 +146,19 @@ def check_serving_metrics(eng):
     else:
         assert m["budget_steps"] == 0 and m["budget_tokens_used"] == 0
         assert m["budget_utilization"] is None
+    # SLO/goodput reconciliation: every FINISHED request gets exactly
+    # one verdict (ok / violated-by-queueing / violated-by-service), so
+    # the three counters must sum to requests_finished — with no
+    # objectives declared everything is ok
+    assert (m["slo_ok"] + m["slo_violated_queue"]
+            + m["slo_violated_service"]) == m["requests_finished"], (
+        f"SLO accounting broke: ok={m['slo_ok']} + "
+        f"queue={m['slo_violated_queue']} + "
+        f"service={m['slo_violated_service']} != "
+        f"finished={m['requests_finished']}")
+    if not getattr(eng, "_slo").enabled:
+        assert m["slo_violated_queue"] == 0
+        assert m["slo_violated_service"] == 0
     # paged-pool block accounting: the allocator must reconcile on
     # EVERY serving test — used + free == NBtotal (a refcounted block
     # shared by N slot tables and the prefix store is ONE physical
@@ -180,9 +193,18 @@ def check_serving_metrics(eng):
         assert (m["ttft_p50_s"] is None) == (tele.hist_ttft.count == 0)
         assert (m["latency_p50_s"] is None) == (tele.hist_latency.count
                                                 == 0)
+        # queue/service decomposition observes exactly the finished set
+        # (the SLO layer's cause-attribution source)
+        assert tele.hist_queue.count == m["requests_finished"]
+        assert tele.hist_service.count == m["requests_finished"]
+        assert (m["queue_p50_s"] is None) == (tele.hist_queue.count == 0)
+        assert (m["service_p50_s"] is None) == (tele.hist_service.count
+                                                == 0)
         for a, b in (("ttft_p50_s", "ttft_p90_s"),
                      ("ttft_p90_s", "ttft_p99_s"),
-                     ("latency_p50_s", "latency_p99_s")):
+                     ("latency_p50_s", "latency_p99_s"),
+                     ("queue_p50_s", "queue_p99_s"),
+                     ("service_p50_s", "service_p99_s")):
             if m[a] is not None:
                 assert 0.0 <= m[a] <= m[b], (a, b, m[a], m[b])
         assert m["queue_depth"] >= 0 and 0.0 <= m["occupancy"] <= 1.0

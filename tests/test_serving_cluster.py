@@ -141,6 +141,10 @@ class FakeReplica:
     def heartbeat_age(self):
         return 0.0
 
+    def metrics_prometheus(self):
+        return ("# HELP fake_metric a stub sample\n"
+                "# TYPE fake_metric gauge\nfake_metric 1\n")
+
     @property
     def alive(self):
         return True
@@ -298,6 +302,97 @@ class TestRouterPolicies:
         new, d2, _ = r.harvest(gid, c2)    # reader 2 starts late
         s2 += new
         assert d2 and s1 == s2 == [1, 2, 3, 4, 5]
+
+
+# =====================================================================
+# router decision audit (the placement explainability surface)
+# =====================================================================
+class TestRouterAudit:
+    def test_reason_coverage_and_counters(self):
+        from paddle_tpu.serving_cluster import AUDIT_REASONS
+        reps = [FakeReplica("r0"), FakeReplica("r1")]
+        r = _router(reps, policy="prefix_affinity", spill_depth=4)
+        template = [5, 6, 7, 8, 9]
+        r.submit(template, max_new_tokens=2)        # affinity_hit
+        assert r.audit[-1]["reason"] == "affinity_hit"
+        owner_name = r.audit[-1]["chosen"]
+        r.submit([1, 2, 3], max_new_tokens=2)       # short: least_loaded
+        assert r.audit[-1]["reason"] == "least_loaded"
+        owner = next(rep for rep in reps if rep.name == owner_name)
+        owner.queue_depth = 4                       # saturate the owner
+        r.submit(template, max_new_tokens=2)        # -> spill
+        assert r.audit[-1]["reason"] == "spill"
+        owner.queue_depth = 0
+        owner.full = True                           # shedding owner
+        r.submit(template, max_new_tokens=2)        # -> spill (retry)
+        assert r.audit[-1]["reason"] == "spill"
+        owner.full = False
+        # failover: kill the replica holding a live assignment
+        gid = r.submit(template, max_new_tokens=2, trace_id="aud-1")
+        held_by = r.poll(gid)["replica"]
+        r.mark_dead(held_by)
+        assert r.audit[-1]["reason"] == "failover"
+        assert r.audit[-1]["trace_id"] == "aud-1"
+        assert r.audit[-1]["attempt"] == 2
+        # orphaned: the survivor dies too, draining onto nothing
+        survivor = next(n for n in r.alive_names())
+        r.submit(template, max_new_tokens=2)
+        r.mark_dead(survivor)
+        assert any(e["reason"] == "orphaned" and e["chosen"] is None
+                   for e in r.audit)
+        # counters reconcile with the ring's full history (the ring
+        # here is unbounded enough to hold everything)
+        assert sum(r.audit_counts.values()) == len(r.audit)
+        assert set(r.audit_counts) == set(AUDIT_REASONS)
+        # every entry is JSON-able (the cluster trace consumes it)
+        json.dumps(list(r.audit))
+        # round_robin policy stamps its own reason
+        rr = _router([FakeReplica("a"), FakeReplica("b")],
+                     policy="round_robin")
+        rr.submit([1, 2, 3], max_new_tokens=2)
+        assert rr.audit[-1]["reason"] == "round_robin"
+        # ... and the exposition carries the per-reason counters
+        text = rr.metrics_prometheus()
+        assert ('paddle_gateway_route_decisions_total'
+                '{reason="round_robin"} 1') in text
+        assert ('paddle_gateway_route_decisions_total'
+                '{reason="failover"} 0') in text
+
+    def test_audit_ring_bounded(self):
+        reps = [FakeReplica("a"), FakeReplica("b")]
+        r = _router(reps, policy="least_loaded", audit_ring=4)
+        for i in range(10):
+            r.submit([1, 2, i], max_new_tokens=2)
+        assert len(r.audit) == 4                    # bounded
+        assert r.audit_counts["least_loaded"] == 10  # counters keep all
+        # the ring holds the MOST RECENT decisions
+        assert [e["gid"] for e in r.audit] == \
+            [f"req-{i}" for i in range(7, 11)]
+
+    def test_audit_ring_zero_disables_entries_not_counters(self):
+        # PADDLE_ROUTER_AUDIT_RING=0 turns the ring off entirely, but
+        # the per-reason counters (pinned in /metrics) keep counting
+        r = _router([FakeReplica("a"), FakeReplica("b")],
+                    policy="least_loaded", audit_ring=0)
+        for i in range(5):
+            r.submit([1, 2, i], max_new_tokens=2)
+        assert len(r.audit) == 0
+        assert r.audit_counts["least_loaded"] == 5
+
+    def test_idempotent_repeat_keeps_original_trace_id(self):
+        # a retry with the same request_id but a fresh proxy-minted
+        # trace id must resolve to the ORIGINAL submission's trace id
+        # — that is the id the engine spans and the audit carry
+        r = _router([FakeReplica("a"), FakeReplica("b")],
+                    policy="least_loaded")
+        gid = r.submit([1, 2, 3], max_new_tokens=2,
+                       request_id="ridem", trace_id="trace-orig")
+        gid2 = r.submit([1, 2, 3], max_new_tokens=2,
+                        request_id="ridem", trace_id="trace-retry")
+        assert gid2 == gid
+        assert r.trace_id_of(gid) == "trace-orig"
+        r.release(gid)
+        assert r.trace_id_of(gid) is None
 
 
 # =====================================================================
@@ -527,6 +622,142 @@ class TestClusterE2E:
         assert state == "finished"
         assert router.failovers_total == 1
 
+    def test_trace_id_survives_failover_virtual_clock(self):
+        """THE trace-context contract, deterministically: one trace id
+        threads submit -> victim replica (attempt 1) -> failover ->
+        replacement replica (attempt 2), with token parity — the
+        engines' request spans join on the id across the kill."""
+        fmt, embed, head = _model()
+        clock = [0.0]
+        reps = [LocalReplica(f"replica{i}", _engine(fmt, embed, head),
+                             threaded=False, clock=lambda: clock[0])
+                for i in range(2)]
+        router = Router(reps, policy="round_robin", hb_dead_s=1.0,
+                        snap_max_age_s=0.0, clock=lambda: clock[0])
+        prompt = [int(t) for t in
+                  np.random.RandomState(3).randint(1, V, (10,))]
+        want = _oracle(fmt, embed, head, prompt, 20)
+        gid = router.submit(prompt, max_new_tokens=20,
+                            trace_id="trace-failover-1")
+        assert router.poll(gid)["trace_id"] == "trace-failover-1"
+        assert router.poll(gid)["attempt"] == 1
+        victim = router._table[gid].replica
+        vrep = router.replicas[victim]
+        got = []
+        deadline = time.monotonic() + WAIT_S
+        while len(got) < 3:
+            assert time.monotonic() < deadline
+            vrep.pump()
+            got += router.harvest(gid)[0]
+        # the victim engine's live span carries the trace id, attempt 1
+        vspan = next(sp for sp in vrep.engine.telemetry._live.values()
+                     if sp.trace_id == "trace-failover-1")
+        assert vspan.attempt == 1
+        vrep.kill()
+        clock[0] += 2.0
+        assert router.check_health() == [victim]
+        assert router.poll(gid)["attempt"] == 2
+        other = router.replicas[router._table[gid].replica]
+        done = False
+        while not done:
+            assert time.monotonic() < deadline
+            other.pump()
+            new, done, _ = router.harvest(gid)
+            got += new
+        assert got == want
+        # the replacement engine's span: SAME trace id, attempt 2
+        dump = other.trace_dump()
+        span = next(s for s in dump["spans"]
+                    if s["trace_id"] == "trace-failover-1")
+        assert span["attempt"] == 2 and span["state"] == "finished"
+        # the victim's post-mortem dump still shows attempt 1
+        vdump = vrep.trace_dump()
+        vs = next(s for s in vdump["spans"]
+                  if s["trace_id"] == "trace-failover-1")
+        assert vs["attempt"] == 1 and vs["state"] != "finished"
+
+    def test_cluster_trace_merged_export(self, tmp_path):
+        """The acceptance gate: a kill-mid-stream drill exports ONE
+        merged Perfetto trace that validates and contains, for a
+        single trace id, the gateway HTTP span, a router decision,
+        and engine request spans on TWO replica pids at attempts 1
+        and 2 — with zero retraces per replica and greedy parity."""
+        from paddle_tpu.inference.telemetry import validate_chrome_trace
+        from paddle_tpu.serving_cluster import export_cluster_trace
+        fmt, embed, head = _model()
+        hits = {"n": 0}
+
+        def killer(rep):
+            hits["n"] += 1
+            if hits["n"] == 4:
+                rep.kill()
+
+        reps = [LocalReplica(f"replica{i}", _engine(fmt, embed, head),
+                             step_hook=killer)
+                for i in range(2)]
+        router = Router(reps, policy="round_robin", hb_dead_s=0.3,
+                        snap_max_age_s=0.0)
+        gw = Gateway(router, port=0, hb_s=0.05,
+                     poll_s=0.002).start_background()
+        try:
+            prompt = [int(t) for t in
+                      np.random.RandomState(0).randint(1, V, (12,))]
+            want = _oracle(fmt, embed, head, prompt, 60)
+            payload = json.dumps({"prompt": prompt, "max_tokens": 60,
+                                  "stream": True}).encode()
+            s = socket.create_connection(("127.0.0.1", gw.port),
+                                         timeout=WAIT_S)
+            s.sendall(b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
+                      b"X-Request-Id: trace-drill-1\r\n"
+                      b"Content-Length: %d\r\n\r\n%s"
+                      % (len(payload), payload))
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            s.close()
+            toks = []
+            for ln in buf.partition(b"\r\n\r\n")[2].split(b"\n"):
+                ln = ln.strip()
+                if not ln.startswith(b"data: ") or ln == b"data: [DONE]":
+                    continue
+                toks += json.loads(ln[6:])["choices"][0]["tokens"]
+            assert toks == want               # greedy parity through kill
+            assert router.failovers_total == 1
+
+            path = str(tmp_path / "cluster_trace.json")
+            export_cluster_trace(gw, path)
+            doc = validate_chrome_trace(path)
+            evs = doc["traceEvents"]
+            tid = "trace-drill-1"
+            http_spans = [e for e in evs
+                          if e.get("pid") == 0 and e.get("ph") == "X"
+                          and (e.get("args") or {}).get("trace_id") == tid
+                          and e["name"].startswith("POST")]
+            decisions = [e for e in evs
+                         if e.get("pid") == 0 and e.get("ph") == "X"
+                         and str(e["name"]).startswith("decision")
+                         and e["args"].get("trace_id") == tid]
+            rep_spans = [e for e in evs
+                         if e.get("pid", 0) > 0 and e.get("ph") == "X"
+                         and (e.get("args") or {}).get("trace_id") == tid]
+            assert http_spans, "gateway HTTP span missing"
+            assert decisions, "router decision event missing"
+            attempts = sorted(e["args"]["attempt"] for e in rep_spans)
+            pids = {e["pid"] for e in rep_spans}
+            assert attempts[0] == 1 and attempts[-1] == 2, attempts
+            assert len(pids) == 2, "failover did not span two replicas"
+            assert {e["args"]["reason"] for e in decisions} >= \
+                {"failover"}
+            # every event ts is non-negative (the anchor rebase holds)
+            assert all(e.get("ts", 0) >= 0 for e in evs)
+        finally:
+            gw.stop()
+            for r in reps:
+                r.close()
+
     def test_orphaned_when_no_replica_left(self):
         fmt, embed, head = _model()
         rep = LocalReplica("only", _engine(fmt, embed, head),
@@ -567,10 +798,20 @@ class TestRpcReplica:
             prompt = [int(t) for t in
                       np.random.RandomState(5).randint(1, V, (10,))]
             want = _oracle(fmt, embed, head, prompt, 6)
-            rid = rep.submit(prompt, max_new_tokens=6)
+            rid = rep.submit(prompt, max_new_tokens=6,
+                             trace_id="trace-rpc-1", attempt=2)
             snap = rep.snapshot()
             assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION
             assert snap["replica"] == "replica-rpc"
+            # snapshot v2: the slo block crosses the wire too
+            assert "slo" in snap and "objectives" in snap["slo"]
+            # trace context PROPAGATES over rpc: the worker engine's
+            # span carries the id/attempt the client submitted with
+            dump = rep.trace_dump()
+            assert dump["replica"] == "replica-rpc"
+            sp = next(s for s in dump["spans"]
+                      if s["trace_id"] == "trace-rpc-1")
+            assert sp["attempt"] == 2
             # AdmissionFull crosses the rpc boundary AS AdmissionFull
             # (backpressure stays backpressure, never a transport error)
             long = [1] * 20
@@ -612,22 +853,26 @@ def test_http_surface_pinned(capsys):
 
 
 def test_gateway_env_registry_complete():
-    """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_* env the package reads is
-    registered in testing.GW_ENV_VARS (the conftest leak guard's list),
-    and the registry carries no dead entries — same structural
-    discipline as FI_ENV_VARS/FR_ENV_VARS."""
+    """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_*/PADDLE_SLO_* env the
+    serving stack reads is registered in testing.GW_ENV_VARS (the
+    conftest leak guard's list), and the registry carries no dead
+    entries — same structural discipline as FI_ENV_VARS/FR_ENV_VARS.
+    The SLO knobs live in inference/telemetry.py (SloPolicy.from_env),
+    so that file joins the scan."""
     import re
 
+    import paddle_tpu.inference.telemetry as tele_mod
     import paddle_tpu.serving_cluster as sc
     from paddle_tpu.testing import GW_ENV_VARS
     pkg = os.path.dirname(os.path.abspath(sc.__file__))
+    paths = [os.path.join(pkg, fn) for fn in os.listdir(pkg)
+             if fn.endswith(".py")]
+    paths.append(os.path.abspath(tele_mod.__file__))
     found = set()
-    for fn in os.listdir(pkg):
-        if not fn.endswith(".py"):
-            continue
-        with open(os.path.join(pkg, fn)) as f:
+    for path in paths:
+        with open(path) as f:
             found |= set(re.findall(
-                r"PADDLE_(?:GATEWAY|ROUTER)_[A-Z_0-9]+", f.read()))
+                r"PADDLE_(?:GATEWAY|ROUTER|SLO)_[A-Z_0-9]+", f.read()))
     # the rpc-replica probe knob lives in replica.py; bench/tests may
     # reference more — the guard list must cover everything READ here
     assert found <= set(GW_ENV_VARS), (
@@ -635,3 +880,7 @@ def test_gateway_env_registry_complete():
         "add them to paddle_tpu.testing.GW_ENV_VARS")
     assert set(GW_ENV_VARS) <= found, (
         f"dead GW_ENV_VARS entries: {set(GW_ENV_VARS) - found}")
+    # the SLO registry constant in telemetry.py must agree with the
+    # guard list (one source of truth for the knob names)
+    from paddle_tpu.inference.telemetry import SLO_ENV_VARS
+    assert set(SLO_ENV_VARS) <= set(GW_ENV_VARS)

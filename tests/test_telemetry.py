@@ -279,6 +279,120 @@ class TestRequestSpans:
 # =====================================================================
 # Prometheus exposition
 # =====================================================================
+class TestSloGoodput:
+    """SLO/goodput layer (the cluster trace plane's accounting half):
+    every finished request gets exactly one verdict against the
+    declared objectives, violations attribute to queueing vs service,
+    and the snapshot schema (v2) carries the slo block + the
+    queue/service decomposition the autoscaler consumes."""
+
+    def test_classify_pure(self):
+        from paddle_tpu.inference.telemetry import SloPolicy
+        p = SloPolicy(ttft_s=0.5, itl_s=0.1, e2e_s=2.0)
+        assert p.enabled
+        # all objectives met
+        assert p.classify(0.0, 1.0, 0.4, 0.05, 1.0) == "ok"
+        # ttft blown, time dominated by service
+        assert p.classify(0.1, 1.0, 0.9, 0.05, 1.1) == "service"
+        # e2e blown, time dominated by queueing
+        assert p.classify(3.0, 0.5, 0.4, 0.05, 3.5) == "queue"
+        # itl objective alone
+        assert p.classify(0.0, 1.0, 0.4, 0.2, 1.0) == "service"
+        # no objectives = never violated
+        none = SloPolicy()
+        assert not none.enabled
+        assert none.classify(99.0, 99.0, 99.0, 99.0, 198.0) == "ok"
+        with pytest.raises(ValueError):
+            SloPolicy(ttft_s=0.0)
+
+    def test_queue_vs_service_attribution_virtual_clock(
+            self, serving_metrics_ok):
+        """num_slots=1 + a virtual clock: the head request is admitted
+        instantly (ok), the second waits a whole request's worth of
+        steps in the queue and blows the TTFT objective — attributed
+        to QUEUEING, deterministically."""
+        from paddle_tpu.inference.telemetry import SloPolicy
+        fmt, embed, head = _model()
+        clock = [0.0]
+
+        def tick():
+            # every read advances 1ms (busy_s must survive metrics()'
+            # 4-decimal rounding); the BIG advances happen between
+            # steps below
+            clock[0] += 1e-3
+            return clock[0]
+
+        eng = ServingEngine(fmt, embed, head, num_slots=1,
+                            max_seq_len=64, prefill_cap=4,
+                            clock=tick, slo=SloPolicy(ttft_s=0.5))
+        rng = np.random.RandomState(0)
+        r1 = eng.submit(_prompt(rng, 5), max_new_tokens=3)
+        r2 = eng.submit(_prompt(rng, 6), max_new_tokens=3)
+        while eng.has_work:
+            eng.step()
+            clock[0] += 1.0               # 1 virtual second per step
+        m = serving_metrics_ok(eng)
+        assert m["requests_finished"] == 2
+        assert m["slo_ok"] == 1           # r1: ttft 0.0
+        assert m["slo_violated_queue"] == 1   # r2 queued for seconds
+        assert m["slo_violated_service"] == 0
+        # the decomposition histograms saw exactly the finished pair
+        assert eng.telemetry.hist_queue.count == 2
+        assert m["queue_p99_s"] >= m["queue_p50_s"] >= 0.0
+        # and the per-request records reconcile with the verdicts
+        assert eng.results[r1]["ttft_s"] <= 0.5
+        assert eng.results[r2]["ttft_s"] > 0.5
+
+    def test_snapshot_v2_slo_block_and_exposition(
+            self, serving_metrics_ok):
+        from paddle_tpu.inference.telemetry import (
+            SNAPSHOT_SCHEMA_VERSION, SloPolicy)
+        fmt, embed, head = _model()
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=64, prefill_cap=4,
+                            slo=SloPolicy(ttft_s=1e-9))
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            eng.submit(_prompt(rng, 5), max_new_tokens=2)
+        eng.run()
+        m = serving_metrics_ok(eng)
+        # a 1ns TTFT objective is unmeetable on a real clock: every
+        # request is violated, split across the two causes
+        assert m["slo_ok"] == 0
+        assert (m["slo_violated_queue"]
+                + m["slo_violated_service"]) == 3
+        snap = eng.telemetry_snapshot()
+        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 2
+        slo = snap["slo"]
+        assert slo["objectives"]["ttft_s"] == 1e-9
+        assert (slo["ok"] + slo["violated_queue"]
+                + slo["violated_service"]) == 3
+        assert snap["histograms"]["queue_s"]["count"] == 3
+        assert snap["histograms"]["service_s"]["count"] == 3
+        json.dumps(snap)                  # still a wire payload
+        text = eng.metrics_prometheus()
+        assert "paddle_serving_slo_ok_total 0" in text
+        assert "paddle_serving_queue_time_seconds_bucket" in text
+        assert "paddle_serving_service_time_seconds_count 3" in text
+
+    def test_trace_dump_payload(self):
+        from paddle_tpu.inference.telemetry import trace_dump
+        fmt, embed, head = _model()
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=64, prefill_cap=4)
+        rng = np.random.RandomState(2)
+        eng.submit(_prompt(rng, 5), max_new_tokens=2,
+                   trace_id="trc-dump", attempt=3)
+        eng.run()
+        d = trace_dump(eng)
+        json.dumps(d)                     # crosses the rpc boundary
+        assert d["num_slots"] == 2 and d["t_wall"] > 0
+        sp = next(s for s in d["spans"] if s["trace_id"] == "trc-dump")
+        assert sp["attempt"] == 3 and sp["state"] == "finished"
+        assert [e[0] for e in sp["events"]][0] == "queued"
+        assert d["steps"], "step timeline missing from the dump"
+
+
 class TestPrometheus:
     def test_parse_and_counter_monotonic_across_reset(self):
         """The exposition round-trips a text parse, and every counter is

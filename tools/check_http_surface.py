@@ -15,8 +15,13 @@ Pinned end-to-end:
   * POST /v1/completions — COMPLETION_FIELDS / CHOICE_FIELDS /
     USAGE_FIELDS exactly; SSE chunks carry STREAM_CHUNK_FIELDS and the
     stream ends with ``data: [DONE]``.
+  * TRACE CONTEXT ECHO: every response carries ``X-Request-Id``
+    (protocol.TRACE_HEADER); an inbound id is honored verbatim in the
+    header, the JSON ``trace_id`` field, and every SSE chunk — the
+    wire contract the merged cluster trace joins on.
   * GET /v1/models, /healthz — field sets; /metrics — text exposition
-    with per-replica labels + gateway gauges.
+    with per-replica labels + gateway gauges + gateway HTTP latency
+    histograms + router decision counters.
   * Error mapping (ERROR_STATUS rows, each triggered for real):
     bad_request→400, unknown_model→404, not_found→404,
     deadline_exceeded→504, admission_full→429 (+ Retry-After),
@@ -54,23 +59,26 @@ def _build_engine(num_slots=2, **kw):
                          max_seq_len=64, prefill_cap=4, **kw)
 
 
-def _req(port, method, path, body=None, timeout=60):
+def _req(port, method, path, body=None, timeout=60, headers=None):
     c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     c.request(method, path,
               body=None if body is None else json.dumps(body),
-              headers={"Content-Type": "application/json"})
+              headers=dict({"Content-Type": "application/json"},
+                           **(headers or {})))
     r = c.getresponse()
     data = r.read()
     c.close()
     return r.status, {k.lower(): v for k, v in r.getheaders()}, data
 
 
-def _sse(port, body, timeout=120):
+def _sse(port, body, timeout=120, trace_id=None):
     """Raw-socket SSE read: returns (status_line+headers, data lines)."""
     payload = json.dumps(body).encode()
+    hdr = (b"" if trace_id is None
+           else b"X-Request-Id: %s\r\n" % trace_id.encode())
     s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
     s.sendall(b"POST /v1/completions HTTP/1.1\r\nHost: x\r\n"
-              b"Content-Type: application/json\r\n"
+              b"Content-Type: application/json\r\n" + hdr +
               b"Content-Length: %d\r\n\r\n%s" % (len(payload), payload))
     buf = b""
     while True:
@@ -126,11 +134,33 @@ def main(argv=None):
               f"finish_reason {ch.get('finish_reason')!r}")
         check(ch.get("text") == " ".join(str(t) for t in ch["tokens"]),
               "text is not the space-joined token ids")
+        # trace context echo: a minted id arrives in BOTH the header
+        # and the body, and they agree
+        check(hd.get(P.TRACE_HEADER.lower()) == obj.get("trace_id")
+              and obj.get("trace_id"),
+              f"trace echo broken: header "
+              f"{hd.get(P.TRACE_HEADER.lower())!r} vs body "
+              f"{obj.get('trace_id')!r}")
+        # ... and an INBOUND id is honored verbatim end-to-end
+        st, hd, data = _req(gw.port, "POST", "/v1/completions",
+                            {"prompt": prompt, "max_tokens": 2},
+                            headers={P.TRACE_HEADER: "pin-trace-7"})
+        obj = json.loads(data)
+        check(st == 200 and obj.get("trace_id") == "pin-trace-7"
+              and hd.get(P.TRACE_HEADER.lower()) == "pin-trace-7",
+              f"inbound {P.TRACE_HEADER} not honored: {st} "
+              f"{obj.get('trace_id')!r} {hd.get(P.TRACE_HEADER.lower())!r}")
 
         head, lines = _sse(gw.port, {"prompt": prompt, "max_tokens": 4,
-                                     "stream": True})
+                                     "stream": True},
+                           trace_id="pin-sse-9")
         check("200 OK" in head and "text/event-stream" in head,
               f"SSE head {head!r}")
+        check(f"{P.TRACE_HEADER}: pin-sse-9" in head,
+              f"SSE head lacks the trace header echo: {head!r}")
+        check(all(json.loads(ln).get("trace_id") == "pin-sse-9"
+                  for ln in lines[:-1]),
+              "SSE chunks lost the trace_id field")
         check(lines and lines[-1] == b"[DONE]",
               "SSE stream does not end with data: [DONE]")
         for ln in lines[:-1]:
@@ -170,6 +200,11 @@ def main(argv=None):
         check("paddle_gateway_replicas_alive" in text
               and "paddle_gateway_failovers_total" in text,
               "/metrics lacks gateway gauges")
+        check('paddle_gateway_route_decisions_total{reason="'
+              in text, "/metrics lacks router decision counters")
+        check("paddle_gateway_http_request_seconds_completions_200"
+              in text and 'replica="gateway"' in text,
+              "/metrics lacks the gateway HTTP latency histograms")
 
         # ---- error rows, each triggered for real ----
         seen = {}

@@ -146,6 +146,12 @@ def main(argv=None):
     # histogram would silently vanish from the rank-level exposition
     n_ops = _check_runtime_registry(failures)
 
+    # ---- 6. SLO counter names + router decision-audit counters: the
+    # goodput surface the autoscaling item will consume — dashboards
+    # key on these exact strings, so they are pinned BY VALUE, not just
+    # by the mapping-exists rule of section 3
+    _check_slo_and_audit_surface(failures)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -155,8 +161,48 @@ def main(argv=None):
           "by reset_metrics + conftest reconciliation + Prometheus "
           "exposition; snapshot schema pinned; "
           f"{n_ops} flight-recorder op histograms in the "
-          "runtime registry)")
+          "runtime registry; SLO + router-audit counter names pinned)")
     return 0
+
+
+def _check_slo_and_audit_surface(failures):
+    from paddle_tpu.inference.telemetry import PROMETHEUS_NAMES
+    from paddle_tpu.serving_cluster.router import AUDIT_REASONS, Router
+
+    pinned = {
+        "slo_ok": ("paddle_serving_slo_ok_total", "counter"),
+        "slo_violated_queue": (
+            "paddle_serving_slo_violated_queue_total", "counter"),
+        "slo_violated_service": (
+            "paddle_serving_slo_violated_service_total", "counter"),
+        "queue_p50_s": ("paddle_serving_queue_time_seconds",
+                        "histogram"),
+        "service_p50_s": ("paddle_serving_service_time_seconds",
+                          "histogram"),
+    }
+    for k, want in pinned.items():
+        got = PROMETHEUS_NAMES.get(k)
+        if got != want:
+            failures.append(
+                f"SLO metrics key {k!r} maps to {got!r}, pinned "
+                f"{want!r} — the goodput surface must not drift")
+    want_reasons = {"affinity_hit", "least_loaded", "round_robin",
+                    "spill", "failover", "orphaned"}
+    if set(AUDIT_REASONS) != want_reasons:
+        failures.append(
+            f"router AUDIT_REASONS drifted: {sorted(AUDIT_REASONS)} != "
+            f"{sorted(want_reasons)} (dashboards key on the reason "
+            "label values)")
+    # an EMPTY router still exposes every reason counter (zero-valued):
+    # the label set is discoverable before any traffic flows
+    text = Router([]).metrics_prometheus()
+    for reason in want_reasons:
+        probe = (f'paddle_gateway_route_decisions_total'
+                 f'{{reason="{reason}"}}')
+        if probe not in text:
+            failures.append(
+                f"router exposition lost the {reason!r} decision "
+                f"counter ({probe} not found)")
 
 
 def _check_snapshot_schema(failures, eng):
