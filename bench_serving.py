@@ -84,6 +84,19 @@ ok/violated_queue/violated_service verdicts + queue/service
 percentiles against the BENCH_SLO_TTFT_S/ITL_S/E2E_S objectives
 (unset = no objectives; the accounting still reconciles).
 
+--cluster ALSO runs the SCALE CHAOS DRILL (1 -> 3 -> 1): one replica
+takes the 3-replica-rate arrivals, the Autoscaler grows the set on
+queue-depth signals, a mid-load graceful drain of the busiest replica
+LIVE-MIGRATES its in-flight streams (KV blocks + sampler state ship
+replica-to-replica; BENCH_CLUSTER_DRAIN_AT picks the trigger index),
+and the tail drains the set back to one. The bench exits non-zero
+unless: greedy token parity vs the no-scale 1-replica run holds for
+every request, zero streams dropped/orphaned, at least one live
+migration happened with ZERO aborts, migrated slots recomputed ZERO
+prefill tokens (engines' prefill_tokens_computed sum measured around
+every drain), the set actually reached 3 and returned to 1, and every
+engine — spawned replicas included — stayed at zero retraces.
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
@@ -1474,15 +1487,29 @@ def main_cluster():
                            e2e_s=_env_f("BENCH_SLO_E2E_S"))
 
     def build_engine(clock):
+        # paged FORCED: live migration (export/import_slot, warmed
+        # below) needs the block pool — a leaked PADDLE_SERVING_PAGED=0
+        # must not crash the warmup or silently skip the scale drill
         eng = ServingEngine(
             fmt, embed, head, num_slots=slots, max_seq_len=smax,
             prefill_cap=cap_, prefix_cache_blocks=pool_blocks,
-            clock=clock.now, slo=slo_policy)
+            paged=True, clock=clock.now, slo=slo_policy)
         for sfx in (sfx_lo, sfx_lo, sfx_hi):
             p = np.concatenate([warm_template,
                                 np.arange(1, sfx + 1, dtype=np.int32)])
             eng.submit(p, max_new_tokens=max(new_choices))
             eng.run()
+        # warm the MIGRATION executables too (BlockPool read/write
+        # block): one export/import round-trip on the throwaway
+        # template, so the scale drill's live migrations are
+        # zero-retrace on every replica — spawned ones included
+        p = np.concatenate([warm_template,
+                            np.arange(1, sfx_lo + 1, dtype=np.int32)])
+        rid = eng.submit(p, max_new_tokens=max(new_choices))
+        while rid in eng._req_index and not eng._req_index[rid].tokens:
+            eng.step()
+        rid = eng.import_slot(eng.export_slot(rid))
+        eng.run()
         eng.reset_metrics(keep_results=False)
         return eng
 
@@ -1627,6 +1654,180 @@ def main_cluster():
     parity_ok = all(kill_toks[i] == aff_toks[i]
                     for i in range(len(meas_reqs)))
 
+    # ----------------- SCALE CHAOS DRILL: 1 -> 3 -> 1 ----------------
+    # ONE replica takes the 3-replica-rate arrivals (3x oversubscribed
+    # — the scale-up trigger), the autoscaler grows the set to 3, a
+    # mid-load graceful drain of the busiest replica live-migrates its
+    # streams (rolling-restart flavor; the autoscaler replaces it if
+    # load demands), and the tail's empty queues drain the set back to
+    # 1. Gates: greedy token parity vs the no-scale 1-replica run at
+    # the SAME arrivals, zero dropped/orphaned streams, ZERO prefill
+    # recompute across every drain (migrated slots ship their KV — the
+    # engines' prefill_tokens_computed sum is measured around each
+    # remove_replica call), zero migration aborts, the 1->3->1 shape,
+    # and zero retraces on every engine, spawned replicas included.
+    from paddle_tpu.inference.serving import AdmissionFull
+    from paddle_tpu.serving_cluster import Autoscaler, NoReplicaError
+    from paddle_tpu.serving_cluster.replica import ReplicaError
+
+    drain_at = int(os.environ.get("BENCH_CLUSTER_DRAIN_AT",
+                                  str((2 * n_meas) // 3)))
+
+    def run_scale(arrivals, elastic):
+        clock = VirtualClock()
+        reps, engines, traces0 = [], [], []
+
+        def spawn(name):
+            rep = LocalReplica(name, build_engine(clock),
+                               threaded=False, clock=clock.now)
+            reps.append(rep)
+            engines.append(rep.engine)
+            traces0.append(rep.engine.metrics()["traces"])
+            return rep
+
+        router = Router([spawn("replica0")], policy="least_loaded",
+                        hb_dead_s=0.05, spill_depth=spill,
+                        snap_max_age_s=0.0, clock=clock.now,
+                        audit_ring=4096)
+        asc = None
+        recompute = {"tokens": 0}
+        if elastic:
+            asc = Autoscaler(router, spawn, min_replicas=1,
+                             max_replicas=3, queue_high=1.5,
+                             queue_low=0.5, cooldown_s=0.25,
+                             hysteresis=2, clock=clock.now)
+            orig_remove = router.remove_replica
+
+            def measured_remove(name, migrate=True):
+                # the zero-reprefill gate: the drive is single-threaded
+                # on one virtual clock, so nothing else can move the
+                # engines' prefill counters during the synchronous
+                # drain — any delta IS migration-induced recompute
+                pf0 = sum(e.metrics()["prefill_tokens_computed"]
+                          for e in engines)
+                out = orig_remove(name, migrate=migrate)
+                recompute["tokens"] += sum(
+                    e.metrics()["prefill_tokens_computed"]
+                    for e in engines) - pf0
+                return out
+
+            router.remove_replica = measured_remove
+        recs = {}
+        open_gids = set()
+        i = 0
+        max_alive = 1
+        drained = False
+        orphaned = 0
+        mid_drain = {"replica": None, "migrated": 0}
+        arr = arrivals + clock.now()
+        t0 = clock.now()
+        while i < len(meas_reqs) or open_gids:
+            now = clock.now()
+            while i < len(meas_reqs) and arr[i] <= now:
+                if elastic and not drained and i >= drain_at \
+                        and len(router.placeable_names()) >= 2:
+                    # rolling-restart: gracefully drain whoever holds
+                    # the most in-flight work — every stream must
+                    # LIVE-MIGRATE, none may drop
+                    owner_of = {g: router.poll(g)["replica"]
+                                for g in open_gids}
+                    loadc = {}
+                    for rep_name in owner_of.values():
+                        if rep_name in router.placeable_names():
+                            loadc[rep_name] = loadc.get(rep_name, 0) + 1
+                    if loadc:
+                        victim = max(sorted(loadc),
+                                     key=lambda n: loadc[n])
+                        m0 = router.migrations_total
+                        router.remove_replica(victim, migrate=True)
+                        mid_drain.update(
+                            replica=victim,
+                            migrated=router.migrations_total - m0)
+                        drained = True
+                prompt, max_new = meas_reqs[i]
+                try:
+                    gid = router.submit([int(t) for t in prompt],
+                                        max_new_tokens=max_new)
+                except AdmissionFull:
+                    break
+                recs[gid] = {"idx": i, "toks": [], "state": None}
+                open_gids.add(gid)
+                i += 1
+            progressed = False
+            for rep in list(reps):
+                if rep.alive:
+                    try:
+                        progressed |= bool(rep.pump())
+                    except ReplicaError:
+                        pass
+            router.check_health()
+            if asc is not None:
+                asc.tick()
+                max_alive = max(max_alive,
+                                len(router.placeable_names()))
+            for gid in list(open_gids):
+                try:
+                    new, done, state = router.harvest(gid)
+                except NoReplicaError:
+                    orphaned += 1
+                    new, done, state = [], True, "orphaned"
+                recs[gid]["toks"].extend(new)
+                if done:
+                    recs[gid]["state"] = state
+                    open_gids.discard(gid)
+            if not progressed and not open_gids and i < len(meas_reqs):
+                clock.skip_to(arr[i])
+        # tail: the backlog is gone, the low watermark holds — tick
+        # through cooldowns until the set is back at the floor
+        guard = 0
+        while asc is not None and guard < 64 \
+                and len(router.placeable_names()) > 1:
+            clock.skip_to(clock.now() + asc.cooldown_s + 0.01)
+            asc.tick()
+            guard += 1
+        elapsed = clock.now() - t0
+        toks = sum(len(r["toks"]) for r in recs.values())
+        by_idx = {r["idx"]: r["toks"] for r in recs.values()}
+        return {
+            "replicas_spawned": len(reps),
+            "max_alive": max_alive,
+            "final_alive": len(router.placeable_names()),
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "migrations": router.migrations_total,
+            "migration_aborts": router.migration_aborts_total,
+            "mid_drain": mid_drain,
+            "scale_events": dict(router.scale_events),
+            "failovers": router.failovers_total,
+            "orphaned": orphaned,
+            "unfinished": sum(1 for r in recs.values()
+                              if r["state"] != "finished"),
+            "submitted": len(recs),
+            "prefill_recompute_tokens": recompute["tokens"],
+            # whole-run conservation: every admitted prompt token is
+            # either computed or prefix-adopted EXACTLY once across the
+            # cluster — a migration that replays prefill (even via
+            # later pumps, outside the per-drain delta window above)
+            # inflates this past the submitted prompt tokens
+            "prefill_tokens_accounted": sum(
+                e.metrics()["prefill_tokens_computed"]
+                + e.metrics()["prefill_tokens_saved"]
+                for e in engines),
+            "retraces_after_warmup": [
+                e.metrics()["traces"] - t
+                for e, t in zip(engines, traces0)],
+        }, by_idx
+
+    scale_arr_rng = np.random.RandomState(seed + 2)
+    scale_arrivals = np.cumsum(scale_arr_rng.exponential(
+        mean_new / max(load * cap_tps, 1e-9), size=len(meas_reqs)))
+    scale_base, scale_base_toks = run_scale(scale_arrivals,
+                                            elastic=False)
+    scale_drill, scale_toks = run_scale(scale_arrivals, elastic=True)
+    scale_parity = all(scale_toks.get(i) == scale_base_toks.get(i)
+                       for i in range(len(meas_reqs)))
+
     record = {
         "metric": "cluster_prefix_affinity_hit_rate",
         "value": aff["prefix_hit_rate_overall"],
@@ -1641,6 +1842,9 @@ def main_cluster():
         "prefix_affinity": aff,
         "kill_drill": killed,
         "kill_token_parity": parity_ok,
+        "scale_drill": scale_drill,
+        "scale_baseline": scale_base,
+        "scale_token_parity": scale_parity,
         # the goodput block the autoscaling item consumes (the kill
         # run's: it includes the failover's queue/service impact)
         "slo": killed["slo"],
@@ -1694,6 +1898,62 @@ def main_cluster():
               f"record: {slo_rec['requests_classified']} classified "
               f"!= {slo_rec['requests_finished']} engine-finished: "
               f"{slo_rec}", file=sys.stderr)
+        rc = 1
+    # ---- scale-drill gates (the elastic acceptance criteria) ----
+    sd = scale_drill
+    if not scale_parity:
+        print("bench_serving: SCALE-DRILL TOKEN PARITY BROKE — a "
+              "migrated stream is not greedy-identical to the no-scale "
+              "run", file=sys.stderr)
+        rc = 1
+    if sd["orphaned"] or sd["unfinished"] \
+            or sd["submitted"] != len(meas_reqs):
+        print(f"bench_serving: SCALE DRILL DROPPED STREAMS — "
+              f"submitted={sd['submitted']}/{len(meas_reqs)}, "
+              f"unfinished={sd['unfinished']}, "
+              f"orphaned={sd['orphaned']}", file=sys.stderr)
+        rc = 1
+    if sd["migrations"] == 0 or sd["mid_drain"]["replica"] is None:
+        print("bench_serving: the scale drill never LIVE-MIGRATED a "
+              "stream (mid-load drain found no victim? tune "
+              "BENCH_CLUSTER_DRAIN_AT)", file=sys.stderr)
+        rc = 1
+    if sd["migration_aborts"]:
+        print(f"bench_serving: {sd['migration_aborts']} migrations "
+              "ABORTED to failover during the scale drill",
+              file=sys.stderr)
+        rc = 1
+    if sd["prefill_recompute_tokens"]:
+        print("bench_serving: migrated slots RECOMPUTED "
+              f"{sd['prefill_recompute_tokens']} prefill tokens — "
+              "migration must ship KV, not replay prompts",
+              file=sys.stderr)
+        rc = 1
+    # the delta window above only sees SYNCHRONOUS recompute inside
+    # remove_replica; this conservation check catches a migration that
+    # replays prefill during later pumps (e.g. pf_left restored as the
+    # full prompt): every submitted prompt token must be computed or
+    # prefix-adopted exactly once cluster-wide (failovers re-prefill
+    # legitimately, so the drill requires zero of them first)
+    expected_prefill = sum(int(p.size) for p, _ in meas_reqs)
+    if sd["failovers"] \
+            or sd["prefill_tokens_accounted"] != expected_prefill:
+        print("bench_serving: scale-drill prefill accounting broke — "
+              f"computed+saved = {sd['prefill_tokens_accounted']} vs "
+              f"{expected_prefill} submitted prompt tokens "
+              f"(failovers={sd['failovers']}); migration replayed "
+              "prefill work", file=sys.stderr)
+        rc = 1
+    if sd["max_alive"] < 3 or sd["final_alive"] != 1:
+        print(f"bench_serving: scale shape broke — expected 1->3->1, "
+              f"got max {sd['max_alive']}, final {sd['final_alive']}",
+              file=sys.stderr)
+        rc = 1
+    if any(sd["retraces_after_warmup"]):
+        print("bench_serving: RETRACES AFTER WARMUP during the scale "
+              f"drill: {sd['retraces_after_warmup']} — migration and "
+              "spawned replicas must reuse warm executables",
+              file=sys.stderr)
         rc = 1
     return rc
 

@@ -202,6 +202,64 @@ class BlockPool:
         return fn(caches, jnp.asarray(src, jnp.int32),
                   jnp.asarray(dst, jnp.int32))
 
+    # ------------------------------------------- block transfer (migration)
+    # The live-migration primitive (and the groundwork for cross-replica
+    # prefix shipping): ONE pool block moves device <-> host per
+    # fixed-shape dispatch with the block index as DATA, so exporting a
+    # whole slot is n_blocks reuses of one executable each way — zero
+    # retraces across any sequence length, same discipline as copy_block.
+    def _build_read(self):
+        import jax
+
+        def read(caches, src):
+            kv = caches["kv"]
+            L, _, _, H, Bt, D = kv.shape
+            out = {"kv": jax.lax.dynamic_slice(kv, (0, 0, src, 0, 0, 0),
+                                               (L, 2, 1, H, Bt, D))}
+            if "sc" in caches:
+                out["sc"] = jax.lax.dynamic_slice(
+                    caches["sc"], (0, 0, src, 0, 0, 0),
+                    (L, 2, 1, H, 1, Bt))
+            return out
+        return read
+
+    def read_block(self, caches, src):
+        """Gather ONE pool block to host arrays ``{"kv"[, "sc"]}`` —
+        the migration-export half of the transfer primitive. The caches
+        are NOT donated (the pool keeps serving while a slot exports)."""
+        import jax.numpy as jnp
+        fn = counted_jit(self._jit_cache, ("read",), self._build_read,
+                         self._bump_traces)
+        out = fn(caches, jnp.asarray(src, jnp.int32))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _build_write(self):
+        import jax
+
+        def write(caches, blk, dst):
+            kv = caches["kv"]
+            out = dict(caches, kv=jax.lax.dynamic_update_slice(
+                kv, blk["kv"].astype(kv.dtype), (0, 0, dst, 0, 0, 0)))
+            if "sc" in caches:
+                sc = caches["sc"]
+                out["sc"] = jax.lax.dynamic_update_slice(
+                    sc, blk["sc"].astype(sc.dtype), (0, 0, dst, 0, 0, 0))
+            return out
+        return write
+
+    def write_block(self, caches, block, dst):
+        """Scatter one exported host block into pool block ``dst`` —
+        the migration-import half. The caches dict is donated like every
+        other pool-mutating dispatch; returns the updated dict. The
+        block must match this pool's layout exactly (the engine-level
+        import validates shapes with a readable error first)."""
+        import jax.numpy as jnp
+        fn = counted_jit(self._jit_cache, ("write",), self._build_write,
+                         self._bump_traces, donate=(0,))
+        blk = {k: jnp.asarray(v) for k, v in block.items()
+               if k in ("kv", "sc")}
+        return fn(caches, blk, jnp.asarray(dst, jnp.int32))
+
 
 class PagedPrefixStore(PrefixStore):
     """The radix store of prefix_cache.py, re-pointed at the SHARED

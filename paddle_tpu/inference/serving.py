@@ -503,6 +503,14 @@ class ServingEngine:
         self._slot_req = [None] * b              # slot -> ServedRequest
         self._presence = None                    # [B, V] bool when rep_on
 
+        # live-migration counters: a migrated-in request enters a slot
+        # WITHOUT an admission (no prefix lookup, no prefill — its KV
+        # arrived as pool blocks), a migrated-out one leaves without a
+        # finish verdict; both are first-class window counters so the
+        # conftest reconciliations stay exact
+        self._migrated_in = 0
+        self._migrated_out = 0
+
         self._queue = deque()
         self.results = {}
         # streaming-harvest bookkeeping: every queued/running request is
@@ -779,6 +787,8 @@ class ServingEngine:
             "requests_forked": self._forked,
             "requests_rejected": self._rejected,
             "requests_expired": self._expired,
+            "requests_migrated_in": self._migrated_in,
+            "requests_migrated_out": self._migrated_out,
             "prefix_hits": self._prefix_hits,
             "prefix_misses": self._prefix_misses,
             "prefill_tokens_saved": self._prefill_tokens_saved,
@@ -822,6 +832,8 @@ class ServingEngine:
         self._finished = 0
         self._rejected = 0
         self._expired = 0
+        self._migrated_in = 0
+        self._migrated_out = 0
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefill_tokens_saved = 0
@@ -867,6 +879,12 @@ class ServingEngine:
             "requests_forked": self._forked,
             "requests_rejected": self._rejected,
             "requests_expired": self._expired,
+            # live-migration window counters (0 unless a cluster drain
+            # moved sessions): migrated_in entered a slot with KV blocks
+            # shipped from another engine (no admission, no prefill);
+            # migrated_out left mid-flight with their state
+            "requests_migrated_in": self._migrated_in,
+            "requests_migrated_out": self._migrated_out,
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
             "traces": self._traces_total(),
@@ -1211,6 +1229,255 @@ class ServingEngine:
         if not self._active[s1] and not self._pf_left[s1]:
             self._finish(child, self.clock())
         return child.rid
+
+    # ------------------------------------------------------ live migration
+    # The cluster-drain primitive: a live request's COMPLETE decode state
+    # — committed KV blocks (host bytes via BlockPool.read_block), lens /
+    # nt / next input token / prefill cursor, per-request sampler seed,
+    # and the request contract (prompt, budget, eos, penalties, trace
+    # context) — detaches from this engine and resumes MID-STREAM on
+    # another one. Drafter n-gram maps and the repetition-penalty
+    # presence row are NOT shipped: both are deterministic functions of
+    # prompt + generated tokens and are rebuilt at import, byte-
+    # equivalent to the live state (the drafter inserts incrementally in
+    # exactly the order update() replays; presence is the one-hot union).
+    # Greedy continuations are token-identical by construction; plain
+    # sampled mode is too (the seed moves and every draw is
+    # fold_in(seed, nt)); spec-decode sampled mode redraws its host
+    # rejection RNG — the documented caveat.
+    MIGRATION_FMT = "paddle-slot-v1"
+
+    def export_slot(self, rid):
+        """Detach request ``rid`` (queued or running) into a
+        JSON/pickle-able migration state dict and free everything it
+        held here (slot, block references, reservations). The request's
+        record leaves this engine as state ``migrated`` — it is neither
+        finished nor expired, so no latency/SLO verdict is recorded.
+        Paged engines only (the payload IS pool blocks)."""
+        if not self.paged:
+            raise ValueError("export_slot needs the paged KV cache "
+                             "(the migration payload is pool blocks; "
+                             "PADDLE_SERVING_PAGED=0 disables it)")
+        req = self._req_index.get(rid)
+        if req is None or req.state not in ("queued", "running"):
+            raise ValueError(f"request {rid} is not live on this engine")
+        now = self.clock()
+        state = {
+            "fmt": self.MIGRATION_FMT,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "min_length": req.min_length,
+            "repetition_penalty": req.repetition_penalty,
+            "deadline_s": req.deadline_s,
+            "seed": req.seed,
+            "trace_id": req.trace_id,
+            "attempt": req.attempt,
+            "prefill_cap": self.prefill_cap,
+            "lens": 0, "nt": 0, "tok": 0, "active": False,
+            "pf_left": int(req.prompt.size),
+            "kv": [],
+        }
+        need = self._blocks_needed(req.prompt.size, req.max_new_tokens)
+        if req.state == "queued":
+            self._queue.remove(req)
+            self._kv_committed -= need
+        else:
+            s = req.slot
+            state.update(
+                lens=int(self._lens[s]), nt=int(self._nt[s]),
+                tok=int(self._tok[s]), active=bool(self._active[s]),
+                pf_left=int(self._pf_left[s]))
+            # KV entries written so far live in [0, lens) — the next
+            # token's K/V lands at `lens` on the IMPORTING engine
+            # (write-then-attend), so the partial tail block travels
+            # as-is and decode resumes seamlessly
+            row = self._tables[s]
+            for j in range(-(-state["lens"] // self.prefill_cap)):
+                state["kv"].append(
+                    self.pool.read_block(self._caches, int(row[j])))
+            self._kv_committed -= need
+            self._kv_reserved -= need
+            self._slot_req[s] = None
+            self._active[s] = False
+            self._pf_left[s] = 0
+            self._free_slot_blocks(s)
+        req.state = "migrated"
+        self._req_index.pop(rid, None)
+        self._harvest.pop(rid, None)
+        self._migrated_out += 1
+        if self.telemetry.enabled:
+            self.telemetry.req_event(rid, "migrate_out", now)
+        self.telemetry.req_done(rid, "migrated", now)
+        return state
+
+    def import_slot(self, state):
+        """Resume an exported request on THIS engine: allocate fresh
+        pool blocks, upload the KV bytes, restore the decode vectors,
+        and rebuild the derived per-slot state (drafter, presence) from
+        the token history. Returns the request's NEW rid here. Sheds
+        honestly with ``AdmissionFull`` when no slot or no pool headroom
+        can take it — the caller (router drain) falls back to classic
+        failover. A never-prefilled export (queued, zero KV) re-enters
+        the queue instead of claiming a slot."""
+        if not self.paged:
+            raise ValueError("import_slot needs the paged KV cache")
+        if not isinstance(state, dict) or \
+                state.get("fmt") != self.MIGRATION_FMT:
+            raise ValueError(
+                f"not a migration state dict (fmt="
+                f"{None if not isinstance(state, dict) else state.get('fmt')!r}"
+                f", expected {self.MIGRATION_FMT!r})")
+        if int(state["prefill_cap"]) != self.prefill_cap:
+            raise ValueError(
+                f"migration state has prefill_cap={state['prefill_cap']}"
+                f" but this engine uses {self.prefill_cap} — the KV "
+                "blocks are prefill_cap-sized and cannot be re-chunked")
+        prompt = np.asarray(state["prompt"], np.int32).reshape(-1)
+        max_new = int(state["max_new_tokens"])
+        if prompt.size + max_new > self.smax:
+            raise ValueError(
+                f"migrated request needs {prompt.size} + {max_new} "
+                f"positions but this engine's Smax is {self.smax}")
+        lens = int(state["lens"])
+        if not 0 <= lens <= prompt.size + max_new:
+            # without this bound a corrupt payload with a huge lens
+            # (and a matching kv list) would pass the count check below
+            # and allocate blocks past the admission-time reservation —
+            # breaking the pool's over-commit invariant mid-serving
+            # instead of shedding the one bad import here
+            raise ValueError(
+                f"migration state has lens={lens} outside its own "
+                f"request budget [0, {prompt.size} + {max_new}] — "
+                "corrupt or mismatched payload")
+        blocks = state["kv"]
+        if len(blocks) != -(-lens // self.prefill_cap):
+            raise ValueError(
+                f"migration state ships {len(blocks)} kv blocks but "
+                f"lens={lens} needs "
+                f"{-(-lens // self.prefill_cap)}")
+        kv_shape = self._caches["kv"].shape      # [L, 2, NB, H, Bt, D]
+        want = (kv_shape[0], 2, 1, kv_shape[3], kv_shape[4], kv_shape[5])
+        for blk in blocks:
+            if tuple(blk["kv"].shape) != want:
+                raise ValueError(
+                    f"migrated kv block shape {tuple(blk['kv'].shape)} "
+                    f"does not match this pool's {want} — the engines' "
+                    "model/layout configs must agree")
+            if ("sc" in self._caches) != ("sc" in blk):
+                raise ValueError(
+                    "migrated block cache flavor (int8 scales) does not "
+                    "match this engine's")
+        now = self.clock()
+        need = self._blocks_needed(prompt.size, max_new)
+        tokens = [int(t) for t in state["tokens"]]
+        req = ServedRequest(next(self._rid), prompt, max_new,
+                            state["eos_token_id"],
+                            int(state["min_length"]),
+                            float(state["repetition_penalty"]), now,
+                            deadline_s=state["deadline_s"],
+                            seed=int(state["seed"]),
+                            trace_id=state["trace_id"],
+                            attempt=int(state["attempt"]))
+        if not blocks and not tokens and int(state["nt"]) == 0:
+            # never prefilled: the import is a plain (re-)queue — it
+            # will be ADMITTED normally later (prefix lookup included)
+            if self.max_pending and len(self._queue) >= self.max_pending:
+                self._rejected += 1
+                if self.telemetry.enabled:
+                    self.telemetry.req_rejected(
+                        now, trace_id=req.trace_id, attempt=req.attempt)
+                raise AdmissionFull(
+                    f"pending queue full ({len(self._queue)}/"
+                    f"{self.max_pending}) — migrated request shed")
+            if self._kv_gate and \
+                    self._kv_committed + need > self.pool.num_blocks:
+                self._rejected += 1
+                if self.telemetry.enabled:
+                    self.telemetry.req_rejected(
+                        now, trace_id=req.trace_id, attempt=req.attempt)
+                raise AdmissionFull("kv pool exhausted — migrated "
+                                    "request shed at import")
+            self._kv_committed += need
+            self._queue.append(req)
+            self._req_index[req.rid] = req
+            self._migrated_in += 1
+            self.telemetry.req_queued(req.rid, now,
+                                      trace_id=req.trace_id,
+                                      attempt=req.attempt)
+            if self.telemetry.enabled:
+                self.telemetry.req_event(req.rid, "migrate_in", now)
+            return req.rid
+        free = self._free_slots()
+        if not free:
+            self._rejected += 1
+            if self.telemetry.enabled:
+                self.telemetry.req_rejected(now, trace_id=req.trace_id,
+                                            attempt=req.attempt)
+            raise AdmissionFull("no free slot to import the migrated "
+                                "session into")
+        if self._kv_reserved + need > self.pool.num_blocks:
+            self._rejected += 1
+            if self.telemetry.enabled:
+                self.telemetry.req_rejected(now, trace_id=req.trace_id,
+                                            attempt=req.attempt)
+            raise AdmissionFull(
+                f"kv pool exhausted: migrated session needs {need} "
+                f"blocks, {self.pool.num_blocks - self._kv_reserved} "
+                "unreserved")
+        s = free[0]
+        req.state = "running"
+        req.slot = s
+        req.t_admit = now                  # queue time on THIS engine: 0
+        # TTFT belongs to the attempt that produced the first token —
+        # a stream that already emitted keeps t_first unset here (the
+        # TTFT histogram legitimately sees fewer entries than finished)
+        req.tokens = tokens
+        self._kv_committed += need
+        self._kv_reserved += need
+        ids = self._alloc_kv_blocks(len(blocks)) if blocks else []
+        for blk, dst in zip(blocks, ids):
+            self._caches = self.pool.write_block(self._caches, blk, dst)
+        row = self._tables[s]
+        row[:] = self.pool.num_blocks
+        row[:len(ids)] = ids
+        self._lens[s] = lens
+        self._nt[s] = int(state["nt"])
+        self._tok[s] = int(state["tok"])
+        self._max_nt[s] = max_new
+        self._eos[s] = (-1 if req.eos_token_id is None
+                        else int(req.eos_token_id))
+        self._min_len[s] = req.min_length
+        self._rep_pen[s] = req.repetition_penalty
+        self._rseed[s] = req.seed
+        self._active[s] = bool(state["active"])
+        self._pf_left[s] = int(state["pf_left"])
+        if self._drafters is not None:
+            # the n-gram maps are a pure function of the token history:
+            # reset + update replays exactly the live insert order
+            self._drafters[s].reset(prompt)
+            self._drafters[s].update(tokens)
+        if self._rep_on:
+            vocab = self._presence_init().shape[1]
+            rowv = np.zeros(vocab, bool)
+            rowv[prompt] = True
+            if tokens:
+                rowv[np.asarray(tokens, np.int64)] = True
+            self._presence = self._presence_init().at[s].set(
+                jnp.asarray(rowv))
+        self._slot_req[s] = req
+        self._req_index[req.rid] = req
+        self._migrated_in += 1
+        self.telemetry.req_queued(req.rid, now, trace_id=req.trace_id,
+                                  attempt=req.attempt)
+        self.telemetry.req_admitted(req.rid, s, now)
+        if self.telemetry.enabled:
+            self.telemetry.req_event(req.rid, "migrate_in", now)
+        if not self._active[s] and not self._pf_left[s] and tokens:
+            # exported at the exact finish boundary: complete instantly
+            self._finish(req, now)
+        return req.rid
 
     def _build_decode_chunk(self):
         """The ONE compiled decode step: decode_chunk tokens per dispatch

@@ -89,7 +89,9 @@ DEFAULT_RING = 2048
 # v2: added the "slo" block (declared objectives + goodput counters)
 # and the queue_s/service_s decomposition histograms — the signals the
 # autoscaling item consumes.
-SNAPSHOT_SCHEMA_VERSION = 2
+# v3: the "requests" block gains migrated_in/migrated_out (live session
+# migration — the autoscaler's drain accounting).
+SNAPSHOT_SCHEMA_VERSION = 3
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
@@ -552,6 +554,10 @@ PROMETHEUS_NAMES = {
                           "counter"),
     "requests_expired": ("paddle_serving_requests_expired_total",
                          "counter"),
+    "requests_migrated_in": (
+        "paddle_serving_requests_migrated_in_total", "counter"),
+    "requests_migrated_out": (
+        "paddle_serving_requests_migrated_out_total", "counter"),
     "queue_depth": ("paddle_serving_queue_depth", "gauge"),
     "occupancy": ("paddle_serving_slot_occupancy", "gauge"),
     "traces": ("paddle_serving_compiled_traces_total", "counter"),
@@ -742,7 +748,7 @@ def snapshot(engine):
         "tokens_per_sec": m["tokens_per_sec"],
         "requests": {k: m[f"requests_{k}"] for k in
                      ("admitted", "finished", "forked", "rejected",
-                      "expired")},
+                      "expired", "migrated_in", "migrated_out")},
         "histograms": {
             "ttft_s": tele.hist_ttft.snapshot(),
             "latency_s": tele.hist_latency.snapshot(),

@@ -14,6 +14,14 @@ cluster.
 The router is pure host code: nothing here dispatches to the device,
 so the per-replica zero-retrace contract is untouched by construction.
 
+The replica set is ELASTIC (PR 12): ``Autoscaler`` (autoscale.py)
+spawns/drains replicas on telemetry-snapshot signals (queue depth, kv
+headroom, SLO queue violations) under the PADDLE_AUTOSCALE_* knobs,
+and a drain LIVE-MIGRATES every in-flight session (KV blocks + sampler
+state over export_slot/import_slot — zero re-prefill, greedy
+token-identical) instead of killing it; ``/admin/scale`` and
+``/admin/drain`` expose the same levers to operators.
+
 The trace plane rides on top (PR 11): one ``X-Request-Id`` trace id
 per HTTP request threaded gateway -> router -> replica -> engine and
 ACROSS failover (same id, incremented attempt), a router decision
@@ -21,6 +29,7 @@ audit ring with per-reason counters, gateway HTTP latency histograms,
 and ``export_cluster_trace`` — one merged Perfetto trace for the whole
 cluster (trace.py).
 """
+from .autoscale import Autoscaler
 from .gateway import Gateway
 from .protocol import ProtocolError
 from .replica import LocalReplica, ReplicaError, RpcReplica, serve_engine
@@ -30,4 +39,4 @@ from .trace import export_cluster_trace
 __all__ = ["Gateway", "Router", "HashRing", "LocalReplica",
            "RpcReplica", "serve_engine", "ReplicaError",
            "NoReplicaError", "ProtocolError", "AUDIT_REASONS",
-           "export_cluster_trace"]
+           "Autoscaler", "export_cluster_trace"]

@@ -20,6 +20,12 @@ tools/check_http_surface.py):
   * ``GET /metrics``   — the router's aggregated Prometheus exposition
     (every replica's engine metrics with a ``replica`` label + router
     gauges).
+  * ``GET/POST /admin/scale`` — elastic status / manual replica-count
+    target (POST needs an Autoscaler: its spawn hook builds replicas);
+    ``POST /admin/drain`` — graceful single-replica drain (live
+    sessions migrate off, then the replica retires; rolling restarts).
+    Admin ops refused in the current state (no autoscaler, draining
+    the last replica) map to 409 ``conflict``.
 
 Backpressure is honest end-to-end: AdmissionFull from every replica →
 HTTP 429 with ``Retry-After``; ``deadline_s`` expiry → 504; all
@@ -77,7 +83,9 @@ _MAX_BODY = 8 << 20                       # 8 MiB: token-id prompts only
 _ENDPOINT_KEYS = {"/v1/completions": "completions",
                   "/v1/models": "models",
                   "/healthz": "healthz",
-                  "/metrics": "metrics"}
+                  "/metrics": "metrics",
+                  "/admin/scale": "admin_scale",
+                  "/admin/drain": "admin_drain"}
 
 
 class _HttpError(Exception):
@@ -87,8 +95,12 @@ class _HttpError(Exception):
 
 class Gateway:
     def __init__(self, router, model_id="paddle_tpu", host="127.0.0.1",
-                 port=None, poll_s=None, hb_s=None):
+                 port=None, poll_s=None, hb_s=None, autoscaler=None):
         self.router = router
+        # optional elastic control plane (serving_cluster/autoscale.py):
+        # the health sweep drives its tick; POST /admin/scale needs it
+        # (scale-up requires its spawn hook)
+        self.autoscaler = autoscaler
         self.model_id = model_id
         self.host = host
         self.port = int(port if port is not None
@@ -107,6 +119,9 @@ class Gateway:
             raise ValueError(f"trace ring must be >= 0, got {ring}")
         self.trace_ring = ring
         self.http_log = deque(maxlen=max(ring, 1))
+        # drain serialization fallback when no autoscaler is configured
+        # (with one, its _op_lock serializes drain vs tick/scale_to)
+        self._drain_lock = threading.RLock()
         self._thread = None
         self._loop = None
         self._stop_evt = None
@@ -154,6 +169,12 @@ class Gateway:
                 await loop.run_in_executor(None, self.router.refresh)
                 await loop.run_in_executor(None,
                                            self.router.check_health)
+                if self.autoscaler is not None:
+                    # the elastic control loop rides the health sweep:
+                    # one tick per sweep (hysteresis + cooldown make the
+                    # effective decision cadence much slower)
+                    await loop.run_in_executor(None,
+                                               self.autoscaler.tick)
             except Exception:
                 pass                      # the sweep must never die
             await asyncio.sleep(self.hb_s)
@@ -211,9 +232,12 @@ class Gateway:
                 await self._send_error(writer, e.code, e.message,
                                        span=span)
             except AdmissionFull as e:
+                # Retry-After computed from the MEASURED queue drain
+                # rate (router snapshots), floored/capped in protocol
                 await self._send_error(
                     writer, "admission_full", str(e),
-                    extra={"Retry-After": str(protocol.RETRY_AFTER_S)},
+                    extra={"Retry-After":
+                           str(self.router.retry_after_s())},
                     span=span)
             except NoReplicaError as e:
                 await self._send_error(writer, "no_replica", str(e),
@@ -307,10 +331,91 @@ class Gateway:
                 span=span)
         elif method == "POST" and path == "/v1/completions":
             await self._completions(body, writer, span)
+        elif path == "/admin/scale" and method in ("GET", "POST"):
+            await self._admin_scale(method, body, writer, span)
+        elif method == "POST" and path == "/admin/drain":
+            await self._admin_drain(body, writer, span)
         else:
             await self._send_error(writer, "not_found",
                                    f"no route {method} {path}",
                                    span=span)
+
+    # ------------------------------------------------------------ admin
+    def _scale_status(self):
+        """The /admin/scale payload (protocol.SCALE_FIELDS): the
+        router's elastic counters + the autoscaler's bounds (null when
+        no autoscaler is configured — the field SET never varies)."""
+        st = self.router.scale_status()
+        a = self.autoscaler
+        st["autoscaler"] = a is not None
+        st["min_replicas"] = None if a is None else a.min_replicas
+        st["max_replicas"] = None if a is None else a.max_replicas
+        return st
+
+    async def _admin_scale(self, method, body, writer, span):
+        loop = asyncio.get_running_loop()
+        if method == "GET":
+            await self._send_json(writer, 200, self._scale_status(),
+                                  span=span)
+            return
+        if self.autoscaler is None:
+            raise protocol.ProtocolError(
+                "conflict", "no autoscaler configured — manual scaling "
+                "needs a spawn hook (Gateway(autoscaler=...))")
+        try:
+            obj = json.loads(body.decode() or "null")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise protocol.ProtocolError("bad_request",
+                                         f"body is not JSON: {e}")
+        n = (obj or {}).get("replicas") if isinstance(obj, dict) else None
+        if isinstance(n, bool) or not isinstance(n, int) or n < 1:
+            raise protocol.ProtocolError(
+                "bad_request", "'replicas' must be a positive integer")
+        # the walk migrates live sessions on scale-down — run it in the
+        # executor like every other replica-touching call
+        await loop.run_in_executor(None, self.autoscaler.scale_to, n)
+        await self._send_json(writer, 200, self._scale_status(),
+                              span=span)
+
+    async def _admin_drain(self, body, writer, span):
+        """Graceful drain of ONE named replica (rolling restarts): live
+        sessions migrate off, then the replica retires. Refuses to
+        drain the last placeable replica — that would orphan every
+        stream it holds."""
+        loop = asyncio.get_running_loop()
+        try:
+            obj = json.loads(body.decode() or "null")
+        except (ValueError, UnicodeDecodeError) as e:
+            raise protocol.ProtocolError("bad_request",
+                                         f"body is not JSON: {e}")
+        name = (obj or {}).get("replica") if isinstance(obj, dict) \
+            else None
+        if not isinstance(name, str) or not name:
+            raise protocol.ProtocolError(
+                "bad_request", "'replica' must be a replica name")
+        summary = await loop.run_in_executor(None, self._drain_sync,
+                                             name)
+        await self._send_json(writer, 200, summary, span=span)
+
+    def _drain_sync(self, name):
+        """Check-and-drain ATOMICALLY under the scale-op lock: two
+        concurrent drains of the last two replicas must not both pass
+        the last-placeable guard (each seeing count 2) and drain the
+        cluster to zero — same for a drain racing an autoscaler
+        tick's scale-down."""
+        lock = (self.autoscaler._op_lock if self.autoscaler is not None
+                else self._drain_lock)
+        with lock:
+            placeable = self.router.placeable_names()
+            if name not in self.router.replicas:
+                raise protocol.ProtocolError(
+                    "not_found", f"unknown replica {name!r}")
+            if name in placeable and len(placeable) <= 1:
+                raise protocol.ProtocolError(
+                    "conflict", f"refusing to drain {name!r}: it is "
+                    "the last placeable replica — its sessions would "
+                    "have nowhere to migrate")
+            return self.router.remove_replica(name)
 
     # ------------------------------------------------------ completions
     async def _completions(self, body, writer, span):
@@ -462,7 +567,8 @@ class Gateway:
     async def _send_raw(self, writer, status, payload, ctype,
                         extra=None, span=None):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  429: "Too Many Requests", 500: "Internal Server Error",
+                  409: "Conflict", 429: "Too Many Requests",
+                  500: "Internal Server Error",
                   503: "Service Unavailable",
                   504: "Gateway Timeout"}.get(status, "OK")
         head = [f"HTTP/1.1 {status} {reason}",

@@ -28,9 +28,10 @@ from __future__ import annotations
 import json
 
 __all__ = ["ProtocolError", "CompletionRequest", "ERROR_STATUS",
-           "RETRY_AFTER_S", "COMPLETION_FIELDS", "CHOICE_FIELDS",
-           "USAGE_FIELDS", "STREAM_CHUNK_FIELDS", "MODELS_FIELDS",
-           "MODEL_ENTRY_FIELDS", "HEALTHZ_FIELDS", "ERROR_BODY_FIELDS",
+           "RETRY_AFTER_S", "RETRY_AFTER_MAX_S", "COMPLETION_FIELDS",
+           "CHOICE_FIELDS", "USAGE_FIELDS", "STREAM_CHUNK_FIELDS",
+           "MODELS_FIELDS", "MODEL_ENTRY_FIELDS", "HEALTHZ_FIELDS",
+           "SCALE_FIELDS", "DRAIN_FIELDS", "ERROR_BODY_FIELDS",
            "ENDPOINTS", "TRACE_HEADER", "parse_completion_request",
            "completion_response", "stream_chunk", "sse_event",
            "SSE_DONE", "error_body", "finish_reason"]
@@ -47,12 +48,20 @@ ERROR_STATUS = {
     "not_found": 404,           # unknown route / unknown request id
     "bad_request": 400,         # malformed JSON / invalid fields
     "no_replica": 503,          # every replica dead/unreachable
+    "conflict": 409,            # admin op refused in the current state
+                                # (no autoscaler; draining the last
+                                # alive replica)
     "internal": 500,            # anything else (bug, not backpressure)
 }
 
 # 429 responses carry Retry-After (seconds) — honest backpressure tells
-# the client WHEN, not just no
+# the client WHEN, not just no. The value is COMPUTED from the measured
+# queue drain rate (Router.retry_after_s: total queued / finished-per-
+# second over the recent snapshot window), floored here and capped at
+# RETRY_AFTER_MAX_S so a stalled window can't tell clients to wait an
+# hour (or to hammer a saturated cluster every second).
 RETRY_AFTER_S = 1
+RETRY_AFTER_MAX_S = 30
 
 # the end-to-end trace context header: the gateway honors an inbound
 # id (so an upstream proxy can pre-mint) or mints one, echoes it on
@@ -71,6 +80,19 @@ STREAM_CHUNK_FIELDS = ("id", "object", "created", "model", "choices",
 MODELS_FIELDS = ("object", "data")
 MODEL_ENTRY_FIELDS = ("id", "object", "owned_by")
 HEALTHZ_FIELDS = ("status", "replicas_alive", "replicas_total")
+# the elastic admin surface: scale status (GET and the POST /admin/scale
+# response) and the drain summary. Autoscaler-less gateways report the
+# same field set with null bounds — the shape never varies.
+SCALE_FIELDS = ("replicas_alive", "replicas_total", "draining",
+                "migrations_total", "migration_aborts_total",
+                "scale_events_up", "scale_events_down", "autoscaler",
+                "min_replicas", "max_replicas")
+# "expired": a deadline_s stream whose remaining budget lapsed during
+# the drain itself — terminal, but the operator must see it in the
+# drain accounting (migrated+failed_over+orphaned+expired covers every
+# live assignment the drain touched)
+DRAIN_FIELDS = ("replica", "migrated", "failed_over", "orphaned",
+                "expired")
 ERROR_BODY_FIELDS = ("message", "type", "code")
 
 # route -> top-level response field tuple (None = non-JSON body, e.g.
@@ -80,6 +102,9 @@ ENDPOINTS = {
     "GET /v1/models": MODELS_FIELDS,
     "GET /healthz": HEALTHZ_FIELDS,
     "GET /metrics": None,
+    "GET /admin/scale": SCALE_FIELDS,
+    "POST /admin/scale": SCALE_FIELDS,
+    "POST /admin/drain": DRAIN_FIELDS,
 }
 
 SSE_DONE = b"data: [DONE]\n\n"

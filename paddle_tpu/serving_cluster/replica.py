@@ -152,6 +152,26 @@ class LocalReplica:
         with self._lock:
             self.engine.release(rid)
 
+    def export_slot(self, rid):
+        """Live-migration export: detach one request's full decode
+        state (engine.export_slot) under the replica lock, so the
+        driver thread can never interleave a step mid-export."""
+        self._check_alive()
+        with self._lock:
+            return self.engine.export_slot(rid)
+
+    def import_slot(self, state):
+        """Live-migration import: resume an exported session here.
+        Tracks the new rid under the SAME lock hold, exactly like
+        submit — the streaming cursor exists before the driver can
+        finish the request."""
+        self._check_alive()
+        with self._lock:
+            rid = self.engine.import_slot(state)
+            self.engine.track(rid)
+        self._wake.set()
+        return rid
+
     def snapshot(self):
         self._check_alive()
         with self._lock:
@@ -215,6 +235,14 @@ def _rw_poll(rid):
 
 def _rw_release(rid):
     return _served().release(rid)
+
+
+def _rw_export_slot(rid):
+    return _served().export_slot(rid)
+
+
+def _rw_import_slot(state):
+    return _served().import_slot(state)
 
 
 def _rw_snapshot():
@@ -305,6 +333,18 @@ class RpcReplica:
             return self._call(_rw_release, rid)
         except ReplicaError:
             return None                   # nothing to free on a corpse
+
+    def export_slot(self, rid):
+        """Migration export over rpc: the KV block bytes ride the
+        pickle channel (a dead/unreachable worker surfaces as
+        ReplicaError — the router's abort-to-failover trigger)."""
+        return self._call(_rw_export_slot, rid)
+
+    def import_slot(self, state):
+        """Migration import over rpc; AdmissionFull pickles through
+        intact (a full target is backpressure, not death — the drain
+        tries the next candidate)."""
+        return self._call(_rw_import_slot, state)
 
     def snapshot(self):
         # the routing payload is tiny and polled at heartbeat cadence:

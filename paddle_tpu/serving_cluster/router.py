@@ -36,6 +36,21 @@ Snapshots are trusted only at the pinned ``SNAPSHOT_SCHEMA_VERSION``:
 a replica reporting an unknown version is excluded from load scoring
 (counted in ``version_mismatches``) instead of being silently misread.
 
+The replica set is ELASTIC: ``add_replica`` joins a new replica to the
+ring (minimal key movement — only the new vnodes' keys change home),
+``remove_replica`` drains one gracefully — off the ring immediately,
+every live assignment MIGRATED (``export_slot``/``import_slot``: the
+session's KV blocks + sampler state move and decode resumes mid-stream
+with zero re-prefill, greedy token-identical through the delivered-
+prefix skip), then the handle retires. Any migration failure (target
+death mid-transfer, rpc timeout, fault injection) degrades that
+assignment to the classic failover path — replay from the prompt,
+never a hang, never a double delivery. The autoscaler (autoscale.py)
+drives both off the telemetry-snapshot signals; scale events and
+migrations land in the decision audit and ``/metrics``
+(``paddle_gateway_scale_events_total{direction=}``,
+``paddle_gateway_migrations_total``/``_aborts_total``).
+
 Every placement is AUDITED: the router records WHY each request landed
 where it did — policy, per-candidate load scores, chosen replica, and
 a reason from ``AUDIT_REASONS`` — in a bounded ring
@@ -72,9 +87,13 @@ POLICIES = ("prefix_affinity", "least_loaded", "round_robin")
 # not drift): affinity_hit = consistent-hash owner took it, spill =
 # saturated/shedding owner overflowed to least-loaded, least_loaded /
 # round_robin = the policy's own choice, failover = re-submit after a
-# replica death, orphaned = failover found nowhere to go
+# replica death, orphaned = failover found nowhere to go, migrated =
+# a live session moved to a new replica during a drain, scale_up /
+# scale_down = the elastic control plane changed the replica set
+# (autoscaler watermark trip or an /admin scale command)
 AUDIT_REASONS = ("affinity_hit", "least_loaded", "round_robin", "spill",
-                 "failover", "orphaned")
+                 "failover", "orphaned", "migrated", "scale_up",
+                 "scale_down")
 
 
 class NoReplicaError(ReplicaError):
@@ -229,10 +248,33 @@ class Router:
         self.audit_enabled = ar > 0
         self.audit = deque(maxlen=max(ar, 1))
         self.audit_counts = {r: 0 for r in AUDIT_REASONS}
+        # elastic control plane: replicas mid-drain take no NEW
+        # placements but keep serving their existing assignments until
+        # every one has migrated off; scale/migration counters ride
+        # /metrics next to the decision counters
+        self.draining = set()
+        self.migrations_total = 0
+        self.migration_aborts_total = 0
+        self.scale_events = {"up": 0, "down": 0}
+        # (t, sum of replica finished counters) samples from refresh():
+        # the measured queue-drain rate behind retry_after_s(). Samples
+        # are spaced at least _drain_gap_s apart — refresh() runs on
+        # EVERY submit, so a 429 retry storm would otherwise collapse
+        # the 16-slot window to milliseconds in which nothing finished
+        # and retry_after_s would report the cap while the queue
+        # actually drains fine (each retry re-collapsing the window)
+        self._drain_samples = deque(maxlen=16)
+        self._drain_gap_s = 0.25
 
     # -------------------------------------------------------- snapshots
     def alive_names(self):
         return [n for n in sorted(self.replicas) if n not in self.dead]
+
+    def placeable_names(self):
+        """Alive AND not draining — the placement candidate set. A
+        draining replica still serves (and is harvested for) its
+        existing assignments until the drain moves them off."""
+        return [n for n in self.alive_names() if n not in self.draining]
 
     def refresh(self, force=False):
         """Pull each alive replica's telemetry snapshot (the routing
@@ -252,7 +294,7 @@ class Router:
         now = self.clock()
         with self._lock:
             todo = []
-            for name in self.alive_names():
+            for name in self.placeable_names():
                 got = self._snaps.get(name)
                 if force or got is None \
                         or now - got[1] > self.snap_max_age_s:
@@ -278,10 +320,58 @@ class Router:
                 else:
                     self._snaps[name] = (snap, now)
                     self._prefill_cap = snap["prefill_cap"]
+            # drain-rate sample for retry_after_s: the cluster-wide
+            # finished count at this instant (engine window counters —
+            # monotonic between resets; a negative step from a replica
+            # leaving/reset invalidates the window, handled there)
+            total_fin = 0
+            saw = False
+            for name in self.placeable_names():
+                got = self._snaps.get(name)
+                if got is not None:
+                    total_fin += int(got[0].get("requests", {})
+                                     .get("finished", 0))
+                    saw = True
+            if saw and (not self._drain_samples
+                        or now - self._drain_samples[-1][0]
+                        >= self._drain_gap_s):
+                self._drain_samples.append((now, total_fin))
 
     def _snap(self, name):
         got = self._snaps.get(name)
         return got[0] if got else None
+
+    def retry_after_s(self):
+        """429 Retry-After from the MEASURED queue drain rate: total
+        queued requests / (finished per second over the recent refresh
+        window), floored at protocol.RETRY_AFTER_S and capped at
+        protocol.RETRY_AFTER_MAX_S. No backlog or no data yet -> the
+        floor; a backlog with zero observed drain -> the cap (honest
+        "back off hard" instead of an invented number)."""
+        import math
+
+        from . import protocol
+        with self._lock:
+            qd = 0
+            for name in self.placeable_names():
+                snap = self._snap(name)
+                if snap is not None:
+                    qd += int(snap.get("queue_depth", 0))
+            samples = list(self._drain_samples)
+        lo, hi = protocol.RETRY_AFTER_S, protocol.RETRY_AFTER_MAX_S
+        if qd <= 0 or len(samples) < 2:
+            return lo
+        dt = samples[-1][0] - samples[0][0]
+        df = samples[-1][1] - samples[0][1]
+        if df < 0:
+            # a replica retired/reset mid-window: the cumulative count
+            # stepped backwards, the window is garbage — drop it
+            with self._lock:
+                self._drain_samples.clear()
+            return lo
+        if dt <= 0 or df == 0:
+            return hi
+        return int(min(max(math.ceil(qd / (df / dt)), lo), hi))
 
     @staticmethod
     def load_score(snap):
@@ -443,7 +533,7 @@ class Router:
         shed = False
         while True:
             with self._lock:
-                names = [n for n in self.alive_names()
+                names = [n for n in self.placeable_names()
                          if n not in tried]
                 if names:
                     name, reason = self._choose(prompt, names)
@@ -583,7 +673,11 @@ class Router:
         router lock); failure = dead = drain + re-route. Returns the
         names newly marked dead."""
         with self._lock:
-            suspects = [n for n in self.alive_names()
+            # a mid-drain replica is the drain's responsibility — its
+            # heartbeat may stall while blocks stream off it, and
+            # declaring it dead would turn a graceful migrate-then-
+            # retire into kill-and-reprefill
+            suspects = [n for n in self.placeable_names()
                         if self.replicas[n].heartbeat_age()
                         > self.hb_dead_s]
         died = []
@@ -663,6 +757,219 @@ class Router:
         if stray is not None:
             stray.release(rid)
 
+    # ------------------------------------------------- elastic scaling
+    def _record_scale(self, direction, name):
+        """One scale event in the decision audit (reason scale_up /
+        scale_down, gid None — dashboards and the merged cluster trace
+        see WHEN the replica set changed next to WHERE requests went)
+        plus the per-direction counter in /metrics."""
+        entry = None
+        if self.audit_enabled:
+            entry = {"t": self.clock(), "gid": None, "trace_id": None,
+                     "attempt": 0, "policy": self.policy, "chosen": name,
+                     "reason": f"scale_{direction}", "scores": {}}
+        with self._lock:
+            if entry is not None:
+                self.audit.append(entry)
+            self.audit_counts[f"scale_{direction}"] += 1
+            self.scale_events[direction] += 1
+
+    def add_replica(self, replica):
+        """Dynamic scale-up: register a new replica and add it to the
+        consistent-hash ring — ONLY the keys the new vnodes claim move
+        (~K/(N+1)); every other template's home replica, and its hot
+        radix chain, stays put (pinned by test). Re-using a retired
+        name is allowed (a replaced process). Records a scale_up
+        audit event."""
+        with self._lock:
+            name = replica.name
+            if name in self.replicas and name not in self.dead:
+                raise ValueError(
+                    f"replica {name!r} is already registered and alive")
+            self.dead.discard(name)
+            self.draining.discard(name)
+            self.replicas[name] = replica
+            self._snaps.pop(name, None)
+            self.ring.add(name)
+        self._record_scale("up", name)
+        return name
+
+    def remove_replica(self, name, migrate=True):
+        """Graceful scale-down: drain = MIGRATE-then-retire. The
+        replica leaves the ring and the placement set immediately (no
+        new work lands), every unfinished assignment it holds is
+        live-migrated to another replica (``export_slot`` ->
+        ``import_slot``: KV blocks + sampler state move, the stream
+        resumes mid-decode with zero re-prefill and the delivered
+        prefix skipped — greedy token-identical), and only then is the
+        handle closed and dropped. ``migrate=False`` (or any migration
+        error: target death mid-transfer, rpc timeout, a fault
+        injection at the "migration" point) degrades per-assignment to
+        the classic failover path — replay from the prompt, never a
+        hang, never a double delivery. Returns a drain summary dict
+        (protocol.DRAIN_FIELDS)."""
+        with self._lock:
+            if name not in self.replicas:
+                raise KeyError(f"unknown replica {name!r}")
+            was_dead = name in self.dead
+            src = self.replicas[name]
+            if not was_dead:
+                self.draining.add(name)
+                self.ring.remove(name)
+                self._snaps.pop(name, None)
+            victims = [asg for asg in self._table.values()
+                       if asg.replica == name and not asg.done
+                       and not asg.orphaned]
+        summary = {"replica": name, "migrated": 0, "failed_over": 0,
+                   "orphaned": 0, "expired": 0}
+        self.refresh()                    # fresh load scores for targets
+        for asg in victims:
+            if migrate and not was_dead:
+                out = self._migrate_one(asg, name)
+            else:
+                with self._lock:
+                    stuck = (not asg.done and not asg.orphaned
+                             and asg.replica == name)
+                    if stuck:
+                        asg.replica, asg.rid = None, None
+                out = None
+                if stuck:
+                    self._failover_one(asg)
+                    out = ("orphaned" if asg.orphaned else
+                           "expired" if asg.state == "expired" else
+                           "failed_over")
+            if out in summary:
+                summary[out] += 1
+        with self._lock:
+            self.draining.discard(name)
+            self.dead.discard(name)
+            self.replicas.pop(name, None)
+        try:
+            src.close()
+        except Exception:
+            pass                          # retiring a corpse is fine
+        self._record_scale("down", name)
+        return summary
+
+    def _migrate_one(self, asg, src_name):
+        """Live-migrate ONE assignment off ``src_name``: export the
+        slot (KV blocks + decode state leave the source atomically),
+        import it on the least-loaded placeable replica (AdmissionFull
+        walks the next candidate), and repoint the assignment with the
+        delivered-prefix skip — the client stream never notices. ANY
+        failure after the export (the testing/fault.py "migration"
+        point, a target dying mid-transfer, everyone full) aborts to
+        the classic failover fallback: re-submit from the prompt, skip
+        the delivered prefix — degraded to a re-prefill, still
+        exactly-once. Returns "migrated" | "failed_over" | "orphaned" |
+        "expired" | "skipped"."""
+        from ..testing import fault
+        src = self.replicas[src_name]
+        with self._lock:
+            if asg.done or asg.orphaned or asg.replica != src_name \
+                    or asg.rid is None:
+                return "skipped"
+            rid = asg.rid
+        # final harvest first: a request that FINISHED on the engine but
+        # was not yet collected needs its tokens drained, not a
+        # migration (exporting it would fail and the fallback would
+        # wastefully replay a completed request elsewhere)
+        try:
+            new, done, state = src.harvest(rid)
+        except Exception:
+            new, done, state = None, False, None
+        with self._lock:
+            if new is not None and (asg.replica, asg.rid) == (src_name,
+                                                              rid):
+                if asg.skip:
+                    drop = min(asg.skip, len(new))
+                    asg.skip -= drop
+                    new = new[drop:]
+                asg.tokens.extend(new)
+                if done:
+                    asg.done, asg.state = True, state
+                    return "skipped"
+            if asg.done or asg.orphaned or (asg.replica, asg.rid) != \
+                    (src_name, rid):
+                return "skipped"
+            # detach NOW: a concurrent harvest that raced the export
+            # discards its batch (epoch mismatch) exactly like failover
+            asg.replica, asg.rid = None, None
+        attempt = asg.resubmits + 2
+        tgt_name = rid2 = None
+        try:
+            state = src.export_slot(rid)
+            # the chaos lever: PADDLE_FI_AT_POINT=migration kills the
+            # transfer exactly here — state is off the source, not yet
+            # on any target (the worst moment)
+            fault.inject("migration")
+            if asg.kw.get("deadline_s") is not None:
+                # remaining budget from the PRISTINE submit-time deadline
+                # (like _failover_one) — the exported value is already
+                # the remainder from any prior migration, so subtracting
+                # elapsed-since-submit from IT would double-count every
+                # leg before this one
+                remaining = asg.kw["deadline_s"] - (self.clock()
+                                                    - asg.t_submit)
+                if remaining <= 0:
+                    with self._lock:
+                        asg.done, asg.state = True, "expired"
+                    return "expired"
+                state["deadline_s"] = remaining
+            state["attempt"] = attempt
+            with self._lock:
+                order = sorted(
+                    (n for n in self.placeable_names() if n != src_name),
+                    key=lambda n: (self.load_score(self._snap(n)), n))
+            last_full = None
+            for cand in order:
+                try:
+                    rid2 = self.replicas[cand].import_slot(state)
+                except AdmissionFull as e:
+                    last_full = e
+                    continue
+                tgt_name = cand
+                break
+            if tgt_name is None:
+                raise last_full if last_full is not None else \
+                    NoReplicaError("no placeable replica to migrate to")
+        except Exception:
+            with self._lock:
+                self.migration_aborts_total += 1
+                stuck = not asg.done and not asg.orphaned
+            if stuck:
+                self._failover_one(asg)
+            with self._lock:
+                return ("orphaned" if asg.orphaned else
+                        "expired" if asg.state == "expired" else
+                        "failed_over")
+        with self._lock:
+            if asg.gid in self._table and not asg.done:
+                asg.skip = len(asg.tokens)
+                asg.replica, asg.rid = tgt_name, rid2
+                asg.resubmits += 1
+                self.migrations_total += 1
+                stray = None
+            else:                         # released/finished meanwhile
+                stray = self.replicas.get(tgt_name)
+        if stray is not None:
+            stray.release(rid2)
+            return "skipped"
+        self._record_decision(asg, tgt_name, "migrated", {}, attempt)
+        return "migrated"
+
+    def scale_status(self):
+        """The /admin/scale payload's router half (the gateway folds in
+        the autoscaler's bounds)."""
+        with self._lock:
+            return {"replicas_alive": len(self.alive_names()),
+                    "replicas_total": len(self.replicas),
+                    "draining": sorted(self.draining),
+                    "migrations_total": self.migrations_total,
+                    "migration_aborts_total": self.migration_aborts_total,
+                    "scale_events_up": self.scale_events["up"],
+                    "scale_events_down": self.scale_events["down"]}
+
     # ------------------------------------------------------- aggregation
     def metrics_prometheus(self):
         """Cluster exposition: each alive replica's engine exposition
@@ -717,6 +1024,16 @@ class Router:
             for reason in AUDIT_REASONS:
                 lines.append(f'{name}{{reason="{reason}"}} '
                              f"{self.audit_counts[reason]}")
+            # elastic control-plane counters (zero-initialized like the
+            # decision counters: the label set is discoverable before
+            # any scale event — pinned by check_metrics_surface)
+            name = "paddle_gateway_scale_events_total"
+            lines.append(f"# HELP {name} replica-set changes by "
+                         "direction (autoscaler or /admin/scale)")
+            lines.append(f"# TYPE {name} counter")
+            for d in ("up", "down"):
+                lines.append(f'{name}{{direction="{d}"}} '
+                             f"{self.scale_events[d]}")
         with self._lock:
             gauges = (
                 ("paddle_gateway_replicas_alive", "gauge",
@@ -728,6 +1045,12 @@ class Router:
                 ("paddle_gateway_failovers_total", "counter",
                  self.failovers_total,
                  "in-flight re-submissions after a replica death"),
+                ("paddle_gateway_migrations_total", "counter",
+                 self.migrations_total,
+                 "live sessions moved replica-to-replica (drain)"),
+                ("paddle_gateway_migration_aborts_total", "counter",
+                 self.migration_aborts_total,
+                 "migrations aborted mid-transfer -> classic failover"),
                 ("paddle_gateway_snapshot_version_mismatches_total",
                  "counter", self.version_mismatches,
                  "snapshots refused for schema_version drift"))

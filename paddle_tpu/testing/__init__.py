@@ -12,11 +12,12 @@ import os
 # Every env var the fault-injection harness reads. Keep sorted; the
 # conftest guard fails any non-FT test that runs with one of these set.
 FI_ENV_VARS = (
-    "PADDLE_FI_AT_POINT",       # named hook point targeting KILL/HANG
+    "PADDLE_FI_AT_POINT",       # named hook point targeting KILL/HANG/RAISE
     "PADDLE_FI_AT_STEP",        # step index gating KILL/HANG ("step" point)
     "PADDLE_FI_DROP_HEARTBEAT",  # rank whose heartbeat publisher goes dark
     "PADDLE_FI_HANG",           # rank that hangs (bounded sleep) at the point
     "PADDLE_FI_KILL_RANK",      # rank that hard-exits (os._exit) at the point
+    "PADDLE_FI_RAISE",          # rank that raises FaultInjected at the point
 )
 
 # Flight-recorder configuration (distributed/resilience/flight_recorder.py)
@@ -34,6 +35,16 @@ FR_ENV_VARS = (
 # so only tests/test_serving_cluster.py may run with these set (and it
 # uses monkeypatch / constructor args, not the process env).
 GW_ENV_VARS = (
+    # elastic autoscaler (serving_cluster/autoscale.py): leaked
+    # watermarks silently change when every later cluster spawns or
+    # drains replicas — same guard discipline as the router knobs
+    "PADDLE_AUTOSCALE_COOLDOWN_S",  # seconds between scale events
+    "PADDLE_AUTOSCALE_HYSTERESIS",  # consecutive agreeing ticks needed
+    "PADDLE_AUTOSCALE_KV_FREE_FRAC",  # pool-free fraction -> scale up
+    "PADDLE_AUTOSCALE_MAX",        # replica-count ceiling
+    "PADDLE_AUTOSCALE_MIN",        # replica-count floor
+    "PADDLE_AUTOSCALE_QUEUE_HIGH",  # mean queue depth -> scale up
+    "PADDLE_AUTOSCALE_QUEUE_LOW",  # mean queue depth -> scale down
     "PADDLE_GATEWAY_HB_DEAD_S",    # heartbeat age -> replica dead
     "PADDLE_GATEWAY_HB_S",         # gateway health-sweep interval
     "PADDLE_GATEWAY_HB_TIMEOUT_S",  # rpc replica liveness-probe timeout

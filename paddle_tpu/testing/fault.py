@@ -13,9 +13,14 @@ Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
                                 hook); unset -> they fire at "init"
   PADDLE_FI_AT_POINT=<name>     target a NAMED hook point instead
                                 ("init" | "step" | "collective" — the
-                                flight-recorder choke point); KILL/HANG
-                                fire at the AT_STEP-th occurrence of
-                                that point (unset AT_STEP = the first).
+                                flight-recorder choke point — or
+                                "migration" — the router's live-slot
+                                transfer, fired BETWEEN export and
+                                import so the state is off the source
+                                but on no target, the worst moment);
+                                KILL/HANG/RAISE fire at the AT_STEP-th
+                                occurrence of that point (unset
+                                AT_STEP = the first).
                                 "collective" requires the flight
                                 recorder to be enabled (the hook rides
                                 its choke point) — the desync e2e's
@@ -28,6 +33,14 @@ Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
                                 (the process stays alive: the watchdog on
                                 the PEERS must convert this into a
                                 PeerFailureError)
+  PADDLE_FI_RAISE=<r>           rank r RAISES FaultInjected at the
+                                point instead of exiting — the
+                                in-process fault flavor (a single-
+                                process cluster cannot os._exit to
+                                simulate a peer dying mid-transfer;
+                                the caller's abort path must handle
+                                the exception exactly like a transport
+                                error)
 
 Injections fire at most once per process (a restarted generation whose
 env cleared the vars is unaffected; one that kept them re-injects —
@@ -41,10 +54,16 @@ import time
 from . import FI_ENV_VARS
 
 __all__ = ["inject", "heartbeat_dropped", "step_count", "reset",
-           "FI_EXIT_CODE", "HANG_BOUND_S"]
+           "FaultInjected", "FI_EXIT_CODE", "HANG_BOUND_S"]
 
 FI_EXIT_CODE = 43          # distinctive: never collides with signal codes
 HANG_BOUND_S = 3600.0      # a "hang" is a bounded sleep, not a true wedge
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the PADDLE_FI_RAISE flavor: an injected in-process
+    failure the exercised code path must degrade from (e.g. a migration
+    transfer dying mid-flight -> classic failover fallback)."""
 
 _steps = 0                 # "step"-point calls observed in this process
 _point_counts: dict = {}   # point -> calls observed (AT_POINT mode)
@@ -114,6 +133,11 @@ def inject(point: str, rank=None):
     if not hit or _fired:
         return
     r = str(rank) if rank is not None else _rank()
+    if os.environ.get("PADDLE_FI_RAISE") == r:
+        _fired = True
+        print(f"paddle_tpu.testing.fault: rank {r} RAISING at {point}",
+              flush=True)
+        raise FaultInjected(f"injected fault at point {point!r}")
     if os.environ.get("PADDLE_FI_HANG") == r:
         _fired = True
         print(f"paddle_tpu.testing.fault: rank {r} HANGING at {point} "

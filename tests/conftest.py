@@ -82,12 +82,21 @@ def check_serving_metrics(eng):
     admitted one, which legitimately breaks the reconciliation."""
     m = eng.metrics()
     assert m["requests_admitted"] >= 0
-    # every finished request was admitted or forked (expired ones may
-    # have been shed straight from the queue, so they don't reconcile
-    # this way; a fork is a clone — it performs no prefix lookup and
-    # counts separately so hits + misses == admitted stays exact)
+    # every finished request was admitted, forked, or MIGRATED IN
+    # (expired ones may have been shed straight from the queue, so they
+    # don't reconcile this way; forks and migrated-in sessions are not
+    # admissions — they perform no prefix lookup and count separately,
+    # so hits + misses == admitted stays exact)
     assert m["requests_finished"] <= \
-        m["requests_admitted"] + m["requests_forked"]
+        m["requests_admitted"] + m["requests_forked"] \
+        + m["requests_migrated_in"]
+    # live-migration counters only move on paged engines (the payload
+    # IS pool blocks)
+    assert m["requests_migrated_in"] >= 0
+    assert m["requests_migrated_out"] >= 0
+    if getattr(eng, "pool", None) is None:
+        assert m["requests_migrated_in"] == 0
+        assert m["requests_migrated_out"] == 0
     if getattr(eng, "prefix_cache", None) is not None:
         assert m["prefix_hits"] + m["prefix_misses"] == \
             m["requests_admitted"], (

@@ -853,12 +853,14 @@ def test_http_surface_pinned(capsys):
 
 
 def test_gateway_env_registry_complete():
-    """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_*/PADDLE_SLO_* env the
-    serving stack reads is registered in testing.GW_ENV_VARS (the
-    conftest leak guard's list), and the registry carries no dead
-    entries — same structural discipline as FI_ENV_VARS/FR_ENV_VARS.
-    The SLO knobs live in inference/telemetry.py (SloPolicy.from_env),
-    so that file joins the scan."""
+    """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_*/PADDLE_SLO_*/
+    PADDLE_AUTOSCALE_* env the serving stack reads is registered in
+    testing.GW_ENV_VARS (the conftest leak guard's list), and the
+    registry carries no dead entries — same structural discipline as
+    FI_ENV_VARS/FR_ENV_VARS. The SLO knobs live in
+    inference/telemetry.py (SloPolicy.from_env), so that file joins
+    the scan; the autoscale knobs live in serving_cluster/autoscale.py
+    (already in the package scan)."""
     import re
 
     import paddle_tpu.inference.telemetry as tele_mod
@@ -872,7 +874,8 @@ def test_gateway_env_registry_complete():
     for path in paths:
         with open(path) as f:
             found |= set(re.findall(
-                r"PADDLE_(?:GATEWAY|ROUTER|SLO)_[A-Z_0-9]+", f.read()))
+                r"PADDLE_(?:GATEWAY|ROUTER|SLO|AUTOSCALE)_[A-Z_0-9]+",
+                f.read()))
     # the rpc-replica probe knob lives in replica.py; bench/tests may
     # reference more — the guard list must cover everything READ here
     assert found <= set(GW_ENV_VARS), (

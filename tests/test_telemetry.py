@@ -362,7 +362,10 @@ class TestSloGoodput:
         assert (m["slo_violated_queue"]
                 + m["slo_violated_service"]) == 3
         snap = eng.telemetry_snapshot()
-        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 2
+        # v3: the requests block carries the migration counters too
+        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 3
+        assert snap["requests"]["migrated_in"] == 0
+        assert snap["requests"]["migrated_out"] == 0
         slo = snap["slo"]
         assert slo["objectives"]["ttft_s"] == 1e-9
         assert (slo["ok"] + slo["violated_queue"]

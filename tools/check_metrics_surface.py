@@ -186,8 +186,24 @@ def _check_slo_and_audit_surface(failures):
             failures.append(
                 f"SLO metrics key {k!r} maps to {got!r}, pinned "
                 f"{want!r} — the goodput surface must not drift")
+    # migration counters join the pinned-by-value set: the engine's
+    # migrated_in/out totals are what the scale drill's zero-reprefill
+    # gate and the drain dashboards key on
+    mig_pinned = {
+        "requests_migrated_in": (
+            "paddle_serving_requests_migrated_in_total", "counter"),
+        "requests_migrated_out": (
+            "paddle_serving_requests_migrated_out_total", "counter"),
+    }
+    for k, want in mig_pinned.items():
+        got = PROMETHEUS_NAMES.get(k)
+        if got != want:
+            failures.append(
+                f"migration metrics key {k!r} maps to {got!r}, pinned "
+                f"{want!r}")
     want_reasons = {"affinity_hit", "least_loaded", "round_robin",
-                    "spill", "failover", "orphaned"}
+                    "spill", "failover", "orphaned", "migrated",
+                    "scale_up", "scale_down"}
     if set(AUDIT_REASONS) != want_reasons:
         failures.append(
             f"router AUDIT_REASONS drifted: {sorted(AUDIT_REASONS)} != "
@@ -203,6 +219,17 @@ def _check_slo_and_audit_surface(failures):
             failures.append(
                 f"router exposition lost the {reason!r} decision "
                 f"counter ({probe} not found)")
+    # ... and every elastic control-plane counter, zero-valued before
+    # any scale event (migrations, aborts, per-direction scale events)
+    for probe in ("paddle_gateway_migrations_total 0",
+                  "paddle_gateway_migration_aborts_total 0",
+                  'paddle_gateway_scale_events_total{direction="up"} 0',
+                  'paddle_gateway_scale_events_total{direction="down"}'
+                  " 0"):
+        if probe not in text:
+            failures.append(
+                f"empty-router exposition lost the elastic counter "
+                f"{probe.split()[0]!r}")
 
 
 def _check_snapshot_schema(failures, eng):
