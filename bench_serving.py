@@ -56,7 +56,13 @@ the decode gang hostage) at 2x offered load, the chunked engine
 reporting TTFT p50/p90/p99 straight from engine metrics() (no
 out-of-band percentile math), the p99/p50 flatness ratio, tokens/s,
 budget utilization, an exact greedy chunked-vs-phase token-parity
-check, and the zero-retrace contract. Its knobs: BENCH_TOKEN_BUDGET
+check, and the zero-retrace contract. It also runs the FLAT-vs-row
+A/B (ISSUE 13): the token-flattened [T] dispatch
+(PADDLE_SERVING_FLAT_BUDGET) against the row-aligned [B, C] block at
+the SAME arrivals, recording budget_padding_tokens for both (the
+wasted-position collapse that IS the flat win on this dispatch-bound
+CPU toy), with an exact greedy flat-vs-row parity gate and exit 1 on
+any post-warmup retrace. Its knobs: BENCH_TOKEN_BUDGET
 (default: the engine default B x decode_chunk), BENCH_CHUNKED_LONG
 (long-prompt fraction, default 0.6).
 
@@ -287,8 +293,10 @@ def _telemetry_block(eng, on_rec, off_rec):
         "latency_p99_ms": ms(m["latency_p99_s"]),
         "budget_steps": m["budget_steps"],
         "budget_tokens_used": m["budget_tokens_used"],
-        "budget_tokens_wasted": (m["budget_steps"] * tb
-                                 - m["budget_tokens_used"]) if tb else 0,
+        # real computed-position waste from the engine counter (was a
+        # steps x budget - used proxy before budget_padding_tokens
+        # existed; the counter is exact under both layouts)
+        "budget_tokens_wasted": m["budget_padding_tokens"] if tb else 0,
         "budget_utilization": m["budget_utilization"],
         "step_events": len(eng.telemetry.steps),
         "request_spans": len(eng.telemetry.spans),
@@ -1184,15 +1192,56 @@ def main_chunked():
     par_reqs = _make_longprompt_workload(rng, 2 * slots, V, smax,
                                          long_frac)
 
-    def parity_run(tb):
+    def parity_run(tb, flat=False):
         eng = ServingEngine(fmt, embed, head, num_slots=slots,
                             max_seq_len=smax, decode_chunk=chunk,
-                            token_budget=tb)
+                            token_budget=tb, flat_budget=flat)
         rids = [eng.submit(p, max_new_tokens=m) for p, m in par_reqs]
         eng.run()
         return [eng.results[r]["tokens"].tolist() for r in rids]
 
     parity_ok = parity_run(token_budget) == parity_run(0)
+
+    # ---- flat-vs-row A/B (ISSUE 13): the token-FLATTENED [T] dispatch
+    # against the row-aligned [B, C] block on the long-prompt mix (the
+    # (B-1) x C waste workload), SAME arrivals. The flat engine warms
+    # by driving the EXACT measured stream once (virtual clock ->
+    # deterministic replay -> identical pow-2 ladder buckets), so the
+    # zero-retrace gate is meaningful. The win gauge on this
+    # dispatch-bound CPU toy is budget_padding_tokens (wasted computed
+    # positions), not tokens/s — see the record's honesty note.
+    def run_flat_ab(flat, reqs, arrivals):
+        clock = VirtualClock()
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            clock=clock.now, token_budget=token_budget,
+                            flat_budget=flat)
+        arr = arrivals + clock.now()
+        _drive_continuous(eng, clock, reqs, arr)        # self-warm pass
+        traces_warm = eng.metrics()["traces"]
+        eng.reset_metrics(keep_results=False)
+        arr = arrivals + clock.now()
+        t0 = clock.now()
+        _drive_continuous(eng, clock, reqs, arr)
+        elapsed = clock.now() - t0
+        m = eng.metrics()
+        return {
+            "layout": "flat" if flat else "row",
+            "tokens": m["tokens_emitted"],
+            "tokens_per_sec": round(m["tokens_emitted"]
+                                    / max(elapsed, 1e-9), 2),
+            "budget_steps": m["budget_steps"],
+            "budget_tokens_used": m["budget_tokens_used"],
+            "budget_padding_tokens": m["budget_padding_tokens"],
+            "budget_utilization": m["budget_utilization"],
+            "ttft_p99_ms": round(1e3 * m["ttft_p99_s"], 1),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+        }
+
+    flat_ab = run_flat_ab(True, meas_reqs, arrivals)
+    row_ab = run_flat_ab(False, meas_reqs, arrivals)
+    flat_parity_ok = (parity_run(token_budget, flat=True)
+                      == parity_run(token_budget, flat=False))
 
     record = {
         "metric": "serving_chunked_prefill_ttft_p99_over_p50",
@@ -1241,6 +1290,17 @@ def main_chunked():
         "budget_utilization": chunked["budget_utilization"],
         "budget_prefill_tokens": chunked["budget_prefill_tokens"],
         "parity_ok": parity_ok,
+        # flat-vs-row A/B (same arrivals, long-prompt mix): the flat
+        # layout's win on this dispatch-bound CPU toy shows as
+        # wasted-position collapse (padding_ratio ~ a few %), not
+        # tokens/s — prefill here costs ~the dispatch either way; on a
+        # compute-bound accelerator the padding IS the FLOPs bill
+        "flat_ab": flat_ab,
+        "row_ab": row_ab,
+        "flat_padding_ratio": round(
+            flat_ab["budget_padding_tokens"]
+            / max(row_ab["budget_padding_tokens"], 1), 4),
+        "flat_parity_ok": flat_parity_ok,
         "retraces_after_warmup": chunked["retraces_after_warmup"],
         "retraces_after_warmup_phase": phase["retraces_after_warmup"],
         "long_prompt_fraction": long_frac,
@@ -1269,6 +1329,15 @@ def main_chunked():
         rc = 1
     if not parity_ok:
         print("bench_serving: CHUNKED/PHASE TOKEN PARITY BROKE",
+              file=sys.stderr)
+        rc = 1
+    if flat_ab["retraces_after_warmup"] or row_ab["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP in the flat-vs-row "
+              "A/B — the ladder/fixed-shape contract is broken",
+              file=sys.stderr)
+        rc = 1
+    if not flat_parity_ok:
+        print("bench_serving: FLAT/ROW TOKEN PARITY BROKE",
               file=sys.stderr)
         rc = 1
     return rc

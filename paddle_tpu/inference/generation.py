@@ -41,6 +41,7 @@ DISPATCH_KINDS = {
     "decode": "decode",           # the decode-chunk scan
     "verify": "verify",           # the K+1-position spec-verify block
     "budget": "budget",           # the [B, C] token-budget core
+    "flat_budget": "budget",      # the token-flattened [T] budget core
 }
 
 
@@ -145,6 +146,44 @@ def _sample_rows(logits, do_sample, top_k, top_p, temperature, seeds, nt):
         key = jax.random.fold_in(jax.random.PRNGKey(seed), n)
         return jax.random.categorical(key, lg)
     return jax.vmap(one)(seeds, nt, logits).astype(jnp.int32)
+
+
+def _make_budget_tail(hidden, head_logits, penalize_slots, rep_on,
+                      do_sample, top_k, top_p, temperature, nscan):
+    """The budget cores' TRAILING decode scan (the decode-chunk body
+    verbatim): after the block samples, rows that are decoding keep
+    emitting `nscan` tokens in the SAME dispatch so mixed steps never
+    slow decode below the plain chunk. ONE owner shared by the
+    row-aligned [B, C] core and the flat [T] core — the two layouts'
+    tail iterations cannot drift numerically."""
+    def run(stk, e_arrays, h_arrays, tok, caches, lens, active, nt,
+            presence, max_nt, eos_ids, min_len, rep_pen, seeds):
+        def body(carry, _):
+            tok, caches, lens, active, nt, presence = carry
+            xs, caches = hidden(stk, e_arrays, caches, tok, lens)
+            lg = head_logits(h_arrays, xs)
+            lg = lg.reshape(lg.shape[0], -1)
+            lg = penalize_slots(
+                lg, presence if rep_on else None, rep_pen, nt,
+                min_len, eos_ids)
+            nxt = _sample_rows(lg, do_sample, top_k, top_p,
+                               temperature, seeds, nt)
+            emitted = active
+            h_eos = (eos_ids >= 0) & (nxt == eos_ids)
+            step_ = active.astype(jnp.int32)
+            nt2 = nt + step_
+            lens2 = lens + step_
+            act2 = active & ~h_eos & (nt2 < max_nt)
+            tok2 = jnp.where(emitted, nxt, tok)
+            if rep_on:
+                presence = presence.at[
+                    jnp.arange(nxt.shape[0]), nxt].max(emitted)
+            return (tok2, caches, lens2, act2, nt2,
+                    presence), (nxt, emitted)
+        return jax.lax.scan(
+            body, (tok, caches, lens, active, nt, presence), None,
+            length=nscan)
+    return run
 
 
 @no_grad()
@@ -1251,6 +1290,145 @@ class FusedDecoder:
             return proj_ffn_tail(residual, attn.reshape(b, kp, nh * hd),
                                  p), caches
 
+        def flat_write(caches, l, tslot, tpos, kv_new, b):
+            # scatter the flat stream's K/V rows to (slot, pos) — the
+            # SEVENTH `cache_lens < Smax` clamp client (see
+            # decode_attention.py's inventory): pad tokens carry the
+            # slot SENTINEL b, which resolves to an out-of-bounds batch
+            # index (dense) or the pool's sentinel block (paged), and
+            # mode="drop" skips them; real positions are < Smax by the
+            # packer's budget arithmetic. kv_new: [2, 1, H, T, D].
+            vals = jnp.transpose(kv_new[:, 0], (2, 0, 1, 3))  # [T,2,H,D]
+            if isinstance(caches, dict):
+                pool_kv, tbl = caches["kv"], caches["tbl"]
+                nb = pool_kv.shape[2]
+                bt = pool_kv.shape[4]
+                nblk = tbl.shape[1]
+                ji = tpos // bt
+                safe = (tslot < b) & (ji < nblk)
+                rows = jnp.take(tbl, jnp.minimum(tslot, b - 1), axis=0)
+                blk = jnp.take_along_axis(
+                    rows, jnp.minimum(ji, nblk - 1)[:, None],
+                    axis=1)[:, 0]
+                blk = jnp.where(safe, blk, nb)
+                off = tpos % bt
+                if "sc" in caches:
+                    q_new, sc_new = _absmax_int8(kv_new, -1)
+                    kvq = pool_kv.at[l, :, blk, :, off, :].set(
+                        jnp.transpose(q_new[:, 0], (2, 0, 1, 3)),
+                        mode="drop")
+                    scq = caches["sc"].at[l, :, blk, :, 0, off].set(
+                        jnp.transpose(sc_new[:, 0, :, :, 0], (2, 0, 1)),
+                        mode="drop")
+                    return dict(caches, kv=kvq, sc=scq)
+                return dict(caches, kv=pool_kv.at[
+                    l, :, blk, :, off, :].set(
+                    vals.astype(pool_kv.dtype), mode="drop"))
+            sl = jnp.minimum(tslot, b - 1)
+            tv = jnp.where(tslot < b, tpos, smax)    # OOB -> dropped
+            if isinstance(caches, tuple):
+                q_new, sc_new = _absmax_int8(kv_new, -1)
+                ci8 = caches[0].at[l, :, sl, :, tv, :].set(
+                    jnp.transpose(q_new[:, 0], (2, 0, 1, 3)),
+                    mode="drop")
+                scs = caches[1].at[l, :, sl, :, 0, tv].set(
+                    jnp.transpose(sc_new[:, 0, :, :, 0], (2, 0, 1)),
+                    mode="drop")
+                return (ci8, scs)
+            return caches.at[l, :, sl, :, tv, :].set(
+                vals.astype(caches.dtype), mode="drop")
+
+        def flat_attend_seg(q_s, caches, l, sslot, spos, cmeta, b):
+            # the SEGMENT region's ragged block-flash attend: q_s
+            # [Ts, H, D] — aligned single-slot chunks of prefill /
+            # draft segments; each token attends its OWN slot's cache
+            # positions <= its position. Paged fp pools take the flat
+            # Pallas kernel (per-chunk metadata rides as scalar
+            # prefetch); everything else (int8 pools, dense rings,
+            # mesh, opt-out) goes through the gather-through-table
+            # dense fallback — the parity path.
+            ts_ = q_s.shape[0]
+            paged = isinstance(caches, dict)
+            quant = isinstance(caches, tuple) or (paged and
+                                                  "sc" in caches)
+            if paged:
+                pool_kv, tbl = caches["kv"], caches["tbl"]
+                if (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
+                        != "0" and mesh is None and not quant):
+                    from ..ops.pallas.decode_attention import (
+                        decode_attention_paged_flat,
+                        paged_flat_is_supported)
+                    if paged_flat_is_supported(
+                            ts_, nh, hd, pool_kv.shape, q_s.dtype,
+                            cache_dtype=pool_kv.dtype):
+                        cslot, cbase, cn = cmeta
+                        o = decode_attention_paged_flat(
+                            q_s, pool_kv, tbl,
+                            jnp.minimum(cslot, b - 1), cbase, cn, l)
+                        return o
+                from .paged_kv import flat_gather_view
+                pool_l = jax.lax.dynamic_index_in_dim(pool_kv, l, 0,
+                                                      keepdims=False)
+                sc_l = (jax.lax.dynamic_index_in_dim(
+                    caches["sc"], l, 0, keepdims=False)
+                    if quant else None)
+                kvg = flat_gather_view(pool_l, tbl,
+                                       jnp.minimum(sslot, b - 1),
+                                       smax, sc_l)  # [2,Ts,H,Smax,D]
+            else:
+                sl = jnp.minimum(sslot, b - 1)
+                if quant:
+                    ci = jax.lax.dynamic_index_in_dim(caches[0], l, 0,
+                                                      keepdims=False)
+                    sc = jax.lax.dynamic_index_in_dim(caches[1], l, 0,
+                                                      keepdims=False)
+                    kvg = (jnp.take(ci, sl, axis=1).astype(jnp.float32)
+                           * jnp.swapaxes(jnp.take(sc, sl, axis=1),
+                                          -1, -2))
+                else:
+                    cache_l = jax.lax.dynamic_index_in_dim(
+                        caches, l, 0, keepdims=False)
+                    kvg = jnp.take(cache_l, sl, axis=1).astype(
+                        jnp.float32)
+            s_ = jnp.einsum("thd,thsd->ths",
+                            q_s.astype(jnp.float32), kvg[0]) \
+                * (hd ** -0.5)
+            mask = (jnp.arange(smax)[None, None, :]
+                    <= spos[:, None, None])
+            s_ = jnp.where(mask, s_, -1e30)
+            p = jax.nn.softmax(s_, axis=-1)
+            o = jnp.einsum("ths,thsd->thd", p, kvg[1])
+            return o.astype(q_s.dtype)
+
+        def flat_layer_step(x, p, caches, l, tslot, tpos, cmeta, b):
+            # one layer of the FLAT budget core: the whole ragged [T]
+            # stream runs the dense ops as one [1, T, E] pass (T real
+            # tokens cost T positions — no [B, C] row padding), K/V
+            # scatters to (slot, pos), then attention splits by region:
+            # tokens [0, b) are the DECODE region (token i IS slot i —
+            # the existing per-token kernels serve it unchanged), the
+            # rest are aligned segments through flat_attend_seg.
+            residual = x
+            h = ln(x, p["ln_s"], p["ln_b"]) if pre_ln else x
+            t_all = h.shape[1]
+            q, k, v = qkv_of(h, p)                  # [1, T, H, D]
+            if use_rotary:
+                q = rope_block(q, tpos[None, :])
+                k = rope_block(k, tpos[None, :])
+            kv_new = jnp.stack([jnp.swapaxes(k, 1, 2),
+                                jnp.swapaxes(v, 1, 2)])  # [2,1,H,T,D]
+            caches = flat_write(caches, l, tslot, tpos, kv_new, b)
+            qd = q[0, :b][:, None]                  # [b, 1, H, D]
+            ad = attend(qd, caches, l, tpos[:b])    # [b, 1, H, D]
+            parts = [jnp.swapaxes(ad, 0, 1).reshape(1, b, nh * hd)]
+            if t_all > b:
+                a_s = flat_attend_seg(q[0, b:], caches, l, tslot[b:],
+                                      tpos[b:], cmeta, b)
+                parts.append(a_s.reshape(1, t_all - b, nh * hd))
+            attn = (jnp.concatenate(parts, axis=1)
+                    if len(parts) > 1 else parts[0])
+            return proj_ffn_tail(residual, attn, p), caches
+
         embed, head = self.embed, self.head
         e_params, h_params = self._embed_params, self._head_params
 
@@ -1320,6 +1498,39 @@ class FusedDecoder:
                 p, l = xs
                 x, caches = spec_layer_step(x, p, caches, l, lens,
                                             write_mask)
+                return (x, caches), None
+            nl = (caches["kv"] if isinstance(caches, dict)
+                  else caches[0] if isinstance(caches, tuple)
+                  else caches).shape[0]
+            (x, caches), _ = jax.lax.scan(
+                body, (x, caches), (stk, jnp.arange(nl, dtype=jnp.int32)))
+            return x, caches
+
+        def flat_hidden(stk, e_arrays, caches, toks, tslot, tpos, cmeta,
+                        b):
+            # toks/tslot/tpos: [T] — the flat budget core's ragged
+            # token stream ([0, b) decode region + aligned segments);
+            # cmeta: per-chunk (slot, base, n) scalar-prefetch metadata
+            # for the flat Pallas kernel. Returns (x [1, T, E], caches)
+            # with every valid token's K/V landed at (slot, pos).
+            x = call_layerlike(embed, e_params, e_arrays, toks[None, :])
+            if mesh is not None and not isinstance(caches, dict):
+                # (the paged pool carries no sharding annotations — the
+                # serving engine disables paged mode under a mesh)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                sh = NamedSharding(mesh,
+                                   P(None, None, None, "mp", None, None))
+                if isinstance(caches, tuple):
+                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
+                                   for c in caches)
+                else:
+                    caches = jax.lax.with_sharding_constraint(caches, sh)
+
+            def body(carry, xs):
+                x, caches = carry
+                p, l = xs
+                x, caches = flat_layer_step(x, p, caches, l, tslot,
+                                            tpos, cmeta, b)
                 return (x, caches), None
             nl = (caches["kv"] if isinstance(caches, dict)
                   else caches[0] if isinstance(caches, tuple)
@@ -1426,6 +1637,7 @@ class FusedDecoder:
 
         step.hidden = hidden
         step.spec_hidden = spec_hidden
+        step.flat_hidden = flat_hidden
         step.bulk_hidden = bulk_hidden
         step.sample_head = sample_head
         step.call_layerlike = call_layerlike
@@ -1570,6 +1782,9 @@ class FusedDecoder:
         smax = self.smax
         c = int(c)
         nscan = int(scan_tail)
+        tail = _make_budget_tail(hidden, head_logits, _penalize_slots,
+                                 rep_on, do_sample, top_k, top_p,
+                                 temperature, nscan)
 
         def budget(stk, e_arrays, h_arrays, caches, toks, lens, seg,
                    gen0, nt, max_nt, eos_ids, min_len, rep_pen,
@@ -1609,34 +1824,10 @@ class FusedDecoder:
                     presence = presence.at[
                         jnp.arange(tok0.shape[0]), tok0].max(emit0)
 
-                def body(carry, _):
-                    tok, caches, lens, active, nt, presence = carry
-                    xs, caches = hidden(stk, e_arrays, caches, tok,
-                                        lens)
-                    lg = head_logits(h_arrays, xs)
-                    lg = lg.reshape(lg.shape[0], -1)
-                    lg = _penalize_slots(
-                        lg, presence if rep_on else None, rep_pen, nt,
-                        min_len, eos_ids)
-                    nxt = _sample_rows(lg, do_sample, top_k, top_p,
-                                       temperature, seeds, nt)
-                    emitted = active
-                    h_eos = (eos_ids >= 0) & (nxt == eos_ids)
-                    step_ = active.astype(jnp.int32)
-                    nt2 = nt + step_
-                    lens2 = lens + step_
-                    act2 = active & ~h_eos & (nt2 < max_nt)
-                    tok2 = jnp.where(emitted, nxt, tok)
-                    if rep_on:
-                        presence = presence.at[
-                            jnp.arange(nxt.shape[0]), nxt].max(emitted)
-                    return (tok2, caches, lens2, act2, nt2,
-                            presence), (nxt, emitted)
-                (tok, caches, lens, active, nt, presence), ys = \
-                    jax.lax.scan(
-                        body,
-                        (tok, caches, lens, active, nt, presence),
-                        None, length=nscan)
+                (tok, caches, lens, active, nt, presence), ys = tail(
+                    stk, e_arrays, h_arrays, tok, caches, lens, active,
+                    nt, presence, max_nt, eos_ids, min_len, rep_pen,
+                    seeds)
                 return (caches, tok0, emit0, ys, tok, lens, active, nt,
                         presence)
             logits = head_logits(h_arrays, x)
@@ -1665,6 +1856,134 @@ class FusedDecoder:
                 return caches, logits
             return caches, jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return budget
+
+    # --------------------------------------------- flat token-budget step
+    def _build_flat_budget_core(self, ts, b, rep_on=False,
+                                do_sample=False, top_k=0, top_p=1.0,
+                                temperature=1.0, full_logits=False,
+                                chain=False, scan_tail=0):
+        """The TOKEN-FLATTENED budget step (sibling of
+        _build_budget_core, Sarathi's token-flattened batch): instead
+        of the row-aligned [B, C] block — which computes every masked
+        column, wasting (B-1) x C positions on a lone long prefill —
+        the dispatch is ONE ragged [T] token stream: T = b + ts, where
+        tokens [0, b) are the DECODE REGION (token i is slot i's
+        current input when the slot decodes draft-free this dispatch;
+        idle slots ride the SENTINEL b) and tokens [b, b+ts) are
+        SEGMENTS (prefill chunks, spec draft claims) packed
+        back-to-back with starts aligned to decode_attention.FLAT_CHUNK
+        so the flat Pallas kernel's query chunks are single-slot. Every
+        per-token datum — (slot, pos), segment columns, chunk metadata
+        — is DATA; ts comes from the packer's eighth-octave ladder, so
+        the executable set is bounded and churn retraces nothing after the
+        ladder warms.
+
+        A prefill segment is NOT capped at C columns: one segment can
+        span the whole remaining budget, so a long prompt streams
+        budget-sized chunks per dispatch instead of C-sized ones — the
+        flat layout's second win beyond dropping the row padding.
+
+        K/V writes scatter per token to (slot, pos) with the sentinel/
+        OOB drop discipline (the SEVENTH `cache_lens < Smax` clamp
+        client — decode_attention.py's inventory); sampling gathers
+        each slot's LAST valid hidden state (`last_idx`, the PR 7
+        gather-then-head trick generalized from per-row to
+        per-segment) before the LM head and draws via _sample_rows
+        keyed on fold_in(seed, nt) — per-token, never per-layout, so
+        flat outputs are EXACTLY the row core's, greedy and sampled.
+        Without spec the same trailing decode scan (`scan_tail`,
+        shared builder) follows; with spec (chain=True) the core
+        returns the whole stream's argmax chain (or penalized logits
+        [T, V] with full_logits) and the host slices each slot's
+        segment for acceptance — draft claims are just flat segments.
+
+        Signature (operands beyond the row core's: tslot/tpos [T] the
+        per-token indices, cslot/cbase/cn [T/FLAT_CHUNK] the kernel's
+        chunk metadata, tcol/tstart [T] per-token segment columns and
+        segment-start stream indices for the chain penalties, tok_in/
+        last_idx/emit0/adv [B] the per-slot harvest vectors)."""
+        from .serving import _penalize_slots
+        core = self._build_step_core(False, 0, 1.0, 1.0)
+        flat_hidden, head_logits = core.flat_hidden, core.head_logits
+        hidden = core.hidden
+        b = int(b)
+        nscan = int(scan_tail)
+        tail = _make_budget_tail(hidden, head_logits, _penalize_slots,
+                                 rep_on, do_sample, top_k, top_p,
+                                 temperature, nscan)
+
+        def flat_budget(stk, e_arrays, h_arrays, caches, toks, tslot,
+                        tpos, cslot, cbase, cn, tcol, tstart, gen0,
+                        tok_in, last_idx, emit0, adv, lens, nt, max_nt,
+                        eos_ids, min_len, rep_pen, presence, seeds):
+            x, caches = flat_hidden(stk, e_arrays, caches, toks, tslot,
+                                    tpos, (cslot, cbase, cn), b)
+            if not chain:
+                # gather-then-head at each slot's last valid stream
+                # index (bit-identical to head-then-gather: the head is
+                # per-position linear), then the row core's block
+                # bookkeeping verbatim — emit0/adv arrive as data from
+                # the packer instead of being derived from seg/gen0
+                xl = jnp.take(x[0], last_idx, axis=0)[:, None]
+                logits = head_logits(h_arrays, xl)
+                logits = logits.reshape(logits.shape[0], -1)
+                logits = _penalize_slots(
+                    logits, presence if rep_on else None, rep_pen, nt,
+                    min_len, eos_ids)
+                tok0 = _sample_rows(logits, do_sample, top_k, top_p,
+                                    temperature, seeds, nt)
+                hit_eos = (eos_ids >= 0) & (tok0 == eos_ids)
+                lens = lens + adv
+                nt = nt + emit0.astype(jnp.int32)
+                active = emit0 & ~hit_eos & (nt < max_nt)
+                tok = jnp.where(emit0, tok0, tok_in)
+                if rep_on:
+                    presence = presence.at[
+                        jnp.arange(tok0.shape[0]), tok0].max(emit0)
+                (tok, caches, lens, active, nt, presence), ys = tail(
+                    stk, e_arrays, h_arrays, tok, caches, lens, active,
+                    nt, presence, max_nt, eos_ids, min_len, rep_pen,
+                    seeds)
+                return (caches, tok0, emit0, ys, tok, lens, active, nt,
+                        presence)
+            # chain: per-token outputs over the whole stream for
+            # host-side draft acceptance / prefill first-token reads
+            logits = head_logits(h_arrays, x)
+            logits = logits.reshape(-1, logits.shape[-1])   # [T, V]
+            v = logits.shape[-1]
+            cl = jnp.minimum(tslot, b - 1)
+            valid = tslot < b
+            if rep_on:
+                # speculative presence, segment-local: the global
+                # cumsum minus its value just before each token's
+                # segment start isolates the segment's own tokens
+                # (counts are monotone), matching the row core's
+                # per-row cumulative OR exactly
+                oh = (jax.nn.one_hot(toks, v, dtype=jnp.int32)
+                      * valid[:, None].astype(jnp.int32))
+                cs = jnp.cumsum(oh, axis=0)
+                prev = jnp.where(
+                    (tstart > 0)[:, None],
+                    jnp.take(cs, jnp.maximum(tstart - 1, 0), axis=0),
+                    0)
+                seen = ((cs - prev) > 0) | jnp.take(presence, cl,
+                                                    axis=0)
+                pen = jnp.take(rep_pen, cl)[:, None]
+                logits = jnp.where(
+                    seen,
+                    jnp.where(logits > 0, logits / pen, logits * pen),
+                    logits)
+            nt_eff = jnp.take(nt, cl) + jnp.maximum(
+                tcol - jnp.take(gen0, cl), 0)
+            cols = jnp.arange(v)[None, :]
+            is_eos = cols == jnp.take(eos_ids, cl)[:, None]
+            suppress = is_eos & (nt_eff
+                                 < jnp.take(min_len, cl))[:, None]
+            logits = jnp.where(suppress, -1e30, logits)
+            if full_logits:
+                return caches, logits
+            return caches, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return flat_budget
 
     def _generate_beam(self, ids, last_x, caches, stk, e_arrays, h_arrays,
                        max_new_tokens, eos_token_id, k, length_penalty,

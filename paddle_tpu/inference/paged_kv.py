@@ -52,7 +52,7 @@ import numpy as np
 from .prefix_cache import PrefixNode, PrefixStore
 
 __all__ = ["BlockPool", "PagedPrefixStore", "PagedPrefixCache",
-           "counted_jit"]
+           "counted_jit", "flat_gather_view"]
 
 
 def counted_jit(jit_cache, key, build, bump, donate=()):
@@ -430,3 +430,37 @@ class PagedPrefixCache:
                 self.pool.deref([ids[i]])
                 tables[slot, i] = node.block
         return new
+
+
+def flat_gather_view(pool_l, tbl, tslot, smax, sc_l=None):
+    """Per-TOKEN gather-through-table view for the flat budget core's
+    dense-fallback attention (generation._build_flat_budget_core):
+    resolve each flat-stream token's slot through the block tables and
+    materialize its full [Smax]-position K/V row.
+
+    pool_l: [2, NB, Hk, Bt, D] (ONE layer's pool slice); tbl:
+    [B, Smax/Bt] int32 per-slot tables (sentinel NB for unmapped);
+    tslot: [T] int32 per-token slot ids ALREADY CLAMPED in-bounds
+    (pad tokens point at any valid slot — their positions are masked
+    by the caller); sc_l: optional [2, NB, Hk, 1, Bt] int8 dequant
+    scales (the int8 pool flavor — the flat Pallas kernel has no i8
+    path, so quantized pools always come through here). Returns
+    [2, T, Hk, Smax, D] float32 (dequantized when sc_l is given).
+
+    Sentinel/unmapped table entries clamp to an arbitrary block —
+    their positions are >= the row's lens and masked by the caller's
+    block-causal mask, exactly like the row-aligned gather fallback."""
+    import jax.numpy as jnp
+    nb = pool_l.shape[1]
+    hk, bt, d = pool_l.shape[2], pool_l.shape[3], pool_l.shape[4]
+    rows = jnp.take(tbl, tslot, axis=0)               # [T, Smax/Bt]
+    tc = jnp.minimum(rows, nb - 1)
+    kvg = jnp.take(pool_l, tc, axis=1)          # [2, T, Nblk, Hk, Bt, D]
+    kvg = jnp.transpose(kvg, (0, 1, 3, 2, 4, 5)).reshape(
+        2, tslot.shape[0], hk, smax, d)
+    if sc_l is None:
+        return kvg.astype(jnp.float32)
+    scg = jnp.take(sc_l, tc, axis=1)            # [2, T, Nblk, Hk, 1, Bt]
+    scg = jnp.transpose(scg, (0, 1, 3, 4, 2, 5)).reshape(
+        2, tslot.shape[0], hk, 1, smax)
+    return kvg.astype(jnp.float32) * jnp.swapaxes(scg, -1, -2)

@@ -71,6 +71,19 @@ Sampled mode draws each token from fold_in(request_seed, position)
 to the scheduler — the chunked-vs-phase parity tests pin token
 equality in both greedy and sampled mode.
 
+Token-FLATTENED budget layout (`PADDLE_SERVING_FLAT_BUDGET=1` /
+`flat_budget=True`; row-aligned stays the default): the [B, C] block
+computes every masked column — a lone long prefill wastes (B-1) x C
+positions per dispatch. Flat mode packs the SAME work as ONE ragged
+[T] token stream (a B-wide decode region plus back-to-back segments
+with eighth-octave ladder width) with per-token (slot, pos) indices,
+so T real tokens cost ~T computed positions (`budget_padding_tokens`
+~ 0) and one prefill segment can span the whole spare budget, not C
+columns; prefill chunks attend via a block-flash Pallas kernel
+(decode_attention_paged_flat) with the gather-dense fallback as the
+parity path. Token outputs are EXACTLY the row layout's, greedy and
+sampled (tests/test_flat_budget.py).
+
 Telemetry (telemetry.py; `telemetry_ring=` / `PADDLE_TELEMETRY_RING`,
 0 disables collection): per-request lifecycle spans and a per-dispatch
 step timeline in bounded rings, TTFT/latency/tokens-per-step as
@@ -223,7 +236,8 @@ class ServingEngine:
                  max_pending=None, prefill_cap=None,
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
                  paged=None, kv_pool=None, kv_pool_blocks=None,
-                 token_budget=None, telemetry_ring=None, slo=None):
+                 token_budget=None, flat_budget=None,
+                 telemetry_ring=None, slo=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
@@ -467,6 +481,28 @@ class ServingEngine:
                 "arithmetic). Set token_budget=0 for the legacy phase "
                 "scheduler if you need the old heuristic.",
                 DeprecationWarning, stacklevel=2)
+        # TOKEN-FLATTENED budget dispatch (PADDLE_SERVING_FLAT_BUDGET=1
+        # / flat_budget=True; row-aligned stays the default until the
+        # bench A/B gate flips it): the budget step becomes ONE ragged
+        # [T] token stream — a B-wide decode region plus back-to-back
+        # segments with eighth-octave ladder width — instead of the [B, C]
+        # block, so T real tokens cost ~T computed positions
+        # (budget_padding_tokens ~ 0) where the row layout paid B x C
+        # (a lone long prefill wasted (B-1) x C per dispatch), and one
+        # prefill segment can span the whole spare budget instead of C
+        # columns. Token parity with the row layout is exact (greedy
+        # AND sampled — sampling is keyed fold_in(seed, nt), never by
+        # layout); tests/test_flat_budget.py pins it.
+        flat_env = os.environ.get("PADDLE_SERVING_FLAT_BUDGET", "0")
+        self._flat_budget = (bool(flat_budget)
+                             if flat_budget is not None
+                             else flat_env == "1")
+        if self._flat_budget and not tb:
+            raise ValueError(
+                "flat_budget needs the token-budget scheduler "
+                "(token_budget > 0): the flat [T] stream IS the budget "
+                "dispatch — token_budget=0 selects the legacy phase "
+                "scheduler, which has no budget step to flatten")
         # prefill progress: prompt tokens still to feed per slot (> 0
         # marks an admitted-but-unprefilled "prefilling" slot the
         # budget packer advances, oldest request first)
@@ -476,6 +512,11 @@ class ServingEngine:
         self._budget_prefill_tokens = 0
         self._budget_decode_tokens = 0
         self._budget_draft_tokens = 0
+        # masked/pad positions the budget dispatches actually computed
+        # (row: B x C - packed; flat: (B + T_seg) - packed) — the
+        # wasted-FLOPs ledger the flat layout exists to flatten;
+        # utilization = used / (used + padding) by construction
+        self._budget_padding_tokens = 0
 
         b = self.num_slots
         fmt.eval()
@@ -802,6 +843,7 @@ class ServingEngine:
             "budget_prefill_tokens": self._budget_prefill_tokens,
             "budget_decode_tokens": self._budget_decode_tokens,
             "budget_draft_tokens": self._budget_draft_tokens,
+            "budget_padding_tokens": self._budget_padding_tokens,
             "slo_ok": self._slo_ok,
             "slo_violated_queue": self._slo_violated_queue,
             "slo_violated_service": self._slo_violated_service,
@@ -847,6 +889,7 @@ class ServingEngine:
         self._budget_prefill_tokens = 0
         self._budget_decode_tokens = 0
         self._budget_draft_tokens = 0
+        self._budget_padding_tokens = 0
         self._slo_ok = 0
         self._slo_violated_queue = 0
         self._slo_violated_service = 0
@@ -931,19 +974,28 @@ class ServingEngine:
             # token-budget window counters (all zero in phase mode):
             # used = the REAL tokens packed into budget dispatches
             # (prefill + decode + draft parts sum to it exactly — the
-            # conftest reconciliation pins the split), utilization =
-            # used / (steps x token_budget). Plain decode-chunk
-            # dispatches the budget arithmetic falls back to are NOT
-            # budget steps and don't count here.
+            # conftest reconciliation pins the split); padding = the
+            # masked/pad positions those dispatches actually COMPUTED
+            # (row-aligned: B x C - used per step; flat: the decode
+            # region's idle rows + alignment/ladder tail — the flat
+            # layout's whole point is driving this to ~0). Utilization
+            # is used / (used + padding): the denominator is each
+            # dispatch's real compute width (B x C row-aligned, T
+            # flat), so the gauge stays in (0, 1] under BOTH layouts.
+            # Plain decode-chunk dispatches the budget arithmetic
+            # falls back to are NOT budget steps and don't count here.
             "budget_steps": self._budget_steps,
             "budget_tokens_used": self._budget_tokens_used,
             "budget_prefill_tokens": self._budget_prefill_tokens,
             "budget_decode_tokens": self._budget_decode_tokens,
             "budget_draft_tokens": self._budget_draft_tokens,
+            "budget_padding_tokens": self._budget_padding_tokens,
             "budget_utilization": (
                 round(self._budget_tokens_used
-                      / (self._budget_steps * self.token_budget), 4)
-                if self._budget_steps and self.token_budget else None),
+                      / (self._budget_tokens_used
+                         + self._budget_padding_tokens), 4)
+                if self._budget_steps and self._budget_tokens_used
+                else None),
             # SLO/goodput window counters (SloPolicy; objectives unset
             # = everything ok): ok + violated_queue + violated_service
             # == requests_finished by construction — every finished
@@ -1979,9 +2031,12 @@ class ServingEngine:
         steps fall back to the (equally warm) decode-chunk scan when
         IT moves more tokens per dispatch — the budget arithmetic that
         subsumes the deprecated thin-draft heuristic. Returns tokens
-        emitted."""
-        from .spec_decode import (filtered_probs, greedy_accept,
-                                  rejection_sample, truncate_emitted)
+        emitted. Flat mode (PADDLE_SERVING_FLAT_BUDGET) swaps the
+        [B, C] block for the token-flattened [T] stream — same
+        contracts, ~zero padding (see _flat_budget_step)."""
+        if self._flat_budget:
+            return self._flat_budget_step()
+        from .spec_decode import propose_claims
         b = self.num_slots
         c = self._budget_cols
         dec_rows = [s for s in range(b) if self._active[s]]
@@ -1989,19 +2044,15 @@ class ServingEngine:
         if not dec_rows and not pf_rows:
             return 0
         k = self.spec_k
-        drafts = np.zeros((b, max(k, 1)), np.int32)
-        dlen = np.zeros(b, np.int32)
         if k:
-            for s in dec_rows:
-                d = self._drafters[s].propose()
-                # the bonus token always ships: at most remaining-1
-                # drafts are useful, and a row's whole segment must fit
-                # the C columns
-                m = min(int(d.size),
-                        int(self._max_nt[s] - self._nt[s]) - 1, c - 1)
-                if m > 0:
-                    drafts[s, :m] = d[:m]
-                    dlen[s] = m
+            # a row's whole segment (input + drafts) must fit the C
+            # columns; the bonus-token budget cap lives in the helper
+            drafts, dlen = propose_claims(self._drafters, dec_rows, k,
+                                          self._max_nt - self._nt,
+                                          col_cap=c)
+        else:
+            drafts = np.zeros((b, 1), np.int32)
+            dlen = np.zeros(b, np.int32)
         if not pf_rows and len(dec_rows) + int(dlen.sum()) < \
                 len(dec_rows) * self.decode_chunk:
             # budget arithmetic: the block step processes
@@ -2072,7 +2123,6 @@ class ServingEngine:
         h_arrays = self.dec._maybe_quant_head(
             [p._data for p in self.dec._head_params])
         full_logits = bool(self.do_sample and k)
-        tele = self.telemetry
         res, ev = self._run_dispatch(
             ("budget", c),
             lambda: self.dec._build_budget_core(
@@ -2096,76 +2146,109 @@ class ServingEngine:
         self._budget_prefill_tokens += int(pf_n.sum())
         self._budget_decode_tokens += len(dec_rows)
         self._budget_draft_tokens += int(dlen.sum())
+        # the row layout COMPUTES every one of the B x C positions —
+        # the masked remainder is the wasted-FLOPs ledger the flat
+        # layout drives to ~0
+        self._budget_padding_tokens += b * c - int(seg.sum())
+        if not k:
+            return self._harvest_budget_plain(res, ev, pf_n, tail)
+        # per-slot chain views into the [B, C] block outputs: slot s's
+        # segment occupies columns [0, seg[s]) of its row
+        out = np.asarray(res[1])
+        if full_logits:
+            out = out.astype(np.float32)
+        chain_out = {s: out[s, :int(seg[s])]
+                     for s in range(b) if seg[s]}
+        return self._harvest_budget_chain(chain_out, ev, pf_n, dec_rows,
+                                          drafts, dlen, full_logits)
+
+    def _harvest_budget_plain(self, res, ev, pf_n, tail):
+        """Non-spec budget harvest, shared by the row-aligned and flat
+        dispatches (both cores return the same advanced-state tuple):
+        the core advanced ALL row state on device (block sample +
+        trailing decode scan); the host walks tokens and finish
+        events. Returns tokens emitted."""
+        b = self.num_slots
+        tele = self.telemetry
         now = self.clock()
         mesh_on = self.dec._mesh_mp() is not None
         pc = self.prefix_cache if not mesh_on else None
-        if not k:
-            # ---- non-spec harvest: the core advanced ALL row state on
-            # device (block sample + trailing decode scan); the host
-            # walks tokens and finish events
-            (_, tok0, emit0, (ys_t, ys_e), tokc, lensc, activec, ntc,
-             presc) = res
-            tok0 = np.asarray(tok0)
-            emit0 = np.asarray(emit0)
-            ys_t = np.asarray(ys_t)          # [tail, B]
-            ys_e = np.asarray(ys_e)
-            prev_active = self._active.copy()
-            self._tok = np.array(tokc)
-            self._lens = np.array(lensc)
-            self._nt = np.array(ntc)
-            still_active = np.array(activec)
-            if self._rep_on:
-                self._presence = presc
-            n_emitted = 0
-            for s in range(b):
-                req = self._slot_req[s]
-                if req is None:
-                    continue
-                if pf_n[s]:
-                    self._pf_left[s] -= int(pf_n[s])
-                    tele.req_event(req.rid, "prefill_chunk", now)
-                    if self._pf_left[s] == 0 and pc is not None:
-                        # commit-on-prefill publication: decode writes
-                        # (including this dispatch's trailing scan)
-                        # land strictly past every published full
-                        # block, so publishing at harvest is safe
-                        if self.paged:
-                            pc.publish_from(self._tables, s, req.prompt)
-                        else:
-                            pc.publish(self._caches, s, req.prompt)
-                if not emit0[s] and not prev_active[s]:
-                    continue                 # idle or still prefilling
-                row_toks = []
-                if emit0[s]:
-                    row_toks.append(int(tok0[s]))
-                    if pf_n[s]:              # the prompt finished HERE
-                        req.t_first = now
-                        tele.req_event(req.rid, "first_token", now)
-                if tail:
-                    hits = ys_e[:, s]
-                    row_toks.extend(int(t) for t in ys_t[hits, s])
-                if row_toks and prev_active[s]:
-                    tele.req_event(req.rid, "decode", now)
-                req.tokens.extend(row_toks)
-                n_emitted += len(row_toks)
-                self._decode_steps += len(row_toks)
-                if not still_active[s]:
-                    self._finish(req, now)
-            self._active = still_active
-            tele.finish_step(ev, self.clock() if ev is not None else 0.0,
-                             tokens=n_emitted)
-            return n_emitted
-        # ---- spec harvest: block-only (accepted drafts already make
-        # the step multi-token); acceptance/rollback on host, as in the
-        # legacy verify step
-        out = np.asarray(res[1])
+        (_, tok0, emit0, (ys_t, ys_e), tokc, lensc, activec, ntc,
+         presc) = res
+        tok0 = np.asarray(tok0)
+        emit0 = np.asarray(emit0)
+        ys_t = np.asarray(ys_t)          # [tail, B]
+        ys_e = np.asarray(ys_e)
+        prev_active = self._active.copy()
+        self._tok = np.array(tokc)
+        self._lens = np.array(lensc)
+        self._nt = np.array(ntc)
+        still_active = np.array(activec)
+        if self._rep_on:
+            self._presence = presc
+        n_emitted = 0
+        for s in range(b):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            if pf_n[s]:
+                self._pf_left[s] -= int(pf_n[s])
+                tele.req_event(req.rid, "prefill_chunk", now)
+                if self._pf_left[s] == 0 and pc is not None:
+                    # commit-on-prefill publication: decode writes
+                    # (including this dispatch's trailing scan)
+                    # land strictly past every published full
+                    # block, so publishing at harvest is safe
+                    if self.paged:
+                        pc.publish_from(self._tables, s, req.prompt)
+                    else:
+                        pc.publish(self._caches, s, req.prompt)
+            if not emit0[s] and not prev_active[s]:
+                continue                 # idle or still prefilling
+            row_toks = []
+            if emit0[s]:
+                row_toks.append(int(tok0[s]))
+                if pf_n[s]:              # the prompt finished HERE
+                    req.t_first = now
+                    tele.req_event(req.rid, "first_token", now)
+            if tail:
+                hits = ys_e[:, s]
+                row_toks.extend(int(t) for t in ys_t[hits, s])
+            if row_toks and prev_active[s]:
+                tele.req_event(req.rid, "decode", now)
+            req.tokens.extend(row_toks)
+            n_emitted += len(row_toks)
+            self._decode_steps += len(row_toks)
+            if not still_active[s]:
+                self._finish(req, now)
+        self._active = still_active
+        tele.finish_step(ev, self.clock() if ev is not None else 0.0,
+                         tokens=n_emitted)
+        return n_emitted
+
+    def _harvest_budget_chain(self, chain_out, ev, pf_n, dec_rows,
+                              drafts, dlen, full_logits):
+        """Spec budget harvest, shared by the row-aligned and flat
+        dispatches: block-only (accepted drafts already make the step
+        multi-token); acceptance/rollback on host, as in the legacy
+        verify step. ``chain_out`` maps each packed slot to ITS
+        segment's outputs — argmax chain [seg] or penalized logits
+        [seg, V] — so the two layouts' different block shapes never
+        leak into the acceptance logic. Returns tokens emitted."""
+        from .spec_decode import (filtered_probs, greedy_accept,
+                                  rejection_sample, truncate_emitted)
+        tele = self.telemetry
+        now = self.clock()
+        mesh_on = self.dec._mesh_mp() is not None
+        pc = self.prefix_cache if not mesh_on else None
         n_emitted = 0
         new_rows, new_cols = [], []
-        logits = out.astype(np.float32) if full_logits else None
-        for s in pf_rows:
+        # FCFS (rid) order, exactly the packer's: publication order
+        # into the bounded prefix store is part of its eviction state
+        pf_order = sorted((s for s in range(self.num_slots) if pf_n[s]),
+                          key=lambda s: self._slot_req[s].rid)
+        for s in pf_order:
             n = int(pf_n[s])
-            if n == 0:
-                continue
             req = self._slot_req[s]
             self._pf_left[s] -= n
             self._lens[s] += n
@@ -2179,14 +2262,14 @@ class ServingEngine:
                     pc.publish_from(self._tables, s, req.prompt)
                 else:
                     pc.publish(self._caches, s, req.prompt)
+            arr = chain_out[s]
             if full_logits:
-                p = filtered_probs(logits[s, int(seg[s]) - 1][None],
-                                   self.top_k, self.top_p,
-                                   self.temperature)
+                p = filtered_probs(arr[-1][None], self.top_k,
+                                   self.top_p, self.temperature)
                 tok0 = int(self._get_spec_rng().choice(p.shape[-1],
                                                        p=p[0]))
             else:
-                tok0 = int(out[s, int(seg[s]) - 1])   # greedy chain
+                tok0 = int(arr[-1])                   # greedy chain
             req.t_first = now
             tele.req_event(req.rid, "first_token", now)
             req.tokens.append(tok0)
@@ -2209,13 +2292,14 @@ class ServingEngine:
             if req is None or not self._active[s]:
                 continue
             m = int(dlen[s])
+            arr = chain_out[s]
             if full_logits:
-                probs = filtered_probs(logits[s, :m + 1], self.top_k,
+                probs = filtered_probs(arr[:m + 1], self.top_k,
                                        self.top_p, self.temperature)
                 kept, _ = rejection_sample(drafts[s, :m], probs,
                                            self._get_spec_rng())
             else:
-                kept, _ = greedy_accept(drafts[s, :m], out[s, :m + 1])
+                kept, _ = greedy_accept(drafts[s, :m], arr[:m + 1])
             eos = None if self._eos[s] < 0 else int(self._eos[s])
             emitted, hit_eos = truncate_emitted(
                 kept, int(self._max_nt[s] - self._nt[s]), eos)
@@ -2244,6 +2328,202 @@ class ServingEngine:
         tele.finish_step(ev, self.clock() if ev is not None else 0.0,
                          tokens=n_emitted)
         return n_emitted
+
+    def _flat_budget_step(self):
+        """ONE token-FLATTENED budget dispatch (the Sarathi
+        token-flattened batch, PADDLE_SERVING_FLAT_BUDGET): instead of
+        the [B, C] row-aligned block, the packer emits ONE ragged [T]
+        stream — a B-wide DECODE REGION (token i is slot i's input when
+        it decodes draft-free; idle slots ride the sentinel) followed
+        by SEGMENTS (spec claims, prefill chunks) packed back-to-back
+        with starts aligned to the flat kernel's chunk size, total
+        segment width from an eighth-octave ladder. Per-token
+        (slot, pos) index
+        vectors drive the compiled flat core
+        (generation._build_flat_budget_core); a prefill segment can
+        span the whole spare budget (no C cap), so long prompts stream
+        budget-sized chunks and budget_padding_tokens stays ~0 where
+        the row layout computed (B-1) x C masked positions. All stream
+        layout is DATA — only the ladder width is trace structure, so
+        churn retraces nothing once the ladder is warm. Token outputs
+        are EXACTLY the row dispatch's (shared harvests, shared
+        sampling keyed fold_in(seed, nt)). Returns tokens emitted."""
+        from ..ops.pallas.decode_attention import FLAT_CHUNK
+        from .spec_decode import propose_claims
+        b = self.num_slots
+        dec_rows = [s for s in range(b) if self._active[s]]
+        pf_rows = [s for s in range(b) if self._pf_left[s] > 0]
+        if not dec_rows and not pf_rows:
+            return 0
+        k = self.spec_k
+        if k:
+            drafts, dlen = propose_claims(self._drafters, dec_rows, k,
+                                          self._max_nt - self._nt)
+        else:
+            drafts = np.zeros((b, 1), np.int32)
+            dlen = np.zeros(b, np.int32)
+        if not pf_rows and len(dec_rows) + int(dlen.sum()) < \
+                len(dec_rows) * self.decode_chunk:
+            # same budget arithmetic as the row dispatch: pure-decode
+            # steps run whichever warm executable moves more tokens
+            return self._decode_one_chunk()
+        # ---- pack: decode inputs are mandatory; prefill chunks (FCFS,
+        # uncapped by any column count) fill spare capacity FIRST and
+        # drafts claim what is left — the row packer's priority order,
+        # so saturated decoders with fat drafts can never starve a
+        # pending prefill (TTFT) in flat mode either
+        budget = self.token_budget - len(dec_rows)
+        segs = []                    # [slot, tokens, is_decode_claim]
+        pf_n = np.zeros(b, np.int64)
+        if pf_rows:
+            pf_rows.sort(key=lambda s: self._slot_req[s].rid)
+            for s in pf_rows:
+                n = min(int(self._pf_left[s]), budget)
+                if n <= 0:
+                    continue
+                req = self._slot_req[s]
+                p0 = req.prompt.size - int(self._pf_left[s])
+                segs.append([s, req.prompt[p0:p0 + n].astype(np.int32),
+                             False])
+                pf_n[s] = n
+                budget -= n
+        if k:
+            for s in dec_rows:
+                m = min(int(dlen[s]), budget)
+                dlen[s] = m
+                if m > 0:
+                    segs.append([s, np.concatenate(
+                        ([self._tok[s]], drafts[s, :m])).astype(
+                        np.int32), True])
+                    budget -= m
+        regd = [s for s in dec_rows if not (k and dlen[s] > 0)]
+        # ---- layout: segment starts aligned to FLAT_CHUNK (the flat
+        # kernel's single-slot query-chunk contract), total segment
+        # width from an EIGHTH-OCTAVE ladder: round up to the next
+        # multiple of next_pow2(need)/8 — ladder tail <= ~12% of the
+        # stream (a plain pow-2 ladder wasted up to 2x on long prompt
+        # chunks, re-creating a chunk of the row padding this layout
+        # exists to kill) at <= 8 widths per octave, all bounded by
+        # the token budget; the width is the ONLY trace structure
+        align = FLAT_CHUNK
+        starts = []
+        cursor = 0
+        for e in segs:
+            starts.append(cursor)
+            cursor = -(-(cursor + len(e[1])) // align) * align
+        if segs:
+            need = max(cursor, align)
+            step = max((1 << (need - 1).bit_length()) // 8, align)
+            ts = -(-need // step) * step
+        else:
+            ts = 0
+        t_total = b + ts
+        nc = ts // align
+        toks = np.zeros(t_total, np.int32)
+        tslot = np.full(t_total, b, np.int32)       # b == pad sentinel
+        tpos = np.zeros(t_total, np.int32)
+        tcol = np.zeros(t_total, np.int32)
+        tstart = np.zeros(t_total, np.int32)
+        cslot = np.zeros(nc, np.int32)
+        cbase = np.zeros(nc, np.int32)
+        cn = np.zeros(nc, np.int32)
+        last_idx = np.zeros(b, np.int32)
+        emit0 = np.zeros(b, bool)
+        adv = np.zeros(b, np.int32)
+        gen0 = np.zeros(b, np.int32)
+        for s in regd:
+            toks[s] = self._tok[s]
+            tslot[s] = s
+            tpos[s] = self._lens[s]
+            tstart[s] = s
+            last_idx[s] = s
+            emit0[s] = True
+            adv[s] = 1
+        for e, st in zip(segs, starts):
+            s, tk, is_dec = e
+            n = len(tk)
+            sl = slice(b + st, b + st + n)
+            base = int(self._lens[s])
+            toks[sl] = tk
+            tslot[sl] = s
+            tpos[sl] = base + np.arange(n)
+            tcol[sl] = np.arange(n)
+            tstart[sl] = b + st
+            last_idx[s] = b + st + n - 1
+            adv[s] = n
+            if is_dec:
+                emit0[s] = True
+            else:
+                fin = pf_n[s] == self._pf_left[s]
+                emit0[s] = bool(fin)
+                # the last prompt token's logits sample the request's
+                # FIRST generated token; mid-prompt chunks never emit
+                gen0[s] = n - 1 if fin else (1 << 30)
+            for ci in range(st // align, (st + n - 1) // align + 1):
+                cslot[ci] = s
+                cbase[ci] = base + (ci * align - st)
+                cn[ci] = min(n - (ci * align - st), align)
+        used = len(regd) + sum(len(e[1]) for e in segs)
+        computed = t_total
+        tail = 0 if k else max(self.decode_chunk - 1, 0)
+        if self.paged:
+            # cover every packed slot's write window before dispatch
+            # (lazy mapping + the COW guard), clamped to the
+            # admission-time reservation — same rule as the row path
+            for s in range(b):
+                if not adv[s]:
+                    continue
+                decodes = bool(self._active[s]) or \
+                    (pf_n[s] and pf_n[s] == self._pf_left[s])
+                hi = (int(self._lens[s]) + int(adv[s])
+                      + (tail if decodes else 0))
+                req = self._slot_req[s]
+                cap_pos = req.prompt.size + int(self._max_nt[s])
+                self._ensure_writable(s, int(self._lens[s]),
+                                      min(hi, cap_pos))
+        stk = self.dec._stacked()
+        e_arrays = [p._data for p in self.dec._embed_params]
+        h_arrays = self.dec._maybe_quant_head(
+            [p._data for p in self.dec._head_params])
+        full_logits = bool(self.do_sample and k)
+        res, ev = self._run_dispatch(
+            ("flat_budget", ts),
+            lambda: self.dec._build_flat_budget_core(
+                ts, b, self._rep_on, self.do_sample, self.top_k,
+                self.top_p, self.temperature, full_logits=full_logits,
+                chain=bool(k), scan_tail=tail),
+            (3,),
+            (stk, e_arrays, h_arrays, self._cache_arg(),
+             jnp.asarray(toks), jnp.asarray(tslot), jnp.asarray(tpos),
+             jnp.asarray(cslot), jnp.asarray(cbase), jnp.asarray(cn),
+             jnp.asarray(tcol), jnp.asarray(tstart), jnp.asarray(gen0),
+             jnp.asarray(self._tok), jnp.asarray(last_idx),
+             jnp.asarray(emit0), jnp.asarray(adv),
+             jnp.asarray(self._lens), jnp.asarray(self._nt),
+             jnp.asarray(self._max_nt), jnp.asarray(self._eos),
+             jnp.asarray(self._min_len), jnp.asarray(self._rep_pen),
+             self._presence_arg(), jnp.asarray(self._rseed, jnp.int32)),
+            rows=int((adv > 0).sum()),
+            budget_used=used,
+            budget_wasted=computed - used,
+            drafts=int(dlen.sum()))
+        self._keep_caches(res[0])
+        self._budget_steps += 1
+        self._budget_tokens_used += used
+        self._budget_prefill_tokens += int(pf_n.sum())
+        self._budget_decode_tokens += len(dec_rows)
+        self._budget_draft_tokens += int(dlen.sum())
+        self._budget_padding_tokens += computed - used
+        if not k:
+            return self._harvest_budget_plain(res, ev, pf_n, tail)
+        out = np.asarray(res[1])
+        if full_logits:
+            out = out.astype(np.float32)
+        chain_out = {s: out[s:s + 1] for s in regd}
+        for e, st in zip(segs, starts):
+            chain_out[e[0]] = out[b + st: b + st + len(e[1])]
+        return self._harvest_budget_chain(chain_out, ev, pf_n, dec_rows,
+                                          drafts, dlen, full_logits)
 
     def _decode_one_chunk(self):
         chunk = self.decode_chunk
@@ -2321,27 +2601,17 @@ class ServingEngine:
         step inside the SAME executable — zero retraces across churn,
         counted by the usual trace spy."""
         from .spec_decode import (filtered_probs, greedy_accept,
-                                  rejection_sample, truncate_emitted)
+                                  propose_claims, rejection_sample,
+                                  truncate_emitted)
         k = self.spec_k
         b = self.num_slots
         stk = self.dec._stacked()
         e_arrays = [p._data for p in self.dec._embed_params]
         h_arrays = self.dec._maybe_quant_head(
             [p._data for p in self.dec._head_params])
-        drafts = np.zeros((b, k), np.int32)
-        dlen = np.zeros(b, np.int32)
-        for s in range(b):
-            if not self._active[s]:
-                continue
-            d = self._drafters[s].propose()
-            # the bonus token always ships, so at most remaining-1
-            # drafts are useful — this cap also keeps every landed
-            # write inside the submit-time `prompt + max_new <= Smax`
-            # bound (lens + dlen <= prompt + max_nt - 1 < Smax)
-            m = min(int(d.size), int(self._max_nt[s] - self._nt[s]) - 1)
-            if m > 0:
-                drafts[s, :m] = d[:m]
-                dlen[s] = m
+        drafts, dlen = propose_claims(
+            self._drafters, [s for s in range(b) if self._active[s]],
+            k, self._max_nt - self._nt)
         if int(dlen.sum()) < self._spec_min_draft * self._active.sum():
             # thin-draft phase (cold contexts, non-repetitive spans):
             # the plain decode chunk emits decode_chunk tokens/row per

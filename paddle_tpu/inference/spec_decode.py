@@ -42,7 +42,38 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["NGramDrafter", "greedy_accept", "rejection_sample",
-           "filtered_probs", "truncate_emitted", "validate_spec_k"]
+           "filtered_probs", "truncate_emitted", "validate_spec_k",
+           "propose_claims"]
+
+
+def propose_claims(drafters, rows, k, remaining, col_cap=None):
+    """ONE owner for the serving schedulers' draft-claim proposal (the
+    row-aligned budget packer, the FLAT budget packer, and the legacy
+    phase verify step all capped drafts with hand-copied arithmetic):
+    for each slot in ``rows``, propose up to ``k`` draft tokens and cap
+    the claim at the row's remaining generation budget MINUS ONE (the
+    bonus token always ships, so at most remaining-1 drafts are useful
+    — this is also what keeps every landed draft write under the
+    submit-time ``prompt + max_new <= Smax`` bound) and, when
+    ``col_cap`` is given, at the dispatch's per-row column capacity.
+
+    drafters: per-slot NGramDrafter list; rows: slot ids to draft for;
+    remaining: [B] ints (max_new_tokens - nt per slot). Returns
+    (drafts [B, max(k, 1)] int32, dlen [B] int32)."""
+    b = len(drafters)
+    drafts = np.zeros((b, max(int(k), 1)), np.int32)
+    dlen = np.zeros(b, np.int32)
+    if not k:
+        return drafts, dlen
+    for s in rows:
+        d = drafters[s].propose()
+        m = min(int(d.size), int(remaining[s]) - 1)
+        if col_cap is not None:
+            m = min(m, int(col_cap) - 1)
+        if m > 0:
+            drafts[s, :m] = d[:m]
+            dlen[s] = m
+    return drafts, dlen
 
 
 def truncate_emitted(kept, remaining, eos):

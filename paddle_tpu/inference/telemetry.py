@@ -593,6 +593,12 @@ PROMETHEUS_NAMES = {
         "paddle_serving_budget_decode_tokens_total", "counter"),
     "budget_draft_tokens": ("paddle_serving_budget_draft_tokens_total",
                             "counter"),
+    # masked/pad positions the budget dispatches actually computed
+    # (the flat layout's win gauge: row-aligned pays B x C - used per
+    # step, the token-flattened stream ~0) — utilization is
+    # used / (used + padding) by construction
+    "budget_padding_tokens": (
+        "paddle_serving_budget_padding_tokens_total", "counter"),
     "budget_utilization": ("paddle_serving_budget_utilization", "gauge"),
     # SLO/goodput layer: every finished request is classified against
     # the declared objectives (SloPolicy) — ok, violated-by-queueing,
@@ -768,7 +774,8 @@ def snapshot(engine):
         },
         "budget": {k: m[f"budget_{k}"] for k in
                    ("steps", "tokens_used", "prefill_tokens",
-                    "decode_tokens", "draft_tokens", "utilization")},
+                    "decode_tokens", "draft_tokens", "padding_tokens",
+                    "utilization")},
         "prefix": {"hits": m["prefix_hits"], "misses": m["prefix_misses"],
                    "hit_rate": m["prefix_hit_rate"]},
         "spans_logged": len(tele.spans),

@@ -44,6 +44,17 @@ lens..lens+seg-1 through the same spec_hidden write-masked path —
 validity is (col < seg) & (pos < Smax), decode segments stay under the
 submit-time budget exactly like drafts, and prefill segments stay
 under plen <= Smax - max_new by construction.
+
+The SEVENTH client is the FLAT budget core
+(generation._build_flat_budget_core, serving's
+PADDLE_SERVING_FLAT_BUDGET token-flattened dispatch): every token of
+the ragged [T] stream scatters to (slot[t], pos[t]) — a padding token
+carries the slot SENTINEL B, which resolves to batch index B (dense
+ring: out of bounds on the batch axis) or to the pool's sentinel
+block `num_blocks` (paged), so mode="drop" skips it; real tokens
+inherit the submit-time `prompt + max_new <= Smax` bound through the
+packer (a segment's positions are lens..lens+seg-1, exactly the
+budget core's window), so `pos < Smax` holds for every landed write.
 """
 from __future__ import annotations
 
@@ -59,10 +70,12 @@ __all__ = ["decode_attention", "decode_attention_stacked",
            "decode_attention_stacked_i8", "decode_attention_stacked_write",
            "decode_attention_stacked_i8_write",
            "decode_attention_paged", "decode_attention_paged_i8",
+           "decode_attention_paged_flat",
            "is_supported", "stacked_is_supported",
            "stacked_i8_is_supported", "stacked_write_is_supported",
            "stacked_i8_write_is_supported", "paged_is_supported",
-           "paged_i8_is_supported"]
+           "paged_i8_is_supported", "paged_flat_is_supported",
+           "FLAT_CHUNK"]
 
 NEG_INF = -1e30
 
@@ -1115,3 +1128,160 @@ def decode_attention_paged_i8(qt, pool_i8, pool_scales, tables, layer,
         interpret=_interpret(),
     )(lay, lens, tbl, qt, pool_i8, pool_scales)
     return out[:, :, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Flat-stream variant: the token-budget scheduler's FLAT dispatch packs
+# every request's segment (a prefill chunk, a decode token + draft
+# claim) into ONE ragged [T] token stream instead of the row-aligned
+# [B, C] block — T real tokens cost T positions of compute, where the
+# row layout paid B x C regardless of packing (a lone long prefill
+# wasted (B-1) x C positions per dispatch). This kernel is the
+# block-flash attend for that stream: the packer aligns segment starts
+# to FLAT_CHUNK so every FLAT_CHUNK-sized query chunk belongs to ONE
+# slot, per-chunk (slot, base position, valid count) ride in as
+# scalar-prefetch metadata, and each chunk streams its slot's paged KV
+# blocks through the block table with block-causal masking — the
+# Sq > 1 write-then-attend generalization from the verify step,
+# extended to ragged multi-request streams. Pad chunks (slot sentinel)
+# carry n == 0: no block runs, l stays 0, the output row is zeroed by
+# the l == 0 guard.
+# ---------------------------------------------------------------------------
+
+# the packer's segment-start alignment = the kernel's query-chunk size:
+# 8 is the fp32 sublane minimum, so the q block (1, FLAT_CHUNK, d)
+# tiles legally for every supported dtype
+FLAT_CHUNK = 8
+
+
+def paged_flat_is_supported(t, h, d, pool_shape, dtype,
+                            cache_dtype=None) -> bool:
+    """Support predicate for decode_attention_paged_flat: stream width
+    t must tile into FLAT_CHUNK query chunks; the pool obeys the same
+    Bt-sublane and dtype-match rules as the row-aligned paged kernel
+    (int8 pools go to the gather-dense fallback — no flat i8 flavor)."""
+    if len(pool_shape) != 6:
+        return False
+    if t < FLAT_CHUNK or t % FLAT_CHUNK:
+        return False
+    if d > 256:
+        return False
+    if pool_shape[3] == 0 or h % pool_shape[3] != 0:
+        return False
+    bt = pool_shape[4]
+    sub = _paged_sublane(cache_dtype if cache_dtype is not None else dtype)
+    if bt < sub or bt % sub:
+        return False
+    if cache_dtype is not None and jnp.dtype(cache_dtype) != jnp.dtype(dtype):
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _paged_flat_kernel(lay_ref, cslot_ref, cbase_ref, cn_ref, tbl_ref,
+                       q_ref, kv_ref, o_ref, acc_sc, m_sc, l_sc,
+                       *, scale, bq, bk):
+    # flash math identical to _paged_kernel; the addressing unit is a
+    # QUERY CHUNK instead of a batch row — chunk ci's tokens are the
+    # contiguous positions cbase[ci] .. cbase[ci] + cn[ci] - 1 of slot
+    # cslot[ci], so the standard causal mask applies with the chunk's
+    # base as the prefix length and its valid count as the (dynamic)
+    # query count
+    ci = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = cbase_ref[ci]
+    sq_dyn = cn_ref[ci]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = (sq_dyn > 0) & (k_start < n_valid + sq_dyn)
+
+    @pl.when(run)
+    def _():
+        _online_softmax_block(q_ref[0], kv_ref[0, 0, 0, 0],
+                              kv_ref[0, 1, 0, 0], n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq_dyn, bq=bq, bk=bk)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0] = (acc_sc[:] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_paged_flat(q, pool, tables, chunk_slot, chunk_base,
+                                chunk_n, layer, scale=None):
+    """q: [T, H, D] — the flat token stream's queries, segment starts
+    aligned to FLAT_CHUNK so each FLAT_CHUNK query chunk is single-slot;
+    pool: [L, 2, NB, Hk, Bt, D]; tables: [B(+sentinel rows ok), Smax/Bt]
+    int32; chunk_slot/chunk_base/chunk_n: [T/FLAT_CHUNK] int32 per-chunk
+    metadata (slot id CLAMPED in-bounds by the caller, base position of
+    the chunk's first token, number of valid tokens — 0 for pad
+    chunks). Returns [T, H, D]: token i attends its slot's
+    table-resolved positions <= its own position (block-causal; the
+    chunk's K/V must already be written — write-then-attend)."""
+    t, h, d = q.shape
+    hk, bt = pool.shape[3], pool.shape[4]
+    nb = pool.shape[2]
+    nblk = tables.shape[1]
+    group = h // hk
+    nc = t // FLAT_CHUNK
+    if t % FLAT_CHUNK:
+        raise ValueError(
+            f"decode_attention_paged_flat: stream width {t} must be a "
+            f"multiple of FLAT_CHUNK={FLAT_CHUNK} (gate with "
+            "paged_flat_is_supported)")
+    if scale is None:
+        scale = d ** -0.5
+    if pool.dtype != q.dtype:
+        raise ValueError(
+            f"decode_attention_paged_flat: query dtype {q.dtype} != "
+            f"pool dtype {pool.dtype}; gate with paged_flat_is_supported"
+            "(..., cache_dtype=...) and use the gather-dense fallback")
+    out_dtype = q.dtype
+    # [T, H, D] -> [H, T, D]: heads ride their own grid axis, the token
+    # chunk is the q block's sublane axis
+    qt = jnp.swapaxes(q, 0, 1)
+    grid = (nc, h, nblk)
+
+    def _blk(ci, j, cb_r, cn_r, tbl_r, cs_r):
+        # last-valid-block clamp per CHUNK (pipeline copy elision, the
+        # stacked/paged kernels' trick): the chunk's highest attendable
+        # position is cbase + cn - 1; later grid steps re-address that
+        # block. Pad chunks (cn == 0) pin to the chunk's base block.
+        last = (cb_r[ci] + jnp.maximum(cn_r[ci], 1) - 1) // bt
+        return jnp.minimum(tbl_r[cs_r[ci], jnp.minimum(j, last)], nb - 1)
+
+    kvidx = lambda ci, h_, j, lay_r, cs_r, cb_r, cn_r, tbl_r, g=group: (  # noqa: E731
+        lay_r[0], 0, _blk(ci, j, cb_r, cn_r, tbl_r, cs_r), h_ // g, 0, 0)
+    qidx = lambda ci, h_, j, lay_r, cs_r, cb_r, cn_r, tbl_r: (  # noqa: E731
+        h_, ci, 0)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(_paged_flat_kernel, scale=float(scale),
+                          bq=FLAT_CHUNK, bk=bt),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, FLAT_CHUNK, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, bt, d), kvidx),
+            ],
+            out_specs=pl.BlockSpec((1, FLAT_CHUNK, d), qidx),
+            scratch_shapes=[
+                pltpu.VMEM((FLAT_CHUNK, d), jnp.float32),
+                pltpu.VMEM((FLAT_CHUNK, 1), jnp.float32),
+                pltpu.VMEM((FLAT_CHUNK, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, t, d), pool.dtype),
+        interpret=_interpret(),
+    )(lay, chunk_slot.astype(jnp.int32), chunk_base.astype(jnp.int32),
+      chunk_n.astype(jnp.int32), tables.astype(jnp.int32), qt, pool)
+    return jnp.swapaxes(out, 0, 1).astype(out_dtype)

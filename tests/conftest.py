@@ -146,14 +146,27 @@ def check_serving_metrics(eng):
         f"budget token split broke: {m['budget_tokens_used']} != "
         f"{m['budget_prefill_tokens']} + {m['budget_decode_tokens']} + "
         f"{m['budget_draft_tokens']}")
+    # padding = masked/pad positions the budget dispatches actually
+    # computed; used + padding is each dispatch's real compute width,
+    # so the utilization gauge reconstructs from the two counters
+    # exactly — true under BOTH the row-aligned and flat layouts, no
+    # layout branch needed
+    assert m["budget_padding_tokens"] >= 0
     if tb:
         assert m["budget_tokens_used"] <= m["budget_steps"] * tb, (
             f"budget overspent: {m['budget_tokens_used']} tokens in "
             f"{m['budget_steps']} steps at budget {tb}")
         if m["budget_utilization"] is not None:
             assert 0.0 < m["budget_utilization"] <= 1.0
+            assert m["budget_utilization"] == round(
+                m["budget_tokens_used"]
+                / (m["budget_tokens_used"]
+                   + m["budget_padding_tokens"]), 4), (
+                "budget_utilization no longer reconstructs from "
+                "used/(used + padding)")
     else:
         assert m["budget_steps"] == 0 and m["budget_tokens_used"] == 0
+        assert m["budget_padding_tokens"] == 0
         assert m["budget_utilization"] is None
     # SLO/goodput reconciliation: every FINISHED request gets exactly
     # one verdict (ok / violated-by-queueing / violated-by-service), so

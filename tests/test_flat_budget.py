@@ -1,0 +1,310 @@
+"""Token-flattened budget batch: ragged [T] dispatch + chunked-prefill
+block-flash path (ISSUE 13).
+
+Contracts under test:
+  * flat-vs-row EXACT token parity — greedy AND sampled (the
+    sampling-invariance contract `fold_in(seed, nt)` makes sampled
+    parity a hard gate) — across prefix-cache on/off and spec on/off,
+    under paged eviction churn; the flat side must really have run the
+    flat executable (a "flat_budget" jit key), not fallen back;
+  * zero retraces after warmup on a staggered mixed stream: stream
+    layout (slots, positions, segment boundaries, chunk metadata) is
+    all data — only the pow-2 ladder width is trace structure;
+  * the block-flash flat kernel (decode_attention_paged_flat) is
+    numerically the masked-scan/gather reference (allclose), including
+    pad chunks and mid-cache base positions;
+  * the wasted-position ledger: on a long-prompt stream the flat
+    layout's budget_padding_tokens is a small fraction of the row
+    layout's ~(B-1) x C per-dispatch waste, and utilization
+    reconstructs from used/(used + padding) (conftest pins that);
+  * env/ctor knob: PADDLE_SERVING_FLAT_BUDGET=1 opts in, the ctor arg
+    wins over the env, flat + token_budget=0 is refused.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.nn.layer.common import Embedding, Linear
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+
+
+def _model(seed=3):
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return fmt, embed, head
+
+
+def _prompt(rng, n):
+    return rng.randint(1, V, (n,)).astype(np.int32)
+
+
+def _mixed_reqs(rng, n=8, spec=False):
+    if spec:
+        cores = [_prompt(rng, 4 + j) for j in range(3)]
+        return [(np.tile(cores[i % 3], 2), 14 + 4 * (i % 3))
+                for i in range(n)]
+    prefixes = [_prompt(rng, 8) for _ in range(3)]
+    reqs = [(np.concatenate([prefixes[i % 3], _prompt(rng, 2 + i % 5)]),
+             4 + i % 3) for i in range(n - 1)]
+    reqs.append((_prompt(rng, 40), 6))        # one genuinely long prompt
+    return reqs
+
+
+def _ran_flat(eng):
+    """The flat engine must have dispatched the flat executable (not
+    just fallen through to decode chunks)."""
+    return any(k[0] == "flat_budget" for k in eng._jit_cache)
+
+
+class TestFlatVsRowParity:
+    """The layout must be invisible token-for-token: the SAME request
+    stream through the flat [T] engine and the row-aligned [B, C]
+    engine (both token-budget scheduled) yields identical outputs."""
+
+    @pytest.mark.parametrize("sample,prefix_blocks,spec", [
+        (False, 0, 0), (False, 3, 0), (False, 0, 4), (False, 3, 4),
+        (True, 0, 0), (True, 3, 0),
+        # sampled + spec is EXCLUDED by design, exactly like the
+        # chunked-vs-phase suite: rejection sampling consumes the host
+        # acceptance RNG in dispatch order, which legitimately differs
+        # between layouts (distribution-exact either way)
+    ])
+    def test_exact_token_parity(self, sample, prefix_blocks, spec,
+                                serving_metrics_ok):
+        fmt, embed, head = _model(seed=61)
+        rng = np.random.RandomState(11)
+        reqs = _mixed_reqs(rng, spec=bool(spec))
+
+        def run(flat):
+            paddle.seed(0)           # identical per-request seed stream
+            eng = ServingEngine(fmt, embed, head, num_slots=2,
+                                max_seq_len=128, decode_chunk=2,
+                                prefill_cap=4,
+                                prefix_cache_blocks=prefix_blocks,
+                                spec_k=spec, do_sample=sample, top_k=5,
+                                flat_budget=flat)
+            rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+            eng.run()
+            return eng, [eng.results[r]["tokens"] for r in rids]
+
+        eng_f, toks_f = run(True)
+        eng_r, toks_r = run(False)
+        assert eng_f._flat_budget and not eng_r._flat_budget
+        for a, b in zip(toks_f, toks_r):
+            np.testing.assert_array_equal(a, b)
+        m = serving_metrics_ok(eng_f)
+        serving_metrics_ok(eng_r)
+        assert m["budget_steps"] > 0
+        assert _ran_flat(eng_f) and not _ran_flat(eng_r)
+        if prefix_blocks:
+            assert m["prefix_store"]["evictions"] > 0    # churned
+        if spec:
+            assert m["draft_accepted"] > 0
+
+    def test_int8_cache_parity(self, monkeypatch):
+        """PADDLE_TPU_DECODE_INT8_CACHE=1 pins the quantized flat
+        branches (flat_write's int8 scatter, flat_attend_seg's
+        dequant gathers through flat_gather_view's sc path — the flat
+        kernel has no i8 flavor, so this IS the fallback's test):
+        exact flat-vs-row parity on the quantized pool."""
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
+        fmt, embed, head = _model(seed=66)
+        rng = np.random.RandomState(5)
+        reqs = [(_prompt(rng, 8 + i % 5), 4) for i in range(5)]
+        reqs.append((_prompt(rng, 40), 6))
+
+        def run(flat):
+            paddle.seed(0)
+            eng = ServingEngine(fmt, embed, head, num_slots=2,
+                                max_seq_len=128, decode_chunk=2,
+                                prefill_cap=4, flat_budget=flat)
+            rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+            eng.run()
+            return eng, [eng.results[r]["tokens"] for r in rids]
+
+        eng_f, toks_f = run(True)
+        _, toks_r = run(False)
+        assert "sc" in eng_f._caches and _ran_flat(eng_f)
+        for a, b in zip(toks_f, toks_r):
+            np.testing.assert_array_equal(a, b)
+
+    def test_kernel_engaged_parity(self):
+        """prefill_cap=8 satisfies the flat kernel's Bt sublane rule,
+        so the flat side runs the real block-flash path (interpret
+        mode on CPU) — parity must still be exact."""
+        fmt, embed, head = _model(seed=62)
+        rng = np.random.RandomState(7)
+        reqs = [(_prompt(rng, 8 + i % 5), 4) for i in range(5)]
+        reqs.append((_prompt(rng, 60), 6))
+
+        def run(flat):
+            paddle.seed(0)
+            eng = ServingEngine(fmt, embed, head, num_slots=2,
+                                max_seq_len=128, decode_chunk=2,
+                                prefill_cap=8, flat_budget=flat)
+            rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+            eng.run()
+            return eng, [eng.results[r]["tokens"] for r in rids]
+
+        eng_f, toks_f = run(True)
+        _, toks_r = run(False)
+        from paddle_tpu.ops.pallas.decode_attention import (
+            paged_flat_is_supported)
+        pool = eng_f._caches["kv"]
+        assert paged_flat_is_supported(16, H, pool.shape[-1], pool.shape,
+                                       pool.dtype,
+                                       cache_dtype=pool.dtype)
+        for a, b in zip(toks_f, toks_r):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestFlatZeroRetrace:
+    def test_staggered_stream_retraces_nothing_after_warmup(
+            self, serving_metrics_ok):
+        """Segments, drafts, chunk metadata and prefill cursors are
+        data; the pow-2 ladder width is the only trace structure, so an
+        identical staggered replay must not build a single new
+        executable."""
+        fmt, embed, head = _model(seed=63)
+        rng = np.random.RandomState(3)
+
+        def staggered(eng, reqs):
+            for p, m in reqs[:len(reqs) // 2]:
+                eng.submit(p, max_new_tokens=m)
+            for _ in range(3):
+                eng.step()
+            for p, m in reqs[len(reqs) // 2:]:
+                eng.submit(p, max_new_tokens=m)
+            eng.run()
+
+        reqs = _mixed_reqs(rng, n=8, spec=True)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, decode_chunk=2, spec_k=4,
+                            flat_budget=True)
+        staggered(eng, reqs)
+        warm = eng.metrics()["traces"]
+        assert warm > 0 and _ran_flat(eng)
+        staggered(eng, reqs)              # identical churn
+        m = serving_metrics_ok(eng)
+        assert m["traces"] == warm, (
+            f"flat staggered churn retraced: {warm} -> {m['traces']}")
+        assert m["budget_prefill_tokens"] > 0
+        assert m["budget_decode_tokens"] > 0
+
+
+class TestFlatKernelNumerics:
+    def test_block_flash_matches_masked_reference(self):
+        """decode_attention_paged_flat vs the gather-through-table
+        masked-softmax reference, over mixed chunks: mid-cache bases,
+        a partial chunk, and a pure-pad chunk."""
+        from paddle_tpu.ops.pallas.decode_attention import (
+            FLAT_CHUNK, decode_attention_paged_flat,
+            paged_flat_is_supported)
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        lnum, nb, h, bt, d = 2, 12, 4, 8, 16
+        b, nblk = 3, 4                       # Smax = 32
+        t = 4 * FLAT_CHUNK
+        pool = rng.randn(lnum, 2, nb, h, bt, d).astype(np.float32)
+        tbl = rng.permutation(nb)[:b * nblk].reshape(b, nblk).astype(
+            np.int32)
+        cslot = np.array([0, 1, 1, 2], np.int32)
+        cbase = np.array([5, 0, 8, 17], np.int32)
+        cn = np.array([8, 8, 3, 0], np.int32)    # partial + pad chunks
+        q = rng.randn(t, h, d).astype(np.float32)
+        assert paged_flat_is_supported(t, h, d, pool.shape, q.dtype,
+                                       cache_dtype=pool.dtype)
+        lay = 1
+        out = np.asarray(decode_attention_paged_flat(
+            jnp.asarray(q), jnp.asarray(pool), jnp.asarray(tbl),
+            jnp.asarray(cslot), jnp.asarray(cbase), jnp.asarray(cn),
+            lay))
+        smax = nblk * bt
+        for ci in range(4):
+            for r in range(int(cn[ci])):
+                tok = ci * FLAT_CHUNK + r
+                s, pos = int(cslot[ci]), int(cbase[ci]) + r
+                kv = pool[lay][:, tbl[s]].transpose(
+                    0, 2, 1, 3, 4).reshape(2, h, smax, d)
+                sc = np.einsum("hd,hsd->hs", q[tok], kv[0]) * (d ** -0.5)
+                sc[:, pos + 1:] = -1e30
+                p = np.exp(sc - sc.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                ref = np.einsum("hs,hsd->hd", p, kv[1])
+                np.testing.assert_allclose(out[tok], ref, rtol=2e-5,
+                                           atol=2e-5)
+
+    def test_unaligned_stream_refused(self):
+        from paddle_tpu.ops.pallas.decode_attention import (
+            FLAT_CHUNK, paged_flat_is_supported)
+        pool_shape = (1, 2, 8, 4, 8, 16)
+        assert not paged_flat_is_supported(FLAT_CHUNK + 1, 4, 16,
+                                           pool_shape, np.float32)
+        assert not paged_flat_is_supported(0, 4, 16, pool_shape,
+                                           np.float32)
+        # Bt below the fp32 sublane minimum -> gather fallback
+        assert not paged_flat_is_supported(FLAT_CHUNK, 4, 16,
+                                           (1, 2, 8, 4, 4, 16),
+                                           np.float32)
+
+
+class TestFlatPaddingWin:
+    def test_long_prompt_padding_collapses(self, serving_metrics_ok):
+        """The (B-1) x C workload: long prompts next to short decodes.
+        The row layout computes every masked column; the flat layout's
+        padding is bounded by the decode region's idle rows plus the
+        alignment/ladder tail — a small fraction of the row waste."""
+        fmt, embed, head = _model(seed=64)
+        rng = np.random.RandomState(9)
+        reqs = [(_prompt(rng, 100), 8), (_prompt(rng, 9), 8),
+                (_prompt(rng, 120), 8), (_prompt(rng, 11), 8)]
+
+        def run(flat):
+            paddle.seed(0)
+            eng = ServingEngine(fmt, embed, head, num_slots=4,
+                                max_seq_len=256, decode_chunk=4,
+                                token_budget=256, flat_budget=flat)
+            for p, m in reqs:
+                eng.submit(p, max_new_tokens=m)
+            eng.run()
+            return serving_metrics_ok(eng)
+
+        mf = run(True)
+        mr = run(False)
+        assert mf["budget_padding_tokens"] < mr["budget_padding_tokens"] / 4, (
+            f"flat padding {mf['budget_padding_tokens']} not << row "
+            f"{mr['budget_padding_tokens']}")
+        # same real work moved, far fewer computed positions
+        assert mf["budget_utilization"] > mr["budget_utilization"]
+
+
+class TestFlatKnob:
+    def test_env_ctor_and_validation(self, monkeypatch):
+        fmt, embed, head = _model(seed=65)
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128)
+        assert not eng._flat_budget            # row-aligned default
+        monkeypatch.setenv("PADDLE_SERVING_FLAT_BUDGET", "1")
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128)
+        assert eng._flat_budget
+        # ctor arg wins over env, both directions
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, flat_budget=False)
+        assert not eng._flat_budget
+        monkeypatch.delenv("PADDLE_SERVING_FLAT_BUDGET")
+        eng = ServingEngine(fmt, embed, head, num_slots=2,
+                            max_seq_len=128, flat_budget=True)
+        assert eng._flat_budget
+        with pytest.raises(ValueError, match="token_budget > 0"):
+            ServingEngine(fmt, embed, head, num_slots=2,
+                          max_seq_len=128, flat_budget=True,
+                          token_budget=0)

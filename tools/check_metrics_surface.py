@@ -37,7 +37,7 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _build_engine():
+def _build_engine(**kw):
     import numpy as np
 
     import paddle_tpu as paddle
@@ -55,9 +55,10 @@ def _build_engine():
     # prefix cache ON (paged default): the widest metrics surface —
     # every key the engine can emit is present in this configuration
     rng = np.random.RandomState(5)
-    eng = ServingEngine(fmt, embed, head, num_slots=2, max_seq_len=64,
-                        decode_chunk=2, prefill_cap=4,
-                        prefix_cache_blocks=8)
+    args = dict(num_slots=2, max_seq_len=64, decode_chunk=2,
+                prefill_cap=4, prefix_cache_blocks=8)
+    args.update(kw)
+    eng = ServingEngine(fmt, embed, head, **args)
     return eng, rng, V
 
 
@@ -152,6 +153,13 @@ def main(argv=None):
     # by the mapping-exists rule of section 3
     _check_slo_and_audit_surface(failures)
 
+    # ---- 7. dispatch-kind coverage: every compiled executable the
+    # serving engines actually dispatch must name itself in
+    # generation.DISPATCH_KINDS — a new jit-key family without an
+    # entry would silently fall through to an "unknown" label in the
+    # telemetry step timeline instead of failing tier-1
+    n_kinds = _check_dispatch_kinds(failures, eng)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -161,8 +169,60 @@ def main(argv=None):
           "by reset_metrics + conftest reconciliation + Prometheus "
           "exposition; snapshot schema pinned; "
           f"{n_ops} flight-recorder op histograms in the "
-          "runtime registry; SLO + router-audit counter names pinned)")
+          "runtime registry; SLO + router-audit counter names pinned; "
+          f"{n_kinds} dispatched executable families covered by "
+          "generation.DISPATCH_KINDS)")
     return 0
+
+
+def _check_dispatch_kinds(failures, budget_eng):
+    """Drive every scheduler flavor (row-aligned budget — the engine
+    already driven above —, FLAT budget, legacy phase incl. the spec
+    verify step) and assert each executable family that actually got
+    dispatched has a DISPATCH_KINDS entry. Structural: a future PR
+    adding an executable kind without registering it fails here, not
+    as a silent 'unknown' timeline label."""
+    import numpy as np
+
+    from paddle_tpu.inference import generation
+
+    seen = set(k[0] for k in budget_eng._jit_cache)
+    # flat budget: the token-flattened [T] dispatch
+    eng_f, rng, V = _build_engine(flat_budget=True,
+                                  prefix_cache_blocks=0)
+    for n in (5, 9):
+        eng_f.submit(rng.randint(1, V, (n,)).astype(np.int32),
+                     max_new_tokens=3)
+    eng_f.run()
+    seen |= set(k[0] for k in eng_f._jit_cache)
+    if not any(k[0] == "flat_budget" for k in eng_f._jit_cache):
+        failures.append(
+            "the flat-budget engine never dispatched a 'flat_budget' "
+            "executable — the dispatch-kind probe lost its flat "
+            "coverage")
+    # legacy phase scheduler + spec verify: bulk_admit / prefill /
+    # admit_sample / decode / verify
+    eng_p, rng, V = _build_engine(token_budget=0, spec_k=2,
+                                  prefix_cache_blocks=0)
+    for _ in range(2):
+        core = rng.randint(1, V, (4,)).astype(np.int32)
+        eng_p.submit(np.tile(core, 3), max_new_tokens=8)
+    eng_p.run()
+    seen |= set(k[0] for k in eng_p._jit_cache)
+    for fam in sorted(seen, key=str):
+        if fam not in generation.DISPATCH_KINDS:
+            failures.append(
+                f"dispatched executable family {fam!r} has no "
+                "generation.DISPATCH_KINDS entry — its step-timeline "
+                "kind falls through to an unknown label (register it "
+                "next to the core builder)")
+    for fam in ("budget", "flat_budget", "decode"):
+        if fam not in seen:
+            failures.append(
+                f"dispatch-kind probe no longer exercises the {fam!r} "
+                "executable family — it can no longer catch an "
+                "unregistered kind there")
+    return len(seen)
 
 
 def _check_slo_and_audit_surface(failures):
