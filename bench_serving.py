@@ -103,11 +103,23 @@ prefill tokens (engines' prefill_tokens_computed sum measured around
 every drain), the set actually reached 3 and returned to 1, and every
 engine — spawned replicas included — stayed at zero retraces.
 
+--mesh runs the TENSOR-PARALLEL serving A/B: the SAME paged engine
+single-device (mp=1) vs sharded over an mp=2 mesh (head-sharded KV
+block pool, shard_map paged kernels), same fixed-seed Poisson
+workload at the SAME arrivals. On CPU hosts the mesh is forced via
+XLA_FLAGS=--xla_force_host_platform_device_count (honesty: forced
+host "devices" share one physical CPU, so tokens/s measures dispatch
+overhead, not a real TP speedup — the gates are the point). Exits
+non-zero unless: exact greedy token parity mp=2 vs mp=1 for EVERY
+request, zero retraces after warmup on the sharded engine, and the
+per-device pool residency reconciles (kv_shard_pool_bytes x mp ==
+the mp=1 engine's whole pool). Its knob: BENCH_MESH_MP (default 2).
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
-"chunked_prefill", the cluster record under "cluster"; each mode
-preserves the others' records).
+"chunked_prefill", the cluster record under "cluster", the mesh
+record under "mesh_serving"; each mode preserves the others' records).
 """
 from __future__ import annotations
 
@@ -206,7 +218,7 @@ def _collect(eng, sub, arrivals):
 
 
 _SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
-                "chunked_prefill", "cluster")
+                "chunked_prefill", "cluster", "mesh_serving")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -338,6 +350,8 @@ def main(argv=None):
         return main_chunked()
     if "--cluster" in argv:
         return main_cluster()
+    if "--mesh" in argv:
+        return main_mesh()
     from bench import _init_devices
     jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
@@ -1041,6 +1055,193 @@ def main_paged():
         rc = 1
     if not parity_ok:
         print("bench_serving: PAGED/DENSE TOKEN PARITY BROKE",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def main_mesh():
+    """Tensor-parallel serving A/B: ONE paged ServingEngine sharded
+    over an mp-way mesh (head-sharded KV block pool + shard_map paged
+    kernels) vs the identical engine single-device, SAME weights, SAME
+    fixed-seed Poisson arrivals. The gates ARE the result: exact
+    greedy token parity per request, zero retraces after warmup on the
+    sharded side, and per-device pool bytes == the mp=1 pool / mp.
+    Lands under "mesh_serving" in BENCH_serving.json."""
+    # the mesh needs devices BEFORE the first jax import: force host
+    # CPU devices unless the operator already pinned XLA_FLAGS (the
+    # flag only affects the CPU backend, so it is harmless on TPU)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import AdmissionFull, ServingEngine
+    from paddle_tpu.parallel import init_serving_mesh
+
+    mp = int(os.environ.get("BENCH_MESH_MP", "2"))
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    cap_ = int(os.environ.get("BENCH_PAGED_CAP", "32"))
+    if jax.device_count() < mp:
+        print(f"bench_serving: --mesh needs >= {mp} devices, found "
+              f"{jax.device_count()}", file=sys.stderr)
+        return 1
+
+    # the --paged mid-size CPU model (H=8 divides mp=2 and 4); the toy
+    # 4-head model would leave 2 heads per shard — legal, but a less
+    # honest read on the sharded kernel's block shapes
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(
+        on_tpu, dims=None if on_tpu else (256, 8, 1024, 4, 512))
+    if H % mp:
+        print(f"bench_serving: --mesh mp={mp} does not divide "
+              f"num_heads={H}", file=sys.stderr)
+        return 1
+
+    rng = np.random.RandomState(seed)
+
+    def make(n):
+        reqs = []
+        for _ in range(n):
+            plen = int(rng.randint(6, 25))
+            max_new = int(rng.choice([16, 24, 32]))
+            reqs.append((rng.randint(1, V, (plen,)).astype("int32"),
+                         max_new))
+        return reqs
+
+    bucket_reqs = [(rng.randint(1, V, (p,)).astype("int32"), 4)
+                   for p in (8, 16, 24)]
+    warm_reqs = make(2 * slots)
+    meas_reqs = make(n_meas)
+
+    def run_mode(label, arrivals=None):
+        clock = VirtualClock()
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            prefill_cap=cap_, paged=True,
+                            clock=clock.now)
+        for prompt, max_new in bucket_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+            eng.run()
+        for prompt, max_new in warm_reqs:
+            try:
+                eng.submit(prompt, max_new_tokens=max_new)
+            except AdmissionFull:
+                eng.run()
+                eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        eng.reset_metrics(keep_results=False)
+        t0 = clock.now()
+        _drive_continuous(eng, clock, warm_reqs,
+                          np.zeros(len(warm_reqs)) + clock.now())
+        warm = eng.metrics()
+        cap_tps = warm["tokens_emitted"] / max(clock.now() - t0, 1e-9)
+        traces_warm = warm["traces"]
+        eng.reset_metrics(keep_results=False)
+
+        if arrivals is None:
+            mean_new = float(np.mean([m for _, m in meas_reqs]))
+            rate = load * cap_tps / mean_new
+            arr_rng = np.random.RandomState(seed + 1)
+            arrivals = np.cumsum(
+                arr_rng.exponential(1.0 / rate, size=len(meas_reqs)))
+        arr = arrivals + clock.now()
+        t_start = clock.now()
+        sub = _drive_continuous(eng, clock, meas_reqs, arr)
+        elapsed = clock.now() - t_start
+        ttft, lat, toks = _collect(eng, sub, arr)
+        m = eng.metrics()
+        # workload index -> emitted tokens: the parity surface (both
+        # runs see the same requests at the same arrivals)
+        tokens_by_req = {j: eng.results[rid]["tokens"].tolist()
+                         for rid, (j, _t) in sub.items()}
+        return {
+            "label": label,
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "capacity_tokens_per_sec": round(cap_tps, 2),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+            "kv_shard_count": m["kv_shard_count"],
+            "kv_shard_heads": m["kv_shard_heads"],
+            "kv_shard_pool_bytes": m["kv_shard_pool_bytes"],
+            "ttft_p50_ms": round(1e3 * float(np.percentile(ttft, 50)), 1),
+            "ttft_p99_ms": round(1e3 * float(np.percentile(ttft, 99)), 1),
+        }, arrivals, tokens_by_req
+
+    # mp=1 baseline FIRST (the mesh, once initialized, is process-
+    # global); then the sharded engine replays the SAME arrivals
+    base, arrivals, base_toks = run_mode("mp1")
+    init_serving_mesh(mp)
+    shard, _, shard_toks = run_mode(f"mp{mp}", arrivals)
+
+    parity_ok = (set(base_toks) == set(shard_toks)
+                 and all(base_toks[j] == shard_toks[j]
+                         for j in base_toks))
+    # per-device residency: each shard holds exactly the dense pool/mp
+    pool_full = base["kv_shard_pool_bytes"] * base["kv_shard_count"]
+    shard_bytes_ok = (
+        shard["kv_shard_count"] == mp
+        and shard["kv_shard_pool_bytes"] * mp == pool_full)
+
+    record = {
+        "metric": "serving_mesh_tp_parity",
+        "value": round(shard["tokens_per_sec"]
+                       / max(base["tokens_per_sec"], 1e-9), 3),
+        "unit": f"x tokens/s mp={mp} vs mp=1 (same arrivals)",
+        "mesh_mp": mp,
+        "parity_ok": parity_ok,
+        "requests_compared": len(base_toks),
+        "retraces_after_warmup": shard["retraces_after_warmup"],
+        "retraces_after_warmup_mp1": base["retraces_after_warmup"],
+        "kv_shard_count": shard["kv_shard_count"],
+        "kv_shard_heads": shard["kv_shard_heads"],
+        "kv_shard_pool_bytes": shard["kv_shard_pool_bytes"],
+        "kv_pool_bytes_total": pool_full,
+        "shard_bytes_ok": shard_bytes_ok,
+        "tokens_per_sec_sharded": shard["tokens_per_sec"],
+        "tokens_per_sec_mp1": base["tokens_per_sec"],
+        "ttft_p50_ms_sharded": shard["ttft_p50_ms"],
+        "ttft_p50_ms_mp1": base["ttft_p50_ms"],
+        # honesty: forced host devices share ONE physical CPU — the
+        # tokens/s ratio reads dispatch overhead, not a TP speedup;
+        # the parity/retrace/residency gates are the measurement
+        "devices_forced_host": not on_tpu,
+        "max_seq": smax, "decode_chunk": chunk, "block_tokens": cap_,
+        "num_slots": slots, "layers": L, "hidden": E, "heads": H,
+        "vocab": V, "requests": n_meas, "offered_load": load,
+        "seed": seed, "device": str(dev),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "mesh_serving", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    rc = 0
+    if not parity_ok:
+        print("bench_serving: MESH/SINGLE-DEVICE TOKEN PARITY BROKE",
+              file=sys.stderr)
+        rc = 1
+    if record["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP on the sharded "
+              "engine — block churn leaked into the trace key",
+              file=sys.stderr)
+        rc = 1
+    if not shard_bytes_ok:
+        print("bench_serving: PER-SHARD POOL RESIDENCY DOES NOT "
+              f"RECONCILE (shard bytes x {mp} != mp=1 pool bytes)",
               file=sys.stderr)
         rc = 1
     return rc

@@ -518,7 +518,13 @@ class FusedDecoder:
         mirrored int8 scales in cache-quant mode). The caller (the
         serving engine) adds the per-slot block tables as "tbl" per
         dispatch — tables are host state, rebuilt from numpy each call,
-        while the pool arrays ride donation like the dense cache."""
+        while the pool arrays ride donation like the dense cache.
+
+        Under an active mp mesh the pool is laid out head-sharded on
+        the 'mp' axis (NamedSharding; axis 3 of both kv and sc) so each
+        device holds pool_bytes / mp — the block allocator, tables and
+        all scheduler metadata stay replicated host data, so paged
+        churn is invisible to the partitioner."""
         f = self.fmt
         dtype = dtype or self.fmt.qkv_weights[0]._data.dtype
         if getattr(pool, "smax", self.smax) != self.smax:
@@ -529,11 +535,29 @@ class FusedDecoder:
                 "agree")
         shape = (f.num_layers, 2, pool.num_blocks, f.num_heads,
                  pool.block_tokens, f.head_dim)
+        mesh = self._mesh_mp()
+        sharding = None
+        if mesh is not None:
+            mp = dict(mesh.shape)["mp"]
+            if f.num_heads % mp:
+                raise ValueError(
+                    f"paged KV pool cannot shard: num_heads="
+                    f"{f.num_heads} is not divisible by the mesh's mp "
+                    f"degree {mp} — the pool shards by head on the "
+                    "'mp' axis")
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(
+                mesh, P(None, None, None, "mp", None, None))
+
+        def _zeros(shp, dt):
+            z = jnp.zeros(shp, dt)
+            return jax.device_put(z, sharding) if sharding is not None \
+                else z
         if self._int8_cache():
-            return {"kv": jnp.zeros(shape, jnp.int8),
-                    "sc": jnp.zeros(shape[:4] + (1, pool.block_tokens),
-                                    jnp.float32)}
-        return {"kv": jnp.zeros(shape, dtype)}
+            return {"kv": _zeros(shape, jnp.int8),
+                    "sc": _zeros(shape[:4] + (1, pool.block_tokens),
+                                 jnp.float32)}
+        return {"kv": _zeros(shape, dtype)}
 
     # ------------------------------------------------------------ the step
     def _mesh_mp(self):
@@ -885,19 +909,61 @@ class FusedDecoder:
                 nb = pool_kv.shape[2]
                 # the paged kernel gathers K/V through the block table
                 # (table rides as scalar prefetch — block ids are data);
-                # the pool never shards, so the mesh path stays dense
+                # under a mesh the pool shards by HEAD on 'mp' while the
+                # table stays replicated, so each device runs the same
+                # kernel over its local heads against the full table
                 if (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
-                        != "0" and mesh is None):
+                        != "0"):
                     from ..ops.pallas.decode_attention import (
                         decode_attention_paged, decode_attention_paged_i8,
                         paged_i8_is_supported, paged_is_supported)
-                    if quant and paged_i8_is_supported(
+                    mp = (1 if mesh is None
+                          else dict(mesh.shape).get("mp", 1))
+                    if mesh is not None and mp >= 2 and nh % mp == 0 \
+                            and pool_kv.shape[3] % mp == 0:
+                        # head-sharded paged kernel: attention is
+                        # embarrassingly parallel over heads, and the
+                        # block table addresses the (replicated) NB axis
+                        # only, so shard_map over 'mp' needs no
+                        # collectives — same escape-from-GSPMD the dense
+                        # stacked path uses below
+                        lshape = (pool_kv.shape[:3]
+                                  + (pool_kv.shape[3] // mp,)
+                                  + pool_kv.shape[4:])
+                        ok = (paged_i8_is_supported(
+                                  (q.shape[0], sq, nh // mp, hd), lshape,
+                                  q.dtype) if quant else
+                              paged_is_supported(
+                                  (q.shape[0], sq, nh // mp, hd), lshape,
+                                  q.dtype, cache_dtype=pool_kv.dtype))
+                        if ok:
+                            from jax import shard_map
+                            from jax.sharding import PartitionSpec as SP
+                            hsp = SP(None, "mp", None, None)
+                            psp = SP(None, None, None, "mp", None, None)
+                            if quant:
+                                fn = shard_map(
+                                    decode_attention_paged_i8, mesh=mesh,
+                                    in_specs=(hsp, psp, psp, SP(), SP(),
+                                              SP()),
+                                    out_specs=hsp, check_vma=False)
+                                o = fn(qt, pool_kv, caches["sc"], tbl, l,
+                                       tb)
+                            else:
+                                fn = shard_map(
+                                    decode_attention_paged, mesh=mesh,
+                                    in_specs=(hsp, psp, SP(), SP(),
+                                              SP()),
+                                    out_specs=hsp, check_vma=False)
+                                o = fn(qt, pool_kv, tbl, l, tb)
+                            return jnp.swapaxes(o, 1, 2)
+                    if mesh is None and quant and paged_i8_is_supported(
                             (q.shape[0], sq, nh, hd), pool_kv.shape,
                             q.dtype):
                         o = decode_attention_paged_i8(
                             qt, pool_kv, caches["sc"], tbl, l, tb)
                         return jnp.swapaxes(o, 1, 2)
-                    if not quant and paged_is_supported(
+                    if mesh is None and not quant and paged_is_supported(
                             (q.shape[0], sq, nh, hd), pool_kv.shape,
                             q.dtype, cache_dtype=pool_kv.dtype):
                         o = decode_attention_paged(qt, pool_kv, tbl, l,
@@ -1344,8 +1410,9 @@ class FusedDecoder:
             # draft segments; each token attends its OWN slot's cache
             # positions <= its position. Paged fp pools take the flat
             # Pallas kernel (per-chunk metadata rides as scalar
-            # prefetch); everything else (int8 pools, dense rings,
-            # mesh, opt-out) goes through the gather-through-table
+            # prefetch; under a mesh it runs per-shard via shard_map
+            # over the head axis); everything else (int8 pools, dense
+            # rings, opt-out) goes through the gather-through-table
             # dense fallback — the parity path.
             ts_ = q_s.shape[0]
             paged = isinstance(caches, dict)
@@ -1354,11 +1421,40 @@ class FusedDecoder:
             if paged:
                 pool_kv, tbl = caches["kv"], caches["tbl"]
                 if (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
-                        != "0" and mesh is None and not quant):
+                        != "0" and not quant):
                     from ..ops.pallas.decode_attention import (
                         decode_attention_paged_flat,
                         paged_flat_is_supported)
-                    if paged_flat_is_supported(
+                    mp = (1 if mesh is None
+                          else dict(mesh.shape).get("mp", 1))
+                    if mesh is not None and mp >= 2 and nh % mp == 0 \
+                            and pool_kv.shape[3] % mp == 0:
+                        # head-sharded flat kernel: per-chunk metadata
+                        # and the block table are replicated, the pool
+                        # shards by head — shard_map over 'mp' with no
+                        # collectives (see attend() for the rationale)
+                        lshape = (pool_kv.shape[:3]
+                                  + (pool_kv.shape[3] // mp,)
+                                  + pool_kv.shape[4:])
+                        if paged_flat_is_supported(
+                                ts_, nh // mp, hd, lshape, q_s.dtype,
+                                cache_dtype=pool_kv.dtype):
+                            cslot, cbase, cn = cmeta
+                            from jax import shard_map
+                            from jax.sharding import PartitionSpec as SP
+                            fn = shard_map(
+                                decode_attention_paged_flat, mesh=mesh,
+                                in_specs=(SP(None, "mp", None),
+                                          SP(None, None, None, "mp",
+                                             None, None),
+                                          SP(), SP(), SP(), SP(), SP()),
+                                out_specs=SP(None, "mp", None),
+                                check_vma=False)
+                            o = fn(q_s, pool_kv, tbl,
+                                   jnp.minimum(cslot, b - 1), cbase, cn,
+                                   l)
+                            return o
+                    if mesh is None and paged_flat_is_supported(
                             ts_, nh, hd, pool_kv.shape, q_s.dtype,
                             cache_dtype=pool_kv.dtype):
                         cslot, cbase, cn = cmeta
@@ -1439,6 +1535,34 @@ class FusedDecoder:
                 out = fn(Tensor(x_arr))
             return out._data if isinstance(out, Tensor) else out
 
+        def shard_caches(caches):
+            # pin the carried cache sharding under a mesh so the
+            # scan-carried buffer (and its donation round-trip) keeps a
+            # stable layout: dense rings / int8 stacks AND the paged
+            # pool's kv/sc shard by HEAD on 'mp' (axis 3 in every
+            # layout); the paged block table is replicated host
+            # metadata re-uploaded per dispatch
+            if mesh is None:
+                return caches
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(mesh,
+                               P(None, None, None, "mp", None, None))
+            if isinstance(caches, dict):
+                out = dict(caches)
+                out["kv"] = jax.lax.with_sharding_constraint(
+                    caches["kv"], sh)
+                if "sc" in caches:
+                    out["sc"] = jax.lax.with_sharding_constraint(
+                        caches["sc"], sh)
+                if "tbl" in caches:
+                    out["tbl"] = jax.lax.with_sharding_constraint(
+                        caches["tbl"], NamedSharding(mesh, P()))
+                return out
+            if isinstance(caches, tuple):
+                return tuple(jax.lax.with_sharding_constraint(c, sh)
+                             for c in caches)
+            return jax.lax.with_sharding_constraint(caches, sh)
+
         def hidden(stk, e_arrays, caches, tok, t, write_mask=None):
             # tok: [B] int32; t: scalar int32 OR [B] per-row positions
             # (serving: each slot decodes at its own depth); caches:
@@ -1450,17 +1574,7 @@ class FusedDecoder:
             # whole stack per token — the r3 decode profile's ~10 ms/token
             # vs ~1 ms bandwidth-floor gap).
             x = call_layerlike(embed, e_params, e_arrays, tok[:, None])
-            if mesh is not None and not isinstance(caches, dict):
-                # (the paged pool carries no sharding annotations — the
-                # serving engine disables paged mode under a mesh)
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                sh = NamedSharding(mesh,
-                                   P(None, None, None, "mp", None, None))
-                if isinstance(caches, tuple):
-                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
-                                   for c in caches)
-                else:
-                    caches = jax.lax.with_sharding_constraint(caches, sh)
+            caches = shard_caches(caches)
 
             def body(carry, xs):
                 x, caches = carry
@@ -1481,17 +1595,7 @@ class FusedDecoder:
             # verify-step hidden core: ONE pass of the layer stack over
             # the whole K+1 block (see spec_layer_step).
             x = call_layerlike(embed, e_params, e_arrays, toks)
-            if mesh is not None and not isinstance(caches, dict):
-                # (the paged pool carries no sharding annotations — the
-                # serving engine disables paged mode under a mesh)
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                sh = NamedSharding(mesh,
-                                   P(None, None, None, "mp", None, None))
-                if isinstance(caches, tuple):
-                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
-                                   for c in caches)
-                else:
-                    caches = jax.lax.with_sharding_constraint(caches, sh)
+            caches = shard_caches(caches)
 
             def body(carry, xs):
                 x, caches = carry
@@ -1514,17 +1618,7 @@ class FusedDecoder:
             # for the flat Pallas kernel. Returns (x [1, T, E], caches)
             # with every valid token's K/V landed at (slot, pos).
             x = call_layerlike(embed, e_params, e_arrays, toks[None, :])
-            if mesh is not None and not isinstance(caches, dict):
-                # (the paged pool carries no sharding annotations — the
-                # serving engine disables paged mode under a mesh)
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                sh = NamedSharding(mesh,
-                                   P(None, None, None, "mp", None, None))
-                if isinstance(caches, tuple):
-                    caches = tuple(jax.lax.with_sharding_constraint(c, sh)
-                                   for c in caches)
-                else:
-                    caches = jax.lax.with_sharding_constraint(caches, sh)
+            caches = shard_caches(caches)
 
             def body(carry, xs):
                 x, caches = carry

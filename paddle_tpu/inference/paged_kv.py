@@ -77,6 +77,24 @@ def counted_jit(jit_cache, key, build, bump, donate=()):
     return fn
 
 
+def _pool_sharding():
+    """The pool's head-sharded layout under an active mp mesh
+    (NamedSharding over P(None, None, None, 'mp', None, None) — axis 3
+    is the head axis of both the kv blocks and the int8 scales), else
+    None. The pool executables below constrain their kv/sc outputs
+    with it so every donation round-trip hands back a buffer in the
+    SAME layout it consumed — no silent resharding between a COW copy
+    / migration write and the next engine step. All the block-index
+    slices run on the (replicated) NB axis, so none of these dispatches
+    needs a collective."""
+    from ..parallel import current_mesh
+    mesh = current_mesh()
+    if mesh is None or dict(mesh.shape).get("mp", 1) < 2:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(None, None, None, "mp", None, None))
+
+
 class BlockPool:
     """Host allocator for the ONE paged KV pool.
 
@@ -171,8 +189,22 @@ class BlockPool:
     def _bump_traces(self):
         self.trace_count += 1
 
+    @staticmethod
+    def _pin(out, sh):
+        """Constrain the pool arrays of ``out`` to the head-sharded
+        layout ``sh`` (no-op when unsharded) — see _pool_sharding."""
+        if sh is None:
+            return out
+        import jax
+        out = dict(out, kv=jax.lax.with_sharding_constraint(
+            out["kv"], sh))
+        if "sc" in out:
+            out["sc"] = jax.lax.with_sharding_constraint(out["sc"], sh)
+        return out
+
     def _build_copy(self):
         import jax
+        sh = _pool_sharding()
 
         def copy(caches, src, dst):
             kv = caches["kv"]
@@ -187,7 +219,7 @@ class BlockPool:
                                            (L, 2, 1, H, 1, Bt))
                 out["sc"] = jax.lax.dynamic_update_slice(
                     sc, sb, (0, 0, dst, 0, 0, 0))
-            return out
+            return self._pin(out, sh)
         return copy
 
     def copy_block(self, caches, src, dst):
@@ -210,6 +242,16 @@ class BlockPool:
     # retraces across any sequence length, same discipline as copy_block.
     def _build_read(self):
         import jax
+        # the exported block leaves as FULLY REPLICATED data (P() on
+        # every axis): read_block hands it to np.asarray for the host
+        # migration payload, and a replicated output makes that one
+        # device-local copy instead of a cross-device assembly
+        from ..parallel import current_mesh
+        mesh = current_mesh()
+        rep = None
+        if mesh is not None and dict(mesh.shape).get("mp", 1) >= 2:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(mesh, P())
 
         def read(caches, src):
             kv = caches["kv"]
@@ -220,6 +262,9 @@ class BlockPool:
                 out["sc"] = jax.lax.dynamic_slice(
                     caches["sc"], (0, 0, src, 0, 0, 0),
                     (L, 2, 1, H, 1, Bt))
+            if rep is not None:
+                out = {k: jax.lax.with_sharding_constraint(v, rep)
+                       for k, v in out.items()}
             return out
         return read
 
@@ -235,6 +280,7 @@ class BlockPool:
 
     def _build_write(self):
         import jax
+        sh = _pool_sharding()
 
         def write(caches, blk, dst):
             kv = caches["kv"]
@@ -244,7 +290,7 @@ class BlockPool:
                 sc = caches["sc"]
                 out["sc"] = jax.lax.dynamic_update_slice(
                     sc, blk["sc"].astype(sc.dtype), (0, 0, dst, 0, 0, 0))
-            return out
+            return self._pin(out, sh)
         return write
 
     def write_block(self, caches, block, dst):

@@ -268,8 +268,12 @@ class ServingEngine:
         # POOL (actual token residency), not B x Smax; blocks map
         # lazily as lens grows and free on eviction. A shared dense
         # PrefixCache object forces dense mode (its pool is separate
-        # storage); an active mp mesh does too (the pool carries no
-        # sharding annotations).
+        # storage). Under an active mp mesh the pool shards by HEAD on
+        # the 'mp' axis (init_paged_cache lays it out with a
+        # NamedSharding); the allocator, block tables and every
+        # scheduler decision stay replicated host data, so paged mode
+        # runs under a mesh with zero extra retraces — the only hard
+        # requirement is num_heads % mp == 0.
         env_paged = os.environ.get("PADDLE_SERVING_PAGED", "1") != "0"
         want_paged = env_paged if paged is None else bool(paged)
         if want_paged and prefix_cache is not None:
@@ -281,18 +285,30 @@ class ServingEngine:
                     "— pass prefix_cache_blocks= instead, or "
                     "paged=False")
             want_paged = False
-        if want_paged and self.dec._mesh_mp() is not None:
-            if paged:
-                # only the env/auto default may downgrade silently — an
-                # EXPLICIT paged=True must not quietly hand back a
-                # dense engine (fork_slot would then fail, the kv gate
-                # would never exist)
-                raise ValueError(
-                    "paged=True under an active mp mesh is not "
-                    "supported (the block pool carries no sharding "
-                    "annotations) — drop paged= to accept the dense "
-                    "fallback")
-            want_paged = False
+        _mesh = self.dec._mesh_mp()
+        if want_paged and _mesh is not None:
+            mp = dict(_mesh.shape)["mp"]
+            nh_ = self.dec.fmt.num_heads
+            if nh_ % mp:
+                if paged:
+                    # only the env/auto default may downgrade silently
+                    # — an EXPLICIT paged=True must not quietly hand
+                    # back a dense engine (fork_slot would then fail,
+                    # the kv gate would never exist)
+                    raise ValueError(
+                        f"paged=True under an mp={mp} mesh needs "
+                        f"num_heads % mp == 0 to shard the pool by "
+                        f"head, got num_heads={nh_} — use a divisible "
+                        "mesh degree or drop paged= to accept the "
+                        "dense fallback")
+                import warnings
+                warnings.warn(
+                    f"serving: paged KV pool disabled — num_heads="
+                    f"{nh_} is not divisible by the mesh's mp degree "
+                    f"{mp}, so the head-sharded pool layout is "
+                    "unavailable; falling back to the dense ring",
+                    RuntimeWarning, stacklevel=2)
+                want_paged = False
         self.paged = want_paged
         if not self.paged and (kv_pool is not None
                                or kv_pool_blocks is not None):
@@ -300,7 +316,7 @@ class ServingEngine:
                 "kv_pool/kv_pool_blocks state a paged-pool memory "
                 "budget, but this engine resolved to the DENSE layout "
                 "(PADDLE_SERVING_PAGED=0, paged=False, a shared dense "
-                "prefix cache, or the automatic fallback under an "
+                "prefix cache, or an indivisible head count under an "
                 "active mp mesh) — refusing to drop the budget "
                 "silently")
         self.pool = None
@@ -378,6 +394,7 @@ class ServingEngine:
             self.prefix_cache = None
         self._prefix_hits = 0
         self._prefix_misses = 0
+        self._pc_mesh_warned = False
         self._prefill_tokens_saved = 0
         self._prefill_tokens_computed = 0
         self._rep_on = bool(enable_repetition_penalty)
@@ -971,6 +988,17 @@ class ServingEngine:
             "kv_blocks_free": (self.pool.free_count if self.paged
                                else None),
             "kv_cow_copies": self._cow_copies,
+            # mesh-sharded pool layout gauges (static config, so they
+            # survive reset_metrics unchanged without an exemption;
+            # dense mode: all None): shard_count is the mesh's mp
+            # degree (1 when a paged engine runs unsharded),
+            # shard_heads the per-device head count, and
+            # shard_pool_bytes the PER-DEVICE kv(+scales) bytes —
+            # shard_count x shard_pool_bytes == the full pool, i.e.
+            # per-device residency is dense/mp
+            "kv_shard_count": self._kv_shard_count(),
+            "kv_shard_heads": self._kv_shard_heads(),
+            "kv_shard_pool_bytes": self._kv_shard_pool_bytes(),
             # token-budget window counters (all zero in phase mode):
             # used = the REAL tokens packed into budget dispatches
             # (prefill + decode + draft parts sum to it exactly — the
@@ -1014,6 +1042,30 @@ class ServingEngine:
         if self.prefix_cache is not None:
             m["prefix_store"] = self.prefix_cache.store.stats()
         return m
+
+    def _kv_shard_count(self):
+        """Number of pool shards: the mesh's mp degree, 1 for an
+        unsharded paged engine, None in dense mode (no pool)."""
+        if not self.paged:
+            return None
+        mesh = self.dec._mesh_mp()
+        return dict(mesh.shape)["mp"] if mesh is not None else 1
+
+    def _kv_shard_heads(self):
+        n = self._kv_shard_count()
+        return None if n is None else self.dec.fmt.num_heads // n
+
+    def _kv_shard_pool_bytes(self):
+        """Per-device pool residency: kv(+scales) bytes / shard count.
+        The head axis divides exactly (enforced at construction), so
+        this is the precise per-chip HBM the pool costs — dense/mp."""
+        n = self._kv_shard_count()
+        if n is None:
+            return None
+        total = int(self._caches["kv"].nbytes)
+        if "sc" in self._caches:
+            total += int(self._caches["sc"].nbytes)
+        return total // n
 
     def metrics_prometheus(self):
         """Prometheus text-format exposition: every metrics() key under
@@ -1700,6 +1752,33 @@ class ServingEngine:
         return [i for i in range(self.num_slots)
                 if not self._active[i] and self._slot_req[i] is None]
 
+    def _prefix_cache_for_dispatch(self):
+        """The prefix cache this dispatch may use, or None. The PAGED
+        cache is pure host index bookkeeping over the (head-sharded)
+        block pool — adopt writes table entries, commit pins the
+        slot's own blocks — so it participates under a mesh unchanged.
+        The DENSE cache's compiled adopt/commit gather/splat copies
+        assume an unsharded ring: that is the one genuinely
+        unsupported config left, so under a mesh it stays off (warned
+        ONCE, naming why) and every admission counts as a miss —
+        hits + misses == admitted still reconciles and the dead cache
+        is visible as hit_rate == 0."""
+        if self.prefix_cache is None:
+            return None
+        if self.paged or self.dec._mesh_mp() is None:
+            return self.prefix_cache
+        if not self._pc_mesh_warned:
+            import warnings
+            warnings.warn(
+                "serving: dense prefix cache disabled under an active "
+                "mp mesh — its compiled adopt/commit copies assume an "
+                "unsharded ring cache, so every admission counts as a "
+                "miss. The paged engine (the default) shards its pool "
+                "by head and keeps prefix caching on under a mesh.",
+                RuntimeWarning, stacklevel=3)
+            self._pc_mesh_warned = True
+        return None
+
     def _admit(self):
         """Move queued requests into free slots: batched in-slot prefill
         (chunked, write-masked) + one first-token sample. Returns the
@@ -1774,11 +1853,11 @@ class ServingEngine:
         # Prefix-cache admission: the longest published block chain is
         # splatted into the slot's cache row by ONE compiled gather-copy
         # dispatch (pow-2 ladder over chain length), and only the
-        # uncached suffix goes through prefill. Disabled under a mesh
-        # (the pool carries no sharding annotations) — every admission
-        # then counts as a miss so hits + misses == admitted still
-        # reconciles and a dead cache is visible as hit_rate == 0.
-        pc = self.prefix_cache if not mesh_on else None
+        # uncached suffix goes through prefill. The paged cache (host
+        # index writes over the shared pool) also runs under a mesh;
+        # only the dense flavor sits out there — see
+        # _prefix_cache_for_dispatch for the miss-counting contract.
+        pc = self._prefix_cache_for_dispatch()
         if pc is None and self.prefix_cache is not None:
             self._prefix_misses += len(batch)
         base = np.zeros(b, np.int32)          # adopted tokens per slot
@@ -1974,8 +2053,7 @@ class ServingEngine:
             self._presence = jnp.where(
                 jnp.asarray(admit_mask)[:, None], jnp.asarray(rows),
                 self._presence_init())
-        mesh_on = self.dec._mesh_mp() is not None
-        pc = self.prefix_cache if not mesh_on else None
+        pc = self._prefix_cache_for_dispatch()
         if pc is None and self.prefix_cache is not None:
             self._prefix_misses += len(batch)
         for r in batch:
@@ -2171,8 +2249,7 @@ class ServingEngine:
         b = self.num_slots
         tele = self.telemetry
         now = self.clock()
-        mesh_on = self.dec._mesh_mp() is not None
-        pc = self.prefix_cache if not mesh_on else None
+        pc = self._prefix_cache_for_dispatch()
         (_, tok0, emit0, (ys_t, ys_e), tokc, lensc, activec, ntc,
          presc) = res
         tok0 = np.asarray(tok0)
@@ -2239,8 +2316,7 @@ class ServingEngine:
                                   rejection_sample, truncate_emitted)
         tele = self.telemetry
         now = self.clock()
-        mesh_on = self.dec._mesh_mp() is not None
-        pc = self.prefix_cache if not mesh_on else None
+        pc = self._prefix_cache_for_dispatch()
         n_emitted = 0
         new_rows, new_cols = [], []
         # FCFS (rid) order, exactly the packer's: publication order

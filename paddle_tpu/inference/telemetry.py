@@ -584,6 +584,14 @@ PROMETHEUS_NAMES = {
     "kv_blocks_used": ("paddle_serving_kv_blocks_used", "gauge"),
     "kv_blocks_free": ("paddle_serving_kv_blocks_free", "gauge"),
     "kv_cow_copies": ("paddle_serving_kv_cow_copies_total", "counter"),
+    # mesh-sharded pool layout (static config gauges — constant for an
+    # engine's lifetime, so reset-stable without an exemption):
+    # shard_count x shard_pool_bytes == the whole pool, i.e.
+    # per-device residency is dense/mp
+    "kv_shard_count": ("paddle_serving_kv_shard_count", "gauge"),
+    "kv_shard_heads": ("paddle_serving_kv_shard_heads", "gauge"),
+    "kv_shard_pool_bytes": ("paddle_serving_kv_shard_pool_bytes",
+                            "gauge"),
     "budget_steps": ("paddle_serving_budget_steps_total", "counter"),
     "budget_tokens_used": ("paddle_serving_budget_tokens_used_total",
                            "counter"),

@@ -25,14 +25,57 @@ from .context_parallel import (ring_attention, ulysses_attention,
                                make_ulysses_attention_fn)
 
 __all__ = ["apply_shardings", "shard_batch", "data_spec", "current_mesh",
-           "with_spec", "ring_attention", "ulysses_attention",
-           "make_ring_attention_fn", "make_ulysses_attention_fn"]
+           "init_serving_mesh", "with_spec", "ring_attention",
+           "ulysses_attention", "make_ring_attention_fn",
+           "make_ulysses_attention_fn"]
 
 
 def current_mesh() -> Optional[Mesh]:
     from ..distributed.fleet.base.topology import _HYBRID_GROUP
     hcg = _HYBRID_GROUP[0]
     return hcg.mesh if hcg is not None else None
+
+
+def init_serving_mesh(mp: Optional[int] = None) -> Optional[Mesh]:
+    """Stand up (or reuse) a pure tensor-parallel mesh for serving:
+    dp=pp=sharding=1, mp as given (default: ``PADDLE_SERVING_MESH_MP``;
+    unset/0/1 = no mesh — returns whatever mesh is already active).
+    Idempotent: if the active mesh already has the requested mp degree
+    it is returned as-is; a CONFLICTING active mesh raises instead of
+    silently re-initializing fleet under a live engine's feet.
+
+    This is the one-call bring-up a sharded ``ServingEngine`` needs:
+
+        init_serving_mesh(2)          # or PADDLE_SERVING_MESH_MP=2
+        eng = ServingEngine(...)      # pool shards by head over 'mp'
+    """
+    import os
+    if mp is None:
+        mp = int(os.environ.get("PADDLE_SERVING_MESH_MP", "0") or 0)
+    mp = int(mp)
+    mesh = current_mesh()
+    if mp <= 1:
+        return mesh
+    if mesh is not None:
+        have = dict(mesh.shape).get("mp", 1)
+        if have == mp:
+            return mesh
+        raise RuntimeError(
+            f"init_serving_mesh(mp={mp}): a mesh with mp={have} is "
+            "already active — one process, one hybrid topology (reset "
+            "fleet state before re-initializing)")
+    if jax.device_count() < mp:
+        raise RuntimeError(
+            f"init_serving_mesh(mp={mp}) needs >= {mp} devices, found "
+            f"{jax.device_count()} — on CPU hosts set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={mp} before the "
+            "first jax import")
+    from ..distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    return current_mesh()
 
 
 def _valid_spec(arr, spec, mesh: Mesh) -> bool:

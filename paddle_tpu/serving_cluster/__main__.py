@@ -1,6 +1,6 @@
 """python -m paddle_tpu.serving_cluster — a self-contained demo
-cluster: N in-process replicas (each its own ServingEngine + prefix
-cache over a shared toy model) behind the gateway, ready for curl.
+cluster: N replicas (each its own ServingEngine + prefix cache over a
+shared toy model) behind the gateway, ready for curl.
 
     JAX_PLATFORMS=cpu python -m paddle_tpu.serving_cluster \
         --replicas 2 --port 8100
@@ -11,15 +11,32 @@ cache over a shared toy model) behind the gateway, ready for curl.
         '{"prompt": [5, 9, 2, 41], "max_tokens": 8, "stream": true}'
     curl -s localhost:8100/metrics | head
 
+``--workers N`` promotes the replicas OUT OF PROCESS: the gateway
+process becomes a supervisor that spawns N worker processes as a gang
+(workerlog capture, SIGTERM->grace->SIGKILL teardown — the same
+discipline as distributed.launch), rendezvouses them over
+``distributed.rpc``, and fronts each with an ``RpcReplica``. Each
+worker builds its own engine and calls ``serve_engine`` — the
+production recipe (one engine per accelerator process) instead of the
+manual ``init_rpc`` glue. A dead worker tears the whole demo down
+with a failure report naming the rank and its log tail.
+
+``--mesh-mp M`` shards every engine's paged KV pool by head over an
+M-way tensor-parallel mesh (``parallel.init_serving_mesh``); workers
+inherit it via ``PADDLE_SERVING_MESH_MP``. On CPU hosts the mesh
+devices are forced via XLA_FLAGS automatically.
+
 Flags default from the env contract (``PADDLE_GATEWAY_PORT``,
-``PADDLE_GATEWAY_REPLICAS``, ``PADDLE_ROUTER_POLICY``). This is the
-demo/e2e harness; a real deployment builds its own engines (one per
-accelerator) and passes them to ``LocalReplica``/``serve_engine``.
+``PADDLE_GATEWAY_REPLICAS``, ``PADDLE_ROUTER_POLICY``,
+``PADDLE_SERVING_MESH_MP``). This is the demo/e2e harness; a real
+deployment builds its own engines (one per accelerator) and passes
+them to ``LocalReplica``/``serve_engine``.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
 
 
@@ -41,10 +58,108 @@ def _build_engine(seed, slots, smax, prefix_blocks, cap):
                          prefix_cache_blocks=prefix_blocks)
 
 
+def _worker_main(args):
+    """Worker-process entry (the supervisor re-execs this module with
+    --worker-rank): join the rpc rendezvous FIRST (registration is
+    cheap — the supervisor's 60s window must not pay for engine
+    compiles), then build the engine and serve it."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.parallel import init_serving_mesh
+
+    from .replica import serve_engine
+
+    rank = args.worker_rank
+    world = args.workers + 1
+    last = None
+    for _ in range(200):      # the supervisor's store server races us up
+        try:
+            rpc.init_rpc(f"cluster_worker{rank}", rank=rank,
+                         world_size=world)
+            break
+        except (OSError, ConnectionError) as e:
+            last = e
+            time.sleep(0.1)
+    else:
+        raise RuntimeError(
+            f"worker {rank}: rpc rendezvous never came up: {last!r}")
+    init_serving_mesh()       # PADDLE_SERVING_MESH_MP; unset = no mesh
+    eng = _build_engine(0, args.slots, args.max_seq_len,
+                        args.prefix_blocks, args.prefill_cap)
+    serve_engine(eng, name=f"replica{rank}", threaded=True)
+    print(f"serving_cluster: worker {rank} serving", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rpc.shutdown()
+    return 0
+
+
+def _spawn_workers(args, master):
+    """Spawn the worker gang with workerlog capture; a mid-loop spawn
+    failure reaps the already-started ranks (launch discipline)."""
+    import subprocess
+
+    from paddle_tpu.distributed.launch.__main__ import _reap_gang
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs, logs = [], []
+    try:
+        for rank in range(1, args.workers + 1):
+            env = dict(os.environ)
+            env["PADDLE_MASTER"] = master
+            if args.mesh_mp > 1:
+                env["PADDLE_SERVING_MESH_MP"] = str(args.mesh_mp)
+            logf = open(os.path.join(
+                args.log_dir, f"workerlog.serving.{rank}"), "a")
+            logs.append(logf)
+            p = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.serving_cluster",
+                 "--worker-rank", str(rank),
+                 "--workers", str(args.workers),
+                 "--slots", str(args.slots),
+                 "--max-seq-len", str(args.max_seq_len),
+                 "--prefill-cap", str(args.prefill_cap),
+                 "--prefix-blocks", str(args.prefix_blocks)],
+                env=env, stdout=logf, stderr=subprocess.STDOUT)
+            p._pd_rank = rank
+            procs.append(p)
+    except Exception:
+        _reap_gang(procs, 5.0)
+        for f in logs:
+            f.close()
+        raise
+    return procs, logs
+
+
+def _wait_ready(replicas, timeout_s=120.0):
+    """Block until every worker has installed its engine: registration
+    happens before the (slow) engine build, so the first snapshot may
+    find no served engine yet — that RuntimeError is 'not ready', any
+    transport error is a dead worker."""
+    from .replica import ReplicaError
+
+    deadline = time.time() + timeout_s
+    for rep in replicas:
+        while True:
+            try:
+                rep.snapshot()
+                break
+            except ReplicaError:
+                raise
+            except Exception:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"worker {rep.name!r} never became ready")
+                time.sleep(0.25)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.serving_cluster",
-        description="demo cluster: N local replicas behind the gateway")
+        description="demo cluster: N replicas behind the gateway")
     ap.add_argument("--replicas", type=int, default=int(os.environ.get(
         "PADDLE_GATEWAY_REPLICAS", "2")))
     ap.add_argument("--port", type=int, default=int(os.environ.get(
@@ -56,34 +171,105 @@ def main(argv=None):
     ap.add_argument("--policy", default=None,
                     help="router policy (default: PADDLE_ROUTER_POLICY "
                          "or prefix_affinity)")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="spawn N out-of-process rpc workers instead of "
+                         "in-process replicas (supervised gang)")
+    ap.add_argument("--mesh-mp", type=int, default=int(os.environ.get(
+        "PADDLE_SERVING_MESH_MP", "0") or 0),
+        help="shard every engine's paged KV pool by head over an "
+             "mp-way mesh (0/1 = no mesh)")
+    ap.add_argument("--log-dir", default="log",
+                    help="worker gang log directory (workerlog.serving.N)")
+    ap.add_argument("--worker-rank", type=int, default=0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    # the mesh needs devices before the first jax import (CPU hosts:
+    # forced host devices — same lever as bench_serving --mesh)
+    if args.mesh_mp > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{max(8, args.mesh_mp)}").strip()
+
+    if args.worker_rank:
+        return _worker_main(args)
+
     from .gateway import Gateway
-    from .replica import LocalReplica
     from .router import Router
 
-    # every replica serves the SAME weights (seed-shared toy model) so
-    # routing is invisible to outputs — exactly the production contract
-    replicas = [
-        LocalReplica(f"replica{i}",
-                     _build_engine(0, args.slots, args.max_seq_len,
-                                   args.prefix_blocks, args.prefill_cap))
-        for i in range(args.replicas)]
+    procs, logs = [], []
+    if args.workers > 0:
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.launch.__main__ import (_free_port,
+                                                            _reap_gang,
+                                                            _tail)
+
+        from .replica import RpcReplica
+
+        master = f"127.0.0.1:{_free_port()}"
+        procs, logs = _spawn_workers(args, master)
+        # rank 0 hosts the store; init blocks until the gang registers
+        rpc.init_rpc("cluster_gateway", rank=0,
+                     world_size=args.workers + 1, master_endpoint=master)
+        replicas = [RpcReplica(f"cluster_worker{r}")
+                    for r in range(1, args.workers + 1)]
+        _wait_ready(replicas)
+        n_label = f"{args.workers} worker processes"
+    else:
+        from paddle_tpu.parallel import init_serving_mesh
+
+        from .replica import LocalReplica
+        if args.mesh_mp > 1:
+            init_serving_mesh(args.mesh_mp)
+        # every replica serves the SAME weights (seed-shared toy model)
+        # so routing is invisible to outputs — the production contract
+        replicas = [
+            LocalReplica(f"replica{i}",
+                         _build_engine(0, args.slots, args.max_seq_len,
+                                       args.prefix_blocks,
+                                       args.prefill_cap))
+            for i in range(args.replicas)]
+        n_label = f"{args.replicas} replicas"
+
     router = Router(replicas, policy=args.policy)
     gw = Gateway(router, port=args.port).start_background()
-    print(f"serving_cluster: {args.replicas} replicas on "
-          f"http://127.0.0.1:{gw.port} (policy {router.policy}) — "
-          "Ctrl-C to stop", flush=True)
+    mesh_note = (f", mesh mp={args.mesh_mp}" if args.mesh_mp > 1 else "")
+    print(f"serving_cluster: {n_label} on "
+          f"http://127.0.0.1:{gw.port} (policy {router.policy}"
+          f"{mesh_note}) — Ctrl-C to stop", flush=True)
+    rc = 0
     try:
         while True:
             time.sleep(1)
+            # gang supervision: the first dead worker tears down the
+            # demo with a report naming the rank and its log tail
+            dead = [p for p in procs if p.poll() is not None]
+            if dead:
+                p = dead[0]
+                path = os.path.join(args.log_dir,
+                                    f"workerlog.serving.{p._pd_rank}")
+                print(f"serving_cluster: worker {p._pd_rank} died "
+                      f"(exit {p.poll()}):\n{_tail(path)}",
+                      file=sys.stderr, flush=True)
+                rc = 1
+                break
     except KeyboardInterrupt:
         pass
     finally:
         gw.stop()
         for r in replicas:
-            r.close()
-    return 0
+            try:
+                r.close()
+            except Exception:
+                pass
+        if procs:
+            _reap_gang(procs, 5.0)
+            for f in logs:
+                f.close()
+            rpc.shutdown()
+    return rc
 
 
 if __name__ == "__main__":

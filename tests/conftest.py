@@ -198,9 +198,28 @@ def check_serving_metrics(eng):
         assert 0 <= eng._kv_reserved <= pool.num_blocks
         assert m["kv_cow_copies"] >= 0
         assert pool.used <= pool.used_peak <= pool.num_blocks
+        # mesh-sharded pool accounting: shard_count is the mesh's mp
+        # degree (1 unsharded), the head split is exact (enforced at
+        # construction), and shard_count x per-shard bytes covers the
+        # WHOLE pool — per-device residency is dense/mp. Block counts
+        # above are deliberately shard-independent: the allocator and
+        # tables are replicated host data, one logical pool.
+        fmt_heads = eng.dec.fmt.num_heads
+        assert m["kv_shard_count"] >= 1
+        assert m["kv_shard_heads"] * m["kv_shard_count"] == fmt_heads
+        pool_bytes = int(eng._caches["kv"].nbytes)
+        if "sc" in eng._caches:
+            pool_bytes += int(eng._caches["sc"].nbytes)
+        assert m["kv_shard_pool_bytes"] * m["kv_shard_count"] == \
+            pool_bytes, (
+            f"per-shard pool bytes broke: {m['kv_shard_pool_bytes']} x "
+            f"{m['kv_shard_count']} != {pool_bytes}")
     else:
         assert m["kv_blocks_total"] is None
         assert m["kv_cow_copies"] == 0
+        assert m["kv_shard_count"] is None
+        assert m["kv_shard_heads"] is None
+        assert m["kv_shard_pool_bytes"] is None
     # telemetry reconciliation (the PR 8 surface): the histograms ARE
     # the percentile source — latency observes exactly the non-expired
     # finished requests, TTFT at most that (a request always has a

@@ -1,0 +1,264 @@
+"""Mesh-sharded paged serving (ISSUE 15): tensor-parallel engine step
+over a head-sharded KV block pool.
+
+Contracts under test:
+  * EXACT sharded-vs-single-device token parity (greedy AND sampled,
+    prefix cache on/off, spec decode on/off) — the mp=2 mesh layout
+    must be invisible in the tokens;
+  * fork (COW) + export/import migration parity under paged eviction
+    churn on a deliberately tight pool — every pool executable
+    (copy/read/write block) runs against the sharded arrays;
+  * the shard_map paged kernel actually engages under the mesh (spy on
+    decode_attention_paged — the dense gather fallback alone would
+    also pass parity, silently);
+  * zero retraces after warmup on the sharded engine (block churn is
+    host data; the mesh adds no trace keys);
+  * head-count divisibility validation: explicit paged=True raises,
+    the env/auto default downgrades to dense with a warning, and
+    init_paged_cache refuses to lay out an indivisible pool;
+  * kv_shard_* gauges: count x per-shard bytes == the whole pool
+    (per-device residency is dense/mp).
+
+The conftest forces 8 host CPU devices, so mp=2 meshes build anywhere;
+fleet topology state is reset per test by the _seed_all fixture.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+
+
+def _model(seed=3):
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.nn.layer.common import Embedding, Linear
+    paddle.seed(seed)
+    embed = Embedding(V, E)
+    fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
+                                normalize_before=True)
+    head = Linear(E, V, bias_attr=False)
+    fmt.eval()
+    return fmt, embed, head
+
+
+def _mesh(mp=2):
+    from paddle_tpu.parallel import init_serving_mesh
+    return init_serving_mesh(mp)
+
+
+def _engine(**kw):
+    from paddle_tpu.inference.serving import ServingEngine
+    fmt, embed, head = _model()
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_cap", 8)
+    kw.setdefault("decode_chunk", 2)
+    return ServingEngine(fmt, embed, head, **kw)
+
+
+def _reqs(seed=11, n=5):
+    """Deterministic request mix with a shared 24-token prefix in two
+    waves (the second wave adopts what the first published)."""
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(1, V, (24,)).astype(np.int32)
+    wave1 = [(shared.copy(), 8)]
+    wave2 = []
+    for _ in range(n - 1):
+        tail = rng.randint(1, V, (rng.randint(2, 9),)).astype(np.int32)
+        wave2.append((np.concatenate([shared, tail]), 8))
+    return [wave1, wave2]
+
+
+def _drive(eng, waves):
+    toks = []
+    for wave in waves:
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in wave]
+        eng.run()
+        toks += [eng.results[r]["tokens"].tolist() for r in rids]
+    return toks
+
+
+class TestMeshPagedParity:
+    def _ab(self, **kw):
+        """tokens from a single-device engine vs an mp=2 engine with
+        the SAME weights and submission order."""
+        waves = _reqs()
+        ref = _drive(_engine(**kw), waves)
+        _mesh(2)
+        eng = _engine(**kw)
+        assert eng.paged, "mesh engine must stay paged (the tentpole)"
+        got = _drive(eng, waves)
+        return ref, got, eng
+
+    def test_greedy_prefix_parity(self):
+        ref, got, eng = self._ab(prefix_cache_blocks=16)
+        assert got == ref
+        m = eng.metrics()
+        assert m["prefix_hits"] > 0          # the cache PARTICIPATED
+
+    def test_greedy_no_prefix_parity(self):
+        ref, got, _ = self._ab()
+        assert got == ref
+
+    def test_sampled_parity(self):
+        # scheduling-invariant sampling: fold_in(seed, nt) makes the
+        # sampled stream a pure function of (request, position) — the
+        # mesh layout must not perturb it
+        ref, got, _ = self._ab(do_sample=True, top_k=8, temperature=0.7,
+                               prefix_cache_blocks=16)
+        assert got == ref
+
+    def test_spec_decode_parity(self):
+        ref, got, _ = self._ab(spec_k=2, prefix_cache_blocks=16)
+        assert got == ref
+
+    def test_zero_retraces_after_warmup(self):
+        waves = _reqs()
+        _mesh(2)
+        eng = _engine(prefix_cache_blocks=16)
+        _drive(eng, waves)                    # warmup: all shapes seen
+        warm = eng.metrics()["traces"]
+        _drive(eng, _reqs(seed=23))           # same shape ladder
+        assert eng.metrics()["traces"] == warm, \
+            "sharded paged churn must stay zero-retrace"
+
+
+class TestMeshKernelPath:
+    def test_shard_map_paged_kernel_engages(self, monkeypatch):
+        # parity alone can't tell the shard_map kernel from the dense
+        # gather fallback — count actual kernel entries (trace-time,
+        # like the dense TP spy in test_fused_decode)
+        import paddle_tpu.ops.pallas.decode_attention as da
+        calls = {"n": 0}
+        orig = da.decode_attention_paged
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+        monkeypatch.setattr(da, "decode_attention_paged", spy)
+        _mesh(2)
+        eng = _engine()
+        _drive(eng, _reqs(n=3))
+        assert calls["n"] > 0, \
+            "mp decode took the dense fallback, not the shard_map kernel"
+
+
+class TestMeshForkMigrationChurn:
+    def _churn(self, eng):
+        """fork + export/import + eviction pressure on a tight pool,
+        identical op sequence on both engines; returns all tokens."""
+        rng = np.random.RandomState(5)
+        out = []
+        p0 = rng.randint(1, V, (24,)).astype(np.int32)
+        r0 = eng.submit(p0, max_new_tokens=6)
+        eng.run()
+        out.append(eng.results[r0]["tokens"].tolist())
+        # fork a session mid-decode: COW shares blocks, the diverging
+        # write pays exactly one copy_block per touched block
+        r1 = eng.submit(p0, max_new_tokens=8)
+        eng.step()
+        eng.step()
+        rf = eng.fork_slot(r1, max_new_tokens=6)
+        eng.run()
+        out.append(eng.results[r1]["tokens"].tolist())
+        out.append(eng.results[rf]["tokens"].tolist())
+        # live migration round-trip: export a RUNNING session's blocks,
+        # import it back (read_block/write_block on the sharded pool)
+        r2 = eng.submit(rng.randint(1, V, (17,)).astype(np.int32),
+                        max_new_tokens=6)
+        eng.step()
+        eng.step()
+        state = eng.export_slot(r2)
+        r3 = eng.import_slot(state)
+        eng.run()
+        out.append(eng.results[r3]["tokens"].tolist())
+        # churn wave over the tight pool: admissions force eviction
+        # of finished slots' blocks
+        for _ in range(4):
+            rids = [eng.submit(rng.randint(1, V, (12,)).astype(np.int32),
+                               max_new_tokens=5) for _ in range(2)]
+            eng.run()
+            out += [eng.results[r]["tokens"].tolist() for r in rids]
+        return out
+
+    def test_fork_migration_parity_under_churn(self):
+        kw = dict(num_slots=2, max_seq_len=64, kv_pool_blocks=18)
+        ref = self._churn(_engine(**kw))
+        _mesh(2)
+        eng = _engine(**kw)
+        got = self._churn(eng)
+        assert got == ref
+        assert eng.metrics()["kv_cow_copies"] >= 0   # counters intact
+        # per-shard block accounting still reconciles after churn
+        m = eng.metrics()
+        assert m["kv_blocks_used"] + m["kv_blocks_free"] \
+            == m["kv_blocks_total"]
+
+
+class TestMeshValidation:
+    def test_explicit_paged_indivisible_heads_raises(self):
+        _mesh(8)                              # H=4 % 8 != 0
+        from paddle_tpu.inference.serving import ServingEngine
+        fmt, embed, head = _model()
+        with pytest.raises(ValueError, match="num_heads % mp"):
+            ServingEngine(fmt, embed, head, num_slots=2, max_seq_len=64,
+                          prefill_cap=8, paged=True)
+
+    def test_default_indivisible_heads_downgrades_with_warning(self):
+        _mesh(8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            eng = _engine(max_seq_len=64)
+        assert not eng.paged
+        assert any("not divisible" in str(x.message) for x in w)
+
+    def test_init_paged_cache_indivisible_raises(self):
+        from paddle_tpu.inference.generation import FusedDecoder
+        from paddle_tpu.inference.paged_kv import BlockPool
+        _mesh(8)
+        fmt, embed, head = _model()
+        dec = FusedDecoder(fmt, embed, head, 64)
+        with pytest.raises(ValueError, match="not divisible"):
+            dec.init_paged_cache(BlockPool(8, 8, dec.smax))
+
+    def test_init_serving_mesh_conflict_raises(self):
+        _mesh(2)
+        from paddle_tpu.parallel import init_serving_mesh
+        with pytest.raises(RuntimeError, match="already active"):
+            init_serving_mesh(4)
+
+    def test_init_serving_mesh_noop_without_request(self):
+        from paddle_tpu.parallel import init_serving_mesh
+        assert init_serving_mesh(0) is None
+        assert init_serving_mesh(1) is None
+
+
+class TestShardGauges:
+    def test_shard_math(self):
+        _mesh(2)
+        eng = _engine()
+        m = eng.metrics()
+        assert m["kv_shard_count"] == 2
+        assert m["kv_shard_heads"] == H // 2
+        total = int(eng._caches["kv"].nbytes)
+        assert m["kv_shard_pool_bytes"] * 2 == total
+        # the pool really is laid out sharded on the head axis
+        sh = eng._caches["kv"].sharding
+        assert getattr(sh, "spec", None) is not None
+
+    def test_unsharded_paged_gauges(self):
+        eng = _engine()
+        m = eng.metrics()
+        assert m["kv_shard_count"] == 1
+        assert m["kv_shard_heads"] == H
+        assert m["kv_shard_pool_bytes"] == int(eng._caches["kv"].nbytes)
+
+    def test_dense_gauges_none(self):
+        eng = _engine(paged=False)
+        m = eng.metrics()
+        assert m["kv_shard_count"] is None
+        assert m["kv_shard_heads"] is None
+        assert m["kv_shard_pool_bytes"] is None

@@ -836,6 +836,74 @@ class TestRpcReplica:
 
 
 # =====================================================================
+# supervised worker gang (python -m paddle_tpu.serving_cluster --workers)
+# =====================================================================
+@pytest.mark.slow
+def test_supervised_worker_gang_e2e(tmp_path):
+    """The CLI's --workers recipe end to end: the supervisor spawns a
+    worker process, rendezvouses it over rpc, fronts it with an
+    RpcReplica, and serves a completion through the gateway — the
+    promoted replacement for hand-rolled init_rpc glue."""
+    import re
+    import signal
+    import subprocess
+    import sys
+    import urllib.request
+
+    from paddle_tpu.core.native import load_native
+    if load_native() is None:
+        pytest.skip("native runtime unavailable")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving_cluster",
+         "--workers", "1", "--port", "0",
+         "--log-dir", str(tmp_path / "log")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + WAIT_S
+        for line in p.stdout:
+            m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+            assert time.monotonic() < deadline, "supervisor never ready"
+        assert port is not None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": [5, 9, 2, 41],
+                             "max_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.load(urllib.request.urlopen(req, timeout=WAIT_S))
+        toks = doc["choices"][0]["tokens"]
+        assert len(toks) == 8
+        # the worker engine serves the SAME weights as an in-process
+        # replica would — the tokens match the local oracle (the CLI's
+        # toy model: E,H,FF,L,V = 64,4,128,2,256, seed 0)
+        paddle.seed(0)
+        embed = Embedding(256, 64)
+        fmt = FusedMultiTransformer(64, 4, 128, num_layers=2,
+                                    normalize_before=True)
+        head = Linear(64, 256, bias_attr=False)
+        fmt.eval()
+        dec = FusedDecoder(fmt, embed, head, max_seq_len=256)
+        out = dec.generate(
+            paddle.to_tensor(np.array([[5, 9, 2, 41]], np.int32)),
+            max_new_tokens=8)
+        want = [int(t) for t in np.asarray(out._data)[0, 4:]]
+        assert toks == want
+    finally:
+        p.send_signal(signal.SIGINT)
+        try:
+            rc = p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rc = p.wait()
+    assert rc == 0
+
+
+# =====================================================================
 # structural pins
 # =====================================================================
 def test_http_surface_pinned(capsys):

@@ -160,6 +160,14 @@ def main(argv=None):
     # telemetry step timeline instead of failing tier-1
     n_kinds = _check_dispatch_kinds(failures, eng)
 
+    # ---- 8. mesh shard-gauge coverage: an mp=2 head-sharded paged
+    # engine must reconcile its kv_shard_* gauges against the actual
+    # pool layout, expose them in Prometheus, and dispatch ONLY
+    # executable families already in DISPATCH_KINDS (the mesh reuses
+    # the existing jit keys — a new family here means someone forked
+    # the dispatch without registering it)
+    _check_mesh_shard_surface(failures)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -171,8 +179,73 @@ def main(argv=None):
           f"{n_ops} flight-recorder op histograms in the "
           "runtime registry; SLO + router-audit counter names pinned; "
           f"{n_kinds} dispatched executable families covered by "
-          "generation.DISPATCH_KINDS)")
+          "generation.DISPATCH_KINDS; mp=2 shard gauges reconcile)")
     return 0
+
+
+def _check_mesh_shard_surface(failures):
+    """Mesh engine probe: drive a real mp=2 head-sharded paged engine
+    and reconcile the kv_shard_* gauges against the pool it actually
+    allocated. Runs in-process as a tier-1 test, so fleet topology
+    state is saved and restored around the probe."""
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet import _fleet_state
+    from paddle_tpu.distributed.fleet.base.topology import _HYBRID_GROUP
+    from paddle_tpu.inference import generation
+    from paddle_tpu.inference.telemetry import PROMETHEUS_NAMES
+    from paddle_tpu.parallel import init_serving_mesh
+
+    prior_hcg = _HYBRID_GROUP[0]
+    prior_fleet = dict(_fleet_state)
+    try:
+        _HYBRID_GROUP[0] = None
+        _fleet_state.update(strategy=None, hcg=None, initialized=False)
+        init_serving_mesh(2)
+        eng, rng, V = _build_engine()
+        for n in (5, 9):
+            eng.submit(rng.randint(1, V, (n,)).astype(np.int32),
+                       max_new_tokens=3)
+        eng.run()
+        m = eng.metrics()
+        if m.get("kv_shard_count") != 2:
+            failures.append(
+                f"mp=2 mesh engine reports kv_shard_count="
+                f"{m.get('kv_shard_count')!r}, expected 2")
+            return
+        heads = eng.dec.fmt.num_heads
+        if m["kv_shard_heads"] * m["kv_shard_count"] != heads:
+            failures.append(
+                f"mesh shard gauges do not reconcile: kv_shard_heads="
+                f"{m['kv_shard_heads']} x kv_shard_count="
+                f"{m['kv_shard_count']} != num_heads={heads}")
+        pool_bytes = int(eng._caches["kv"].nbytes)
+        if "sc" in eng._caches:
+            pool_bytes += int(eng._caches["sc"].nbytes)
+        if m["kv_shard_pool_bytes"] * m["kv_shard_count"] != pool_bytes:
+            failures.append(
+                f"mesh shard gauges do not reconcile: "
+                f"kv_shard_pool_bytes={m['kv_shard_pool_bytes']} x "
+                f"{m['kv_shard_count']} != pool bytes {pool_bytes} — "
+                "per-device residency must be the dense pool / mp")
+        text = eng.metrics_prometheus()
+        for k in ("kv_shard_count", "kv_shard_heads",
+                  "kv_shard_pool_bytes"):
+            name, _typ = PROMETHEUS_NAMES[k]
+            if name not in text:
+                failures.append(
+                    f"mesh engine exposition lost {name!r} (metrics key "
+                    f"{k!r} has a value under the mesh)")
+        for fam in sorted(set(k[0] for k in eng._jit_cache), key=str):
+            if fam not in generation.DISPATCH_KINDS:
+                failures.append(
+                    f"mesh engine dispatched executable family {fam!r} "
+                    "with no generation.DISPATCH_KINDS entry — the "
+                    "sharded step must reuse registered jit keys")
+    finally:
+        _HYBRID_GROUP[0] = prior_hcg
+        _fleet_state.clear()
+        _fleet_state.update(prior_fleet)
 
 
 def _check_dispatch_kinds(failures, budget_eng):
