@@ -115,11 +115,27 @@ request, zero retraces after warmup on the sharded engine, and the
 per-device pool residency reconciles (kv_shard_pool_bytes x mp ==
 the mp=1 engine's whole pool). Its knob: BENCH_MESH_MP (default 2).
 
+--qos runs the OVERLOAD QoS chaos drill: one paged engine at 2x its
+measured capacity, mixed-class (high/normal/low) fixed-seed Poisson
+traffic. Under that pressure the scheduler must degrade GRACEFULLY:
+strictly better arrivals preempt running low-class slots to host RAM
+and the parked sessions resume when pressure clears. Exits non-zero
+unless: exact greedy token parity for EVERY request vs an unloaded
+oracle run of the same workload (preemption/park/resume and the
+weighted-fair packer never corrupt a stream), at least one preemption
+actually fired with ZERO aborted/expired/dropped admitted requests,
+the high class stayed inside its SLO (p99 TTFT within
+BENCH_QOS_SLO_X, default 4x, of the unloaded p99) while the low class
+measurably degraded past it, and zero retraces after warmup (every
+QoS decision is pure host data). Knobs: BENCH_QOS_LOAD (default 2.0),
+BENCH_QOS_SLO_X.
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
 "chunked_prefill", the cluster record under "cluster", the mesh
-record under "mesh_serving"; each mode preserves the others' records).
+record under "mesh_serving", the QoS overload record under "qos";
+each mode preserves the others' records).
 """
 from __future__ import annotations
 
@@ -218,7 +234,7 @@ def _collect(eng, sub, arrivals):
 
 
 _SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
-                "chunked_prefill", "cluster", "mesh_serving")
+                "chunked_prefill", "cluster", "mesh_serving", "qos")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -352,6 +368,8 @@ def main(argv=None):
         return main_cluster()
     if "--mesh" in argv:
         return main_mesh()
+    if "--qos" in argv:
+        return main_qos()
     from bench import _init_devices
     jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
@@ -2224,6 +2242,238 @@ def main_cluster():
               f"drill: {sd['retraces_after_warmup']} — migration and "
               "spawned replicas must reuse warm executables",
               file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def main_qos():
+    """The OVERLOAD QoS chaos drill: one paged engine, mixed-class
+    (high/normal/low) fixed-seed Poisson traffic at BENCH_QOS_LOAD
+    (default 2x) the engine's measured capacity. Graceful degradation
+    is the product under test: strictly better arrivals preempt
+    running low-class slots into the host parking lot, parked sessions
+    resume when pressure clears, the weighted-fair packer splits
+    prefill budget by class share — and NONE of it may cost a token,
+    a request, or a retrace. Gates (exit 1): exact greedy parity for
+    every request vs an unloaded oracle run, >= 1 preemption fired
+    with zero aborted/expired requests and every admitted request
+    finished, the high class p99 TTFT within BENCH_QOS_SLO_X
+    (default 4x) of the unloaded p99 while the low class degraded
+    past that line, zero retraces after warmup. Lands under "qos" in
+    BENCH_serving.json (other modes' records preserved)."""
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import AdmissionFull, ServingEngine
+
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "128"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    cap_ = int(os.environ.get("BENCH_PAGED_CAP", "16"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_QOS_LOAD", "2.0"))
+    slo_x = float(os.environ.get("BENCH_QOS_SLO_X", "4.0"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
+
+    rng = np.random.RandomState(seed)
+    classes = ("high", "normal", "low")
+
+    def make(n):
+        reqs = []
+        for _ in range(n):
+            plen = int(rng.randint(6, 25))
+            max_new = int(rng.choice([16, 24, 32]))
+            prio = str(rng.choice(classes, p=[.25, .45, .30]))
+            reqs.append((rng.randint(1, V, (plen,)).astype("int32"),
+                         max_new, prio))
+        return reqs
+
+    bucket_reqs = [(rng.randint(1, V, (p,)).astype("int32"), 4)
+                   for p in (8, 16, 24)]
+    warm_reqs = make(2 * slots)
+    meas_reqs = make(n_meas)
+
+    def new_engine(clock):
+        return ServingEngine(fmt, embed, head, num_slots=slots,
+                             max_seq_len=smax, decode_chunk=chunk,
+                             prefill_cap=cap_, paged=True,
+                             clock=clock.now)
+
+    # ---- unloaded oracle: every request SOLO on a fresh engine — the
+    # greedy want-tokens for the parity gate and the unloaded TTFT
+    # distribution the SLO line is drawn from
+    oclock = VirtualClock()
+    oracle = new_engine(oclock)
+    for prompt, max_new in bucket_reqs:
+        oracle.submit(prompt, max_new_tokens=max_new)
+        oracle.run()
+    want, ttft_unloaded = [], []
+    for prompt, max_new, prio in meas_reqs:
+        rid = oracle.submit(prompt, max_new_tokens=max_new,
+                            priority=prio)
+        oracle.run()
+        want.append(oracle.results[rid]["tokens"].tolist())
+        ttft_unloaded.append(oracle.results[rid]["ttft_s"])
+    ttft_un_p99 = float(np.percentile(ttft_unloaded, 99))
+    slo_s = slo_x * ttft_un_p99
+
+    # ---- measured engine: compile warmup (buckets solo), capacity
+    # estimate, then ONE forced preempt/resume cycle so the KV
+    # export/import helpers are warm before the retrace gate arms
+    clock = VirtualClock()
+    eng = new_engine(clock)
+    for prompt, max_new in bucket_reqs:
+        eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+    for prompt, max_new, _prio in warm_reqs:
+        try:
+            eng.submit(prompt, max_new_tokens=max_new)
+        except AdmissionFull:
+            eng.run()
+            eng.submit(prompt, max_new_tokens=max_new)
+    eng.run()
+    eng.reset_metrics(keep_results=False)
+    t0 = clock.now()
+    for prompt, max_new, _prio in warm_reqs[:slots]:
+        eng.submit(prompt, max_new_tokens=max_new)
+    eng.run()
+    cap_tps = eng.metrics()["tokens_emitted"] / max(clock.now() - t0,
+                                                    1e-9)
+    lows = [eng.submit(rng.randint(1, V, (12,)).astype("int32"),
+                       max_new_tokens=24, priority="low")
+            for _ in range(slots)]
+    while not all(eng._req_index[r].tokens for r in lows):
+        eng.step()
+    eng.submit(rng.randint(1, V, (12,)).astype("int32"),
+               max_new_tokens=8, priority="high")
+    eng.run()
+    if not eng.metrics()["requests_preempted"]:
+        print("bench_serving: qos warmup never preempted — the "
+              "park/resume path is cold", file=sys.stderr)
+    traces_warm = eng.metrics()["traces"]
+    eng.reset_metrics(keep_results=False)
+
+    # ---- measured phase: mixed-class Poisson at `load` x capacity
+    mean_new = float(np.mean([m for _, m, _ in meas_reqs]))
+    rate = load * cap_tps / mean_new
+    arr_rng = np.random.RandomState(seed + 1)
+    arrivals = np.cumsum(
+        arr_rng.exponential(1.0 / rate, size=n_meas)) + clock.now()
+
+    sub = {}
+    i = 0
+    t_start = clock.now()
+    while i < n_meas or eng.has_work:
+        now = clock.now()
+        while i < n_meas and arrivals[i] <= now:
+            prompt, max_new, prio = meas_reqs[i]
+            try:
+                rid = eng.submit(prompt, max_new_tokens=max_new,
+                                 priority=prio)
+            except AdmissionFull:
+                break                    # honest backpressure: retry
+            sub[rid] = (i, clock.now())
+            i += 1
+        if not eng.has_work:
+            clock.skip_to(arrivals[i])
+            continue
+        eng.step()
+    elapsed = clock.now() - t_start
+    m = eng.metrics()
+
+    # per-class TTFT from ARRIVAL (queueing + park time included) and
+    # the parity sweep against the unloaded oracle
+    ttft_by = {c: [] for c in classes}
+    parity_bad = drops = 0
+    for rid, (j, t_sub) in sub.items():
+        r = eng.results.get(rid)
+        if r is None or r["expired"]:
+            drops += 1
+            continue
+        if r["tokens"].tolist() != want[j]:
+            parity_bad += 1
+        wait = t_sub - arrivals[j]
+        ttft_by[meas_reqs[j][2]].append(wait + r["ttft_s"])
+    p99 = {c: (round(1e3 * float(np.percentile(v, 99)), 1) if v
+               else None) for c, v in ttft_by.items()}
+    high_p99_s = (p99["high"] or 0.0) / 1e3
+    low_p99_s = (p99["low"] or 0.0) / 1e3
+
+    record = {
+        "metric": "serving_qos_high_ttft_p99_over_unloaded_x",
+        "value": round(high_p99_s / max(ttft_un_p99, 1e-9), 2),
+        "unit": "x unloaded p99 TTFT (gate: <= slo_x under overload)",
+        "offered_load": load, "slo_x": slo_x,
+        "slo_ms": round(1e3 * slo_s, 1),
+        "ttft_unloaded_p99_ms": round(1e3 * ttft_un_p99, 1),
+        "ttft_p99_ms_by_class": p99,
+        "requests": n_meas,
+        "requests_by_class": {c: sum(1 for _, _m, p in meas_reqs
+                                     if p == c) for c in classes},
+        "tokens_by_class": {c: m[f"tokens_emitted_{c}"]
+                            for c in classes},
+        "preemptions": m["requests_preempted"],
+        "resumes": m["requests_resumed"],
+        "expired": m["requests_expired"],
+        "dropped_admitted": drops,
+        "parity_bad": parity_bad,
+        "retraces_after_warmup": m["traces"] - traces_warm,
+        "capacity_tokens_per_sec": round(cap_tps, 2),
+        "elapsed_s": round(elapsed, 3),
+        "num_slots": slots, "max_seq": smax, "block_tokens": cap_,
+        "layers": L, "hidden": E, "vocab": V, "seed": seed,
+        "device": str(dev),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "qos", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    rc = 0
+    if parity_bad:
+        print(f"bench_serving: QOS PARITY BROKE — {parity_bad} "
+              "request(s) diverged from the unloaded oracle (a "
+              "preempt/resume or packing decision corrupted a stream)",
+              file=sys.stderr)
+        rc = 1
+    if drops or m["requests_expired"] or len(sub) != n_meas \
+            or m["requests_finished"] != n_meas:
+        print(f"bench_serving: ADMITTED WORK WAS DROPPED — "
+              f"submitted {len(sub)}/{n_meas}, finished "
+              f"{m['requests_finished']}, expired "
+              f"{m['requests_expired']}, lost {drops}; overload must "
+              "delay the low class, never abort it", file=sys.stderr)
+        rc = 1
+    if not m["requests_preempted"] or \
+            m["requests_resumed"] != m["requests_preempted"]:
+        print(f"bench_serving: preemption never exercised or never "
+              f"recovered (preempted={m['requests_preempted']} "
+              f"resumed={m['requests_resumed']}) — the drill needs "
+              "real slot pressure", file=sys.stderr)
+        rc = 1
+    if high_p99_s > slo_s:
+        print(f"bench_serving: HIGH-CLASS SLO RED under overload — "
+              f"p99 TTFT {p99['high']}ms > {round(1e3 * slo_s, 1)}ms "
+              f"({slo_x}x unloaded p99)", file=sys.stderr)
+        rc = 1
+    if low_p99_s <= slo_s:
+        print(f"bench_serving: the low class did NOT degrade "
+              f"(p99 {p99['low']}ms <= the {round(1e3 * slo_s, 1)}ms "
+              "SLO line) — the drill is not actually overloaded; "
+              "raise BENCH_QOS_LOAD", file=sys.stderr)
+        rc = 1
+    if record["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP during the QoS "
+              "drill — class churn and park/resume must be pure host "
+              "data", file=sys.stderr)
         rc = 1
     return rc
 

@@ -122,10 +122,12 @@ from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 from .generation import (FusedDecoder, _absmax_int8, _host_seed,
                          _sample_rows, dispatch_kind)
-from .telemetry import (COUNTER_FOLD_KEYS, DEFAULT_RING, SloPolicy,
-                        Telemetry)
+from .telemetry import (COUNTER_FOLD_KEYS, DEFAULT_QOS_SHARES,
+                        DEFAULT_RING, QOS_CLASSES, QOS_DEFAULT, QOS_RANK,
+                        SloPolicy, Telemetry)
 
-__all__ = ["ServingEngine", "ServedRequest", "AdmissionFull"]
+__all__ = ["ServingEngine", "ServedRequest", "AdmissionFull",
+           "QOS_CLASSES"]
 
 
 class AdmissionFull(RuntimeError):
@@ -143,11 +145,12 @@ class ServedRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "min_length", "repetition_penalty", "state", "slot",
                  "tokens", "t_submit", "t_admit", "t_first", "t_done",
-                 "deadline_s", "seed", "trace_id", "attempt")
+                 "deadline_s", "seed", "trace_id", "attempt", "priority")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  min_length, repetition_penalty, t_submit,
-                 deadline_s=None, seed=0, trace_id=None, attempt=1):
+                 deadline_s=None, seed=0, trace_id=None, attempt=1,
+                 priority=QOS_DEFAULT):
         self.rid = rid
         self.prompt = prompt                      # np.int32 [S]
         self.max_new_tokens = int(max_new_tokens)
@@ -172,6 +175,10 @@ class ServedRequest:
         # carries both, so cross-replica spans join on the trace id)
         self.trace_id = None if trace_id is None else str(trace_id)
         self.attempt = int(attempt)
+        # QoS class (telemetry.QOS_CLASSES, best first): drives the
+        # admission order, the weighted-fair budget shares, and
+        # preemption-victim selection — all pure host data
+        self.priority = priority
 
     @property
     def ttft_s(self):
@@ -569,7 +576,22 @@ class ServingEngine:
         self._migrated_in = 0
         self._migrated_out = 0
 
-        self._queue = deque()
+        # QoS: one FIFO per class, admitted best-class-first (all-default
+        # workloads collapse to the old single FIFO, token-identically);
+        # the parking lot holds preempted slot state dicts (host RAM —
+        # export_slot already serializes everything, kv blocks included)
+        self._queues = {c: deque() for c in QOS_CLASSES}
+        self._parked = {}                 # rid -> export_slot state dict
+        self._preempted = 0
+        self._resumed = 0
+        self._class_admitted = {c: 0 for c in QOS_CLASSES}
+        self._class_tokens = {c: 0 for c in QOS_CLASSES}
+        self._slo_vq_class = {c: 0 for c in QOS_CLASSES}
+        # weighted-fair prefill shares (host data only — the packer
+        # changes WHICH rows fill the same fixed-shape budget, never the
+        # shapes, so zero retraces by construction)
+        self.qos_shares = self._parse_qos_shares(
+            os.environ.get("PADDLE_QOS_SHARES", ""))
         self.results = {}
         # streaming-harvest bookkeeping: every queued/running request is
         # reachable by rid (bounded by queue + slots); a FINISHED request
@@ -603,7 +625,7 @@ class ServingEngine:
     # ------------------------------------------------------------- public
     def submit(self, prompt, max_new_tokens=20, eos_token_id=None,
                min_length=0, repetition_penalty=1.0, deadline_s=None,
-               trace_id=None, attempt=1):
+               trace_id=None, attempt=1, priority=QOS_DEFAULT):
         """Queue one request; returns its id. The slot-eviction invariant
         is enforced HERE: a request may never be able to push its slot's
         cache_lens to Smax (the write kernels' documented invariant).
@@ -635,14 +657,17 @@ class ServingEngine:
                 "static trace structure)")
         if attempt < 1:
             raise ValueError(f"attempt must be >= 1, got {attempt}")
-        if self.max_pending and len(self._queue) >= self.max_pending:
+        if priority not in QOS_CLASSES:
+            raise ValueError(
+                f"priority must be one of {QOS_CLASSES}, got {priority!r}")
+        if self.max_pending and self._queue_len() >= self.max_pending:
             self._rejected += 1
             if self.telemetry.enabled:
                 self.telemetry.req_rejected(self.clock(),
                                             trace_id=trace_id,
                                             attempt=attempt)
             raise AdmissionFull(
-                f"pending queue full ({len(self._queue)}/"
+                f"pending queue full ({self._queue_len()}/"
                 f"{self.max_pending}) — request shed at admission")
         if self.paged:
             need = self._blocks_needed(ids.size, max_new_tokens)
@@ -673,8 +698,8 @@ class ServingEngine:
                             eos_token_id, min_length, repetition_penalty,
                             self.clock(), deadline_s=deadline_s,
                             seed=self._fresh_seed(), trace_id=trace_id,
-                            attempt=attempt)
-        self._queue.append(req)
+                            attempt=attempt, priority=priority)
+        self._queues[priority].append(req)
         self._req_index[req.rid] = req
         self.telemetry.req_queued(req.rid, req.t_submit,
                                   trace_id=req.trace_id,
@@ -687,14 +712,64 @@ class ServingEngine:
         unrelated consumers of the global key)."""
         return _host_seed(next_key()) if self.do_sample else 0
 
+    # ------------------------------------------------- per-class queues
+    # The admission order is strict priority across classes (best class
+    # first), FIFO within a class — these four helpers are the ONLY code
+    # that touches the per-class deques, so the old single-FIFO call
+    # sites read unchanged.
+    @staticmethod
+    def _parse_qos_shares(spec):
+        """Parse ``high=4,normal=2,low=1`` into a share dict; unknown
+        classes reject loudly, missing ones keep the default weight."""
+        shares = dict(DEFAULT_QOS_SHARES)
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            cls, _, w = part.partition("=")
+            if cls not in QOS_CLASSES:
+                raise ValueError(
+                    f"PADDLE_QOS_SHARES: unknown class {cls!r} "
+                    f"(classes: {QOS_CLASSES})")
+            w = int(w)
+            if w < 1:
+                raise ValueError(
+                    f"PADDLE_QOS_SHARES: share for {cls!r} must be "
+                    f">= 1, got {w}")
+            shares[cls] = w
+        return shares
+
+    def _queue_len(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def _queue_head(self):
+        for c in QOS_CLASSES:
+            if self._queues[c]:
+                return self._queues[c][0]
+        return None
+
+    def _queue_popleft(self):
+        for c in QOS_CLASSES:
+            if self._queues[c]:
+                return self._queues[c].popleft()
+        raise IndexError("pop from empty queue")
+
+    def _queue_remove(self, req):
+        self._queues[req.priority].remove(req)
+
+    def queue_depths(self):
+        """Per-class pending depths (host dict; snapshot v4 surface)."""
+        return {c: len(self._queues[c]) for c in QOS_CLASSES}
+
     @property
     def has_work(self):
-        return (bool(self._queue) or bool(self._active.any())
-                or bool((self._pf_left > 0).any()))
+        return (bool(self._queue_len()) or bool(self._active.any())
+                or bool((self._pf_left > 0).any())
+                or bool(self._parked))
 
     @property
     def queue_depth(self):
-        return len(self._queue)
+        return self._queue_len()
 
     @property
     def occupancy(self):
@@ -715,6 +790,10 @@ class ServingEngine:
         t0 = self.clock()
         had_work = self.has_work
         self._expire_deadlines(t0)
+        # QoS pass BEFORE admission: resume parked requests when pressure
+        # cleared, preempt the lowest-class running slot when a better-
+        # class head is blocked — so this step's admission sees the slot
+        self._qos_schedule()
         if self.token_budget:
             self._admit_chunked()
             emitted = self._budget_step()
@@ -847,6 +926,14 @@ class ServingEngine:
             "requests_expired": self._expired,
             "requests_migrated_in": self._migrated_in,
             "requests_migrated_out": self._migrated_out,
+            "requests_preempted": self._preempted,
+            "requests_resumed": self._resumed,
+            "requests_admitted_high": self._class_admitted["high"],
+            "requests_admitted_normal": self._class_admitted["normal"],
+            "requests_admitted_low": self._class_admitted["low"],
+            "tokens_emitted_high": self._class_tokens["high"],
+            "tokens_emitted_normal": self._class_tokens["normal"],
+            "tokens_emitted_low": self._class_tokens["low"],
             "prefix_hits": self._prefix_hits,
             "prefix_misses": self._prefix_misses,
             "prefill_tokens_saved": self._prefill_tokens_saved,
@@ -893,6 +980,11 @@ class ServingEngine:
         self._expired = 0
         self._migrated_in = 0
         self._migrated_out = 0
+        self._preempted = 0
+        self._resumed = 0
+        self._class_admitted = {c: 0 for c in QOS_CLASSES}
+        self._class_tokens = {c: 0 for c in QOS_CLASSES}
+        self._slo_vq_class = {c: 0 for c in QOS_CLASSES}
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefill_tokens_saved = 0
@@ -945,6 +1037,19 @@ class ServingEngine:
             # migrated_out left mid-flight with their state
             "requests_migrated_in": self._migrated_in,
             "requests_migrated_out": self._migrated_out,
+            # QoS window counters: preempted running slots parked to
+            # host RAM, resumed re-imported; parked is a live gauge.
+            # Per-class admissions/tokens sum to the totals (all-default
+            # traffic lands entirely in "normal") — conftest pins it.
+            "requests_preempted": self._preempted,
+            "requests_resumed": self._resumed,
+            "requests_parked": len(self._parked),
+            "requests_admitted_high": self._class_admitted["high"],
+            "requests_admitted_normal": self._class_admitted["normal"],
+            "requests_admitted_low": self._class_admitted["low"],
+            "tokens_emitted_high": self._class_tokens["high"],
+            "tokens_emitted_normal": self._class_tokens["normal"],
+            "tokens_emitted_low": self._class_tokens["low"],
             "queue_depth": self.queue_depth,
             "occupancy": self.occupancy,
             "traces": self._traces_total(),
@@ -1284,7 +1389,8 @@ class ServingEngine:
                               src.eos_token_id, src.min_length,
                               src.repetition_penalty, self.clock(),
                               seed=self._fresh_seed(),
-                              trace_id=src.trace_id, attempt=src.attempt)
+                              trace_id=src.trace_id, attempt=src.attempt,
+                              priority=src.priority)
         child.state = "running"
         child.slot = s1
         child.t_admit = child.t_submit    # a clone never queues
@@ -1378,6 +1484,7 @@ class ServingEngine:
             "seed": req.seed,
             "trace_id": req.trace_id,
             "attempt": req.attempt,
+            "priority": req.priority,
             "prefill_cap": self.prefill_cap,
             "lens": 0, "nt": 0, "tok": 0, "active": False,
             "pf_left": int(req.prompt.size),
@@ -1385,7 +1492,7 @@ class ServingEngine:
         }
         need = self._blocks_needed(req.prompt.size, req.max_new_tokens)
         if req.state == "queued":
-            self._queue.remove(req)
+            self._queue_remove(req)
             self._kv_committed -= need
         else:
             s = req.slot
@@ -1483,17 +1590,18 @@ class ServingEngine:
                             deadline_s=state["deadline_s"],
                             seed=int(state["seed"]),
                             trace_id=state["trace_id"],
-                            attempt=int(state["attempt"]))
+                            attempt=int(state["attempt"]),
+                            priority=state.get("priority", QOS_DEFAULT))
         if not blocks and not tokens and int(state["nt"]) == 0:
             # never prefilled: the import is a plain (re-)queue — it
             # will be ADMITTED normally later (prefix lookup included)
-            if self.max_pending and len(self._queue) >= self.max_pending:
+            if self.max_pending and self._queue_len() >= self.max_pending:
                 self._rejected += 1
                 if self.telemetry.enabled:
                     self.telemetry.req_rejected(
                         now, trace_id=req.trace_id, attempt=req.attempt)
                 raise AdmissionFull(
-                    f"pending queue full ({len(self._queue)}/"
+                    f"pending queue full ({self._queue_len()}/"
                     f"{self.max_pending}) — migrated request shed")
             if self._kv_gate and \
                     self._kv_committed + need > self.pool.num_blocks:
@@ -1504,7 +1612,7 @@ class ServingEngine:
                 raise AdmissionFull("kv pool exhausted — migrated "
                                     "request shed at import")
             self._kv_committed += need
-            self._queue.append(req)
+            self._queues[req.priority].append(req)
             self._req_index[req.rid] = req
             self._migrated_in += 1
             self.telemetry.req_queued(req.rid, now,
@@ -1582,6 +1690,222 @@ class ServingEngine:
             # exported at the exact finish boundary: complete instantly
             self._finish(req, now)
         return req.rid
+
+    # ----------------------------------------------------- QoS preemption
+    # Preemption-to-host reuses the migration serialization (the state
+    # dict IS a MIGRATION_FMT payload) but keeps the request FIRST-CLASS
+    # on this engine: same rid, same _req_index entry (state
+    # "preempted"), same tokens list and streaming-harvest cursor — so a
+    # tracked reader sees one continuous exactly-once stream across the
+    # park/resume legs with zero router involvement. _kv_committed stays
+    # held while parked (the request still intends to run here; releasing
+    # it would let submit() overcommit the pool against a request that
+    # WILL come back); only the running-worst-case reservation
+    # (_kv_reserved) and the physical blocks are released.
+    def preempt_to_host(self, rid):
+        """Preempt a RUNNING request into the host-RAM parking lot:
+        serialize its full decode state (KV bytes included), free the
+        slot + physical blocks, and keep the request indexed as
+        ``preempted``. resume_from_host() restores it token-identically
+        (greedy AND plain-sampled — the seed rides the state and every
+        draw is fold_in(seed, nt)). Paged engines only."""
+        if not self.paged:
+            raise ValueError("preempt_to_host needs the paged KV cache "
+                             "(the parked payload is pool blocks; "
+                             "PADDLE_SERVING_PAGED=0 disables it)")
+        req = self._req_index.get(rid)
+        if req is None or req.state != "running":
+            raise ValueError(f"request {rid} is not running in a slot")
+        now = self.clock()
+        s = req.slot
+        state = {
+            "fmt": self.MIGRATION_FMT,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": req.max_new_tokens,
+            "eos_token_id": req.eos_token_id,
+            "min_length": req.min_length,
+            "repetition_penalty": req.repetition_penalty,
+            "deadline_s": req.deadline_s,
+            "seed": req.seed,
+            "trace_id": req.trace_id,
+            "attempt": req.attempt,
+            "priority": req.priority,
+            "prefill_cap": self.prefill_cap,
+            "lens": int(self._lens[s]), "nt": int(self._nt[s]),
+            "tok": int(self._tok[s]), "active": bool(self._active[s]),
+            "pf_left": int(self._pf_left[s]),
+            "kv": [],
+        }
+        row = self._tables[s]
+        for j in range(-(-state["lens"] // self.prefill_cap)):
+            state["kv"].append(
+                self.pool.read_block(self._caches, int(row[j])))
+        need = self._blocks_needed(req.prompt.size, req.max_new_tokens)
+        self._kv_reserved -= need
+        self._slot_req[s] = None
+        self._active[s] = False
+        self._pf_left[s] = 0
+        self._free_slot_blocks(s)
+        req.slot = None
+        req.state = "preempted"
+        # the injected-fault window: slot freed, parking insert pending.
+        # A raise here loses the parked copy — the replica dies and the
+        # router's CLASSIC failover (delivered-prefix skip) replays the
+        # stream exactly-once elsewhere; pinned by test.
+        from ..testing import fault
+        fault.inject("preempt")
+        self._parked[rid] = state
+        self._preempted += 1
+        if self.telemetry.enabled:
+            self.telemetry.req_event(rid, "preempt", now)
+        return rid
+
+    def resume_from_host(self, rid):
+        """Re-import a parked request into a free slot (fresh physical
+        blocks, KV bytes re-uploaded, drafter/presence rebuilt from the
+        token history). Sheds with ``AdmissionFull`` when no slot or no
+        reservation headroom can take it — the parked copy stays put and
+        a later pass retries. t_submit/t_admit/deadline are UNTOUCHED:
+        the deadline clock keeps running while parked (park time is
+        queue-attributed delay, never a budget refill)."""
+        state = self._parked.get(rid)
+        req = self._req_index.get(rid)
+        if state is None or req is None or req.state != "preempted":
+            raise ValueError(f"request {rid} is not parked here")
+        free = self._free_slots()
+        if not free:
+            raise AdmissionFull("no free slot to resume the parked "
+                                "request into")
+        need = self._blocks_needed(req.prompt.size, req.max_new_tokens)
+        if self._kv_reserved + need > self.pool.num_blocks:
+            raise AdmissionFull(
+                f"kv pool exhausted: resume needs {need} blocks, "
+                f"{self.pool.num_blocks - self._kv_reserved} unreserved")
+        now = self.clock()
+        s = free[0]
+        del self._parked[rid]
+        blocks = state["kv"]
+        self._kv_reserved += need          # committed never left
+        ids = self._alloc_kv_blocks(len(blocks)) if blocks else []
+        for blk, dst in zip(blocks, ids):
+            self._caches = self.pool.write_block(self._caches, blk, dst)
+        row = self._tables[s]
+        row[:] = self.pool.num_blocks
+        row[:len(ids)] = ids
+        self._lens[s] = int(state["lens"])
+        self._nt[s] = int(state["nt"])
+        self._tok[s] = int(state["tok"])
+        self._max_nt[s] = req.max_new_tokens
+        self._eos[s] = (-1 if req.eos_token_id is None
+                        else int(req.eos_token_id))
+        self._min_len[s] = req.min_length
+        self._rep_pen[s] = req.repetition_penalty
+        self._rseed[s] = req.seed
+        self._active[s] = bool(state["active"])
+        self._pf_left[s] = int(state["pf_left"])
+        if self._drafters is not None:
+            self._drafters[s].reset(req.prompt)
+            self._drafters[s].update(req.tokens)
+        if self._rep_on:
+            vocab = self._presence_init().shape[1]
+            rowv = np.zeros(vocab, bool)
+            rowv[req.prompt] = True
+            if req.tokens:
+                rowv[np.asarray(req.tokens, np.int64)] = True
+            self._presence = self._presence_init().at[s].set(
+                jnp.asarray(rowv))
+        req.slot = s
+        req.state = "running"
+        self._slot_req[s] = req
+        self._resumed += 1
+        if self.telemetry.enabled:
+            self.telemetry.req_event(rid, "resume", now)
+        if not self._active[s] and not self._pf_left[s] and req.tokens:
+            self._finish(req, now)
+        return rid
+
+    def _qos_schedule(self):
+        """One scheduling pass per step (paged engines only): resume
+        parked requests best-class-first while there is headroom, then —
+        when a strictly better-class queue head is blocked on slots or
+        on the kv reservation — preempt the single worst (lowest-class,
+        youngest) running victim to the parking lot. At most one
+        preemption per step keeps the pass O(slots) and lets the freed
+        capacity be re-measured before the next eviction."""
+        if not self.paged:
+            return
+        # resume pass: parked requests compete in class order; stop at
+        # the first one that doesn't fit (FIFO-within-class fairness),
+        # and never jump ahead of a strictly better queued head
+        for rid in sorted(self._parked,
+                          key=lambda r: (QOS_RANK[
+                              self._parked[r]["priority"]], r)):
+            head = self._queue_head()
+            if head is not None and QOS_RANK[head.priority] < \
+                    QOS_RANK[self._parked[rid]["priority"]]:
+                break
+            try:
+                self.resume_from_host(rid)
+            except AdmissionFull:
+                break
+        head = self._queue_head()
+        if head is None:
+            return
+        need = self._blocks_needed(head.prompt.size,
+                                   head.max_new_tokens)
+        blocked = (not self._free_slots()
+                   or self._kv_reserved + need > self.pool.num_blocks)
+        if not blocked:
+            return
+        victims = [r for r in self._slot_req
+                   if r is not None and r.state == "running"
+                   and QOS_RANK[r.priority] > QOS_RANK[head.priority]]
+        if not victims:
+            return
+        victim = max(victims,
+                     key=lambda r: (QOS_RANK[r.priority], r.rid))
+        self.preempt_to_host(victim.rid)
+
+    def _prefill_allocations(self, pf_rows, budget, col_cap=None):
+        """Weighted-fair split of this dispatch's prefill budget across
+        QoS classes — pure host arithmetic over which rows advance their
+        prefill cursors, so the dispatch shapes (and therefore the
+        executables) never change. Two passes: (1) proportional — each
+        class with waiting prefill work gets floor(budget * share /
+        total_shares) tokens, spent FCFS-by-rid within the class; (2)
+        work-conserving spill — leftover budget (idle classes, floors,
+        capped rows) goes to remaining demand in (class-rank, rid)
+        order. With a SINGLE class present pass 1 is skipped and the
+        result is exactly the old FCFS packing — token-identical to the
+        pre-QoS scheduler. Returns ([(slot, n), ...] ordered by
+        (class-rank, rid), remaining_budget)."""
+        order = sorted(pf_rows,
+                       key=lambda s: (QOS_RANK[self._slot_req[s].priority],
+                                      self._slot_req[s].rid))
+        cap = budget if col_cap is None else col_cap
+        want = {s: min(int(self._pf_left[s]), cap) for s in order}
+        alloc = {s: 0 for s in order}
+        classes = {self._slot_req[s].priority for s in order}
+        if len(classes) > 1:
+            total = sum(self.qos_shares[c] for c in classes)
+            for c in classes:
+                fair = budget * self.qos_shares[c] // total
+                for s in order:
+                    if self._slot_req[s].priority != c:
+                        continue
+                    n = min(want[s] - alloc[s], fair)
+                    alloc[s] += n
+                    fair -= n
+        spent = sum(alloc.values())
+        left = budget - spent
+        for s in order:
+            if left <= 0:
+                break
+            n = min(want[s] - alloc[s], left)
+            alloc[s] += n
+            left -= n
+        return [(s, alloc[s]) for s in order if alloc[s] > 0], left
 
     def _build_decode_chunk(self):
         """The ONE compiled decode step: decode_chunk tokens per dispatch
@@ -1785,20 +2109,20 @@ class ServingEngine:
         list of admitted requests (each just emitted its first token)."""
         free = self._free_slots()
         batch = []
-        while free and self._queue:
+        while free and self._queue_len():
             if self.paged:
                 # pool-bounded admission: a request enters a slot only
                 # with its WORST-CASE block reservation covered (sum of
                 # running reservations <= NBtotal keeps every lazy
                 # allocation satisfiable — shared blocks only add
                 # slack). Otherwise it waits; eviction frees blocks.
-                head = self._queue[0]
+                head = self._queue_head()
                 need = self._blocks_needed(head.prompt.size,
                                            head.max_new_tokens)
                 if self._kv_reserved + need > self.pool.num_blocks:
                     break
                 self._kv_reserved += need
-            req = self._queue.popleft()
+            req = self._queue_popleft()
             slot = free.pop(0)
             req.slot = slot
             req.state = "running"
@@ -1807,6 +2131,8 @@ class ServingEngine:
         if not batch:
             return []
         self._admitted += len(batch)
+        for r in batch:
+            self._class_admitted[r.priority] += 1
         tele = self.telemetry
         # t_admit is ALWAYS stamped (ring on or off): the SLO layer's
         # queue/service decomposition reads it at _finish
@@ -1988,6 +2314,7 @@ class ServingEngine:
             r.t_first = now
             tele.req_event(r.rid, "first_token", now)
             r.tokens.append(tok0)
+            self._class_tokens[r.priority] += 1
             self._nt[s] = 1
             self._tok[s] = tok0
             if self._drafters is not None:
@@ -2014,18 +2341,18 @@ class ServingEngine:
         publish-then-lookup; the store converges one prompt later)."""
         free = self._free_slots()
         batch = []
-        while free and self._queue:
+        while free and self._queue_len():
             if self.paged:
                 # pool-bounded admission, same reservation rule as the
                 # phase path: worst-case blocks covered or the head
                 # waits (deadline expiry still runs every step)
-                head = self._queue[0]
+                head = self._queue_head()
                 need = self._blocks_needed(head.prompt.size,
                                            head.max_new_tokens)
                 if self._kv_reserved + need > self.pool.num_blocks:
                     break
                 self._kv_reserved += need
-            req = self._queue.popleft()
+            req = self._queue_popleft()
             slot = free.pop(0)
             req.slot = slot
             req.state = "running"
@@ -2034,6 +2361,8 @@ class ServingEngine:
         if not batch:
             return []
         self._admitted += len(batch)
+        for r in batch:
+            self._class_admitted[r.priority] += 1
         tele = self.telemetry
         # always stamped (SLO queue/service decomposition reads it)
         t_adm = self.clock()
@@ -2150,15 +2479,16 @@ class ServingEngine:
             seg[s] = 1
             gen0[s] = 0
         if pf_rows:
-            # FCFS (Sarathi's order): the OLDEST prefilling request
-            # takes the whole spare budget first — round-robin sharing
-            # would stretch EVERY concurrent prompt's prefill (and so
-            # the TTFT tail) by the number of prefilling slots
-            pf_rows.sort(key=lambda s: self._slot_req[s].rid)
-            for s in pf_rows:
-                n = min(int(self._pf_left[s]), c, budget)
-                if n <= 0:
-                    continue
+            # weighted-fair packing: each QoS class PRESENT in the
+            # prefilling set gets its proportional share of the spare
+            # budget, spent FCFS (Sarathi's order) within the class,
+            # leftovers spill work-conserving in class order. With one
+            # class present this is exactly the old pure-FCFS packing —
+            # the oldest prompt takes the whole spare budget first
+            # (round-robin would stretch every concurrent TTFT tail).
+            allocs, budget = self._prefill_allocations(pf_rows, budget,
+                                                       col_cap=c)
+            for s, n in allocs:
                 req = self._slot_req[s]
                 p0 = req.prompt.size - int(self._pf_left[s])
                 toks[s, :n] = req.prompt[p0:p0 + n]
@@ -2168,7 +2498,6 @@ class ServingEngine:
                     # finishing this dispatch: the last prompt token's
                     # logits sample the request's FIRST generated token
                     gen0[s] = n - 1
-                budget -= n
         if k:
             for s in dec_rows:
                 m = min(int(dlen[s]), budget)
@@ -2294,6 +2623,7 @@ class ServingEngine:
             if row_toks and prev_active[s]:
                 tele.req_event(req.rid, "decode", now)
             req.tokens.extend(row_toks)
+            self._class_tokens[req.priority] += len(row_toks)
             n_emitted += len(row_toks)
             self._decode_steps += len(row_toks)
             if not still_active[s]:
@@ -2349,6 +2679,7 @@ class ServingEngine:
             req.t_first = now
             tele.req_event(req.rid, "first_token", now)
             req.tokens.append(tok0)
+            self._class_tokens[req.priority] += 1
             self._nt[s] = 1
             self._tok[s] = tok0
             self._decode_steps += 1      # one sample event for the row
@@ -2381,6 +2712,7 @@ class ServingEngine:
                 kept, int(self._max_nt[s] - self._nt[s]), eos)
             self._nt[s] += len(emitted)
             req.tokens.extend(emitted)
+            self._class_tokens[req.priority] += len(emitted)
             n_emitted += len(emitted)
             self._lens[s] += len(emitted)
             self._tok[s] = emitted[-1]
@@ -2452,17 +2784,16 @@ class ServingEngine:
         segs = []                    # [slot, tokens, is_decode_claim]
         pf_n = np.zeros(b, np.int64)
         if pf_rows:
-            pf_rows.sort(key=lambda s: self._slot_req[s].rid)
-            for s in pf_rows:
-                n = min(int(self._pf_left[s]), budget)
-                if n <= 0:
-                    continue
+            # weighted-fair packing, same allocator as the row path
+            # (FCFS within a class; single-class == old pure FCFS) —
+            # no column cap, so a segment can span the whole share
+            allocs, budget = self._prefill_allocations(pf_rows, budget)
+            for s, n in allocs:
                 req = self._slot_req[s]
                 p0 = req.prompt.size - int(self._pf_left[s])
                 segs.append([s, req.prompt[p0:p0 + n].astype(np.int32),
                              False])
                 pf_n[s] = n
-                budget -= n
         if k:
             for s in dec_rows:
                 m = min(int(dlen[s]), budget)
@@ -2646,6 +2977,7 @@ class ServingEngine:
                 continue
             hits = emitted[:, s]
             req.tokens.extend(int(t) for t in toks[hits, s])
+            self._class_tokens[req.priority] += int(hits.sum())
             if hits.any():
                 self.telemetry.req_event(req.rid, "decode", now)
             if self._drafters is not None:
@@ -2749,6 +3081,7 @@ class ServingEngine:
                 kept, int(self._max_nt[s] - self._nt[s]), eos)
             self._nt[s] += len(emitted)
             req.tokens.extend(emitted)
+            self._class_tokens[req.priority] += len(emitted)
             n_emitted += len(emitted)
             self._lens[s] += len(emitted)
             self._tok[s] = emitted[-1]
@@ -2781,16 +3114,26 @@ class ServingEngine:
         shed before they ever cost a prefill; RUNNING ones release their
         slot through the normal eviction machinery (_finish resets the
         slot bookkeeping; the cache row needs no zeroing)."""
-        for req in [r for r in self._queue
-                    if r.deadline_s is not None
-                    and now - r.t_submit > r.deadline_s]:
-            self._queue.remove(req)
-            self._finish(req, now, expired=True)
+        for q in self._queues.values():
+            for req in [r for r in q
+                        if r.deadline_s is not None
+                        and now - r.t_submit > r.deadline_s]:
+                q.remove(req)
+                self._finish(req, now, expired=True)
         for s in range(self.num_slots):
             req = self._slot_req[s]
             if (req is not None and req.deadline_s is not None
                     and now - req.t_submit > req.deadline_s):
                 self._finish(req, now, expired=True)
+        # parked requests age too: the deadline clock never pauses in
+        # the parking lot (park time is queue-attributed delay) — an
+        # expired one is shed HERE, releasing its kv commitment exactly
+        # once through the normal _finish path (slot is already None)
+        for rid in [r for r, st in self._parked.items()
+                    if st["deadline_s"] is not None
+                    and now - self._req_index[r].t_submit
+                    > st["deadline_s"]]:
+            self._finish(self._req_index[rid], now, expired=True)
 
     def _finish(self, req, now, expired=False):
         req.state = "expired" if expired else "finished"
@@ -2816,6 +3159,10 @@ class ServingEngine:
                 self._slo_ok += 1
             elif verdict == "queue":
                 self._slo_violated_queue += 1
+                # per-class queue-violation attribution: the autoscaler
+                # reads the HIGH-class series (scale on premium pain
+                # only) and the gateway's shed logic reads the split
+                self._slo_vq_class[req.priority] += 1
             else:
                 self._slo_violated_service += 1
             # histogram observation happens HERE, not at the first
@@ -2838,6 +3185,9 @@ class ServingEngine:
         # record, exactly the old lifecycle
         if req.rid not in self._harvest:
             self._req_index.pop(req.rid, None)
+        # a parked request finishing (deadline expiry) drops its host
+        # copy; its blocks/reservation were already released at preempt
+        self._parked.pop(req.rid, None)
         if self.paged:
             self._kv_committed -= self._blocks_needed(req.prompt.size,
                                                       req.max_new_tokens)

@@ -74,9 +74,25 @@ __all__ = ["LogHistogram", "Telemetry", "RequestTrace", "SloPolicy",
            "runtime_registry_snapshot", "PROMETHEUS_NAMES",
            "PROMETHEUS_EXEMPT_KEYS", "RESET_EXEMPT_KEYS", "DEFAULT_RING",
            "SNAPSHOT_SCHEMA_VERSION", "SNAPSHOT_REQUIRED_KEYS",
-           "SNAPSHOT_OPTIONAL_KEYS", "SLO_ENV_VARS"]
+           "SNAPSHOT_OPTIONAL_KEYS", "SLO_ENV_VARS", "QOS_CLASSES",
+           "QOS_DEFAULT", "QOS_RANK", "DEFAULT_QOS_SHARES"]
 
 DEFAULT_RING = 2048
+
+# ---- QoS priority classes -------------------------------------------
+# The canonical class set, best-first: admission, preemption-victim
+# selection, and the weighted-fair packer all rank by position in this
+# tuple. It lives HERE (the import-light module) so the stdlib-only
+# cluster protocol (serving_cluster/protocol.py) can validate the
+# X-Priority header without dragging jax in.
+QOS_CLASSES = ("high", "normal", "low")
+QOS_DEFAULT = "normal"
+QOS_RANK = {c: i for i, c in enumerate(QOS_CLASSES)}
+# weighted-fair token-budget shares (PADDLE_QOS_SHARES overrides,
+# "high=4,normal=2,low=1" syntax): a class's share of the SPARE prefill
+# budget when several classes are prefilling at once — work-conserving,
+# so an idle class's share spills to the hungry ones
+DEFAULT_QOS_SHARES = {"high": 4, "normal": 2, "low": 1}
 
 # ---- telemetry_snapshot() wire contract -----------------------------
 # The snapshot IS a wire payload now: the cluster router
@@ -91,14 +107,20 @@ DEFAULT_RING = 2048
 # autoscaling item consumes.
 # v3: the "requests" block gains migrated_in/migrated_out (live session
 # migration — the autoscaler's drain accounting).
-SNAPSHOT_SCHEMA_VERSION = 3
+# v4: per-class QoS — top-level "queue_depths" ({class: depth}, the
+# router/gateway shed signal), the "requests" block gains
+# preempted/resumed (preemption-to-host accounting), and the "slo"
+# block gains "violated_queue_by_class" (the autoscaler scales up on
+# HIGH-priority queue violations only; low-priority backlog is the QoS
+# layer degrading gracefully, not a capacity signal).
+SNAPSHOT_SCHEMA_VERSION = 4
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
     "schema_version", "queue_depth", "occupancy", "num_slots",
     "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
     "requests", "histograms", "budget", "prefix", "spans_logged",
-    "steps_logged", "telemetry_ring", "slo",
+    "steps_logged", "telemetry_ring", "slo", "queue_depths",
 })
 
 # keys present only on some configurations (paged pool / spec decode)
@@ -558,6 +580,36 @@ PROMETHEUS_NAMES = {
         "paddle_serving_requests_migrated_in_total", "counter"),
     "requests_migrated_out": (
         "paddle_serving_requests_migrated_out_total", "counter"),
+    # QoS preemption-to-host: preempted left their slot for the host-RAM
+    # parking lot (same rid, stream intact), resumed re-entered a slot;
+    # preempted >= resumed always (the difference is currently parked)
+    "requests_preempted": ("paddle_serving_requests_preempted_total",
+                           "counter"),
+    "requests_resumed": ("paddle_serving_requests_resumed_total",
+                         "counter"),
+    "requests_parked": ("paddle_serving_requests_parked", "gauge"),
+    # per-class QoS counters as LABELED series of one family (the three
+    # entries share a family name; render_prometheus emits HELP/TYPE
+    # once per family and one labeled sample per key, zero-initialized
+    # so every class is discoverable before traffic arrives)
+    "requests_admitted_high": (
+        'paddle_serving_class_requests_admitted_total{class="high"}',
+        "counter"),
+    "requests_admitted_normal": (
+        'paddle_serving_class_requests_admitted_total{class="normal"}',
+        "counter"),
+    "requests_admitted_low": (
+        'paddle_serving_class_requests_admitted_total{class="low"}',
+        "counter"),
+    "tokens_emitted_high": (
+        'paddle_serving_class_tokens_emitted_total{class="high"}',
+        "counter"),
+    "tokens_emitted_normal": (
+        'paddle_serving_class_tokens_emitted_total{class="normal"}',
+        "counter"),
+    "tokens_emitted_low": (
+        'paddle_serving_class_tokens_emitted_total{class="low"}',
+        "counter"),
     "queue_depth": ("paddle_serving_queue_depth", "gauge"),
     "occupancy": ("paddle_serving_slot_occupancy", "gauge"),
     "traces": ("paddle_serving_compiled_traces_total", "counter"),
@@ -658,6 +710,7 @@ def render_prometheus(engine):
     base = getattr(engine, "_prom_base", {})
     lines = []
     seen = set()
+    seen_fams = set()
     for key, (name, typ) in PROMETHEUS_NAMES.items():
         if typ == "histogram" or name in seen:
             continue
@@ -667,8 +720,15 @@ def render_prometheus(engine):
         elif v is None:
             continue                      # gauge with nothing to report
         seen.add(name)
-        lines.append(f"# HELP {name} serving metric {key!r}")
-        lines.append(f"# TYPE {name} {typ}")
+        # labeled per-class series share ONE metric family: HELP/TYPE
+        # are emitted once per family (label-stripped name — a TYPE
+        # line naming `family{label}` is malformed text format), then
+        # each labeled sample rides under it
+        fam = name.split("{", 1)[0]
+        if fam not in seen_fams:
+            seen_fams.add(fam)
+            lines.append(f"# HELP {fam} serving metric {key!r}")
+            lines.append(f"# TYPE {fam} {typ}")
         lines.append(f"{name} {_fmt(v)}")
     tele = engine.telemetry
     lines.extend(tele.hist_ttft.prometheus_lines(
@@ -762,7 +822,12 @@ def snapshot(engine):
         "tokens_per_sec": m["tokens_per_sec"],
         "requests": {k: m[f"requests_{k}"] for k in
                      ("admitted", "finished", "forked", "rejected",
-                      "expired", "migrated_in", "migrated_out")},
+                      "expired", "migrated_in", "migrated_out",
+                      "preempted", "resumed")},
+        # per-class queue depths (v4): the gateway's SLO-aware shed and
+        # the router's placement read backlog BY CLASS — a deep
+        # low-priority queue is graceful degradation, not overload
+        "queue_depths": dict(engine.queue_depths()),
         "histograms": {
             "ttft_s": tele.hist_ttft.snapshot(),
             "latency_s": tele.hist_latency.snapshot(),
@@ -779,6 +844,10 @@ def snapshot(engine):
             "ok": m["slo_ok"],
             "violated_queue": m["slo_violated_queue"],
             "violated_service": m["slo_violated_service"],
+            # per-class queue-violation attribution (v4): the
+            # autoscaler scales up on the HIGH class only — low-class
+            # queueing under overload is the QoS layer working
+            "violated_queue_by_class": dict(engine._slo_vq_class),
         },
         "budget": {k: m[f"budget_{k}"] for k in
                    ("steps", "tokens_used", "prefill_tokens",

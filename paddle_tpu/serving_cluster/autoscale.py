@@ -144,8 +144,17 @@ class Autoscaler:
             if b and b.get("kv_blocks_total"):
                 kv_free = min(kv_free, b["kv_blocks_free"]
                               / b["kv_blocks_total"])
-        vq = sum(int((s.get("slo") or {}).get("violated_queue", 0))
-                 for s in snaps)
+        # scale on HIGH-priority queue pain only (snapshot v4 splits
+        # violated_queue by class): low-class violations under overload
+        # are the QoS layer degrading gracefully — spawning a replica
+        # for them defeats the priority shed. Snapshots without the
+        # per-class split (none today; defensive) fall back to totals.
+        vq = 0
+        for s in snaps:
+            slo = s.get("slo") or {}
+            by_cls = slo.get("violated_queue_by_class")
+            vq += int(by_cls["high"] if by_cls is not None
+                      else slo.get("violated_queue", 0))
         return {"replicas": len(names), "snapshots": len(snaps),
                 "queue_mean": qmean, "kv_free_frac": kv_free,
                 "slo_violated_queue": vq}

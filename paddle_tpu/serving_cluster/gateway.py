@@ -33,6 +33,27 @@ replicas dead → 503. A replica dying mid-stream never errors the
 stream — the router fails over and the replayed greedy prefix is
 skipped (router.py), so the client just sees one slow poll interval.
 
+QoS / multi-tenant admission (all off by default):
+
+  * ``X-Priority`` (or the body's ``"priority"`` field, which wins)
+    tags the request ``high``/``normal``/``low`` — threaded through
+    the router into the engine's per-class queues, weighted-fair
+    packer, and preemption policy (inference/serving.py);
+  * ``X-Tenant`` keys a per-tenant TOKEN BUCKET
+    (``PADDLE_TENANT_RATE`` req/s refill, ``PADDLE_TENANT_BURST``
+    capacity) and a live-request quota (``PADDLE_TENANT_QUOTA``).
+    Over-rate → 429 ``reason=rate_limited``; over-quota → 429
+    ``reason=quota_exceeded`` — both with ``Retry-After`` computed
+    from the TENANT'S OWN bucket refill time (not the cluster drain
+    rate), so a throttled tenant backs off by its own allowance while
+    everyone else's 429s keep the drain-rate hint;
+  * SLO-aware shedding (``PADDLE_QOS_SHED_DEPTH`` > 0): a LOW-class
+    arrival is refused 429 ``reason=overload`` when the cluster's
+    mean queue depth crosses the watermark AND the PR-11 queue-vs-
+    service decomposition attributes the SLO pain to queueing
+    (``violated_queue >= violated_service``) — shedding only helps
+    when waiting, not service time, is the bottleneck.
+
 Trace plane (the cluster observability spine):
 
   * every HTTP request gets a TRACE ID — inbound ``X-Request-Id``
@@ -63,6 +84,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import os
 import threading
 import time
@@ -95,7 +117,9 @@ class _HttpError(Exception):
 
 class Gateway:
     def __init__(self, router, model_id="paddle_tpu", host="127.0.0.1",
-                 port=None, poll_s=None, hb_s=None, autoscaler=None):
+                 port=None, poll_s=None, hb_s=None, autoscaler=None,
+                 tenant_rate=None, tenant_burst=None, tenant_quota=None,
+                 shed_depth=None):
         self.router = router
         # optional elastic control plane (serving_cluster/autoscale.py):
         # the health sweep drives its tick; POST /admin/scale needs it
@@ -119,6 +143,35 @@ class Gateway:
             raise ValueError(f"trace ring must be >= 0, got {ring}")
         self.trace_ring = ring
         self.http_log = deque(maxlen=max(ring, 1))
+        # per-tenant admission (X-Tenant): token-bucket rate limit +
+        # live-request quota. 0 disables a check; buckets/live counts
+        # are pure host dicts under one lock (handlers run in executor
+        # threads). Constructor args override env so tests don't need
+        # the process environment (conftest leak guard).
+        self.tenant_rate = float(
+            tenant_rate if tenant_rate is not None
+            else os.environ.get("PADDLE_TENANT_RATE", "0") or "0")
+        self.tenant_burst = float(
+            tenant_burst if tenant_burst is not None
+            else os.environ.get("PADDLE_TENANT_BURST", "8") or "8")
+        self.tenant_quota = int(
+            tenant_quota if tenant_quota is not None
+            else os.environ.get("PADDLE_TENANT_QUOTA", "0") or "0")
+        if self.tenant_rate < 0 or self.tenant_burst < 1 \
+                or self.tenant_quota < 0:
+            raise ValueError(
+                f"need tenant_rate >= 0, tenant_burst >= 1, "
+                f"tenant_quota >= 0; got rate={self.tenant_rate} "
+                f"burst={self.tenant_burst} quota={self.tenant_quota}")
+        # SLO-aware shed watermark: mean queue depth above which LOW-
+        # class arrivals are refused while queueing dominates the SLO
+        # violations (router.qos_pressure()); 0 disables
+        self.shed_depth = float(
+            shed_depth if shed_depth is not None
+            else os.environ.get("PADDLE_QOS_SHED_DEPTH", "0") or "0")
+        self._tenant_lock = threading.Lock()
+        self._buckets = {}                # tenant -> [tokens, last_ts]
+        self._tenant_live = {}            # tenant -> in-flight count
         # drain serialization fallback when no autoscaler is configured
         # (with one, its _op_lock serializes drain vs tick/scale_to)
         self._drain_lock = threading.RLock()
@@ -209,7 +262,8 @@ class Gateway:
             try:
                 # bound the request read: a client that connects and
                 # sends nothing must not pin a handler task forever
-                method, path, body, tid = await asyncio.wait_for(
+                (method, path, body, tid, prio_h,
+                 tenant) = await asyncio.wait_for(
                     self._read_request(reader, writer, span),
                     timeout=30)
             except (asyncio.IncompleteReadError, ConnectionError,
@@ -227,7 +281,8 @@ class Gateway:
             record = True
             span["trace_id"] = tid or uuid.uuid4().hex
             try:
-                await self._route(method, path, body, writer, span)
+                await self._route(method, path, body, writer, span,
+                                  prio_h, tenant)
             except protocol.ProtocolError as e:
                 await self._send_error(writer, e.code, e.message,
                                        span=span)
@@ -274,6 +329,8 @@ class Gateway:
         clen = 0
         expect_continue = False
         trace_id = None
+        prio = None
+        tenant = None
         while True:
             h = (await reader.readline()).decode("latin-1").strip()
             if not h:
@@ -292,6 +349,13 @@ class Gateway:
             elif key == protocol.TRACE_HEADER.lower():
                 trace_id = v.strip() or None
                 span["trace_id"] = trace_id
+            elif key == protocol.PRIORITY_HEADER.lower():
+                # QoS class hint; the body's "priority" field wins
+                # (protocol.parse_completion_request), validation
+                # happens there too
+                prio = v.strip() or None
+            elif key == protocol.TENANT_HEADER.lower():
+                tenant = v.strip() or None
         if not 0 <= clen <= _MAX_BODY:
             # the lower bound matters too: readexactly(-1) raises an
             # unhandled ValueError instead of a clean 400
@@ -305,9 +369,10 @@ class Gateway:
             writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
             await writer.drain()
         body = await reader.readexactly(clen) if clen else b""
-        return method, path, body, trace_id
+        return method, path, body, trace_id, prio, tenant
 
-    async def _route(self, method, path, body, writer, span):
+    async def _route(self, method, path, body, writer, span,
+                     prio_h=None, tenant=None):
         if method == "GET" and path == "/healthz":
             alive = len(self.router.alive_names())
             total = len(self.router.replicas)
@@ -330,7 +395,7 @@ class Gateway:
                 ctype="text/plain; version=0.0.4; charset=utf-8",
                 span=span)
         elif method == "POST" and path == "/v1/completions":
-            await self._completions(body, writer, span)
+            await self._completions(body, writer, span, prio_h, tenant)
         elif path == "/admin/scale" and method in ("GET", "POST"):
             await self._admin_scale(method, body, writer, span)
         elif method == "POST" and path == "/admin/drain":
@@ -417,16 +482,115 @@ class Gateway:
                     "have nowhere to migrate")
             return self.router.remove_replica(name)
 
+    # -------------------------------------------------- QoS / tenants
+    def _tenant_retry_after(self, tokens):
+        """Retry-After from the TENANT'S OWN bucket: time for it to
+        refill to one whole token at its refill rate, ceil'd and
+        clamped to the protocol bounds. No refill configured -> the
+        floor (the quota frees on a completion, not a clock)."""
+        if self.tenant_rate <= 0:
+            return protocol.RETRY_AFTER_S
+        wait = math.ceil(max(1.0 - tokens, 0.0) / self.tenant_rate)
+        return int(min(max(wait, protocol.RETRY_AFTER_S),
+                       protocol.RETRY_AFTER_MAX_S))
+
+    def _tenant_admit(self, tenant):
+        """One admission decision for a tenant-tagged arrival: returns
+        None (admitted; one bucket token consumed) or an error code +
+        Retry-After seconds. Quota is checked BEFORE the bucket so a
+        refused request doesn't burn rate allowance."""
+        if tenant is None or (self.tenant_rate <= 0
+                              and self.tenant_quota <= 0):
+            return None
+        now = time.monotonic()
+        with self._tenant_lock:
+            if self.tenant_quota > 0 \
+                    and self._tenant_live.get(tenant, 0) \
+                    >= self.tenant_quota:
+                tok = self._buckets.get(tenant,
+                                        [self.tenant_burst, now])[0]
+                return ("quota_exceeded", self._tenant_retry_after(tok))
+            if self.tenant_rate > 0:
+                tok, last = self._buckets.get(
+                    tenant, (self.tenant_burst, now))
+                tok = min(self.tenant_burst,
+                          tok + (now - last) * self.tenant_rate)
+                if tok < 1.0:
+                    self._buckets[tenant] = [tok, now]
+                    return ("rate_limited", self._tenant_retry_after(tok))
+                self._buckets[tenant] = [tok - 1.0, now]
+            if self.tenant_quota > 0:
+                self._tenant_live[tenant] = \
+                    self._tenant_live.get(tenant, 0) + 1
+        return None
+
+    def _tenant_release(self, tenant):
+        """Undo a quota admission when its request leaves the gateway
+        (finished, errored, or the client vanished)."""
+        if tenant is None or self.tenant_quota <= 0:
+            return
+        with self._tenant_lock:
+            n = self._tenant_live.get(tenant, 0) - 1
+            if n > 0:
+                self._tenant_live[tenant] = n
+            else:
+                self._tenant_live.pop(tenant, None)
+
+    def _should_shed(self, priority):
+        """SLO-aware load shedding: refuse a LOW-class arrival when
+        the cluster's mean queue depth crosses the watermark AND the
+        queue-vs-service decomposition says queueing (not service
+        time) is where the SLO pain comes from — only then does
+        refusing new waiters actually protect the objectives. Higher
+        classes are never shed here; they preempt instead."""
+        if self.shed_depth <= 0 or priority != "low":
+            return False
+        p = self.router.qos_pressure()
+        return (p["queue_mean"] >= self.shed_depth
+                and p["violated_queue"] >= p["violated_service"])
+
     # ------------------------------------------------------ completions
-    async def _completions(self, body, writer, span):
+    async def _completions(self, body, writer, span, prio_h=None,
+                           tenant=None):
         try:
             obj = json.loads(body.decode() or "null")
         except (ValueError, UnicodeDecodeError) as e:
             raise protocol.ProtocolError("bad_request",
                                          f"body is not JSON: {e}")
-        req = protocol.parse_completion_request(obj, self.model_id)
+        req = protocol.parse_completion_request(obj, self.model_id,
+                                                priority_header=prio_h)
         loop = asyncio.get_running_loop()
         trace_id = span["trace_id"]
+        # tenant admission first (cheap host arithmetic, no router
+        # lock): the 429 carries the tenant's OWN bucket refill time,
+        # not the cluster drain rate
+        verdict = await loop.run_in_executor(None, self._tenant_admit,
+                                             tenant)
+        if verdict is not None:
+            code, retry = verdict
+            await self._send_error(
+                writer, code,
+                f"tenant {tenant!r} {code.replace('_', ' ')}: "
+                f"rate={self.tenant_rate}/s burst={self.tenant_burst} "
+                f"quota={self.tenant_quota}",
+                extra={"Retry-After": str(retry)}, span=span)
+            return
+        try:
+            if await loop.run_in_executor(None, self._should_shed,
+                                          req.priority):
+                # surfaces as 429 reason=overload with the drain-rate
+                # Retry-After (the _handle AdmissionFull path)
+                raise AdmissionFull(
+                    f"shedding class={req.priority!r}: cluster queue "
+                    f"depth over {self.shed_depth} with queue-"
+                    "attributed SLO violations dominating")
+            await self._completions_admitted(req, writer, span,
+                                             trace_id, loop)
+        finally:
+            self._tenant_release(tenant)
+
+    async def _completions_admitted(self, req, writer, span, trace_id,
+                                    loop):
         try:
             gid = await loop.run_in_executor(
                 None, lambda: self.router.submit(

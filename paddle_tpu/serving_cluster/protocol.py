@@ -27,11 +27,17 @@ from __future__ import annotations
 
 import json
 
+# import-light by design (telemetry pulls no jax/numpy at module load):
+# the QoS class vocabulary is shared engine <-> wire surface
+from ..inference.telemetry import QOS_CLASSES, QOS_DEFAULT
+
 __all__ = ["ProtocolError", "CompletionRequest", "ERROR_STATUS",
            "RETRY_AFTER_S", "RETRY_AFTER_MAX_S", "COMPLETION_FIELDS",
            "CHOICE_FIELDS", "USAGE_FIELDS", "STREAM_CHUNK_FIELDS",
            "MODELS_FIELDS", "MODEL_ENTRY_FIELDS", "HEALTHZ_FIELDS",
            "SCALE_FIELDS", "DRAIN_FIELDS", "ERROR_BODY_FIELDS",
+           "ERROR_BODY_FIELDS_429", "REASON_FOR_429",
+           "PRIORITY_HEADER", "TENANT_HEADER",
            "ENDPOINTS", "TRACE_HEADER", "parse_completion_request",
            "completion_response", "stream_chunk", "sse_event",
            "SSE_DONE", "error_body", "finish_reason"]
@@ -43,6 +49,8 @@ __all__ = ["ProtocolError", "CompletionRequest", "ERROR_STATUS",
 # surface check pins every row end-to-end over real HTTP.
 ERROR_STATUS = {
     "admission_full": 429,      # ServingEngine.AdmissionFull: shed
+    "rate_limited": 429,        # tenant token bucket empty
+    "quota_exceeded": 429,      # tenant live-request quota hit
     "deadline_exceeded": 504,   # deadline_s lapsed before completion
     "unknown_model": 404,       # model id not served here
     "not_found": 404,           # unknown route / unknown request id
@@ -62,6 +70,15 @@ ERROR_STATUS = {
 # hour (or to hammer a saturated cluster every second).
 RETRY_AFTER_S = 1
 RETRY_AFTER_MAX_S = 30
+
+# QoS headers: X-Priority selects the request's class (the JSON body's
+# "priority" field wins when both are present — the body is the signed
+# payload, the header the proxy-injectable convenience) and X-Tenant
+# keys the gateway's per-tenant token bucket + quota. Both optional:
+# absent priority = "normal", absent tenant = the shared anonymous
+# bucket-less pool.
+PRIORITY_HEADER = "X-Priority"
+TENANT_HEADER = "X-Tenant"
 
 # the end-to-end trace context header: the gateway honors an inbound
 # id (so an upstream proxy can pre-mint) or mints one, echoes it on
@@ -94,6 +111,16 @@ SCALE_FIELDS = ("replicas_alive", "replicas_total", "draining",
 DRAIN_FIELDS = ("replica", "migrated", "failed_over", "orphaned",
                 "expired")
 ERROR_BODY_FIELDS = ("message", "type", "code")
+# EVERY 429 additionally carries a machine-readable shed cause, so a
+# client can distinguish "the cluster is full, back off" (overload)
+# from "YOUR tenant hit its limit" (rate_limited / quota_exceeded) —
+# the latter two must not trigger fleet-wide client backoff
+ERROR_BODY_FIELDS_429 = ("message", "type", "code", "reason")
+REASON_FOR_429 = {
+    "admission_full": "overload",
+    "rate_limited": "rate_limited",
+    "quota_exceeded": "quota_exceeded",
+}
 
 # route -> top-level response field tuple (None = non-JSON body, e.g.
 # the Prometheus text exposition). The surface check walks this table.
@@ -126,10 +153,11 @@ class CompletionRequest:
 
     __slots__ = ("model", "prompt", "max_tokens", "stream",
                  "stop_token_id", "min_tokens", "repetition_penalty",
-                 "deadline_s", "request_id")
+                 "deadline_s", "request_id", "priority")
 
     def __init__(self, model, prompt, max_tokens, stream, stop_token_id,
-                 min_tokens, repetition_penalty, deadline_s, request_id):
+                 min_tokens, repetition_penalty, deadline_s, request_id,
+                 priority=QOS_DEFAULT):
         self.model = model
         self.prompt = prompt
         self.max_tokens = max_tokens
@@ -139,6 +167,7 @@ class CompletionRequest:
         self.repetition_penalty = repetition_penalty
         self.deadline_s = deadline_s
         self.request_id = request_id
+        self.priority = priority
 
     def submit_kwargs(self):
         """The ServingEngine.submit keyword view of this request."""
@@ -146,7 +175,8 @@ class CompletionRequest:
                     eos_token_id=self.stop_token_id,
                     min_length=self.min_tokens,
                     repetition_penalty=self.repetition_penalty,
-                    deadline_s=self.deadline_s)
+                    deadline_s=self.deadline_s,
+                    priority=self.priority)
 
 
 def _int_field(body, key, default, lo=None):
@@ -161,9 +191,11 @@ def _int_field(body, key, default, lo=None):
     return v
 
 
-def parse_completion_request(body, served_model):
+def parse_completion_request(body, served_model, priority_header=None):
     """Validate a decoded JSON body against the served model; raises
-    ProtocolError(bad_request / unknown_model)."""
+    ProtocolError(bad_request / unknown_model). ``priority_header``
+    carries the inbound X-Priority value (the body's "priority" field
+    wins when both are present)."""
     if not isinstance(body, dict):
         raise ProtocolError("bad_request", "body must be a JSON object")
     model = body.get("model", served_model)
@@ -197,6 +229,14 @@ def parse_completion_request(body, served_model):
     if rid is not None and not isinstance(rid, str):
         raise ProtocolError("bad_request", "'request_id' must be a "
                             "string")
+    prio = body.get("priority", priority_header)
+    if prio is None:
+        prio = QOS_DEFAULT
+    if prio not in QOS_CLASSES:
+        raise ProtocolError(
+            "bad_request",
+            f"'priority' must be one of {list(QOS_CLASSES)}, got "
+            f"{prio!r}")
     # an explicit JSON null means "use the default" (OpenAI semantics),
     # never a None that would reach the engine's integer comparisons
     mt = _int_field(body, "max_tokens", 16, lo=1)
@@ -209,7 +249,7 @@ def parse_completion_request(body, served_model):
         min_tokens=0 if mn is None else mn,
         repetition_penalty=float(rp),
         deadline_s=None if dl is None else float(dl),
-        request_id=rid)
+        request_id=rid, priority=prio)
 
 
 def _choice(tokens, reason):
@@ -246,9 +286,12 @@ def sse_event(payload) -> bytes:
 
 
 def error_body(code, message):
-    """OpenAI-style error envelope; returns (status, body_dict)."""
-    return ERROR_STATUS[code], {
-        "error": {"message": message, "type": code, "code": code}}
+    """OpenAI-style error envelope; returns (status, body_dict). 429s
+    auto-carry the machine-readable ``reason`` field (REASON_FOR_429)."""
+    err = {"message": message, "type": code, "code": code}
+    if ERROR_STATUS[code] == 429:
+        err["reason"] = REASON_FOR_429[code]
+    return ERROR_STATUS[code], {"error": err}
 
 
 def finish_reason(tokens, stop_token_id, expired):

@@ -373,6 +373,24 @@ class Router:
             return hi
         return int(min(max(math.ceil(qd / (df / dt)), lo), hi))
 
+    def qos_pressure(self):
+        """Cluster-wide overload reading for the gateway's SLO-aware
+        shed: mean queue depth over placeable replicas plus the
+        cumulative queue-vs-service violation split (PR 11's
+        decomposition, summed from the snapshot cache — no rpc)."""
+        with self._lock:
+            names = self.placeable_names()
+            snaps = [self._snap(n) for n in names]
+        snaps = [s for s in snaps if s is not None]
+        qmean = (sum(int(s.get("queue_depth", 0)) for s in snaps)
+                 / max(len(snaps), 1))
+        vq = sum(int((s.get("slo") or {}).get("violated_queue", 0))
+                 for s in snaps)
+        vs = sum(int((s.get("slo") or {}).get("violated_service", 0))
+                 for s in snaps)
+        return {"queue_mean": qmean, "violated_queue": vq,
+                "violated_service": vs}
+
     @staticmethod
     def load_score(snap):
         """queue pressure + slot pressure + pool pressure, one number.

@@ -52,6 +52,12 @@ GW_ENV_VARS = (
     "PADDLE_GATEWAY_PORT",         # gateway listen port (0 = ephemeral)
     "PADDLE_GATEWAY_REPLICAS",     # demo-cluster replica count
     "PADDLE_GATEWAY_TRACE_RING",   # HTTP span ring size (0 = off)
+    # QoS / multi-tenant knobs (inference/serving.py weighted-fair
+    # shares; serving_cluster/gateway.py shed + tenant buckets): a
+    # leaked share split or rate limit silently reshapes every later
+    # engine's packing and the gateway's 429 behavior
+    "PADDLE_QOS_SHARES",           # per-class budget shares "high=4,..."
+    "PADDLE_QOS_SHED_DEPTH",       # mean queue depth -> shed low class
     "PADDLE_ROUTER_AUDIT_RING",    # decision ring (0 = ring off;
                                    # reason counters stay)
     "PADDLE_ROUTER_POLICY",        # prefix_affinity|least_loaded|round_robin
@@ -63,6 +69,12 @@ GW_ENV_VARS = (
     "PADDLE_SLO_E2E_S",            # end-to-end latency objective (s)
     "PADDLE_SLO_ITL_S",            # mean inter-token latency objective
     "PADDLE_SLO_TTFT_S",           # time-to-first-token objective (s)
+    # per-tenant admission (serving_cluster/gateway.py token buckets):
+    # X-Tenant header keys the bucket; 429s carry reason=rate_limited /
+    # quota_exceeded with a bucket-derived Retry-After
+    "PADDLE_TENANT_BURST",         # token-bucket capacity per tenant
+    "PADDLE_TENANT_QUOTA",         # live-request quota per tenant
+    "PADDLE_TENANT_RATE",          # bucket refill rate (req/s)
 )
 
 

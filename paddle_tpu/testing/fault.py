@@ -17,7 +17,13 @@ Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
                                 "migration" — the router's live-slot
                                 transfer, fired BETWEEN export and
                                 import so the state is off the source
-                                but on no target, the worst moment);
+                                but on no target, the worst moment —
+                                or "preempt" — the engine's QoS
+                                preemption-to-host, fired AFTER the
+                                slot is freed but BEFORE the parking-
+                                lot insert, so the parked copy is lost
+                                and the router's classic failover must
+                                pick the stream up exactly-once);
                                 KILL/HANG/RAISE fire at the AT_STEP-th
                                 occurrence of that point (unset
                                 AT_STEP = the first).

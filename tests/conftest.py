@@ -82,14 +82,40 @@ def check_serving_metrics(eng):
     admitted one, which legitimately breaks the reconciliation."""
     m = eng.metrics()
     assert m["requests_admitted"] >= 0
-    # every finished request was admitted, forked, or MIGRATED IN
-    # (expired ones may have been shed straight from the queue, so they
-    # don't reconcile this way; forks and migrated-in sessions are not
-    # admissions — they perform no prefix lookup and count separately,
-    # so hits + misses == admitted stays exact)
+    # every finished request was admitted, forked, MIGRATED IN, or
+    # RESUMED from the QoS parking lot (expired ones may have been shed
+    # straight from the queue, so they don't reconcile this way; forks,
+    # migrated-in sessions, and resumes are not admissions — they
+    # perform no prefix lookup and count separately, so hits + misses
+    # == admitted stays exact)
     assert m["requests_finished"] <= \
         m["requests_admitted"] + m["requests_forked"] \
-        + m["requests_migrated_in"]
+        + m["requests_migrated_in"] + m["requests_resumed"]
+    # QoS preemption reconciliation: a resume re-imports a previously
+    # preempted session, so resumed can never lead preempted; the
+    # parking-lot gauge is exactly the not-yet-resumed (and not yet
+    # expired) preemptions still holding their host-RAM state
+    assert 0 <= m["requests_resumed"] <= m["requests_preempted"]
+    assert 0 <= m["requests_parked"] <= \
+        m["requests_preempted"] - m["requests_resumed"]
+    if getattr(eng, "pool", None) is None:
+        assert m["requests_preempted"] == 0    # preemption is paged-only
+    # per-class split: every admission and every emitted token carries
+    # exactly one QoS class, so the class counters must sum to the
+    # totals — true with zero QoS traffic (all-default runs land every
+    # count in "normal")
+    adm_by_class = (m["requests_admitted_high"],
+                    m["requests_admitted_normal"],
+                    m["requests_admitted_low"])
+    assert sum(adm_by_class) == m["requests_admitted"], (
+        f"per-class admissions don't sum: {adm_by_class} != "
+        f"{m['requests_admitted']}")
+    tok_by_class = (m["tokens_emitted_high"],
+                    m["tokens_emitted_normal"],
+                    m["tokens_emitted_low"])
+    assert sum(tok_by_class) == m["tokens_emitted"], (
+        f"per-class tokens don't sum: {tok_by_class} != "
+        f"{m['tokens_emitted']}")
     # live-migration counters only move on paged engines (the payload
     # IS pool blocks)
     assert m["requests_migrated_in"] >= 0

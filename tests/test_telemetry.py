@@ -283,7 +283,7 @@ class TestSloGoodput:
     """SLO/goodput layer (the cluster trace plane's accounting half):
     every finished request gets exactly one verdict against the
     declared objectives, violations attribute to queueing vs service,
-    and the snapshot schema (v2) carries the slo block + the
+    and the snapshot schema carries the slo block + the
     queue/service decomposition the autoscaler consumes."""
 
     def test_classify_pure(self):
@@ -343,7 +343,7 @@ class TestSloGoodput:
         assert eng.results[r1]["ttft_s"] <= 0.5
         assert eng.results[r2]["ttft_s"] > 0.5
 
-    def test_snapshot_v2_slo_block_and_exposition(
+    def test_snapshot_slo_block_and_exposition(
             self, serving_metrics_ok):
         from paddle_tpu.inference.telemetry import (
             SNAPSHOT_SCHEMA_VERSION, SloPolicy)
@@ -362,14 +362,25 @@ class TestSloGoodput:
         assert (m["slo_violated_queue"]
                 + m["slo_violated_service"]) == 3
         snap = eng.telemetry_snapshot()
-        # v3: the requests block carries the migration counters too
-        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 3
+        # v4: migration counters (v3) + the QoS additions — preemption
+        # accounting in the requests block, per-class queue depths at
+        # the top level, and the per-class queue-violation split in slo
+        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 4
         assert snap["requests"]["migrated_in"] == 0
         assert snap["requests"]["migrated_out"] == 0
+        assert snap["requests"]["preempted"] == 0
+        assert snap["requests"]["resumed"] == 0
+        assert snap["queue_depths"] == {"high": 0, "normal": 0,
+                                        "low": 0}
         slo = snap["slo"]
         assert slo["objectives"]["ttft_s"] == 1e-9
         assert (slo["ok"] + slo["violated_queue"]
                 + slo["violated_service"]) == 3
+        # every queued-violation lands in exactly one class bucket
+        # (this all-default run: "normal")
+        assert sum(slo["violated_queue_by_class"].values()) == \
+            slo["violated_queue"]
+        assert slo["violated_queue_by_class"]["high"] == 0
         assert snap["histograms"]["queue_s"]["count"] == 3
         assert snap["histograms"]["service_s"]["count"] == 3
         json.dumps(snap)                  # still a wire payload

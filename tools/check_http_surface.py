@@ -32,9 +32,19 @@ Pinned end-to-end:
     bad_request→400, unknown_model→404, not_found→404,
     deadline_exceeded→504, admission_full→429 (Retry-After computed
     from the measured drain rate — pinned to the documented
-    [RETRY_AFTER_S, RETRY_AFTER_MAX_S] bounds), no_replica→503,
-    conflict→409. ``internal``(500) is the only untriggered row —
-    reaching it requires a bug by definition.
+    [RETRY_AFTER_S, RETRY_AFTER_MAX_S] bounds), rate_limited→429,
+    quota_exceeded→429, no_replica→503, conflict→409.
+    ``internal``(500) is the only untriggered row — reaching it
+    requires a bug by definition.
+  * QoS / multi-tenant surface: every 429 body carries the
+    machine-readable ``reason`` field (ERROR_BODY_FIELDS_429 /
+    REASON_FOR_429 — overload vs rate_limited vs quota_exceeded, so
+    clients can distinguish "cluster busy" from "you specifically are
+    throttled"); the ``X-Priority`` header threads the class through
+    to the engine's per-class counters (invalid classes → 400); a
+    tenant's 429 Retry-After comes from ITS OWN token bucket (above
+    the drain-rate floor other 429s use) and tenants are isolated —
+    one throttled tenant never 429s another.
 
 Usage: python tools/check_http_surface.py   (exit 0 = surface pinned)
 """
@@ -219,13 +229,23 @@ def main(argv=None):
 
         def err(st, data, hd=None):
             obj = json.loads(data)
+            # 429s grow the machine-readable `reason` field — pinned
+            # to the code→reason map so clients can tell cluster
+            # overload from tenant-specific throttling
+            want_fields = (P.ERROR_BODY_FIELDS_429 if st == 429
+                           else P.ERROR_BODY_FIELDS)
             check(set(obj) == {"error"} and
-                  set(obj["error"]) == set(P.ERROR_BODY_FIELDS),
+                  set(obj["error"]) == set(want_fields),
                   f"error envelope {obj}")
             code = obj["error"]["code"]
             check(P.ERROR_STATUS.get(code) == st,
                   f"code {code!r} arrived with status {st} != "
                   f"{P.ERROR_STATUS.get(code)}")
+            if st == 429:
+                check(obj["error"].get("reason")
+                      == P.REASON_FOR_429.get(code),
+                      f"429 reason {obj['error'].get('reason')!r} != "
+                      f"{P.REASON_FOR_429.get(code)!r} for {code!r}")
             seen[code] = st
             return obj
 
@@ -307,8 +327,8 @@ def main(argv=None):
                 break
         st, hd, data = _req(gw_b.port, "POST", "/v1/completions",
                             {"prompt": prompt, "max_tokens": 2})
-        obj = json.loads(data)
-        check(st == 429 and obj["error"]["code"] == "admission_full",
+        obj = err(st, data)               # envelope + reason=overload
+        check(obj["error"]["code"] == "admission_full",
               f"backpressure {st} {data[:120]!r}")
         # Retry-After is COMPUTED from the measured queue drain rate,
         # so its exact value depends on timing — the wire contract is
@@ -318,7 +338,6 @@ def main(argv=None):
               and P.RETRY_AFTER_S <= int(ra) <= P.RETRY_AFTER_MAX_S,
               f"429 Retry-After {ra!r} outside "
               f"[{P.RETRY_AFTER_S}, {P.RETRY_AFTER_MAX_S}]: {hd}")
-        seen["admission_full"] = st
 
         tiny.kill()
         deadline = time.monotonic() + 10
@@ -338,6 +357,90 @@ def main(argv=None):
     finally:
         gw_b.stop()
         tiny.close()
+
+    # ---------------- cluster C: tenant QoS admission ----------------
+    # a refill rate of 0.01/s with burst 1 makes the bucket effectively
+    # one-shot on the check's timescale: the second request is
+    # rate-limited no matter how long the first one's compile took
+    rep_c = LocalReplica("qos0", _build_engine())
+    router_c = Router([rep_c], policy="least_loaded")
+    gw_c = Gateway(router_c, port=0, hb_s=0.2, tenant_rate=0.01,
+                   tenant_burst=1, tenant_quota=1).start_background()
+    try:
+        # X-Priority threads the class through gateway -> router ->
+        # engine: the per-class admission counter is the proof the
+        # header reached the scheduler, not just the parser
+        st, _, data = _req(gw_c.port, "POST", "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 2},
+                           headers={P.PRIORITY_HEADER: "high",
+                                    P.TENANT_HEADER: "acme"})
+        check(st == 200, f"priority-tagged completion failed: {st}")
+        check(rep_c.engine.metrics()["requests_admitted_high"] == 1,
+              "X-Priority: high never reached the engine's per-class "
+              "admission counter")
+        # an invalid class is the client's 400, not a silent default
+        err(*_req(gw_c.port, "POST", "/v1/completions",
+                  {"prompt": prompt, "priority": "platinum"})[::2])
+        # acme's bucket is now empty -> 429 rate_limited, Retry-After
+        # from ACME'S OWN refill time: ceil(~1/0.01) clamped to the
+        # cap — strictly above the drain-rate floor an idle cluster
+        # would report, which is the whole point of the tenant path
+        st, hd, data = _req(gw_c.port, "POST", "/v1/completions",
+                            {"prompt": prompt, "max_tokens": 2},
+                            headers={P.TENANT_HEADER: "acme"})
+        obj = err(st, data)
+        check(obj["error"]["code"] == "rate_limited",
+              f"empty bucket gave {obj['error']['code']!r}, expected "
+              "rate_limited")
+        ra = hd.get("retry-after", "")
+        check(ra.isdigit()
+              and P.RETRY_AFTER_S < int(ra) <= P.RETRY_AFTER_MAX_S,
+              f"tenant 429 Retry-After {ra!r} not bucket-derived "
+              f"(must be > drain floor {P.RETRY_AFTER_S}, <= cap "
+              f"{P.RETRY_AFTER_MAX_S})")
+        # tenant isolation: acme being throttled must not 429 anyone
+        # else — and untagged requests bypass tenant admission entirely
+        st, _, _ = _req(gw_c.port, "POST", "/v1/completions",
+                        {"prompt": prompt, "max_tokens": 2},
+                        headers={P.TENANT_HEADER: "other"})
+        check(st == 200, f"tenant isolation broke: 'other' got {st}")
+        st, _, _ = _req(gw_c.port, "POST", "/v1/completions",
+                        {"prompt": prompt, "max_tokens": 2})
+        check(st == 200, f"untagged request hit tenant limits: {st}")
+        # live-request quota: while one 'bulk' request is in flight the
+        # second is refused quota_exceeded (checked BEFORE the bucket,
+        # so it burns no rate allowance)
+        import threading as _threading
+        t = _threading.Thread(
+            target=_req, args=(gw_c.port, "POST", "/v1/completions",
+                               {"prompt": prompt, "max_tokens": 40}),
+            kwargs={"headers": {P.TENANT_HEADER: "bulk"}}, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not gw_c._tenant_live.get("bulk") \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        check(gw_c._tenant_live.get("bulk") == 1,
+              "quota accounting never saw the in-flight request")
+        st, hd, data = _req(gw_c.port, "POST", "/v1/completions",
+                            {"prompt": prompt, "max_tokens": 2},
+                            headers={P.TENANT_HEADER: "bulk"})
+        obj = err(st, data)
+        check(obj["error"]["code"] == "quota_exceeded",
+              f"over-quota gave {obj['error']['code']!r}")
+        check(hd.get("retry-after", "").isdigit(),
+              f"quota 429 lost Retry-After: {hd}")
+        t.join(timeout=60)
+        # the quota admission is released when its request finishes
+        deadline = time.monotonic() + 10
+        while gw_c._tenant_live.get("bulk") \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        check("bulk" not in gw_c._tenant_live,
+              f"quota leak: {gw_c._tenant_live}")
+    finally:
+        gw_c.stop()
+        rep_c.close()
 
     # every mapped error code except `internal` must have been
     # triggered over the wire (internal == a bug path by definition)

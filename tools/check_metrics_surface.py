@@ -168,6 +168,14 @@ def main(argv=None):
     # the dispatch without registering it)
     _check_mesh_shard_surface(failures)
 
+    # ---- 9. QoS surface: the per-class counter names (class-labeled
+    # Prometheus series) are pinned BY VALUE — QoS dashboards and the
+    # bench gates key on these exact strings — the class-label series
+    # exist zero-valued BEFORE any traffic (the label set is
+    # discoverable up front), and the v4 snapshot carries the per-class
+    # queue depths + violation split the shed/autoscale paths read
+    _check_qos_surface(failures)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -179,7 +187,8 @@ def main(argv=None):
           f"{n_ops} flight-recorder op histograms in the "
           "runtime registry; SLO + router-audit counter names pinned; "
           f"{n_kinds} dispatched executable families covered by "
-          "generation.DISPATCH_KINDS; mp=2 shard gauges reconcile)")
+          "generation.DISPATCH_KINDS; mp=2 shard gauges reconcile; "
+          "QoS per-class series pinned + zero-initialized)")
     return 0
 
 
@@ -363,6 +372,83 @@ def _check_slo_and_audit_surface(failures):
             failures.append(
                 f"empty-router exposition lost the elastic counter "
                 f"{probe.split()[0]!r}")
+
+
+def _check_qos_surface(failures):
+    from paddle_tpu.inference.telemetry import (PROMETHEUS_NAMES,
+                                                QOS_CLASSES, QOS_DEFAULT,
+                                                QOS_RANK,
+                                                SNAPSHOT_REQUIRED_KEYS)
+
+    # the class vocabulary itself is wire surface: MIGRATION_FMT state
+    # dicts, X-Priority values, and the label values below all use it
+    if QOS_CLASSES != ("high", "normal", "low") \
+            or QOS_DEFAULT != "normal":
+        failures.append(
+            f"QoS class vocabulary drifted: {QOS_CLASSES!r} default "
+            f"{QOS_DEFAULT!r} — pinned ('high', 'normal', 'low') / "
+            "'normal' (headers, parked state dicts, and label values "
+            "all carry these strings)")
+    if [QOS_RANK[c] for c in QOS_CLASSES] != [0, 1, 2]:
+        failures.append(f"QOS_RANK no longer orders QOS_CLASSES: "
+                        f"{QOS_RANK!r}")
+    pinned = {
+        "requests_preempted": (
+            "paddle_serving_requests_preempted_total", "counter"),
+        "requests_resumed": (
+            "paddle_serving_requests_resumed_total", "counter"),
+        "requests_parked": ("paddle_serving_requests_parked", "gauge"),
+    }
+    for c in QOS_CLASSES:
+        pinned[f"requests_admitted_{c}"] = (
+            'paddle_serving_class_requests_admitted_total'
+            f'{{class="{c}"}}', "counter")
+        pinned[f"tokens_emitted_{c}"] = (
+            'paddle_serving_class_tokens_emitted_total'
+            f'{{class="{c}"}}', "counter")
+    for k, want in pinned.items():
+        got = PROMETHEUS_NAMES.get(k)
+        if got != want:
+            failures.append(
+                f"QoS metrics key {k!r} maps to {got!r}, pinned "
+                f"{want!r} — the per-class surface must not drift")
+    # a FRESH engine already exposes every class-labeled series,
+    # zero-valued: dashboards discover the label set before traffic
+    eng, _rng, _V = _build_engine()
+    text = eng.metrics_prometheus()
+    for k, (name, _typ) in pinned.items():
+        probe = f"{name} 0"
+        if probe not in text:
+            failures.append(
+                f"fresh-engine exposition missing zero-valued QoS "
+                f"series {name!r} (metrics key {k!r})")
+    # v4 snapshot: per-class queue depths (the weighted-fair / shed
+    # inputs) are REQUIRED, and the slo block carries the per-class
+    # queue-violation split the autoscaler scales on
+    if "queue_depths" not in SNAPSHOT_REQUIRED_KEYS:
+        failures.append(
+            "SNAPSHOT_REQUIRED_KEYS lost 'queue_depths' — the v4 "
+            "per-class backlog signal")
+    snap = eng.telemetry_snapshot()
+    qd = snap.get("queue_depths")
+    if qd is None or set(qd) != set(QOS_CLASSES):
+        failures.append(
+            f"snapshot queue_depths keys {sorted(qd or ())} != "
+            f"QOS_CLASSES {sorted(QOS_CLASSES)}")
+    by_cls = (snap.get("slo") or {}).get("violated_queue_by_class")
+    if by_cls is None or set(by_cls) != set(QOS_CLASSES):
+        failures.append(
+            f"snapshot slo.violated_queue_by_class keys "
+            f"{sorted(by_cls or ())} != QOS_CLASSES "
+            f"{sorted(QOS_CLASSES)} — the autoscaler scales on "
+            "['high'] and the shed path reads the split")
+    for blk in ("requests", ):
+        r = snap.get(blk) or {}
+        for key in ("preempted", "resumed"):
+            if key not in r:
+                failures.append(
+                    f"snapshot {blk!r} block lost {key!r} — the "
+                    "preemption accounting the drill gates read")
 
 
 def _check_snapshot_schema(failures, eng):
