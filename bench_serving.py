@@ -130,12 +130,38 @@ measurably degraded past it, and zero retraces after warmup (every
 QoS decision is pure host data). Knobs: BENCH_QOS_LOAD (default 2.0),
 BENCH_QOS_SLO_X.
 
+--disagg runs the DISAGGREGATED prefill/decode A/B: the SAME
+fixed-seed long/short Poisson mix (the interference regime: long
+prompt prefills stall co-resident decodes) on (a) one MIXED engine
+and (b) a role-split cluster — one PREFILL replica + one DECODE
+replica at EQUAL total slots — with streamed KV handoff
+(handoff_blocks=1: committed prompt blocks ship while the prefill
+tail runs). Reported per side: delivered tokens/s, arrival-anchored
+TTFT p50/p99, decode ITL p50/p99 (inter-token gap once the stream
+started — the interference metric disaggregation exists to fix), the
+SLO verdict split, handoff/transfer counters, prefill accounting.
+Exits non-zero unless: exact greedy token parity per request vs the
+mixed run, zero drops/orphans/failovers, every session actually
+handed off, ZERO prompt recompute (the decode engine computed no
+prefill tokens AND cluster-wide computed+saved == submitted prompt
+tokens — needs the prefix pool configured), zero retraces after
+warmup on BOTH roles, and decode ITL p99 <= BENCH_DISAGG_ITL_X x the mixed run's
+(default 4.0: the single-process driver serializes the two engines,
+so a decode gap can carry a prefill pump the roles would overlap on
+real split hardware — decode ITL p50 runs at parity and the p99
+ratio is recorded for trending; the gate trips on gross regression,
+e.g. a handoff stalling the decode loop). Knobs: BENCH_DISAGG_ITL_X,
+BENCH_DISAGG_HANDOFF_BLOCKS (1), BENCH_CHUNKED_LONG (long-prompt
+fraction, 0.4 here), BENCH_SLOTS (per-role slot count; mixed gets
+2x).
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
 "chunked_prefill", the cluster record under "cluster", the mesh
-record under "mesh_serving", the QoS overload record under "qos";
-each mode preserves the others' records).
+record under "mesh_serving", the QoS overload record under "qos",
+the disaggregated A/B under "disagg"; each mode preserves the
+others' records).
 """
 from __future__ import annotations
 
@@ -234,7 +260,8 @@ def _collect(eng, sub, arrivals):
 
 
 _SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
-                "chunked_prefill", "cluster", "mesh_serving", "qos")
+                "chunked_prefill", "cluster", "mesh_serving", "qos",
+                "disagg")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -370,6 +397,8 @@ def main(argv=None):
         return main_mesh()
     if "--qos" in argv:
         return main_qos()
+    if "--disagg" in argv:
+        return main_disagg()
     from bench import _init_devices
     jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
@@ -2474,6 +2503,266 @@ def main_qos():
         print("bench_serving: RETRACES AFTER WARMUP during the QoS "
               "drill — class churn and park/resume must be pure host "
               "data", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def main_disagg():
+    """Disaggregated prefill/decode A/B (see the module docstring):
+    the SAME fixed-seed long/short Poisson arrivals on one MIXED
+    engine vs a PREFILL+DECODE role-split cluster at equal total
+    slots, streamed KV handoff on. Both sides run router-driven on
+    their own virtual clock so TTFT/ITL are arrival-anchored and the
+    handoff machinery itself (export, staged stream, import, adopt)
+    is inside the measured window. Gates (exit 1): exact greedy
+    parity per request, zero drops/orphans/failovers, every session
+    handed off exactly once, zero prompt recompute, zero retraces
+    after warmup on every engine, decode ITL p99 within
+    BENCH_DISAGG_ITL_X of mixed."""
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.serving_cluster import LocalReplica, Router
+
+    slots = int(os.environ.get("BENCH_SLOTS", "4" if on_tpu else "2"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    cap_ = int(os.environ.get("BENCH_PAGED_CAP", "16"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(12 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.0"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    long_frac = float(os.environ.get("BENCH_CHUNKED_LONG", "0.4"))
+    # decode ITL p99 tolerance vs mixed. NOT 1.0: this harness pumps
+    # both engines from ONE thread, so a decode token gap can carry a
+    # whole prefill-chunk pump from the other engine — time real
+    # role-split hardware overlaps. Measured here: p50 at parity,
+    # p99 ~2.8x from exactly those serialization points. The gate is
+    # a gross-regression tripwire (a handoff stalling decode shows up
+    # as 10x+); the recorded ratio is what to trend
+    itl_x = float(os.environ.get("BENCH_DISAGG_ITL_X", "4.0"))
+    hb = int(os.environ.get("BENCH_DISAGG_HANDOFF_BLOCKS", "1"))
+    # prefix pool sized to hold every live session's blocks twice
+    # over: the pool is what makes prefill_tokens_computed/saved
+    # accounting (the zero-recompute gate) and streamed staging real
+    pool_blocks = 4 * slots * max(smax // cap_, 1)
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
+    rng = np.random.RandomState(seed)
+
+    # warmup covers the prefill buckets both the short and long arms
+    # of the workload hit, plus workload-shaped waves that exercise
+    # hold/export on the prefill engine and import/decode on the
+    # decode engine (driven THROUGH the router below, so the handoff
+    # executables compile before the retrace gate arms)
+    bucket_reqs = [(rng.randint(1, V, (p,)).astype("int32"), 4)
+                   for p in (8, 16, 32, 64, 128, 160)]
+    warm_reqs = _make_longprompt_workload(rng, 4 * slots, V, smax,
+                                          long_frac)
+    meas_reqs = _make_longprompt_workload(rng, n_meas, V, smax,
+                                          long_frac)
+    total_prompt = sum(int(p.size) for p, _ in meas_reqs)
+
+    def _env_f(name):
+        v = os.environ.get(name)
+        return float(v) if v not in (None, "") else None
+    slo_ttft = _env_f("BENCH_SLO_TTFT_S")
+    slo_itl = _env_f("BENCH_SLO_ITL_S")
+    slo_e2e = _env_f("BENCH_SLO_E2E_S")
+
+    def mk_engine(clock, role, ns):
+        return ServingEngine(fmt, embed, head, num_slots=ns,
+                             max_seq_len=smax, decode_chunk=chunk,
+                             prefill_cap=cap_, paged=True,
+                             prefix_cache_blocks=pool_blocks,
+                             role=role, clock=clock.now)
+
+    def run_side(disagg, arrivals=None):
+        clock = VirtualClock()
+        if disagg:
+            engs = [mk_engine(clock, "prefill", slots),
+                    mk_engine(clock, "decode", slots)]
+            names = ("prefill0", "decode0")
+        else:
+            engs = [mk_engine(clock, "mixed", 2 * slots)]
+            names = ("mixed0",)
+        reps = [LocalReplica(n, e, threaded=False, clock=clock.now)
+                for n, e in zip(names, engs)]
+        router = Router(reps, snap_max_age_s=0.0, clock=clock.now,
+                        handoff_blocks=(hb if disagg else None))
+        warm = bucket_reqs + warm_reqs
+        _drive_cluster(router, reps, clock, warm,
+                       np.zeros(len(warm)) + clock.now())
+        for e in engs:
+            e.reset_metrics(keep_results=False)
+        # capacity probe on the warm wave (the mixed side's estimate
+        # sets the shared arrival process)
+        t0 = clock.now()
+        _drive_cluster(router, reps, clock, warm_reqs,
+                       np.zeros(len(warm_reqs)) + clock.now())
+        cap = sum(e.metrics()["tokens_emitted"] for e in engs) \
+            / max(clock.now() - t0, 1e-9)
+        traces0 = [e.metrics()["traces"] for e in engs]
+        handoffs0 = router.handoffs_total
+        for e in engs:
+            e.reset_metrics(keep_results=False)
+
+        if arrivals is None:
+            mean_new = float(np.mean([m for _, m in meas_reqs]))
+            rate = load * cap / mean_new
+            arr_rng = np.random.RandomState(seed + 1)
+            arrivals = np.cumsum(
+                arr_rng.exponential(1.0 / rate, size=n_meas))
+        arr = arrivals + clock.now()
+        t0 = clock.now()
+        recs, _ = _drive_cluster(router, reps, clock, meas_reqs, arr)
+        elapsed = clock.now() - t0
+
+        toks = sum(len(r["toks"]) for r in recs.values())
+        got, ttft, itl, slo_ok = {}, [], [], 0
+        unfinished = 0
+        for r in recs.values():
+            got[r["idx"]] = r["toks"]
+            if r["t_first"] is None or r["t_done"] is None:
+                unfinished += 1
+                continue
+            t_arr = arr[r["idx"]]
+            tf = r["t_first"] - t_arr
+            e2e = r["t_done"] - t_arr
+            ttft.append(tf)
+            gap = ((r["t_done"] - r["t_first"])
+                   / max(len(r["toks"]) - 1, 1))
+            if len(r["toks"]) > 1:
+                itl.append(gap)
+            ok = ((slo_ttft is None or tf <= slo_ttft)
+                  and (slo_itl is None or gap <= slo_itl)
+                  and (slo_e2e is None or e2e <= slo_e2e))
+            slo_ok += int(ok)
+
+        def pctl(v, q):
+            return round(1e3 * float(np.percentile(v, q)), 2) \
+                if v else None
+        side = {
+            "roles": {n: e.role for n, e in zip(names, engs)},
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "capacity_tokens_per_sec": round(cap, 2),
+            "ttft_p50_ms": pctl(ttft, 50), "ttft_p99_ms": pctl(ttft, 99),
+            "itl_p50_ms": pctl(itl, 50), "itl_p99_ms": pctl(itl, 99),
+            "slo": {"ok": slo_ok, "violated": len(recs) - slo_ok},
+            "retraces_after_warmup": sum(
+                e.metrics()["traces"] - t for e, t in zip(engs, traces0)),
+            "elapsed_s": round(elapsed, 3),
+        }
+        info = {"engs": engs, "router": router, "recs": recs,
+                "got": got, "unfinished": unfinished,
+                "handoffs": router.handoffs_total - handoffs0,
+                "itl": itl}
+        return side, info, arrivals
+
+    side_m, info_m, arrivals = run_side(False)
+    side_d, info_d, _ = run_side(True, arrivals)
+
+    eng_p, eng_d = info_d["engs"]
+    mp, md = eng_p.metrics(), eng_d.metrics()
+    mm = info_m["engs"][0].metrics()
+    itl_ratio = ((side_d["itl_p99_ms"] or 0.0)
+                 / max(side_m["itl_p99_ms"] or 0.0, 1e-9))
+
+    record = {
+        "metric": "serving_disagg_decode_itl_p99_over_mixed_x",
+        "value": round(itl_ratio, 3),
+        "unit": "x mixed decode ITL p99 (gate: <= itl_x)",
+        "itl_x": itl_x, "offered_load": load,
+        "handoff_blocks": hb, "long_frac": long_frac,
+        "requests": n_meas,
+        "slots": {"mixed": 2 * slots, "prefill": slots,
+                  "decode": slots},
+        "mixed": side_m, "disagg": side_d,
+        "handoffs": info_d["handoffs"],
+        "failovers": info_d["router"].failovers_total,
+        "migration_aborts": info_d["router"].migration_aborts_total,
+        "kv_blocks_shipped": mp["kv_blocks_shipped"],
+        "kv_blocks_adopted": md["kv_blocks_adopted"],
+        "prefill_tokens": {
+            "submitted": total_prompt,
+            "computed_prefill": mp["prefill_tokens_computed"],
+            "saved_prefill": mp["prefill_tokens_saved"],
+            "computed_decode": md["prefill_tokens_computed"],
+            "computed_mixed": mm["prefill_tokens_computed"],
+            "saved_mixed": mm["prefill_tokens_saved"],
+        },
+        "num_slots": 2 * slots, "max_seq": smax, "block_tokens": cap_,
+        "layers": L, "hidden": E, "vocab": V, "seed": seed,
+        "device": str(dev),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "disagg", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+
+    rc = 0
+    parity_bad = sum(1 for i in range(n_meas)
+                     if info_d["got"].get(i) != info_m["got"].get(i))
+    if parity_bad or len(info_d["got"]) != n_meas \
+            or len(info_m["got"]) != n_meas:
+        print(f"bench_serving: DISAGG PARITY BROKE — {parity_bad} "
+              f"request(s) diverged from the mixed run "
+              f"(disagg={len(info_d['got'])}/{n_meas} mixed="
+              f"{len(info_m['got'])}/{n_meas} finished); a KV handoff "
+              "corrupted or dropped a stream", file=sys.stderr)
+        rc = 1
+    if info_d["unfinished"] or info_m["unfinished"]:
+        print(f"bench_serving: ADMITTED WORK WAS DROPPED — "
+              f"{info_d['unfinished']} disagg / "
+              f"{info_m['unfinished']} mixed session(s) never "
+              "finished", file=sys.stderr)
+        rc = 1
+    if info_d["handoffs"] != n_meas \
+            or record["failovers"] or record["migration_aborts"]:
+        print(f"bench_serving: HANDOFF ACCOUNTING OFF — "
+              f"{info_d['handoffs']}/{n_meas} handoffs, "
+              f"{record['failovers']} failover(s), "
+              f"{record['migration_aborts']} abort(s); every session "
+              "must ship prefill->decode exactly once, no replays",
+              file=sys.stderr)
+        rc = 1
+    pt = record["prefill_tokens"]
+    if md["prefill_tokens_computed"] != 0 \
+            or pt["computed_prefill"] + pt["saved_prefill"] \
+            != total_prompt \
+            or mp["kv_blocks_shipped"] != md["kv_blocks_adopted"] \
+            or not mp["kv_blocks_shipped"]:
+        print(f"bench_serving: PROMPT RECOMPUTE ON THE DECODE TIER — "
+              f"decode computed {pt['computed_decode']} prefill "
+              f"tokens, prefill computed+saved "
+              f"{pt['computed_prefill']}+{pt['saved_prefill']} of "
+              f"{total_prompt} submitted, shipped/adopted "
+              f"{mp['kv_blocks_shipped']}/{md['kv_blocks_adopted']}; "
+              "the KV wire must carry every prompt block exactly once",
+              file=sys.stderr)
+        rc = 1
+    if side_d["retraces_after_warmup"] or side_m["retraces_after_warmup"]:
+        print(f"bench_serving: RETRACES AFTER WARMUP — "
+              f"disagg {side_d['retraces_after_warmup']}, mixed "
+              f"{side_m['retraces_after_warmup']}; role split and "
+              "streamed handoff must be pure host-side data movement",
+              file=sys.stderr)
+        rc = 1
+    if side_d["itl_p99_ms"] is not None and side_m["itl_p99_ms"] \
+            and itl_ratio > itl_x:
+        print(f"bench_serving: DECODE ITL REGRESSED — disagg p99 "
+              f"{side_d['itl_p99_ms']}ms > {itl_x}x mixed p99 "
+              f"{side_m['itl_p99_ms']}ms; isolating decode from "
+              "prefill interference is the point of the split",
+              file=sys.stderr)
         rc = 1
     return rc
 

@@ -244,10 +244,28 @@ class ServingEngine:
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
                  paged=None, kv_pool=None, kv_pool_blocks=None,
                  token_budget=None, flat_budget=None,
-                 telemetry_ring=None, slo=None):
+                 telemetry_ring=None, slo=None, role=None):
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
                                 use_rotary=use_rotary)
         self.num_slots = int(num_slots)
+        # disaggregated serving role (PADDLE_ROLE): "mixed" (default)
+        # is today's behavior — prefill and decode share this engine.
+        # "prefill" runs prompt processing only: a slot whose prompt
+        # completes (first token sampled) is HELD as state "prefilled"
+        # (active=False, KV + slot resident) until the cluster router
+        # ships it to a decode replica via export_slot/import_slot —
+        # the DistServe/Splitwise split that keeps long prompts from
+        # stalling decode inter-token latency. "decode" engines run
+        # normally (role enforcement is placement-side: the router
+        # never routes fresh prompts at them); their import path is
+        # the handoff landing zone.
+        role = (role if role is not None
+                else os.environ.get("PADDLE_ROLE", "mixed"))
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be one of ('prefill', 'decode', 'mixed'), "
+                f"got {role!r}")
+        self.role = role
         self.smax = self.dec.smax
         self.do_sample = bool(do_sample)
         self.top_k, self.top_p = top_k, top_p
@@ -575,6 +593,18 @@ class ServingEngine:
         # conftest reconciliations stay exact
         self._migrated_in = 0
         self._migrated_out = 0
+        # disaggregated-handoff counters + staging area: shipped counts
+        # every KV block serialized OFF this engine (export_slot and
+        # the streamed export_kv_prefix), adopted every block written
+        # INTO this engine's pool from a shipped payload (import_slot
+        # uploads and stage_kv_blocks). Cluster-wide, lossless handoff
+        # conserves sum(shipped) == sum(adopted); preemption-to-host
+        # serializes inline and never touches either. _staged maps a
+        # router-chosen tag -> pool block ids received AHEAD of the
+        # final export (streamed handoff overlapping the prefill tail)
+        self._kv_blocks_shipped = 0
+        self._kv_blocks_adopted = 0
+        self._staged = {}
 
         # QoS: one FIFO per class, admitted best-class-first (all-default
         # workloads collapse to the old single FIFO, token-identically);
@@ -800,9 +830,20 @@ class ServingEngine:
         else:
             admitted = self._admit()
             emitted = len(admitted)
+            # phase-mode hold runs BETWEEN admission (which already
+            # sampled the first token) and the decode chunk — a
+            # prefill worker must never spend a decode dispatch on a
+            # request that is about to ship out
+            if self.role == "prefill":
+                self._hold_prefilled()
             if self._active.any():
                 emitted += (self._spec_decode_step() if self.spec_k
                             else self._decode_one_chunk())
+        if self.role == "prefill":
+            # budget-mode hold: a slot whose prompt completed in this
+            # dispatch (first token sampled, decoding would start next
+            # step) parks as "prefilled" awaiting the KV handoff
+            self._hold_prefilled()
         # re-check AFTER the dispatch: a deadline that lapsed while the
         # step ran (or while admission waits on a head-of-line block
         # reservation) must expire now, not one full step later — a
@@ -828,6 +869,29 @@ class ServingEngine:
         while self.has_work:
             self.step()
         return self.results
+
+    def _hold_prefilled(self):
+        """Role "prefill" only: park every slot whose prompt finished
+        (first token sampled, decode would start next dispatch) as
+        state ``prefilled`` — active=False, KV blocks and slot stay
+        RESIDENT awaiting export_slot to a decode replica. The request
+        rides the streaming harvest as (tokens, done=False,
+        "prefilled"), which is the router's handoff trigger. Requests
+        that finished ON their first token (eos / max_new_tokens == 1)
+        were already completed by the dispatch harvest and never reach
+        here. A held slot drops out of ``has_work`` on purpose: the
+        prefill worker idles (or admits the next prompt into other
+        slots) while the router drives the transfer."""
+        for s in range(self.num_slots):
+            req = self._slot_req[s]
+            if (req is not None and req.state == "running"
+                    and self._active[s] and not self._pf_left[s]
+                    and self._nt[s] >= 1):
+                req.state = "prefilled"
+                self._active[s] = False
+                if self.telemetry.enabled:
+                    self.telemetry.req_event(req.rid, "prefill_hold",
+                                             self.clock())
 
     # ------------------------------------------------- streaming harvest
     def _lookup_req(self, rid):
@@ -926,6 +990,8 @@ class ServingEngine:
             "requests_expired": self._expired,
             "requests_migrated_in": self._migrated_in,
             "requests_migrated_out": self._migrated_out,
+            "kv_blocks_shipped": self._kv_blocks_shipped,
+            "kv_blocks_adopted": self._kv_blocks_adopted,
             "requests_preempted": self._preempted,
             "requests_resumed": self._resumed,
             "requests_admitted_high": self._class_admitted["high"],
@@ -980,6 +1046,8 @@ class ServingEngine:
         self._expired = 0
         self._migrated_in = 0
         self._migrated_out = 0
+        self._kv_blocks_shipped = 0
+        self._kv_blocks_adopted = 0
         self._preempted = 0
         self._resumed = 0
         self._class_admitted = {c: 0 for c in QOS_CLASSES}
@@ -1037,6 +1105,16 @@ class ServingEngine:
             # migrated_out left mid-flight with their state
             "requests_migrated_in": self._migrated_in,
             "requests_migrated_out": self._migrated_out,
+            # disaggregation surface: the engine's pool role (static
+            # config — "mixed" runs today's combined behavior) plus the
+            # KV-handoff window counters. Shipped counts blocks this
+            # engine read out for another engine (export_slot payloads
+            # + streamed export_kv_prefix chunks); adopted counts
+            # blocks written INTO this pool from another engine
+            # (import_slot payloads + stage_kv_blocks uploads).
+            "role": self.role,
+            "kv_blocks_shipped": self._kv_blocks_shipped,
+            "kv_blocks_adopted": self._kv_blocks_adopted,
             # QoS window counters: preempted running slots parked to
             # host RAM, resumed re-imported; parked is a live gauge.
             # Per-class admissions/tokens sum to the totals (all-default
@@ -1457,21 +1535,34 @@ class ServingEngine:
     # rejection RNG — the documented caveat.
     MIGRATION_FMT = "paddle-slot-v1"
 
-    def export_slot(self, rid):
-        """Detach request ``rid`` (queued or running) into a
+    def export_slot(self, rid, skip_blocks=0):
+        """Detach request ``rid`` (queued, running, or a held
+        ``prefilled`` slot on a prefill-role engine) into a
         JSON/pickle-able migration state dict and free everything it
         held here (slot, block references, reservations). The request's
         record leaves this engine as state ``migrated`` — it is neither
         finished nor expired, so no latency/SLO verdict is recorded.
-        Paged engines only (the payload IS pool blocks)."""
+        Paged engines only (the payload IS pool blocks).
+
+        ``skip_blocks`` supports the STREAMED handoff: the first N
+        blocks are assumed already staged on the importing engine
+        (export_kv_prefix -> stage_kv_blocks while prefill was still
+        running), so they are neither re-read nor re-shipped — the
+        state dict records ``kv_skip`` and import_slot splices the
+        staged blocks back in. A held ``prefilled`` slot exports with
+        ``active=True``: its first token is sampled but decode has not
+        started, and the importer must resume decoding, not
+        instant-finish at the boundary."""
         if not self.paged:
             raise ValueError("export_slot needs the paged KV cache "
                              "(the migration payload is pool blocks; "
                              "PADDLE_SERVING_PAGED=0 disables it)")
         req = self._req_index.get(rid)
-        if req is None or req.state not in ("queued", "running"):
+        if req is None or req.state not in ("queued", "running",
+                                            "prefilled"):
             raise ValueError(f"request {rid} is not live on this engine")
         now = self.clock()
+        skip_blocks = int(skip_blocks)
         state = {
             "fmt": self.MIGRATION_FMT,
             "prompt": np.asarray(req.prompt, np.int32),
@@ -1488,6 +1579,7 @@ class ServingEngine:
             "prefill_cap": self.prefill_cap,
             "lens": 0, "nt": 0, "tok": 0, "active": False,
             "pf_left": int(req.prompt.size),
+            "kv_skip": 0,
             "kv": [],
         }
         need = self._blocks_needed(req.prompt.size, req.max_new_tokens)
@@ -1498,14 +1590,32 @@ class ServingEngine:
             s = req.slot
             state.update(
                 lens=int(self._lens[s]), nt=int(self._nt[s]),
-                tok=int(self._tok[s]), active=bool(self._active[s]),
+                tok=int(self._tok[s]),
+                # a held prefilled slot was deactivated only to park it
+                # — the importer must treat it as mid-decode (there are
+                # tokens left to generate by construction: a request
+                # finishing on its first token never parks)
+                active=(bool(self._active[s])
+                        or req.state == "prefilled"),
                 pf_left=int(self._pf_left[s]))
+            if req.state == "prefilled" and req.tokens:
+                # dispatches batched AFTER the hold overwrite the
+                # per-slot sampled-token vector for inactive rows —
+                # the request's own emit history is the durable copy
+                # of the token decode resumes from
+                state["tok"] = int(req.tokens[-1])
             # KV entries written so far live in [0, lens) — the next
             # token's K/V lands at `lens` on the IMPORTING engine
             # (write-then-attend), so the partial tail block travels
             # as-is and decode resumes seamlessly
             row = self._tables[s]
-            for j in range(-(-state["lens"] // self.prefill_cap)):
+            total = -(-state["lens"] // self.prefill_cap)
+            if not 0 <= skip_blocks <= total:
+                raise ValueError(
+                    f"skip_blocks={skip_blocks} outside the request's "
+                    f"committed block count [0, {total}]")
+            state["kv_skip"] = skip_blocks
+            for j in range(skip_blocks, total):
                 state["kv"].append(
                     self.pool.read_block(self._caches, int(row[j])))
             self._kv_committed -= need
@@ -1518,12 +1628,15 @@ class ServingEngine:
         self._req_index.pop(rid, None)
         self._harvest.pop(rid, None)
         self._migrated_out += 1
+        if state["kv"]:
+            self._kv_blocks_shipped += len(state["kv"])
+            self.telemetry.observe_handoff(_kv_payload_bytes(state["kv"]))
         if self.telemetry.enabled:
             self.telemetry.req_event(rid, "migrate_out", now)
         self.telemetry.req_done(rid, "migrated", now)
         return state
 
-    def import_slot(self, state):
+    def import_slot(self, state, staged=None):
         """Resume an exported request on THIS engine: allocate fresh
         pool blocks, upload the KV bytes, restore the decode vectors,
         and rebuild the derived per-slot state (drafter, presence) from
@@ -1531,7 +1644,16 @@ class ServingEngine:
         honestly with ``AdmissionFull`` when no slot or no pool headroom
         can take it — the caller (router drain) falls back to classic
         failover. A never-prefilled export (queued, zero KV) re-enters
-        the queue instead of claiming a slot."""
+        the queue instead of claiming a slot.
+
+        ``staged`` names a stage_kv_blocks tag whose blocks arrived
+        AHEAD of this import (streamed handoff): they must cover
+        exactly the export's ``kv_skip`` leading blocks and are spliced
+        in as the slot's leading table entries — already resident, so
+        only the remainder uploads here and the import cost overlaps
+        the prefill tail instead of serializing after it. A shed import
+        leaves the staged blocks in place (the caller retries or
+        abort_stage()s them)."""
         if not self.paged:
             raise ValueError("import_slot needs the paged KV cache")
         if not isinstance(state, dict) or \
@@ -1563,11 +1685,26 @@ class ServingEngine:
                 f"request budget [0, {prompt.size} + {max_new}] — "
                 "corrupt or mismatched payload")
         blocks = state["kv"]
-        if len(blocks) != -(-lens // self.prefill_cap):
+        kv_skip = int(state.get("kv_skip", 0))
+        staged_ids = []
+        if staged is not None:
+            got = self._staged.get(staged)
+            if got is None:
+                raise ValueError(
+                    f"no staged kv blocks under tag {staged!r}")
+            staged_ids = got
+        if len(staged_ids) != kv_skip:
             raise ValueError(
-                f"migration state ships {len(blocks)} kv blocks but "
-                f"lens={lens} needs "
-                f"{-(-lens // self.prefill_cap)}")
+                f"export skips {kv_skip} leading kv blocks but "
+                f"{len(staged_ids)} are staged under "
+                f"{staged!r} — the streamed prefix must cover the skip "
+                "exactly")
+        total_blocks = -(-lens // self.prefill_cap)
+        if kv_skip + len(blocks) != total_blocks:
+            raise ValueError(
+                f"migration state ships {len(blocks)} kv blocks "
+                f"(+{kv_skip} staged) but lens={lens} needs "
+                f"{total_blocks}")
         kv_shape = self._caches["kv"].shape      # [L, 2, NB, H, Bt, D]
         want = (kv_shape[0], 2, 1, kv_shape[3], kv_shape[4], kv_shape[5])
         for blk in blocks:
@@ -1592,7 +1729,8 @@ class ServingEngine:
                             trace_id=state["trace_id"],
                             attempt=int(state["attempt"]),
                             priority=state.get("priority", QOS_DEFAULT))
-        if not blocks and not tokens and int(state["nt"]) == 0:
+        if not blocks and not staged_ids and not tokens \
+                and int(state["nt"]) == 0:
             # never prefilled: the import is a plain (re-)queue — it
             # will be ADMITTED normally later (prefix lookup included)
             if self.max_pending and self._queue_len() >= self.max_pending:
@@ -1612,6 +1750,8 @@ class ServingEngine:
                 raise AdmissionFull("kv pool exhausted — migrated "
                                     "request shed at import")
             self._kv_committed += need
+            if staged is not None:
+                self._staged.pop(staged, None)   # empty tag, consumed
             self._queues[req.priority].append(req)
             self._req_index[req.rid] = req
             self._migrated_in += 1
@@ -1629,7 +1769,12 @@ class ServingEngine:
                                             attempt=req.attempt)
             raise AdmissionFull("no free slot to import the migrated "
                                 "session into")
-        if self._kv_reserved + need > self.pool.num_blocks:
+        if self._kv_reserved - len(staged_ids) + need \
+                > self.pool.num_blocks:
+            # staged blocks already hold their own reservation (made at
+            # stage_kv_blocks) — it transfers into this request's
+            # worst-case reservation on success, so only the DELTA is
+            # checked here
             self._rejected += 1
             if self.telemetry.enabled:
                 self.telemetry.req_rejected(now, trace_id=req.trace_id,
@@ -1646,11 +1791,19 @@ class ServingEngine:
         # a stream that already emitted keeps t_first unset here (the
         # TTFT histogram legitimately sees fewer entries than finished)
         req.tokens = tokens
+        if staged_ids:
+            # consume the staged prefix: its standalone reservation
+            # folds into the request's, and the blocks become the
+            # slot's leading table entries — no re-upload
+            del self._staged[staged]
+            self._kv_reserved -= len(staged_ids)
         self._kv_committed += need
         self._kv_reserved += need
-        ids = self._alloc_kv_blocks(len(blocks)) if blocks else []
-        for blk, dst in zip(blocks, ids):
+        new_ids = self._alloc_kv_blocks(len(blocks)) if blocks else []
+        for blk, dst in zip(blocks, new_ids):
             self._caches = self.pool.write_block(self._caches, blk, dst)
+        self._kv_blocks_adopted += len(blocks)
+        ids = list(staged_ids) + list(new_ids)
         row = self._tables[s]
         row[:] = self.pool.num_blocks
         row[:len(ids)] = ids
@@ -1689,7 +1842,108 @@ class ServingEngine:
         if not self._active[s] and not self._pf_left[s] and tokens:
             # exported at the exact finish boundary: complete instantly
             self._finish(req, now)
+        elif (self.role == "prefill" and self._active[s]
+                and not self._pf_left[s] and self._nt[s] >= 1):
+            # a prompt-complete session landing on a prefill worker
+            # (handoff bounce-back after a decode-pool shed race)
+            # re-holds immediately — a prefill engine never decodes
+            req.state = "prefilled"
+            self._active[s] = False
         return req.rid
+
+    # ------------------------------------------------- streamed KV handoff
+    def export_kv_prefix(self, rid, start_block=0, min_blocks=1):
+        """Read the COMMITTED full KV blocks of a live request without
+        detaching it — the streamed-handoff source primitive. Returns
+        ``(blocks, n_full)`` where blocks covers pool block indices
+        [start_block, n_full) of the slot's table (n_full = lens //
+        prefill_cap: only FULL blocks ship early; the partial tail
+        block travels with the final export_slot). The request keeps
+        running — the router overlaps stage_kv_blocks on the decode
+        target with the remaining prefill, so the final transfer is
+        just the tail + bookkeeping and TTFT ~ prefill time. Blocks in
+        [start_block, n_full) ship exactly once per cursor advance;
+        the caller owns the cursor."""
+        if not self.paged:
+            raise ValueError("export_kv_prefix needs the paged KV cache")
+        req = self._req_index.get(rid)
+        if req is None or req.state not in ("running", "prefilled") \
+                or req.slot is None:
+            raise ValueError(f"request {rid} is not resident in a slot")
+        s = req.slot
+        n_full = int(self._lens[s]) // self.prefill_cap
+        start_block = int(start_block)
+        if not 0 <= start_block <= n_full:
+            raise ValueError(
+                f"start_block={start_block} outside [0, {n_full}]")
+        if n_full - start_block < max(1, int(min_blocks)):
+            # below the caller's chunk threshold: answer without
+            # reading so the shipped counter stays exact (every
+            # counted block left the pool exactly once per cursor)
+            return [], n_full
+        row = self._tables[s]
+        blocks = [self.pool.read_block(self._caches, int(row[j]))
+                  for j in range(start_block, n_full)]
+        if blocks:
+            self._kv_blocks_shipped += len(blocks)
+            self.telemetry.observe_handoff(_kv_payload_bytes(blocks))
+            if self.telemetry.enabled:
+                self.telemetry.req_event(rid, "kv_ship", self.clock())
+        return blocks, n_full
+
+    def stage_kv_blocks(self, tag, blocks):
+        """Receive streamed KV blocks AHEAD of their session's import:
+        allocate pool blocks (under a staging reservation — the
+        admission guarantee that every lazy mapping is satisfiable
+        must hold with staged blocks resident), upload the payloads,
+        and file the ids under ``tag`` for import_slot(staged=tag) to
+        splice in. Repeat calls append (one tag accumulates a prefix
+        block-by-block as prefill commits them). Sheds with
+        ``AdmissionFull`` when the pool cannot take the blocks — the
+        staged prefix so far stays put. Returns the total staged count
+        under the tag."""
+        if not self.paged:
+            raise ValueError("stage_kv_blocks needs the paged KV cache")
+        blocks = list(blocks)
+        kv_shape = self._caches["kv"].shape      # [L, 2, NB, H, Bt, D]
+        want = (kv_shape[0], 2, 1, kv_shape[3], kv_shape[4], kv_shape[5])
+        for blk in blocks:
+            if tuple(blk["kv"].shape) != want:
+                raise ValueError(
+                    f"staged kv block shape {tuple(blk['kv'].shape)} "
+                    f"does not match this pool's {want}")
+            if ("sc" in self._caches) != ("sc" in blk):
+                raise ValueError(
+                    "staged block cache flavor (int8 scales) does not "
+                    "match this engine's")
+        if blocks and self._kv_reserved + len(blocks) \
+                > self.pool.num_blocks:
+            raise AdmissionFull(
+                f"kv pool exhausted: staging {len(blocks)} blocks, "
+                f"{self.pool.num_blocks - self._kv_reserved} unreserved")
+        if blocks:
+            self._kv_reserved += len(blocks)
+            ids = self._alloc_kv_blocks(len(blocks))
+            for blk, dst in zip(blocks, ids):
+                self._caches = self.pool.write_block(self._caches, blk,
+                                                     dst)
+            self._kv_blocks_adopted += len(blocks)
+            self._staged.setdefault(tag, []).extend(ids)
+        elif tag not in self._staged:
+            self._staged[tag] = []
+        return len(self._staged[tag])
+
+    def abort_stage(self, tag):
+        """Drop a staging tag: free its pool blocks + reservation (the
+        handoff fell through — target raced a shed, source died, the
+        session finished on the prefill worker). Idempotent; returns
+        the number of blocks released."""
+        ids = self._staged.pop(tag, None)
+        if not ids:
+            return 0
+        self.pool.deref(ids)
+        self._kv_reserved -= len(ids)
+        return len(ids)
 
     # ----------------------------------------------------- QoS preemption
     # Preemption-to-host reuses the migration serialization (the state
@@ -3240,6 +3494,17 @@ class ServingEngine:
             # a [B, 1] placeholder keeps the compiled signature stable
             return jnp.zeros((self.num_slots, 1), bool)
         return self._presence_init()
+
+
+def _kv_payload_bytes(blocks):
+    """Wire size of a KV handoff payload: the kv tensors plus int8
+    scales when present — what a cross-host transport would move."""
+    total = 0
+    for blk in blocks:
+        total += int(blk["kv"].nbytes)
+        if "sc" in blk:
+            total += int(blk["sc"].nbytes)
+    return total
 
 
 def _penalize_slots(logits, presence, rep_pen, nt, min_len, eos_ids):

@@ -113,7 +113,12 @@ DEFAULT_QOS_SHARES = {"high": 4, "normal": 2, "low": 1}
 # block gains "violated_queue_by_class" (the autoscaler scales up on
 # HIGH-priority queue violations only; low-priority backlog is the QoS
 # layer degrading gracefully, not a capacity signal).
-SNAPSHOT_SCHEMA_VERSION = 4
+# v5: disaggregated serving — top-level "role" (prefill|decode|mixed;
+# the router's placement filter and the autoscaler's pool split) and
+# the "handoff" block (kv_blocks_shipped/adopted — the streamed
+# prefill->decode KV transfer accounting). Routers older than v5 must
+# refuse rather than place decode traffic on a prefill-only replica.
+SNAPSHOT_SCHEMA_VERSION = 5
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
@@ -121,6 +126,7 @@ SNAPSHOT_REQUIRED_KEYS = frozenset({
     "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
     "requests", "histograms", "budget", "prefix", "spans_logged",
     "steps_logged", "telemetry_ring", "slo", "queue_depths",
+    "role", "handoff",
 })
 
 # keys present only on some configurations (paged pool / spec decode)
@@ -384,6 +390,10 @@ class Telemetry:
         # like the other histograms these stay on with the ring off
         self.hist_queue = LogHistogram(1e-6, 1e4)
         self.hist_service = LogHistogram(1e-6, 1e4)
+        # disaggregated-serving KV transfer sizes (bytes per handoff
+        # payload: export_slot kv + streamed export_kv_prefix chunks) —
+        # stays on with the ring off like the latency histograms
+        self.hist_handoff = LogHistogram(64.0, 1e9)
 
     # ------------------------------------------------------- request spans
     def req_queued(self, rid, t, trace_id=None, attempt=1):
@@ -469,6 +479,9 @@ class Telemetry:
     def observe_step_tokens(self, n):
         self.hist_step_tokens.observe(n)
 
+    def observe_handoff(self, nbytes):
+        self.hist_handoff.observe(nbytes)
+
     def reset(self):
         """Window reset (rides ``engine.reset_metrics``): clears the
         rings so the next export covers exactly the measured window,
@@ -481,6 +494,7 @@ class Telemetry:
         self.hist_step_tokens.reset()
         self.hist_queue.reset()
         self.hist_service.reset()
+        self.hist_handoff.reset()
 
 
 # -------------------------------------------------------- runtime registry
@@ -580,6 +594,14 @@ PROMETHEUS_NAMES = {
         "paddle_serving_requests_migrated_in_total", "counter"),
     "requests_migrated_out": (
         "paddle_serving_requests_migrated_out_total", "counter"),
+    # disaggregated KV handoff: blocks this engine read out for another
+    # engine (export_slot / streamed export_kv_prefix) vs blocks
+    # written into this pool from another engine (import_slot /
+    # stage_kv_blocks) — the prefill->decode transfer volume
+    "kv_blocks_shipped": ("paddle_serving_kv_blocks_shipped_total",
+                          "counter"),
+    "kv_blocks_adopted": ("paddle_serving_kv_blocks_adopted_total",
+                          "counter"),
     # QoS preemption-to-host: preempted left their slot for the host-RAM
     # parking lot (same rid, stream intact), resumed re-entered a slot;
     # preempted >= resumed always (the difference is currently parked)
@@ -678,8 +700,9 @@ PROMETHEUS_NAMES = {
 }
 
 # metrics() keys with no scalar Prometheus twin (nested dicts whose
-# fields are exported under their own names below)
-PROMETHEUS_EXEMPT_KEYS = {"prefix_store"}
+# fields are exported under their own names below; "role" is a string
+# — it exports as the labeled info gauge paddle_serving_role{role=..})
+PROMETHEUS_EXEMPT_KEYS = {"prefix_store", "role"}
 
 # metrics() keys reset_metrics legitimately does NOT restore to a fresh
 # engine's values: the trace spy (documented: never reset, it IS the
@@ -746,6 +769,19 @@ def render_prometheus(engine):
     lines.extend(tele.hist_service.prometheus_lines(
         "paddle_serving_service_time_seconds",
         "per-request service time (admitted -> finished), seconds"))
+    lines.extend(tele.hist_handoff.prometheus_lines(
+        "paddle_serving_handoff_bytes",
+        "KV handoff payload size per transfer (kv + scales), bytes"))
+    role = m.get("role")
+    if role is not None:
+        # info-style gauge: the role is a string, so it rides as a
+        # label with a constant value of 1 (the Prometheus idiom for
+        # enum state)
+        name = "paddle_serving_role"
+        lines.append(f"# HELP {name} replica role "
+                     "(prefill|decode|mixed), exported as a label")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f'{name}{{role="{role}"}} 1')
     if engine.pool is not None:
         g = engine.pool.gauges()
         name = "paddle_serving_kv_blocks_used_peak"
@@ -855,12 +891,26 @@ def snapshot(engine):
                     "utilization")},
         "prefix": {"hits": m["prefix_hits"], "misses": m["prefix_misses"],
                    "hit_rate": m["prefix_hit_rate"]},
+        # v5: disaggregation — the router's placement filter (role) and
+        # the KV transfer accounting the bench's zero-recompute gate
+        # reconciles across the prefill/decode pools
+        "role": m["role"],
+        "handoff": {"kv_blocks_shipped": m["kv_blocks_shipped"],
+                    "kv_blocks_adopted": m["kv_blocks_adopted"]},
         "spans_logged": len(tele.spans),
         "steps_logged": len(tele.steps),
         "telemetry_ring": tele.ring,
     }
     if engine.pool is not None:
-        out["kv_blocks"] = engine.pool.gauges()
+        g = dict(engine.pool.gauges())
+        # worst-case ADMISSION headroom (total minus running
+        # reservations), not residency: import_slot sheds against the
+        # reservation ledger, so a router deciding whether a decode
+        # target can take a handoff must read this — kv_blocks_free
+        # can be ample while every free block is already spoken for
+        g["kv_blocks_unreserved"] = (engine.pool.num_blocks
+                                     - engine._kv_reserved)
+        out["kv_blocks"] = g
     if engine._drafters is not None:
         out["drafter"] = {
             "propose_calls": sum(d.propose_calls

@@ -40,7 +40,7 @@ import sys
 import time
 
 
-def _build_engine(seed, slots, smax, prefix_blocks, cap):
+def _build_engine(seed, slots, smax, prefix_blocks, cap, role="mixed"):
     import paddle_tpu as paddle
     from paddle_tpu.incubate.nn import FusedMultiTransformer
     from paddle_tpu.inference.serving import ServingEngine
@@ -53,9 +53,50 @@ def _build_engine(seed, slots, smax, prefix_blocks, cap):
                                 normalize_before=True)
     head = Linear(E, V, bias_attr=False)
     fmt.eval()
-    return ServingEngine(fmt, embed, head, num_slots=slots,
-                         max_seq_len=smax, prefill_cap=cap,
-                         prefix_cache_blocks=prefix_blocks)
+    kw = dict(num_slots=slots, max_seq_len=smax, prefill_cap=cap,
+              prefix_cache_blocks=prefix_blocks, role=role)
+    if role == "prefill":
+        # prompt-crunching shape: few slots, one wide flat token
+        # budget — the whole batch is prefill chunks, decode never
+        # competes for the budget on this engine
+        kw.update(num_slots=max(2, slots // 2), flat_budget=True,
+                  token_budget=4 * cap, decode_chunk=1)
+    elif role == "decode":
+        # token-pump shape: deep slot count, small per-step budget —
+        # many resident sessions, short steps, low inter-token jitter
+        kw.update(num_slots=2 * slots, token_budget=2 * slots)
+    return ServingEngine(fmt, embed, head, **kw)
+
+
+def _parse_roles(spec):
+    """'prefill:1,decode:2' -> ["prefill", "decode", "decode"]. The
+    pool must be able to both place prompts and decode them: at least
+    one prefill-capable AND one decode-capable entry."""
+    roles = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, cnt = part.partition(":")
+        name = name.strip()
+        if name not in ("prefill", "decode", "mixed"):
+            raise SystemExit(
+                f"--roles: unknown role {name!r} (want prefill, "
+                "decode, or mixed)")
+        try:
+            n = int(cnt)
+        except ValueError:
+            raise SystemExit(f"--roles: bad count in {part!r}")
+        if n < 1:
+            raise SystemExit(f"--roles: count must be >= 1 in {part!r}")
+        roles.extend([name] * n)
+    if not any(r in ("prefill", "mixed") for r in roles):
+        raise SystemExit("--roles: no prefill-capable replica — "
+                         "prompts would have nowhere to land")
+    if not any(r in ("decode", "mixed") for r in roles):
+        raise SystemExit("--roles: no decode-capable replica — "
+                         "prefilled sessions would have nowhere to go")
+    return roles
 
 
 def _worker_main(args):
@@ -84,7 +125,8 @@ def _worker_main(args):
             f"worker {rank}: rpc rendezvous never came up: {last!r}")
     init_serving_mesh()       # PADDLE_SERVING_MESH_MP; unset = no mesh
     eng = _build_engine(0, args.slots, args.max_seq_len,
-                        args.prefix_blocks, args.prefill_cap)
+                        args.prefix_blocks, args.prefill_cap,
+                        role=args.role)
     serve_engine(eng, name=f"replica{rank}", threaded=True)
     print(f"serving_cluster: worker {rank} serving", flush=True)
     try:
@@ -97,9 +139,11 @@ def _worker_main(args):
     return 0
 
 
-def _spawn_workers(args, master):
+def _spawn_workers(args, master, role_list=None):
     """Spawn the worker gang with workerlog capture; a mid-loop spawn
-    failure reaps the already-started ranks (launch discipline)."""
+    failure reaps the already-started ranks (launch discipline).
+    ``role_list`` (from --roles) assigns rank r its role by position —
+    the worker builds its engine with the matching per-role shape."""
     import subprocess
 
     from paddle_tpu.distributed.launch.__main__ import _reap_gang
@@ -112,6 +156,8 @@ def _spawn_workers(args, master):
             env["PADDLE_MASTER"] = master
             if args.mesh_mp > 1:
                 env["PADDLE_SERVING_MESH_MP"] = str(args.mesh_mp)
+            role = (role_list[rank - 1] if role_list is not None
+                    else "mixed")
             logf = open(os.path.join(
                 args.log_dir, f"workerlog.serving.{rank}"), "a")
             logs.append(logf)
@@ -122,7 +168,8 @@ def _spawn_workers(args, master):
                  "--slots", str(args.slots),
                  "--max-seq-len", str(args.max_seq_len),
                  "--prefill-cap", str(args.prefill_cap),
-                 "--prefix-blocks", str(args.prefix_blocks)],
+                 "--prefix-blocks", str(args.prefix_blocks),
+                 "--role", role],
                 env=env, stdout=logf, stderr=subprocess.STDOUT)
             p._pd_rank = rank
             procs.append(p)
@@ -180,9 +227,18 @@ def main(argv=None):
              "mp-way mesh (0/1 = no mesh)")
     ap.add_argument("--log-dir", default="log",
                     help="worker gang log directory (workerlog.serving.N)")
+    ap.add_argument("--roles", default=os.environ.get(
+        "PADDLE_GATEWAY_ROLES", ""),
+        help="disaggregated pool spec 'prefill:1,decode:2' — builds "
+             "role-specialized replicas (prefill: flat-budget wide; "
+             "decode: deep slots) instead of --replicas mixed ones; "
+             "with --workers the spec also sets the worker count")
     ap.add_argument("--worker-rank", type=int, default=0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--role", default="mixed",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    role_list = _parse_roles(args.roles) if args.roles else None
 
     # the mesh needs devices before the first jax import (CPU hosts:
     # forced host devices — same lever as bench_serving --mesh)
@@ -208,15 +264,18 @@ def main(argv=None):
 
         from .replica import RpcReplica
 
+        if role_list is not None:
+            args.workers = len(role_list)
         master = f"127.0.0.1:{_free_port()}"
-        procs, logs = _spawn_workers(args, master)
+        procs, logs = _spawn_workers(args, master, role_list)
         # rank 0 hosts the store; init blocks until the gang registers
         rpc.init_rpc("cluster_gateway", rank=0,
                      world_size=args.workers + 1, master_endpoint=master)
         replicas = [RpcReplica(f"cluster_worker{r}")
                     for r in range(1, args.workers + 1)]
         _wait_ready(replicas)
-        n_label = f"{args.workers} worker processes"
+        n_label = (f"{args.workers} worker processes ({args.roles})"
+                   if role_list else f"{args.workers} worker processes")
     else:
         from paddle_tpu.parallel import init_serving_mesh
 
@@ -225,13 +284,15 @@ def main(argv=None):
             init_serving_mesh(args.mesh_mp)
         # every replica serves the SAME weights (seed-shared toy model)
         # so routing is invisible to outputs — the production contract
+        roles = role_list or ["mixed"] * args.replicas
         replicas = [
-            LocalReplica(f"replica{i}",
+            LocalReplica(f"{role}{i}" if role_list else f"replica{i}",
                          _build_engine(0, args.slots, args.max_seq_len,
                                        args.prefix_blocks,
-                                       args.prefill_cap))
-            for i in range(args.replicas)]
-        n_label = f"{args.replicas} replicas"
+                                       args.prefill_cap, role=role))
+            for i, role in enumerate(roles)]
+        n_label = (f"{len(roles)} replicas ({args.roles})"
+                   if role_list else f"{args.replicas} replicas")
 
     router = Router(replicas, policy=args.policy)
     gw = Gateway(router, port=args.port).start_background()

@@ -103,7 +103,11 @@ HEALTHZ_FIELDS = ("status", "replicas_alive", "replicas_total")
 SCALE_FIELDS = ("replicas_alive", "replicas_total", "draining",
                 "migrations_total", "migration_aborts_total",
                 "scale_events_up", "scale_events_down", "autoscaler",
-                "min_replicas", "max_replicas")
+                "min_replicas", "max_replicas",
+                # disaggregated serving: alive replicas per role
+                # ({"prefill": n, "decode": n, "mixed": n}) and the
+                # completed prefill->decode KV handoff count
+                "roles", "handoffs_total")
 # "expired": a deadline_s stream whose remaining budget lapsed during
 # the drain itself — terminal, but the operator must see it in the
 # drain accounting (migrated+failed_over+orphaned+expired covers every

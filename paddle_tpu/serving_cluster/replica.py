@@ -48,6 +48,10 @@ class LocalReplica:
                  idle_wait_s=0.002, step_hook=None):
         self.name = name
         self.engine = engine
+        # pool role for the router's placement filter (prefill workers
+        # never take decode-resident sessions and vice versa); engines
+        # predating the role knob read as mixed = place anywhere
+        self.role = getattr(engine, "role", "mixed")
         self._lock = threading.RLock()
         self._clock = clock or time.monotonic
         self._hb = self._clock()
@@ -152,25 +156,52 @@ class LocalReplica:
         with self._lock:
             self.engine.release(rid)
 
-    def export_slot(self, rid):
+    def export_slot(self, rid, skip_blocks=0):
         """Live-migration export: detach one request's full decode
         state (engine.export_slot) under the replica lock, so the
-        driver thread can never interleave a step mid-export."""
+        driver thread can never interleave a step mid-export.
+        ``skip_blocks`` elides KV blocks the target already staged
+        (streamed handoff)."""
         self._check_alive()
         with self._lock:
-            return self.engine.export_slot(rid)
+            return self.engine.export_slot(rid, skip_blocks=skip_blocks)
 
-    def import_slot(self, state):
+    def import_slot(self, state, staged=None):
         """Live-migration import: resume an exported session here.
         Tracks the new rid under the SAME lock hold, exactly like
         submit — the streaming cursor exists before the driver can
-        finish the request."""
+        finish the request. ``staged`` names a stage_kv_blocks tag
+        whose blocks splice in as the session's leading KV."""
         self._check_alive()
         with self._lock:
-            rid = self.engine.import_slot(state)
+            rid = self.engine.import_slot(state, staged=staged)
             self.engine.track(rid)
         self._wake.set()
         return rid
+
+    def export_kv_prefix(self, rid, start_block=0, min_blocks=1):
+        """Streamed-handoff source: read a live request's committed
+        full KV blocks from ``start_block`` on, WITHOUT detaching it
+        (engine.export_kv_prefix). Returns (blocks, cursor)."""
+        self._check_alive()
+        with self._lock:
+            return self.engine.export_kv_prefix(
+                rid, start_block=start_block, min_blocks=min_blocks)
+
+    def stage_kv_blocks(self, tag, blocks):
+        """Streamed-handoff sink: land KV blocks ahead of their
+        session's import under ``tag`` (engine.stage_kv_blocks;
+        AdmissionFull = backpressure, the stream stays put)."""
+        self._check_alive()
+        with self._lock:
+            return self.engine.stage_kv_blocks(tag, blocks)
+
+    def abort_stage(self, tag):
+        """Release a staging tag's blocks (handoff fell through)."""
+        if not self.alive:
+            return 0                      # nothing to free on a corpse
+        with self._lock:
+            return self.engine.abort_stage(tag)
 
     def snapshot(self):
         self._check_alive()
@@ -237,12 +268,29 @@ def _rw_release(rid):
     return _served().release(rid)
 
 
-def _rw_export_slot(rid):
-    return _served().export_slot(rid)
+def _rw_export_slot(rid, skip_blocks=0):
+    return _served().export_slot(rid, skip_blocks=skip_blocks)
 
 
-def _rw_import_slot(state):
-    return _served().import_slot(state)
+def _rw_import_slot(state, staged=None):
+    return _served().import_slot(state, staged=staged)
+
+
+def _rw_export_kv_prefix(rid, start_block, min_blocks=1):
+    return _served().export_kv_prefix(rid, start_block=start_block,
+                                      min_blocks=min_blocks)
+
+
+def _rw_stage_kv_blocks(tag, blocks):
+    return _served().stage_kv_blocks(tag, blocks)
+
+
+def _rw_abort_stage(tag):
+    return _served().abort_stage(tag)
+
+
+def _rw_role():
+    return _served().role
 
 
 def _rw_snapshot():
@@ -278,6 +326,17 @@ class RpcReplica:
             else os.environ.get("PADDLE_GATEWAY_HB_TIMEOUT_S", "2"))
         self._dead = False
         self._hb = time.monotonic()
+        self._role = None                 # fetched lazily, then cached
+
+    @property
+    def role(self):
+        """The worker's pool role — fetched once over rpc (it is
+        engine-construction-time config and cannot change), cached for
+        every later placement read."""
+        if self._role is None:
+            self._role = str(self._call(_rw_role,
+                                        timeout=self._ping_timeout))
+        return self._role
 
     def _call(self, fn, *args, timeout=None):
         if self._dead:
@@ -334,17 +393,30 @@ class RpcReplica:
         except ReplicaError:
             return None                   # nothing to free on a corpse
 
-    def export_slot(self, rid):
+    def export_slot(self, rid, skip_blocks=0):
         """Migration export over rpc: the KV block bytes ride the
         pickle channel (a dead/unreachable worker surfaces as
         ReplicaError — the router's abort-to-failover trigger)."""
-        return self._call(_rw_export_slot, rid)
+        return self._call(_rw_export_slot, rid, skip_blocks)
 
-    def import_slot(self, state):
+    def import_slot(self, state, staged=None):
         """Migration import over rpc; AdmissionFull pickles through
         intact (a full target is backpressure, not death — the drain
         tries the next candidate)."""
-        return self._call(_rw_import_slot, state)
+        return self._call(_rw_import_slot, state, staged)
+
+    def export_kv_prefix(self, rid, start_block=0, min_blocks=1):
+        return self._call(_rw_export_kv_prefix, rid, start_block,
+                          min_blocks)
+
+    def stage_kv_blocks(self, tag, blocks):
+        return self._call(_rw_stage_kv_blocks, tag, blocks)
+
+    def abort_stage(self, tag):
+        try:
+            return self._call(_rw_abort_stage, tag)
+        except ReplicaError:
+            return 0                      # nothing to free on a corpse
 
     def snapshot(self):
         # the routing payload is tiny and polled at heartbeat cadence:

@@ -159,7 +159,8 @@ class _Assignment:
     __slots__ = ("gid", "request_id", "prompt", "kw", "replica", "rid",
                  "tokens", "skip", "done", "state", "resubmits",
                  "t_submit", "orphaned", "failed", "dup_returns",
-                 "trace_id")
+                 "trace_id", "ho_target", "ho_tag", "ho_blocks",
+                 "ho_busy")
 
     def __init__(self, gid, request_id, prompt, kw, replica, rid,
                  t_submit, trace_id=None):
@@ -179,6 +180,14 @@ class _Assignment:
         self.orphaned = False
         self.failed = None                # placement exception, if any
         self.dup_returns = 0              # idempotent-retry handouts
+        # streamed prefill->decode handoff state: the decode replica
+        # holding this session's staged KV prefix, the staging tag it
+        # filed under, and the block cursor (how many leading blocks
+        # are already over there — export_slot skips exactly these)
+        self.ho_target = None
+        self.ho_tag = None
+        self.ho_blocks = 0
+        self.ho_busy = False              # one streaming ship at a time
 
 
 class Router:
@@ -201,10 +210,23 @@ class Router:
 
     def __init__(self, replicas, policy=None, spill_depth=None,
                  hb_dead_s=None, snap_max_age_s=None, clock=None,
-                 audit_ring=None):
+                 audit_ring=None, handoff_blocks=None):
         self.replicas = {r.name: r for r in replicas}
         if len(self.replicas) != len(replicas):
             raise ValueError("replica names must be unique")
+        # pool roles, read once at registration (engine-construction
+        # config, immutable): prefill workers take fresh prompts only,
+        # decode workers take handed-off resident sessions only, mixed
+        # (the default everywhere) takes both — today's behavior
+        self.roles = {n: str(getattr(r, "role", "mixed"))
+                      for n, r in self.replicas.items()}
+        # streamed-handoff chunk: ship a prefilling session's committed
+        # KV to its decode target once this many NEW full blocks exist
+        # (0 = ship only at prompt completion, no mid-prefill overlap)
+        self._handoff_blocks = int(
+            handoff_blocks if handoff_blocks is not None
+            else os.environ.get("PADDLE_ROLE_HANDOFF_BLOCKS", "0"))
+        self.handoffs_total = 0
         self.policy = policy or os.environ.get("PADDLE_ROUTER_POLICY",
                                                "prefix_affinity")
         if self.policy not in POLICIES:
@@ -406,6 +428,34 @@ class Router:
                                           / kv["kv_blocks_total"])
         return score
 
+    @staticmethod
+    def decode_load_score(snap):
+        """Decode-pool placement score: resident sessions + pool
+        residency, NO queue term — decode workers take handed-off
+        sessions straight into slots, so backlogged prompts (a prefill
+        signal) must not repel a decode target whose slots and pool
+        are actually free."""
+        if snap is None:
+            return float("inf")
+        score = snap["num_slots"] - snap["slots_free"]
+        kv = snap.get("kv_blocks")
+        if kv and kv["kv_blocks_total"]:
+            score += snap["num_slots"] * (kv["kv_blocks_used"]
+                                          / kv["kv_blocks_total"])
+        return score
+
+    # ------------------------------------------------------------ roles
+    def prefill_capable(self, name):
+        """Can ``name`` run a prompt from scratch? Fresh submits and
+        failover replays (both re-prefill) may only land here."""
+        return self.roles.get(name, "mixed") in ("prefill", "mixed")
+
+    def decode_capable(self, name):
+        """Can ``name`` decode a resident session? Handoffs and
+        mid-decode migrations may only land here — never on a
+        prefill-only worker (it would hold the session forever)."""
+        return self.roles.get(name, "mixed") in ("decode", "mixed")
+
     # -------------------------------------------------------- placement
     def _least_loaded(self, names):
         return min(names, key=lambda n: (self.load_score(self._snap(n)),
@@ -551,8 +601,13 @@ class Router:
         shed = False
         while True:
             with self._lock:
+                # fresh submits and failover replays both run the
+                # prompt from scratch — decode-only workers are never
+                # candidates (satellite bugfix: a prefill drain must
+                # re-route in-flight prompts to prefill-capable
+                # replicas, not strand them on a decode pool)
                 names = [n for n in self.placeable_names()
-                         if n not in tried]
+                         if n not in tried and self.prefill_capable(n)]
                 if names:
                     name, reason = self._choose(prompt, names)
                     # the per-candidate score dict exists only for the
@@ -565,7 +620,8 @@ class Router:
             if name is None:
                 if last_full is not None:
                     raise last_full
-                raise NoReplicaError("no alive replica to place on")
+                raise NoReplicaError(
+                    "no alive prefill-capable replica to place on")
             tried.add(name)
             try:
                 rid = self.replicas[name].submit(
@@ -643,7 +699,26 @@ class Router:
             asg.tokens.extend(new)
             if done:
                 asg.done, asg.state = True, state
-            return list(asg.tokens[base:]), done, state
+            out = (list(asg.tokens[base:]), done, state)
+            # disaggregation hook: this poll is the handoff driver. A
+            # session a prefill worker HOLDS (state "prefilled":
+            # prompt complete, first token sampled, decode parked)
+            # moves to a decode worker now; a still-prefilling session
+            # streams its committed KV blocks ahead when the chunk
+            # knob is on — the import overlaps the prefill tail.
+            src_role = self.roles.get(epoch[0], "mixed")
+            handoff = (None if done or src_role != "prefill"
+                       else "full" if state == "prefilled"
+                       else "stream" if (state == "running"
+                                         and self._handoff_blocks > 0)
+                       else None)
+        if done:
+            self._drop_stage(asg)
+        elif handoff == "full":
+            self._handoff_one(asg)
+        elif handoff == "stream":
+            self._handoff_stream(asg)
+        return out
 
     @_locked
     def poll(self, gid):
@@ -683,6 +758,8 @@ class Router:
                 rep = self.replicas.get(asg.replica)
         if rep is not None:
             rep.release(asg.rid)
+        if asg.ho_tag is not None:
+            self._drop_stage(asg)
 
     # ----------------------------------------------------------- health
     def check_health(self):
@@ -738,6 +815,10 @@ class Router:
         while-draining assignment (client disconnect racing the drain)
         gets its stray replacement submission released instead of
         leaking a tracked engine record forever."""
+        if asg.ho_tag is not None:
+            # the replayed prompt re-prefills from scratch — a staged
+            # prefix from the dead leg is garbage on its target
+            self._drop_stage(asg)
         kw = dict(asg.kw)
         if kw.get("deadline_s") is not None:
             remaining = kw["deadline_s"] - (self.clock()
@@ -775,6 +856,288 @@ class Router:
         if stray is not None:
             stray.release(rid)
 
+    # -------------------------------------------- disaggregated handoff
+    def _drop_stage(self, asg):
+        """Abort any streamed-KV prefix parked on a decode target for
+        ``asg`` (the session finished, was released, or failed over
+        before the handoff consumed it). Staged blocks hold pool
+        reservation on the target and would leak forever otherwise.
+        Best-effort: a dead target already freed them with its pool."""
+        with self._lock:
+            tgt, tag = asg.ho_target, asg.ho_tag
+            asg.ho_target = asg.ho_tag = None
+            asg.ho_blocks = 0
+            rep = self.replicas.get(tgt) if tag is not None else None
+        if rep is not None:
+            try:
+                rep.abort_stage(tag)
+            except Exception:
+                pass                      # corpse cleanup is moot
+
+    @staticmethod
+    def _import_headroom_ok(snap, plen, max_new, staged_blocks=0):
+        """Would ``import_slot`` on the replica behind ``snap`` admit a
+        session of this shape right now? Mirrors the engine's own shed
+        gates: a free slot, plus worst-case pool blocks against the
+        RESERVATION ledger (``kv_blocks_unreserved``), not residency —
+        every free block can already be spoken for by running
+        sessions' growth budgets. ``staged_blocks`` already hold their
+        own reservation on the target, which transfers into the
+        imported session's, so they count toward the need. No
+        snapshot (or an old one missing the gauge) reads optimistic:
+        the import's own AdmissionFull shed stays the safety net."""
+        if snap is None:
+            return True
+        if snap.get("slots_free", 1) < 1:
+            return False
+        unres = (snap.get("kv_blocks") or {}).get("kv_blocks_unreserved")
+        if unres is None:
+            return True
+        bt = int(snap.get("prefill_cap", 1)) or 1
+        need = -(-(int(plen) + int(max_new)) // bt)
+        return unres + staged_blocks >= need
+
+    def _handoff_one(self, asg):
+        """Ship one HELD session (engine state "prefilled": prompt
+        complete, first token sampled, decode parked) from its prefill
+        worker to a decode worker — the disaggregation transfer. With
+        a streamed prefix already staged on a decode target
+        (``ho_tag`` set), the export skips those blocks and the import
+        splices them in: the remaining transfer is just the partial
+        tail block plus bookkeeping, so TTFT tracks prefill time
+        rather than prefill + full KV copy. No decode capacity RIGHT
+        NOW is not an error — the session stays parked on the prefill
+        worker (bounced back if the export already happened) and the
+        next harvest poll retries: "held" is backpressure, not
+        failure. Returns "handed_off" | "held" | "skipped" |
+        "failed_over" | "orphaned" | "expired"."""
+        # snapshot freshness IS the export/bounce economy here: a slot
+        # another handoff filled microseconds ago must read as taken,
+        # or this session pays a full KV export + re-import bounce (or
+        # worse, a prompt replay when a staged prefix pins the target).
+        # refresh() throttles itself to snap_max_age_s, so the steady
+        # state costs nothing extra
+        self.refresh()
+        with self._lock:
+            if asg.done or asg.orphaned or asg.replica is None \
+                    or asg.rid is None or asg.ho_busy:
+                return "skipped"
+            src_name, rid = asg.replica, asg.rid
+            asg.ho_busy = True
+            names = self.placeable_names()
+            tgt0, tag, cursor = asg.ho_target, asg.ho_tag, asg.ho_blocks
+            dead_stage = None
+            if tag is not None and (tgt0 not in names
+                                    or not self.decode_capable(tgt0)):
+                # the staged prefix's target is gone: forget the stage
+                # and export the full payload from block 0 instead
+                dead_stage = (tgt0, tag)
+                asg.ho_target = asg.ho_tag = None
+                asg.ho_blocks = 0
+                tgt0, tag, cursor = None, None, 0
+            max_new = int(asg.kw.get("max_new_tokens", 20))
+            plen = len(asg.prompt)
+            if tag is not None:
+                # a partially-staged session can ONLY land where its
+                # prefix lives (import validates staged == kv_skip);
+                # it must be admittable BEFORE the export: once
+                # export_slot runs, the source slot is gone and a shed
+                # import can only fall back to a prompt replay
+                targets = [tgt0] if self._import_headroom_ok(
+                    self._snap(tgt0), plen, max_new,
+                    staged_blocks=cursor) else []
+            else:
+                # unstaged sessions can go anywhere decode-capable,
+                # but exporting toward a full target just buys a
+                # bounce (export + re-import on the source, twice the
+                # KV traffic for nothing) — screen on the same
+                # headroom the import gates on
+                targets = sorted(
+                    (n for n in names
+                     if n != src_name and self.decode_capable(n)
+                     and self._import_headroom_ok(
+                         self._snap(n), plen, max_new)),
+                    key=lambda n: (self.decode_load_score(
+                        self._snap(n)), n))
+        try:
+            if dead_stage is not None and \
+                    dead_stage[0] in self.replicas:
+                try:
+                    self.replicas[dead_stage[0]].abort_stage(
+                        dead_stage[1])
+                except Exception:
+                    pass
+            if not targets:
+                return "held"
+            with self._lock:
+                if asg.done or asg.orphaned \
+                        or (asg.replica, asg.rid) != (src_name, rid):
+                    return "skipped"
+                # detach: a concurrent harvest discards its batch on
+                # the epoch mismatch, exactly like migration/failover
+                asg.replica, asg.rid = None, None
+            attempt = asg.resubmits + 2
+            src = self.replicas[src_name]
+            tgt_name = rid2 = None
+            try:
+                state = src.export_slot(rid, skip_blocks=cursor)
+                if asg.kw.get("deadline_s") is not None:
+                    remaining = asg.kw["deadline_s"] - (self.clock()
+                                                        - asg.t_submit)
+                    if remaining <= 0:
+                        with self._lock:
+                            asg.done, asg.state = True, "expired"
+                        return "expired"
+                    state["deadline_s"] = remaining
+                state["attempt"] = attempt
+            except Exception:
+                with self._lock:
+                    self.migration_aborts_total += 1
+                    stuck = not asg.done and not asg.orphaned
+                if stuck:
+                    self._failover_one(asg)
+                with self._lock:
+                    return ("orphaned" if asg.orphaned else
+                            "expired" if asg.state == "expired" else
+                            "failed_over")
+            for cand in targets:
+                try:
+                    rid2 = self.replicas[cand].import_slot(
+                        state, staged=(tag if cand == tgt0 else None))
+                except (AdmissionFull, ReplicaError, KeyError):
+                    continue
+                tgt_name = cand
+                break
+            if tgt_name is None and cursor == 0:
+                # nowhere to decode RIGHT NOW: bounce the full payload
+                # back onto the prefill worker — the engine re-holds
+                # it ("prefilled") and the next poll retries
+                try:
+                    rid2 = src.import_slot(state)
+                    tgt_name = src_name
+                except Exception:
+                    pass
+            if tgt_name is None:
+                # the payload is off every engine (and a skipped
+                # prefix, if any, lives only on a target that just
+                # refused it) — honest fallback: drop the stage and
+                # replay from the prompt
+                if tag is not None and tgt0 in self.replicas:
+                    try:
+                        self.replicas[tgt0].abort_stage(tag)
+                    except Exception:
+                        pass
+                with self._lock:
+                    asg.ho_target = asg.ho_tag = None
+                    asg.ho_blocks = 0
+                    self.migration_aborts_total += 1
+                    stuck = not asg.done and not asg.orphaned
+                if stuck:
+                    self._failover_one(asg)
+                with self._lock:
+                    return ("orphaned" if asg.orphaned else
+                            "expired" if asg.state == "expired" else
+                            "failed_over")
+            with self._lock:
+                if asg.gid in self._table and not asg.done:
+                    asg.skip = len(asg.tokens)
+                    asg.replica, asg.rid = tgt_name, rid2
+                    stray = None
+                    if tgt_name != src_name:
+                        asg.resubmits += 1
+                        self.handoffs_total += 1
+                        asg.ho_target = asg.ho_tag = None
+                        asg.ho_blocks = 0
+                else:                     # released/finished meanwhile
+                    stray = self.replicas.get(tgt_name)
+            if stray is not None:
+                stray.release(rid2)
+                return "skipped"
+            if tgt_name == src_name:
+                return "held"
+            self._record_decision(asg, tgt_name, "migrated", {},
+                                  attempt)
+            return "handed_off"
+        finally:
+            with self._lock:
+                asg.ho_busy = False
+
+    def _handoff_stream(self, asg):
+        """Stream the COMMITTED full KV blocks of a still-prefilling
+        session on a prefill worker ahead to a decode target
+        (``stage_kv_blocks``): the transfer overlaps the prefill tail,
+        so by the time the prompt completes and ``_handoff_one`` runs,
+        only the partial tail block is left to move. The cursor
+        (``asg.ho_blocks``) advances only after a successful stage —
+        a shed (AdmissionFull) just re-reads the same span on the
+        next poll (reads are idempotent). No decode target, or fewer
+        than ``handoff_blocks`` new committed blocks, is a silent
+        no-op."""
+        with self._lock:
+            if asg.done or asg.orphaned or asg.replica is None \
+                    or asg.rid is None or asg.ho_busy:
+                return
+            src_name, rid = asg.replica, asg.rid
+            asg.ho_busy = True
+            names = self.placeable_names()
+            tgt0, tag, cursor = asg.ho_target, asg.ho_tag, asg.ho_blocks
+            if tag is not None and (tgt0 not in names
+                                    or not self.decode_capable(tgt0)):
+                # stage target died/drained (its pool freed the
+                # blocks with it): restart streaming from scratch
+                asg.ho_target = asg.ho_tag = None
+                asg.ho_blocks = 0
+                tgt0, tag, cursor = None, None, 0
+            if tag is None:
+                cands = sorted(
+                    (n for n in names
+                     if n != src_name and self.decode_capable(n)),
+                    key=lambda n: (self.decode_load_score(
+                        self._snap(n)), n))
+                if not cands:
+                    asg.ho_busy = False
+                    return
+                tgt0 = cands[0]
+                # resubmits in the tag: a failover between streams
+                # must not collide with a stale stage under the
+                # same gid on the same target
+                tag = ("ho", asg.gid, asg.resubmits)
+                cursor = 0
+        try:
+            try:
+                blocks, _n_full = self.replicas[src_name] \
+                    .export_kv_prefix(rid, start_block=cursor,
+                                      min_blocks=self._handoff_blocks)
+            except (ValueError, KeyError, ReplicaError):
+                return
+            if not blocks:
+                return                    # below the chunk threshold
+            try:
+                self.replicas[tgt0].stage_kv_blocks(tag, blocks)
+            except AdmissionFull:
+                return                    # target pool full — retry;
+                                          # cursor does NOT advance
+            except (ReplicaError, KeyError):
+                with self._lock:
+                    asg.ho_target = asg.ho_tag = None
+                    asg.ho_blocks = 0
+                return
+            raced = False
+            with self._lock:
+                if asg.done or asg.orphaned:
+                    raced = True
+                else:
+                    asg.ho_target, asg.ho_tag = tgt0, tag
+                    asg.ho_blocks = cursor + len(blocks)
+            if raced and tgt0 in self.replicas:
+                try:
+                    self.replicas[tgt0].abort_stage(tag)
+                except Exception:
+                    pass
+        finally:
+            with self._lock:
+                asg.ho_busy = False
+
     # ------------------------------------------------- elastic scaling
     def _record_scale(self, direction, name):
         """One scale event in the decision audit (reason scale_up /
@@ -807,6 +1170,7 @@ class Router:
             self.dead.discard(name)
             self.draining.discard(name)
             self.replicas[name] = replica
+            self.roles[name] = str(getattr(replica, "role", "mixed"))
             self._snaps.pop(name, None)
             self.ring.add(name)
         self._record_scale("up", name)
@@ -862,6 +1226,7 @@ class Router:
             self.draining.discard(name)
             self.dead.discard(name)
             self.replicas.pop(name, None)
+            self.roles.pop(name, None)
         try:
             src.close()
         except Exception:
@@ -888,6 +1253,11 @@ class Router:
                     or asg.rid is None:
                 return "skipped"
             rid = asg.rid
+        if asg.ho_tag is not None:
+            # a drain-migration exports the FULL payload (skip 0) —
+            # any streamed prefix staged for the handoff path is
+            # stale the moment the session moves
+            self._drop_stage(asg)
         # final harvest first: a request that FINISHED on the engine but
         # was not yet collected needs its tokens drained, not a
         # migration (exporting it would fail and the fallback would
@@ -935,10 +1305,26 @@ class Router:
                     return "expired"
                 state["deadline_s"] = remaining
             state["attempt"] = attempt
+            # role check (pinned by the drain test): a session that
+            # still owes prefill work may only land prefill-capable —
+            # a decode-only replica would starve it forever. A
+            # prompt-complete session goes to the decode pool, scored
+            # by resident-session pressure (no queue term).
+            need_prefill = (int(state.get("pf_left", 0)) > 0
+                            or int(state.get("nt", 0)) == 0)
             with self._lock:
-                order = sorted(
-                    (n for n in self.placeable_names() if n != src_name),
-                    key=lambda n: (self.load_score(self._snap(n)), n))
+                if need_prefill:
+                    order = sorted(
+                        (n for n in self.placeable_names()
+                         if n != src_name and self.prefill_capable(n)),
+                        key=lambda n: (self.load_score(self._snap(n)),
+                                       n))
+                else:
+                    order = sorted(
+                        (n for n in self.placeable_names()
+                         if n != src_name and self.decode_capable(n)),
+                        key=lambda n: (self.decode_load_score(
+                            self._snap(n)), n))
             last_full = None
             for cand in order:
                 try:
@@ -980,13 +1366,18 @@ class Router:
         """The /admin/scale payload's router half (the gateway folds in
         the autoscaler's bounds)."""
         with self._lock:
+            roles = {"prefill": 0, "decode": 0, "mixed": 0}
+            for n in self.alive_names():
+                roles[self.roles.get(n, "mixed")] += 1
             return {"replicas_alive": len(self.alive_names()),
                     "replicas_total": len(self.replicas),
                     "draining": sorted(self.draining),
                     "migrations_total": self.migrations_total,
                     "migration_aborts_total": self.migration_aborts_total,
                     "scale_events_up": self.scale_events["up"],
-                    "scale_events_down": self.scale_events["down"]}
+                    "scale_events_down": self.scale_events["down"],
+                    "roles": roles,
+                    "handoffs_total": self.handoffs_total}
 
     # ------------------------------------------------------- aggregation
     def metrics_prometheus(self):
@@ -1069,6 +1460,9 @@ class Router:
                 ("paddle_gateway_migration_aborts_total", "counter",
                  self.migration_aborts_total,
                  "migrations aborted mid-transfer -> classic failover"),
+                ("paddle_gateway_handoffs_total", "counter",
+                 self.handoffs_total,
+                 "prefill->decode KV handoffs completed (disagg)"),
                 ("paddle_gateway_snapshot_version_mismatches_total",
                  "counter", self.version_mismatches,
                  "snapshots refused for schema_version drift"))

@@ -39,18 +39,29 @@ GW_ENV_VARS = (
     # watermarks silently change when every later cluster spawns or
     # drains replicas — same guard discipline as the router knobs
     "PADDLE_AUTOSCALE_COOLDOWN_S",  # seconds between scale events
+    # disaggregated per-pool watermarks (autoscale.py role_aware mode):
+    # the prefill pool scales on queue depth, the decode pool on kv
+    # headroom + resident-session depth — leaked values silently split
+    # every later cluster's scaling behavior by role
+    "PADDLE_AUTOSCALE_DC_KV_FREE_FRAC",   # decode pool-free frac -> up
+    "PADDLE_AUTOSCALE_DC_SESSIONS_HIGH",  # decode session frac -> up
+    "PADDLE_AUTOSCALE_DC_SESSIONS_LOW",   # decode session frac -> down
     "PADDLE_AUTOSCALE_HYSTERESIS",  # consecutive agreeing ticks needed
     "PADDLE_AUTOSCALE_KV_FREE_FRAC",  # pool-free fraction -> scale up
     "PADDLE_AUTOSCALE_MAX",        # replica-count ceiling
     "PADDLE_AUTOSCALE_MIN",        # replica-count floor
+    "PADDLE_AUTOSCALE_PF_QUEUE_HIGH",  # prefill queue depth -> up
+    "PADDLE_AUTOSCALE_PF_QUEUE_LOW",   # prefill queue depth -> down
     "PADDLE_AUTOSCALE_QUEUE_HIGH",  # mean queue depth -> scale up
     "PADDLE_AUTOSCALE_QUEUE_LOW",  # mean queue depth -> scale down
+    "PADDLE_AUTOSCALE_ROLE_AWARE",  # per-pool scaling on/off
     "PADDLE_GATEWAY_HB_DEAD_S",    # heartbeat age -> replica dead
     "PADDLE_GATEWAY_HB_S",         # gateway health-sweep interval
     "PADDLE_GATEWAY_HB_TIMEOUT_S",  # rpc replica liveness-probe timeout
     "PADDLE_GATEWAY_POLL_S",       # SSE harvest poll interval
     "PADDLE_GATEWAY_PORT",         # gateway listen port (0 = ephemeral)
     "PADDLE_GATEWAY_REPLICAS",     # demo-cluster replica count
+    "PADDLE_GATEWAY_ROLES",        # demo-cluster pool spec "prefill:1,..."
     "PADDLE_GATEWAY_TRACE_RING",   # HTTP span ring size (0 = off)
     # QoS / multi-tenant knobs (inference/serving.py weighted-fair
     # shares; serving_cluster/gateway.py shed + tenant buckets): a
@@ -58,6 +69,11 @@ GW_ENV_VARS = (
     # engine's packing and the gateway's 429 behavior
     "PADDLE_QOS_SHARES",           # per-class budget shares "high=4,..."
     "PADDLE_QOS_SHED_DEPTH",       # mean queue depth -> shed low class
+    # disaggregated serving roles (inference/serving.py role= and
+    # serving_cluster/router.py streamed handoff): a leaked role turns
+    # every later engine into a prefill-only worker
+    "PADDLE_ROLE",                 # engine role prefill|decode|mixed
+    "PADDLE_ROLE_HANDOFF_BLOCKS",  # streamed-handoff chunk (0 = off)
     "PADDLE_ROUTER_AUDIT_RING",    # decision ring (0 = ring off;
                                    # reason counters stay)
     "PADDLE_ROUTER_POLICY",        # prefix_affinity|least_loaded|round_robin

@@ -123,6 +123,15 @@ def check_serving_metrics(eng):
     if getattr(eng, "pool", None) is None:
         assert m["requests_migrated_in"] == 0
         assert m["requests_migrated_out"] == 0
+    # disaggregated-handoff counters are paged-only the same way (the
+    # shipped payload IS pool blocks), and the role label is always one
+    # of the three placement classes
+    assert m["kv_blocks_shipped"] >= 0
+    assert m["kv_blocks_adopted"] >= 0
+    assert m["role"] in ("prefill", "decode", "mixed")
+    if getattr(eng, "pool", None) is None:
+        assert m["kv_blocks_shipped"] == 0
+        assert m["kv_blocks_adopted"] == 0
     if getattr(eng, "prefix_cache", None) is not None:
         assert m["prefix_hits"] + m["prefix_misses"] == \
             m["requests_admitted"], (

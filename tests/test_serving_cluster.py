@@ -772,6 +772,344 @@ class TestClusterE2E:
 
 
 # =====================================================================
+# disaggregated prefill/decode serving (role-specialized replicas)
+# =====================================================================
+def _drive_cluster(router, reps, gids):
+    """Pump every unthreaded replica and harvest every stream until all
+    finish (bounded). Returns {gid: [tokens]}."""
+    outs = {g: [] for g in gids}
+    done = {g: False for g in gids}
+    deadline = time.monotonic() + WAIT_S
+    while not all(done.values()):
+        assert time.monotonic() < deadline, "disagg drive stalled"
+        for r in reps:
+            r.pump()
+        for g in gids:
+            if not done[g]:
+                new, d, _ = router.harvest(g, len(outs[g]))
+                outs[g].extend(new)
+                done[g] = d
+    return outs
+
+
+class TestDisaggServing:
+    """Role-split cluster (prefill workers hold prompt-complete
+    sessions; the router ships their KV to decode workers) vs the SAME
+    arrivals on a mixed single-engine baseline: token parity, zero
+    prompt recompute, streamed mid-prefill handoff, backpressure
+    bounce-back on a tight decode pool, zero retraces after warmup."""
+
+    def _prompts(self, seed, n):
+        rng = np.random.RandomState(seed)
+        return [[int(t) for t in rng.randint(1, V, (int(ln),))]
+                for ln in rng.randint(6, 15, (n,))]
+
+    def _mixed_baseline(self, fmt, embed, head, prompts, max_new=6,
+                        **ekw):
+        eng = _engine(fmt, embed, head, num_slots=4,
+                      prefix_cache_blocks=32, **ekw)
+        rep = LocalReplica("m0", eng, threaded=False)
+        rt = Router([rep], snap_max_age_s=0.0)
+        paddle.seed(1234)                 # per-request sampler seeds
+        gids = [rt.submit(p, max_new_tokens=max_new) for p in prompts]
+        outs = _drive_cluster(rt, [rep], gids)
+        return eng, [outs[g] for g in gids]
+
+    def _disagg_cluster(self, fmt, embed, head, handoff_blocks=None,
+                        dc_kw=None, **ekw):
+        eng_p = _engine(fmt, embed, head, role="prefill", num_slots=2,
+                        prefix_cache_blocks=32, **ekw)
+        dkw = dict(num_slots=4, prefix_cache_blocks=32, **ekw)
+        dkw.update(dc_kw or {})
+        eng_d = _engine(fmt, embed, head, role="decode", **dkw)
+        reps = [LocalReplica("pf0", eng_p, threaded=False),
+                LocalReplica("dc0", eng_d, threaded=False)]
+        rt = Router(reps, snap_max_age_s=0.0,
+                    handoff_blocks=handoff_blocks)
+        return eng_p, eng_d, reps, rt
+
+    def test_greedy_parity_and_zero_recompute(self):
+        fmt, embed, head = _model()
+        prompts = self._prompts(21, 6)
+        eng_m, want = self._mixed_baseline(fmt, embed, head, prompts)
+        eng_p, eng_d, reps, rt = self._disagg_cluster(fmt, embed, head)
+        paddle.seed(1234)
+        gids = [rt.submit(p, max_new_tokens=6) for p in prompts]
+        outs = _drive_cluster(rt, reps, gids)
+        assert [outs[g] for g in gids] == want
+        # every session prefilled on pf0, decoded on dc0 — one handoff
+        # each, no failover/replay anywhere
+        assert rt.handoffs_total == len(prompts)
+        assert rt.failovers_total == 0
+        assert rt.migration_aborts_total == 0
+        # ZERO prompt recompute: the decode engine never ran a prefill
+        # (its sessions all arrived prompt-complete over the KV wire),
+        # and the prefill side computed exactly what the mixed
+        # baseline did for the same arrivals
+        mp, md = eng_p.metrics(), eng_d.metrics()
+        assert md["prefill_tokens_computed"] == 0
+        assert mp["prefill_tokens_computed"] == \
+            eng_m.metrics()["prefill_tokens_computed"]
+        # the transfer counters reconcile across the wire
+        assert mp["kv_blocks_shipped"] == md["kv_blocks_adopted"] > 0
+
+    def test_sampled_parity_across_handoff(self):
+        """Sampler state (per-request seed + counter) rides the export:
+        a sampled stream is identical whether it decodes in place or on
+        the other side of a KV handoff."""
+        fmt, embed, head = _model()
+        prompts = self._prompts(22, 4)
+        samp = dict(do_sample=True, top_k=12, top_p=0.9,
+                    temperature=0.8)
+        eng_m, want = self._mixed_baseline(fmt, embed, head, prompts,
+                                           **samp)
+        eng_p, eng_d, reps, rt = self._disagg_cluster(fmt, embed, head,
+                                                      **samp)
+        paddle.seed(1234)                 # same seed draw order
+        gids = [rt.submit(p, max_new_tokens=6) for p in prompts]
+        outs = _drive_cluster(rt, reps, gids)
+        assert [outs[g] for g in gids] == want
+        assert rt.handoffs_total == len(prompts)
+        assert eng_d.metrics()["prefill_tokens_computed"] == 0
+
+    def test_streamed_handoff_ships_mid_prefill(self):
+        """handoff_blocks=1: committed prompt blocks stream to the
+        decode target WHILE the prefill tail is still running — the
+        shipped counter moves before the request produces a token."""
+        fmt, embed, head = _model()
+        long_prompt = [int(t) for t in
+                       np.random.RandomState(9).randint(1, V, (40,))]
+        eng_m, want = self._mixed_baseline(fmt, embed, head,
+                                           [long_prompt], max_new=6)
+        eng_p, eng_d, reps, rt = self._disagg_cluster(
+            fmt, embed, head, handoff_blocks=1)
+        gid = rt.submit(long_prompt, max_new_tokens=6)
+        got, shipped_mid = [], 0
+        deadline = time.monotonic() + WAIT_S
+        done = False
+        while not done:
+            assert time.monotonic() < deadline
+            for r in reps:
+                r.pump()
+            new, done, _ = rt.harvest(gid, len(got))
+            got.extend(new)
+            if not got and not done:
+                # still prefilling (prefill_cap=8 chunks a 40-token
+                # prompt): record the transfer progress so far
+                shipped_mid = max(shipped_mid,
+                                  eng_p.metrics()["kv_blocks_shipped"])
+        assert shipped_mid > 0, \
+            "no KV block left the prefill worker before the first token"
+        assert got == want[0]
+        assert rt.handoffs_total == 1 and rt.failovers_total == 0
+        assert eng_d.metrics()["prefill_tokens_computed"] == 0
+        # staged prefix + final handoff moved every block exactly once
+        assert eng_p.metrics()["kv_blocks_shipped"] == \
+            eng_d.metrics()["kv_blocks_adopted"]
+
+    def test_tight_decode_pool_backpressure_then_parity(self):
+        """A decode pool too small for the offered load: handoffs
+        bounce back ('held' = backpressure, not failure) and retry as
+        sessions retire — everything still finishes with exact parity
+        and zero drops/replays."""
+        fmt, embed, head = _model()
+        prompts = self._prompts(23, 6)
+        eng_m, want = self._mixed_baseline(fmt, embed, head, prompts)
+        # decode: 2 slots, pool sized to ~2 resident sessions
+        eng_p, eng_d, reps, rt = self._disagg_cluster(
+            fmt, embed, head, dc_kw=dict(num_slots=2,
+                                         prefix_cache_blocks=8))
+        paddle.seed(1234)
+        gids = [rt.submit(p, max_new_tokens=6) for p in prompts]
+        outs = _drive_cluster(rt, reps, gids)
+        assert [outs[g] for g in gids] == want
+        assert rt.handoffs_total == len(prompts)
+        assert rt.failovers_total == 0
+        assert eng_d.metrics()["prefill_tokens_computed"] == 0
+
+    def test_zero_retraces_after_warmup_both_roles(self):
+        """After one warmup wave compiled both roles' executables
+        (prefill chunks + export on pf0, import + decode on dc0),
+        steady-state disagg traffic traces NOTHING new on either."""
+        fmt, embed, head = _model()
+        eng_p, eng_d, reps, rt = self._disagg_cluster(fmt, embed, head)
+        rng = np.random.RandomState(31)
+
+        def wave(n):
+            gids = [rt.submit([int(t) for t in rng.randint(1, V, (10,))],
+                              max_new_tokens=5) for _ in range(n)]
+            _drive_cluster(rt, reps, gids)
+
+        wave(3)                            # warmup: compile everything
+        traces = [eng_p.metrics()["traces"], eng_d.metrics()["traces"]]
+        wave(6)
+        assert [eng_p.metrics()["traces"],
+                eng_d.metrics()["traces"]] == traces
+        assert rt.handoffs_total == 9
+
+    def test_prefill_drain_routes_by_remaining_work(self):
+        """THE drain-role contract: draining a PREFILL replica sends a
+        session that still owes prefill work to another prefill-capable
+        replica (a decode-only target would starve it), while a
+        prompt-complete held session drains to the decode pool."""
+        fmt, embed, head = _model()
+        kw = dict(prefix_cache_blocks=32)
+        reps = [LocalReplica("pf0", _engine(fmt, embed, head,
+                                            role="prefill", **kw),
+                             threaded=False),
+                LocalReplica("pf1", _engine(fmt, embed, head,
+                                            role="prefill", **kw),
+                             threaded=False),
+                LocalReplica("dc0", _engine(fmt, embed, head,
+                                            role="decode", **kw),
+                             threaded=False)]
+        rt = Router(reps, snap_max_age_s=0.0)
+        prompt = [int(t) for t in
+                  np.random.RandomState(4).randint(1, V, (12,))]
+        want = _oracle(fmt, embed, head, prompt, 8)
+        gid = rt.submit(prompt, max_new_tokens=8)
+        first = rt._table[gid].replica
+        assert first in ("pf0", "pf1")     # placement is role-aware too
+        # (a) un-prefilled (queued) session: drain must land it on the
+        # OTHER prefill replica, never the decode-only one
+        summary = rt.remove_replica(first, migrate=True)
+        assert summary["migrated"] == 1
+        second = rt._table[gid].replica
+        assert second == ({"pf0", "pf1"} - {first}).pop()
+        # (b) run the prompt to completion on the prefill engine: it
+        # HOLDS the session; draining now must land it decode-side
+        srep = rt.replicas[second]
+        deadline = time.monotonic() + WAIT_S
+        while srep.engine.has_work:
+            assert time.monotonic() < deadline
+            srep.pump()
+        summary = rt.remove_replica(second, migrate=True)
+        assert summary["migrated"] == 1
+        assert rt._table[gid].replica == "dc0"
+        got, done = [], False
+        while not done:
+            assert time.monotonic() < deadline
+            reps[2].pump()
+            new, done, state = rt.harvest(gid, len(got))
+            got.extend(new)
+        assert got == want and state == "finished"
+        assert rt.failovers_total == 0     # drains, not replays
+
+
+# =====================================================================
+# role-aware autoscaler: per-pool watermarks
+# =====================================================================
+class TestRoleAutoscaler:
+    def _scaler(self, router=None, spawn=None, **kw):
+        from paddle_tpu.serving_cluster.autoscale import Autoscaler
+        kw.setdefault("role_aware", True)
+        kw.setdefault("pf_queue_high", 4.0)
+        kw.setdefault("pf_queue_low", 1.0)
+        kw.setdefault("dc_kv_free_low", 0.2)
+        kw.setdefault("dc_sessions_high", 0.8)
+        kw.setdefault("dc_sessions_low", 0.3)
+        kw.setdefault("max_replicas", 8)
+        return Autoscaler(router if router is not None else Router([]),
+                          spawn or (lambda *a: None), **kw)
+
+    def test_decide_roles_truth_table(self):
+        """The per-pool watermark logic, pinned case by case: the two
+        pools scale on DIFFERENT signal families, scale-up beats
+        scale-down, prefill backlog beats decode pressure, and a pool
+        with no snapshots contributes no verdict."""
+        a = self._scaler()
+
+        def sig(pq=2.0, kv=0.5, sess=0.5, npf=1, ndc=1):
+            return {"prefill_replicas": npf, "decode_replicas": ndc,
+                    "prefill_snapshots": npf, "decode_snapshots": ndc,
+                    "prefill_queue_mean": pq,
+                    "decode_kv_free_frac": kv,
+                    "decode_sessions_frac": sess}
+
+        cases = [
+            (sig(), None),                            # mid-band: hold
+            (sig(pq=5.0), ("up", "prefill")),         # prompt backlog
+            (sig(kv=0.1), ("up", "decode")),          # kv starvation
+            (sig(sess=0.9), ("up", "decode")),        # slots resident
+            # both pools want up: the user-visible TTFT backlog wins
+            (sig(pq=5.0, kv=0.1), ("up", "prefill")),
+            (sig(pq=0.5), ("down", "prefill")),       # idle prefill
+            (sig(sess=0.2), ("down", "decode")),      # idle decode
+            # decode-down needs BOTH idle sessions and kv headroom
+            (sig(sess=0.2, kv=0.1), ("up", "decode")),
+            # up beats down across pools
+            (sig(pq=5.0, sess=0.2), ("up", "prefill")),
+            (sig(sess=0.9, pq=0.5), ("up", "decode")),
+            # prefill-down is evaluated before decode-down
+            (sig(pq=0.5, sess=0.2), ("down", "prefill")),
+            # a pool with no snapshot data contributes nothing
+            (sig(pq=9.0, npf=0), None),
+            (sig(kv=0.0, sess=1.0, ndc=0), None),
+            (sig(pq=0.0, npf=0, ndc=0), None),
+        ]
+        for s, want in cases:
+            assert a.decide_roles(s) == want, (s, want)
+
+    def test_tick_scales_pools_independently(self):
+        """e2e over stub replicas: a hot prefill queue spawns into the
+        prefill pool (spawn hook receives the role), an idle prefill
+        pool drains back — the decode pool is untouched either way."""
+        pf = FakeReplica("pf0", queue_depth=9)
+        dc = FakeReplica("dc0")
+        pf.role, dc.role = "prefill", "decode"
+        clock = [0.0]
+        spawned = []
+
+        def spawn(name, role):
+            rep = FakeReplica(name)
+            rep.role = role
+            spawned.append((name, role))
+            return rep
+
+        rt = _router([pf, dc])
+        a = self._scaler(rt, spawn, hysteresis=1, cooldown_s=0.0,
+                         clock=lambda: clock[0])
+        assert a.tick() == "up:prefill"
+        assert spawned and spawned[-1][1] == "prefill"
+        assert sorted(rt.roles.values()) == \
+            ["decode", "prefill", "prefill"]
+        # queues drain: the 2-replica prefill pool contracts; the
+        # decode pool (1 replica) is never drained below one
+        pf.queue_depth = 0
+        clock[0] += 1.0
+        assert a.tick() == "down:prefill"
+        names = set(rt.alive_names())
+        assert "dc0" in names
+        assert sum(1 for n in names
+                   if rt.roles.get(n) == "prefill") == 1
+        # ... and the now-single prefill pool refuses to drain to zero
+        clock[0] += 1.0
+        assert a.tick() is None
+
+    def test_pool_floor_repair_bypasses_hysteresis(self):
+        """An empty pool (operator drain, replica death) is repaired on
+        the NEXT tick regardless of hysteresis/cooldown — an empty
+        prefill pool strands every new prompt, an empty decode pool
+        strands every prefilled session."""
+        pf = FakeReplica("pf0")
+        pf.role = "prefill"
+        spawned = []
+
+        def spawn(name, role):
+            rep = FakeReplica(name)
+            rep.role = role
+            spawned.append((name, role))
+            return rep
+
+        rt = _router([pf])
+        a = self._scaler(rt, spawn, hysteresis=99, cooldown_s=1e9)
+        assert a.tick() == "up:decode"     # decode pool was empty
+        assert spawned[-1][1] == "decode"
+        # both pools populated now: the huge hysteresis holds
+        assert a.tick() is None
+
+
+# =====================================================================
 # RpcReplica: the same interface across a process boundary
 # =====================================================================
 class TestRpcReplica:
@@ -922,15 +1260,17 @@ def test_http_surface_pinned(capsys):
 
 def test_gateway_env_registry_complete():
     """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_*/PADDLE_SLO_*/
-    PADDLE_AUTOSCALE_* env the serving stack reads is registered in
-    testing.GW_ENV_VARS (the conftest leak guard's list), and the
-    registry carries no dead entries — same structural discipline as
-    FI_ENV_VARS/FR_ENV_VARS. The SLO knobs live in
-    inference/telemetry.py (SloPolicy.from_env), so that file joins
-    the scan; the autoscale knobs live in serving_cluster/autoscale.py
-    (already in the package scan)."""
+    PADDLE_AUTOSCALE_*/PADDLE_QOS_*/PADDLE_TENANT_*/PADDLE_ROLE* env
+    the serving stack reads is registered in testing.GW_ENV_VARS (the
+    conftest leak guard's list), and the registry carries no dead
+    entries — same structural discipline as FI_ENV_VARS/FR_ENV_VARS.
+    The SLO knobs live in inference/telemetry.py (SloPolicy.from_env)
+    and the QoS shares + engine role in inference/serving.py, so both
+    files join the scan; the autoscale knobs live in
+    serving_cluster/autoscale.py (already in the package scan)."""
     import re
 
+    import paddle_tpu.inference.serving as serving_mod
     import paddle_tpu.inference.telemetry as tele_mod
     import paddle_tpu.serving_cluster as sc
     from paddle_tpu.testing import GW_ENV_VARS
@@ -938,11 +1278,13 @@ def test_gateway_env_registry_complete():
     paths = [os.path.join(pkg, fn) for fn in os.listdir(pkg)
              if fn.endswith(".py")]
     paths.append(os.path.abspath(tele_mod.__file__))
+    paths.append(os.path.abspath(serving_mod.__file__))
     found = set()
     for path in paths:
         with open(path) as f:
             found |= set(re.findall(
-                r"PADDLE_(?:GATEWAY|ROUTER|SLO|AUTOSCALE)_[A-Z_0-9]+",
+                r"PADDLE_(?:(?:GATEWAY|ROUTER|SLO|AUTOSCALE|QOS"
+                r"|TENANT|ROLE)_[A-Z_0-9]+|ROLE\b)",
                 f.read()))
     # the rpc-replica probe knob lives in replica.py; bench/tests may
     # reference more — the guard list must cover everything READ here

@@ -176,6 +176,14 @@ def main(argv=None):
     # queue depths + violation split the shed/autoscale paths read
     _check_qos_surface(failures)
 
+    # ---- 10. disaggregated-serving surface: the role label (snapshot
+    # + Prometheus info gauge), the handoff counters
+    # kv_blocks_shipped/adopted, and the transfer-bytes histogram —
+    # what the --disagg bench gates and the per-pool dashboards key
+    # on; the v5 snapshot stamp keeps pre-role routers refusing the
+    # payload instead of misreading it
+    _check_role_surface(failures)
+
     if failures:
         print("check_metrics_surface: FAILED")
         for f_ in failures:
@@ -188,7 +196,8 @@ def main(argv=None):
           "runtime registry; SLO + router-audit counter names pinned; "
           f"{n_kinds} dispatched executable families covered by "
           "generation.DISPATCH_KINDS; mp=2 shard gauges reconcile; "
-          "QoS per-class series pinned + zero-initialized)")
+          "QoS per-class series pinned + zero-initialized; disagg "
+          "role/handoff surface pinned end-to-end)")
     return 0
 
 
@@ -449,6 +458,121 @@ def _check_qos_surface(failures):
                 failures.append(
                     f"snapshot {blk!r} block lost {key!r} — the "
                     "preemption accounting the drill gates read")
+
+
+def _check_role_surface(failures):
+    """Disagg surface probe: drive ONE real prefill->decode KV handoff
+    (engine-level export/import — the same path the router's
+    ``_handoff_one`` rides) and assert every series the --disagg bench
+    gates and the per-pool dashboards key on actually moved."""
+    import numpy as np
+
+    from paddle_tpu.inference.telemetry import (PROMETHEUS_NAMES,
+                                                SNAPSHOT_REQUIRED_KEYS,
+                                                SNAPSHOT_SCHEMA_VERSION)
+    from paddle_tpu.serving_cluster import protocol as P
+    from paddle_tpu.serving_cluster.router import Router
+
+    if SNAPSHOT_SCHEMA_VERSION != 5:
+        failures.append(
+            f"SNAPSHOT_SCHEMA_VERSION = {SNAPSHOT_SCHEMA_VERSION!r}, "
+            "pinned 5 (v5 = role + handoff block — bump this check "
+            "deliberately alongside the schema)")
+    for key in ("role", "handoff"):
+        if key not in SNAPSHOT_REQUIRED_KEYS:
+            failures.append(
+                f"SNAPSHOT_REQUIRED_KEYS lost {key!r} — the router's "
+                "disagg placement filter reads it off the wire")
+    pinned = {
+        "kv_blocks_shipped": (
+            "paddle_serving_kv_blocks_shipped_total", "counter"),
+        "kv_blocks_adopted": (
+            "paddle_serving_kv_blocks_adopted_total", "counter"),
+    }
+    for k, want in pinned.items():
+        got = PROMETHEUS_NAMES.get(k)
+        if got != want:
+            failures.append(
+                f"handoff metrics key {k!r} maps to {got!r}, pinned "
+                f"{want!r} — the --disagg bench zero-recompute gate "
+                "keys on it")
+    for fld in ("roles", "handoffs_total"):
+        if fld not in P.SCALE_FIELDS:
+            failures.append(
+                f"protocol.SCALE_FIELDS lost {fld!r} — the /scale "
+                "control surface no longer reports the disagg pools")
+    # one REAL handoff: the prefill-role engine runs the prompt then
+    # HOLDS the session (no decode), export/import moves the KV to the
+    # decode-role engine, which finishes the generation off it
+    eng_p, rng, V = _build_engine(role="prefill")
+    eng_d, _rng2, _V2 = _build_engine(role="decode")
+    rid = eng_p.submit(rng.randint(1, V, (9,)).astype(np.int32),
+                       max_new_tokens=3)
+    for _ in range(64):
+        if not eng_p.has_work:
+            break
+        eng_p.step()
+    if eng_p.has_work:
+        failures.append("prefill-role probe engine never quiesced — "
+                        "the prompt-complete hold is broken")
+        return
+    state = eng_p.export_slot(rid)
+    rid2 = eng_d.import_slot(state)
+    eng_d.run()
+    toks, done, _st = eng_d.harvest_new_tokens(rid2)
+    if not done or not toks:
+        failures.append(
+            "decode-role engine did not finish the adopted session "
+            f"(done={done}, {len(toks)} tokens) — the handoff path is "
+            "not end-to-end")
+    mp, md = eng_p.metrics(), eng_d.metrics()
+    if mp.get("role") != "prefill" or md.get("role") != "decode":
+        failures.append(
+            f"engine role gauges drifted: prefill engine reports "
+            f"{mp.get('role')!r}, decode engine {md.get('role')!r}")
+    if not mp.get("kv_blocks_shipped"):
+        failures.append(
+            "prefill engine kv_blocks_shipped did not move on "
+            "export_slot — the zero-recompute conservation gate reads "
+            "this counter")
+    if md.get("kv_blocks_adopted") != mp.get("kv_blocks_shipped"):
+        failures.append(
+            f"handoff counters do not reconcile: shipped "
+            f"{mp.get('kv_blocks_shipped')!r} != adopted "
+            f"{md.get('kv_blocks_adopted')!r} on a lossless transfer")
+    snap = eng_p.telemetry_snapshot()
+    if snap.get("role") != "prefill":
+        failures.append(
+            f"snapshot role {snap.get('role')!r} != 'prefill' — the "
+            "router filters placement on this field")
+    ho = snap.get("handoff") or {}
+    if ho.get("kv_blocks_shipped") != mp.get("kv_blocks_shipped"):
+        failures.append(
+            "snapshot handoff block does not mirror the "
+            "kv_blocks_shipped counter")
+    text_p = eng_p.metrics_prometheus()
+    probe = 'paddle_serving_role{role="prefill"} 1'
+    if probe not in text_p:
+        failures.append(
+            f"prefill exposition lost the role info gauge ({probe!r})")
+    if "paddle_serving_handoff_bytes_bucket" not in text_p:
+        failures.append(
+            "exposition lost the paddle_serving_handoff_bytes "
+            "transfer-size histogram")
+    count = [ln for ln in text_p.splitlines()
+             if ln.startswith("paddle_serving_handoff_bytes_count")]
+    if not count or count[0].split()[-1] == "0":
+        failures.append(
+            "paddle_serving_handoff_bytes recorded no observation "
+            "after a real export_slot — transfer sizes are not being "
+            "observed")
+    # an EMPTY router still exposes the gateway handoff counter,
+    # zero-valued — discoverable before any disagg traffic flows
+    if "paddle_gateway_handoffs_total 0" not in \
+            Router([]).metrics_prometheus():
+        failures.append(
+            "empty-router exposition lost "
+            "'paddle_gateway_handoffs_total'")
 
 
 def _check_snapshot_schema(failures, eng):
