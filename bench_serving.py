@@ -155,13 +155,41 @@ BENCH_DISAGG_HANDOFF_BLOCKS (1), BENCH_CHUNKED_LONG (long-prompt
 fraction, 0.4 here), BENCH_SLOTS (per-role slot count; mixed gets
 2x).
 
+--gray runs the GRAY-FAILURE chaos drill: 3 in-process replicas on
+one virtual clock, round_robin routing (queue-blind on purpose: a
+fresh-snapshot least_loaded policy quietly routes around a slow
+replica's standing queue, masking the defense stack the drill is
+about), and one replica injected
+SLOW-BUT-ALIVE mid-bench (its pump only steps every
+BENCH_GRAY_SLOW_FACTOR-th call while the heartbeat keeps beating —
+the failure the heartbeat sweep can NOT see), lifted after the
+measured stream drains (BENCH_GRAY_LIFT_AT < the request count lifts
+mid-bench instead). Three runs at the SAME fixed-seed arrivals:
+healthy baseline,
+gray + defense (health scoring, circuit breaker, hedged dispatch on),
+gray + defense OFF. Exits non-zero unless: exact greedy token parity
+for EVERY request in both gray runs vs the healthy run (this is also
+the hedge double-billing gate — a loser leg's tokens entering the
+stream would break equality), zero drops/orphans, zero failovers and
+zero deaths (gray must be shed, never declared dead), the breaker
+actually OPENED and at least one hedge WON during the defense run,
+the victim's breaker RE-CLOSED after the slowness lifted, defense
+TTFT p99 <= 0.5x the no-defense p99 (the tail-at-scale payoff), the
+injection really hurt the undefended run (no-defense p99 >= 1.5x
+healthy), and zero retraces after warmup everywhere. Knobs:
+BENCH_GRAY_SLOW_FACTOR (400), BENCH_GRAY_REQUESTS (48),
+BENCH_GRAY_SLOW_AT / BENCH_GRAY_LIFT_AT (submission indices, default
+1/3 of the workload and end-of-stream), BENCH_GRAY_LOAD (0.1 of probed
+capacity ~ 1/3 of the drive loop's real capacity: gray defense is a
+tail story and a backlog buries it).
+
 All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
 "chunked_prefill", the cluster record under "cluster", the mesh
 record under "mesh_serving", the QoS overload record under "qos",
-the disaggregated A/B under "disagg"; each mode preserves the
-others' records).
+the disaggregated A/B under "disagg", the gray-failure drill under
+"gray_failure"; each mode preserves the others' records).
 """
 from __future__ import annotations
 
@@ -261,7 +289,7 @@ def _collect(eng, sub, arrivals):
 
 _SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
                 "chunked_prefill", "cluster", "mesh_serving", "qos",
-                "disagg")
+                "disagg", "gray_failure")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -399,6 +427,8 @@ def main(argv=None):
         return main_qos()
     if "--disagg" in argv:
         return main_disagg()
+    if "--gray" in argv:
+        return main_gray()
     from bench import _init_devices
     jax, dev, tpu_unavailable = _init_devices()
     on_tpu = dev.platform in ("tpu", "axon")
@@ -2270,6 +2300,426 @@ def main_cluster():
         print("bench_serving: RETRACES AFTER WARMUP during the scale "
               f"drill: {sd['retraces_after_warmup']} — migration and "
               "spawned replicas must reuse warm executables",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def main_gray():
+    """The GRAY-FAILURE chaos drill (see the module docstring): one
+    replica goes slow-but-alive mid-bench — heartbeat fresh, work
+    crawling — and the router's defense stack (health scoring,
+    circuit breaker, hedged dispatch) must bound the TTFT tail
+    without ever declaring the replica dead, then hand the traffic
+    back once the slowness lifts."""
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import AdmissionFull, ServingEngine
+    from paddle_tpu.serving_cluster import NoReplicaError, Router
+    from paddle_tpu.serving_cluster.replica import LocalReplica, ReplicaError
+
+    n_rep = 3
+    slots = int(os.environ.get("BENCH_SLOTS", "4" if on_tpu else "2"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    cap_ = int(os.environ.get("BENCH_PREFIX_CAP", "64"))
+    tlen = int(os.environ.get("BENCH_PREFIX_TLEN",
+                              "512" if on_tpu else "128"))
+    n_templates = 4
+    n_meas = int(os.environ.get("BENCH_GRAY_REQUESTS", str(16 * n_rep)))
+    # load WELL below the PROBED capacity: gray defense is a
+    # tail-latency story and a standing backlog buries the victim's
+    # slowness inside queueing noise. The probe measures a bare
+    # engine.run loop; the cluster drive adds per-iteration router
+    # work (snapshots on every submit, a harvest per open stream), so
+    # its real capacity is ~1/3 of probed x n_rep — 0.1 here is ~1/3
+    # of true capacity (measured: 0.3 ran the loop at saturation and
+    # the healthy p99 matched the injected run's)
+    load = float(os.environ.get("BENCH_GRAY_LOAD", "0.1"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    # the victim steps only every Nth pump: at a quiet-cluster loop
+    # pace (~0.2ms/iteration) 200 skipped pumps ≈ tens of ms per
+    # victim step ≈ 10-20x its healthy step time — and the skipped
+    # pumps are near-free, so the slowness never stalls the shared
+    # single-threaded drive loop the way a real sleep would
+    slow_factor = int(os.environ.get("BENCH_GRAY_SLOW_FACTOR", "400"))
+    slow_at = int(os.environ.get("BENCH_GRAY_SLOW_AT", str(n_meas // 3)))
+    # default: the slowness lifts once the whole measured stream has
+    # been submitted and drained (the undefended run must pay the
+    # FULL crawl price — lifting mid-window quietly rescues it);
+    # BENCH_GRAY_LIFT_AT below n_meas lifts at that submission index
+    lift_at = int(os.environ.get("BENCH_GRAY_LIFT_AT", str(n_meas)))
+    # half-open cooldown: each half-open probe mid-window sacrifices
+    # a real request to the still-slow victim (the canary cost of
+    # breaker probing), so the cooldown bounds that to ~1 per window;
+    # the post-lift recovery phase skip_to()s across it, so a long
+    # cooldown costs no real time there
+    cooldown = 1.0
+    spill = int(os.environ.get("BENCH_CLUSTER_SPILL_DEPTH",
+                               str(4 * slots)))
+    pool_blocks = 4 * n_templates * max(tlen // cap_, 1)
+    new_choices = [8, 12, 16]
+    sfx_lo, sfx_hi = 3, min(8, smax - tlen - max(new_choices))
+
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(on_tpu)
+    rng = np.random.RandomState(seed)
+    templates = [rng.randint(1, V, (tlen,)).astype("int32")
+                 for _ in range(n_templates)]
+    meas_reqs = _make_shared_workload(rng, n_meas, V, smax, templates,
+                                      sfx_lo, sfx_hi, new_choices)
+    warm_template = rng.randint(1, V, (tlen,)).astype("int32")
+
+    def build_engine(clock):
+        eng = ServingEngine(
+            fmt, embed, head, num_slots=slots, max_seq_len=smax,
+            prefill_cap=cap_, prefix_cache_blocks=pool_blocks,
+            paged=True, clock=clock.now)
+        for sfx in (sfx_lo, sfx_lo, sfx_hi):
+            p = np.concatenate([warm_template,
+                                np.arange(1, sfx + 1, dtype=np.int32)])
+            eng.submit(p, max_new_tokens=max(new_choices))
+            eng.run()
+        eng.reset_metrics(keep_results=False)
+        return eng
+
+    class SlowReplica(LocalReplica):
+        """The gray-failure lever: while ``slow_factor`` > 1, only
+        every slow_factor-th pump() actually steps the engine — but
+        the heartbeat refreshes on EVERY call, so the replica keeps
+        LOOKING alive. Deterministic slow-but-alive, the failure mode
+        the heartbeat sweep cannot see and the breaker must.
+        ``steps_done`` counts REAL engine steps, so the driver can
+        tell a skipped (gray) pump from actual progress and advance
+        the virtual clock across the crawl instead of spinning."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.slow_factor = 1
+            self.steps_done = 0
+            self._pumps = 0
+
+        def pump(self):
+            self._pumps += 1
+            self._check_alive()
+            if self.slow_factor > 1 and self._pumps % self.slow_factor:
+                self._hb = self._clock()
+                return 0
+            with self._lock:
+                work = self.engine.has_work
+                out = self.engine.step() if work else 0
+            if work:
+                self.steps_done += 1
+            self._hb = self._clock()
+            return out
+
+    def run_gray(slow, defense):
+        clock = VirtualClock()
+        reps = [SlowReplica(f"replica{r}", build_engine(clock),
+                            threaded=False, clock=clock.now)
+                for r in range(n_rep)]
+        # knobs pinned explicitly (not env defaults): an exported
+        # PADDLE_ROUTER_HEDGE_QUANTILE=0 must not silently disarm the
+        # drill; the no-defense arm disables by unreachable thresholds
+        # instead of new code paths, so both arms run the same router
+        kw = (dict(suspect_ratio=3.0, breaker_ratio=6.0,
+                   breaker_errs=3, breaker_probes=1,
+                   hedge_quantile=95.0, hedge_margin=2.0,
+                   hedge_min_s=0.02, retry_rate=8.0, retry_burst=16)
+              if defense else
+              dict(suspect_ratio=1e9, breaker_ratio=1e9,
+                   breaker_errs=10 ** 9, hedge_quantile=0.0))
+        # round_robin ON PURPOSE: queue-aware policies (least_loaded
+        # at fresh snapshots) quietly route around a slow replica's
+        # standing queue, which would mask the stack under test —
+        # queue-blind rotation keeps feeding the victim, so the
+        # breaker/hedge layer is the ONLY defense in the A/B
+        router = Router(reps, policy="round_robin", hb_dead_s=0.05,
+                        spill_depth=spill, snap_max_age_s=0.0,
+                        clock=clock.now, audit_ring=4096,
+                        breaker_cooldown_s=cooldown, **kw)
+        traces0 = [r.engine.metrics()["traces"] for r in reps]
+        arr = arrivals + clock.now()
+        t0 = clock.now()
+        recs = {}
+        open_gids = set()
+        i = 0
+        orphaned = 0
+        gray = {"victim": None, "t_slow": None, "t_lift": None}
+        victim_rep = None
+        while i < len(meas_reqs) or open_gids:
+            now = clock.now()
+            while i < len(meas_reqs) and arr[i] <= now:
+                if slow and victim_rep is None and i >= slow_at:
+                    # inject: whoever holds the most in-flight work
+                    # goes 20x slow (in-flight streams are what hedges
+                    # must rescue); deterministic fallback if the
+                    # instant happens to be idle
+                    owner_of = {g: router.poll(g)["replica"]
+                                for g in open_gids}
+                    loadc = {}
+                    for rep_name in owner_of.values():
+                        if rep_name is not None:
+                            loadc[rep_name] = loadc.get(rep_name, 0) + 1
+                    name = (max(sorted(loadc), key=lambda n: loadc[n])
+                            if loadc else sorted(router.replicas)[0])
+                    victim_rep = router.replicas[name]
+                    victim_rep.slow_factor = slow_factor
+                    gray.update(victim=name, t_slow=clock.now())
+                if slow and victim_rep is not None \
+                        and gray["t_lift"] is None and i >= lift_at:
+                    victim_rep.slow_factor = 1
+                    gray["t_lift"] = clock.now()
+                prompt, max_new = meas_reqs[i]
+                try:
+                    gid = router.submit([int(t) for t in prompt],
+                                        max_new_tokens=max_new)
+                except AdmissionFull:
+                    break
+                recs[gid] = {"idx": i, "toks": [], "t_first": None,
+                             "state": None}
+                open_gids.add(gid)
+                i += 1
+            stepped = False
+            for rep in reps:
+                if rep.alive:
+                    s0 = rep.steps_done
+                    try:
+                        rep.pump()
+                    except ReplicaError:
+                        pass
+                    stepped |= rep.steps_done > s0
+            router.check_health()
+            for gid in list(open_gids):
+                try:
+                    new, done, state = router.harvest(gid)
+                except NoReplicaError:
+                    orphaned += 1
+                    new, done, state = [], True, "orphaned"
+                r = recs[gid]
+                if new and r["t_first"] is None:
+                    r["t_first"] = clock.now()
+                r["toks"].extend(new)
+                if done:
+                    r["state"] = state
+                    open_gids.discard(gid)
+            if not stepped and not open_gids and i < len(meas_reqs):
+                clock.skip_to(arr[i])
+            elif not stepped and open_gids:
+                # nothing stepped but streams are open: only gray-
+                # skipped pumps are pending. A real cluster would sit
+                # in wall-clock time here — advance the virtual clock
+                # by a small quantum instead of burning a real spin,
+                # so the victim's crawl COSTS virtual latency (~
+                # slow_factor x quantum per step when the cluster is
+                # otherwise idle) without costing bench wall time
+                clock.skip_to(clock.now() + 0.002)
+        if slow and victim_rep is not None and gray["t_lift"] is None:
+            victim_rep.slow_factor = 1        # the slowness lifts
+            gray["t_lift"] = clock.now()
+
+        def probe_round():
+            # one recovery round: n_rep short probes over warmed
+            # shapes, driven to completion — least_loaded spreads them
+            # across the idle set, so the half-open victim gets its
+            # probe placement and the breaker gets its verdict
+            pr = np.concatenate([templates[0],
+                                 np.arange(1, sfx_lo + 1,
+                                           dtype=np.int32)])
+            open_ = set()
+            for _ in range(n_rep):
+                try:
+                    open_.add(router.submit([int(t) for t in pr],
+                                            max_new_tokens=min(
+                                                new_choices)))
+                except AdmissionFull:
+                    break
+            guard = 0
+            while open_ and guard < 20000:
+                guard += 1
+                for rep in reps:
+                    if rep.alive:
+                        try:
+                            rep.pump()
+                        except ReplicaError:
+                            pass
+                router.check_health()
+                for g in list(open_):
+                    try:
+                        _, done, _ = router.harvest(g)
+                    except NoReplicaError:
+                        done = True
+                    if done:
+                        open_.discard(g)
+
+        recovery_rounds = 0
+        if defense and slow and gray["victim"] is not None:
+            # the RE-CLOSE gate: tick past the cooldown and feed probe
+            # traffic until the half-open probe settles the breaker
+            while router.breaker_state(gray["victim"]) != "closed" \
+                    and recovery_rounds < 12:
+                clock.skip_to(clock.now() + cooldown + 0.01)
+                probe_round()
+                recovery_rounds += 1
+        elapsed = clock.now() - t0
+        toks = sum(len(r["toks"]) for r in recs.values())
+        ttft = [r["t_first"] - arr[r["idx"]] for r in recs.values()
+                if r["t_first"] is not None]
+        victim = gray["victim"]
+        out = {
+            "slow": slow, "defense": defense,
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "elapsed_s": round(elapsed, 3),
+            "ttft_p50_ms": round(1e3 * float(np.percentile(ttft, 50)),
+                                 1),
+            "ttft_p99_ms": round(1e3 * float(np.percentile(ttft, 99)),
+                                 1),
+            "submitted": len(recs),
+            "unfinished": sum(1 for r in recs.values()
+                              if r["state"] != "finished"),
+            "orphaned": orphaned,
+            "failovers": router.failovers_total,
+            "dead": sorted(router.dead),
+            "hedges": router.hedges_total,
+            "hedge_wins": router.hedge_wins_total,
+            "retry_budget_exhausted":
+                router.retry_budget_exhausted_total,
+            "breaker_transitions": dict(router.breaker_transitions),
+            "gray": dict(gray, recovery_rounds=recovery_rounds,
+                         victim_breaker_final=(
+                             router.breaker_state(victim)
+                             if victim else None),
+                         victim_alive_final=(
+                             router.replicas[victim].alive
+                             if victim else None)),
+            "health_final": {n: h["verdict"] for n, h
+                             in router.health_status().items()},
+            "retraces_after_warmup": [
+                r.engine.metrics()["traces"] - t
+                for r, t in zip(reps, traces0)],
+        }
+        by_idx = {r["idx"]: r["toks"] for r in recs.values()}
+        return out, by_idx
+
+    # arrival rate anchored on a capacity probe of ONE warmed engine
+    # times the replica count (same discipline as --cluster)
+    probe_clock = VirtualClock()
+    probe_eng = build_engine(probe_clock)
+    t0 = probe_clock.now()
+    for prompt, max_new in meas_reqs[: 4 * slots]:
+        probe_eng.submit(prompt, max_new_tokens=max_new)
+    probe_eng.run()
+    cap_tps = (probe_eng.metrics()["tokens_emitted"]
+               / max(probe_clock.now() - t0, 1e-9)) * n_rep
+    mean_new = float(np.mean([m for _, m in meas_reqs]))
+    arr_rng = np.random.RandomState(seed + 1)
+    arrivals = np.cumsum(arr_rng.exponential(
+        mean_new / max(load * cap_tps, 1e-9), size=len(meas_reqs)))
+
+    healthy, healthy_toks = run_gray(slow=False, defense=True)
+    defense, defense_toks = run_gray(slow=True, defense=True)
+    nodef, nodef_toks = run_gray(slow=True, defense=False)
+
+    # parity doubles as the double-billing gate: a hedge loser's
+    # tokens entering the delivered stream, or a token streamed twice,
+    # breaks exact equality against the undisturbed run
+    parity_defense = all(defense_toks.get(i) == healthy_toks.get(i)
+                         for i in range(len(meas_reqs)))
+    parity_nodef = all(nodef_toks.get(i) == healthy_toks.get(i)
+                       for i in range(len(meas_reqs)))
+    ratio = round(defense["ttft_p99_ms"]
+                  / max(nodef["ttft_p99_ms"], 1e-9), 4)
+
+    record = {
+        "metric": "gray_failure_ttft_p99_ratio",
+        "value": ratio,
+        "unit": "defense/no-defense TTFT p99 (lower = better defense)",
+        "replicas": n_rep, "slots_per_replica": slots,
+        "requests": n_meas, "offered_load": load, "seed": seed,
+        "slow_factor": slow_factor, "slow_at": slow_at,
+        "lift_at": lift_at,
+        "healthy": healthy,
+        "defense": defense,
+        "no_defense": nodef,
+        "token_parity_defense_vs_healthy": parity_defense,
+        "token_parity_no_defense_vs_healthy": parity_nodef,
+        "layers": L, "hidden": E, "vocab": V,
+        "device": str(dev),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "gray_failure", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+
+    rc = 0
+    if not parity_defense:
+        print("bench_serving: GRAY-DRILL TOKEN PARITY BROKE (defense "
+              "run) — hedged dispatch is not greedy-identical, or a "
+              "loser leg's tokens were double-billed", file=sys.stderr)
+        rc = 1
+    if not parity_nodef:
+        print("bench_serving: GRAY-DRILL TOKEN PARITY BROKE "
+              "(no-defense run) — slowness alone must never change "
+              "delivered tokens", file=sys.stderr)
+        rc = 1
+    for run in (healthy, defense, nodef):
+        tag = (f"slow={run['slow']} defense={run['defense']}")
+        if run["orphaned"] or run["unfinished"] \
+                or run["submitted"] != n_meas:
+            print(f"bench_serving: GRAY DRILL DROPPED STREAMS ({tag}) "
+                  f"— submitted={run['submitted']}/{n_meas}, "
+                  f"unfinished={run['unfinished']}, "
+                  f"orphaned={run['orphaned']}", file=sys.stderr)
+            rc = 1
+        if run["failovers"] or run["dead"]:
+            print(f"bench_serving: GRAY DRILL DECLARED DEATH ({tag}) "
+                  f"— failovers={run['failovers']}, "
+                  f"dead={run['dead']}; a slow-but-alive replica must "
+                  "be shed by the breaker, never killed",
+                  file=sys.stderr)
+            rc = 1
+        if any(run["retraces_after_warmup"]):
+            print(f"bench_serving: RETRACES AFTER WARMUP ({tag}): "
+                  f"{run['retraces_after_warmup']} — the defense "
+                  "stack must be pure host code", file=sys.stderr)
+            rc = 1
+    if defense["breaker_transitions"]["open"] < 1 \
+            or defense["gray"]["victim"] is None:
+        print("bench_serving: the gray drill never OPENED the breaker "
+              f"({defense['breaker_transitions']}) — the injected "
+              "slowness went undetected", file=sys.stderr)
+        rc = 1
+    if defense["hedge_wins"] < 1:
+        print("bench_serving: no hedge WON during the defense run "
+              f"(hedges={defense['hedges']}, "
+              f"wins={defense['hedge_wins']}) — the drill must "
+              "exercise the promotion path", file=sys.stderr)
+        rc = 1
+    if defense["gray"]["victim_breaker_final"] != "closed":
+        print("bench_serving: the victim's breaker never RE-CLOSED "
+              "after the slowness lifted (final="
+              f"{defense['gray']['victim_breaker_final']}, "
+              f"recovery_rounds={defense['gray']['recovery_rounds']})",
+              file=sys.stderr)
+        rc = 1
+    if defense["ttft_p99_ms"] > 0.5 * nodef["ttft_p99_ms"]:
+        print("bench_serving: GRAY TAIL NOT BOUNDED — defense TTFT "
+              f"p99 {defense['ttft_p99_ms']}ms vs no-defense "
+              f"{nodef['ttft_p99_ms']}ms (ratio {ratio}, gate 0.5)",
+              file=sys.stderr)
+        rc = 1
+    if nodef["ttft_p99_ms"] < 1.5 * healthy["ttft_p99_ms"]:
+        print("bench_serving: the slow injection had NO EFFECT — "
+              f"no-defense TTFT p99 {nodef['ttft_p99_ms']}ms vs "
+              f"healthy {healthy['ttft_p99_ms']}ms; nothing was "
+              "defended against (tune BENCH_GRAY_SLOW_FACTOR?)",
               file=sys.stderr)
         rc = 1
     return rc

@@ -252,6 +252,11 @@ class _RpcAgent:
         return value
 
     def _call_inner(self, info, to, fn, args, kwargs, timeout):
+        # flaky-transport fault injection (PADDLE_FI_RPC_DELAY_MS /
+        # PADDLE_FI_RPC_ERR_RATE): fires BEFORE the wire so an injected
+        # error is indistinguishable from a connect failure to callers
+        from ..testing import fault
+        fault.rpc_flaky()
         deadline = time.monotonic() + timeout
         # deadline-bounded by default: a refused connect is instantaneous,
         # and a peer mid-restart stays refused for the supervisor's whole
@@ -373,7 +378,14 @@ def ping(to: str, timeout=None) -> float:
     errors (TimeoutError / ConnectionError) when the peer is gone — the
     cluster router's replica heartbeat rides exactly this, with a SHORT
     timeout so a dead replica is detected in heartbeats, not in a
-    30s-default user-facing call."""
+    30s-default user-facing call. The probe deadline is tunable
+    independently of the call deadline: None falls back to
+    PADDLE_RPC_PING_TIMEOUT_S first, then the PADDLE_RPC_TIMEOUT_S
+    chain — a 30s liveness probe would hold a health sweep hostage."""
+    if timeout is None:
+        env = os.environ.get("PADDLE_RPC_PING_TIMEOUT_S")
+        if env not in (None, ""):
+            timeout = float(env)
     t0 = time.monotonic()
     out = _require_agent().call(to, _pong, (), {},
                                 _resolve_timeout(timeout))

@@ -639,6 +639,10 @@ class ServingEngine:
             "PADDLE_TPU_SERVE_CHUNK_LOG", "4096")))
         self._tokens_emitted = 0
         self._busy_s = 0.0
+        # EWMA of WORKING step duration (snapshot v6 "health" block):
+        # the replica-local slowness signal the cluster router's
+        # median-relative health scorer compares across replicas
+        self._step_ewma_s = 0.0
         self._admitted = 0
         self._forked = 0
         # window counter (was recomputed from the results dict, which is
@@ -818,6 +822,15 @@ class ServingEngine:
         admission + decode chunk. Emits one chunk_log record; returns
         the number of tokens emitted this step."""
         t0 = self.clock()
+        # gray-failure chaos hook: PADDLE_FI_SLOW_POINT=serve_step slows
+        # THIS engine's scheduler loop (per-process env = per-replica in
+        # an rpc cluster) while its heartbeat keeps beating — the
+        # router's health scoring, not death detection, must notice.
+        # After t0, so the injected delay lands in the step-duration
+        # EWMA the snapshot health block reports. Free when disarmed
+        # (inject() gates on any PADDLE_FI_* set).
+        from ..testing import fault
+        fault.inject("serve_step")
         had_work = self.has_work
         self._expire_deadlines(t0)
         # QoS pass BEFORE admission: resume parked requests when pressure
@@ -854,6 +867,10 @@ class ServingEngine:
         self._busy_s += dt
         self._tokens_emitted += emitted
         if had_work:
+            # smoothed WORKING-step duration (idle steps would dilute
+            # the gray-failure signal toward zero on a lulled replica)
+            self._step_ewma_s = (dt if self._step_ewma_s == 0.0
+                                 else 0.8 * self._step_ewma_s + 0.2 * dt)
             # tokens-per-step distribution (0 is a real value: a pure-
             # prefill budget step emits nothing and that IS the story)
             self.telemetry.observe_step_tokens(emitted)

@@ -118,7 +118,13 @@ DEFAULT_QOS_SHARES = {"high": 4, "normal": 2, "low": 1}
 # the "handoff" block (kv_blocks_shipped/adopted — the streamed
 # prefill->decode KV transfer accounting). Routers older than v5 must
 # refuse rather than place decode traffic on a prefill-only replica.
-SNAPSHOT_SCHEMA_VERSION = 5
+# v6: gray-failure defense — top-level "do_sample" (engine sampling
+# mode: the router's hedged-dispatch safety gate — only a GREEDY
+# stream is bit-identical across replicas, so only do_sample=False
+# traffic may hedge) and the "health" block (step_ewma_s — the
+# engine's own smoothed step duration, the replica-local slowness
+# signal the router's median-relative health scorer consumes).
+SNAPSHOT_SCHEMA_VERSION = 6
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
@@ -126,7 +132,7 @@ SNAPSHOT_REQUIRED_KEYS = frozenset({
     "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
     "requests", "histograms", "budget", "prefix", "spans_logged",
     "steps_logged", "telemetry_ring", "slo", "queue_depths",
-    "role", "handoff",
+    "role", "handoff", "do_sample", "health",
 })
 
 # keys present only on some configurations (paged pool / spec decode)
@@ -897,6 +903,13 @@ def snapshot(engine):
         "role": m["role"],
         "handoff": {"kv_blocks_shipped": m["kv_blocks_shipped"],
                     "kv_blocks_adopted": m["kv_blocks_adopted"]},
+        # v6: gray-failure defense — the hedge safety gate (ONLY greedy
+        # streams are bit-identical across replicas, so only
+        # do_sample=False traffic may hedge) and the engine's own
+        # smoothed step duration (the replica-local slowness signal)
+        "do_sample": bool(engine.do_sample),
+        "health": {"step_ewma_s": float(
+            getattr(engine, "_step_ewma_s", 0.0))},
         "spans_logged": len(tele.spans),
         "steps_logged": len(tele.steps),
         "telemetry_ring": tele.ring,

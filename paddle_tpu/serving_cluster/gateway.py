@@ -16,7 +16,9 @@ tools/check_http_surface.py):
   * ``GET /v1/models`` — the single served model id.
   * ``GET /healthz``   — ok/degraded + replica counts (degraded = some
     but not all replicas dead; a fully dead cluster still answers,
-    status ``down`` — the load balancer's probe must not hang).
+    status ``down`` — the load balancer's probe must not hang), plus
+    per-replica gray-failure verdicts and circuit-breaker states (a
+    replica can be alive yet shed from placement).
   * ``GET /metrics``   — the router's aggregated Prometheus exposition
     (every replica's engine metrics with a ``replica`` label + router
     gauges).
@@ -84,6 +86,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import math
 import os
 import threading
@@ -206,7 +209,13 @@ class Gateway:
             name="gateway")
         self._thread.start()
         if not ready.wait(timeout=30):
-            raise RuntimeError("gateway failed to start within 30s")
+            # name the configuration in the failure: "which port, which
+            # replicas" is the first question a hung-start stack trace
+            # can't answer
+            raise RuntimeError(
+                f"gateway failed to start within 30s "
+                f"(port={self.port}, replicas="
+                f"{sorted(self.router.replicas)})")
         return self
 
     def stop(self):
@@ -214,6 +223,13 @@ class Gateway:
             self._loop.call_soon_threadsafe(self._stop_evt.set)
         if self._thread is not None:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # daemon thread: it cannot block exit, but a wedged
+                # event loop is worth a loud line, not silence
+                logging.getLogger("paddle.gateway").warning(
+                    "gateway thread still alive after 10s join "
+                    "(port=%s) — event loop wedged; daemon thread "
+                    "will be abandoned", self.port)
 
     async def _health_loop(self):
         loop = asyncio.get_running_loop()
@@ -378,9 +394,20 @@ class Gateway:
             total = len(self.router.replicas)
             status = ("ok" if alive == total
                       else "degraded" if alive else "down")
+            # gray-failure verdicts ride the probe payload: a replica
+            # can be alive (heartbeating) yet shed from placement —
+            # operators see WHICH one and WHY without a /metrics scrape
+            loop = asyncio.get_running_loop()
+            health = await loop.run_in_executor(
+                None, self.router.health_status)
             await self._send_json(writer, 200 if alive else 503, {
                 "status": status, "replicas_alive": alive,
-                "replicas_total": total}, span=span)
+                "replicas_total": total,
+                "replicas": {
+                    n: {"verdict": st["verdict"],
+                        "breaker": st["breaker"],
+                        "signal_s": st["signal_s"]}
+                    for n, st in sorted(health.items())}}, span=span)
         elif method == "GET" and path == "/v1/models":
             await self._send_json(writer, 200, {
                 "object": "list",

@@ -35,6 +35,7 @@ __all__ = ["ProtocolError", "CompletionRequest", "ERROR_STATUS",
            "RETRY_AFTER_S", "RETRY_AFTER_MAX_S", "COMPLETION_FIELDS",
            "CHOICE_FIELDS", "USAGE_FIELDS", "STREAM_CHUNK_FIELDS",
            "MODELS_FIELDS", "MODEL_ENTRY_FIELDS", "HEALTHZ_FIELDS",
+           "HEALTHZ_REPLICA_FIELDS",
            "SCALE_FIELDS", "DRAIN_FIELDS", "ERROR_BODY_FIELDS",
            "ERROR_BODY_FIELDS_429", "REASON_FOR_429",
            "PRIORITY_HEADER", "TENANT_HEADER",
@@ -96,7 +97,10 @@ STREAM_CHUNK_FIELDS = ("id", "object", "created", "model", "choices",
                        "trace_id")
 MODELS_FIELDS = ("object", "data")
 MODEL_ENTRY_FIELDS = ("id", "object", "owned_by")
-HEALTHZ_FIELDS = ("status", "replicas_alive", "replicas_total")
+HEALTHZ_FIELDS = ("status", "replicas_alive", "replicas_total",
+                  "replicas")
+# per-replica gray-failure probe entry under /healthz "replicas"
+HEALTHZ_REPLICA_FIELDS = ("verdict", "breaker", "signal_s")
 # the elastic admin surface: scale status (GET and the POST /admin/scale
 # response) and the drain summary. Autoscaler-less gateways report the
 # same field set with null bounds — the shape never varies.

@@ -41,6 +41,47 @@ class ReplicaError(RuntimeError):
     or spill, death means drain and re-route."""
 
 
+_RTT_ALPHA = 0.2    # EWMA smoothing for proxy-side RTT observation
+
+
+class _HealthMeter:
+    """Proxy-side gray-failure observables, shared by both replica
+    flavors: an EWMA of per-operation round-trip time (submit / harvest
+    / snapshot / ping) and a consecutive-transport-error streak. The
+    router's health scorer reads these through ``health_stats()`` —
+    RTTs catch a slow-but-alive replica, the error streak catches a
+    lossy link, and NEITHER declares death (that stays the heartbeat
+    sweep's job)."""
+
+    __slots__ = ("rtt", "consec_errors", "errors_total", "ops_total")
+
+    def __init__(self):
+        self.rtt = {}             # op -> EWMA seconds
+        self.consec_errors = 0
+        self.errors_total = 0
+        self.ops_total = 0
+
+    def ok(self, op, dt):
+        prev = self.rtt.get(op)
+        self.rtt[op] = dt if prev is None else (
+            (1.0 - _RTT_ALPHA) * prev + _RTT_ALPHA * dt)
+        self.consec_errors = 0
+        self.ops_total += 1
+
+    def err(self):
+        self.consec_errors += 1
+        self.errors_total += 1
+        self.ops_total += 1
+
+    def stats(self):
+        return {
+            "rtt_ewma_s": dict(self.rtt),
+            "consecutive_errors": self.consec_errors,
+            "errors_total": self.errors_total,
+            "ops_total": self.ops_total,
+        }
+
+
 class LocalReplica:
     """Thread-per-engine in-process replica (see module docstring)."""
 
@@ -63,6 +104,7 @@ class LocalReplica:
         # deterministic fault-drill lever (kill at exactly step K,
         # mid-request, regardless of scheduler/socket timing)
         self._step_hook = step_hook
+        self._health = _HealthMeter()
         self._thread = None
         if threaded:
             self._thread = threading.Thread(
@@ -113,6 +155,10 @@ class LocalReplica:
         if not self.alive:
             raise ReplicaError(f"replica {self.name!r} is dead")
 
+    def health_stats(self):
+        """Proxy-side gray-failure observables (see _HealthMeter)."""
+        return self._health.stats()
+
     def kill(self):
         """Simulated crash (tests/bench/fault drills): the driver stops
         mid-flight WITHOUT draining — in-flight requests are stranded
@@ -134,16 +180,21 @@ class LocalReplica:
         registered before the driver thread can possibly finish the
         request, closing the results-cap race by construction."""
         self._check_alive()
+        t0 = self._clock()
         with self._lock:
             rid = self.engine.submit(prompt, **kw)
             self.engine.track(rid)
+        self._health.ok("submit", self._clock() - t0)
         self._wake.set()
         return rid
 
     def harvest(self, rid):
         self._check_alive()
+        t0 = self._clock()
         with self._lock:
-            return self.engine.harvest_new_tokens(rid)
+            out = self.engine.harvest_new_tokens(rid)
+        self._health.ok("harvest", self._clock() - t0)
+        return out
 
     def poll(self, rid):
         self._check_alive()
@@ -205,8 +256,10 @@ class LocalReplica:
 
     def snapshot(self):
         self._check_alive()
+        t0 = self._clock()
         with self._lock:
             snap = self.engine.telemetry_snapshot()
+        self._health.ok("snapshot", self._clock() - t0)
         snap["replica"] = self.name
         return snap
 
@@ -321,9 +374,16 @@ class RpcReplica:
         self._timeout = float(
             timeout if timeout is not None
             else os.environ.get("PADDLE_RPC_TIMEOUT_S", "30"))
+        # liveness-probe deadline, tunable independently of the call
+        # deadline: arg -> PADDLE_RPC_PING_TIMEOUT_S -> the gateway
+        # heartbeat-probe default (a 30s probe would hold every health
+        # sweep hostage on one wedged worker)
         self._ping_timeout = float(
             ping_timeout if ping_timeout is not None
-            else os.environ.get("PADDLE_GATEWAY_HB_TIMEOUT_S", "2"))
+            else os.environ.get(
+                "PADDLE_RPC_PING_TIMEOUT_S",
+                os.environ.get("PADDLE_GATEWAY_HB_TIMEOUT_S", "2")))
+        self._health = _HealthMeter()
         self._dead = False
         self._hb = time.monotonic()
         self._role = None                 # fetched lazily, then cached
@@ -339,34 +399,49 @@ class RpcReplica:
         return self._role
 
     def _call(self, fn, *args, timeout=None):
+        from ..testing.fault import FaultInjected
         if self._dead:
             raise ReplicaError(f"replica {self.name!r} is dead")
+        op = getattr(fn, "__name__", "rpc").replace("_rw_", "")
+        t0 = time.monotonic()
         try:
             out = self._rpc.rpc_sync(
                 self.name, fn, args=args,
                 timeout=self._timeout if timeout is None else timeout)
         except AdmissionFull:
             self._hb = time.monotonic()   # a shed IS a live round-trip
+            self._health.ok(op, time.monotonic() - t0)
             raise
-        except (TimeoutError, ConnectionError, OSError) as e:
+        except (TimeoutError, ConnectionError, OSError,
+                FaultInjected) as e:
+            # FaultInjected is the flaky-transport injection flavor —
+            # by contract indistinguishable from a real wire failure
+            self._health.err()
             raise ReplicaError(
                 f"replica {self.name!r} unreachable: {e!r}") from e
         self._hb = time.monotonic()
+        self._health.ok(op, time.monotonic() - t0)
         return out
 
     # ---------------------------------------------------------- health
     def heartbeat_age(self):
         return time.monotonic() - self._hb
 
+    def health_stats(self):
+        """Proxy-side gray-failure observables (see _HealthMeter)."""
+        return self._health.stats()
+
     @property
     def alive(self):
         if self._dead:
             return False
         try:
-            self._rpc.ping(self.name, timeout=self._ping_timeout)
+            rtt = self._rpc.ping(self.name, timeout=self._ping_timeout)
         except Exception:
+            self._health.err()
             return False
         self._hb = time.monotonic()
+        self._health.ok("ping", rtt)
         return True
 
     def kill(self):

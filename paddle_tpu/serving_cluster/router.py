@@ -51,6 +51,39 @@ migrations land in the decision audit and ``/metrics``
 (``paddle_gateway_scale_events_total{direction=}``,
 ``paddle_gateway_migrations_total``/``_aborts_total``).
 
+Gray failure — a replica that is SLOW but alive (degraded host, lossy
+rpc link) — is defended in three layers, because death detection never
+fires for it (the heartbeat keeps beating):
+
+  * **health scoring** — per-replica first-token-latency EWMA observed
+    on the router's own harvest path (plus the engine's step-duration
+    EWMA from the v6 snapshot ``health`` block as a cold-start signal),
+    judged RELATIVE to the cluster median: ``healthy`` / ``suspect``
+    (>= ``PADDLE_ROUTER_SUSPECT_RATIO`` x median) / ``degraded``
+    (>= ``PADDLE_ROUTER_BREAKER_RATIO`` x median). Exposed via
+    ``health_status()`` -> /healthz and /metrics.
+  * **circuit breaker** per replica: closed -> open on a degraded
+    verdict or ``PADDLE_ROUTER_BREAKER_ERRS`` accumulated transport/
+    snapshot errors; open replicas are shed from placement (never
+    declared dead) until ``PADDLE_ROUTER_BREAKER_COOLDOWN_S`` passes;
+    then half-open admits <= ``PADDLE_ROUTER_BREAKER_PROBES``
+    concurrent probe placements whose first-token latency closes the
+    breaker (non-outlier) or re-opens it. Recovery needs no operator.
+  * **hedged dispatch** — a GREEDY request whose first token is
+    overdue (past the cluster's own TTFT
+    p``PADDLE_ROUTER_HEDGE_QUANTILE`` x ``PADDLE_ROUTER_HEDGE_MARGIN``)
+    is speculatively re-submitted to the next-best replica;
+    first-to-first-token wins, the loser is aborted through the normal
+    release path and its tokens are never delivered or billed. Greedy
+    decoding makes the two legs bit-identical, so the race is pure
+    latency; SAMPLED streams never hedge (each engine submit re-draws
+    the per-request seed, so two legs would diverge and the client's
+    stream would depend on which leg won). Hedges draw from a
+    cluster-wide retry-budget token bucket
+    (``PADDLE_ROUTER_RETRY_RATE``/``_BURST``) so a brown-out cannot
+    amplify into a retry storm — death failovers also drain the
+    bucket but proceed on empty (they are the stream's only copy).
+
 Every placement is AUDITED: the router records WHY each request landed
 where it did — policy, per-candidate load scores, chosen replica, and
 a reason from ``AUDIT_REASONS`` — in a bounded ring
@@ -74,7 +107,7 @@ import uuid
 from collections import deque
 
 from ..inference.serving import AdmissionFull
-from ..inference.telemetry import SNAPSHOT_SCHEMA_VERSION
+from ..inference.telemetry import LogHistogram, SNAPSHOT_SCHEMA_VERSION
 from .replica import ReplicaError
 
 __all__ = ["HashRing", "Router", "NoReplicaError", "POLICIES",
@@ -90,10 +123,12 @@ POLICIES = ("prefix_affinity", "least_loaded", "round_robin")
 # replica death, orphaned = failover found nowhere to go, migrated =
 # a live session moved to a new replica during a drain, scale_up /
 # scale_down = the elastic control plane changed the replica set
-# (autoscaler watermark trip or an /admin scale command)
+# (autoscaler watermark trip or an /admin scale command), hedge = a
+# speculative duplicate of an overdue greedy request (gray-failure
+# defense; first-to-first-token wins, the loser is aborted)
 AUDIT_REASONS = ("affinity_hit", "least_loaded", "round_robin", "spill",
                  "failover", "orphaned", "migrated", "scale_up",
-                 "scale_down")
+                 "scale_down", "hedge")
 
 
 class NoReplicaError(ReplicaError):
@@ -160,7 +195,8 @@ class _Assignment:
                  "tokens", "skip", "done", "state", "resubmits",
                  "t_submit", "orphaned", "failed", "dup_returns",
                  "trace_id", "ho_target", "ho_tag", "ho_blocks",
-                 "ho_busy")
+                 "ho_busy", "t_placed", "first_seen", "hedged",
+                 "hg_replica", "hg_rid", "hg_t")
 
     def __init__(self, gid, request_id, prompt, kw, replica, rid,
                  t_submit, trace_id=None):
@@ -188,6 +224,16 @@ class _Assignment:
         self.ho_tag = None
         self.ho_blocks = 0
         self.ho_busy = False              # one streaming ship at a time
+        # gray-failure defense: when the CURRENT leg was placed (the
+        # first-token latency anchor — re-set on failover/migration/
+        # hedge promotion so TTFT attributes to the serving replica),
+        # whether a fresh token has been observed, and the hedge leg
+        self.t_placed = t_submit
+        self.first_seen = False
+        self.hedged = False               # one hedge per request, ever
+        self.hg_replica = None            # hedge leg: replica name
+        self.hg_rid = None                # hedge leg: engine rid
+        self.hg_t = 0.0                   # hedge leg: placement time
 
 
 class Router:
@@ -210,7 +256,12 @@ class Router:
 
     def __init__(self, replicas, policy=None, spill_depth=None,
                  hb_dead_s=None, snap_max_age_s=None, clock=None,
-                 audit_ring=None, handoff_blocks=None):
+                 audit_ring=None, handoff_blocks=None,
+                 suspect_ratio=None, breaker_ratio=None,
+                 breaker_errs=None, breaker_cooldown_s=None,
+                 breaker_probes=None, hedge_quantile=None,
+                 hedge_margin=None, hedge_min_s=None,
+                 retry_rate=None, retry_burst=None):
         self.replicas = {r.name: r for r in replicas}
         if len(self.replicas) != len(replicas):
             raise ValueError("replica names must be unique")
@@ -287,6 +338,58 @@ class Router:
         # actually drains fine (each retry re-collapsing the window)
         self._drain_samples = deque(maxlen=16)
         self._drain_gap_s = 0.25
+        # ---- gray-failure defense (see module docstring) ----------
+        # health scoring: router-observed first-token latency EWMA per
+        # replica (it sees queueing AND service on the real placement
+        # path), plus the cluster-wide TTFT histogram the hedge delay
+        # derives from. Verdicts are cluster-MEDIAN-relative: absolute
+        # thresholds would need per-model tuning, and tail-at-scale
+        # defense only cares about outliers anyway.
+        self.suspect_ratio = float(
+            suspect_ratio if suspect_ratio is not None
+            else os.environ.get("PADDLE_ROUTER_SUSPECT_RATIO", "3.0"))
+        self.breaker_ratio = float(
+            breaker_ratio if breaker_ratio is not None
+            else os.environ.get("PADDLE_ROUTER_BREAKER_RATIO", "6.0"))
+        self.breaker_errs = int(
+            breaker_errs if breaker_errs is not None
+            else os.environ.get("PADDLE_ROUTER_BREAKER_ERRS", "3"))
+        self.breaker_cooldown_s = float(
+            breaker_cooldown_s if breaker_cooldown_s is not None
+            else os.environ.get("PADDLE_ROUTER_BREAKER_COOLDOWN_S",
+                                "2.0"))
+        self.breaker_probes = int(
+            breaker_probes if breaker_probes is not None
+            else os.environ.get("PADDLE_ROUTER_BREAKER_PROBES", "1"))
+        # hedged dispatch: 0 disables; the delay derives from the
+        # cluster's OWN TTFT distribution, not a configured constant
+        self.hedge_quantile = float(
+            hedge_quantile if hedge_quantile is not None
+            else os.environ.get("PADDLE_ROUTER_HEDGE_QUANTILE", "95"))
+        self.hedge_margin = float(
+            hedge_margin if hedge_margin is not None
+            else os.environ.get("PADDLE_ROUTER_HEDGE_MARGIN", "2.0"))
+        self.hedge_min_s = float(
+            hedge_min_s if hedge_min_s is not None
+            else os.environ.get("PADDLE_ROUTER_HEDGE_MIN_S", "0.02"))
+        # cluster-wide retry budget (token bucket over retries+hedges)
+        self.retry_rate = float(
+            retry_rate if retry_rate is not None
+            else os.environ.get("PADDLE_ROUTER_RETRY_RATE", "8.0"))
+        self.retry_burst = float(
+            retry_burst if retry_burst is not None
+            else os.environ.get("PADDLE_ROUTER_RETRY_BURST", "16"))
+        self._ttft_ewma = {}              # name -> first-token EWMA (s)
+        self._ttft_seen = {}              # name -> observation count
+        self.hist_ttft = LogHistogram()   # cluster-wide (hedge delay)
+        self._breaker = {}                # name -> breaker record
+        self.breaker_transitions = {"open": 0, "half_open": 0,
+                                    "closed": 0}
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
+        self.retry_budget_exhausted_total = 0
+        self._retry_tokens = self.retry_burst
+        self._retry_t = self.clock()
 
     # -------------------------------------------------------- snapshots
     def alive_names(self):
@@ -333,6 +436,11 @@ class Router:
                     continue
                 if snap is None:
                     self._snaps.pop(name, None)
+                    # breaker input, NOT a death verdict: enough
+                    # accumulated snapshot/transport errors shed the
+                    # replica from placement (state "open") while the
+                    # heartbeat keeps it alive
+                    self._breaker_err(name)
                 elif snap.get("schema_version") != \
                         SNAPSHOT_SCHEMA_VERSION:
                     # unknown payload: refuse to score it (drop any
@@ -342,6 +450,12 @@ class Router:
                 else:
                     self._snaps[name] = (snap, now)
                     self._prefill_cap = snap["prefill_cap"]
+                    br = self._breaker.get(name)
+                    if br is not None and br["errs"]:
+                        # DECAY (not reset) on success: a lossy link
+                        # alternating ok/error must still accumulate
+                        # toward the breaker threshold
+                        br["errs"] -= 1
             # drain-rate sample for retry_after_s: the cluster-wide
             # finished count at this instant (engine window counters —
             # monotonic between resets; a negative step from a replica
@@ -517,6 +631,340 @@ class Router:
                 self.audit.append(entry)
             self.audit_counts[reason] += 1
 
+    # ---------------------------------------------- gray-failure defense
+    def _breaker_of(self, name):
+        """Get-or-create one replica's breaker record (call under the
+        lock)."""
+        br = self._breaker.get(name)
+        if br is None:
+            br = {"state": "closed", "errs": 0, "opened_t": 0.0,
+                  "probe_gids": set()}
+            self._breaker[name] = br
+        return br
+
+    def _breaker_transition(self, name, to):
+        """Move one breaker to state ``to`` (call under the lock);
+        bumps the per-target-state transition counter in /metrics."""
+        br = self._breaker_of(name)
+        if br["state"] == to:
+            return
+        br["state"] = to
+        self.breaker_transitions[to] += 1
+        br["probe_gids"].clear()
+        if to == "open":
+            br["opened_t"] = self.clock()
+        elif to == "closed":
+            br["errs"] = 0
+
+    def _breaker_err(self, name):
+        """One transport/snapshot error against ``name`` (call under
+        the lock). NEVER a death verdict: enough accumulated errors
+        OPEN the breaker — shed from placement, still heartbeating —
+        and a half-open probe-phase error re-opens immediately."""
+        br = self._breaker_of(name)
+        br["errs"] += 1
+        if br["state"] == "closed" and br["errs"] >= self.breaker_errs:
+            self._breaker_transition(name, "open")
+        elif br["state"] == "half_open":
+            self._breaker_transition(name, "open")
+
+    def _breaker_admits(self, name):
+        """Placement gate (call under the lock): open sheds; after
+        ``breaker_cooldown_s`` the breaker half-opens and admits at
+        most ``breaker_probes`` concurrent probe placements."""
+        br = self._breaker.get(name)
+        if br is None or br["state"] == "closed":
+            return True
+        if br["state"] == "open":
+            if self.clock() - br["opened_t"] < self.breaker_cooldown_s:
+                return False
+            self._breaker_transition(name, "half_open")
+        # prune probe gids whose request no longer lives here
+        # (released / failed over / hedged away before the first
+        # token): a vanished probe must not wedge the breaker
+        # half-open with its only probe slot occupied forever
+        live = set()
+        for g in br["probe_gids"]:
+            a = self._table.get(g)
+            if a is not None and not a.done and a.replica == name:
+                live.add(g)
+        br["probe_gids"] = live
+        return len(br["probe_gids"]) < self.breaker_probes
+
+    def breaker_state(self, name):
+        """closed | half_open | open (public, for /healthz + drills)."""
+        with self._lock:
+            br = self._breaker.get(name)
+            return "closed" if br is None else br["state"]
+
+    def _health_signals(self):
+        """Per-replica slowness signal in seconds (call under the
+        lock; lower = better): the router-observed first-token EWMA
+        once it has >= 3 observations, else the engine's own
+        step-duration EWMA from the v6 snapshot ``health`` block,
+        else None (no data — never judged on ignorance)."""
+        vals = {}
+        for n in self.alive_names():
+            v = None
+            if self._ttft_seen.get(n, 0) >= 3:
+                v = self._ttft_ewma[n]
+            else:
+                snap = self._snap(n)
+                if snap is not None:
+                    sv = float((snap.get("health") or {})
+                               .get("step_ewma_s", 0.0) or 0.0)
+                    if sv > 0.0:
+                        v = sv
+            vals[n] = v
+        return vals
+
+    def health_status(self):
+        """Cluster-median-relative gray-failure verdicts, one entry
+        per alive replica: ``{"verdict": healthy|suspect|degraded,
+        "signal_s", "median_s", "breaker", "consecutive_errors"}``.
+        Judged RELATIVE to the cluster median (suspect_ratio /
+        breaker_ratio multiples) — exposed via /healthz and
+        /metrics."""
+        with self._lock:
+            vals = self._health_signals()
+            known = sorted(v for v in vals.values() if v is not None)
+            med = known[len(known) // 2] if known else None
+            out = {}
+            for n, v in vals.items():
+                verdict = "healthy"
+                if (v is not None and med is not None and med > 0.0
+                        and len(known) >= 2):
+                    if v >= self.breaker_ratio * med:
+                        verdict = "degraded"
+                    elif v >= self.suspect_ratio * med:
+                        verdict = "suspect"
+                br = self._breaker.get(n)
+                out[n] = {
+                    "verdict": verdict,
+                    "signal_s": v,
+                    "median_s": med,
+                    "breaker": ("closed" if br is None
+                                else br["state"]),
+                    "consecutive_errors": (0 if br is None
+                                           else br["errs"]),
+                }
+            return out
+
+    def _observe_ttft(self, name, dt, gid=None, hist=True):
+        """One first-token-latency observation against ``name`` (call
+        under the lock): feeds the per-replica EWMA, the cluster TTFT
+        histogram (the hedge-delay source), and — when ``gid`` is a
+        half-open breaker probe — the probe verdict: close on a
+        non-outlier TTFT, re-open on an outlier. ``hist=False`` keeps
+        a PENALTY reading (a hedge loser's pending age) out of the
+        histogram: it must inflate the sick replica's EWMA, but
+        letting it poison the cluster-wide delay source would make
+        every subsequent hedge slower exactly when hedges are most
+        needed — a positive feedback loop."""
+        dt = max(float(dt), 0.0)
+        prev = self._ttft_ewma.get(name)
+        self._ttft_ewma[name] = dt if prev is None else (
+            0.7 * prev + 0.3 * dt)
+        self._ttft_seen[name] = self._ttft_seen.get(name, 0) + 1
+        if hist:
+            self.hist_ttft.observe(dt)
+        br = self._breaker.get(name)
+        if br is not None and br["state"] == "half_open" \
+                and gid in br["probe_gids"]:
+            br["probe_gids"].discard(gid)
+            others = sorted(
+                v for n2, v in self._health_signals().items()
+                if n2 != name and v is not None)
+            med = others[len(others) // 2] if others else None
+            if med is not None and med > 0.0 \
+                    and dt >= self.breaker_ratio * med:
+                self._breaker_transition(name, "open")
+            else:
+                # recovered: seed the EWMA from the fresh probe
+                # reading — slow-era history must not re-trip it
+                self._ttft_ewma[name] = dt
+                self._breaker_transition(name, "closed")
+
+    def _take_retry_token(self, force=False):
+        """Cluster-wide retry budget (token bucket over retries +
+        hedges). Hedges are SPECULATIVE and strictly require a token;
+        a death failover is the stream's ONLY copy, so it proceeds
+        even on an empty bucket (``force=True``) — the exhausted
+        counter still records that the cluster is in retry debt."""
+        with self._lock:
+            now = self.clock()
+            self._retry_tokens = min(
+                self.retry_burst,
+                self._retry_tokens + self.retry_rate
+                * max(0.0, now - self._retry_t))
+            self._retry_t = now
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                return True
+            self.retry_budget_exhausted_total += 1
+            return bool(force)
+
+    def _drop_hedge(self, asg, dead=None):
+        """Release ``asg``'s hedge leg, if any (replica I/O outside
+        the lock): the assignment is moving (migration/handoff) or
+        ending, and a speculative duplicate must never outlive the
+        decision. ``dead`` skips the release on a corpse."""
+        with self._lock:
+            hg_name, hg_rid = asg.hg_replica, asg.hg_rid
+            asg.hg_replica, asg.hg_rid = None, None
+            rep = (self.replicas.get(hg_name)
+                   if hg_name is not None and hg_name != dead
+                   else None)
+        if rep is not None:
+            rep.release(hg_rid)
+
+    def _maybe_hedge(self, asg):
+        """Hedged dispatch trigger (called from the harvest path,
+        replica I/O outside the lock): a GREEDY request whose first
+        token is overdue — older than the cluster's own TTFT
+        p(hedge_quantile) x hedge_margin — is speculatively
+        re-submitted to the next-best replica. One hedge per request,
+        ever; sampled traffic never hedges (the legs would diverge);
+        the retry budget strictly gates it."""
+        if self.hedge_quantile <= 0:
+            return
+        with self._lock:
+            if (asg.done or asg.orphaned or asg.hedged
+                    or asg.first_seen or asg.replica is None
+                    or asg.hg_rid is not None):
+                return
+            owner = asg.replica
+            # greedy-only safety gate (v6 snapshots carry do_sample;
+            # absent/unknown reads as NOT greedy — never hedge on a
+            # guess): a sampled stream re-draws its per-request seed
+            # on each engine submit, so two legs would DIVERGE and
+            # the delivered stream would depend on which leg won.
+            # Greedy decoding is bit-identical across replicas,
+            # making first-to-first-token a pure latency race.
+            snap = self._snap(owner)
+            if snap is None:
+                for n2 in self.placeable_names():
+                    snap = self._snap(n2)
+                    if snap is not None:
+                        break
+            if snap is None or snap.get("do_sample") is not False:
+                return
+            if self.hist_ttft.count < 8:
+                return            # no distribution to derive from yet
+            p = self.hist_ttft.percentile(self.hedge_quantile)
+            delay = max((p or 0.0) * self.hedge_margin,
+                        self.hedge_min_s)
+            if self.clock() - asg.t_placed <= delay:
+                return
+            cands = [n for n in self.placeable_names()
+                     if n != owner and self.prefill_capable(n)
+                     and self._breaker_admits(n)]
+            if not cands:
+                return
+            target = self._least_loaded(cands)
+            asg.hedged = True     # one attempt per request, win or lose
+            attempt = asg.resubmits + 2
+            kw = dict(asg.kw)
+        if kw.get("deadline_s") is not None:
+            remaining = kw["deadline_s"] - (self.clock()
+                                            - asg.t_submit)
+            if remaining <= 0:
+                return            # the deadline path expires it
+            kw["deadline_s"] = remaining
+        if not self._take_retry_token():
+            return                # budget empty: no speculative copies
+        try:
+            rid = self.replicas[target].submit(
+                asg.prompt, trace_id=asg.trace_id, attempt=attempt,
+                **kw)
+        except (AdmissionFull, ReplicaError):
+            return                # opportunistic: no retry walk
+        with self._lock:
+            live = (asg.gid in self._table and not asg.done
+                    and not asg.orphaned and not asg.first_seen
+                    and asg.hg_rid is None)
+            if live:
+                asg.hg_replica, asg.hg_rid = target, rid
+                asg.hg_t = self.clock()
+                self.hedges_total += 1
+                stray = None
+            else:                 # finished/released while submitting
+                stray = self.replicas.get(target)
+        if stray is not None:
+            stray.release(rid)
+            return
+        self._record_decision(asg, target, "hedge", {}, attempt)
+
+    def _poll_hedge(self, asg, leg, base):
+        """Poll ``asg``'s hedge leg (replica I/O outside the lock) and
+        decide the race when it produced tokens: promote the leg if
+        the owner is still silent (the owner becomes the loser), else
+        abort it. The loser is released through the normal path — its
+        tokens never enter the delivered history, so they are never
+        streamed or billed. Returns the updated harvest triple after
+        a promotion, else None."""
+        hname, hrid = leg
+        rep = self.replicas.get(hname)
+        if rep is None:
+            with self._lock:
+                if (asg.hg_replica, asg.hg_rid) == leg:
+                    asg.hg_replica, asg.hg_rid = None, None
+            return None
+        try:
+            hnew, hdone, hstate = rep.harvest(hrid)
+        except ReplicaError:
+            # the hedge leg was speculative: drop it, leave the death
+            # verdict to the heartbeat sweep
+            with self._lock:
+                if (asg.hg_replica, asg.hg_rid) == leg:
+                    asg.hg_replica, asg.hg_rid = None, None
+            return None
+        loser = None
+        out = None
+        with self._lock:
+            if (asg.hg_replica, asg.hg_rid) != leg \
+                    or asg.done or asg.orphaned:
+                return None
+            if not hnew:
+                if hdone:         # zero-token finish: useless leg
+                    asg.hg_replica, asg.hg_rid = None, None
+                return None
+            if asg.first_seen:
+                # the owner answered while we polled: hedge lost
+                asg.hg_replica, asg.hg_rid = None, None
+                loser = leg
+            else:
+                # hedge wins: promote the leg, the old owner is the
+                # loser. Its pending age is ITS first-token
+                # observation — the slow replica's EWMA inflates NOW,
+                # not whenever it finally answers.
+                loser = (asg.replica, asg.rid)
+                # gid passes through: if this request was the loser's
+                # half-open breaker PROBE, being hedged away IS the
+                # probe verdict (an outlier pending age re-opens) —
+                # otherwise the probe slot would stay occupied by a
+                # request that no longer lives there
+                self._observe_ttft(loser[0],
+                                   self.clock() - asg.t_placed,
+                                   gid=asg.gid, hist=False)
+                asg.replica, asg.rid = hname, hrid
+                asg.t_placed = asg.hg_t
+                asg.hg_replica, asg.hg_rid = None, None
+                asg.resubmits += 1
+                asg.tokens.extend(hnew)
+                asg.first_seen = True
+                self._observe_ttft(hname, self.clock() - asg.hg_t,
+                                   gid=asg.gid)
+                if hdone:
+                    asg.done, asg.state = True, hstate
+                self.hedge_wins_total += 1
+                out = (list(asg.tokens[base:]), asg.done, asg.state)
+        if loser is not None:
+            lrep = self.replicas.get(loser[0])
+            if lrep is not None:
+                lrep.release(loser[1])
+        return out
+
     # ------------------------------------------------------- submit path
     def submit(self, prompt, request_id=None, trace_id=None, **kw):
         """Route one request; returns the gateway-global id (gid).
@@ -574,6 +1022,7 @@ class Router:
             raise
         with self._lock:
             asg.replica, asg.rid = name, rid
+            asg.t_placed = self.clock()
             # the chosen replica may have been declared dead between
             # our successful engine submit and this bookkeeping write
             # — mark_dead's drain skipped the still-placement-pending
@@ -608,6 +1057,14 @@ class Router:
                 # replicas, not strand them on a decode pool)
                 names = [n for n in self.placeable_names()
                          if n not in tried and self.prefill_capable(n)]
+                # gray-failure shed: an open breaker drops the replica
+                # from placement WITHOUT declaring it dead. Availability
+                # beats purity — when every candidate's breaker is open
+                # the unfiltered set stays (serve slow over serve
+                # nothing)
+                ok = [n for n in names if self._breaker_admits(n)]
+                if ok:
+                    names = ok
                 if names:
                     name, reason = self._choose(prompt, names)
                     # the per-candidate score dict exists only for the
@@ -635,6 +1092,13 @@ class Router:
                 self.mark_dead(name)
             else:
                 if asg is not None:
+                    with self._lock:
+                        br = self._breaker.get(name)
+                        if br is not None and br["state"] == "half_open":
+                            # this placement IS the recovery probe: its
+                            # first-token latency closes or re-opens the
+                            # breaker (_observe_ttft)
+                            br["probe_gids"].add(asg.gid)
                     self._record_decision(
                         asg, name,
                         reason_override or ("spill" if shed else reason),
@@ -697,6 +1161,24 @@ class Router:
                 asg.skip -= drop
                 new = new[drop:]
             asg.tokens.extend(new)
+            if new and not asg.first_seen:
+                # first delivered token: the owner answered — feed the
+                # health EWMA + cluster TTFT histogram (and settle a
+                # half-open breaker probe, if this placement was one)
+                asg.first_seen = True
+                self._observe_ttft(epoch[0],
+                                   self.clock() - asg.t_placed,
+                                   gid=gid)
+            # a hedge leg racing this stream loses the moment the owner
+            # produces (or finishes): capture it for release outside
+            # the lock. A still-silent owner leaves the leg up for the
+            # poll below.
+            hg_release = None
+            if asg.hg_rid is not None and (new or done):
+                hg_release = (asg.hg_replica, asg.hg_rid)
+                asg.hg_replica, asg.hg_rid = None, None
+            hedge_poll = ((asg.hg_replica, asg.hg_rid)
+                          if asg.hg_rid is not None else None)
             if done:
                 asg.done, asg.state = True, state
             out = (list(asg.tokens[base:]), done, state)
@@ -712,6 +1194,24 @@ class Router:
                        else "stream" if (state == "running"
                                          and self._handoff_blocks > 0)
                        else None)
+        if hg_release is not None:
+            lrep = self.replicas.get(hg_release[0])
+            if lrep is not None:
+                lrep.release(hg_release[1])
+        if hedge_poll is not None:
+            # owner still silent, hedge leg up: poll it — a promotion
+            # repoints the assignment at the hedge replica and the
+            # delivered stream continues from ITS tokens
+            promoted = self._poll_hedge(asg, hedge_poll, base)
+            if promoted is not None:
+                out = promoted
+                done = out[1]
+                handoff = None
+        elif not done and not out[0]:
+            # no tokens, no hedge yet: maybe the owner is gray-slow —
+            # the hedge trigger compares its silence to the cluster's
+            # own TTFT distribution
+            self._maybe_hedge(asg)
         if done:
             self._drop_stage(asg)
         elif handoff == "full":
@@ -758,6 +1258,8 @@ class Router:
                 rep = self.replicas.get(asg.replica)
         if rep is not None:
             rep.release(asg.rid)
+        if asg.hg_rid is not None:
+            self._drop_hedge(asg)
         if asg.ho_tag is not None:
             self._drop_stage(asg)
 
@@ -781,6 +1283,17 @@ class Router:
                 continue
             self.mark_dead(name)
             died.append(name)
+        # gray-failure sweep: a replica whose latency signal is a
+        # breaker_ratio outlier against the cluster median is DEGRADED
+        # — open its breaker (shed from placement, keep heartbeating;
+        # half-open probes re-admit it once it recovers). Deliberately
+        # NOT a death: its in-flight streams keep draining.
+        status = self.health_status()
+        with self._lock:
+            for n, st in status.items():
+                if st["verdict"] == "degraded" \
+                        and self._breaker_of(n)["state"] == "closed":
+                    self._breaker_transition(n, "open")
         return died
 
     def mark_dead(self, name):
@@ -805,6 +1318,10 @@ class Router:
                        and not asg.orphaned]
             for asg in victims:
                 asg.replica, asg.rid = None, None
+            # hedge legs parked on the corpse are gone with it
+            for asg in self._table.values():
+                if asg.hg_replica == name:
+                    asg.hg_replica, asg.hg_rid = None, None
         for asg in victims:
             self._failover_one(asg)
 
@@ -819,6 +1336,23 @@ class Router:
             # the replayed prompt re-prefills from scratch — a staged
             # prefix from the dead leg is garbage on its target
             self._drop_stage(asg)
+        # a live hedge leg IS the failover, already paid for: promote
+        # it instead of burning a third prefill of the same prompt
+        with self._lock:
+            hg_name, hg_rid = asg.hg_replica, asg.hg_rid
+            if hg_rid is not None and hg_name not in self.dead \
+                    and hg_name in self.replicas \
+                    and asg.gid in self._table \
+                    and not asg.done and not asg.orphaned:
+                asg.skip = len(asg.tokens)
+                asg.replica, asg.rid = hg_name, hg_rid
+                asg.t_placed = asg.hg_t
+                asg.hg_replica, asg.hg_rid = None, None
+                asg.resubmits += 1
+                self.failovers_total += 1
+                return
+            # a leg on a dead/gone replica is just forgotten
+            asg.hg_replica, asg.hg_rid = None, None
         kw = dict(asg.kw)
         if kw.get("deadline_s") is not None:
             remaining = kw["deadline_s"] - (self.clock()
@@ -828,6 +1362,9 @@ class Router:
                     asg.done, asg.state = True, "expired"
                 return
             kw["deadline_s"] = remaining
+        # death failovers draw on the retry budget but are never
+        # blocked by it (force=True): this is the stream's only copy
+        self._take_retry_token(force=True)
         # same trace id, NEXT attempt: the re-submitted stream joins
         # the original's trace (resubmits bumps only after placement
         # lands, so attempt = prior resubmits + this one + 1)
@@ -848,6 +1385,7 @@ class Router:
             if asg.gid in self._table and not asg.done:
                 asg.skip = len(asg.tokens)
                 asg.replica, asg.rid = new_name, rid
+                asg.t_placed = self.clock()
                 asg.resubmits += 1
                 self.failovers_total += 1
                 stray = None
@@ -918,6 +1456,8 @@ class Router:
         # refresh() throttles itself to snap_max_age_s, so the steady
         # state costs nothing extra
         self.refresh()
+        if asg.hg_rid is not None:
+            self._drop_hedge(asg)
         with self._lock:
             if asg.done or asg.orphaned or asg.replica is None \
                     or asg.rid is None or asg.ho_busy:
@@ -1042,6 +1582,7 @@ class Router:
                 if asg.gid in self._table and not asg.done:
                     asg.skip = len(asg.tokens)
                     asg.replica, asg.rid = tgt_name, rid2
+                    asg.t_placed = self.clock()
                     stray = None
                     if tgt_name != src_name:
                         asg.resubmits += 1
@@ -1258,6 +1799,9 @@ class Router:
             # any streamed prefix staged for the handoff path is
             # stale the moment the session moves
             self._drop_stage(asg)
+        if asg.hg_rid is not None:
+            # a speculative duplicate must not chase a moving session
+            self._drop_hedge(asg)
         # final harvest first: a request that FINISHED on the engine but
         # was not yet collected needs its tokens drained, not a
         # migration (exporting it would fail and the fallback would
@@ -1351,6 +1895,7 @@ class Router:
             if asg.gid in self._table and not asg.done:
                 asg.skip = len(asg.tokens)
                 asg.replica, asg.rid = tgt_name, rid2
+                asg.t_placed = self.clock()
                 asg.resubmits += 1
                 self.migrations_total += 1
                 stray = None
@@ -1443,6 +1988,35 @@ class Router:
             for d in ("up", "down"):
                 lines.append(f'{name}{{direction="{d}"}} '
                              f"{self.scale_events[d]}")
+            # circuit-breaker state machine traffic (zero-initialized:
+            # the label set is discoverable before any gray failure)
+            name = "paddle_gateway_breaker_transitions_total"
+            lines.append(f"# HELP {name} circuit breaker state "
+                         "transitions by target state")
+            lines.append(f"# TYPE {name} counter")
+            for to in ("open", "half_open", "closed"):
+                lines.append(f'{name}{{to="{to}"}} '
+                             f"{self.breaker_transitions[to]}")
+        # per-replica gray-failure verdicts + breaker states (encoded
+        # gauges: 0 healthy/closed, 1 suspect/half_open, 2
+        # degraded/open); health_status takes the lock itself
+        status = self.health_status()
+        vmap = {"healthy": 0, "suspect": 1, "degraded": 2}
+        bmap = {"closed": 0, "half_open": 1, "open": 2}
+        name = "paddle_gateway_replica_health_state"
+        lines.append(f"# HELP {name} gray-failure verdict "
+                     "(0=healthy 1=suspect 2=degraded)")
+        lines.append(f"# TYPE {name} gauge")
+        for n in sorted(status):
+            lines.append(f'{name}{{replica="{n}"}} '
+                         f'{vmap[status[n]["verdict"]]}')
+        name = "paddle_gateway_breaker_state"
+        lines.append(f"# HELP {name} circuit breaker state "
+                     "(0=closed 1=half_open 2=open)")
+        lines.append(f"# TYPE {name} gauge")
+        for n in sorted(status):
+            lines.append(f'{name}{{replica="{n}"}} '
+                         f'{bmap[status[n]["breaker"]]}')
         with self._lock:
             gauges = (
                 ("paddle_gateway_replicas_alive", "gauge",
@@ -1465,7 +2039,20 @@ class Router:
                  "prefill->decode KV handoffs completed (disagg)"),
                 ("paddle_gateway_snapshot_version_mismatches_total",
                  "counter", self.version_mismatches,
-                 "snapshots refused for schema_version drift"))
+                 "snapshots refused for schema_version drift"),
+                ("paddle_gateway_hedges_total", "counter",
+                 self.hedges_total,
+                 "speculative duplicate dispatches (greedy only)"),
+                ("paddle_gateway_hedge_wins_total", "counter",
+                 self.hedge_wins_total,
+                 "hedge legs that beat the original to first token"),
+                ("paddle_gateway_retry_budget_exhausted_total",
+                 "counter", self.retry_budget_exhausted_total,
+                 "retry/hedge attempts that found the token bucket "
+                 "empty"),
+                ("paddle_gateway_retry_budget_tokens", "gauge",
+                 round(self._retry_tokens, 4),
+                 "retry/hedge token bucket level"))
         for gname, typ, val, help_ in gauges:
             lines.append(f"# HELP {gname} {help_}")
             lines.append(f"# TYPE {gname} {typ}")

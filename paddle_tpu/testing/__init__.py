@@ -18,6 +18,10 @@ FI_ENV_VARS = (
     "PADDLE_FI_HANG",           # rank that hangs (bounded sleep) at the point
     "PADDLE_FI_KILL_RANK",      # rank that hard-exits (os._exit) at the point
     "PADDLE_FI_RAISE",          # rank that raises FaultInjected at the point
+    "PADDLE_FI_RPC_DELAY_MS",   # flaky transport: per-rpc-call delay
+    "PADDLE_FI_RPC_ERR_RATE",   # flaky transport: deterministic error frac
+    "PADDLE_FI_SLOW_MS",        # gray failure: persistent delay at a point
+    "PADDLE_FI_SLOW_POINT",     # which hook point the slowness rides
 )
 
 # Flight-recorder configuration (distributed/resilience/flight_recorder.py)
@@ -76,9 +80,27 @@ GW_ENV_VARS = (
     "PADDLE_ROLE_HANDOFF_BLOCKS",  # streamed-handoff chunk (0 = off)
     "PADDLE_ROUTER_AUDIT_RING",    # decision ring (0 = ring off;
                                    # reason counters stay)
+    # gray-failure defense (serving_cluster/router.py): a leaked breaker
+    # threshold or hedge quantile silently changes which replicas every
+    # later cluster sheds and when it speculates — guard them all
+    "PADDLE_ROUTER_BREAKER_COOLDOWN_S",  # open -> half-open delay (s)
+    "PADDLE_ROUTER_BREAKER_ERRS",  # consecutive errors -> breaker open
+    "PADDLE_ROUTER_BREAKER_PROBES",  # concurrent half-open placements
+    "PADDLE_ROUTER_BREAKER_RATIO",  # x cluster median -> degraded/open
+    "PADDLE_ROUTER_HEDGE_MARGIN",  # hedge delay = pXX * margin
+    "PADDLE_ROUTER_HEDGE_MIN_S",   # hedge delay floor (s)
+    "PADDLE_ROUTER_HEDGE_QUANTILE",  # TTFT percentile (0 = hedging off)
     "PADDLE_ROUTER_POLICY",        # prefix_affinity|least_loaded|round_robin
+    "PADDLE_ROUTER_RETRY_BURST",   # retry/hedge token-bucket capacity
+    "PADDLE_ROUTER_RETRY_RATE",    # retry/hedge bucket refill (tokens/s)
     "PADDLE_ROUTER_SNAP_AGE_S",    # snapshot staleness bound
     "PADDLE_ROUTER_SPILL_DEPTH",   # owner queue depth -> affinity spill
+    "PADDLE_ROUTER_SUSPECT_RATIO",  # x cluster median -> suspect verdict
+    # rpc client timeouts (distributed/rpc.py + serving_cluster/
+    # replica.py RpcReplica): a leaked timeout silently changes how fast
+    # every later cluster declares a frozen replica dead
+    "PADDLE_RPC_PING_TIMEOUT_S",   # liveness-probe rpc timeout
+    "PADDLE_RPC_TIMEOUT_S",        # per-call rpc client timeout
     # SLO objectives (inference/telemetry.py SloPolicy): a leaked
     # objective silently flips every later engine's goodput counters —
     # same guard discipline as the router knobs

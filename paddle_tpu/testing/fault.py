@@ -47,10 +47,35 @@ Knobs (registered in paddle_tpu.testing.FI_ENV_VARS):
                                 the caller's abort path must handle
                                 the exception exactly like a transport
                                 error)
+  PADDLE_FI_SLOW_MS=<ms>        GRAY-FAILURE flavor: the named point
+                                (PADDLE_FI_SLOW_POINT, default "step")
+                                sleeps <ms> on EVERY occurrence from
+                                the PADDLE_FI_AT_STEP-th onward (unset
+                                AT_STEP = from the first). Unlike
+                                KILL/HANG/RAISE this is PERSISTENT —
+                                a slow replica stays slow until the
+                                env is cleared — because gray failure
+                                is a condition, not an event. The
+                                process stays alive and keeps beating
+                                its heartbeat: the router's health
+                                scoring / circuit breaker, not death
+                                detection, must shed it.
+  PADDLE_FI_SLOW_POINT=<name>   which hook point the slowness rides
+                                (any inject() point name)
+  PADDLE_FI_RPC_DELAY_MS=<ms>   flaky-transport: every rpc client
+                                call sleeps <ms> before the wire
+  PADDLE_FI_RPC_ERR_RATE=<f>    flaky-transport: fraction of rpc
+                                client calls (deterministic
+                                accumulator, not random) that raise
+                                FaultInjected instead of sending —
+                                the caller must treat it exactly like
+                                a transport error (ReplicaError path)
 
 Injections fire at most once per process (a restarted generation whose
 env cleared the vars is unaffected; one that kept them re-injects —
 companions gate on PADDLE_RESTART_COUNT to fault only generation 0).
+The SLOW and RPC flavors are the exception: they model a *condition*
+(degraded host, lossy link) and fire on every qualifying call.
 """
 from __future__ import annotations
 
@@ -60,7 +85,8 @@ import time
 from . import FI_ENV_VARS
 
 __all__ = ["inject", "heartbeat_dropped", "step_count", "reset",
-           "FaultInjected", "FI_EXIT_CODE", "HANG_BOUND_S"]
+           "slow_s", "rpc_flaky", "FaultInjected", "FI_EXIT_CODE",
+           "HANG_BOUND_S"]
 
 FI_EXIT_CODE = 43          # distinctive: never collides with signal codes
 HANG_BOUND_S = 3600.0      # a "hang" is a bounded sleep, not a true wedge
@@ -73,14 +99,19 @@ class FaultInjected(RuntimeError):
 
 _steps = 0                 # "step"-point calls observed in this process
 _point_counts: dict = {}   # point -> calls observed (AT_POINT mode)
+_slow_counts: dict = {}    # point -> calls observed (SLOW gating)
 _fired = False
+_rpc_calls = 0             # rpc client calls observed (flaky accounting)
+_rpc_errs = 0              # flaky errors already raised
 
 
 def reset():
     """Re-arm the harness (in-process tests; subprocesses never need it)."""
-    global _steps, _fired
+    global _steps, _fired, _rpc_calls, _rpc_errs
     _steps, _fired = 0, False
+    _rpc_calls, _rpc_errs = 0, 0
     _point_counts.clear()
+    _slow_counts.clear()
 
 
 def step_count() -> int:
@@ -99,6 +130,52 @@ def heartbeat_dropped(rank=None) -> bool:
     """Consulted by the watchdog's publisher before every beat."""
     r = str(rank) if rank is not None else _rank()
     return os.environ.get("PADDLE_FI_DROP_HEARTBEAT") == r
+
+
+def slow_s(point: str) -> float:
+    """Seconds of injected slowness for THIS occurrence of `point`.
+
+    Advances the point's private occurrence counter; returns 0.0 when
+    disarmed or before the PADDLE_FI_AT_STEP-th occurrence. Persistent:
+    every occurrence from the threshold onward is slowed (gray failure
+    is a condition, not a one-shot event), so `_fired` is not consulted.
+    """
+    ms = os.environ.get("PADDLE_FI_SLOW_MS")
+    if ms in (None, ""):
+        return 0.0
+    target = os.environ.get("PADDLE_FI_SLOW_POINT", "step") or "step"
+    if point != target:
+        return 0.0
+    idx = _slow_counts.get(point, 0)
+    _slow_counts[point] = idx + 1
+    at = os.environ.get("PADDLE_FI_AT_STEP")
+    if at not in (None, "") and idx < int(at):
+        return 0.0
+    return float(ms) / 1000.0
+
+
+def rpc_flaky():
+    """Flaky-transport hook: called by the rpc client before every call.
+
+    Applies PADDLE_FI_RPC_DELAY_MS as a pre-wire sleep, then raises
+    FaultInjected for a PADDLE_FI_RPC_ERR_RATE fraction of calls. The
+    error schedule is a DETERMINISTIC accumulator (fire whenever the
+    running error count falls behind rate * calls), not a coin flip —
+    chaos drills must reproduce bit-for-bit across runs.
+    """
+    global _rpc_calls, _rpc_errs
+    delay = os.environ.get("PADDLE_FI_RPC_DELAY_MS")
+    rate = os.environ.get("PADDLE_FI_RPC_ERR_RATE")
+    if delay in (None, "") and rate in (None, ""):
+        return
+    _rpc_calls += 1
+    if delay not in (None, ""):
+        time.sleep(float(delay) / 1000.0)
+    if rate not in (None, ""):
+        if _rpc_errs < int(float(rate) * _rpc_calls):
+            _rpc_errs += 1
+            raise FaultInjected(
+                f"injected rpc transport error (call {_rpc_calls})")
 
 
 def _should_fire(point: str) -> bool:
@@ -135,6 +212,9 @@ def inject(point: str, rank=None):
     global _steps, _fired
     if not _armed():
         return
+    d = slow_s(point)          # gray-failure flavor: slow, don't die
+    if d > 0.0:
+        time.sleep(d)
     hit = _should_fire(point)
     if not hit or _fired:
         return

@@ -364,6 +364,86 @@ class TestFaultHarness:
         assert fault.heartbeat_dropped(2)
         assert not fault.heartbeat_dropped(0)
 
+    def test_slow_injection_is_persistent_and_gated(self, monkeypatch):
+        """The gray-failure flavor: PADDLE_FI_SLOW_MS slows EVERY
+        occurrence of the target point from the AT_STEP-th onward
+        (slowness is a condition, not a one-shot event) and leaves
+        every other point untouched."""
+        fault.reset()
+        monkeypatch.setenv("PADDLE_FI_SLOW_MS", "40")
+        monkeypatch.setenv("PADDLE_FI_SLOW_POINT", "serve_step")
+        monkeypatch.setenv("PADDLE_FI_AT_STEP", "2")
+        assert fault.slow_s("init") == 0.0        # wrong point: never
+        # occurrences 0 and 1 are below the AT_STEP gate...
+        assert fault.slow_s("serve_step") == 0.0
+        assert fault.slow_s("serve_step") == 0.0
+        # ...then EVERY occurrence is slowed (persistent, unlike KILL)
+        assert fault.slow_s("serve_step") == pytest.approx(0.040)
+        assert fault.slow_s("serve_step") == pytest.approx(0.040)
+        fault.reset()
+
+    def test_slow_injection_sleeps_in_inject(self, monkeypatch):
+        fault.reset()
+        monkeypatch.setenv("PADDLE_FI_SLOW_MS", "30")
+        monkeypatch.setenv("PADDLE_FI_SLOW_POINT", "step")
+        t0 = time.monotonic()
+        fault.inject("step")
+        assert time.monotonic() - t0 >= 0.025
+        fault.reset()
+
+    def test_rpc_flaky_schedule_is_deterministic(self, monkeypatch):
+        """The flaky-transport error schedule is an accumulator, not a
+        coin flip: exactly rate * calls errors after N calls, at the
+        same call indices on every run (chaos drills must reproduce)."""
+        fault.reset()
+        monkeypatch.setenv("PADDLE_FI_RPC_ERR_RATE", "0.3")
+
+        def run(n):
+            idxs = []
+            for i in range(n):
+                try:
+                    fault.rpc_flaky()
+                except fault.FaultInjected:
+                    idxs.append(i)
+            return idxs
+
+        first = run(20)
+        assert len(first) == 6                    # floor(0.3 * 20)
+        fault.reset()
+        monkeypatch.setenv("PADDLE_FI_RPC_ERR_RATE", "0.3")
+        assert run(20) == first                   # bit-for-bit replay
+        fault.reset()
+
+    def test_rpc_flaky_surfaces_as_replica_error(self, monkeypatch):
+        """An injected transport error reaching RpcReplica._call must
+        map to ReplicaError (the router's failover contract), exactly
+        like a real timeout/connection failure."""
+        from paddle_tpu.serving_cluster.replica import (ReplicaError,
+                                                        RpcReplica)
+        fault.reset()
+        monkeypatch.setenv("PADDLE_FI_RPC_ERR_RATE", "1.0")
+        rep = RpcReplica.__new__(RpcReplica)      # no live worker needed
+        rep.name = "w0"
+        rep._dead = False
+        rep._timeout = 1.0
+        from paddle_tpu.serving_cluster.replica import _HealthMeter
+        rep._health = _HealthMeter()
+
+        class _Stub:                              # the client-side hook
+            def rpc_sync(self, name, fn, args=(), timeout=None):
+                fault.rpc_flaky()                 # rides _call_inner
+                return fn(*args)
+
+        rep._rpc = _Stub()
+
+        def _rw_submit():
+            return "never reached"
+
+        with pytest.raises(ReplicaError):
+            rep._call(_rw_submit)
+        assert rep._health.stats()["errors_total"] == 1
+        fault.reset()
+
     def test_kill_at_step_exits_with_fi_code(self, tmp_path):
         code = ("from paddle_tpu.testing import fault\n"
                 "for i in range(5):\n"
@@ -676,8 +756,13 @@ open(f"{workdir}/gen.{gen}.{rank_s}", "w").write("1")
 if gen == 0:
     # generation 0: rank 1 goes dark mid-run — heartbeat publisher
     # silenced AND the rank wedges at train step 2 (hang, not crash: the
-    # harder failure mode, invisible to the supervisor's exit polling)
-    os.environ["PADDLE_FI_DROP_HEARTBEAT"] = "1"
+    # harder failure mode, invisible to the supervisor's exit polling).
+    # DROP_HEARTBEAT is armed inside the loop AT the wedge step, not
+    # here: the publisher consults the env before every beat, so arming
+    # it now would silence rank 1 from t=0 — and when jit compilation
+    # pushes the first steps past the watchdog window, rank 0 would
+    # detect the "dead" peer before committing a single checkpoint,
+    # leaving generation 1 nothing to resume from.
     os.environ["PADDLE_FI_HANG"] = "1"
     os.environ["PADDLE_FI_AT_STEP"] = "2"
 os.environ["PADDLE_WATCHDOG_TIMEOUT_S"] = "2"
@@ -713,6 +798,8 @@ w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
 
 try:
     for step in range(start, steps):
+        if gen == 0 and rank == 1 and step == 2:
+            os.environ["PADDLE_FI_DROP_HEARTBEAT"] = "1"
         x = paddle.to_tensor(xs[step])
         y = paddle.to_tensor(xs[step] @ w_true)
         loss = ((m(x) - y) ** 2).mean()

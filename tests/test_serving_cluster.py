@@ -1267,7 +1267,9 @@ def test_gateway_env_registry_complete():
     The SLO knobs live in inference/telemetry.py (SloPolicy.from_env)
     and the QoS shares + engine role in inference/serving.py, so both
     files join the scan; the autoscale knobs live in
-    serving_cluster/autoscale.py (already in the package scan)."""
+    serving_cluster/autoscale.py (already in the package scan); the
+    RPC client timeouts are read by serving_cluster/replica.py
+    (RpcReplica), also in the package scan."""
     import re
 
     import paddle_tpu.inference.serving as serving_mod
@@ -1284,7 +1286,7 @@ def test_gateway_env_registry_complete():
         with open(path) as f:
             found |= set(re.findall(
                 r"PADDLE_(?:(?:GATEWAY|ROUTER|SLO|AUTOSCALE|QOS"
-                r"|TENANT|ROLE)_[A-Z_0-9]+|ROLE\b)",
+                r"|TENANT|ROLE|RPC)_[A-Z_0-9]+|ROLE\b)",
                 f.read()))
     # the rpc-replica probe knob lives in replica.py; bench/tests may
     # reference more — the guard list must cover everything READ here
@@ -1297,3 +1299,298 @@ def test_gateway_env_registry_complete():
     # guard list (one source of truth for the knob names)
     from paddle_tpu.inference.telemetry import SLO_ENV_VARS
     assert set(SLO_ENV_VARS) <= set(GW_ENV_VARS)
+
+
+# =====================================================================
+# gray-failure defense: health scoring, circuit breaker, hedging
+# =====================================================================
+class RecordingReplica(FakeReplica):
+    """FakeReplica + scripted harvests, recorded releases, a snapshot
+    failure switch (the flake/breaker lever), and a ``do_sample`` flag
+    in the snapshot (the hedge safety gate reads it off the wire)."""
+
+    def __init__(self, name, script=None, do_sample=False, **kw):
+        super().__init__(name, **kw)
+        self.script = list(script or [])
+        self.do_sample = do_sample
+        self.fail_snap = False
+        self.released = []
+
+    def snapshot(self):
+        if self.fail_snap:
+            raise ReplicaError(f"{self.name}: injected snapshot flake")
+        snap = super().snapshot()
+        snap["do_sample"] = self.do_sample
+        return snap
+
+    def harvest(self, rid):
+        if self.script:
+            return self.script.pop(0)
+        return [], False, "running"
+
+    def release(self, rid):
+        self.released.append(rid)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestGrayFailureDefense:
+    def test_snapshot_flake_keeps_replica_alive(self):
+        """Contract: ONE failed snapshot drops the snapshot (the
+        replica scores worst until it answers again) but must NOT mark
+        the replica dead — and one flake alone must not open the
+        breaker either."""
+        a, b = RecordingReplica("a"), RecordingReplica("b")
+        r = _router([a, b], policy="least_loaded", hedge_quantile=0)
+        r.refresh(force=True)
+        assert r._snap("a") is not None
+        a.fail_snap = True
+        r.refresh(force=True)
+        assert "a" in r.alive_names()
+        assert r._snap("a") is None
+        assert r.breaker_state("a") == "closed"
+        a.fail_snap = False
+        r.refresh(force=True)
+        assert r._snap("a") is not None
+
+    def test_raced_death_placement_runs_failover(self):
+        """Deterministic replay of the submit/mark_dead race: the
+        replica is declared dead AFTER its engine accepted the request
+        but BEFORE the router's bookkeeping wrote the placement.
+        mark_dead's drain skips the still-pending assignment (replica
+        is None), so submit() itself must detect the raced death and
+        run the failover — the request may not strand on the corpse."""
+        class DiesOnSubmit(RecordingReplica):
+            router = None
+
+            def submit(self, prompt, **kw):
+                rid = super().submit(prompt, **kw)
+                self.router.mark_dead(self.name)
+                return rid
+
+        a = DiesOnSubmit("a")
+        b = RecordingReplica("b", queue_depth=5,
+                             script=[([3, 4], True, "finished")])
+        r = _router([a, b], policy="least_loaded", hedge_quantile=0)
+        a.router = r
+        gid = r.submit([1, 2])
+        assert r.poll(gid)["replica"] == "b"
+        assert r.failovers_total == 1
+        assert "a" in r.dead
+        toks, done, _ = r.harvest(gid)
+        assert toks == [3, 4] and done
+        # exactly one engine-side submission landed on each replica:
+        # the corpse's accepted request was replayed once, not re-driven
+        assert len(a.submitted) == 1 and len(b.submitted) == 1
+
+    def test_breaker_opens_sheds_and_recovers(self):
+        """closed -> open on accumulated snapshot errors (replica stays
+        ALIVE), open sheds from placement, cooldown -> half_open admits
+        exactly breaker_probes probe placements, and a healthy probe
+        first-token closes the breaker — no operator action anywhere."""
+        clk = _Clock()
+        a = RecordingReplica("a", script=[([7], True, "finished")])
+        b = RecordingReplica("b", queue_depth=5)
+        r = _router([a, b], policy="least_loaded", clock=clk,
+                    breaker_errs=2, breaker_cooldown_s=5.0,
+                    breaker_probes=1, hedge_quantile=0)
+        a.fail_snap = True
+        r.refresh(force=True)
+        clk.t += 1.0
+        r.refresh(force=True)
+        assert r.breaker_state("a") == "open"
+        assert "a" in r.alive_names()          # shed, NOT dead
+        a.fail_snap = False
+        # placement avoids the open breaker though a is less loaded
+        gid = r.submit([1, 2, 3])
+        assert r.poll(gid)["replica"] == "b"
+        # cooldown elapses -> half_open admits ONE probe placement
+        clk.t += 10.0
+        gid2 = r.submit([4, 5, 6])
+        assert r.poll(gid2)["replica"] == "a"
+        assert r.breaker_state("a") == "half_open"
+        # with the probe outstanding further placements stay off a
+        gid3 = r.submit([7, 8, 9])
+        assert r.poll(gid3)["replica"] == "b"
+        # the probe's first token closes the breaker
+        clk.t += 0.01
+        toks, done, _ = r.harvest(gid2)
+        assert toks == [7] and done
+        assert r.breaker_state("a") == "closed"
+        assert r.breaker_transitions == {"open": 1, "half_open": 1,
+                                         "closed": 1}
+
+    def test_health_verdicts_are_median_relative(self):
+        """A replica whose latency signal is a breaker_ratio outlier
+        against the cluster median reads degraded, and check_health
+        opens its breaker (shed while still alive and heartbeating)."""
+        reps = [RecordingReplica(n) for n in ("a", "b", "c")]
+        r = _router(reps, hedge_quantile=0)
+        r.refresh(force=True)
+        with r._lock:
+            for _ in range(3):
+                r._observe_ttft("a", 0.01)
+                r._observe_ttft("b", 0.012)
+                r._observe_ttft("c", 0.4)      # ~33x median: degraded
+        st = r.health_status()
+        assert st["a"]["verdict"] == "healthy"
+        assert st["c"]["verdict"] == "degraded"
+        assert r.check_health() == []          # nobody DIES
+        assert r.breaker_state("c") == "open"
+        assert "c" in r.alive_names()
+
+    def _hedge_router(self, a, b, clk, **kw):
+        kw.setdefault("policy", "least_loaded")
+        kw.setdefault("hedge_quantile", 95)
+        kw.setdefault("hedge_margin", 1.0)
+        kw.setdefault("hedge_min_s", 0.001)
+        r = _router([a, b], clock=clk, **kw)
+        for _ in range(8):                     # cluster TTFT history
+            r.hist_ttft.observe(0.001)
+        return r
+
+    def test_hedge_wins_and_loser_is_released(self):
+        """A greedy request whose owner is silent past the cluster's
+        own p95 TTFT is speculatively re-submitted; the hedge leg's
+        first token wins, the original leg is aborted through the
+        normal release path, and its tokens never reach the stream."""
+        clk = _Clock()
+        a = RecordingReplica("a")              # silent gray owner
+        b = RecordingReplica("b", queue_depth=5,
+                             script=[([5, 6], True, "finished")])
+        r = self._hedge_router(a, b, clk)
+        gid = r.submit([1, 2, 3])
+        assert r.poll(gid)["replica"] == "a"
+        toks, done, _ = r.harvest(gid)         # not overdue yet
+        assert toks == [] and not done and r.hedges_total == 0
+        clk.t += 1.0                           # way past p95 * margin
+        r.harvest(gid)                         # arms the hedge
+        assert r.hedges_total == 1
+        rid_a = a.submitted[0][0]
+        toks, done, _ = r.harvest(gid)         # hedge leg polls + wins
+        assert toks == [5, 6] and done
+        assert r.hedge_wins_total == 1
+        assert rid_a in a.released             # loser leg aborted
+        assert r.audit_counts["hedge"] == 1
+        assert r.poll(gid)["resubmits"] == 1
+
+    def test_hedge_loses_when_owner_answers_first(self):
+        """The owner producing its first token makes the hedge leg the
+        loser: released immediately, zero hedge wins, and the stream is
+        exactly the owner's (no duplicate tokens)."""
+        clk = _Clock()
+        a = RecordingReplica("a", script=[([], False, "running"),
+                                          ([], False, "running"),
+                                          ([9], True, "finished")])
+        b = RecordingReplica("b", queue_depth=5)   # hedge target, silent
+        r = self._hedge_router(a, b, clk)
+        gid = r.submit([1, 2, 3])
+        r.harvest(gid)
+        clk.t += 1.0
+        r.harvest(gid)                         # arms the hedge -> b
+        assert r.hedges_total == 1
+        rid_b = b.submitted[0][0]
+        toks, done, _ = r.harvest(gid)         # owner answers
+        assert toks == [9] and done
+        assert r.hedge_wins_total == 0
+        assert rid_b in b.released             # loser leg aborted
+        assert b.released.count(rid_b) == 1
+
+    def test_sampled_requests_never_hedge(self):
+        """Sampling re-draws the per-request seed on each engine
+        submit, so two legs would diverge and the delivered stream
+        would depend on the race — the gate reads do_sample off the v6
+        snapshot and refuses."""
+        clk = _Clock()
+        a = RecordingReplica("a", do_sample=True)
+        b = RecordingReplica("b", queue_depth=5, do_sample=True)
+        r = self._hedge_router(a, b, clk)
+        gid = r.submit([1, 2, 3])
+        clk.t += 5.0
+        r.harvest(gid)
+        r.harvest(gid)
+        assert r.hedges_total == 0
+
+    def test_hedge_respects_retry_budget(self):
+        """An empty cluster-wide retry budget blocks the speculative
+        hedge (and counts the refusal); death failovers still proceed
+        — they are the stream's only copy."""
+        clk = _Clock()
+        a = RecordingReplica("a")
+        b = RecordingReplica("b", queue_depth=5)
+        r = self._hedge_router(a, b, clk, retry_rate=0.0,
+                               retry_burst=0)
+        gid = r.submit([1, 2, 3])
+        clk.t += 5.0
+        r.harvest(gid)
+        r.harvest(gid)
+        assert r.hedges_total == 0
+        assert r.retry_budget_exhausted_total >= 1
+
+    def test_hedged_away_probe_reopens_breaker(self):
+        """A half-open breaker PROBE that gets hedged away before its
+        first token IS the probe verdict: the loser observation
+        carries the probe gid, the outlier pending age re-opens the
+        breaker, and the probe slot is freed — without this, the
+        vanished probe wedges the breaker half-open forever."""
+        clk = _Clock()
+        a = RecordingReplica("a")              # silent owner
+        b = RecordingReplica("b", queue_depth=5,
+                             script=[([5], False, "running")])
+        r = self._hedge_router(a, b, clk, breaker_errs=2,
+                               breaker_cooldown_s=5.0,
+                               breaker_probes=1)
+        with r._lock:
+            for _ in range(3):                 # b's healthy signal
+                r._observe_ttft("b", 0.001)
+        a.fail_snap = True
+        r.refresh(force=True)
+        clk.t += 1.0
+        r.refresh(force=True)
+        assert r.breaker_state("a") == "open"
+        a.fail_snap = False
+        clk.t += 10.0                          # cooldown elapses
+        gid = r.submit([1, 2, 3])              # the probe placement
+        assert r.poll(gid)["replica"] == "a"
+        assert r.breaker_state("a") == "half_open"
+        rid_a = a.submitted[0][0]
+        clk.t += 1.0
+        r.harvest(gid)                         # overdue: hedge -> b
+        assert r.hedges_total == 1
+        clk.t += 0.001
+        toks, done, _ = r.harvest(gid)         # hedge wins, a loses
+        assert toks == [5]
+        assert r.hedge_wins_total == 1
+        assert rid_a in a.released             # probe leg aborted
+        assert r.breaker_state("a") == "open"  # probe verdict: failed
+
+    def test_released_probe_frees_the_probe_slot(self):
+        """A probe released before any first token must not occupy
+        the half-open breaker's probe slot forever: _breaker_admits
+        prunes gids that no longer live on the replica, so the next
+        placement can probe again."""
+        clk = _Clock()
+        a = RecordingReplica("a")
+        b = RecordingReplica("b", queue_depth=5)
+        r = _router([a, b], policy="least_loaded", clock=clk,
+                    breaker_errs=2, breaker_cooldown_s=5.0,
+                    breaker_probes=1, hedge_quantile=0)
+        a.fail_snap = True
+        r.refresh(force=True)
+        clk.t += 1.0
+        r.refresh(force=True)
+        a.fail_snap = False
+        clk.t += 10.0
+        gid = r.submit([1, 2, 3])
+        assert r.poll(gid)["replica"] == "a"
+        assert r.breaker_state("a") == "half_open"
+        r.release(gid)                         # client went away
+        gid2 = r.submit([4, 5, 6])             # slot freed: probe again
+        assert r.poll(gid2)["replica"] == "a"
+        assert r.breaker_state("a") == "half_open"

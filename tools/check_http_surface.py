@@ -207,6 +207,15 @@ def main(argv=None):
         check(st == 200 and set(obj) == set(P.HEALTHZ_FIELDS),
               f"/healthz {st} fields {sorted(obj)}")
         check(obj.get("status") == "ok", f"healthz status {obj}")
+        hz = obj.get("replicas") or {}
+        check(len(hz) == obj.get("replicas_total") and all(
+            set(e) == set(P.HEALTHZ_REPLICA_FIELDS)
+            for e in hz.values()),
+              f"healthz replica entries {hz}")
+        check(all(e["verdict"] in ("healthy", "suspect", "degraded")
+                  and e["breaker"] in ("closed", "open", "half_open")
+                  for e in hz.values()),
+              f"healthz replica vocab {hz}")
 
         st, hd, data = _req(gw.port, "GET", "/metrics")
         check(st == 200 and hd.get("content-type", "").startswith(

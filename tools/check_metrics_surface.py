@@ -354,7 +354,7 @@ def _check_slo_and_audit_surface(failures):
                 f"{want!r}")
     want_reasons = {"affinity_hit", "least_loaded", "round_robin",
                     "spill", "failover", "orphaned", "migrated",
-                    "scale_up", "scale_down"}
+                    "scale_up", "scale_down", "hedge"}
     if set(AUDIT_REASONS) != want_reasons:
         failures.append(
             f"router AUDIT_REASONS drifted: {sorted(AUDIT_REASONS)} != "
@@ -381,6 +381,24 @@ def _check_slo_and_audit_surface(failures):
             failures.append(
                 f"empty-router exposition lost the elastic counter "
                 f"{probe.split()[0]!r}")
+    # ... and the gray-failure defense surface: breaker transition
+    # counters (per target state), hedge/retry-budget counters, and
+    # the bucket-level gauge — all zero/full on an idle router, so the
+    # chaos-drill dashboards discover the series before any failure
+    for probe in ('paddle_gateway_breaker_transitions_total{to="open"}'
+                  " 0",
+                  'paddle_gateway_breaker_transitions_total'
+                  '{to="half_open"} 0',
+                  'paddle_gateway_breaker_transitions_total'
+                  '{to="closed"} 0',
+                  "paddle_gateway_hedges_total 0",
+                  "paddle_gateway_hedge_wins_total 0",
+                  "paddle_gateway_retry_budget_exhausted_total 0",
+                  "paddle_gateway_retry_budget_tokens "):
+        if probe not in text:
+            failures.append(
+                f"empty-router exposition lost the gray-failure "
+                f"series {probe.split()[0]!r}")
 
 
 def _check_qos_surface(failures):
@@ -473,16 +491,17 @@ def _check_role_surface(failures):
     from paddle_tpu.serving_cluster import protocol as P
     from paddle_tpu.serving_cluster.router import Router
 
-    if SNAPSHOT_SCHEMA_VERSION != 5:
+    if SNAPSHOT_SCHEMA_VERSION != 6:
         failures.append(
             f"SNAPSHOT_SCHEMA_VERSION = {SNAPSHOT_SCHEMA_VERSION!r}, "
-            "pinned 5 (v5 = role + handoff block — bump this check "
-            "deliberately alongside the schema)")
-    for key in ("role", "handoff"):
+            "pinned 6 (v6 = do_sample + health block — bump this "
+            "check deliberately alongside the schema)")
+    for key in ("role", "handoff", "do_sample", "health"):
         if key not in SNAPSHOT_REQUIRED_KEYS:
             failures.append(
                 f"SNAPSHOT_REQUIRED_KEYS lost {key!r} — the router's "
-                "disagg placement filter reads it off the wire")
+                "disagg placement filter and the hedge-safety gate "
+                "read them off the wire")
     pinned = {
         "kv_blocks_shipped": (
             "paddle_serving_kv_blocks_shipped_total", "counter"),
