@@ -115,6 +115,19 @@ request, zero retraces after warmup on the sharded engine, and the
 per-device pool residency reconciles (kv_shard_pool_bytes x mp ==
 the mp=1 engine's whole pool). Its knob: BENCH_MESH_MP (default 2).
 
+--mesh-weights runs the WEIGHT-SHARDING A/B on the same mesh setup:
+the identical paged engine mp=1 (weights dense on one device) vs
+sharded over an mp-way mesh where the stacked layer params are placed
+per generation.STACKED_PARAM_SPECS (column-parallel qkv/f1, row-
+parallel out-proj/f2, sharded LM head), SAME weights, SAME fixed-seed
+arrivals. Exits non-zero unless: exact greedy token parity for EVERY
+request, zero retraces after warmup sharded, and the weight-residency
+identity reconciles — (weight_bytes_per_device - weight_bytes_
+replicated) x mp + weight_bytes_replicated == the mp=1 engine's dense
+weight bytes (i.e. the sharded portion holds exactly 1/mp per
+device). Knob: BENCH_MESH_MP; PADDLE_SERVING_MESH_WEIGHTS=0 would
+disable the sharding under test (don't).
+
 --qos runs the OVERLOAD QoS chaos drill: one paged engine at 2x its
 measured capacity, mixed-class (high/normal/low) fixed-seed Poisson
 traffic. Under that pressure the scheduler must degrade GRACEFULLY:
@@ -187,7 +200,8 @@ All modes merge into ONE BENCH_serving.json (the shared-prompt record
 lands under "shared_prompts", the spec record under "spec_decode",
 the paged record under "paged_kv", the chunked-prefill record under
 "chunked_prefill", the cluster record under "cluster", the mesh
-record under "mesh_serving", the QoS overload record under "qos",
+record under "mesh_serving", the weight-sharding A/B under
+"mesh_weights", the QoS overload record under "qos",
 the disaggregated A/B under "disagg", the gray-failure drill under
 "gray_failure"; each mode preserves the others' records).
 """
@@ -288,8 +302,8 @@ def _collect(eng, sub, arrivals):
 
 
 _SUB_RECORDS = ("shared_prompts", "spec_decode", "paged_kv",
-                "chunked_prefill", "cluster", "mesh_serving", "qos",
-                "disagg", "gray_failure")
+                "chunked_prefill", "cluster", "mesh_serving",
+                "mesh_weights", "qos", "disagg", "gray_failure")
 
 
 def _write_merged(path, record, sub_key=None, sub_rec=None):
@@ -421,6 +435,8 @@ def main(argv=None):
         return main_chunked()
     if "--cluster" in argv:
         return main_cluster()
+    if "--mesh-weights" in argv:
+        return main_mesh_weights()
     if "--mesh" in argv:
         return main_mesh()
     if "--qos" in argv:
@@ -1320,6 +1336,191 @@ def main_mesh():
         print("bench_serving: PER-SHARD POOL RESIDENCY DOES NOT "
               f"RECONCILE (shard bytes x {mp} != mp=1 pool bytes)",
               file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def main_mesh_weights():
+    """Weight-sharding A/B on the serving mesh: the SAME paged engine
+    with dense (mp=1) weights vs the stacked layer params tensor-
+    parallel over an mp-way mesh per generation.STACKED_PARAM_SPECS
+    (fused-qkv/f1 column-parallel, out-proj/f2 row-parallel, sharded
+    LM head), identical weights and fixed-seed arrivals. Gates: exact
+    greedy token parity per request, zero retraces after warmup
+    sharded, and the residency identity — the sharded portion of the
+    weights holds exactly 1/mp per device, reconciled against the
+    mp=1 engine's dense bytes. Lands under "mesh_weights"."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from bench import _init_devices
+    jax, dev, tpu_unavailable = _init_devices()
+    on_tpu = dev.platform in ("tpu", "axon")
+    import numpy as np
+
+    from paddle_tpu.inference.serving import AdmissionFull, ServingEngine
+    from paddle_tpu.parallel import init_serving_mesh
+
+    mp = int(os.environ.get("BENCH_MESH_MP", "2"))
+    slots = int(os.environ.get("BENCH_SLOTS", "8" if on_tpu else "4"))
+    smax = int(os.environ.get("BENCH_SMAX", "1024" if on_tpu else "256"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "4"))
+    n_meas = int(os.environ.get("BENCH_SERVE_REQUESTS", str(6 * slots)))
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+    cap_ = int(os.environ.get("BENCH_PAGED_CAP", "32"))
+    if jax.device_count() < mp:
+        print(f"bench_serving: --mesh-weights needs >= {mp} devices, "
+              f"found {jax.device_count()}", file=sys.stderr)
+        return 1
+
+    # the --mesh mid-size CPU model: H=8 and FF=1024 divide mp, and
+    # V=512 is even so the LM head shards too (every STACKED spec plus
+    # the head path actually exercises under mp=2)
+    fmt, embed, head, (E, H, FF, L, V) = _build_model(
+        on_tpu, dims=None if on_tpu else (256, 8, 1024, 4, 512))
+    if H % mp or FF % mp:
+        print(f"bench_serving: --mesh-weights mp={mp} does not divide "
+              f"num_heads={H} / ffn_dim={FF}", file=sys.stderr)
+        return 1
+
+    rng = np.random.RandomState(seed)
+
+    def make(n):
+        reqs = []
+        for _ in range(n):
+            plen = int(rng.randint(6, 25))
+            max_new = int(rng.choice([16, 24, 32]))
+            reqs.append((rng.randint(1, V, (plen,)).astype("int32"),
+                         max_new))
+        return reqs
+
+    bucket_reqs = [(rng.randint(1, V, (p,)).astype("int32"), 4)
+                   for p in (8, 16, 24)]
+    warm_reqs = make(2 * slots)
+    meas_reqs = make(n_meas)
+
+    def run_mode(label, arrivals=None):
+        clock = VirtualClock()
+        eng = ServingEngine(fmt, embed, head, num_slots=slots,
+                            max_seq_len=smax, decode_chunk=chunk,
+                            prefill_cap=cap_, paged=True,
+                            clock=clock.now)
+        for prompt, max_new in bucket_reqs:
+            eng.submit(prompt, max_new_tokens=max_new)
+            eng.run()
+        for prompt, max_new in warm_reqs:
+            try:
+                eng.submit(prompt, max_new_tokens=max_new)
+            except AdmissionFull:
+                eng.run()
+                eng.submit(prompt, max_new_tokens=max_new)
+        eng.run()
+        eng.reset_metrics(keep_results=False)
+        t0 = clock.now()
+        _drive_continuous(eng, clock, warm_reqs,
+                          np.zeros(len(warm_reqs)) + clock.now())
+        warm = eng.metrics()
+        cap_tps = warm["tokens_emitted"] / max(clock.now() - t0, 1e-9)
+        traces_warm = warm["traces"]
+        eng.reset_metrics(keep_results=False)
+
+        if arrivals is None:
+            mean_new = float(np.mean([m for _, m in meas_reqs]))
+            rate = load * cap_tps / mean_new
+            arr_rng = np.random.RandomState(seed + 1)
+            arrivals = np.cumsum(
+                arr_rng.exponential(1.0 / rate, size=len(meas_reqs)))
+        arr = arrivals + clock.now()
+        t_start = clock.now()
+        sub = _drive_continuous(eng, clock, meas_reqs, arr)
+        elapsed = clock.now() - t_start
+        _ttft, _lat, toks = _collect(eng, sub, arr)
+        m = eng.metrics()
+        tokens_by_req = {j: eng.results[rid]["tokens"].tolist()
+                         for rid, (j, _t) in sub.items()}
+        return {
+            "label": label,
+            "tokens": toks,
+            "tokens_per_sec": round(toks / max(elapsed, 1e-9), 2),
+            "retraces_after_warmup": m["traces"] - traces_warm,
+            "weight_shard_count": m["weight_shard_count"],
+            "weight_bytes_per_device": m["weight_bytes_per_device"],
+            "weight_bytes_replicated": m["weight_bytes_replicated"],
+        }, arrivals, tokens_by_req
+
+    # mp=1 baseline FIRST (the mesh, once initialized, is process-
+    # global); then the sharded engine replays the SAME arrivals
+    base, arrivals, base_toks = run_mode("mp1")
+    init_serving_mesh(mp, num_heads=H, ffn_dim=FF)
+    shard, _, shard_toks = run_mode(f"mp{mp}", arrivals)
+
+    parity_ok = (set(base_toks) == set(shard_toks)
+                 and all(base_toks[j] == shard_toks[j]
+                         for j in base_toks))
+    # residency identity: mp=1 holds the dense weights whole, so its
+    # per-device bytes ARE the dense total; sharded, the non-replicated
+    # portion must hold exactly 1/mp of itself per device —
+    # (per_dev - repl) x mp + repl == dense
+    dense_bytes = base["weight_bytes_per_device"]
+    per_dev = shard["weight_bytes_per_device"]
+    repl = shard["weight_bytes_replicated"]
+    weight_bytes_ok = (
+        shard["weight_shard_count"] == mp
+        and base["weight_shard_count"] == 1
+        and base["weight_bytes_replicated"] == dense_bytes
+        and (per_dev - repl) * mp + repl == dense_bytes
+        and per_dev < dense_bytes)
+
+    record = {
+        "metric": "serving_mesh_weight_shard",
+        "value": round(dense_bytes / max(per_dev, 1), 3),
+        "unit": f"x weight bytes/device mp=1 vs mp={mp}",
+        "mesh_mp": mp,
+        "parity_ok": parity_ok,
+        "requests_compared": len(base_toks),
+        "retraces_after_warmup": shard["retraces_after_warmup"],
+        "retraces_after_warmup_mp1": base["retraces_after_warmup"],
+        "weight_shard_count": shard["weight_shard_count"],
+        "weight_bytes_per_device": per_dev,
+        "weight_bytes_replicated": repl,
+        "weight_bytes_dense": dense_bytes,
+        "weight_bytes_ok": weight_bytes_ok,
+        "tokens_per_sec_sharded": shard["tokens_per_sec"],
+        "tokens_per_sec_mp1": base["tokens_per_sec"],
+        # honesty: forced host devices share ONE physical CPU — the
+        # tokens/s ratio reads dispatch overhead, not a TP speedup;
+        # the parity/retrace/residency gates are the measurement
+        "devices_forced_host": not on_tpu,
+        "max_seq": smax, "decode_chunk": chunk, "block_tokens": cap_,
+        "num_slots": slots, "layers": L, "hidden": E, "heads": H,
+        "ffn": FF, "vocab": V, "requests": n_meas,
+        "offered_load": load, "seed": seed, "device": str(dev),
+    }
+    if tpu_unavailable:
+        record["tpu_unavailable"] = True
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    _write_merged(path, None, "mesh_weights", record)
+    if on_tpu and not tpu_unavailable:
+        from bench import _append_tpu_window
+        _append_tpu_window(record)
+    print(json.dumps(record))
+    rc = 0
+    if not parity_ok:
+        print("bench_serving: SHARDED/DENSE-WEIGHT TOKEN PARITY BROKE",
+              file=sys.stderr)
+        rc = 1
+    if record["retraces_after_warmup"]:
+        print("bench_serving: RETRACES AFTER WARMUP with sharded "
+              "weights — placement leaked into the trace key",
+              file=sys.stderr)
+        rc = 1
+    if not weight_bytes_ok:
+        print("bench_serving: WEIGHT RESIDENCY DOES NOT RECONCILE "
+              f"((per_device - replicated) x {mp} + replicated != "
+              "dense bytes)", file=sys.stderr)
         rc = 1
     return rc
 

@@ -21,12 +21,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
 from ..core.rng import next_key
 from ..tensor.tensor import Tensor, no_grad
 
 __all__ = ["generate", "generate_fused", "FusedDecoder",
-           "dispatch_kind", "DISPATCH_KINDS"]
+           "dispatch_kind", "DISPATCH_KINDS", "STACKED_PARAM_SPECS"]
 
 # ---- dispatch-kind vocabulary (serving telemetry) ---------------------
 # Every compiled executable the serving stack can dispatch is built
@@ -51,6 +52,51 @@ def dispatch_kind(jit_key):
     follow). Unknown families pass through as their own name so a new
     dispatch is visible — just unclassified — rather than dropped."""
     return DISPATCH_KINDS.get(jit_key[0], str(jit_key[0]))
+
+
+# ---- stacked-weight sharding table (tensor parallel over 'mp') --------
+# Every key _stacked() can emit MUST have an explicit entry here —
+# sharded on 'mp' or declared-replicated with P() — enforced twice:
+# placement raises on an unknown key, and tools/check_sharding_spec.py
+# (tier-1) rebuilds both weight flavors and diffs the keys against this
+# table, so a new param key cannot silently replicate.
+#
+# Layout (Megatron-style; the KV pool/rings shard by head on the same
+# 'mp' axis, see init_paged_cache / shard_caches):
+#   * qkv_w is pre-fused HEAD-MAJOR at stack time — [L, nh*3*hd, E]
+#     with nh outermost in the fused axis — so sharding the fused axis
+#     IS the head shard and the in-trace (B,S,F)->(B,S,nh,3,hd) unfuse
+#     stays GSPMD-representable (the raw (3,nh,..) layout sharded on
+#     nh would gather the full weight at every dispatch).
+#   * column-parallel (output-axis) shards: qkv_w/qkv_b, f1_w/f1_b —
+#     no cross-device reduction, each device computes its own heads /
+#     FFN columns exactly.
+#   * row-parallel (contracting-axis) shards: lin_w, f2_w — GSPMD
+#     psums the partial products inside the step core; their biases
+#     and per-OUT-channel int8 scales (lin_w_s/f2_w_s) apply to the
+#     summed [*, E] result, hence declared-replicated.
+#   * qkv_w_s / f1_w_s scale a column-parallel output axis: they shard
+#     WITH their weight (a replicated mirror would gather the sharded
+#     dot result to apply it — the int8 flavor's silent-gather trap).
+#   * LN params are tiny and feed every shard: replicated.
+# PartitionSpec pads missing trailing dims with None, so one entry per
+# key covers both the fp and int8 array ranks.
+STACKED_PARAM_SPECS = {
+    "ln_s": PartitionSpec(), "ln_b": PartitionSpec(),
+    "fln_s": PartitionSpec(), "fln_b": PartitionSpec(),
+    "qkv_w": PartitionSpec(None, "mp"),    # [L, nh*3*hd, E] fused col
+    "qkv_b": PartitionSpec(None, "mp"),    # [L, nh*3*hd]
+    "qkv_w_s": PartitionSpec(None, None, "mp"),  # [L, 1, nh*3*hd]
+    "lin_w": PartitionSpec(None, "mp"),    # [L, nh*hd, E] row shard
+    "lin_b": PartitionSpec(),              # applies post-psum
+    "lin_w_s": PartitionSpec(),            # per-out-channel of the psum
+    "f1_w": PartitionSpec(None, None, "mp"),     # [L, E, FF] col
+    "f1_b": PartitionSpec(None, "mp"),     # [L, FF]
+    "f1_w_s": PartitionSpec(None, None, "mp"),   # [L, 1, FF]
+    "f2_w": PartitionSpec(None, "mp"),     # [L, FF, E] row shard
+    "f2_b": PartitionSpec(),
+    "f2_w_s": PartitionSpec(),
+}
 
 
 def _absmax_int8(w, axis):
@@ -398,6 +444,24 @@ class FusedDecoder:
         self._stk_cache = None
 
     # ------------------------------------------------------------ stacking
+    def _weight_shard_mesh(self):
+        """The mesh the stacked weights (and a Linear LM head) shard
+        over, or None (replicated — the pre-sharding behavior).
+        Sharding is ON by default under an active mp mesh; opt out
+        with PADDLE_SERVING_MESH_WEIGHTS=0. Falls back to None when
+        the head / FFN axes do not divide mp — the engine surfaces
+        that downgrade as a bring-up warning, and init_serving_mesh
+        rejects it up front when given the model dims."""
+        mesh = self._mesh_mp()
+        if mesh is None or os.environ.get(
+                "PADDLE_SERVING_MESH_WEIGHTS", "1") == "0":
+            return None
+        mp = dict(mesh.shape)["mp"]
+        ff = int(self.fmt.ffn1_weights[0]._data.shape[-1])
+        if self.fmt.num_heads % mp or ff % mp:
+            return None
+        return mesh
+
     def _stacked(self):
         f = self.fmt
         # identity anchors are WEAK references: a dead weakref reads None
@@ -409,8 +473,12 @@ class FusedDecoder:
         import weakref
         version = [p._data for p in f.parameters()]
         # trace-time env state is part of the cache identity: flipping
-        # the weight-quant flag must rebuild the stack, not reuse it
-        env_sig = os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1"
+        # the weight-quant flag OR the weight-shard placement (mesh /
+        # PADDLE_SERVING_MESH_WEIGHTS) must rebuild the stack, not
+        # reuse it — a stack placed for the wrong mesh would silently
+        # reshard on every dispatch
+        quant = os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1"
+        env_sig = (quant, self._weight_shard_mesh())
         if self._stk_cache is not None and \
                 self._stk_cache[2] == env_sig and \
                 len(self._stk_cache[0]) == len(version) and \
@@ -422,15 +490,29 @@ class FusedDecoder:
 
         def stk(plist):
             return jnp.stack([p._data for p in plist])
+        # qkv is pre-fused HEAD-MAJOR for BOTH weight flavors: the raw
+        # per-layer [3, nh, hd, E] stacks become [L, nh*3*hd, E] (bias
+        # [L, nh*3*hd]) with the head axis OUTERMOST in the fused dim.
+        # Channel order is irrelevant to correctness (per-out-channel
+        # dots and absmax scales commute with any output permutation —
+        # qkv_of un-fuses with the matching (nh, 3, hd) reshape), but
+        # it is what makes tensor parallel representable: sharding the
+        # fused axis 'mp'-ways IS a head shard, and stays a head shard
+        # through the in-trace unfuse reshape.
+        qkv5 = stk(f.qkv_weights)              # [L, 3, nh, hd, E]
+        qkvb4 = stk(f.qkv_biases)              # [L, 3, nh, hd]
+        nl = qkv5.shape[0]
         out = {
             "ln_s": stk(f.ln_scales), "ln_b": stk(f.ln_biases),
-            "qkv_w": stk(f.qkv_weights), "qkv_b": stk(f.qkv_biases),
+            "qkv_w": jnp.swapaxes(qkv5, 1, 2).reshape(
+                nl, -1, qkv5.shape[-1]),
+            "qkv_b": jnp.swapaxes(qkvb4, 1, 2).reshape(nl, -1),
             "lin_w": stk(f.linear_weights), "lin_b": stk(f.linear_biases),
             "fln_s": stk(f.ffn_ln_scales), "fln_b": stk(f.ffn_ln_biases),
             "f1_w": stk(f.ffn1_weights), "f1_b": stk(f.ffn1_biases),
             "f2_w": stk(f.ffn2_weights), "f2_b": stk(f.ffn2_biases),
         }
-        if env_sig:
+        if quant:
             # weight-only int8 decode (reference: Predictor's weight-only
             # mode applied to the fused decode stack): at decode batch
             # sizes the step is WEIGHT-traffic bound (~2 bytes/param/token
@@ -448,13 +530,32 @@ class FusedDecoder:
             def q_right(w3):         # used as h @ W: [L, I, O]
                 return _absmax_int8(w3, 1)            # scales [L, 1, O]
 
-            nl = out["qkv_w"].shape[0]
-            emb = out["qkv_w"].shape[-1]
-            out["qkv_w"], out["qkv_w_s"] = q_left(
-                out["qkv_w"].reshape(nl, -1, emb))
+            out["qkv_w"], out["qkv_w_s"] = q_left(out["qkv_w"])
             out["lin_w"], out["lin_w_s"] = q_right(out["lin_w"])
             out["f1_w"], out["f1_w_s"] = q_right(out["f1_w"])
             out["f2_w"], out["f2_w_s"] = q_right(out["f2_w"])
+        mesh = env_sig[1]
+        if mesh is not None:
+            # tensor-parallel placement: commit every stacked array to
+            # its declared layout so each device holds ~1/mp of the
+            # sharded weight bytes from first dispatch on (no lazy
+            # reshard inside the step). An unknown key is a hard error
+            # — the runtime twin of tools/check_sharding_spec.py.
+            from jax.sharding import NamedSharding
+            from ..parallel import _valid_spec
+            for k in out:
+                spec = STACKED_PARAM_SPECS.get(k)
+                if spec is None:
+                    raise ValueError(
+                        f"stacked param {k!r} has no entry in "
+                        "STACKED_PARAM_SPECS — every stacked key needs "
+                        "an explicit PartitionSpec (sharded or the "
+                        "replicated P()); see "
+                        "tools/check_sharding_spec.py")
+                if not _valid_spec(out[k], spec, mesh):
+                    spec = PartitionSpec()      # indivisible: replicate
+                out[k] = jax.device_put(out[k],
+                                        NamedSharding(mesh, spec))
         try:
             anchors = [weakref.ref(a) for a in version]
         except TypeError:
@@ -465,25 +566,53 @@ class FusedDecoder:
         return out
 
     def _maybe_quant_head(self, h_arrays):
-        """PADDLE_TPU_DECODE_INT8_HEAD=1 + plain Linear head: return
-        [W_int8, scales(, bias)] with per-out-channel (vocab column)
-        absmax scales — head_logits detects the structure and applies
-        dequant after the dot. Cached on (env flag, weight identity);
-        non-Linear heads pass through untouched (call_layerlike path)."""
+        """LM-head preparation for plain Linear heads (non-Linear heads
+        pass through untouched — call_layerlike path): optional int8
+        quant (PADDLE_TPU_DECODE_INT8_HEAD=1 → [W_int8, scales(, bias)]
+        with per-out-channel absmax scales, dequant applied after the
+        dot by head_logits), then tensor-parallel placement — under a
+        weight-shard mesh the weight [E, V], int8 scales [1, V] and
+        bias [V] all shard the VOCAB axis, so logits leave the head
+        vocab-sharded and GSPMD gathers them only at the argmax /
+        sampling reduction. An indivisible vocab stays replicated (the
+        per-key fallback, same policy as the layer stack). Cached on
+        (quant flag, mesh, weight identity)."""
         from ..nn.layer.common import Linear
-        if os.environ.get("PADDLE_TPU_DECODE_INT8_HEAD") != "1" or \
-                type(self.head) is not Linear or not h_arrays:
+        if type(self.head) is not Linear or not h_arrays:
+            return h_arrays
+        quant = os.environ.get("PADDLE_TPU_DECODE_INT8_HEAD") == "1"
+        mesh = self._weight_shard_mesh()
+        if not quant and mesh is None:
             return h_arrays
         import weakref
+        sig = (quant, mesh)
         cached = getattr(self, "_head_q_cache", None)
-        if cached is not None and len(cached[0]) == len(h_arrays) and \
+        if cached is not None and cached[2] == sig and \
+                len(cached[0]) == len(h_arrays) and \
                 all(r() is a for r, a in zip(cached[0], h_arrays)):
             return cached[1]
-        q, s = _absmax_int8(h_arrays[0], 0)            # weight [E, V]
-        out = [q, s] + list(h_arrays[1:])
+        if quant:
+            q, s = _absmax_int8(h_arrays[0], 0)        # weight [E, V]
+            out = [q, s] + list(h_arrays[1:])
+        else:
+            out = list(h_arrays)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from ..parallel import _valid_spec
+            placed = []
+            for a in out:
+                # vocab is the LAST axis of every Linear-head array:
+                # weight [E, V], int8 scales [1, V], bias [V]
+                spec = PartitionSpec(*([None] * (a.ndim - 1) + ["mp"]))
+                if not _valid_spec(a, spec, mesh):
+                    spec = PartitionSpec()
+                placed.append(jax.device_put(
+                    a, NamedSharding(mesh, spec)))
+            out = placed
         # key on EVERY source array (a bias-only swap must invalidate,
         # not serve the stale cached bias)
-        self._head_q_cache = ([weakref.ref(a) for a in h_arrays], out)
+        self._head_q_cache = ([weakref.ref(a) for a in h_arrays], out,
+                              sig)
         return out
 
     @staticmethod
@@ -1097,17 +1226,17 @@ class FusedDecoder:
             return out_ * s.astype(a.dtype) if s is not None else out_
 
         def qkv_of(h, p):
-            # [B, T, E] -> q, k, v [B, T, nh, hd]; handles the weight-
-            # only-int8 stacks ([O, I] pre-reshaped at stack time)
-            if "qkv_w_s" in p:
-                qkv = mm_p(h, p["qkv_w"].T, p["qkv_w_s"]) + \
-                    p["qkv_b"].reshape(-1).astype(h.dtype)
-            else:
-                w = p["qkv_w"].reshape(3 * nh * hd, h.shape[-1]).T
-                qkv = h @ w.astype(h.dtype) + \
-                    p["qkv_b"].reshape(-1).astype(h.dtype)
-            qkv = qkv.reshape(h.shape[0], h.shape[1], 3, nh, hd)
-            return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            # [B, T, E] -> q, k, v [B, T, nh, hd]. Both weight flavors
+            # arrive pre-fused HEAD-MAJOR from _stacked ([F, E] with
+            # F = nh*3*hd, nh outermost), so one branch serves fp and
+            # int8, and the unfuse reshape below keeps the head axis
+            # outermost — under tensor parallel the fused axis carries
+            # the 'mp' head shard straight through to q/k/v without a
+            # weight gather.
+            qkv = mm_p(h, p["qkv_w"].T, p.get("qkv_w_s")) + \
+                p["qkv_b"].astype(h.dtype)
+            qkv = qkv.reshape(h.shape[0], h.shape[1], nh, 3, hd)
+            return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
         def proj_ffn_tail(residual, attn_flat, p):
             # shared post-attention half of a layer: out-proj + residual

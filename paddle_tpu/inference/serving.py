@@ -334,6 +334,23 @@ class ServingEngine:
                     "unavailable; falling back to the dense ring",
                     RuntimeWarning, stacklevel=2)
                 want_paged = False
+        if _mesh is not None and self.dec._weight_shard_mesh() is None \
+                and os.environ.get("PADDLE_SERVING_MESH_WEIGHTS",
+                                   "1") != "0":
+            # weight sharding wanted (mesh up, knob not opted out) but
+            # the model axes don't divide mp: surface the replicated
+            # downgrade at bring-up, not as a quiet HBM surprise
+            import warnings
+            mp = dict(_mesh.shape)["mp"]
+            ff_ = int(self.dec.fmt.ffn1_weights[0]._data.shape[-1])
+            warnings.warn(
+                f"serving: weight sharding disabled — num_heads="
+                f"{self.dec.fmt.num_heads} / ffn_dim={ff_} must both "
+                f"divide the mesh's mp degree {mp} to shard the "
+                "qkv/proj/FFN stacks; weights stay replicated per "
+                "device (init_serving_mesh(mp, num_heads=, ffn_dim=) "
+                "rejects this layout up front)",
+                RuntimeWarning, stacklevel=2)
         self.paged = want_paged
         if not self.paged and (kv_pool is not None
                                or kv_pool_blocks is not None):
@@ -1101,6 +1118,7 @@ class ServingEngine:
         # requests_finished (else finished + expired double-counts).
         tele = self.telemetry
         looked = self._prefix_hits + self._prefix_misses
+        _w_dev, _w_repl = self._weight_bytes()
         m = {
             "tokens_emitted": self._tokens_emitted,
             "busy_s": round(self._busy_s, 4),
@@ -1199,6 +1217,22 @@ class ServingEngine:
             "kv_shard_count": self._kv_shard_count(),
             "kv_shard_heads": self._kv_shard_heads(),
             "kv_shard_pool_bytes": self._kv_shard_pool_bytes(),
+            # tensor-parallel WEIGHT placement gauges (static config,
+            # reset-stable like the kv_shard trio, but never None —
+            # every engine has weights): shard_count is the weight-
+            # shard mp degree (1 = replicated / no mesh),
+            # weight_bytes_per_device the per-chip bytes of the exact
+            # arrays the step dispatches (stacked layer pytree + embed
+            # + LM head, int8 mirrors at their quantized size), and
+            # weight_bytes_replicated the per-chip share that stays
+            # replicated (LN/bias/scale mirrors, embed, an indivisible
+            # LM head). The identity
+            #   (per_device - replicated) * shard_count + replicated
+            #     == dense total bytes
+            # holds exactly on every engine (conftest pins it).
+            "weight_shard_count": self._weight_shard_count(),
+            "weight_bytes_per_device": _w_dev,
+            "weight_bytes_replicated": _w_repl,
             # token-budget window counters (all zero in phase mode):
             # used = the REAL tokens packed into budget dispatches
             # (prefill + decode + draft parts sum to it exactly — the
@@ -1266,6 +1300,47 @@ class ServingEngine:
         if "sc" in self._caches:
             total += int(self._caches["sc"].nbytes)
         return total // n
+
+    def _weight_arrays(self):
+        """The EXACT device arrays the serving step dispatches with:
+        the stacked layer pytree, the embedding params, and the
+        (possibly quantized / vocab-sharded) LM-head arrays. One list
+        so the weight gauges, the conftest identity reconciliation and
+        bench_serving's --mesh-weights A/B all account the same
+        bytes."""
+        dec = self.dec
+        arrs = list(dec._stacked().values())
+        arrs += [p._data for p in dec._embed_params]
+        arrs += list(dec._maybe_quant_head(
+            [p._data for p in dec._head_params]))
+        return arrs
+
+    def _weight_shard_count(self):
+        """Weight-shard degree: the mesh's mp when the stacks shard,
+        1 when weights are replicated (no mesh, opt-out, or an
+        indivisible head/FFN axis)."""
+        mesh = self.dec._weight_shard_mesh()
+        return dict(mesh.shape)["mp"] if mesh is not None else 1
+
+    def _weight_bytes(self):
+        """(per_device, replicated) weight bytes. per_device sums each
+        array's LOCAL shard footprint (sharding.shard_shape — the full
+        shape for replicated arrays, shape/mp on the sharded axis
+        otherwise); replicated sums only the arrays whose local shard
+        IS the full array. With n = _weight_shard_count(),
+        (per_device - replicated) * n + replicated recovers the dense
+        byte total exactly."""
+        import math
+        per_dev = repl = 0
+        for a in self._weight_arrays():
+            shape = tuple(a.shape)
+            shard = tuple(a.sharding.shard_shape(shape)) if hasattr(
+                a, "sharding") else shape
+            b = math.prod(shard) * a.dtype.itemsize
+            per_dev += b
+            if shard == shape:
+                repl += b
+        return per_dev, repl
 
     def metrics_prometheus(self):
         """Prometheus text-format exposition: every metrics() key under

@@ -124,7 +124,12 @@ DEFAULT_QOS_SHARES = {"high": 4, "normal": 2, "low": 1}
 # traffic may hedge) and the "health" block (step_ewma_s — the
 # engine's own smoothed step duration, the replica-local slowness
 # signal the router's median-relative health scorer consumes).
-SNAPSHOT_SCHEMA_VERSION = 6
+# v7: tensor-parallel weights — the "weights" block (shard_count /
+# bytes_per_device / bytes_replicated — the per-chip HBM residency of
+# the serving step's weight arrays; (per_device - replicated) x
+# shard_count + replicated == the dense byte total). The capacity
+# planner's model-fits-here signal for mp-sharded replicas.
+SNAPSHOT_SCHEMA_VERSION = 7
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
@@ -132,7 +137,7 @@ SNAPSHOT_REQUIRED_KEYS = frozenset({
     "slots_free", "prefill_cap", "has_work", "tokens_per_sec",
     "requests", "histograms", "budget", "prefix", "spans_logged",
     "steps_logged", "telemetry_ring", "slo", "queue_depths",
-    "role", "handoff", "do_sample", "health",
+    "role", "handoff", "do_sample", "health", "weights",
 })
 
 # keys present only on some configurations (paged pool / spec decode)
@@ -672,6 +677,16 @@ PROMETHEUS_NAMES = {
     "kv_shard_heads": ("paddle_serving_kv_shard_heads", "gauge"),
     "kv_shard_pool_bytes": ("paddle_serving_kv_shard_pool_bytes",
                             "gauge"),
+    # tensor-parallel weight placement (static config gauges, same
+    # reset-stable discipline; never None — every engine has weights):
+    # (bytes_per_device - bytes_replicated) x shard_count
+    #   + bytes_replicated == the dense weight byte total
+    "weight_shard_count": ("paddle_serving_weight_shard_count",
+                           "gauge"),
+    "weight_bytes_per_device": (
+        "paddle_serving_weight_bytes_per_device", "gauge"),
+    "weight_bytes_replicated": (
+        "paddle_serving_weight_bytes_replicated", "gauge"),
     "budget_steps": ("paddle_serving_budget_steps_total", "counter"),
     "budget_tokens_used": ("paddle_serving_budget_tokens_used_total",
                            "counter"),
@@ -910,6 +925,13 @@ def snapshot(engine):
         "do_sample": bool(engine.do_sample),
         "health": {"step_ewma_s": float(
             getattr(engine, "_step_ewma_s", 0.0))},
+        # v7: tensor-parallel weight placement — the per-chip HBM
+        # residency of the step's weight arrays ((per_device -
+        # replicated) x shard_count + replicated == dense total): the
+        # capacity planner's model-fits-here signal
+        "weights": {"shard_count": m["weight_shard_count"],
+                    "bytes_per_device": m["weight_bytes_per_device"],
+                    "bytes_replicated": m["weight_bytes_replicated"]},
         "spans_logged": len(tele.spans),
         "steps_logged": len(tele.steps),
         "telemetry_ring": tele.ring,

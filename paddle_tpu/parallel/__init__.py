@@ -36,7 +36,9 @@ def current_mesh() -> Optional[Mesh]:
     return hcg.mesh if hcg is not None else None
 
 
-def init_serving_mesh(mp: Optional[int] = None) -> Optional[Mesh]:
+def init_serving_mesh(mp: Optional[int] = None, *,
+                      num_heads: Optional[int] = None,
+                      ffn_dim: Optional[int] = None) -> Optional[Mesh]:
     """Stand up (or reuse) a pure tensor-parallel mesh for serving:
     dp=pp=sharding=1, mp as given (default: ``PADDLE_SERVING_MESH_MP``;
     unset/0/1 = no mesh — returns whatever mesh is already active).
@@ -44,10 +46,19 @@ def init_serving_mesh(mp: Optional[int] = None) -> Optional[Mesh]:
     it is returned as-is; a CONFLICTING active mesh raises instead of
     silently re-initializing fleet under a live engine's feet.
 
+    Pass the model's ``num_heads`` / ``ffn_dim`` to validate the full
+    tensor-parallel layout up front: the KV pool and qkv/out-proj shard
+    by head and the FFN weights by column over 'mp', so an indivisible
+    axis is rejected HERE with an actionable error instead of surfacing
+    as a downstream XLA shape failure (or a silently replicated stack).
+
     This is the one-call bring-up a sharded ``ServingEngine`` needs:
 
         init_serving_mesh(2)          # or PADDLE_SERVING_MESH_MP=2
-        eng = ServingEngine(...)      # pool shards by head over 'mp'
+        eng = ServingEngine(...)      # KV pool AND the stacked weights
+                                      # shard over 'mp' (opt out of the
+                                      # weight half with
+                                      # PADDLE_SERVING_MESH_WEIGHTS=0)
     """
     import os
     if mp is None:
@@ -56,6 +67,17 @@ def init_serving_mesh(mp: Optional[int] = None) -> Optional[Mesh]:
     mesh = current_mesh()
     if mp <= 1:
         return mesh
+    if num_heads is not None and num_heads % mp:
+        raise ValueError(
+            f"init_serving_mesh(mp={mp}): num_heads={num_heads} is not "
+            f"divisible by mp — the qkv/out-proj weights and the KV "
+            "pool shard by head over 'mp'; pick mp from the divisors "
+            f"of {num_heads}")
+    if ffn_dim is not None and ffn_dim % mp:
+        raise ValueError(
+            f"init_serving_mesh(mp={mp}): ffn_dim={ffn_dim} is not "
+            "divisible by mp — the FFN weights shard by column over "
+            f"'mp'; pick mp from the divisors of {ffn_dim}")
     if mesh is not None:
         have = dict(mesh.shape).get("mp", 1)
         if have == mp:
@@ -70,6 +92,13 @@ def init_serving_mesh(mp: Optional[int] = None) -> Optional[Mesh]:
             f"{jax.device_count()} — on CPU hosts set XLA_FLAGS="
             f"--xla_force_host_platform_device_count={mp} before the "
             "first jax import")
+    if jax.device_count() % mp:
+        raise RuntimeError(
+            f"init_serving_mesh(mp={mp}): device count "
+            f"{jax.device_count()} is not divisible by mp — a ragged "
+            "mesh cannot be built; pick mp from the divisors of the "
+            "device count (or adjust "
+            "--xla_force_host_platform_device_count)")
     from ..distributed import fleet
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": mp,

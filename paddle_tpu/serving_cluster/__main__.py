@@ -21,10 +21,14 @@ production recipe (one engine per accelerator process) instead of the
 manual ``init_rpc`` glue. A dead worker tears the whole demo down
 with a failure report naming the rank and its log tail.
 
-``--mesh-mp M`` shards every engine's paged KV pool by head over an
-M-way tensor-parallel mesh (``parallel.init_serving_mesh``); workers
-inherit it via ``PADDLE_SERVING_MESH_MP``. On CPU hosts the mesh
-devices are forced via XLA_FLAGS automatically.
+``--mesh-mp M`` makes every engine tensor-parallel over an M-way mesh
+(``parallel.init_serving_mesh``): the paged KV pool shards by head AND
+the stacked qkv/proj/FFN weights (plus the LM head) shard over 'mp',
+so each device holds ~1/M of both the pool and the weight bytes
+(``PADDLE_SERVING_MESH_WEIGHTS=0`` opts the weight half out). Workers
+inherit the degree via ``PADDLE_SERVING_MESH_MP``; the bring-up
+validates the model's head/FFN axes against M up front. On CPU hosts
+the mesh devices are forced via XLA_FLAGS automatically.
 
 Flags default from the env contract (``PADDLE_GATEWAY_PORT``,
 ``PADDLE_GATEWAY_REPLICAS``, ``PADDLE_ROUTER_POLICY``,
@@ -40,13 +44,19 @@ import sys
 import time
 
 
+# the demo cluster's shared toy-model dims — module-level so the mesh
+# bring-up can validate the tensor-parallel layout (H % mp, FF % mp)
+# BEFORE any engine build
+MODEL_DIMS = {"E": 64, "H": 4, "FF": 128, "L": 2, "V": 256}
+
+
 def _build_engine(seed, slots, smax, prefix_blocks, cap, role="mixed"):
     import paddle_tpu as paddle
     from paddle_tpu.incubate.nn import FusedMultiTransformer
     from paddle_tpu.inference.serving import ServingEngine
     from paddle_tpu.nn.layer.common import Embedding, Linear
 
-    E, H, FF, L, V = 64, 4, 128, 2, 256
+    E, H, FF, L, V = (MODEL_DIMS[k] for k in ("E", "H", "FF", "L", "V"))
     paddle.seed(seed)
     embed = Embedding(V, E)
     fmt = FusedMultiTransformer(E, H, FF, num_layers=L,
@@ -123,7 +133,11 @@ def _worker_main(args):
     else:
         raise RuntimeError(
             f"worker {rank}: rpc rendezvous never came up: {last!r}")
-    init_serving_mesh()       # PADDLE_SERVING_MESH_MP; unset = no mesh
+    # PADDLE_SERVING_MESH_MP; unset = no mesh. The model dims validate
+    # the full tensor-parallel layout (KV heads + FFN columns) at
+    # bring-up — a role worker must fail HERE, not mid-serve
+    init_serving_mesh(num_heads=MODEL_DIMS["H"],
+                      ffn_dim=MODEL_DIMS["FF"])
     eng = _build_engine(0, args.slots, args.max_seq_len,
                         args.prefix_blocks, args.prefill_cap,
                         role=args.role)
@@ -223,8 +237,9 @@ def main(argv=None):
                          "in-process replicas (supervised gang)")
     ap.add_argument("--mesh-mp", type=int, default=int(os.environ.get(
         "PADDLE_SERVING_MESH_MP", "0") or 0),
-        help="shard every engine's paged KV pool by head over an "
-             "mp-way mesh (0/1 = no mesh)")
+        help="tensor-parallel engines over an mp-way mesh: the paged "
+             "KV pool shards by head and the qkv/proj/FFN weight "
+             "stacks by head/column (0/1 = no mesh)")
     ap.add_argument("--log-dir", default="log",
                     help="worker gang log directory (workerlog.serving.N)")
     ap.add_argument("--roles", default=os.environ.get(
@@ -281,7 +296,9 @@ def main(argv=None):
 
         from .replica import LocalReplica
         if args.mesh_mp > 1:
-            init_serving_mesh(args.mesh_mp)
+            init_serving_mesh(args.mesh_mp,
+                              num_heads=MODEL_DIMS["H"],
+                              ffn_dim=MODEL_DIMS["FF"])
         # every replica serves the SAME weights (seed-shared toy model)
         # so routing is invisible to outputs — the production contract
         roles = role_list or ["mixed"] * args.replicas

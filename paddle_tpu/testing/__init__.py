@@ -101,6 +101,13 @@ GW_ENV_VARS = (
     # every later cluster declares a frozen replica dead
     "PADDLE_RPC_PING_TIMEOUT_S",   # liveness-probe rpc timeout
     "PADDLE_RPC_TIMEOUT_S",        # per-call rpc client timeout
+    # tensor-parallel serving mesh (parallel/__init__.py
+    # init_serving_mesh; inference/generation.py weight placement): a
+    # leaked mp degree makes every later engine try to stand up a
+    # mesh, a leaked weight opt-out silently re-replicates every later
+    # sharded engine's stacks
+    "PADDLE_SERVING_MESH_MP",      # mesh mp degree (0/1 = no mesh)
+    "PADDLE_SERVING_MESH_WEIGHTS",  # 0 = replicate weights under mesh
     # SLO objectives (inference/telemetry.py SloPolicy): a leaked
     # objective silently flips every later engine's goodput counters —
     # same guard discipline as the router knobs

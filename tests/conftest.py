@@ -255,6 +255,29 @@ def check_serving_metrics(eng):
         assert m["kv_shard_count"] is None
         assert m["kv_shard_heads"] is None
         assert m["kv_shard_pool_bytes"] is None
+    # tensor-parallel weight placement: on EVERY engine (sharded or
+    # not) the byte identity must be exact — the per-device footprint
+    # of the step's weight arrays splits into a sharded part (counted
+    # once per device) and a replicated part (same bytes everywhere),
+    # and (per_device - replicated) x shard_count + replicated
+    # recovers the dense total computed from the arrays themselves.
+    # Unsharded engines degenerate to per_device == replicated ==
+    # dense with shard_count == 1.
+    n_ws = m["weight_shard_count"]
+    assert n_ws >= 1
+    import math as _math
+    dense_w = sum(_math.prod(a.shape) * a.dtype.itemsize
+                  for a in eng._weight_arrays())
+    assert (m["weight_bytes_per_device"] - m["weight_bytes_replicated"]) \
+        * n_ws + m["weight_bytes_replicated"] == dense_w, (
+        f"weight byte identity broke: per_device="
+        f"{m['weight_bytes_per_device']} replicated="
+        f"{m['weight_bytes_replicated']} shards={n_ws} "
+        f"dense={dense_w}")
+    assert 0 <= m["weight_bytes_replicated"] <= \
+        m["weight_bytes_per_device"] <= dense_w
+    if n_ws == 1:
+        assert m["weight_bytes_per_device"] == dense_w
     # telemetry reconciliation (the PR 8 surface): the histograms ARE
     # the percentile source — latency observes exactly the non-expired
     # finished requests, TTFT at most that (a request always has a

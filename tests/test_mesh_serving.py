@@ -1,10 +1,14 @@
-"""Mesh-sharded paged serving (ISSUE 15): tensor-parallel engine step
-over a head-sharded KV block pool.
+"""Mesh-sharded paged serving (ISSUE 15 + 19): tensor-parallel engine
+step over a head-sharded KV block pool AND tensor-parallel weights
+(the stacked qkv/proj/FFN pytree placed per
+generation.STACKED_PARAM_SPECS — on by default under a mesh, so every
+parity/churn test here ALSO exercises sharded weights).
 
 Contracts under test:
   * EXACT sharded-vs-single-device token parity (greedy AND sampled,
-    prefix cache on/off, spec decode on/off) — the mp=2 mesh layout
-    must be invisible in the tokens;
+    prefix cache on/off, spec decode on/off, row-aligned AND
+    flat-budget) — neither the mp=2 mesh layout nor the weight
+    placement may be visible in the tokens;
   * fork (COW) + export/import migration parity under paged eviction
     churn on a deliberately tight pool — every pool executable
     (copy/read/write block) runs against the sharded arrays;
@@ -12,22 +16,34 @@ Contracts under test:
     decode_attention_paged — the dense gather fallback alone would
     also pass parity, silently);
   * zero retraces after warmup on the sharded engine (block churn is
-    host data; the mesh adds no trace keys);
-  * head-count divisibility validation: explicit paged=True raises,
-    the env/auto default downgrades to dense with a warning, and
-    init_paged_cache refuses to lay out an indivisible pool;
-  * kv_shard_* gauges: count x per-shard bytes == the whole pool
-    (per-device residency is dense/mp).
+    host data; the mesh adds no trace keys) — row-aligned and flat;
+  * the stacked weights REALLY shard: per-device shard shapes match
+    the spec table, the LM head vocab-shards (or replicates when the
+    vocab is indivisible — V=97 here, the documented fallback), and
+    PADDLE_SERVING_MESH_WEIGHTS=0 opts back into replication;
+  * divisibility validation: explicit paged=True raises, the env/auto
+    default downgrades to dense with a warning, init_paged_cache
+    refuses an indivisible pool layout, and init_serving_mesh rejects
+    indivisible num_heads/ffn_dim/device-count up front;
+  * kv_shard_* gauges: count x per-shard bytes == the whole pool;
+    weight_* gauges: (per_device - replicated) x count + replicated
+    == the dense weight bytes (per-device residency is ~dense/mp);
+  * tools/check_sharding_spec.py stays green (tier-1 pin): every
+    stacked key carries an explicit PartitionSpec.
 
 The conftest forces 8 host CPU devices, so mp=2 meshes build anywhere;
 fleet topology state is reset per test by the _seed_all fixture.
 """
+import importlib.util
+import os
 import warnings
 
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 V, E, H, FF, L = 97, 32, 4, 64, 2
 
@@ -113,6 +129,14 @@ class TestMeshPagedParity:
 
     def test_spec_decode_parity(self):
         ref, got, _ = self._ab(spec_k=2, prefix_cache_blocks=16)
+        assert got == ref
+
+    def test_flat_budget_parity(self):
+        # the token-flattened [T] budget core over sharded weights —
+        # the third scheduler flavor the weight tentpole must compose
+        # with (row-aligned and spec decode covered above)
+        ref, got, _ = self._ab(flat_budget=True, token_budget=16,
+                               prefix_cache_blocks=16)
         assert got == ref
 
     def test_zero_retraces_after_warmup(self):
@@ -235,6 +259,28 @@ class TestMeshValidation:
         assert init_serving_mesh(0) is None
         assert init_serving_mesh(1) is None
 
+    def test_init_serving_mesh_rejects_indivisible_heads(self):
+        from paddle_tpu.parallel import init_serving_mesh
+        with pytest.raises(ValueError, match="num_heads=4"):
+            init_serving_mesh(8, num_heads=4, ffn_dim=FF)
+
+    def test_init_serving_mesh_rejects_indivisible_ffn(self):
+        from paddle_tpu.parallel import init_serving_mesh
+        with pytest.raises(ValueError, match="ffn_dim=66"):
+            init_serving_mesh(4, num_heads=8, ffn_dim=66)
+
+    def test_init_serving_mesh_rejects_indivisible_devices(self):
+        # 8 forced host devices: mp=3 divides neither the device count
+        # nor anything else — the error must name the device count
+        from paddle_tpu.parallel import init_serving_mesh
+        with pytest.raises(RuntimeError, match="device count"):
+            init_serving_mesh(3, num_heads=3, ffn_dim=66 * 3)
+
+    def test_divisible_dims_accepted(self):
+        from paddle_tpu.parallel import init_serving_mesh
+        mesh = init_serving_mesh(2, num_heads=H, ffn_dim=FF)
+        assert dict(mesh.shape)["mp"] == 2
+
 
 class TestShardGauges:
     def test_shard_math(self):
@@ -262,3 +308,125 @@ class TestShardGauges:
         assert m["kv_shard_count"] is None
         assert m["kv_shard_heads"] is None
         assert m["kv_shard_pool_bytes"] is None
+
+
+class TestWeightSharding:
+    """ISSUE 19: the stacked layer pytree places per
+    STACKED_PARAM_SPECS at stack time — each device holds ~1/mp of the
+    sharded weight bytes, invisibly to tokens (parity classes above
+    run with it ON by default)."""
+
+    def test_stack_placed_per_spec_table(self):
+        mesh = _mesh(2)
+        eng = _engine()
+        stk = eng.dec._stacked()
+        from paddle_tpu.inference.generation import STACKED_PARAM_SPECS
+        for k, a in stk.items():
+            full = tuple(a.shape)
+            local = tuple(a.sharding.shard_shape(full))
+            want = list(full)
+            for dim, name in enumerate(STACKED_PARAM_SPECS[k]):
+                if name is not None:
+                    want[dim] //= dict(mesh.shape)["mp"]
+            assert local == tuple(want), (k, full, local)
+        # spot the tentpole shapes: fused-head qkv halves its output
+        # axis, f1 its FFN columns, LN stays whole
+        assert stk["qkv_w"].sharding.shard_shape(
+            tuple(stk["qkv_w"].shape))[1] * 2 == 3 * E  # nh*3*hd
+        assert stk["f1_w"].sharding.shard_shape(
+            tuple(stk["f1_w"].shape))[2] * 2 == FF
+        assert stk["ln_s"].sharding.shard_shape(
+            tuple(stk["ln_s"].shape)) == tuple(stk["ln_s"].shape)
+
+    def test_int8_scales_shard_with_weights(self, monkeypatch):
+        # the satellite: a replicated qkv_w_s over a sharded qkv_w
+        # would gather the sharded dot result on every dispatch
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_WEIGHTS", "1")
+        _mesh(2)
+        eng = _engine()
+        stk = eng.dec._stacked()
+        for k in ("qkv_w_s", "f1_w_s"):
+            full = tuple(stk[k].shape)
+            local = tuple(stk[k].sharding.shard_shape(full))
+            assert local[-1] * 2 == full[-1], (k, full, local)
+        for k in ("lin_w_s", "f2_w_s"):       # documented-replicated
+            full = tuple(stk[k].shape)
+            assert tuple(stk[k].sharding.shard_shape(full)) == full
+
+    def test_head_replicates_when_vocab_indivisible(self):
+        # V=97 does not divide mp=2: the Linear head's per-key
+        # fallback keeps it replicated (the documented graceful path)
+        # while the layer stacks still shard
+        _mesh(2)
+        eng = _engine()
+        h_arrays = eng.dec._maybe_quant_head(
+            [p._data for p in eng.dec._head_params])
+        for a in h_arrays:
+            assert tuple(a.sharding.shard_shape(tuple(a.shape))) == \
+                tuple(a.shape)
+        m = eng.metrics()
+        assert m["weight_shard_count"] == 2
+        assert m["weight_bytes_per_device"] < sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in eng._weight_arrays())
+
+    def test_weight_gauges_identity(self):
+        _mesh(2)
+        eng = _engine()
+        m = eng.metrics()
+        dense = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in eng._weight_arrays())
+        assert m["weight_shard_count"] == 2
+        assert (m["weight_bytes_per_device"]
+                - m["weight_bytes_replicated"]) * 2 \
+            + m["weight_bytes_replicated"] == dense
+        assert m["weight_bytes_replicated"] < \
+            m["weight_bytes_per_device"] < dense
+
+    def test_unsharded_weight_gauges(self):
+        eng = _engine()
+        m = eng.metrics()
+        dense = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in eng._weight_arrays())
+        assert m["weight_shard_count"] == 1
+        assert m["weight_bytes_per_device"] == dense
+        assert m["weight_bytes_replicated"] == dense
+
+    def test_opt_out_replicates(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVING_MESH_WEIGHTS", "0")
+        _mesh(2)
+        eng = _engine()
+        assert eng.dec._weight_shard_mesh() is None
+        stk = eng.dec._stacked()
+        for k, a in stk.items():
+            assert tuple(a.sharding.shard_shape(tuple(a.shape))) == \
+                tuple(a.shape), k
+        assert eng.metrics()["weight_shard_count"] == 1
+
+    def test_zero_retraces_flat_budget_sharded_churn(self):
+        # the flat [T] core + prefix adoption + sharded weights under
+        # request churn: warmup sees the shape ladder, the second wave
+        # must add NOTHING to the trace count
+        _mesh(2)
+        eng = _engine(flat_budget=True, token_budget=16,
+                      prefix_cache_blocks=16)
+        _drive(eng, _reqs())
+        warm = eng.metrics()["traces"]
+        _drive(eng, _reqs(seed=29))
+        assert eng.metrics()["traces"] == warm, \
+            "weight-sharded flat-budget churn must stay zero-retrace"
+
+
+def test_sharding_spec_tool_pinned(capsys):
+    """tools/check_sharding_spec.py as a tier-1 test: every stacked
+    param key carries an explicit PartitionSpec (fp AND int8 flavors),
+    and mp=2 placement matches the table exactly."""
+    spec = importlib.util.spec_from_file_location(
+        "check_sharding_spec",
+        os.path.join(REPO_ROOT, "tools", "check_sharding_spec.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main()
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "ok" in out

@@ -1260,20 +1260,25 @@ def test_http_surface_pinned(capsys):
 
 def test_gateway_env_registry_complete():
     """Every PADDLE_GATEWAY_*/PADDLE_ROUTER_*/PADDLE_SLO_*/
-    PADDLE_AUTOSCALE_*/PADDLE_QOS_*/PADDLE_TENANT_*/PADDLE_ROLE* env
-    the serving stack reads is registered in testing.GW_ENV_VARS (the
-    conftest leak guard's list), and the registry carries no dead
-    entries — same structural discipline as FI_ENV_VARS/FR_ENV_VARS.
-    The SLO knobs live in inference/telemetry.py (SloPolicy.from_env)
-    and the QoS shares + engine role in inference/serving.py, so both
-    files join the scan; the autoscale knobs live in
-    serving_cluster/autoscale.py (already in the package scan); the
-    RPC client timeouts are read by serving_cluster/replica.py
-    (RpcReplica), also in the package scan."""
+    PADDLE_AUTOSCALE_*/PADDLE_QOS_*/PADDLE_TENANT_*/PADDLE_ROLE*/
+    PADDLE_SERVING_MESH_* env the serving stack reads is registered in
+    testing.GW_ENV_VARS (the conftest leak guard's list), and the
+    registry carries no dead entries — same structural discipline as
+    FI_ENV_VARS/FR_ENV_VARS. The SLO knobs live in
+    inference/telemetry.py (SloPolicy.from_env) and the QoS shares +
+    engine role in inference/serving.py, so both files join the scan;
+    the autoscale knobs live in serving_cluster/autoscale.py (already
+    in the package scan); the RPC client timeouts are read by
+    serving_cluster/replica.py (RpcReplica), also in the package scan;
+    the serving-mesh knobs are read by parallel/__init__.py
+    (init_serving_mesh) and inference/generation.py (the weight-shard
+    placement), so those two join the scan as well."""
     import re
 
+    import paddle_tpu.inference.generation as gen_mod
     import paddle_tpu.inference.serving as serving_mod
     import paddle_tpu.inference.telemetry as tele_mod
+    import paddle_tpu.parallel as par_mod
     import paddle_tpu.serving_cluster as sc
     from paddle_tpu.testing import GW_ENV_VARS
     pkg = os.path.dirname(os.path.abspath(sc.__file__))
@@ -1281,12 +1286,14 @@ def test_gateway_env_registry_complete():
              if fn.endswith(".py")]
     paths.append(os.path.abspath(tele_mod.__file__))
     paths.append(os.path.abspath(serving_mod.__file__))
+    paths.append(os.path.abspath(par_mod.__file__))
+    paths.append(os.path.abspath(gen_mod.__file__))
     found = set()
     for path in paths:
         with open(path) as f:
             found |= set(re.findall(
                 r"PADDLE_(?:(?:GATEWAY|ROUTER|SLO|AUTOSCALE|QOS"
-                r"|TENANT|ROLE|RPC)_[A-Z_0-9]+|ROLE\b)",
+                r"|TENANT|ROLE|RPC|SERVING_MESH)_[A-Z_0-9]+|ROLE\b)",
                 f.read()))
     # the rpc-replica probe knob lives in replica.py; bench/tests may
     # reference more — the guard list must cover everything READ here

@@ -246,9 +246,44 @@ def _check_mesh_shard_surface(failures):
                 f"kv_shard_pool_bytes={m['kv_shard_pool_bytes']} x "
                 f"{m['kv_shard_count']} != pool bytes {pool_bytes} — "
                 "per-device residency must be the dense pool / mp")
+        # weight-placement gauges: this model's head/FFN axes divide
+        # mp=2, so the stacks must ACTUALLY shard (per-device < dense,
+        # replicated strictly smaller) and the byte identity must
+        # recover the dense total computed from the arrays themselves
+        import math
+        if m.get("weight_shard_count") != 2:
+            failures.append(
+                f"mp=2 mesh engine reports weight_shard_count="
+                f"{m.get('weight_shard_count')!r}, expected 2 — the "
+                "stacked weights are no longer mesh-placed")
+        else:
+            dense_w = sum(math.prod(a.shape) * a.dtype.itemsize
+                          for a in eng._weight_arrays())
+            per_dev = m["weight_bytes_per_device"]
+            repl = m["weight_bytes_replicated"]
+            if (per_dev - repl) * 2 + repl != dense_w:
+                failures.append(
+                    f"weight byte identity broke: (per_device="
+                    f"{per_dev} - replicated={repl}) x 2 + {repl} != "
+                    f"dense {dense_w}")
+            if not 0 <= repl < per_dev < dense_w:
+                failures.append(
+                    f"mp=2 mesh engine shards no weight bytes: "
+                    f"per_device={per_dev} replicated={repl} "
+                    f"dense={dense_w} — expected replicated < "
+                    "per_device < dense")
+            stk = eng.dec._stacked()
+            qshard = stk["qkv_w"].sharding.shard_shape(
+                tuple(stk["qkv_w"].shape))
+            if qshard[1] * 2 != stk["qkv_w"].shape[1]:
+                failures.append(
+                    f"stacked qkv_w is not head-sharded on device: "
+                    f"local shard {qshard} vs full "
+                    f"{tuple(stk['qkv_w'].shape)}")
         text = eng.metrics_prometheus()
         for k in ("kv_shard_count", "kv_shard_heads",
-                  "kv_shard_pool_bytes"):
+                  "kv_shard_pool_bytes", "weight_shard_count",
+                  "weight_bytes_per_device", "weight_bytes_replicated"):
             name, _typ = PROMETHEUS_NAMES[k]
             if name not in text:
                 failures.append(
@@ -491,17 +526,17 @@ def _check_role_surface(failures):
     from paddle_tpu.serving_cluster import protocol as P
     from paddle_tpu.serving_cluster.router import Router
 
-    if SNAPSHOT_SCHEMA_VERSION != 6:
+    if SNAPSHOT_SCHEMA_VERSION != 7:
         failures.append(
             f"SNAPSHOT_SCHEMA_VERSION = {SNAPSHOT_SCHEMA_VERSION!r}, "
-            "pinned 6 (v6 = do_sample + health block — bump this "
-            "check deliberately alongside the schema)")
-    for key in ("role", "handoff", "do_sample", "health"):
+            "pinned 7 (v7 = the weights block — bump this check "
+            "deliberately alongside the schema)")
+    for key in ("role", "handoff", "do_sample", "health", "weights"):
         if key not in SNAPSHOT_REQUIRED_KEYS:
             failures.append(
                 f"SNAPSHOT_REQUIRED_KEYS lost {key!r} — the router's "
-                "disagg placement filter and the hedge-safety gate "
-                "read them off the wire")
+                "disagg placement filter, the hedge-safety gate and "
+                "the capacity planner read them off the wire")
     pinned = {
         "kv_blocks_shipped": (
             "paddle_serving_kv_blocks_shipped_total", "counter"),
