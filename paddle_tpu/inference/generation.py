@@ -114,6 +114,36 @@ def _absmax_int8(w, axis):
     return q, s
 
 
+def _absmax_int4(w, axis):
+    """int4 flavor of _absmax_int8 — SAME recipe, 4-bit range: scales =
+    absmax/7 over the reduced axis (zero-slice guarded), values
+    clip/round to [-7, 7] held in int8 nibbles pending _pack_int4.
+    Returns (int8 array of int4-valued entries, fp32 scales with the
+    reduced axis kept)."""
+    a = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(a), axis=axis, keepdims=True) / 7.0
+    q = jnp.clip(jnp.round(a / jnp.maximum(s, 1e-8)),
+                 -7, 7).astype(jnp.int8)
+    return q, s
+
+
+def _pack_int4(q, axis):
+    """Pack adjacent pairs of int4-valued int8 entries along ``axis``
+    into single bytes: the LOW nibble holds the even index, the HIGH
+    nibble the odd one (both sign-extended on unpack via arithmetic
+    shifts — see ops.pallas.fused_dequant_matmul). The axis must be
+    even-length; halving it is what halves the int8 flavor's bytes."""
+    axis = axis % q.ndim
+    if q.shape[axis] % 2:
+        raise ValueError(
+            f"_pack_int4: axis {axis} has odd length {q.shape[axis]} — "
+            "int4 packing pairs adjacent contracted elements")
+    lo = jax.lax.slice_in_dim(q, 0, None, 2, axis)
+    hi = jax.lax.slice_in_dim(q, 1, None, 2, axis)
+    return ((lo & jnp.int8(0x0F))
+            | jnp.left_shift(hi, 4).astype(jnp.int8)).astype(jnp.int8)
+
+
 def _filter_logits(logits, do_sample, top_k, top_p, temperature):
     if not do_sample:
         return logits
@@ -419,8 +449,26 @@ class FusedDecoder:
     """
 
     def __init__(self, fmt, embed, head, max_seq_len, use_rotary=False,
-                 rope_base=10000.0):
+                 rope_base=10000.0, weight_quant=None, kv_quant=None):
         from ..nn.layer.layers import Layer
+        # first-class quant config: an explicit ctor arg WINS over the
+        # env knobs (PADDLE_TPU_DECODE_INT4_WEIGHTS /
+        # PADDLE_TPU_DECODE_INT8_WEIGHTS / PADDLE_TPU_DECODE_INT8_CACHE
+        # stay as deploy-time fallbacks); None defers to the env.
+        # Explicit config fails FAST — an unknown mode or an int4 model
+        # whose contracted axes cannot pack is a ValueError here, not a
+        # first-dispatch surprise.
+        if weight_quant not in (None, "none", "int8", "int4"):
+            raise ValueError(
+                f"weight_quant={weight_quant!r}: expected 'none', "
+                "'int8' or 'int4'")
+        if kv_quant not in (None, "none", "int8"):
+            raise ValueError(
+                f"kv_quant={kv_quant!r}: expected 'none' or 'int8' — "
+                "the KV pool has no int4 flavor (per-row absmax at 4 "
+                "bits clips decode tails; weights are where int4 pays)")
+        self._weight_quant_arg = weight_quant
+        self._kv_quant_arg = kv_quant
         self.fmt = fmt
         self.embed = embed
         self.head = head
@@ -442,8 +490,44 @@ class FusedDecoder:
             head, Layer) else []
         self._scan_cache = {}      # (sample cfg, mesh, chunk, eos) -> jitted scan
         self._stk_cache = None
+        if self._weight_quant_mode() == "int4":
+            self._validate_int4_dims()
 
     # ------------------------------------------------------------ stacking
+    def _weight_quant_mode(self) -> str:
+        """The serving weight flavor: 'none' | 'int8' | 'int4'. An
+        explicit ctor weight_quant wins; otherwise the env knobs decide
+        (INT4 outranks INT8 when both are set — the more aggressive
+        opt-in is the intended one)."""
+        if self._weight_quant_arg is not None:
+            return ("none" if self._weight_quant_arg == "none"
+                    else self._weight_quant_arg)
+        if os.environ.get("PADDLE_TPU_DECODE_INT4_WEIGHTS") == "1":
+            return "int4"
+        if os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1":
+            return "int8"
+        return "none"
+
+    def _validate_int4_dims(self):
+        """int4 packs TWO adjacent contracted-axis elements per byte, so
+        every contracted axis of the stacked weights must be even:
+        embed_dim (qkv_w / f1_w contract E), num_heads*head_dim (lin_w
+        contracts the concatenated head axis) and ffn_dim (f2_w).
+        Raises up front — the packed stack cannot be built otherwise."""
+        f = self.fmt
+        e = int(f.qkv_weights[0]._data.shape[-1])
+        ff = int(f.ffn1_weights[0]._data.shape[-1])
+        heads = f.num_heads * f.head_dim
+        bad = [n for n, v in (("embed_dim", e),
+                              ("num_heads*head_dim", heads),
+                              ("ffn_dim", ff)) if v % 2]
+        if bad:
+            raise ValueError(
+                "weight_quant='int4' needs even contracted axes to pack "
+                f"two nibbles per byte; odd: {', '.join(bad)} "
+                f"(embed_dim={e}, num_heads*head_dim={heads}, "
+                f"ffn_dim={ff})")
+
     def _weight_shard_mesh(self):
         """The mesh the stacked weights (and a Linear LM head) shard
         over, or None (replicated — the pre-sharding behavior).
@@ -460,6 +544,15 @@ class FusedDecoder:
         ff = int(self.fmt.ffn1_weights[0]._data.shape[-1])
         if self.fmt.num_heads % mp or ff % mp:
             return None
+        if self._weight_quant_mode() == "int4":
+            # the row-parallel stacks shard their PACKED contracted axis
+            # (lin_w [L, nh*hd/2, E], f2_w [L, FF/2, E]): a byte-shard
+            # boundary must land on a whole byte, so the HALF lengths
+            # must divide mp too — else fall back to replicated weights
+            # (init_serving_mesh rejects this up front when given dims)
+            if (self.fmt.num_heads * self.fmt.head_dim // 2) % mp \
+                    or (ff // 2) % mp:
+                return None
         return mesh
 
     def _stacked(self):
@@ -472,13 +565,14 @@ class FusedDecoder:
         # in HBM until the next restack completed (r4 verdict weak #7).
         import weakref
         version = [p._data for p in f.parameters()]
-        # trace-time env state is part of the cache identity: flipping
-        # the weight-quant flag OR the weight-shard placement (mesh /
-        # PADDLE_SERVING_MESH_WEIGHTS) must rebuild the stack, not
-        # reuse it — a stack placed for the wrong mesh would silently
-        # reshard on every dispatch
-        quant = os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS") == "1"
-        env_sig = (quant, self._weight_shard_mesh())
+        # trace-time quant mode (ctor arg or env, see
+        # _weight_quant_mode) and the weight-shard placement (mesh /
+        # PADDLE_SERVING_MESH_WEIGHTS) are part of the cache identity:
+        # flipping either must rebuild the stack, not reuse it — a
+        # stack placed for the wrong mesh would silently reshard on
+        # every dispatch
+        mode = self._weight_quant_mode()
+        env_sig = (mode, self._weight_shard_mesh())
         if self._stk_cache is not None and \
                 self._stk_cache[2] == env_sig and \
                 len(self._stk_cache[0]) == len(version) and \
@@ -512,7 +606,7 @@ class FusedDecoder:
             "f1_w": stk(f.ffn1_weights), "f1_b": stk(f.ffn1_biases),
             "f2_w": stk(f.ffn2_weights), "f2_b": stk(f.ffn2_biases),
         }
-        if quant:
+        if mode == "int8":
             # weight-only int8 decode (reference: Predictor's weight-only
             # mode applied to the fused decode stack): at decode batch
             # sizes the step is WEIGHT-traffic bound (~2 bytes/param/token
@@ -534,6 +628,36 @@ class FusedDecoder:
             out["lin_w"], out["lin_w_s"] = q_right(out["lin_w"])
             out["f1_w"], out["f1_w_s"] = q_right(out["f1_w"])
             out["f2_w"], out["f2_w_s"] = q_right(out["f2_w"])
+        elif mode == "int4":
+            # weight-only int4 (reference: Predictor's weight-only int4
+            # mode): absmax/7 per (layer, out-channel), two adjacent
+            # CONTRACTED-axis nibbles per byte — quartering the int8
+            # flavor's dominant stream again. Packing happens AFTER the
+            # head-major qkv fuse above, and always along the reduced
+            # axis of the absmax, so the pack never straddles a
+            # STACKED_PARAM_SPECS 'mp' split: qkv_w/f1_w pack the
+            # UNsharded E axis, and lin_w/f2_w shard the packed axis in
+            # whole bytes (validated in _weight_shard_mesh /
+            # init_serving_mesh). The packed arrays keep the int8
+            # flavor's key names, so the sharding table and every
+            # downstream consumer (mm_p, tools) see one vocabulary.
+            # mm_p never unpacks to a full fp copy: single-device it
+            # runs the fused dequant-matmul Pallas kernel, under a mesh
+            # a nibble-split XLA dot (see mm_p).
+            self._validate_int4_dims()
+
+            def q4_left(w3):         # used as h @ W.T: [L, O, I]
+                q, s = _absmax_int4(w3, -1)
+                return _pack_int4(q, -1), jnp.swapaxes(s, -1, -2)
+
+            def q4_right(w3):        # used as h @ W: [L, I, O]
+                q, s = _absmax_int4(w3, 1)            # scales [L, 1, O]
+                return _pack_int4(q, 1), s
+
+            out["qkv_w"], out["qkv_w_s"] = q4_left(out["qkv_w"])
+            out["lin_w"], out["lin_w_s"] = q4_right(out["lin_w"])
+            out["f1_w"], out["f1_w_s"] = q4_right(out["f1_w"])
+            out["f2_w"], out["f2_w_s"] = q4_right(out["f2_w"])
         mesh = env_sig[1]
         if mesh is not None:
             # tensor-parallel placement: commit every stacked array to
@@ -615,13 +739,16 @@ class FusedDecoder:
                               sig)
         return out
 
-    @staticmethod
-    def _int8_cache() -> bool:
+    def _int8_cache(self) -> bool:
         """Opt-in int8 KV cache (reference: fused_multi_transformer's
         cache_kv int8 serving mode). Decode is bandwidth-bound — int8
         halves the cache bytes streamed per token; rows are absmax-
         quantized per (layer, kv, batch, head, position) with fp32
-        scales, dequantized in VMEM by the stacked kernel."""
+        scales, dequantized in VMEM by the stacked kernels (row AND
+        flat flavors). An explicit ctor kv_quant wins; None defers to
+        PADDLE_TPU_DECODE_INT8_CACHE."""
+        if self._kv_quant_arg is not None:
+            return self._kv_quant_arg == "int8"
         return os.environ.get("PADDLE_TPU_DECODE_INT8_CACHE") == "1"
 
     def init_cache(self, batch, dtype=None):
@@ -1221,7 +1348,42 @@ class FusedDecoder:
         def mm_p(a, w, s=None):
             # weight-only int8: dot on the exact int-valued weights
             # (bf16-exact in [-127, 127], fp32 accumulation), then
-            # the per-out-channel dequant scale on the [B, O] result
+            # the per-out-channel dequant scale on the [B, O] result.
+            # int4 arrives PACKED (two contracted nibbles per int8
+            # byte), unambiguous by shape: a packed weight's contracted
+            # axis is HALF the activation's — an unpacked int8 weight
+            # always matches it exactly.
+            if s is not None and w.dtype == jnp.int8 \
+                    and 2 * w.shape[0] == a.shape[-1]:
+                k2 = w.shape[0]
+                if mesh is None:
+                    from ..ops.pallas.fused_dequant_matmul import (
+                        fused_dequant_matmul,
+                        fused_dequant_matmul_is_supported)
+                    m_rows = 1
+                    for d_ in a.shape[:-1]:
+                        m_rows *= d_
+                    if fused_dequant_matmul_is_supported(
+                            m_rows, a.shape[-1], w.shape[1]):
+                        # fused dequant-matmul: bytes stream packed,
+                        # nibbles unpack in VMEM, scales fold into the
+                        # fp32 accumulator — no unpacked weight copy
+                        return fused_dequant_matmul(
+                            a, w, s.reshape(1, -1), out_dtype=a.dtype)
+                # nibble-split XLA dot (mesh path — a pallas_call
+                # cannot live under GSPMD auto-partitioning — and the
+                # unsupported-shape fallback): two half-K dots on the
+                # sign-extended nibble planes. The activation splits by
+                # a [..., K/2, 2] reshape (GSPMD-representable on a
+                # row-sharded axis; a stride-2 slice is not), the
+                # weight stays packed — still no full unpacked copy at
+                # rest, only the in-fusion nibble views.
+                lo = jnp.right_shift(jnp.left_shift(w, 4), 4)
+                hi = jnp.right_shift(w, 4)
+                ar = a.reshape(a.shape[:-1] + (k2, 2))
+                out_ = (ar[..., 0] @ lo.astype(a.dtype)
+                        + ar[..., 1] @ hi.astype(a.dtype))
+                return out_ * s.astype(a.dtype)
             out_ = a @ w.astype(a.dtype)
             return out_ * s.astype(a.dtype) if s is not None else out_
 
@@ -1537,12 +1699,15 @@ class FusedDecoder:
             # the SEGMENT region's ragged block-flash attend: q_s
             # [Ts, H, D] — aligned single-slot chunks of prefill /
             # draft segments; each token attends its OWN slot's cache
-            # positions <= its position. Paged fp pools take the flat
-            # Pallas kernel (per-chunk metadata rides as scalar
-            # prefetch; under a mesh it runs per-shard via shard_map
-            # over the head axis); everything else (int8 pools, dense
-            # rings, opt-out) goes through the gather-through-table
-            # dense fallback — the parity path.
+            # positions <= its position. Paged pools take the flat
+            # Pallas kernel in BOTH flavors — fp pools the fp kernel,
+            # int8 pools decode_attention_paged_flat_i8 (in-kernel
+            # dequant of the pool + its mirrored scales; per-chunk
+            # metadata rides as scalar prefetch, and under a mesh
+            # either flavor runs per-shard via shard_map over the head
+            # axis); everything else (dense rings, unsupported shapes,
+            # opt-out) goes through the gather-through-table dense
+            # fallback — the parity path.
             ts_ = q_s.shape[0]
             paged = isinstance(caches, dict)
             quant = isinstance(caches, tuple) or (paged and
@@ -1550,9 +1715,11 @@ class FusedDecoder:
             if paged:
                 pool_kv, tbl = caches["kv"], caches["tbl"]
                 if (os.environ.get("PADDLE_TPU_STACKED_KERNEL", "1")
-                        != "0" and not quant):
+                        != "0"):
                     from ..ops.pallas.decode_attention import (
                         decode_attention_paged_flat,
+                        decode_attention_paged_flat_i8,
+                        paged_flat_i8_is_supported,
                         paged_flat_is_supported)
                     mp = (1 if mesh is None
                           else dict(mesh.shape).get("mp", 1))
@@ -1560,32 +1727,55 @@ class FusedDecoder:
                             and pool_kv.shape[3] % mp == 0:
                         # head-sharded flat kernel: per-chunk metadata
                         # and the block table are replicated, the pool
-                        # shards by head — shard_map over 'mp' with no
+                        # (and in cache-quant mode its scales) shards
+                        # by head — shard_map over 'mp' with no
                         # collectives (see attend() for the rationale)
                         lshape = (pool_kv.shape[:3]
                                   + (pool_kv.shape[3] // mp,)
                                   + pool_kv.shape[4:])
-                        if paged_flat_is_supported(
-                                ts_, nh // mp, hd, lshape, q_s.dtype,
-                                cache_dtype=pool_kv.dtype):
+                        ok = (paged_flat_i8_is_supported(
+                                  ts_, nh // mp, hd, lshape, q_s.dtype)
+                              if quant else
+                              paged_flat_is_supported(
+                                  ts_, nh // mp, hd, lshape, q_s.dtype,
+                                  cache_dtype=pool_kv.dtype))
+                        if ok:
                             cslot, cbase, cn = cmeta
                             from jax import shard_map
                             from jax.sharding import PartitionSpec as SP
+                            qsp = SP(None, "mp", None)
+                            psp = SP(None, None, None, "mp", None, None)
+                            if quant:
+                                fn = shard_map(
+                                    decode_attention_paged_flat_i8,
+                                    mesh=mesh,
+                                    in_specs=(qsp, psp, psp, SP(), SP(),
+                                              SP(), SP(), SP()),
+                                    out_specs=qsp, check_vma=False)
+                                return fn(q_s, pool_kv, caches["sc"],
+                                          tbl, jnp.minimum(cslot, b - 1),
+                                          cbase, cn, l)
                             fn = shard_map(
                                 decode_attention_paged_flat, mesh=mesh,
-                                in_specs=(SP(None, "mp", None),
-                                          SP(None, None, None, "mp",
-                                             None, None),
+                                in_specs=(qsp, psp,
                                           SP(), SP(), SP(), SP(), SP()),
-                                out_specs=SP(None, "mp", None),
+                                out_specs=qsp,
                                 check_vma=False)
                             o = fn(q_s, pool_kv, tbl,
                                    jnp.minimum(cslot, b - 1), cbase, cn,
                                    l)
                             return o
-                    if mesh is None and paged_flat_is_supported(
-                            ts_, nh, hd, pool_kv.shape, q_s.dtype,
-                            cache_dtype=pool_kv.dtype):
+                    if mesh is None and quant and \
+                            paged_flat_i8_is_supported(
+                                ts_, nh, hd, pool_kv.shape, q_s.dtype):
+                        cslot, cbase, cn = cmeta
+                        return decode_attention_paged_flat_i8(
+                            q_s, pool_kv, caches["sc"], tbl,
+                            jnp.minimum(cslot, b - 1), cbase, cn, l)
+                    if mesh is None and not quant and \
+                            paged_flat_is_supported(
+                                ts_, nh, hd, pool_kv.shape, q_s.dtype,
+                                cache_dtype=pool_kv.dtype):
                         cslot, cbase, cn = cmeta
                         o = decode_attention_paged_flat(
                             q_s, pool_kv, tbl,

@@ -489,9 +489,11 @@ def flat_gather_view(pool_l, tbl, tslot, smax, sc_l=None):
     tslot: [T] int32 per-token slot ids ALREADY CLAMPED in-bounds
     (pad tokens point at any valid slot — their positions are masked
     by the caller); sc_l: optional [2, NB, Hk, 1, Bt] int8 dequant
-    scales (the int8 pool flavor — the flat Pallas kernel has no i8
-    path, so quantized pools always come through here). Returns
-    [2, T, Hk, Smax, D] float32 (dequantized when sc_l is given).
+    scales (the int8 pool flavor — quantized pools come through here
+    whenever decode_attention.paged_flat_i8_is_supported refuses the
+    shape, e.g. Bt below the int8 sublane minimum of 32; this view is
+    the parity ORACLE the flat i8 Pallas kernel is tested against).
+    Returns [2, T, Hk, Smax, D] float32 (dequantized when sc_l given).
 
     Sentinel/unmapped table entries clamp to an arbitrary block —
     their positions are >= the row's lens and masked by the caller's

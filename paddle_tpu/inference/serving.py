@@ -244,9 +244,16 @@ class ServingEngine:
                  prefix_cache_blocks=0, prefix_cache=None, spec_k=None,
                  paged=None, kv_pool=None, kv_pool_blocks=None,
                  token_budget=None, flat_budget=None,
-                 telemetry_ring=None, slo=None, role=None):
+                 telemetry_ring=None, slo=None, role=None,
+                 weight_quant=None, kv_quant=None):
+        # first-class quant config rides the decoder ctor: explicit
+        # args win, None defers to the PADDLE_TPU_DECODE_* env knobs;
+        # FusedDecoder fail-fasts unknown modes and int4-unpackable
+        # model axes (see its ctor / _validate_int4_dims)
         self.dec = FusedDecoder(fmt, embed, head, max_seq_len,
-                                use_rotary=use_rotary)
+                                use_rotary=use_rotary,
+                                weight_quant=weight_quant,
+                                kv_quant=kv_quant)
         self.num_slots = int(num_slots)
         # disaggregated serving role (PADDLE_ROLE): "mixed" (default)
         # is today's behavior — prefill and decode share this engine.
@@ -352,6 +359,21 @@ class ServingEngine:
                 "rejects this layout up front)",
                 RuntimeWarning, stacklevel=2)
         self.paged = want_paged
+        if weight_quant == "int4" and not self.paged:
+            # explicit int4 is a serving-memory commitment: the dense
+            # per-slot ring is the parity/bring-up layout (B x Smax HBM
+            # regardless of residency), so pairing it with packed
+            # weights states two contradictory memory intents — refuse
+            # rather than ship a half-quantized engine silently. (The
+            # env knob on a dense engine still works for parity runs;
+            # only the EXPLICIT ctor pairing fails.)
+            raise ValueError(
+                "weight_quant='int4' with a dense KV ring: this engine "
+                "resolved to the dense layout (PADDLE_SERVING_PAGED=0, "
+                "paged=False, a shared dense prefix cache, or an "
+                "indivisible head count under a mesh) — int4 packed "
+                "weights are a paged-serving memory feature; use "
+                "paged=True or drop weight_quant")
         if not self.paged and (kv_pool is not None
                                or kv_pool_blocks is not None):
             raise ValueError(

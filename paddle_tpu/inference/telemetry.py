@@ -129,7 +129,14 @@ DEFAULT_QOS_SHARES = {"high": 4, "normal": 2, "low": 1}
 # the serving step's weight arrays; (per_device - replicated) x
 # shard_count + replicated == the dense byte total). The capacity
 # planner's model-fits-here signal for mp-sharded replicas.
-SNAPSHOT_SCHEMA_VERSION = 7
+# v8: quantized serving — the "weights" block gains weight_quant
+# ("none"|"int8"|"int4") and kv_quant ("none"|"int8"): the byte gauges
+# already report QUANTIZED residency (packed arrays + scale mirrors at
+# their true size), so without the mode fields a capacity planner
+# cannot tell a small fp model from a quantized large one, and a
+# router cannot refuse to mix quantized/fp replicas in a greedy-parity
+# hedge pool.
+SNAPSHOT_SCHEMA_VERSION = 8
 
 # keys every snapshot carries, on every engine configuration
 SNAPSHOT_REQUIRED_KEYS = frozenset({
@@ -929,9 +936,16 @@ def snapshot(engine):
         # residency of the step's weight arrays ((per_device -
         # replicated) x shard_count + replicated == dense total): the
         # capacity planner's model-fits-here signal
+        # v8: + quant modes — the byte gauges report QUANTIZED
+        # residency (packed stacks + scale mirrors), so the planner
+        # needs the mode to size an fp replica of the same model, and
+        # the router needs it to keep hedge pools mode-homogeneous
         "weights": {"shard_count": m["weight_shard_count"],
                     "bytes_per_device": m["weight_bytes_per_device"],
-                    "bytes_replicated": m["weight_bytes_replicated"]},
+                    "bytes_replicated": m["weight_bytes_replicated"],
+                    "weight_quant": engine.dec._weight_quant_mode(),
+                    "kv_quant": ("int8" if engine.dec._int8_cache()
+                                 else "none")},
         "spans_logged": len(tele.spans),
         "steps_logged": len(tele.steps),
         "telemetry_ring": tele.ring,

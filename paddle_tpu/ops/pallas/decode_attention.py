@@ -55,6 +55,12 @@ block `num_blocks` (paged), so mode="drop" skips it; real tokens
 inherit the submit-time `prompt + max_new <= Smax` bound through the
 packer (a segment's positions are lens..lens+seg-1, exactly the
 budget core's window), so `pos < Smax` holds for every landed write.
+Both flat READ kernels consume that discipline: the fp flavor
+(decode_attention_paged_flat) and the int8 flavor
+(decode_attention_paged_flat_i8, which dequants the quantized pool +
+its mirrored scales in kernel) address blocks through the same
+chunk-clamped table translation, so every position a flat chunk can
+attend was landed under the packer's `pos < Smax` bound.
 """
 from __future__ import annotations
 
@@ -70,12 +76,12 @@ __all__ = ["decode_attention", "decode_attention_stacked",
            "decode_attention_stacked_i8", "decode_attention_stacked_write",
            "decode_attention_stacked_i8_write",
            "decode_attention_paged", "decode_attention_paged_i8",
-           "decode_attention_paged_flat",
+           "decode_attention_paged_flat", "decode_attention_paged_flat_i8",
            "is_supported", "stacked_is_supported",
            "stacked_i8_is_supported", "stacked_write_is_supported",
            "stacked_i8_write_is_supported", "paged_is_supported",
            "paged_i8_is_supported", "paged_flat_is_supported",
-           "FLAT_CHUNK"]
+           "paged_flat_i8_is_supported", "FLAT_CHUNK"]
 
 NEG_INF = -1e30
 
@@ -1158,8 +1164,12 @@ def paged_flat_is_supported(t, h, d, pool_shape, dtype,
                             cache_dtype=None) -> bool:
     """Support predicate for decode_attention_paged_flat: stream width
     t must tile into FLAT_CHUNK query chunks; the pool obeys the same
-    Bt-sublane and dtype-match rules as the row-aligned paged kernel
-    (int8 pools go to the gather-dense fallback — no flat i8 flavor)."""
+    Bt-sublane and dtype-match rules as the row-aligned paged kernel.
+    Int8 pools have their own flavor — gate those with
+    paged_flat_i8_is_supported (whose Bt gate is the int8 sublane
+    minimum); only pools passing NEITHER predicate take the
+    gather-dense fallback (paged_kv.flat_gather_view, the parity
+    oracle)."""
     if len(pool_shape) != 6:
         return False
     if t < FLAT_CHUNK or t % FLAT_CHUNK:
@@ -1285,3 +1295,135 @@ def decode_attention_paged_flat(q, pool, tables, chunk_slot, chunk_base,
     )(lay, chunk_slot.astype(jnp.int32), chunk_base.astype(jnp.int32),
       chunk_n.astype(jnp.int32), tables.astype(jnp.int32), qt, pool)
     return jnp.swapaxes(out, 0, 1).astype(out_dtype)
+
+
+def paged_flat_i8_is_supported(t, h, d, pool_shape, dtype) -> bool:
+    """Support predicate for decode_attention_paged_flat_i8: the flat
+    layout rules (FLAT_CHUNK-tiled stream, head grouping, d <= 256)
+    with the int8 pool's sublane gate (Bt % 32 == 0 — the Mosaic
+    minimum for an int8 second-to-minor axis); compute dtype is the
+    query's. Pools failing this go to the gather-dense fallback
+    (flat_gather_view's sc path), which stays the parity oracle."""
+    if len(pool_shape) != 6:
+        return False
+    if t < FLAT_CHUNK or t % FLAT_CHUNK:
+        return False
+    if d > 256:
+        return False
+    if pool_shape[3] == 0 or h % pool_shape[3] != 0:
+        return False
+    bt = pool_shape[4]
+    if bt < 32 or bt % 32:
+        return False
+    return jnp.dtype(dtype) in (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _paged_flat_i8_kernel(lay_ref, cslot_ref, cbase_ref, cn_ref, tbl_ref,
+                          q_ref, kv_ref, kvs_ref, o_ref, acc_sc, m_sc,
+                          l_sc, *, scale, bq, bk):
+    # _paged_flat_kernel's chunk addressing with _paged_i8_kernel's
+    # dequant: int8 KV casts to the compute dtype and the per-row
+    # absmax scales apply COLUMN-wise to the score matrix as [1, bk]
+    # lane-major tiles (see _online_softmax_block)
+    ci = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    n_valid = cbase_ref[ci]
+    sq_dyn = cn_ref[ci]
+
+    @pl.when(ki == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    k_start = ki * bk
+    run = (sq_dyn > 0) & (k_start < n_valid + sq_dyn)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        k = kv_ref[0, 0, 0, 0].astype(q.dtype)
+        v = kv_ref[0, 1, 0, 0].astype(q.dtype)
+        _online_softmax_block(q, k, v, n_valid, k_start,
+                              acc_sc, m_sc, l_sc,
+                              scale=scale, sq=sq_dyn, bq=bq, bk=bk,
+                              k_col_scale=kvs_ref[0, 0, 0, 0],
+                              v_col_scale=kvs_ref[0, 1, 0, 0])
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_sc[:]
+        o_ref[0] = (acc_sc[:] /
+                    jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def decode_attention_paged_flat_i8(q, pool_i8, pool_scales, tables,
+                                   chunk_slot, chunk_base, chunk_n,
+                                   layer, scale=None):
+    """int8 flavor of the flat-stream kernel: pool_i8
+    [L, 2, NB, Hk, Bt, D] int8 with mirrored per-row absmax scales
+    pool_scales [L, 2, NB, Hk, 1, Bt] fp32 (the scales pool resolves
+    through the SAME chunk-clamped table translation block-for-block,
+    like the row-aligned decode_attention_paged_i8). q: [T, H, D] in
+    the compute dtype; chunk metadata as decode_attention_paged_flat.
+    Returns [T, H, D] in the QUERY dtype — the output of a quantized
+    pool is fp, never int8."""
+    t, h, d = q.shape
+    hk, bt = pool_i8.shape[3], pool_i8.shape[4]
+    nb = pool_i8.shape[2]
+    nblk = tables.shape[1]
+    group = h // hk
+    nc = t // FLAT_CHUNK
+    if t % FLAT_CHUNK:
+        raise ValueError(
+            f"decode_attention_paged_flat_i8: stream width {t} must be "
+            f"a multiple of FLAT_CHUNK={FLAT_CHUNK} (gate with "
+            "paged_flat_i8_is_supported)")
+    if scale is None:
+        scale = d ** -0.5
+    if pool_i8.dtype != jnp.int8:
+        raise ValueError(
+            "decode_attention_paged_flat_i8: pool must be int8")
+    if pool_scales.shape != pool_i8.shape[:4] + (1, bt):
+        raise ValueError(
+            "decode_attention_paged_flat_i8: scales must be "
+            f"[L, 2, NB, Hk, 1, Bt], got {pool_scales.shape}")
+    out_dtype = q.dtype
+    qt = jnp.swapaxes(q, 0, 1)                    # [H, T, D]
+    grid = (nc, h, nblk)
+
+    def _blk(ci, j, cb_r, cn_r, tbl_r, cs_r):
+        # same per-chunk last-valid-block clamp as the fp flavor
+        last = (cb_r[ci] + jnp.maximum(cn_r[ci], 1) - 1) // bt
+        return jnp.minimum(tbl_r[cs_r[ci], jnp.minimum(j, last)], nb - 1)
+
+    kvidx = lambda ci, h_, j, lay_r, cs_r, cb_r, cn_r, tbl_r, g=group: (  # noqa: E731
+        lay_r[0], 0, _blk(ci, j, cb_r, cn_r, tbl_r, cs_r), h_ // g, 0, 0)
+    qidx = lambda ci, h_, j, lay_r, cs_r, cb_r, cn_r, tbl_r: (  # noqa: E731
+        h_, ci, 0)
+    lay = jnp.asarray(layer, jnp.int32).reshape(1)
+    out = pl.pallas_call(
+        functools.partial(_paged_flat_i8_kernel, scale=float(scale),
+                          bq=FLAT_CHUNK, bk=bt),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, FLAT_CHUNK, d), qidx),
+                pl.BlockSpec((1, 2, 1, 1, bt, d), kvidx),
+                pl.BlockSpec((1, 2, 1, 1, 1, bt), kvidx),
+            ],
+            out_specs=pl.BlockSpec((1, FLAT_CHUNK, d), qidx),
+            scratch_shapes=[
+                pltpu.VMEM((FLAT_CHUNK, d), jnp.float32),
+                pltpu.VMEM((FLAT_CHUNK, 1), jnp.float32),
+                pltpu.VMEM((FLAT_CHUNK, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, t, d), out_dtype),
+        interpret=_interpret(),
+    )(lay, chunk_slot.astype(jnp.int32), chunk_base.astype(jnp.int32),
+      chunk_n.astype(jnp.int32), tables.astype(jnp.int32), qt, pool_i8,
+      pool_scales)
+    return jnp.swapaxes(out, 0, 1)

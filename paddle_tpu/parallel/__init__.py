@@ -38,7 +38,10 @@ def current_mesh() -> Optional[Mesh]:
 
 def init_serving_mesh(mp: Optional[int] = None, *,
                       num_heads: Optional[int] = None,
-                      ffn_dim: Optional[int] = None) -> Optional[Mesh]:
+                      ffn_dim: Optional[int] = None,
+                      head_dim: Optional[int] = None,
+                      weight_quant: Optional[str] = None
+                      ) -> Optional[Mesh]:
     """Stand up (or reuse) a pure tensor-parallel mesh for serving:
     dp=pp=sharding=1, mp as given (default: ``PADDLE_SERVING_MESH_MP``;
     unset/0/1 = no mesh — returns whatever mesh is already active).
@@ -51,6 +54,11 @@ def init_serving_mesh(mp: Optional[int] = None, *,
     by head and the FFN weights by column over 'mp', so an indivisible
     axis is rejected HERE with an actionable error instead of surfacing
     as a downstream XLA shape failure (or a silently replicated stack).
+    With ``weight_quant='int4'`` (plus ``head_dim``) the validation
+    extends to the PACKED contracted axes: int4 packs two elements per
+    byte, and the row-parallel stacks (out-proj [L, nh*hd/2, E], FFN-2
+    [L, ffn/2, E]) split that packed axis over 'mp' — a shard boundary
+    must land on a whole byte, so the HALF lengths must divide mp too.
 
     This is the one-call bring-up a sharded ``ServingEngine`` needs:
 
@@ -78,6 +86,23 @@ def init_serving_mesh(mp: Optional[int] = None, *,
             f"init_serving_mesh(mp={mp}): ffn_dim={ffn_dim} is not "
             "divisible by mp — the FFN weights shard by column over "
             f"'mp'; pick mp from the divisors of {ffn_dim}")
+    if weight_quant == "int4":
+        if ffn_dim is not None and (ffn_dim % 2 or (ffn_dim // 2) % mp):
+            raise ValueError(
+                f"init_serving_mesh(mp={mp}, weight_quant='int4'): "
+                f"ffn_dim={ffn_dim} must be even AND its packed half "
+                f"{ffn_dim // 2} divisible by mp — the row-parallel "
+                "FFN-2 stack shards its int4-PACKED contracted axis, "
+                "and a shard boundary must land on a whole byte")
+        if num_heads is not None and head_dim is not None:
+            hh = num_heads * head_dim
+            if hh % 2 or (hh // 2) % mp:
+                raise ValueError(
+                    f"init_serving_mesh(mp={mp}, weight_quant='int4'): "
+                    f"num_heads*head_dim={hh} must be even AND its "
+                    f"packed half {hh // 2} divisible by mp — the "
+                    "row-parallel out-proj stack shards its "
+                    "int4-PACKED contracted axis in whole bytes")
     if mesh is not None:
         have = dict(mesh.shape).get("mp", 1)
         if have == mp:

@@ -123,6 +123,21 @@ GW_ENV_VARS = (
 )
 
 
+# Serving quantization knobs (inference/generation.py _weight_quant_mode
+# / _int8_cache; ctor args weight_quant=/kv_quant= override them) — same
+# registry discipline: a leaked weight flavor silently re-stacks every
+# later engine's weights (different bytes, different numerics, different
+# jit cache), and a leaked cache flavor flips every later pool to int8.
+# Only the quant suites may run with these set; everyone else uses
+# monkeypatch or the ctor args.
+QUANT_ENV_VARS = (
+    "PADDLE_TPU_DECODE_INT4_WEIGHTS",  # int4-packed stacked weights
+    "PADDLE_TPU_DECODE_INT8_CACHE",    # int8 KV pool + scale mirrors
+    "PADDLE_TPU_DECODE_INT8_HEAD",     # int8 LM head
+    "PADDLE_TPU_DECODE_INT8_WEIGHTS",  # int8 stacked weights
+)
+
+
 def fi_env_active() -> list:
     """The PADDLE_FI_* vars currently set (empty list = harness disarmed)."""
     return [v for v in FI_ENV_VARS if os.environ.get(v) not in (None, "")]
@@ -138,7 +153,14 @@ def gw_env_active() -> list:
     return [v for v in GW_ENV_VARS if os.environ.get(v) not in (None, "")]
 
 
+def quant_env_active() -> list:
+    """The serving-quant env vars currently set (empty = fp default)."""
+    return [v for v in QUANT_ENV_VARS
+            if os.environ.get(v) not in (None, "")]
+
+
 from . import fault  # noqa: E402  (re-export the harness)
 
-__all__ = ["FI_ENV_VARS", "FR_ENV_VARS", "GW_ENV_VARS", "fi_env_active",
-           "fr_env_active", "gw_env_active", "fault"]
+__all__ = ["FI_ENV_VARS", "FR_ENV_VARS", "GW_ENV_VARS", "QUANT_ENV_VARS",
+           "fi_env_active", "fr_env_active", "gw_env_active",
+           "quant_env_active", "fault"]

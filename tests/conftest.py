@@ -33,7 +33,7 @@ def _no_fault_injection_leak(request):
     their SUBPROCESS env only; the pytest process itself must stay clean
     everywhere except tests/test_fault_tolerance.py."""
     from paddle_tpu.testing import (fi_env_active, fr_env_active,
-                                    gw_env_active)
+                                    gw_env_active, quant_env_active)
     fspath = str(request.node.fspath)
     exempt = ("test_fault_tolerance" in fspath
               or "test_flight_recorder" in fspath)
@@ -64,6 +64,19 @@ def _no_fault_injection_leak(request):
             f"gateway env leaked into an unrelated test: {leaked_gw} "
             "(unset PADDLE_GATEWAY_*/PADDLE_ROUTER_*, or pass them via "
             "monkeypatch / constructor args inside the cluster suite)",
+            pytrace=False)
+    # serving-quant config leaks (PADDLE_TPU_DECODE_*): a leaked weight
+    # flavor silently re-stacks every later engine's weights and a
+    # leaked cache flavor flips every later pool to int8 — quant tests
+    # set these via monkeypatch (invisible here: this fixture reads the
+    # env BEFORE the test body) or the weight_quant=/kv_quant= ctor
+    # args, so any hit is a genuine cross-test leak
+    leaked_q = quant_env_active()
+    if leaked_q and "test_quant_serving" not in fspath:
+        pytest.fail(
+            f"serving-quant env leaked into an unrelated test: "
+            f"{leaked_q} (unset PADDLE_TPU_DECODE_*, or use monkeypatch "
+            "/ the weight_quant=/kv_quant= constructor args)",
             pytrace=False)
     yield
 

@@ -112,9 +112,11 @@ class TestFlatVsRowParity:
     def test_int8_cache_parity(self, monkeypatch):
         """PADDLE_TPU_DECODE_INT8_CACHE=1 pins the quantized flat
         branches (flat_write's int8 scatter, flat_attend_seg's
-        dequant gathers through flat_gather_view's sc path — the flat
-        kernel has no i8 flavor, so this IS the fallback's test):
-        exact flat-vs-row parity on the quantized pool."""
+        dequant gathers through flat_gather_view's sc path — Bt=4
+        here sits below the i8 kernel's 32-sublane minimum, so this
+        exercises the gather FALLBACK; the flat i8 Pallas kernel
+        itself is covered in tests/test_quant_serving.py): exact
+        flat-vs-row parity on the quantized pool."""
         monkeypatch.setenv("PADDLE_TPU_DECODE_INT8_CACHE", "1")
         fmt, embed, head = _model(seed=66)
         rng = np.random.RandomState(5)

@@ -139,6 +139,25 @@ class TestMeshPagedParity:
                                prefix_cache_blocks=16)
         assert got == ref
 
+    def test_int4_weights_parity(self):
+        # int4-PACKED weights under the mesh: the row-parallel stacks
+        # (lin_w/f2_w) shard their packed contracted axis, so the mesh
+        # runs nibble-split XLA dots with a partial-sum all-reduce —
+        # tokens must still match the single-device int4 engine
+        # bit-for-bit (same flavor = same numerics; fp is NOT the
+        # oracle here)
+        ref, got, eng = self._ab(weight_quant="int4")
+        assert got == ref
+        assert eng.dec._weight_quant_mode() == "int4"
+        assert eng.dec._weight_shard_mesh() is not None
+
+    def test_int4_flat_budget_parity(self):
+        # packed weights x the flat [T] core x the mesh — the full
+        # quantized-serving composition in one gate
+        ref, got, _ = self._ab(weight_quant="int4", kv_quant="int8",
+                               flat_budget=True, token_budget=16)
+        assert got == ref
+
     def test_zero_retraces_after_warmup(self):
         waves = _reqs()
         _mesh(2)
@@ -353,6 +372,35 @@ class TestWeightSharding:
             full = tuple(stk[k].shape)
             assert tuple(stk[k].sharding.shard_shape(full)) == full
 
+    def test_int4_packed_placement(self, monkeypatch):
+        # the packed stacks keep the int8 key vocabulary, so the spec
+        # table applies unchanged; the row-parallel contracted axes
+        # (lin_w axis 1 = nh*hd, f2_w axis 1 = FF) pack to HALF length
+        # in int8 bytes and the 'mp' split must land on whole bytes
+        monkeypatch.setenv("PADDLE_TPU_DECODE_INT4_WEIGHTS", "1")
+        _mesh(2)
+        eng = _engine()
+        stk = eng.dec._stacked()
+        heads = eng.dec.fmt.num_heads * eng.dec.fmt.head_dim
+        for k, axis, full_len in (("lin_w", 1, heads),
+                                  ("f2_w", 1, FF)):
+            a = stk[k]
+            assert str(a.dtype) == "int8", k
+            full = tuple(a.shape)
+            assert full[axis] * 2 == full_len, (k, full)
+            local = tuple(a.sharding.shard_shape(full))
+            assert local[axis] * 2 == full[axis], (k, full, local)
+        # column-parallel packed stacks shard their OUTPUT axis, pack
+        # the (unsharded) contracted axis; scale mirrors ride along
+        qkv = stk["qkv_w"]
+        fullq = tuple(qkv.shape)
+        assert fullq[-1] * 2 == E and str(qkv.dtype) == "int8"
+        assert qkv.sharding.shard_shape(fullq)[1] * 2 == fullq[1]
+        for k in ("qkv_w_s", "f1_w_s"):
+            full = tuple(stk[k].shape)
+            local = tuple(stk[k].sharding.shard_shape(full))
+            assert local[-1] * 2 == full[-1], (k, full, local)
+
     def test_head_replicates_when_vocab_indivisible(self):
         # V=97 does not divide mp=2: the Linear head's per-key
         # fallback keeps it replicated (the documented graceful path)
@@ -419,8 +467,9 @@ class TestWeightSharding:
 
 def test_sharding_spec_tool_pinned(capsys):
     """tools/check_sharding_spec.py as a tier-1 test: every stacked
-    param key carries an explicit PartitionSpec (fp AND int8 flavors),
-    and mp=2 placement matches the table exactly."""
+    param key carries an explicit PartitionSpec (fp, int8 AND
+    int4-packed flavors), mp=2 placement matches the table exactly,
+    and the int4 contracted axes pack to whole-byte halves."""
     spec = importlib.util.spec_from_file_location(
         "check_sharding_spec",
         os.path.join(REPO_ROOT, "tools", "check_sharding_spec.py"))

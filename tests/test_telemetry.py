@@ -362,13 +362,14 @@ class TestSloGoodput:
         assert (m["slo_violated_queue"]
                 + m["slo_violated_service"]) == 3
         snap = eng.telemetry_snapshot()
-        # v7: the v4 QoS additions (preemption accounting in the
+        # v8: the v4 QoS additions (preemption accounting in the
         # requests block, per-class queue depths at the top level, the
         # per-class queue-violation split in slo) plus the role (v5),
-        # health (v6) and weights (v7) blocks — the full-version pin
-        # lives in tools/check_metrics_surface.py; here just assert the
+        # health (v6) and weights (v7, + quant modes in v8) blocks —
+        # the full-version pin lives in
+        # tools/check_metrics_surface.py; here just assert the
         # snapshot self-reports the module constant
-        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 7
+        assert snap["schema_version"] == SNAPSHOT_SCHEMA_VERSION == 8
         assert snap["requests"]["migrated_in"] == 0
         assert snap["requests"]["migrated_out"] == 0
         assert snap["requests"]["preempted"] == 0

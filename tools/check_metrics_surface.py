@@ -280,6 +280,41 @@ def _check_mesh_shard_surface(failures):
                     f"stacked qkv_w is not head-sharded on device: "
                     f"local shard {qshard} vs full "
                     f"{tuple(stk['qkv_w'].shape)}")
+        # v8 quant honesty under the mesh: an int4+int8 engine's
+        # gauges must report PACKED/quantized bytes (the arrays the
+        # step actually dispatches), the byte identity must still
+        # recover ITS dense total, and the snapshot weights block must
+        # carry the quant modes the capacity planner keys on
+        eng4, _rng4, _V4 = _build_engine(weight_quant="int4",
+                                         kv_quant="int8")
+        m4 = eng4.metrics()
+        stk4 = eng4.dec._stacked()
+        e_dim = int(eng4.dec.fmt.qkv_weights[0]._data.shape[-1])
+        if str(stk4["f2_w"].dtype) != "int8" or \
+                stk4["qkv_w"].shape[-1] * 2 != e_dim:
+            failures.append(
+                f"int4 engine's stacked qkv_w is not nibble-packed: "
+                f"dtype={stk4['qkv_w'].dtype}, contracted axis "
+                f"{stk4['qkv_w'].shape[-1]} (expected {e_dim // 2})")
+        dense4 = sum(math.prod(a.shape) * a.dtype.itemsize
+                     for a in eng4._weight_arrays())
+        n4 = m4["weight_shard_count"]
+        pd4, rp4 = (m4["weight_bytes_per_device"],
+                    m4["weight_bytes_replicated"])
+        if (pd4 - rp4) * n4 + rp4 != dense4:
+            failures.append(
+                f"int4 weight byte identity broke: (per_device={pd4} "
+                f"- replicated={rp4}) x {n4} + {rp4} != quantized "
+                f"dense {dense4}")
+        snap4 = eng4.telemetry_snapshot()
+        w4 = snap4.get("weights") or {}
+        if (w4.get("weight_quant"), w4.get("kv_quant")) != \
+                ("int4", "int8"):
+            failures.append(
+                f"v8 snapshot weights block misreports quant modes: "
+                f"weight_quant={w4.get('weight_quant')!r} "
+                f"kv_quant={w4.get('kv_quant')!r}, expected "
+                "('int4', 'int8')")
         text = eng.metrics_prometheus()
         for k in ("kv_shard_count", "kv_shard_heads",
                   "kv_shard_pool_bytes", "weight_shard_count",
@@ -526,11 +561,11 @@ def _check_role_surface(failures):
     from paddle_tpu.serving_cluster import protocol as P
     from paddle_tpu.serving_cluster.router import Router
 
-    if SNAPSHOT_SCHEMA_VERSION != 7:
+    if SNAPSHOT_SCHEMA_VERSION != 8:
         failures.append(
             f"SNAPSHOT_SCHEMA_VERSION = {SNAPSHOT_SCHEMA_VERSION!r}, "
-            "pinned 7 (v7 = the weights block — bump this check "
-            "deliberately alongside the schema)")
+            "pinned 8 (v8 = quant modes in the weights block — bump "
+            "this check deliberately alongside the schema)")
     for key in ("role", "handoff", "do_sample", "health", "weights"):
         if key not in SNAPSHOT_REQUIRED_KEYS:
             failures.append(

@@ -8,18 +8,22 @@ stack time per ``generation.STACKED_PARAM_SPECS``. This check makes
 that table STRUCTURAL:
 
   1. key coverage, both directions — every key the stack can emit
-     (fp AND int8 weight flavors) has an explicit spec entry (sharded
-     or declared-replicated ``P()``), and the table carries no dead
-     entries. A new param key without a spec fails tier-1 instead of
-     silently replicating a possibly-huge tensor on every device.
+     (fp, int8 AND int4-packed weight flavors) has an explicit spec
+     entry (sharded or declared-replicated ``P()``), and the table
+     carries no dead entries. A new param key without a spec fails
+     tier-1 instead of silently replicating a possibly-huge tensor on
+     every device.
   2. spec sanity — each entry's sharded axes fit the actual array rank
      and use only the 'mp' mesh axis (the serving mesh's weight axis).
   3. placement truth, probed on a real mp=2 mesh — every stacked array
      lands with EXACTLY its table spec: sharded keys hold 1/mp of the
      bytes per device, declared-replicated keys the full array; the
-     int8 scale mirrors of column-parallel weights (qkv_w_s / f1_w_s)
-     shard WITH their weight, so a quantized stack cannot silently
-     gather full weights on placement.
+     int8/int4 scale mirrors of column-parallel weights (qkv_w_s /
+     f1_w_s) shard WITH their weight, so a quantized stack cannot
+     silently gather full weights on placement. The int4 stack is
+     additionally checked STRUCTURALLY: every contracted axis packs to
+     half length in int8 bytes, so the row-parallel 'mp' split lands
+     on whole bytes (the pack-straddle guard made a tier-1 fact).
 
 Runs in-process as a tier-1 test, so fleet topology state is saved and
 restored around the mesh probe.
@@ -50,19 +54,28 @@ def _build_decoder():
     return FusedDecoder(fmt, embed, head, max_seq_len=64)
 
 
-def _stack_keys(dec, int8):
-    prior = os.environ.get("PADDLE_TPU_DECODE_INT8_WEIGHTS")
+_MODE_VARS = ("PADDLE_TPU_DECODE_INT8_WEIGHTS",
+              "PADDLE_TPU_DECODE_INT4_WEIGHTS")
+
+
+def _stack_keys(dec, mode):
+    """Build the decoder's stack in the given weight flavor ('fp',
+    'int8' or 'int4') via the env knobs, restoring the prior env."""
+    prior = {v: os.environ.get(v) for v in _MODE_VARS}
     try:
-        if int8:
+        for v in _MODE_VARS:
+            os.environ.pop(v, None)
+        if mode == "int8":
             os.environ["PADDLE_TPU_DECODE_INT8_WEIGHTS"] = "1"
-        else:
-            os.environ.pop("PADDLE_TPU_DECODE_INT8_WEIGHTS", None)
+        elif mode == "int4":
+            os.environ["PADDLE_TPU_DECODE_INT4_WEIGHTS"] = "1"
         return dict(dec._stacked())
     finally:
-        if prior is None:
-            os.environ.pop("PADDLE_TPU_DECODE_INT8_WEIGHTS", None)
-        else:
-            os.environ["PADDLE_TPU_DECODE_INT8_WEIGHTS"] = prior
+        for v, val in prior.items():
+            if val is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = val
 
 
 def main(argv=None):
@@ -72,8 +85,37 @@ def main(argv=None):
 
     failures = []
     dec = _build_decoder()
-    stacks = {"fp": _stack_keys(dec, int8=False),
-              "int8": _stack_keys(dec, int8=True)}
+    stacks = {"fp": _stack_keys(dec, "fp"),
+              "int8": _stack_keys(dec, "int8"),
+              "int4": _stack_keys(dec, "int4")}
+
+    # ---- 0. int4 pack structure: two nibbles per byte along every
+    # CONTRACTED axis (qkv_w/f1_w pack E, lin_w the concatenated head
+    # axis, f2_w the FFN axis) — the halved axes are what make the
+    # row-parallel 'mp' split fall on whole bytes, and what the byte
+    # gauges' "quartered" claim rests on
+    f = dec.fmt
+    e_dim = int(f.qkv_weights[0]._data.shape[-1])
+    ff_dim = int(f.ffn1_weights[0]._data.shape[-1])
+    heads = f.num_heads * f.head_dim
+    i4 = stacks["int4"]
+    for k, axis, full_len in (("qkv_w", 2, e_dim), ("lin_w", 1, heads),
+                              ("f1_w", 1, e_dim), ("f2_w", 1, ff_dim)):
+        a = i4[k]
+        if str(a.dtype) != "int8":
+            failures.append(
+                f"int4 stack key {k!r} has dtype {a.dtype}, expected "
+                "int8 bytes holding two nibbles")
+        if a.shape[axis] * 2 != full_len:
+            failures.append(
+                f"int4 stack key {k!r} axis {axis} is "
+                f"{a.shape[axis]}, expected the packed half of "
+                f"{full_len} — the contracted axis did not pack")
+    for k in ("qkv_w_s", "lin_w_s", "f1_w_s", "f2_w_s"):
+        if k not in i4:
+            failures.append(
+                f"int4 stack lost its scale mirror {k!r} — dequant "
+                "cannot be applied without it")
 
     # ---- 1. key coverage, both directions
     emitted = set()
@@ -127,8 +169,8 @@ def main(argv=None):
         _fleet_state.update(strategy=None, hcg=None, initialized=False)
         mesh = init_serving_mesh(2)
         sharded_any = {}
-        for flavor in ("fp", "int8"):
-            stk = _stack_keys(dec, int8=(flavor == "int8"))
+        for flavor in ("fp", "int8", "int4"):
+            stk = _stack_keys(dec, flavor)
             for k, a in sorted(stk.items()):
                 spec = STACKED_PARAM_SPECS.get(k)
                 if spec is None:
@@ -152,8 +194,8 @@ def main(argv=None):
                 sharded_any.setdefault(k, False)
                 if local != full:
                     sharded_any[k] = True
-        # the int8 scale mirrors of column-parallel weights must ride
-        # their weight's shard (the satellite's silent-gather trap)
+        # the int8/int4 scale mirrors of column-parallel weights must
+        # ride their weight's shard (the silent-gather trap)
         for k in ("qkv_w_s", "f1_w_s"):
             if k in sharded_any and not sharded_any[k]:
                 failures.append(
@@ -163,7 +205,7 @@ def main(argv=None):
                     "dispatch")
         # per-device weight bytes must actually drop ~1/mp: the whole
         # point of the table
-        stk = _stack_keys(dec, int8=False)
+        stk = _stack_keys(dec, "fp")
         dense = sum(math.prod(a.shape) * a.dtype.itemsize
                     for a in stk.values())
         per_dev = sum(
@@ -185,9 +227,10 @@ def main(argv=None):
         return 1
     print(
         f"check_sharding_spec: ok ({len(emitted)} stacked keys across "
-        "fp+int8 flavors covered by STACKED_PARAM_SPECS; specs "
-        "rank-checked; mp=2 placement matches the table exactly; "
-        "column-parallel int8 scale mirrors shard with their weights)")
+        "fp+int8+int4 flavors covered by STACKED_PARAM_SPECS; specs "
+        "rank-checked; int4 contracted axes pack to whole-byte halves; "
+        "mp=2 placement matches the table exactly; column-parallel "
+        "quant scale mirrors shard with their weights)")
     return 0
 
 
